@@ -1,0 +1,97 @@
+from tpusim.api.podspec import expand_simulation_pods, parse_simulation_pods
+from tpusim.api.snapshot import (
+    ClusterSnapshot,
+    load_nodes_checkpoint,
+    load_pods_checkpoint,
+    make_node,
+    make_pod,
+    synthetic_cluster,
+)
+
+# the reference quickstart spec shape (reference: etc/pod.yaml:1-18)
+QUICKSTART_YAML = """
+- name: A
+  num: 10
+  pod:
+    spec:
+      containers:
+      - resources:
+          requests:
+            cpu: 1
+            memory: 1
+- name: B
+  num: 10
+  pod:
+    spec:
+      containers:
+      - resources:
+          requests:
+            cpu: 100
+            memory: 1000
+"""
+
+
+def test_parse_quickstart_yaml():
+    sim_pods = parse_simulation_pods(QUICKSTART_YAML)
+    assert len(sim_pods) == 2
+    assert sim_pods[0].name == "A" and sim_pods[0].num == 10
+    assert sim_pods[1].pod.spec.containers[0].requests["cpu"].milli_value() == 100_000
+
+
+def test_expand_simulation_pods():
+    sim_pods = parse_simulation_pods(QUICKSTART_YAML)
+    pods = expand_simulation_pods(sim_pods, namespace="sim")
+    assert len(pods) == 20
+    names = {p.name for p in pods}
+    assert len(names) == 20  # unique uuids
+    for p in pods:
+        assert p.metadata.uid == p.metadata.name  # options.go:91-92
+        assert p.metadata.labels["SimulationName"] in ("A", "B")
+        assert p.namespace == "sim"
+
+
+def test_expand_deterministic():
+    sim_pods = parse_simulation_pods(QUICKSTART_YAML)
+    pods = expand_simulation_pods(sim_pods, deterministic_ids=True)
+    assert pods[0].name == "A-0"
+    assert pods[19].name == "B-9"
+
+
+def test_parse_json_podspec():
+    text = '[{"name": "X", "num": 2, "pod": {"spec": {"containers": []}}}]'
+    sim_pods = parse_simulation_pods(text)
+    assert sim_pods[0].num == 2
+    assert len(expand_simulation_pods(sim_pods)) == 2
+
+
+def test_snapshot_roundtrip(tmp_path):
+    snap = synthetic_cluster(3)
+    snap.pods.append(make_pod("p0", milli_cpu=100, node_name="node-0", phase="Running"))
+    path = tmp_path / "snap.json"
+    snap.save(str(path))
+    loaded = ClusterSnapshot.load(str(path))
+    assert len(loaded.nodes) == 3
+    assert loaded.pods[0].spec.node_name == "node-0"
+    assert loaded.to_obj() == snap.to_obj()
+
+
+def test_checkpoint_files(tmp_path):
+    import json
+
+    pods = [make_pod(f"p{i}", milli_cpu=100).to_obj() for i in range(4)]
+    nodes = [make_node(f"n{i}").to_obj() for i in range(2)]
+    (tmp_path / "pods.json").write_text(json.dumps({"items": pods}))
+    (tmp_path / "nodes.json").write_text(json.dumps(nodes))
+    assert len(load_pods_checkpoint(str(tmp_path / "pods.json"))) == 4
+    assert len(load_nodes_checkpoint(str(tmp_path / "nodes.json"))) == 2
+
+
+def test_make_node_fixture():
+    n = make_node("n1", milli_cpu=2000, memory=4 * 1024**3, pods=10,
+                  taints=[{"key": "k", "value": "v", "effect": "NoSchedule"}],
+                  labels={"zone": "a"})
+    assert n.status.allocatable["cpu"].milli_value() == 2000
+    assert n.status.allocatable["pods"].value() == 10
+    assert n.spec.taints[0].effect == "NoSchedule"
+    assert n.metadata.labels["zone"] == "a"
+    assert n.metadata.labels["kubernetes.io/hostname"] == "n1"

@@ -1,0 +1,63 @@
+from tpusim.api.quantity import Quantity, int_value, milli_value, parse_quantity
+
+
+def test_plain_integers():
+    assert parse_quantity("1").value() == 1
+    assert parse_quantity("1000").value() == 1000
+    assert parse_quantity(7).value() == 7
+
+
+def test_milli_suffix():
+    assert parse_quantity("100m").milli_value() == 100
+    assert parse_quantity("100m").value() == 1  # Value() rounds up
+    assert parse_quantity("1500m").value() == 2
+    assert parse_quantity("1500m").milli_value() == 1500
+
+
+def test_decimal_cpu():
+    assert parse_quantity("0.1").milli_value() == 100
+    assert parse_quantity("1.5").milli_value() == 1500
+    assert parse_quantity("2.5").value() == 3
+
+
+def test_binary_suffixes():
+    assert parse_quantity("1Ki").value() == 1024
+    assert parse_quantity("1Mi").value() == 1024**2
+    assert parse_quantity("2Gi").value() == 2 * 1024**3
+
+
+def test_decimal_suffixes():
+    assert parse_quantity("1k").value() == 1000
+    assert parse_quantity("5M").value() == 5_000_000
+    assert parse_quantity("3G").value() == 3_000_000_000
+
+
+def test_exponent():
+    assert parse_quantity("1e3").value() == 1000
+    assert parse_quantity("12e6").value() == 12_000_000
+    assert parse_quantity("1E2").value() == 100  # exponent, not exbi (needs digits after)
+
+
+def test_sub_milli_rounds_up():
+    assert parse_quantity("1n").milli_value() == 1
+    assert parse_quantity("100u").milli_value() == 1
+
+
+def test_arithmetic_and_compare():
+    a = parse_quantity("1500m")
+    b = parse_quantity("0.5")
+    assert (a + b).milli_value() == 2000
+    assert (a - b).milli_value() == 1000
+    assert b < a
+    assert parse_quantity("1Gi") == Quantity(1024**3)
+
+
+def test_helpers():
+    assert milli_value(None) == 0
+    assert int_value("1Gi") == 1024**3
+    assert milli_value("2") == 2000
+
+
+def test_str_roundtrip_keeps_text():
+    assert str(parse_quantity("100m")) == "100m"
+    assert str(parse_quantity("1Gi")) == "1Gi"

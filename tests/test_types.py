@@ -1,0 +1,125 @@
+from tpusim.api.types import (
+    Affinity,
+    LabelSelector,
+    Node,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    ResourceType,
+    Taint,
+    Toleration,
+)
+
+
+def test_pod_roundtrip():
+    obj = {
+        "metadata": {"name": "p1", "namespace": "ns", "uid": "u1",
+                     "labels": {"app": "web"}},
+        "spec": {
+            "containers": [
+                {"name": "c1",
+                 "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}},
+                 "ports": [{"hostPort": 8080, "containerPort": 80}]},
+            ],
+            "nodeSelector": {"disk": "ssd"},
+            "tolerations": [{"key": "k", "operator": "Exists", "effect": "NoSchedule"}],
+        },
+        "status": {"phase": "Running"},
+    }
+    pod = Pod.from_obj(obj)
+    assert pod.name == "p1"
+    assert pod.key() == "ns/p1"
+    assert pod.spec.containers[0].requests["cpu"].milli_value() == 500
+    assert pod.spec.containers[0].ports[0].host_port == 8080
+    back = Pod.from_obj(pod.to_obj())
+    assert back.to_obj() == pod.to_obj()
+
+
+def test_node_roundtrip():
+    obj = {
+        "metadata": {"name": "n1", "labels": {"zone": "a"}},
+        "spec": {"unschedulable": True,
+                 "taints": [{"key": "gpu", "value": "yes", "effect": "NoSchedule"}]},
+        "status": {
+            "capacity": {"cpu": "4", "memory": "16Gi", "pods": "110"},
+            "allocatable": {"cpu": "3800m", "memory": "15Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+    node = Node.from_obj(obj)
+    assert node.name == "n1"
+    assert node.spec.unschedulable
+    assert node.status.allocatable["cpu"].milli_value() == 3800
+    assert Node.from_obj(node.to_obj()).to_obj() == node.to_obj()
+
+
+def test_toleration_matching():
+    t_noschedule = Taint(key="a", value="v", effect="NoSchedule")
+    assert Toleration(key="a", operator="Equal", value="v",
+                      effect="NoSchedule").tolerates(t_noschedule)
+    assert not Toleration(key="a", operator="Equal", value="x",
+                          effect="NoSchedule").tolerates(t_noschedule)
+    # empty effect matches all effects
+    assert Toleration(key="a", operator="Exists").tolerates(t_noschedule)
+    # empty key + Exists matches everything
+    assert Toleration(operator="Exists").tolerates(t_noschedule)
+    # effect mismatch
+    assert not Toleration(key="a", operator="Exists",
+                          effect="NoExecute").tolerates(t_noschedule)
+    # default operator is Equal
+    assert Toleration(key="a", value="v").tolerates(t_noschedule)
+
+
+def test_node_selector_requirement_ops():
+    labels = {"zone": "a", "n": "5"}
+    assert NodeSelectorRequirement("zone", "In", ["a", "b"]).matches(labels)
+    assert not NodeSelectorRequirement("zone", "In", ["c"]).matches(labels)
+    assert NodeSelectorRequirement("zone", "NotIn", ["c"]).matches(labels)
+    assert NodeSelectorRequirement("missing", "NotIn", ["c"]).matches(labels)
+    assert NodeSelectorRequirement("zone", "Exists").matches(labels)
+    assert not NodeSelectorRequirement("missing", "Exists").matches(labels)
+    assert NodeSelectorRequirement("missing", "DoesNotExist").matches(labels)
+    assert NodeSelectorRequirement("n", "Gt", ["3"]).matches(labels)
+    assert not NodeSelectorRequirement("n", "Gt", ["7"]).matches(labels)
+    assert NodeSelectorRequirement("n", "Lt", ["7"]).matches(labels)
+    assert not NodeSelectorRequirement("zone", "Gt", ["1"]).matches(labels)  # non-int
+
+
+def test_node_selector_term_and_empty():
+    term = NodeSelectorTerm([NodeSelectorRequirement("zone", "In", ["a"]),
+                             NodeSelectorRequirement("disk", "Exists")])
+    assert term.matches({"zone": "a", "disk": "ssd"})
+    assert not term.matches({"zone": "a"})
+    assert NodeSelectorTerm([]).matches({"anything": "x"})
+
+
+def test_label_selector():
+    sel = LabelSelector(match_labels={"app": "web"},
+                        match_expressions=[NodeSelectorRequirement("tier", "In", ["fe"])])
+    assert sel.matches({"app": "web", "tier": "fe"})
+    assert not sel.matches({"app": "web", "tier": "be"})
+    assert LabelSelector().matches({"x": "y"})  # empty selector matches all
+
+
+def test_affinity_parse():
+    aff = Affinity.from_obj({
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a"]}]}
+                ]
+            },
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 5, "preference": {
+                    "matchExpressions": [{"key": "disk", "operator": "Exists"}]}}
+            ],
+        }
+    })
+    assert aff.node_affinity.required_terms[0].matches({"zone": "a"})
+    assert aff.node_affinity.preferred[0].weight == 5
+
+
+def test_resource_type():
+    assert ResourceType.from_string("pods") is ResourceType.PODS
+    assert ResourceType.PODS.object_type() is Pod
+    assert ResourceType.NODES.object_type() is Node

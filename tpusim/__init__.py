@@ -1,0 +1,19 @@
+"""tpusim — a TPU-native Kubernetes scheduling simulator.
+
+Rebuilds the capabilities of xiaoxubeii/kubernetes-schedule-simulator (an offline
+cluster-capacity / schedule simulator wrapping the vendored kube-scheduler) as a
+batched bin-packing engine on JAX/XLA, with a pure-Python reference backend for
+placement-parity testing.
+
+Layout (mirrors SURVEY.md §2's component inventory):
+  api/        domain model + IO  (reference: pkg/api/api.go, cmd/app/options/options.go)
+  engine/     scheduling engine, Go-parity semantics
+              (reference: vendor/k8s.io/kubernetes/pkg/scheduler/*)
+  jaxe/       the JAX/TPU backend: columnar state, vmapped kernels, scan bind loop
+  framework/  store / events / strategy / report
+              (reference: pkg/framework/*)
+  simulator   ClusterCapacity orchestrator (reference: pkg/scheduler/simulator.go)
+  cli         command-line entry (reference: cmd/app/server.go)
+"""
+
+__version__ = "0.1.0"

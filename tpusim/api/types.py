@@ -1,0 +1,917 @@
+"""Domain model: the subset of the Kubernetes object model the scheduling engine reads.
+
+Mirrors the reference's typed API layer (reference: pkg/api/api.go:27-83) plus the
+v1 fields consumed by the vendored engine (requests/limits, init containers,
+nodeSelector/affinity, tolerations, host ports, node conditions, taints,
+allocatable, labels — see SURVEY.md §7 step 1). Objects round-trip to/from
+k8s-style camelCase dicts so `pods.json` / `nodes.json` checkpoints
+(reference: pkg/main.go:147-179) load unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+import enum
+from typing import Any, Optional
+
+from tpusim.api.quantity import Quantity, parse_quantity
+
+# v1 resource names as of the reference's vintage (k8s ~1.10):
+# v1.ResourceNvidiaGPU = "alpha.kubernetes.io/nvidia-gpu".
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_NVIDIA_GPU = "alpha.kubernetes.io/nvidia-gpu"
+RESOURCE_EPHEMERAL_STORAGE = "ephemeral-storage"
+RESOURCE_PODS = "pods"
+
+DEFAULT_NAMESPACE = "default"
+
+# effects
+TAINT_NO_SCHEDULE = "NoSchedule"
+TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_NO_EXECUTE = "NoExecute"
+
+
+def is_scalar_resource_name(name: str) -> bool:
+    """Reference: v1helper.IsScalarResourceName = extended or hugepages.
+
+    Extended means namespaced outside the default namespace: the name contains a
+    "/" and does not contain "kubernetes.io/" (used at predicates.go:687-696,
+    755-767). "alpha.kubernetes.io/nvidia-gpu" is therefore NOT scalar — GPUs
+    are tracked as a first-class field.
+    """
+    return ("/" in name and "kubernetes.io/" not in name) or name.startswith("hugepages-")
+
+
+class ResourceType(enum.Enum):
+    """Reference: pkg/api/api.go:27-58 (ResourceType enum + ObjectType mapping)."""
+
+    PODS = "pods"
+    PERSISTENT_VOLUMES = "persistentvolumes"
+    NODES = "nodes"
+    SERVICES = "services"
+    PERSISTENT_VOLUME_CLAIMS = "persistentvolumeclaims"
+    STORAGE_CLASSES = "storageclasses"
+
+    @staticmethod
+    def from_string(s: str) -> "ResourceType":
+        """Reference: pkg/api/api.go:60-77 (StringToResourceType)."""
+        try:
+            return ResourceType(s.lower())
+        except ValueError:
+            raise ValueError(f"unknown resource type: {s}")
+
+    def object_type(self):
+        return _RESOURCE_OBJECT_TYPES[self]
+
+
+def _get(d: dict, *keys, default=None):
+    for k in keys:
+        if d is None:
+            return default
+        d = d.get(k)
+    return d if d is not None else default
+
+
+# ---------------------------------------------------------------------------
+# metadata
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "OwnerReference":
+        return cls(
+            api_version=o.get("apiVersion", ""),
+            kind=o.get("kind", ""),
+            name=o.get("name", ""),
+            uid=o.get("uid", ""),
+            controller=bool(o.get("controller", False)),
+        )
+
+    def to_obj(self) -> dict:
+        o = {"apiVersion": self.api_version, "kind": self.kind, "name": self.name, "uid": self.uid}
+        if self.controller:
+            o["controller"] = True
+        return o
+
+
+@dataclass
+class ObjectMeta:
+    """namespace stays "" when absent (cluster-scoped objects like Node never
+    get one); namespaced accessors default it to DEFAULT_NAMESPACE at read time
+    so checkpoints round-trip byte-identical."""
+
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    labels: dict = field(default_factory=dict)
+    annotations: dict = field(default_factory=dict)
+    owner_references: list = field(default_factory=list)
+
+    @classmethod
+    def from_obj(cls, o: Optional[dict]) -> "ObjectMeta":
+        o = o or {}
+        return cls(
+            name=o.get("name", ""),
+            namespace=o.get("namespace") or "",
+            uid=o.get("uid", ""),
+            labels=dict(o.get("labels") or {}),
+            annotations=dict(o.get("annotations") or {}),
+            owner_references=[OwnerReference.from_obj(r) for r in o.get("ownerReferences") or []],
+        )
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {"name": self.name}
+        if self.namespace:
+            o["namespace"] = self.namespace
+        if self.uid:
+            o["uid"] = self.uid
+        if self.labels:
+            o["labels"] = dict(self.labels)
+        if self.annotations:
+            o["annotations"] = dict(self.annotations)
+        if self.owner_references:
+            o["ownerReferences"] = [r.to_obj() for r in self.owner_references]
+        return o
+
+    def controller_ref(self) -> Optional[OwnerReference]:
+        for r in self.owner_references:
+            if r.controller:
+                return r
+        return None
+
+
+# ---------------------------------------------------------------------------
+# selectors / affinity
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = "In"  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: list = field(default_factory=list)
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "NodeSelectorRequirement":
+        return cls(key=o.get("key", ""), operator=o.get("operator", "In"),
+                   values=list(o.get("values") or []))
+
+    def to_obj(self) -> dict:
+        o = {"key": self.key, "operator": self.operator}
+        if self.values:
+            o["values"] = list(self.values)
+        return o
+
+    def matches(self, labels: dict) -> bool:
+        """apimachinery labels.Requirement.Matches semantics."""
+        has = self.key in labels
+        if self.operator == "In":
+            return has and labels[self.key] in self.values
+        if self.operator == "NotIn":
+            return (not has) or labels[self.key] not in self.values
+        if self.operator == "Exists":
+            return has
+        if self.operator == "DoesNotExist":
+            return not has
+        if self.operator in ("Gt", "Lt"):
+            if not has or len(self.values) != 1:
+                return False
+            try:
+                lhs = int(labels[self.key])
+                rhs = int(self.values[0])
+            except ValueError:
+                return False
+            return lhs > rhs if self.operator == "Gt" else lhs < rhs
+        return False
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: list = field(default_factory=list)
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "NodeSelectorTerm":
+        return cls(match_expressions=[NodeSelectorRequirement.from_obj(e)
+                                      for e in o.get("matchExpressions") or []])
+
+    def to_obj(self) -> dict:
+        return {"matchExpressions": [e.to_obj() for e in self.match_expressions]}
+
+    def matches(self, labels: dict) -> bool:
+        """All requirements must match (ANDed). An empty term matches everything
+        (NodeSelectorRequirementsAsSelector of [] is labels.Everything())."""
+        return all(e.matches(labels) for e in self.match_expressions)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 0
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "PreferredSchedulingTerm":
+        return cls(weight=int(o.get("weight", 0)),
+                   preference=NodeSelectorTerm.from_obj(o.get("preference") or {}))
+
+    def to_obj(self) -> dict:
+        return {"weight": self.weight, "preference": self.preference.to_obj()}
+
+
+@dataclass
+class NodeAffinity:
+    # requiredDuringSchedulingIgnoredDuringExecution: list of terms (ORed)
+    required_terms: Optional[list] = None
+    preferred: list = field(default_factory=list)
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "NodeAffinity":
+        req = o.get("requiredDuringSchedulingIgnoredDuringExecution")
+        return cls(
+            required_terms=None if req is None else [
+                NodeSelectorTerm.from_obj(t) for t in req.get("nodeSelectorTerms") or []],
+            preferred=[PreferredSchedulingTerm.from_obj(t)
+                       for t in o.get("preferredDuringSchedulingIgnoredDuringExecution") or []],
+        )
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.required_terms is not None:
+            o["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                "nodeSelectorTerms": [t.to_obj() for t in self.required_terms]}
+        if self.preferred:
+            o["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                t.to_obj() for t in self.preferred]
+        return o
+
+
+@dataclass
+class LabelSelector:
+    """A nil selector in Go is represented as None here (matches nothing at call
+    sites); an empty LabelSelector() matches everything."""
+
+    match_labels: dict = field(default_factory=dict)
+    match_expressions: list = field(default_factory=list)
+
+    @classmethod
+    def from_obj(cls, o: Optional[dict]) -> Optional["LabelSelector"]:
+        if o is None:
+            return None
+        return cls(match_labels=dict(o.get("matchLabels") or {}),
+                   match_expressions=[NodeSelectorRequirement.from_obj(e)
+                                      for e in o.get("matchExpressions") or []])
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.match_labels:
+            o["matchLabels"] = dict(self.match_labels)
+        if self.match_expressions:
+            o["matchExpressions"] = [e.to_obj() for e in self.match_expressions]
+        return o
+
+    def matches(self, labels: dict) -> bool:
+        """metav1.LabelSelectorAsSelector: matchLabels AND matchExpressions.
+        An empty selector matches all objects."""
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        return all(e.matches(labels) for e in self.match_expressions)
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    namespaces: list = field(default_factory=list)
+    topology_key: str = ""
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "PodAffinityTerm":
+        return cls(label_selector=LabelSelector.from_obj(o.get("labelSelector")),
+                   namespaces=list(o.get("namespaces") or []),
+                   topology_key=o.get("topologyKey", ""))
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.label_selector is not None:
+            o["labelSelector"] = self.label_selector.to_obj()
+        if self.namespaces:
+            o["namespaces"] = list(self.namespaces)
+        if self.topology_key:
+            o["topologyKey"] = self.topology_key
+        return o
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 0
+    pod_affinity_term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "WeightedPodAffinityTerm":
+        return cls(weight=int(o.get("weight", 0)),
+                   pod_affinity_term=PodAffinityTerm.from_obj(o.get("podAffinityTerm") or {}))
+
+    def to_obj(self) -> dict:
+        return {"weight": self.weight, "podAffinityTerm": self.pod_affinity_term.to_obj()}
+
+
+@dataclass
+class PodAffinity:
+    required: list = field(default_factory=list)  # list[PodAffinityTerm]
+    preferred: list = field(default_factory=list)  # list[WeightedPodAffinityTerm]
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "PodAffinity":
+        return cls(
+            required=[PodAffinityTerm.from_obj(t)
+                      for t in o.get("requiredDuringSchedulingIgnoredDuringExecution") or []],
+            preferred=[WeightedPodAffinityTerm.from_obj(t)
+                       for t in o.get("preferredDuringSchedulingIgnoredDuringExecution") or []],
+        )
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.required:
+            o["requiredDuringSchedulingIgnoredDuringExecution"] = [t.to_obj() for t in self.required]
+        if self.preferred:
+            o["preferredDuringSchedulingIgnoredDuringExecution"] = [t.to_obj() for t in self.preferred]
+        return o
+
+
+class PodAntiAffinity(PodAffinity):
+    pass
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+    @classmethod
+    def from_obj(cls, o: Optional[dict]) -> Optional["Affinity"]:
+        if not o:
+            return None
+        return cls(
+            node_affinity=NodeAffinity.from_obj(o["nodeAffinity"]) if o.get("nodeAffinity") else None,
+            pod_affinity=PodAffinity.from_obj(o["podAffinity"]) if o.get("podAffinity") else None,
+            pod_anti_affinity=PodAntiAffinity.from_obj(o["podAntiAffinity"]) if o.get("podAntiAffinity") else None,
+        )
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.node_affinity is not None:
+            o["nodeAffinity"] = self.node_affinity.to_obj()
+        if self.pod_affinity is not None:
+            o["podAffinity"] = self.pod_affinity.to_obj()
+        if self.pod_anti_affinity is not None:
+            o["podAntiAffinity"] = self.pod_anti_affinity.to_obj()
+        return o
+
+
+# ---------------------------------------------------------------------------
+# taints / tolerations
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = ""  # NoSchedule | PreferNoSchedule | NoExecute
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "Taint":
+        return cls(key=o.get("key", ""), value=o.get("value", ""), effect=o.get("effect", ""))
+
+    def to_obj(self) -> dict:
+        return {"key": self.key, "value": self.value, "effect": self.effect}
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = ""  # "" (== Equal) | Equal | Exists
+    value: str = ""
+    effect: str = ""
+    toleration_seconds: Optional[int] = None
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "Toleration":
+        return cls(key=o.get("key", ""), operator=o.get("operator", ""),
+                   value=o.get("value", ""), effect=o.get("effect", ""),
+                   toleration_seconds=o.get("tolerationSeconds"))
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.key:
+            o["key"] = self.key
+        if self.operator:
+            o["operator"] = self.operator
+        if self.value:
+            o["value"] = self.value
+        if self.effect:
+            o["effect"] = self.effect
+        if self.toleration_seconds is not None:
+            o["tolerationSeconds"] = self.toleration_seconds
+        return o
+
+    def tolerates(self, taint: Taint) -> bool:
+        """v1.Toleration.ToleratesTaint semantics: empty effect matches all effects,
+        empty key with Exists matches all taints."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator in ("", "Equal"):
+            return self.value == taint.value
+        if self.operator == "Exists":
+            return True
+        return False
+
+
+def tolerations_tolerate_taint(tolerations: list, taint: Taint) -> bool:
+    """v1helper.TolerationsTolerateTaint."""
+    return any(t.tolerates(taint) for t in tolerations)
+
+
+def find_matching_untolerated_taint(taints: list, tolerations: list, taint_filter) -> Optional[Taint]:
+    """v1helper.FindMatchingUntoleratedTaint: first filtered taint not tolerated."""
+    for taint in taints:
+        if not taint_filter(taint):
+            continue
+        if not tolerations_tolerate_taint(tolerations, taint):
+            return taint
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pods
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ContainerPort:
+    host_ip: str = ""
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "ContainerPort":
+        return cls(host_ip=o.get("hostIP", ""), host_port=int(o.get("hostPort", 0) or 0),
+                   container_port=int(o.get("containerPort", 0) or 0),
+                   protocol=o.get("protocol") or "TCP")
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.host_ip:
+            o["hostIP"] = self.host_ip
+        if self.host_port:
+            o["hostPort"] = self.host_port
+        if self.container_port:
+            o["containerPort"] = self.container_port
+        if self.protocol != "TCP":
+            o["protocol"] = self.protocol
+        return o
+
+
+def _parse_resource_list(o: Optional[dict]) -> dict:
+    return {k: parse_quantity(v) for k, v in (o or {}).items()}
+
+
+def _resource_list_to_obj(rl: dict) -> dict:
+    return {k: str(v) for k, v in rl.items()}
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    requests: dict = field(default_factory=dict)  # resource name -> Quantity
+    limits: dict = field(default_factory=dict)
+    ports: list = field(default_factory=list)
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "Container":
+        res = o.get("resources") or {}
+        return cls(
+            name=o.get("name", ""),
+            image=o.get("image", ""),
+            requests=_parse_resource_list(res.get("requests")),
+            limits=_parse_resource_list(res.get("limits")),
+            ports=[ContainerPort.from_obj(p) for p in o.get("ports") or []],
+        )
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.name:
+            o["name"] = self.name
+        if self.image:
+            o["image"] = self.image
+        res: dict[str, Any] = {}
+        if self.requests:
+            res["requests"] = _resource_list_to_obj(self.requests)
+        if self.limits:
+            res["limits"] = _resource_list_to_obj(self.limits)
+        o["resources"] = res
+        if self.ports:
+            o["ports"] = [p.to_obj() for p in self.ports]
+        return o
+
+
+@dataclass
+class PodSpec:
+    containers: list = field(default_factory=list)
+    init_containers: list = field(default_factory=list)
+    node_name: str = ""
+    node_selector: Optional[dict] = None
+    affinity: Optional[Affinity] = None
+    tolerations: list = field(default_factory=list)
+    scheduler_name: str = ""
+    priority: Optional[int] = None
+    host_network: bool = False
+
+    @classmethod
+    def from_obj(cls, o: Optional[dict]) -> "PodSpec":
+        o = o or {}
+        return cls(
+            containers=[Container.from_obj(c) for c in o.get("containers") or []],
+            init_containers=[Container.from_obj(c) for c in o.get("initContainers") or []],
+            node_name=o.get("nodeName", ""),
+            node_selector=dict(o["nodeSelector"]) if o.get("nodeSelector") else None,
+            affinity=Affinity.from_obj(o.get("affinity")),
+            tolerations=[Toleration.from_obj(t) for t in o.get("tolerations") or []],
+            scheduler_name=o.get("schedulerName", ""),
+            priority=o.get("priority"),
+            host_network=bool(o.get("hostNetwork", False)),
+        )
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {"containers": [c.to_obj() for c in self.containers]}
+        if self.init_containers:
+            o["initContainers"] = [c.to_obj() for c in self.init_containers]
+        if self.node_name:
+            o["nodeName"] = self.node_name
+        if self.node_selector is not None:
+            o["nodeSelector"] = dict(self.node_selector)
+        if self.affinity is not None:
+            o["affinity"] = self.affinity.to_obj()
+        if self.tolerations:
+            o["tolerations"] = [t.to_obj() for t in self.tolerations]
+        if self.scheduler_name:
+            o["schedulerName"] = self.scheduler_name
+        if self.priority is not None:
+            o["priority"] = self.priority
+        if self.host_network:
+            o["hostNetwork"] = True
+        return o
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "PodCondition":
+        return cls(type=o.get("type", ""), status=o.get("status", ""),
+                   reason=o.get("reason", ""), message=o.get("message", ""))
+
+    def to_obj(self) -> dict:
+        o = {"type": self.type, "status": self.status}
+        if self.reason:
+            o["reason"] = self.reason
+        if self.message:
+            o["message"] = self.message
+        return o
+
+
+@dataclass
+class PodStatus:
+    phase: str = ""
+    conditions: list = field(default_factory=list)
+    reason: str = ""
+    message: str = ""
+    nominated_node_name: str = ""
+
+    @classmethod
+    def from_obj(cls, o: Optional[dict]) -> "PodStatus":
+        o = o or {}
+        return cls(phase=o.get("phase", ""),
+                   conditions=[PodCondition.from_obj(c) for c in o.get("conditions") or []],
+                   reason=o.get("reason", ""), message=o.get("message", ""),
+                   nominated_node_name=o.get("nominatedNodeName", ""))
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.phase:
+            o["phase"] = self.phase
+        if self.conditions:
+            o["conditions"] = [c.to_obj() for c in self.conditions]
+        if self.reason:
+            o["reason"] = self.reason
+        if self.message:
+            o["message"] = self.message
+        if self.nominated_node_name:
+            o["nominatedNodeName"] = self.nominated_node_name
+        return o
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    kind = "Pod"
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "Pod":
+        return cls(metadata=ObjectMeta.from_obj(o.get("metadata")),
+                   spec=PodSpec.from_obj(o.get("spec")),
+                   status=PodStatus.from_obj(o.get("status")))
+
+    def to_obj(self) -> dict:
+        return {"apiVersion": "v1", "kind": "Pod", "metadata": self.metadata.to_obj(),
+                "spec": self.spec.to_obj(), "status": self.status.to_obj()}
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace or DEFAULT_NAMESPACE
+
+    def key(self) -> str:
+        """cache.MetaNamespaceKeyFunc."""
+        return f"{self.namespace}/{self.metadata.name}"
+
+    def copy(self) -> "Pod":
+        return Pod.from_obj(self.to_obj())
+
+
+# ---------------------------------------------------------------------------
+# nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""
+    status: str = ""
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "NodeCondition":
+        return cls(type=o.get("type", ""), status=o.get("status", ""))
+
+    def to_obj(self) -> dict:
+        return {"type": self.type, "status": self.status}
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: list = field(default_factory=list)
+
+    @classmethod
+    def from_obj(cls, o: Optional[dict]) -> "NodeSpec":
+        o = o or {}
+        return cls(unschedulable=bool(o.get("unschedulable", False)),
+                   taints=[Taint.from_obj(t) for t in o.get("taints") or []])
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.unschedulable:
+            o["unschedulable"] = True
+        if self.taints:
+            o["taints"] = [t.to_obj() for t in self.taints]
+        return o
+
+
+@dataclass
+class ContainerImage:
+    names: list = field(default_factory=list)
+    size_bytes: int = 0
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "ContainerImage":
+        return cls(names=list(o.get("names") or []), size_bytes=int(o.get("sizeBytes", 0) or 0))
+
+    def to_obj(self) -> dict:
+        return {"names": list(self.names), "sizeBytes": self.size_bytes}
+
+
+@dataclass
+class NodeStatus:
+    capacity: dict = field(default_factory=dict)
+    allocatable: dict = field(default_factory=dict)
+    conditions: list = field(default_factory=list)
+    images: list = field(default_factory=list)
+
+    @classmethod
+    def from_obj(cls, o: Optional[dict]) -> "NodeStatus":
+        o = o or {}
+        return cls(capacity=_parse_resource_list(o.get("capacity")),
+                   allocatable=_parse_resource_list(o.get("allocatable")),
+                   conditions=[NodeCondition.from_obj(c) for c in o.get("conditions") or []],
+                   images=[ContainerImage.from_obj(i) for i in o.get("images") or []])
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {}
+        if self.capacity:
+            o["capacity"] = _resource_list_to_obj(self.capacity)
+        if self.allocatable:
+            o["allocatable"] = _resource_list_to_obj(self.allocatable)
+        if self.conditions:
+            o["conditions"] = [c.to_obj() for c in self.conditions]
+        if self.images:
+            o["images"] = [i.to_obj() for i in self.images]
+        return o
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    kind = "Node"
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "Node":
+        return cls(metadata=ObjectMeta.from_obj(o.get("metadata")),
+                   spec=NodeSpec.from_obj(o.get("spec")),
+                   status=NodeStatus.from_obj(o.get("status")))
+
+    def to_obj(self) -> dict:
+        return {"apiVersion": "v1", "kind": "Node", "metadata": self.metadata.to_obj(),
+                "spec": self.spec.to_obj(), "status": self.status.to_obj()}
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return self.metadata.name
+
+    def copy(self) -> "Node":
+        return Node.from_obj(self.to_obj())
+
+
+# ---------------------------------------------------------------------------
+# other resource kinds (modelled thinly; the simulator stores but rarely reads them)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: dict = field(default_factory=dict)
+
+    kind = "Service"
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "Service":
+        return cls(metadata=ObjectMeta.from_obj(o.get("metadata")),
+                   selector=dict(_get(o, "spec", "selector", default={}) or {}))
+
+    def to_obj(self) -> dict:
+        return {"apiVersion": "v1", "kind": "Service", "metadata": self.metadata.to_obj(),
+                "spec": {"selector": dict(self.selector)}}
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace or DEFAULT_NAMESPACE
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    raw: dict = field(default_factory=dict)
+
+    kind = "PersistentVolume"
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "PersistentVolume":
+        return cls(metadata=ObjectMeta.from_obj(o.get("metadata")), raw=dict(o))
+
+    def to_obj(self) -> dict:
+        o = dict(self.raw)
+        o.setdefault("apiVersion", "v1")
+        o["kind"] = "PersistentVolume"
+        o["metadata"] = self.metadata.to_obj()
+        return o
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    raw: dict = field(default_factory=dict)
+
+    kind = "PersistentVolumeClaim"
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "PersistentVolumeClaim":
+        return cls(metadata=ObjectMeta.from_obj(o.get("metadata")), raw=dict(o))
+
+    def to_obj(self) -> dict:
+        o = dict(self.raw)
+        o.setdefault("apiVersion", "v1")
+        o["kind"] = "PersistentVolumeClaim"
+        o["metadata"] = self.metadata.to_obj()
+        return o
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace or DEFAULT_NAMESPACE
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    raw: dict = field(default_factory=dict)
+
+    kind = "StorageClass"
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "StorageClass":
+        return cls(metadata=ObjectMeta.from_obj(o.get("metadata")), raw=dict(o))
+
+    def to_obj(self) -> dict:
+        o = dict(self.raw)
+        o.setdefault("apiVersion", "storage.k8s.io/v1")
+        o["kind"] = "StorageClass"
+        o["metadata"] = self.metadata.to_obj()
+        return o
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return self.metadata.name
+
+
+_RESOURCE_OBJECT_TYPES = {
+    ResourceType.PODS: Pod,
+    ResourceType.PERSISTENT_VOLUMES: PersistentVolume,
+    ResourceType.NODES: Node,
+    ResourceType.SERVICES: Service,
+    ResourceType.PERSISTENT_VOLUME_CLAIMS: PersistentVolumeClaim,
+    ResourceType.STORAGE_CLASSES: StorageClass,
+}
+
+
+# ---------------------------------------------------------------------------
+# SimulationPod (podspec schema)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SimulationPod:
+    """Reference: pkg/api/api.go:79-83 — {name, pod, num} podspec entries."""
+
+    name: str = ""
+    pod: Pod = field(default_factory=Pod)
+    num: int = 1
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "SimulationPod":
+        return cls(name=o.get("name", ""), pod=Pod.from_obj(o.get("pod") or {}),
+                   num=int(o.get("num", 1)))
+
+    def to_obj(self) -> dict:
+        return {"name": self.name, "pod": self.pod.to_obj(), "num": self.num}

@@ -1,0 +1,133 @@
+"""End-to-end reference-backend tests: the quickstart shape and engine behaviors
+(SURVEY.md §7 'minimum end-to-end slice')."""
+
+from tpusim.api.podspec import expand_simulation_pods, parse_simulation_pods
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod, synthetic_cluster
+from tpusim.backends import ReferenceBackend, placement_hash
+
+QUICKSTART_YAML = """
+- name: A
+  num: 10
+  pod:
+    spec:
+      containers:
+      - resources:
+          requests:
+            cpu: 1
+            memory: 1
+- name: B
+  num: 10
+  pod:
+    spec:
+      containers:
+      - resources:
+          requests:
+            cpu: 100
+            memory: 1000
+"""
+
+
+def quickstart_pods():
+    return expand_simulation_pods(parse_simulation_pods(QUICKSTART_YAML),
+                                  deterministic_ids=True)
+
+
+def test_quickstart_10_scheduled_10_unschedulable():
+    snap = synthetic_cluster(4, milli_cpu=4000, memory=16 * 1024**3)
+    placements = ReferenceBackend().schedule(quickstart_pods(), snap)
+    scheduled = [p for p in placements if p.scheduled]
+    failed = [p for p in placements if not p.scheduled]
+    assert len(scheduled) == 10 and len(failed) == 10
+    assert all(p.pod.metadata.labels["SimulationName"] == "A" for p in scheduled)
+    assert all(p.pod.metadata.labels["SimulationName"] == "B" for p in failed)
+    assert all(p.reason == "Unschedulable" for p in failed)
+    # failure message carries the sorted reason histogram (FitError format)
+    assert failed[0].message.startswith("0/4 nodes are available: 4 Insufficient cpu")
+    # bound pods are Running with nodeName set
+    assert all(p.pod.status.phase == "Running" and p.pod.spec.node_name for p in scheduled)
+
+
+def test_round_robin_tie_break_spreads_over_tied_nodes():
+    snap = synthetic_cluster(4, milli_cpu=4000, memory=16 * 1024**3)
+    pods = [make_pod(f"p{i}", milli_cpu=1, memory=1) for i in range(8)]
+    placements = ReferenceBackend().schedule(pods, snap)
+    hosts = [p.node_name for p in placements]
+    # All nodes identical: first pod's scores tie across all 4; afterwards
+    # LeastRequested still ties (tiny request), so round-robin walks the nodes.
+    assert len(set(hosts[:4])) == 4
+
+
+def test_state_mutation_between_pods():
+    # One node fits exactly one pod's cpu; second pod must go elsewhere.
+    snap = ClusterSnapshot(nodes=[make_node("big", milli_cpu=2000, memory=16 * 1024**3),
+                                  make_node("small", milli_cpu=1000, memory=16 * 1024**3)])
+    pods = [make_pod("p1", milli_cpu=900), make_pod("p2", milli_cpu=900),
+            make_pod("p3", milli_cpu=900)]
+    placements = ReferenceBackend().schedule(pods, snap)
+    assert [p.scheduled for p in placements] == [True, True, True]
+    # 2700m total across 3000m capacity: must pack big=2, small=1
+    from collections import Counter
+
+    counts = Counter(p.node_name for p in placements)
+    assert counts["big"] == 2 and counts["small"] == 1
+
+
+def test_pre_scheduled_pods_consume_capacity():
+    snap = ClusterSnapshot(
+        nodes=[make_node("n1", milli_cpu=1000, memory=16 * 1024**3)],
+        pods=[make_pod("existing", milli_cpu=800, node_name="n1", phase="Running")])
+    placements = ReferenceBackend().schedule([make_pod("p", milli_cpu=500)], snap)
+    assert not placements[0].scheduled
+    assert "Insufficient cpu" in placements[0].message
+
+
+def test_node_selector_and_taints_end_to_end():
+    nodes = [
+        make_node("gpu", labels={"accel": "gpu"},
+                  taints=[{"key": "gpu", "value": "true", "effect": "NoSchedule"}]),
+        make_node("cpu"),
+    ]
+    snap = ClusterSnapshot(nodes=nodes)
+    backend = ReferenceBackend()
+    # pod requiring gpu node but without toleration -> unschedulable
+    p1 = make_pod("p1", milli_cpu=100, node_selector={"accel": "gpu"})
+    r1 = backend.schedule([p1], snap)[0]
+    assert not r1.scheduled
+    # with toleration -> lands on gpu
+    p2 = make_pod("p2", milli_cpu=100, node_selector={"accel": "gpu"},
+                  tolerations=[{"key": "gpu", "operator": "Exists",
+                                "effect": "NoSchedule"}])
+    r2 = backend.schedule([p2], snap)[0]
+    assert r2.node_name == "gpu"
+    # plain pod avoids nothing; tainted node fails predicate, lands on cpu
+    p3 = make_pod("p3", milli_cpu=100)
+    r3 = backend.schedule([p3], snap)[0]
+    assert r3.node_name == "cpu"
+
+
+def test_providers_differ_least_vs_most_requested():
+    # Two nodes, one half-loaded: DefaultProvider (LeastRequested) prefers the
+    # empty node; TalkintDataProvider (MostRequested) prefers the loaded one.
+    nodes = [make_node("loaded", milli_cpu=4000, memory=4 * 1024**3),
+             make_node("empty", milli_cpu=4000, memory=4 * 1024**3)]
+    existing = make_pod("e", milli_cpu=2000, memory=2 * 1024**3, node_name="loaded")
+    snap = ClusterSnapshot(nodes=nodes, pods=[existing])
+    pod = make_pod("p", milli_cpu=100, memory=100 * 1024 * 1024)
+    r_default = ReferenceBackend(provider="DefaultProvider").schedule([pod], snap)[0]
+    r_td = ReferenceBackend(provider="TalkintDataProvider").schedule([pod], snap)[0]
+    assert r_default.node_name == "empty"
+    assert r_td.node_name == "loaded"
+
+
+def test_no_nodes_available():
+    placements = ReferenceBackend().schedule([make_pod("p")], ClusterSnapshot())
+    assert not placements[0].scheduled
+    assert placements[0].message == "no nodes available to schedule pods"
+
+
+def test_placement_hash_stable():
+    snap = synthetic_cluster(4)
+    pods = quickstart_pods()
+    h1 = placement_hash(ReferenceBackend().schedule(pods, snap))
+    h2 = placement_hash(ReferenceBackend().schedule(pods, snap))
+    assert h1 == h2

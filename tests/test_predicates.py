@@ -1,0 +1,269 @@
+"""Golden predicate tests, modeled on the upstream table-driven tests
+(vendor/.../algorithm/predicates/predicates_test.go)."""
+
+from tpusim.api.snapshot import make_node, make_pod
+from tpusim.engine import errors as err
+from tpusim.engine import predicates as preds
+from tpusim.engine.resources import NodeInfo, get_resource_request
+
+
+def node_info_for(node, *pods):
+    ni = NodeInfo(*pods)
+    ni.set_node(node)
+    return ni
+
+
+def test_pod_fits_resources_ok():
+    node = make_node("n1", milli_cpu=1000, memory=1000, pods=10)
+    ni = node_info_for(node)
+    pod = make_pod("p", milli_cpu=500, memory=500)
+    fit, reasons = preds.pod_fits_resources(pod, None, ni)
+    assert fit and not reasons
+
+
+def test_pod_fits_resources_insufficient_cpu_and_memory():
+    node = make_node("n1", milli_cpu=1000, memory=1000, pods=10)
+    existing = make_pod("e", milli_cpu=600, memory=600, node_name="n1")
+    ni = node_info_for(node, existing)
+    pod = make_pod("p", milli_cpu=500, memory=500)
+    fit, reasons = preds.pod_fits_resources(pod, None, ni)
+    assert not fit
+    assert [r.get_reason() for r in reasons] == ["Insufficient cpu", "Insufficient memory"]
+    assert reasons[0].requested == 500 and reasons[0].used == 600 and reasons[0].capacity == 1000
+
+
+def test_pod_fits_resources_too_many_pods():
+    node = make_node("n1", milli_cpu=1000, memory=1000, pods=1)
+    existing = make_pod("e", milli_cpu=1, node_name="n1")
+    ni = node_info_for(node, existing)
+    pod = make_pod("p", milli_cpu=1)
+    fit, reasons = preds.pod_fits_resources(pod, None, ni)
+    assert not fit
+    assert reasons[0].get_reason() == "Insufficient pods"
+
+
+def test_pod_fits_resources_zero_request_skips_resource_checks():
+    node = make_node("n1", milli_cpu=100, memory=100, pods=10)
+    existing = make_pod("e", milli_cpu=100, memory=100, node_name="n1")
+    ni = node_info_for(node, existing)
+    pod = make_pod("p")  # no requests
+    fit, reasons = preds.pod_fits_resources(pod, None, ni)
+    assert fit
+
+
+def test_init_container_max_rule():
+    pod = make_pod("p", milli_cpu=1000, memory=1000)
+    pod.spec.init_containers = [
+        type(pod.spec.containers[0]).from_obj(
+            {"resources": {"requests": {"cpu": "2", "memory": "500"}}}),
+    ]
+    req = get_resource_request(pod)
+    assert req.milli_cpu == 2000  # init container max wins for cpu
+    assert req.memory == 1000     # containers sum wins for memory
+
+
+def test_pod_fits_host():
+    node = make_node("n1")
+    ni = node_info_for(node)
+    assert preds.pod_fits_host(make_pod("p"), None, ni)[0]
+    assert preds.pod_fits_host(make_pod("p", node_name="n1"), None, ni)[0]
+    fit, reasons = preds.pod_fits_host(make_pod("p", node_name="other"), None, ni)
+    assert not fit and reasons == [err.ERR_POD_NOT_MATCH_HOST_NAME]
+
+
+def test_pod_fits_host_ports():
+    node = make_node("n1")
+    existing = make_pod("e", node_name="n1")
+    existing.spec.containers[0].ports = [
+        type(existing.spec.containers[0]).from_obj(
+            {"ports": [{"hostPort": 8080}]}).ports[0]]
+    ni = node_info_for(node, existing)
+    pod = make_pod("p")
+    pod.spec.containers[0].ports = [
+        type(pod.spec.containers[0]).from_obj({"ports": [{"hostPort": 8080}]}).ports[0]]
+    fit, reasons = preds.pod_fits_host_ports(pod, None, ni)
+    assert not fit and reasons == [err.ERR_POD_NOT_FITS_HOST_PORTS]
+    # different port is fine
+    pod2 = make_pod("p2")
+    pod2.spec.containers[0].ports = [
+        type(pod2.spec.containers[0]).from_obj({"ports": [{"hostPort": 8081}]}).ports[0]]
+    assert preds.pod_fits_host_ports(pod2, None, ni)[0]
+
+
+def test_host_port_wildcard_ip_conflict():
+    node = make_node("n1")
+    existing = make_pod("e", node_name="n1")
+    cont = type(existing.spec.containers[0])
+    existing.spec.containers[0].ports = cont.from_obj(
+        {"ports": [{"hostPort": 80, "hostIP": "127.0.0.1"}]}).ports
+    ni = node_info_for(node, existing)
+    pod = make_pod("p")
+    pod.spec.containers[0].ports = cont.from_obj(
+        {"ports": [{"hostPort": 80}]}).ports  # 0.0.0.0 conflicts with any ip
+    assert not preds.pod_fits_host_ports(pod, None, ni)[0]
+    # UDP vs TCP no conflict
+    pod2 = make_pod("p2")
+    pod2.spec.containers[0].ports = cont.from_obj(
+        {"ports": [{"hostPort": 80, "protocol": "UDP"}]}).ports
+    assert preds.pod_fits_host_ports(pod2, None, ni)[0]
+
+
+def test_match_node_selector():
+    node = make_node("n1", labels={"zone": "a"})
+    ni = node_info_for(node)
+    assert preds.pod_match_node_selector(
+        make_pod("p", node_selector={"zone": "a"}), None, ni)[0]
+    fit, reasons = preds.pod_match_node_selector(
+        make_pod("p", node_selector={"zone": "b"}), None, ni)
+    assert not fit and reasons == [err.ERR_NODE_SELECTOR_NOT_MATCH]
+
+
+def test_required_node_affinity():
+    node = make_node("n1", labels={"zone": "a"})
+    ni = node_info_for(node)
+    aff = {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+        "nodeSelectorTerms": [
+            {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a", "b"]}]}
+        ]}}}
+    assert preds.pod_match_node_selector(make_pod("p", affinity=aff), None, ni)[0]
+    aff_bad = {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+        "nodeSelectorTerms": [
+            {"matchExpressions": [{"key": "zone", "operator": "NotIn", "values": ["a"]}]}
+        ]}}}
+    assert not preds.pod_match_node_selector(make_pod("p", affinity=aff_bad), None, ni)[0]
+    # empty terms list matches nothing
+    aff_empty = {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+        "nodeSelectorTerms": []}}}
+    assert not preds.pod_match_node_selector(make_pod("p", affinity=aff_empty), None, ni)[0]
+
+
+def test_taints_tolerations():
+    node = make_node("n1", taints=[{"key": "gpu", "value": "yes", "effect": "NoSchedule"}])
+    ni = node_info_for(node)
+    fit, reasons = preds.pod_tolerates_node_taints(make_pod("p"), None, ni)
+    assert not fit and reasons == [err.ERR_TAINTS_TOLERATIONS_NOT_MATCH]
+    tolerating = make_pod("p", tolerations=[
+        {"key": "gpu", "operator": "Equal", "value": "yes", "effect": "NoSchedule"}])
+    assert preds.pod_tolerates_node_taints(tolerating, None, ni)[0]
+    # PreferNoSchedule taints never hard-fail
+    soft_node = make_node("n2", taints=[{"key": "x", "value": "y",
+                                         "effect": "PreferNoSchedule"}])
+    ni2 = node_info_for(soft_node)
+    assert preds.pod_tolerates_node_taints(make_pod("p"), None, ni2)[0]
+
+
+def test_check_node_condition():
+    ready = make_node("n1")
+    assert preds.check_node_condition(make_pod("p"), None, node_info_for(ready))[0]
+    not_ready = make_node("n2", ready=False)
+    fit, reasons = preds.check_node_condition(make_pod("p"), None, node_info_for(not_ready))
+    assert not fit and reasons == [err.ERR_NODE_NOT_READY]
+    unsched = make_node("n3", unschedulable=True)
+    fit, reasons = preds.check_node_condition(make_pod("p"), None, node_info_for(unsched))
+    assert not fit and reasons == [err.ERR_NODE_UNSCHEDULABLE]
+    # OutOfDisk True
+    ood = make_node("n4")
+    ood.status.conditions.append(type(ood.status.conditions[0])("OutOfDisk", "True"))
+    fit, reasons = preds.check_node_condition(make_pod("p"), None, node_info_for(ood))
+    assert not fit and reasons == [err.ERR_NODE_OUT_OF_DISK]
+
+
+def test_memory_pressure_only_rejects_best_effort():
+    node = make_node("n1")
+    node.status.conditions.append(type(node.status.conditions[0])("MemoryPressure", "True"))
+    ni = node_info_for(node)
+    best_effort = make_pod("p")  # no requests at all
+    fit, reasons = preds.check_node_memory_pressure(best_effort, None, ni)
+    assert not fit and reasons == [err.ERR_NODE_UNDER_MEMORY_PRESSURE]
+    burstable = make_pod("p2", milli_cpu=100)
+    assert preds.check_node_memory_pressure(burstable, None, ni)[0]
+
+
+def test_disk_pressure_rejects_all():
+    node = make_node("n1")
+    node.status.conditions.append(type(node.status.conditions[0])("DiskPressure", "True"))
+    ni = node_info_for(node)
+    fit, reasons = preds.check_node_disk_pressure(make_pod("p", milli_cpu=1), None, ni)
+    assert not fit and reasons == [err.ERR_NODE_UNDER_DISK_PRESSURE]
+
+
+def test_general_predicates_collects_all_failures():
+    node = make_node("n1", milli_cpu=100, memory=100, labels={"zone": "a"})
+    ni = node_info_for(node)
+    pod = make_pod("p", milli_cpu=500, node_selector={"zone": "b"}, node_name="other")
+    fit, reasons = preds.general_predicates(pod, None, ni)
+    assert not fit
+    reason_strs = [r.get_reason() for r in reasons]
+    assert "Insufficient cpu" in reason_strs
+    assert err.ERR_POD_NOT_MATCH_HOST_NAME.get_reason() in reason_strs
+    assert err.ERR_NODE_SELECTOR_NOT_MATCH.get_reason() in reason_strs
+
+
+def test_interpod_anti_affinity_existing_pods():
+    """Existing pod with anti-affinity against app=web on hostname topology."""
+    node_a = make_node("a", labels={"kubernetes.io/hostname": "a"})
+    node_b = make_node("b", labels={"kubernetes.io/hostname": "b"})
+    existing = make_pod("e", node_name="a", labels={"app": "db"})
+    from tpusim.api.types import Affinity
+
+    existing.spec.affinity = Affinity.from_obj({
+        "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "web"}},
+             "topologyKey": "kubernetes.io/hostname"}]}})
+    from tpusim.engine.resources import new_node_info_map
+
+    infos = new_node_info_map([node_a, node_b], [existing])
+    checker = preds.PodAffinityChecker(lambda n: infos.get(n),
+                                       lambda: [existing])
+    pod = make_pod("p", labels={"app": "web"})
+    meta = preds.get_predicate_metadata(pod, infos)
+    fit, reasons = checker.interpod_affinity_matches(pod, meta, infos["a"])
+    assert not fit
+    assert reasons[0] == err.ERR_POD_AFFINITY_NOT_MATCH
+    fit_b, _ = checker.interpod_affinity_matches(pod, meta, infos["b"])
+    assert fit_b
+
+
+def test_interpod_affinity_required_first_pod_special_case():
+    """A pod whose affinity matches its own labels may land anywhere when no
+    peer exists (predicates.go first-pod-of-group rule)."""
+    node_a = make_node("a", labels={"kubernetes.io/hostname": "a"})
+    from tpusim.engine.resources import new_node_info_map
+
+    infos = new_node_info_map([node_a], [])
+    checker = preds.PodAffinityChecker(lambda n: infos.get(n), lambda: [])
+    pod = make_pod("p", labels={"app": "web"})
+    pod.spec.affinity = type(node_a.spec).from_obj({})  # placeholder replaced below
+    from tpusim.api.types import Affinity
+
+    pod.spec.affinity = Affinity.from_obj({
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "web"}},
+             "topologyKey": "kubernetes.io/hostname"}]}})
+    fit, _ = checker.interpod_affinity_matches(pod, None, infos["a"])
+    assert fit
+    # but a pod NOT matching its own selector fails when no peer exists
+    pod2 = make_pod("p2", labels={"app": "other"})
+    pod2.spec.affinity = pod.spec.affinity
+    fit2, reasons2 = checker.interpod_affinity_matches(pod2, None, infos["a"])
+    assert not fit2 and err.ERR_POD_AFFINITY_RULES_NOT_MATCH in reasons2
+
+
+def test_interpod_affinity_required_peer_topology():
+    node_a = make_node("a", labels={"kubernetes.io/hostname": "a", "zone": "z1"})
+    node_b = make_node("b", labels={"kubernetes.io/hostname": "b", "zone": "z1"})
+    node_c = make_node("c", labels={"kubernetes.io/hostname": "c", "zone": "z2"})
+    peer = make_pod("peer", node_name="a", labels={"app": "web"})
+    from tpusim.api.types import Affinity
+    from tpusim.engine.resources import new_node_info_map
+
+    infos = new_node_info_map([node_a, node_b, node_c], [peer])
+    checker = preds.PodAffinityChecker(lambda n: infos.get(n), lambda: [peer])
+    pod = make_pod("p", labels={"app": "web2"})
+    pod.spec.affinity = Affinity.from_obj({
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "web"}},
+             "topologyKey": "zone"}]}})
+    assert checker.interpod_affinity_matches(pod, None, infos["a"])[0]
+    assert checker.interpod_affinity_matches(pod, None, infos["b"])[0]  # same zone
+    assert not checker.interpod_affinity_matches(pod, None, infos["c"])[0]

@@ -1,0 +1,234 @@
+"""Golden priority tests, modeled on upstream priorities *_test.go tables."""
+
+from tpusim.api.snapshot import make_node, make_pod
+from tpusim.api.types import Affinity
+from tpusim.engine import priorities as prios
+from tpusim.engine.resources import NodeInfo, new_node_info_map
+
+
+def ni_for(node, *pods):
+    ni = NodeInfo(*pods)
+    ni.set_node(node)
+    return ni
+
+
+def test_least_requested_basic():
+    # capacity 4000m/10000 mem; requested (incl. pod) 3000m/5000
+    node = make_node("n1", milli_cpu=4000, memory=10000)
+    existing = make_pod("e", milli_cpu=2000, memory=4000, node_name="n1")
+    ni = ni_for(node, existing)
+    pod = make_pod("p", milli_cpu=1000, memory=1000)
+    hp = prios.least_requested_priority_map(pod, None, ni)
+    # cpu: (4000-3000)*10/4000 = 2; mem: (10000-5000)*10/10000 = 5; avg = 3
+    assert hp.score == (2 + 5) // 2 == 3
+
+
+def test_least_requested_overcommit_scores_zero():
+    node = make_node("n1", milli_cpu=1000, memory=1000)
+    pod = make_pod("p", milli_cpu=2000, memory=500)
+    hp = prios.least_requested_priority_map(pod, None, ni_for(node))
+    # cpu over capacity -> 0; mem: (1000-500)*10/1000 = 5 -> avg 2
+    assert hp.score == (0 + 5) // 2
+
+
+def test_least_requested_nonzero_defaults():
+    node = make_node("n1", milli_cpu=1000, memory=1000 * 1024 * 1024)
+    pod = make_pod("p")  # no requests -> 100m cpu, 200MB mem defaults
+    hp = prios.least_requested_priority_map(pod, None, ni_for(node))
+    cpu_score = ((1000 - 100) * 10) // 1000  # 9
+    mem_score = ((1000 - 200) * 10) // 1000  # 8
+    assert hp.score == (cpu_score + mem_score) // 2
+
+
+def test_most_requested_basic():
+    node = make_node("n1", milli_cpu=4000, memory=10000)
+    existing = make_pod("e", milli_cpu=2000, memory=4000, node_name="n1")
+    ni = ni_for(node, existing)
+    pod = make_pod("p", milli_cpu=1000, memory=1000)
+    hp = prios.most_requested_priority_map(pod, None, ni)
+    # cpu: 3000*10/4000 = 7; mem: 5000*10/10000 = 5; avg 6
+    assert hp.score == (7 + 5) // 2
+
+
+def test_balanced_allocation():
+    node = make_node("n1", milli_cpu=1000, memory=1000)
+    pod = make_pod("p", milli_cpu=500, memory=500)
+    hp = prios.balanced_resource_allocation_map(pod, None, ni_for(node))
+    assert hp.score == 10  # perfectly balanced
+    pod2 = make_pod("p2", milli_cpu=1000, memory=100)
+    hp2 = prios.balanced_resource_allocation_map(pod2, None, ni_for(node))
+    assert hp2.score == 0  # cpu fraction >= 1
+
+
+def test_balanced_allocation_diff():
+    node = make_node("n1", milli_cpu=1000, memory=1000)
+    pod = make_pod("p", milli_cpu=600, memory=200)
+    hp = prios.balanced_resource_allocation_map(pod, None, ni_for(node))
+    # |0.6 - 0.2| = 0.4 -> (1-0.4)*10 = 6
+    assert hp.score == 6
+
+
+def test_node_affinity_priority():
+    aff = Affinity.from_obj({"nodeAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 2, "preference": {"matchExpressions": [
+                {"key": "zone", "operator": "In", "values": ["a"]}]}},
+            {"weight": 5, "preference": {"matchExpressions": [
+                {"key": "disk", "operator": "In", "values": ["ssd"]}]}},
+        ]}})
+    pod = make_pod("p")
+    pod.spec.affinity = aff
+    n_both = make_node("both", labels={"zone": "a", "disk": "ssd"})
+    n_zone = make_node("zone", labels={"zone": "a"})
+    n_none = make_node("none")
+    infos = {n.name: ni_for(n) for n in (n_both, n_zone, n_none)}
+    result = [prios.calculate_node_affinity_priority_map(pod, None, infos[n])
+              for n in ("both", "zone", "none")]
+    assert [hp.score for hp in result] == [7, 2, 0]
+    prios.calculate_node_affinity_priority_reduce(pod, None, infos, result)
+    # normalize to max 10: 7->10, 2->2*10/7=2, 0->0
+    assert [hp.score for hp in result] == [10, 20 // 7, 0]
+
+
+def test_taint_toleration_priority():
+    pod = make_pod("p", tolerations=[
+        {"key": "soft", "operator": "Equal", "value": "ok",
+         "effect": "PreferNoSchedule"}])
+    n_clean = make_node("clean")
+    n_tolerated = make_node("tolerated", taints=[
+        {"key": "soft", "value": "ok", "effect": "PreferNoSchedule"}])
+    n_bad = make_node("bad", taints=[
+        {"key": "soft", "value": "other", "effect": "PreferNoSchedule"},
+        {"key": "more", "value": "x", "effect": "PreferNoSchedule"}])
+    infos = {n.name: ni_for(n) for n in (n_clean, n_tolerated, n_bad)}
+    result = [prios.compute_taint_toleration_priority_map(pod, None, infos[n])
+              for n in ("clean", "tolerated", "bad")]
+    assert [hp.score for hp in result] == [0, 0, 2]
+    prios.compute_taint_toleration_priority_reduce(pod, None, infos, result)
+    # reversed normalize: intolerable-count max=2 -> clean/tolerated=10, bad=0
+    assert [hp.score for hp in result] == [10, 10, 0]
+
+
+def test_taint_toleration_reduce_all_zero():
+    pod = make_pod("p")
+    infos = {"a": ni_for(make_node("a")), "b": ni_for(make_node("b"))}
+    result = [prios.HostPriority("a", 0), prios.HostPriority("b", 0)]
+    prios.compute_taint_toleration_priority_reduce(pod, None, infos, result)
+    assert [hp.score for hp in result] == [10, 10]
+
+
+def test_node_prefer_avoid_pods():
+    import json
+
+    pod = make_pod("p")
+    pod.metadata.owner_references = [
+        type(pod.metadata.owner_references)() if False else
+        __import__("tpusim.api.types", fromlist=["OwnerReference"]).OwnerReference(
+            kind="ReplicaSet", name="rs1", uid="u1", controller=True)]
+    node_avoid = make_node("avoid")
+    node_avoid.metadata.annotations["scheduler.alpha.kubernetes.io/preferAvoidPods"] = \
+        json.dumps({"preferAvoidPods": [
+            {"podSignature": {"podController": {"kind": "ReplicaSet", "uid": "u1"}}}]})
+    node_ok = make_node("ok")
+    assert prios.calculate_node_prefer_avoid_pods_priority_map(
+        pod, None, ni_for(node_avoid)).score == 0
+    assert prios.calculate_node_prefer_avoid_pods_priority_map(
+        pod, None, ni_for(node_ok)).score == 10
+    # pod without controller ref scores max everywhere
+    plain = make_pod("plain")
+    assert prios.calculate_node_prefer_avoid_pods_priority_map(
+        plain, None, ni_for(node_avoid)).score == 10
+
+
+def test_selector_spreading():
+    from tpusim.api.types import Service
+
+    svc = Service.from_obj({"metadata": {"name": "s", "namespace": "default"},
+                            "spec": {"selector": {"app": "web"}}})
+    nodes = [make_node(f"n{i}") for i in range(3)]
+    pods = ([make_pod(f"w{i}", node_name="n0", labels={"app": "web"}) for i in range(2)]
+            + [make_pod("w2", node_name="n1", labels={"app": "web"})])
+    infos = new_node_info_map(nodes, pods)
+    spread = prios.SelectorSpread(lambda: [svc])
+    pod = make_pod("new", labels={"app": "web"})
+    result = [spread.calculate_spread_priority_map(pod, None, infos[n.name])
+              for n in nodes]
+    assert [hp.score for hp in result] == [2, 1, 0]
+    spread.calculate_spread_priority_reduce(pod, None, infos, result)
+    # 10*(max-count)/max with max=2 -> [0, 5, 10]
+    assert [hp.score for hp in result] == [0, 5, 10]
+
+
+def test_selector_spreading_zones():
+    from tpusim.api.types import Service
+
+    svc = Service.from_obj({"metadata": {"name": "s"},
+                            "spec": {"selector": {"app": "web"}}})
+    za = {"failure-domain.beta.kubernetes.io/zone": "za"}
+    zb = {"failure-domain.beta.kubernetes.io/zone": "zb"}
+    nodes = [make_node("a1", labels=za), make_node("a2", labels=za),
+             make_node("b1", labels=zb)]
+    pods = [make_pod("w0", node_name="a1", labels={"app": "web"}),
+            make_pod("w1", node_name="a2", labels={"app": "web"})]
+    infos = new_node_info_map(nodes, pods)
+    spread = prios.SelectorSpread(lambda: [svc])
+    pod = make_pod("new", labels={"app": "web"})
+    result = [spread.calculate_spread_priority_map(pod, None, infos[n.name])
+              for n in nodes]
+    assert [hp.score for hp in result] == [1, 1, 0]
+    spread.calculate_spread_priority_reduce(pod, None, infos, result)
+    # node scores: a1,a2: 10*(1-1)/1=0; b1: 10
+    # zone counts: za=2, zb=0 -> zone scores: za 0, zb 10
+    # final = score/3 + 2/3*zone
+    assert [hp.score for hp in result] == [0, 0, 10]
+
+
+def test_interpod_affinity_priority_preferred():
+    za = {"zone": "z1"}
+    zb = {"zone": "z2"}
+    node_a = make_node("a", labels=za)
+    node_b = make_node("b", labels=zb)
+    peer = make_pod("peer", node_name="a", labels={"app": "web"})
+    infos = new_node_info_map([node_a, node_b], [peer])
+    pod = make_pod("p")
+    pod.spec.affinity = Affinity.from_obj({
+        "podAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 5, "podAffinityTerm": {
+                "labelSelector": {"matchLabels": {"app": "web"}},
+                "topologyKey": "zone"}}]}})
+    ipa = prios.InterPodAffinityPriority(lambda n: infos.get(n), 10)
+    result = ipa.calculate(pod, infos, [node_a, node_b])
+    assert [hp.score for hp in result] == [10, 0]
+
+
+def test_interpod_affinity_priority_hard_symmetric():
+    node_a = make_node("a", labels={"zone": "z1"})
+    node_b = make_node("b", labels={"zone": "z2"})
+    # existing pod with REQUIRED affinity to app=web: symmetric weight attracts
+    peer = make_pod("peer", node_name="a", labels={"app": "db"})
+    peer.spec.affinity = Affinity.from_obj({
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "web"}},
+             "topologyKey": "zone"}]}})
+    infos = new_node_info_map([node_a, node_b], [peer])
+    pod = make_pod("p", labels={"app": "web"})
+    ipa = prios.InterPodAffinityPriority(lambda n: infos.get(n), 10)
+    result = ipa.calculate(pod, infos, [node_a, node_b])
+    assert [hp.score for hp in result] == [10, 0]
+
+
+def test_image_locality():
+    node = make_node("n1")
+    node.status.images = [
+        __import__("tpusim.api.types", fromlist=["ContainerImage"]).ContainerImage(
+            names=["big:latest"], size_bytes=500 * 1024 * 1024)]
+    pod = make_pod("p")
+    pod.spec.containers[0].image = "big:latest"
+    hp = prios.image_locality_priority_map(pod, None, ni_for(node))
+    # (500M-23M)*10/(1000M-23M)+1 = 4+1... int math below
+    mb = 1024 * 1024
+    expected = int(10 * (500 * mb - 23 * mb) // (1000 * mb - 23 * mb) + 1)
+    assert hp.score == expected
+    pod_absent = make_pod("q")
+    pod_absent.spec.containers[0].image = "missing:latest"
+    assert prios.image_locality_priority_map(pod_absent, None, ni_for(node)).score == 0

@@ -121,7 +121,11 @@ def _as_frac(x) -> Fraction:
 
 
 def _ceil(f: Fraction) -> int:
-    return -((-f.numerator) // f.denominator)
+    """k8s Value()/MilliValue() round away from zero (resource/math.go), so
+    fractional negatives get more negative: -0.5 -> -1."""
+    if f.numerator >= 0:
+        return -((-f.numerator) // f.denominator)
+    return f.numerator // f.denominator
 
 
 def parse_quantity(s) -> Quantity:

@@ -12,7 +12,7 @@ from dataclasses import dataclass, field
 import json
 from typing import List, Optional
 
-from tpusim.api.types import Node, Pod, Service
+from tpusim.api.types import LABEL_HOSTNAME, Node, Pod, Service
 
 
 @dataclass
@@ -86,7 +86,7 @@ def make_node(
     """Build a schedulable node fixture (reference: pkg/main.go:200-231 newSampleNode)."""
     cpu = f"{milli_cpu}m"
     obj = {
-        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name, **(labels or {})}},
+        "metadata": {"name": name, "labels": {LABEL_HOSTNAME: name, **(labels or {})}},
         "spec": {},
         "status": {
             "capacity": {"cpu": cpu, "memory": str(memory), "pods": str(pods)},

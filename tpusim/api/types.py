@@ -31,16 +31,25 @@ TAINT_NO_SCHEDULE = "NoSchedule"
 TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
 TAINT_NO_EXECUTE = "NoExecute"
 
+# well-known topology labels (kubeletapis.LabelHostname / LabelZoneFailureDomain /
+# LabelZoneRegion at the reference's vintage)
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_ZONE_FAILURE_DOMAIN = "failure-domain.beta.kubernetes.io/zone"
+LABEL_ZONE_REGION = "failure-domain.beta.kubernetes.io/region"
+
 
 def is_scalar_resource_name(name: str) -> bool:
     """Reference: v1helper.IsScalarResourceName = extended or hugepages.
 
     Extended means namespaced outside the default namespace: the name contains a
-    "/" and does not contain "kubernetes.io/" (used at predicates.go:687-696,
-    755-767). "alpha.kubernetes.io/nvidia-gpu" is therefore NOT scalar — GPUs
-    are tracked as a first-class field.
+    "/", does not contain "kubernetes.io/", and is not "requests."-prefixed
+    (quota notation; v1helper.IsExtendedResourceName). Used at
+    predicates.go:687-696, 755-767. "alpha.kubernetes.io/nvidia-gpu" is
+    therefore NOT scalar — GPUs are tracked as a first-class field.
     """
-    return ("/" in name and "kubernetes.io/" not in name) or name.startswith("hugepages-")
+    extended = ("/" in name and "kubernetes.io/" not in name
+                and not name.startswith("requests."))
+    return extended or name.startswith("hugepages-")
 
 
 class ResourceType(enum.Enum):
@@ -522,7 +531,8 @@ class Container:
             res["requests"] = _resource_list_to_obj(self.requests)
         if self.limits:
             res["limits"] = _resource_list_to_obj(self.limits)
-        o["resources"] = res
+        if res:
+            o["resources"] = res
         if self.ports:
             o["ports"] = [p.to_obj() for p in self.ports]
         return o

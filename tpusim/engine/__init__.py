@@ -1,0 +1,15 @@
+"""Scheduling engine with Go-parity semantics.
+
+Re-expresses the vendored kube-scheduler pipeline
+(reference: vendor/k8s.io/kubernetes/pkg/scheduler/) in Python as the parity
+oracle for the JAX backend:
+
+  errors        predicate failure reasons   (algorithm/predicates/error.go)
+  resources     Resource / NodeInfo / ports (schedulercache/node_info.go, util/utils.go)
+  predicates    ordered fit predicates      (algorithm/predicates/predicates.go)
+  priorities    score map/reduce functions  (algorithm/priorities/*.go)
+  generic_scheduler  filter→score→select    (core/generic_scheduler.go)
+  providers     registry + algorithm providers (factory/plugins.go, algorithmprovider/defaults)
+  cache         scheduler cache             (schedulercache/cache.go)
+  queue         scheduling queues           (core/scheduling_queue.go)
+"""

@@ -1,0 +1,217 @@
+"""The core scheduling algorithm: findNodesThatFit → PrioritizeNodes → selectHost.
+
+Reference: core/generic_scheduler.go. The 16-way goroutine fan-out over nodes
+(:348, :607) is replaced here by plain loops (this backend is the semantics
+oracle; the JAX backend owns performance).
+
+Tie-break parity note (SURVEY.md §7 hard part 2): the Go selectHost does
+``sort.Sort(sort.Reverse(priorityList))`` — an UNSTABLE sort keyed on score
+only — then round-robins over the maximal-score prefix with a persistent
+``lastNodeIndex`` counter (:183-198). Go's unstable tie order is an artifact of
+its introsort; we define the parity semantics as a STABLE descending sort (ties
+keep node-list order), which both backends implement identically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from tpusim.api.types import Node, Pod
+from tpusim.engine.errors import PredicateFailureReason
+from tpusim.engine.predicates import (
+    PREDICATES_ORDERING,
+    PredicateMetadata,
+    get_predicate_metadata,
+)
+from tpusim.engine.priorities import (
+    HostPriority,
+    PriorityConfig,
+    equal_priority_map,
+)
+from tpusim.engine.resources import NodeInfo
+
+NO_NODE_AVAILABLE_MSG = "0/{} nodes are available"
+
+
+class SchedulingError(Exception):
+    pass
+
+
+class FitError(SchedulingError):
+    """Reference: generic_scheduler.go:51-90 — aggregates per-node predicate
+    failures into the sorted reason-histogram message."""
+
+    def __init__(self, pod: Pod, num_all_nodes: int,
+                 failed_predicates: Dict[str, List[PredicateFailureReason]]):
+        self.pod = pod
+        self.num_all_nodes = num_all_nodes
+        self.failed_predicates = failed_predicates
+        super().__init__(self.error())
+
+    def error(self) -> str:
+        reasons: Dict[str, int] = {}
+        for preds in self.failed_predicates.values():
+            for reason in preds:
+                key = reason.get_reason()
+                reasons[key] = reasons.get(key, 0) + 1
+        reason_strings = sorted(f"{v} {k}" for k, v in reasons.items())
+        return (NO_NODE_AVAILABLE_MSG.format(self.num_all_nodes)
+                + ": " + ", ".join(reason_strings) + ".")
+
+
+ERR_NO_NODES_AVAILABLE = SchedulingError("no nodes available to schedule pods")
+
+
+@dataclass
+class ScheduleResult:
+    suggested_host: str
+    evaluated_nodes: int = 0
+    feasible_nodes: int = 0
+
+
+class GenericScheduler:
+    """Reference: generic_scheduler.go:93-200 (genericScheduler struct + Schedule)."""
+
+    def __init__(
+        self,
+        predicates: Dict[str, Callable],
+        prioritizers: List[PriorityConfig],
+        predicate_meta_producer: Callable = get_predicate_metadata,
+        priority_meta_producer: Optional[Callable] = None,
+        extenders: Optional[list] = None,
+        always_check_all_predicates: bool = False,
+    ):
+        self.predicates = predicates
+        self.prioritizers = prioritizers
+        self.predicate_meta_producer = predicate_meta_producer
+        self.priority_meta_producer = priority_meta_producer
+        self.extenders = extenders or []
+        self.always_check_all_predicates = always_check_all_predicates
+        self.last_node_index = 0  # persistent round-robin counter (:97)
+
+    # --- filter phase ---
+
+    def pod_fits_on_node(self, pod: Pod, meta: Optional[PredicateMetadata],
+                         node_info: NodeInfo) -> tuple[bool, List[PredicateFailureReason]]:
+        """Reference: generic_scheduler.go:420-534, with the nominated-pods
+        double-pass elided (pod priority is feature-gated off in the simulator,
+        so no nominated pods exist; SURVEY.md §3.3)."""
+        fails: List[PredicateFailureReason] = []
+        fits = True
+        for pred_key in PREDICATES_ORDERING:
+            predicate = self.predicates.get(pred_key)
+            if predicate is None:
+                continue
+            fit, reasons = predicate(pod, meta, node_info)
+            if not fit:
+                fits = False
+                fails.extend(reasons)
+                if not self.always_check_all_predicates:
+                    break
+        return fits, fails
+
+    def find_nodes_that_fit(self, pod: Pod, nodes: List[Node],
+                            node_info_map: Dict[str, NodeInfo]
+                            ) -> tuple[List[Node], Dict[str, List[PredicateFailureReason]]]:
+        """Reference: generic_scheduler.go:289-377."""
+        if not self.predicates:
+            filtered = list(nodes)
+            failed: Dict[str, List[PredicateFailureReason]] = {}
+        else:
+            meta = self.predicate_meta_producer(pod, node_info_map)
+            filtered = []
+            failed = {}
+            for node in nodes:
+                fits, fails = self.pod_fits_on_node(pod, meta, node_info_map[node.name])
+                if fits:
+                    filtered.append(node)
+                else:
+                    failed[node.name] = fails
+        if filtered and self.extenders:
+            for extender in self.extenders:
+                filtered, failed_map = extender.filter(pod, filtered, node_info_map)
+                for name, reason in failed_map.items():
+                    failed[name] = [reason]
+                if not filtered:
+                    break
+        return filtered, failed
+
+    # --- score phase ---
+
+    def prioritize_nodes(self, pod: Pod, node_info_map: Dict[str, NodeInfo],
+                         nodes: List[Node]) -> List[HostPriority]:
+        """Reference: generic_scheduler.go:542-680."""
+        # If no priority configs and no extenders: all nodes score 1 (:556-571).
+        if not self.prioritizers and not self.extenders:
+            return [HostPriority(n.name, 1) for n in nodes]
+
+        meta = self.priority_meta_producer(pod) if self.priority_meta_producer else None
+
+        # map phase per config (nodes × maps), then per-config reduce
+        results: List[List[HostPriority]] = []
+        for config in self.prioritizers:
+            if config.function is not None:
+                results.append(config.function(pod, node_info_map, nodes))
+            else:
+                per_node = [config.map_fn(pod, meta, node_info_map[n.name]) for n in nodes]
+                results.append(per_node)
+        for i, config in enumerate(self.prioritizers):
+            if config.reduce_fn is not None:
+                config.reduce_fn(pod, meta, node_info_map, results[i])
+
+        # weighted sum (:631-639)
+        result = []
+        for i, node in enumerate(nodes):
+            total = 0
+            for j, config in enumerate(self.prioritizers):
+                total += results[j][i].score * config.weight
+            result.append(HostPriority(node.name, total))
+
+        if self.extenders:
+            combined = {hp.host: hp.score for hp in result}
+            for extender in self.extenders:
+                prioritized_list, weight = extender.prioritize(pod, nodes)
+                for hp in prioritized_list:
+                    combined[hp.host] += hp.score * weight
+            result = [HostPriority(n.name, combined[n.name]) for n in nodes]
+        return result
+
+    # --- select phase ---
+
+    def select_host(self, priority_list: List[HostPriority]) -> str:
+        """Reference: generic_scheduler.go:183-198 — stable sort desc by score,
+        round-robin among the top-score ties via the persistent counter."""
+        if not priority_list:
+            raise SchedulingError("empty priorityList")
+        ordered = sorted(priority_list, key=lambda hp: -hp.score)
+        max_score = ordered[0].score
+        first_after_max = 1
+        while first_after_max < len(ordered) and ordered[first_after_max].score == max_score:
+            first_after_max += 1
+        ix = self.last_node_index % first_after_max
+        self.last_node_index += 1
+        return ordered[ix].host
+
+    # --- the pipeline ---
+
+    def schedule(self, pod: Pod, nodes: List[Node],
+                 node_info_map: Dict[str, NodeInfo]) -> str:
+        """Reference: generic_scheduler.go:112-180."""
+        if not nodes:
+            raise ERR_NO_NODES_AVAILABLE
+        filtered, failed_predicate_map = self.find_nodes_that_fit(pod, nodes, node_info_map)
+        if not filtered:
+            raise FitError(pod, len(nodes), failed_predicate_map)
+        if len(filtered) == 1:
+            return filtered[0].name
+        priority_list = self.prioritize_nodes(pod, node_info_map, filtered)
+        return self.select_host(priority_list)
+
+    def preempt(self, pod: Pod, nodes: List[Node],
+                node_info_map: Dict[str, NodeInfo], schedule_err: Exception):
+        """Reference: generic_scheduler.go:205-262. Pod priority is feature-gated
+        off at the reference's defaults (scheduler.go:210-213 short-circuits via
+        util.PodPriorityEnabled), so preemption never fires in simulation runs;
+        the full victim-selection pipeline is tracked for a later milestone."""
+        return None, [], []

@@ -1,0 +1,274 @@
+"""Plugin registry + algorithm providers.
+
+Reference: factory/plugins.go:111-376 (RegisterFitPredicate /
+RegisterPriorityFunction2 / RegisterAlgorithmProvider / policy factories) and
+algorithmprovider/defaults/defaults.go (DefaultProvider,
+ClusterAutoscalerProvider, and the locally-added TalkintDataProvider =
+defaults with LeastRequested→MostRequested; defaults.go:33-37,207-217).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+from tpusim.engine import predicates as preds
+from tpusim.engine import priorities as prios
+from tpusim.engine.generic_scheduler import GenericScheduler
+from tpusim.engine.priorities import PriorityConfig
+
+DEFAULT_PROVIDER = "DefaultProvider"
+CLUSTER_AUTOSCALER_PROVIDER = "ClusterAutoscalerProvider"
+TD_PROVIDER = "TalkintDataProvider"
+
+DEFAULT_HARD_POD_AFFINITY_SYMMETRIC_WEIGHT = 1  # schedulerapi default; simulator passes 10
+
+
+@dataclass
+class PluginFactoryArgs:
+    """Reference: factory/plugins.go PluginFactoryArgs — the listers handed to
+    predicate/priority factories."""
+
+    pod_lister: Callable[[], list] = field(default=lambda: [])
+    service_lister: Callable[[], list] = field(default=lambda: [])
+    controller_lister: Callable[[], list] = field(default=lambda: [])
+    replica_set_lister: Callable[[], list] = field(default=lambda: [])
+    stateful_set_lister: Callable[[], list] = field(default=lambda: [])
+    node_info_getter: Callable[[str], object] = field(default=lambda name: None)
+    hard_pod_affinity_symmetric_weight: int = DEFAULT_HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
+
+
+@dataclass
+class PriorityConfigFactory:
+    map_reduce_function: Optional[Callable] = None  # args -> (map_fn, reduce_fn)
+    function: Optional[Callable] = None             # args -> legacy function
+    weight: int = 1
+
+
+class AlgorithmRegistry:
+    """One registry instance == the Go package-level registries."""
+
+    def __init__(self):
+        self.fit_predicates: Dict[str, Callable] = {}           # name -> fn
+        self.fit_predicate_factories: Dict[str, Callable] = {}  # name -> (args -> fn)
+        self.mandatory_fit_predicates: Set[str] = set()
+        self.priority_factories: Dict[str, PriorityConfigFactory] = {}
+        self.providers: Dict[str, tuple[Set[str], Set[str]]] = {}
+
+    # --- registration (plugins.go:111-376) ---
+
+    def register_fit_predicate(self, name: str, fn: Callable) -> str:
+        self.fit_predicates[name] = fn
+        return name
+
+    def register_fit_predicate_factory(self, name: str, factory: Callable) -> str:
+        self.fit_predicate_factories[name] = factory
+        return name
+
+    def register_mandatory_fit_predicate(self, name: str, fn: Callable) -> str:
+        self.fit_predicates[name] = fn
+        self.mandatory_fit_predicates.add(name)
+        return name
+
+    def remove_fit_predicate(self, name: str) -> None:
+        self.fit_predicates.pop(name, None)
+        self.fit_predicate_factories.pop(name, None)
+        self.mandatory_fit_predicates.discard(name)
+
+    def register_priority_function2(self, name: str, map_fn, reduce_fn, weight: int) -> str:
+        self.priority_factories[name] = PriorityConfigFactory(
+            map_reduce_function=lambda args: (map_fn, reduce_fn), weight=weight)
+        return name
+
+    def register_priority_config_factory(self, name: str,
+                                         factory: PriorityConfigFactory) -> str:
+        self.priority_factories[name] = factory
+        return name
+
+    def register_algorithm_provider(self, name: str, predicate_keys: Set[str],
+                                    priority_keys: Set[str]) -> str:
+        self.providers[name] = (set(predicate_keys), set(priority_keys))
+        return name
+
+    def get_algorithm_provider(self, name: str) -> tuple[Set[str], Set[str]]:
+        if name not in self.providers:
+            raise KeyError(f"plugin {name!r} has not been registered")
+        return self.providers[name]
+
+    # --- assembly (factory.go CreateFromKeys:1021-1082) ---
+
+    def build_predicates(self, keys: Set[str], args: PluginFactoryArgs) -> Dict[str, Callable]:
+        result: Dict[str, Callable] = {}
+        for key in set(keys) | self.mandatory_fit_predicates:
+            if key in self.fit_predicate_factories:
+                result[key] = self.fit_predicate_factories[key](args)
+            elif key in self.fit_predicates:
+                result[key] = self.fit_predicates[key]
+            else:
+                raise KeyError(f"invalid predicate key {key!r}")
+        return result
+
+    def build_prioritizers(self, keys: Set[str], args: PluginFactoryArgs
+                           ) -> List[PriorityConfig]:
+        configs = []
+        for key in sorted(keys):  # deterministic (Go iterates a map)
+            if key not in self.priority_factories:
+                raise KeyError(f"invalid priority key {key!r}")
+            factory = self.priority_factories[key]
+            if factory.function is not None:
+                configs.append(PriorityConfig(name=key, weight=factory.weight,
+                                              function=factory.function(args)))
+            else:
+                map_fn, reduce_fn = factory.map_reduce_function(args)
+                configs.append(PriorityConfig(name=key, weight=factory.weight,
+                                              map_fn=map_fn, reduce_fn=reduce_fn))
+        return configs
+
+
+def default_registry() -> AlgorithmRegistry:
+    """Reproduces algorithmprovider/defaults/defaults.go init()."""
+    r = AlgorithmRegistry()
+
+    # --- predicates (defaults.go:113-178 + init extras) ---
+    r.register_fit_predicate_factory(
+        preds.NO_VOLUME_ZONE_CONFLICT_PRED, lambda args: preds.no_volume_zone_conflict)
+    r.register_fit_predicate_factory(
+        preds.MAX_EBS_VOLUME_COUNT_PRED,
+        lambda args: preds.make_max_pd_volume_count_predicate("EBS"))
+    r.register_fit_predicate_factory(
+        preds.MAX_GCE_PD_VOLUME_COUNT_PRED,
+        lambda args: preds.make_max_pd_volume_count_predicate("GCE"))
+    r.register_fit_predicate_factory(
+        preds.MAX_AZURE_DISK_VOLUME_COUNT_PRED,
+        lambda args: preds.make_max_pd_volume_count_predicate("AzureDisk"))
+    r.register_fit_predicate_factory(
+        preds.MATCH_INTERPOD_AFFINITY_PRED,
+        lambda args: preds.make_pod_affinity_predicate(args.node_info_getter,
+                                                       args.pod_lister))
+    r.register_fit_predicate(preds.NO_DISK_CONFLICT_PRED, preds.no_disk_conflict)
+    r.register_fit_predicate(preds.GENERAL_PRED, preds.general_predicates)
+    r.register_fit_predicate(preds.CHECK_NODE_MEMORY_PRESSURE_PRED,
+                             preds.check_node_memory_pressure)
+    r.register_fit_predicate(preds.CHECK_NODE_DISK_PRESSURE_PRED,
+                             preds.check_node_disk_pressure)
+    r.register_mandatory_fit_predicate(preds.CHECK_NODE_CONDITION_PRED,
+                                       preds.check_node_condition)
+    r.register_fit_predicate(preds.POD_TOLERATES_NODE_TAINTS_PRED,
+                             preds.pod_tolerates_node_taints)
+    r.register_fit_predicate_factory(
+        preds.CHECK_VOLUME_BINDING_PRED, lambda args: preds.check_volume_binding)
+    # registered-but-not-default predicates (defaults.go init():60-111)
+    r.register_fit_predicate(preds.POD_FITS_RESOURCES_PRED, preds.pod_fits_resources)
+    r.register_fit_predicate(preds.HOSTNAME_PRED, preds.pod_fits_host)
+    r.register_fit_predicate(preds.POD_FITS_HOST_PORTS_PRED, preds.pod_fits_host_ports)
+    r.register_fit_predicate(preds.MATCH_NODE_SELECTOR_PRED, preds.pod_match_node_selector)
+    r.register_fit_predicate(preds.CHECK_NODE_UNSCHEDULABLE_PRED,
+                             preds.check_node_unschedulable)
+    r.register_fit_predicate(preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+                             preds.pod_tolerates_node_no_execute_taints)
+
+    default_predicate_keys = {
+        preds.NO_VOLUME_ZONE_CONFLICT_PRED,
+        preds.MAX_EBS_VOLUME_COUNT_PRED,
+        preds.MAX_GCE_PD_VOLUME_COUNT_PRED,
+        preds.MAX_AZURE_DISK_VOLUME_COUNT_PRED,
+        preds.MATCH_INTERPOD_AFFINITY_PRED,
+        preds.NO_DISK_CONFLICT_PRED,
+        preds.GENERAL_PRED,
+        preds.CHECK_NODE_MEMORY_PRESSURE_PRED,
+        preds.CHECK_NODE_DISK_PRESSURE_PRED,
+        preds.CHECK_NODE_CONDITION_PRED,
+        preds.POD_TOLERATES_NODE_TAINTS_PRED,
+        preds.CHECK_VOLUME_BINDING_PRED,
+    }
+
+    # --- priorities (defaults.go:219-259 + init extras) ---
+    r.register_priority_config_factory(
+        "SelectorSpreadPriority",
+        PriorityConfigFactory(
+            map_reduce_function=lambda args: _selector_spread_map_reduce(args),
+            weight=1))
+    r.register_priority_config_factory(
+        "InterPodAffinityPriority",
+        PriorityConfigFactory(
+            function=lambda args: prios.InterPodAffinityPriority(
+                args.node_info_getter,
+                args.hard_pod_affinity_symmetric_weight).calculate,
+            weight=1))
+    r.register_priority_function2("LeastRequestedPriority",
+                                  prios.least_requested_priority_map, None, 1)
+    r.register_priority_function2("BalancedResourceAllocation",
+                                  prios.balanced_resource_allocation_map, None, 1)
+    r.register_priority_function2("NodePreferAvoidPodsPriority",
+                                  prios.calculate_node_prefer_avoid_pods_priority_map,
+                                  None, 10000)
+    r.register_priority_function2("NodeAffinityPriority",
+                                  prios.calculate_node_affinity_priority_map,
+                                  prios.calculate_node_affinity_priority_reduce, 1)
+    r.register_priority_function2("TaintTolerationPriority",
+                                  prios.compute_taint_toleration_priority_map,
+                                  prios.compute_taint_toleration_priority_reduce, 1)
+    # registered-but-not-default (defaults.go:100-111)
+    r.register_priority_function2("EqualPriority", prios.equal_priority_map, None, 1)
+    r.register_priority_function2("ImageLocalityPriority",
+                                  prios.image_locality_priority_map, None, 1)
+    r.register_priority_function2("MostRequestedPriority",
+                                  prios.most_requested_priority_map, None, 1)
+
+    default_priority_keys = {
+        "SelectorSpreadPriority",
+        "InterPodAffinityPriority",
+        "LeastRequestedPriority",
+        "BalancedResourceAllocation",
+        "NodePreferAvoidPodsPriority",
+        "NodeAffinityPriority",
+        "TaintTolerationPriority",
+    }
+
+    def copy_and_replace(keys: Set[str], what: str, with_: str) -> Set[str]:
+        result = set(keys)
+        if what in result:
+            result.discard(what)
+            result.add(with_)
+        return result
+
+    # registerAlgorithmProvider (defaults.go:207-217)
+    r.register_algorithm_provider(DEFAULT_PROVIDER, default_predicate_keys,
+                                  default_priority_keys)
+    autoscaler_priorities = copy_and_replace(
+        default_priority_keys, "LeastRequestedPriority", "MostRequestedPriority")
+    r.register_algorithm_provider(CLUSTER_AUTOSCALER_PROVIDER, default_predicate_keys,
+                                  autoscaler_priorities)
+    r.register_algorithm_provider(TD_PROVIDER, default_predicate_keys,
+                                  autoscaler_priorities)
+    return r
+
+
+def _selector_spread_map_reduce(args: PluginFactoryArgs):
+    spread = prios.SelectorSpread(args.service_lister, args.controller_lister,
+                                  args.replica_set_lister, args.stateful_set_lister)
+    return spread.calculate_spread_priority_map, spread.calculate_spread_priority_reduce
+
+
+def create_from_provider(provider: str, args: PluginFactoryArgs,
+                         registry: Optional[AlgorithmRegistry] = None,
+                         always_check_all_predicates: bool = False) -> GenericScheduler:
+    """factory.go CreateFromProvider → CreateFromKeys."""
+    registry = registry or default_registry()
+    pred_keys, pri_keys = registry.get_algorithm_provider(provider)
+    predicates = registry.build_predicates(pred_keys, args)
+    prioritizers = registry.build_prioritizers(pri_keys, args)
+
+    selector_spread = prios.SelectorSpread(
+        args.service_lister, args.controller_lister,
+        args.replica_set_lister, args.stateful_set_lister)
+
+    def priority_meta_producer(pod):
+        return prios.get_priority_metadata(pod, selector_spread)
+
+    return GenericScheduler(
+        predicates=predicates,
+        prioritizers=prioritizers,
+        priority_meta_producer=priority_meta_producer,
+        always_check_all_predicates=always_check_all_predicates,
+    )

@@ -1,0 +1,217 @@
+"""Differential parity: JaxBackend placements must be identical to
+ReferenceBackend (the BASELINE.md 'placement-parity' metric)."""
+
+import random
+
+import pytest
+
+from tpusim.api.podspec import expand_simulation_pods, parse_simulation_pods
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod, synthetic_cluster
+from tpusim.backends import ReferenceBackend, placement_hash
+from tpusim.jaxe.backend import JaxBackend
+
+QUICKSTART_YAML = """
+- name: A
+  num: 10
+  pod:
+    spec:
+      containers:
+      - resources:
+          requests:
+            cpu: 1
+            memory: 1
+- name: B
+  num: 10
+  pod:
+    spec:
+      containers:
+      - resources:
+          requests:
+            cpu: 100
+            memory: 1000
+"""
+
+
+def assert_parity(pods, snapshot, provider="DefaultProvider"):
+    ref = ReferenceBackend(provider=provider).schedule(pods, snapshot)
+    jx = JaxBackend(provider=provider, fallback="error").schedule(pods, snapshot)
+    for i, (r, j) in enumerate(zip(ref, jx)):
+        assert (r.node_name, r.reason) == (j.node_name, j.reason), (
+            f"pod {i} ({r.pod.name}): ref={r.node_name or r.message!r} "
+            f"jax={j.node_name or j.message!r}")
+        assert r.message == j.message, f"pod {i}: {r.message!r} != {j.message!r}"
+    assert placement_hash(ref) == placement_hash(jx)
+    return ref
+
+
+def test_quickstart_parity():
+    pods = expand_simulation_pods(parse_simulation_pods(QUICKSTART_YAML),
+                                  deterministic_ids=True)
+    snap = synthetic_cluster(4, milli_cpu=4000, memory=16 * 1024**3)
+    placements = assert_parity(list(reversed(pods)), snap)
+    assert sum(1 for p in placements if p.scheduled) == 10
+
+
+@pytest.mark.parametrize("provider", ["DefaultProvider", "TalkintDataProvider"])
+def test_random_uniform_parity(provider):
+    rng = random.Random(42)
+    nodes = [make_node(f"n{i}", milli_cpu=rng.choice([2000, 4000, 8000]),
+                       memory=rng.choice([4, 8, 16]) * 1024**3,
+                       pods=rng.choice([5, 110]))
+             for i in range(12)]
+    snap = ClusterSnapshot(nodes=nodes)
+    pods = [make_pod(f"p{i}", milli_cpu=rng.randrange(0, 3000),
+                     memory=rng.randrange(0, 4 * 1024**3))
+            for i in range(80)]
+    assert_parity(pods, snap, provider)
+
+
+def test_parity_with_taints_and_selectors():
+    rng = random.Random(7)
+    nodes = []
+    for i in range(10):
+        taints = []
+        if i % 3 == 0:
+            taints.append({"key": "dedicated", "value": "batch", "effect": "NoSchedule"})
+        if i % 4 == 0:
+            taints.append({"key": "soft", "value": "x", "effect": "PreferNoSchedule"})
+        nodes.append(make_node(f"n{i}", milli_cpu=4000, memory=8 * 1024**3,
+                               labels={"zone": "a" if i < 5 else "b"},
+                               taints=taints))
+    snap = ClusterSnapshot(nodes=nodes)
+    pods = []
+    for i in range(60):
+        kwargs = {}
+        roll = rng.random()
+        if roll < 0.3:
+            kwargs["node_selector"] = {"zone": rng.choice(["a", "b"])}
+        if roll < 0.5:
+            kwargs["tolerations"] = [{"key": "dedicated", "operator": "Equal",
+                                      "value": "batch", "effect": "NoSchedule"}]
+        if 0.5 < roll < 0.7:
+            kwargs["tolerations"] = [{"key": "soft", "operator": "Exists",
+                                      "effect": "PreferNoSchedule"}]
+        pods.append(make_pod(f"p{i}", milli_cpu=rng.randrange(100, 1500),
+                             memory=rng.randrange(2**20, 2 * 1024**3), **kwargs))
+    assert_parity(pods, snap)
+
+
+def test_parity_with_node_affinity():
+    nodes = [make_node(f"n{i}", milli_cpu=4000, memory=8 * 1024**3,
+                       labels={"disk": "ssd" if i % 2 == 0 else "hdd",
+                               "zone": f"z{i % 3}"})
+             for i in range(9)]
+    snap = ClusterSnapshot(nodes=nodes)
+    required = {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+        "nodeSelectorTerms": [{"matchExpressions": [
+            {"key": "disk", "operator": "In", "values": ["ssd"]}]}]}}}
+    preferred = {"nodeAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+        {"weight": 3, "preference": {"matchExpressions": [
+            {"key": "zone", "operator": "In", "values": ["z1"]}]}},
+        {"weight": 1, "preference": {"matchExpressions": [
+            {"key": "disk", "operator": "Exists"}]}}]}}
+    both = {"nodeAffinity": {**required["nodeAffinity"], **preferred["nodeAffinity"]}}
+    pods = []
+    for i in range(30):
+        aff = [None, required, preferred, both][i % 4]
+        pods.append(make_pod(f"p{i}", milli_cpu=300, memory=512 * 2**20,
+                             affinity=aff))
+    assert_parity(pods, snap)
+
+
+def test_parity_unschedulable_reasons():
+    nodes = [make_node("ok", milli_cpu=1000, memory=1024**3),
+             make_node("down", ready=False),
+             make_node("cordoned", unschedulable=True)]
+    snap = ClusterSnapshot(nodes=nodes)
+    pods = [make_pod("fits", milli_cpu=500),
+            make_pod("too-big", milli_cpu=5000, memory=8 * 1024**3),
+            make_pod("fits2", milli_cpu=400),
+            make_pod("no-room", milli_cpu=500)]
+    placements = assert_parity(pods, snap)
+    assert placements[1].message.startswith("0/3 nodes are available: ")
+    assert "Insufficient cpu" in placements[1].message
+    assert "node(s) were not ready" in placements[1].message
+    assert "node(s) were unschedulable" in placements[1].message
+
+
+def test_parity_scalar_resources_and_gpu():
+    nodes = [make_node("gpu1", milli_cpu=8000, memory=16 * 1024**3, gpus=4),
+             make_node("plain", milli_cpu=8000, memory=16 * 1024**3)]
+    for n in nodes:
+        n.status.allocatable["example.com/fpga"] = __import__(
+            "tpusim.api.quantity", fromlist=["parse_quantity"]).parse_quantity("2")
+    snap = ClusterSnapshot(nodes=nodes)
+    pods = [make_pod(f"g{i}", milli_cpu=500, gpus=1) for i in range(6)]
+    fpga_pod = make_pod("f0", milli_cpu=100)
+    fpga_pod.spec.containers[0].requests["example.com/fpga"] = __import__(
+        "tpusim.api.quantity", fromlist=["parse_quantity"]).parse_quantity("3")
+    pods.append(fpga_pod)
+    placements = assert_parity(pods, snap)
+    assert sum(1 for p in placements[:6] if p.scheduled) == 4  # only 4 gpus
+    assert not placements[6].scheduled
+    assert "Insufficient example.com/fpga" in placements[6].message
+
+
+def test_parity_prescheduled_pods():
+    nodes = [make_node(f"n{i}", milli_cpu=4000, memory=8 * 1024**3) for i in range(4)]
+    existing = [make_pod(f"e{i}", milli_cpu=1000, memory=1024**3,
+                         node_name=f"n{i % 2}", phase="Running") for i in range(4)]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing)
+    pods = [make_pod(f"p{i}", milli_cpu=800, memory=512 * 2**20) for i in range(10)]
+    assert_parity(pods, snap)
+
+
+def test_fallback_on_interpod_affinity():
+    from tpusim.api.types import Affinity
+
+    snap = synthetic_cluster(3)
+    pod = make_pod("p", milli_cpu=100, labels={"app": "web"})
+    pod.spec.affinity = Affinity.from_obj({
+        "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "web"}},
+             "topologyKey": "kubernetes.io/hostname"}]}})
+    with pytest.raises(NotImplementedError):
+        JaxBackend(fallback="error").schedule([pod], snap)
+    # default fallback matches reference exactly
+    ref = ReferenceBackend().schedule([pod], snap)
+    jx = JaxBackend().schedule([pod], snap)
+    assert placement_hash(ref) == placement_hash(jx)
+
+
+def test_jax_backend_no_nodes():
+    placements = JaxBackend().schedule([make_pod("p")], ClusterSnapshot())
+    assert placements[0].message == "no nodes available to schedule pods"
+
+
+def test_node_only_scalar_resource_no_crash():
+    """Regression: a node advertising a scalar resource no pod requests must not
+    crash compilation (review finding)."""
+    from tpusim.api.quantity import parse_quantity
+
+    node = make_node("n1", milli_cpu=2000, memory=4 * 1024**3)
+    node.status.allocatable["example.com/fpga"] = parse_quantity("2")
+    snap = ClusterSnapshot(nodes=[node])
+    assert_parity([make_pod("p", milli_cpu=100)], snap)
+
+
+def test_fallback_on_existing_pod_required_affinity():
+    """Regression: existing pods with REQUIRED pod affinity feed the symmetric
+    hard-affinity weight — must fall back, not silently diverge (review finding)."""
+    from tpusim.api.types import Affinity
+
+    nodes = [make_node("a", labels={"zone": "z1"}),
+             make_node("b", labels={"zone": "z2"})]
+    peer = make_pod("peer", node_name="b", phase="Running", labels={"app": "db"})
+    peer.spec.affinity = Affinity.from_obj({
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "web"}},
+             "topologyKey": "zone"}]}})
+    snap = ClusterSnapshot(nodes=nodes, pods=[peer])
+    pod = make_pod("p", milli_cpu=100, labels={"app": "web"})
+    with pytest.raises(NotImplementedError):
+        JaxBackend(fallback="error").schedule([pod], snap)
+    ref = ReferenceBackend().schedule([pod], snap)
+    jx = JaxBackend().schedule([pod], snap)
+    assert placement_hash(ref) == placement_hash(jx)
+    assert ref[0].node_name == "b"  # symmetric weight attracts to the peer's zone

@@ -14,7 +14,7 @@ keep node-list order), which both backends implement identically.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from tpusim.api.types import Node, Pod
@@ -24,11 +24,7 @@ from tpusim.engine.predicates import (
     PredicateMetadata,
     get_predicate_metadata,
 )
-from tpusim.engine.priorities import (
-    HostPriority,
-    PriorityConfig,
-    equal_priority_map,
-)
+from tpusim.engine.priorities import HostPriority, PriorityConfig
 from tpusim.engine.resources import NodeInfo
 
 NO_NODE_AVAILABLE_MSG = "0/{} nodes are available"

@@ -417,12 +417,15 @@ def make_node_label_presence_predicate(labels: List[str], presence: bool) -> Fit
 
 def make_service_affinity_predicate(affinity_labels: List[str],
                                     pod_lister: Callable[[], List[Pod]],
-                                    service_lister: Callable[[], list]) -> FitPredicate:
+                                    service_lister: Callable[[], list],
+                                    node_getter: Callable[[str], Optional[Node]] = lambda name: None,
+                                    ) -> FitPredicate:
     """Reference: predicates.go NewServiceAffinityPredicate (policy-configured).
 
     The pod must land on a node whose values for ``affinity_labels`` equal the
     values on the node of an arbitrary existing pod of the same service (or the
-    pod's own nodeSelector values when no service peer exists).
+    pod's own nodeSelector values when no service peer exists). ``node_getter``
+    resolves a peer pod's nodeName to its Node.
     """
 
     def check_service_affinity(pod: Pod, meta, node_info: NodeInfo) -> PredicateResult:
@@ -446,7 +449,7 @@ def make_service_affinity_predicate(affinity_labels: List[str],
                 if service_pods:
                     first = service_pods[0]
                     if first.spec.node_name:
-                        other = _node_by_name.get(first.spec.node_name)
+                        other = node_getter(first.spec.node_name)
                         if other is not None:
                             for l in unresolved:
                                 if l in other.metadata.labels:
@@ -457,9 +460,6 @@ def make_service_affinity_predicate(affinity_labels: List[str],
                 return False, [err.ERR_SERVICE_AFFINITY_VIOLATED]
         return True, []
 
-    # populated lazily by the scheduler when it builds the node-info map
-    _node_by_name: Dict[str, Node] = {}
-    check_service_affinity.node_by_name = _node_by_name  # type: ignore[attr-defined]
     return check_service_affinity
 
 

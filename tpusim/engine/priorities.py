@@ -8,7 +8,6 @@ function (InterPodAffinity). MaxPriority = 10 (api/types.go:36).
 from __future__ import annotations
 
 from dataclasses import dataclass
-import math
 from typing import Callable, Dict, List, Optional
 
 from tpusim.api.types import (

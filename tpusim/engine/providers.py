@@ -37,6 +37,15 @@ class PluginFactoryArgs:
     node_info_getter: Callable[[str], object] = field(default=lambda name: None)
     hard_pod_affinity_symmetric_weight: int = DEFAULT_HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
 
+    def selector_spread(self) -> "prios.SelectorSpread":
+        """One shared SelectorSpread per factory args, so the map/reduce fns and
+        the priority-metadata pod_selectors can never disagree."""
+        if not hasattr(self, "_selector_spread"):
+            self._selector_spread = prios.SelectorSpread(
+                self.service_lister, self.controller_lister,
+                self.replica_set_lister, self.stateful_set_lister)
+        return self._selector_spread
+
 
 @dataclass
 class PriorityConfigFactory:
@@ -245,8 +254,7 @@ def default_registry() -> AlgorithmRegistry:
 
 
 def _selector_spread_map_reduce(args: PluginFactoryArgs):
-    spread = prios.SelectorSpread(args.service_lister, args.controller_lister,
-                                  args.replica_set_lister, args.stateful_set_lister)
+    spread = args.selector_spread()
     return spread.calculate_spread_priority_map, spread.calculate_spread_priority_reduce
 
 
@@ -259,12 +267,8 @@ def create_from_provider(provider: str, args: PluginFactoryArgs,
     predicates = registry.build_predicates(pred_keys, args)
     prioritizers = registry.build_prioritizers(pri_keys, args)
 
-    selector_spread = prios.SelectorSpread(
-        args.service_lister, args.controller_lister,
-        args.replica_set_lister, args.stateful_set_lister)
-
     def priority_meta_producer(pod):
-        return prios.get_priority_metadata(pod, selector_spread)
+        return prios.get_priority_metadata(pod, args.selector_spread())
 
     return GenericScheduler(
         predicates=predicates,

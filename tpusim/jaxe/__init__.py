@@ -1,0 +1,31 @@
+"""The JAX/TPU backend: columnar cluster state + batched scheduling kernels.
+
+Design (SURVEY.md §7, BASELINE.json north star): the reference's per-pod
+Filter/Score loop over a 16-worker goroutine fan-out becomes
+
+  1. a host-side COMPILE step — pods' symbolic features (node selectors,
+     tolerations, node-affinity terms, controller refs, hostname pins) are
+     interned into signature classes and evaluated against the STATIC node
+     attributes (labels, taints, conditions — immutable during a simulation)
+     into dense [signature, node] tables, using the parity engine's own
+     matching functions so semantics match by construction. This subsumes the
+     reference's equivalence cache (core/equivalence_cache.go): instead of
+     memoizing per-pod predicate results behind an equivalence hash, every
+     class×node result is materialized once, up front, vectorized.
+
+  2. a DEVICE scan — `lax.scan` over the pod axis carrying only numeric
+     aggregates (requested/nonzero resources, pod counts, the round-robin
+     counter). Each step fuses predicate masks + reason codes, priority
+     scores, weighted sum, tie-break selection, and the bind scatter-add into
+     one compiled program. Exact integer semantics via int64 (x64 mode).
+
+Integer/float precision: scores use int64 (Go int); BalancedResourceAllocation
+uses float64 exactly like Go. Memory quantities are byte-exact int64.
+"""
+
+import jax
+
+# Go semantics are 64-bit; placement parity requires byte-exact memory sums and
+# int64 score arithmetic. On TPU, int64 is emulated 32-bit-pairwise — the fast
+# path can later narrow where ranges allow.
+jax.config.update("jax_enable_x64", True)

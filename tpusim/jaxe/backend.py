@@ -1,0 +1,110 @@
+"""JaxBackend: the SimulatorBackend implementation running on TPU/XLA.
+
+Exactness contract: for workloads within the compiled feature set (resources,
+node conditions/pressure, taints/tolerations, node selectors, node affinity,
+hostname pins, scalar resources, controller-avoid annotations) placements are
+IDENTICAL to ReferenceBackend — verified by randomized differential tests.
+Features whose state the device kernels don't carry yet (inter-pod
+(anti)affinity, host ports, services/selector-spreading) are detected at
+compile time and routed to the reference backend (fallback="reference") or
+rejected (fallback="error").
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+import numpy as np
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.api.types import Pod
+from tpusim.backends import Placement, ReferenceBackend, bind_pod, mark_unschedulable
+from tpusim.engine.generic_scheduler import NO_NODE_AVAILABLE_MSG
+from tpusim.engine.providers import (
+    CLUSTER_AUTOSCALER_PROVIDER,
+    DEFAULT_PROVIDER,
+    TD_PROVIDER,
+)
+from tpusim.jaxe.kernels import (
+    EngineConfig,
+    carry_init,
+    pod_columns_to_device,
+    schedule_scan,
+    statics_to_device,
+)
+from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
+
+log = logging.getLogger(__name__)
+
+_MOST_REQUESTED_PROVIDERS = {CLUSTER_AUTOSCALER_PROVIDER, TD_PROVIDER}
+_KNOWN_PROVIDERS = {DEFAULT_PROVIDER} | _MOST_REQUESTED_PROVIDERS
+
+
+def format_fit_error(num_nodes: int, counts: np.ndarray, strings: List[str]) -> str:
+    """Byte-identical FitError.Error() (generic_scheduler.go:71-90)."""
+    reason_strs = sorted(f"{int(c)} {strings[i]}"
+                         for i, c in enumerate(counts) if c > 0)
+    return (NO_NODE_AVAILABLE_MSG.format(num_nodes)
+            + ": " + ", ".join(reason_strs) + ".")
+
+
+class JaxBackend:
+    name = "jax"
+
+    def __init__(self, provider: str = DEFAULT_PROVIDER, fallback: str = "reference",
+                 hard_pod_affinity_symmetric_weight: int = 10):
+        if provider not in _KNOWN_PROVIDERS:
+            raise KeyError(f"plugin {provider!r} has not been registered")
+        if fallback not in ("reference", "error"):
+            raise ValueError("fallback must be 'reference' or 'error'")
+        self.provider = provider
+        self.fallback = fallback
+        self.hard_pod_affinity_symmetric_weight = hard_pod_affinity_symmetric_weight
+
+    def schedule(self, pods: List[Pod], snapshot: ClusterSnapshot) -> List[Placement]:
+        if not pods:
+            return []
+        if not snapshot.nodes:
+            msg = "no nodes available to schedule pods"
+            return [Placement(pod=mark_unschedulable(p, msg),
+                              reason="Unschedulable", message=msg) for p in pods]
+
+        compiled, cols = compile_cluster(snapshot, pods)
+        if compiled.unsupported:
+            detail = "; ".join(sorted(set(compiled.unsupported))[:5])
+            if self.fallback == "error":
+                raise NotImplementedError(
+                    f"jax backend does not yet carry state for: {detail}")
+            log.warning("jax backend falling back to reference for: %s", detail)
+            return ReferenceBackend(
+                provider=self.provider,
+                hard_pod_affinity_symmetric_weight=self.hard_pod_affinity_symmetric_weight,
+            ).schedule(pods, snapshot)
+
+        num_bits = NUM_FIXED_BITS + len(compiled.scalar_names)
+        config = EngineConfig(
+            most_requested=self.provider in _MOST_REQUESTED_PROVIDERS,
+            num_reason_bits=num_bits)
+
+        carry = carry_init(compiled)
+        statics = statics_to_device(compiled)
+        xs = pod_columns_to_device(cols)
+        _, choices, counts = schedule_scan(config, carry, statics, xs)
+        choices = np.asarray(choices)
+        counts = np.asarray(counts)
+
+        strings = reason_strings(compiled.scalar_names)
+        names = compiled.statics.names
+        n = len(names)
+        placements: List[Placement] = []
+        for j, pod in enumerate(pods):
+            c = int(choices[j])
+            if c >= 0:
+                placements.append(Placement(pod=bind_pod(pod, names[c]),
+                                            node_name=names[c]))
+            else:
+                msg = format_fit_error(n, counts[j], strings)
+                placements.append(Placement(pod=mark_unschedulable(pod, msg),
+                                            reason="Unschedulable", message=msg))
+        return placements

@@ -1,0 +1,298 @@
+"""Device kernels: the fused filter→score→select→bind scan step.
+
+Reference mapping:
+  findNodesThatFit (generic_scheduler.go:289-377)  -> staged fail masks + reason bits
+  PrioritizeNodes  (generic_scheduler.go:542-680)  -> vectorized scores + masked normalize
+  selectHost       (generic_scheduler.go:183-198)  -> masked argmax + round-robin tie pick
+  assume/bind      (scheduler.go:431-497)          -> scatter-add into the carry
+
+One `lax.scan` step fuses the whole per-pod pipeline; the carry holds only the
+dynamic aggregates (requested/nonzero resources, pod counts, rr counter).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusim.jaxe.state import (
+    BIT_DISK_PRESSURE,
+    BIT_HOSTNAME_MISMATCH,
+    BIT_INSUFFICIENT_CPU,
+    BIT_INSUFFICIENT_EPHEMERAL,
+    BIT_INSUFFICIENT_GPU,
+    BIT_INSUFFICIENT_MEMORY,
+    BIT_INSUFFICIENT_PODS,
+    BIT_MEMORY_PRESSURE,
+    BIT_NODE_SELECTOR_MISMATCH,
+    BIT_TAINTS_NOT_TOLERATED,
+    NUM_FIXED_BITS,
+    CompiledCluster,
+    PodColumns,
+)
+
+MAX_PRIORITY = 10
+AVOID_PODS_WEIGHT = 10000
+
+
+class Carry(NamedTuple):
+    used_cpu: jnp.ndarray      # [N] int64
+    used_mem: jnp.ndarray
+    used_gpu: jnp.ndarray
+    used_eph: jnp.ndarray
+    used_scalar: jnp.ndarray   # [N, S]
+    nonzero_cpu: jnp.ndarray
+    nonzero_mem: jnp.ndarray
+    pod_count: jnp.ndarray
+    rr: jnp.ndarray            # scalar int64 — selectHost's lastNodeIndex
+
+
+class Statics(NamedTuple):
+    alloc_cpu: jnp.ndarray
+    alloc_mem: jnp.ndarray
+    alloc_gpu: jnp.ndarray
+    alloc_eph: jnp.ndarray
+    allowed_pods: jnp.ndarray
+    alloc_scalar: jnp.ndarray
+    cond_fail_bits: jnp.ndarray
+    mem_pressure: jnp.ndarray
+    disk_pressure: jnp.ndarray
+    selector_ok: jnp.ndarray
+    taint_ok: jnp.ndarray
+    intolerable: jnp.ndarray
+    affinity_count: jnp.ndarray
+    avoid_score: jnp.ndarray
+    host_ok: jnp.ndarray
+
+
+class PodX(NamedTuple):
+    """One scan step's xs slice."""
+
+    req_cpu: jnp.ndarray
+    req_mem: jnp.ndarray
+    req_gpu: jnp.ndarray
+    req_eph: jnp.ndarray
+    req_scalar: jnp.ndarray    # [S]
+    nz_cpu: jnp.ndarray
+    nz_mem: jnp.ndarray
+    zero_request: jnp.ndarray
+    best_effort: jnp.ndarray
+    sel_id: jnp.ndarray
+    tol_id: jnp.ndarray
+    aff_id: jnp.ndarray
+    avoid_id: jnp.ndarray
+    host_id: jnp.ndarray
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Static (compile-time) provider configuration."""
+
+    most_requested: bool = False  # LeastRequested -> MostRequested swap (TD/autoscaler)
+    num_reason_bits: int = NUM_FIXED_BITS
+
+
+def statics_to_device(compiled: CompiledCluster) -> Statics:
+    s, t = compiled.statics, compiled.tables
+    return Statics(
+        alloc_cpu=jnp.asarray(s.alloc_cpu), alloc_mem=jnp.asarray(s.alloc_mem),
+        alloc_gpu=jnp.asarray(s.alloc_gpu), alloc_eph=jnp.asarray(s.alloc_eph),
+        allowed_pods=jnp.asarray(s.allowed_pods),
+        alloc_scalar=jnp.asarray(s.alloc_scalar),
+        cond_fail_bits=jnp.asarray(s.cond_fail_bits),
+        mem_pressure=jnp.asarray(s.mem_pressure),
+        disk_pressure=jnp.asarray(s.disk_pressure),
+        selector_ok=jnp.asarray(t.selector_ok), taint_ok=jnp.asarray(t.taint_ok),
+        intolerable=jnp.asarray(t.intolerable),
+        affinity_count=jnp.asarray(t.affinity_count),
+        avoid_score=jnp.asarray(t.avoid_score), host_ok=jnp.asarray(t.host_ok))
+
+
+def carry_init(compiled: CompiledCluster) -> Carry:
+    d = compiled.dynamic
+    return Carry(
+        used_cpu=jnp.asarray(d.used_cpu), used_mem=jnp.asarray(d.used_mem),
+        used_gpu=jnp.asarray(d.used_gpu), used_eph=jnp.asarray(d.used_eph),
+        used_scalar=jnp.asarray(d.used_scalar),
+        nonzero_cpu=jnp.asarray(d.nonzero_cpu), nonzero_mem=jnp.asarray(d.nonzero_mem),
+        pod_count=jnp.asarray(d.pod_count), rr=jnp.asarray(0, dtype=jnp.int64))
+
+
+def pod_columns_to_device(cols: PodColumns) -> PodX:
+    return PodX(
+        req_cpu=jnp.asarray(cols.req_cpu), req_mem=jnp.asarray(cols.req_mem),
+        req_gpu=jnp.asarray(cols.req_gpu), req_eph=jnp.asarray(cols.req_eph),
+        req_scalar=jnp.asarray(cols.req_scalar),
+        nz_cpu=jnp.asarray(cols.nz_cpu), nz_mem=jnp.asarray(cols.nz_mem),
+        zero_request=jnp.asarray(cols.zero_request),
+        best_effort=jnp.asarray(cols.best_effort),
+        sel_id=jnp.asarray(cols.sel_id), tol_id=jnp.asarray(cols.tol_id),
+        aff_id=jnp.asarray(cols.aff_id), avoid_id=jnp.asarray(cols.avoid_id),
+        host_id=jnp.asarray(cols.host_id))
+
+
+def _ratio_score(requested, capacity, most: bool):
+    """least_requested.go:41-52 / most_requested.go:44-55, elementwise."""
+    valid = (capacity > 0) & (requested <= capacity)
+    if most:
+        raw = jnp.where(valid, (requested * MAX_PRIORITY) // jnp.maximum(capacity, 1), 0)
+    else:
+        raw = jnp.where(
+            valid, ((capacity - requested) * MAX_PRIORITY) // jnp.maximum(capacity, 1), 0)
+    return raw
+
+
+def _balanced_score(req_cpu, req_mem, alloc_cpu, alloc_mem):
+    """balanced_resource_allocation.go:39-63 — float64 like Go."""
+    cpu_frac = jnp.where(alloc_cpu == 0, 1.0,
+                         req_cpu.astype(jnp.float64) / jnp.maximum(alloc_cpu, 1))
+    mem_frac = jnp.where(alloc_mem == 0, 1.0,
+                         req_mem.astype(jnp.float64) / jnp.maximum(alloc_mem, 1))
+    diff = jnp.abs(cpu_frac - mem_frac)
+    score = ((1.0 - diff) * MAX_PRIORITY).astype(jnp.int64)
+    return jnp.where((cpu_frac >= 1) | (mem_frac >= 1), 0, score)
+
+
+def make_step(config: EngineConfig):
+    """Build the scan step: (carry, PodX) -> (carry', (choice, reason_counts))."""
+
+    num_bits = config.num_reason_bits
+
+    def step(state: tuple, x: PodX):
+        carry, st = state  # st: Statics closed into carry tuple for sharding ease
+
+        # ---- filter: staged fail masks in predicatesOrdering ----
+        # stage 0: CheckNodeCondition (static)
+        fail_cond = st.cond_fail_bits != 0
+
+        # stage 1: GeneralPredicates (PodFitsResources + Host + Ports + Selector)
+        insuff_pods = (carry.pod_count + 1) > st.allowed_pods
+        check_res = ~x.zero_request
+        insuff_cpu = check_res & (st.alloc_cpu < x.req_cpu + carry.used_cpu)
+        insuff_mem = check_res & (st.alloc_mem < x.req_mem + carry.used_mem)
+        insuff_gpu = check_res & (st.alloc_gpu < x.req_gpu + carry.used_gpu)
+        insuff_eph = check_res & (st.alloc_eph < x.req_eph + carry.used_eph)
+        # scalars: [N, S] comparison
+        insuff_scalar = check_res[..., None] & (
+            st.alloc_scalar < x.req_scalar[None, :] + carry.used_scalar)
+        host_bad = ~st.host_ok[x.host_id]
+        sel_bad = ~st.selector_ok[x.sel_id]
+        fail_general = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
+                        | insuff_eph | jnp.any(insuff_scalar, axis=-1)
+                        | host_bad | sel_bad)
+        bits_general = (
+            insuff_pods.astype(jnp.int64) << BIT_INSUFFICIENT_PODS
+            | insuff_cpu.astype(jnp.int64) << BIT_INSUFFICIENT_CPU
+            | insuff_mem.astype(jnp.int64) << BIT_INSUFFICIENT_MEMORY
+            | insuff_gpu.astype(jnp.int64) << BIT_INSUFFICIENT_GPU
+            | insuff_eph.astype(jnp.int64) << BIT_INSUFFICIENT_EPHEMERAL
+            | host_bad.astype(jnp.int64) << BIT_HOSTNAME_MISMATCH
+            | sel_bad.astype(jnp.int64) << BIT_NODE_SELECTOR_MISMATCH)
+        if st.alloc_scalar.shape[-1] > 0:
+            scalar_bits = (insuff_scalar.astype(jnp.int64)
+                           << (NUM_FIXED_BITS + jnp.arange(st.alloc_scalar.shape[-1],
+                                                           dtype=jnp.int64)))
+            bits_general = bits_general | jnp.sum(scalar_bits, axis=-1)
+
+        # stage 2: PodToleratesNodeTaints (static per toleration signature)
+        fail_taint = ~st.taint_ok[x.tol_id]
+        # stage 3/4: memory / disk pressure
+        fail_mem_pressure = st.mem_pressure & x.best_effort
+        fail_disk_pressure = st.disk_pressure
+
+        feasible = ~(fail_cond | fail_general | fail_taint
+                     | fail_mem_pressure | fail_disk_pressure)
+        # short-circuit reason selection: first failing stage wins
+        reason_bits = jnp.where(
+            fail_cond, st.cond_fail_bits,
+            jnp.where(fail_general, bits_general,
+                      jnp.where(fail_taint, jnp.int64(1) << BIT_TAINTS_NOT_TOLERATED,
+                                jnp.where(fail_mem_pressure,
+                                          jnp.int64(1) << BIT_MEMORY_PRESSURE,
+                                          jnp.where(fail_disk_pressure,
+                                                    jnp.int64(1) << BIT_DISK_PRESSURE,
+                                                    jnp.int64(0))))))
+
+        n_feasible = jnp.sum(feasible)
+
+        # ---- score (only feasible nodes matter) ----
+        total_cpu = x.nz_cpu + carry.nonzero_cpu
+        total_mem = x.nz_mem + carry.nonzero_mem
+        ratio = (_ratio_score(total_cpu, st.alloc_cpu, config.most_requested)
+                 + _ratio_score(total_mem, st.alloc_mem, config.most_requested)) // 2
+        balanced = _balanced_score(total_cpu, total_mem, st.alloc_cpu, st.alloc_mem)
+
+        # NodeAffinityPriority: NormalizeReduce(10, False) over feasible nodes
+        aff = st.affinity_count[x.aff_id]
+        aff_max = jnp.max(jnp.where(feasible, aff, 0))
+        aff_norm = jnp.where(aff_max > 0,
+                             MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
+
+        # TaintTolerationPriority: NormalizeReduce(10, True) over feasible nodes
+        intol = st.intolerable[x.tol_id]
+        intol_max = jnp.max(jnp.where(feasible, intol, 0))
+        taint_norm = jnp.where(
+            intol_max > 0,
+            MAX_PRIORITY - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
+            MAX_PRIORITY)
+
+        avoid = st.avoid_score[x.avoid_id] * AVOID_PODS_WEIGHT
+
+        score = ratio + balanced + aff_norm + taint_norm + avoid
+
+        # ---- select: stable-desc + round-robin among max ties ----
+        masked_score = jnp.where(feasible, score, jnp.int64(-1))
+        max_score = jnp.max(masked_score)
+        tie = feasible & (masked_score == max_score)
+        ties = jnp.maximum(jnp.sum(tie), 1)
+        # selectHost is only invoked when >1 node passed the filter; with exactly
+        # one feasible node scheduleOne returns it directly and the rr counter is
+        # NOT advanced (generic_scheduler.go:176-180).
+        k = jnp.where(n_feasible > 1, carry.rr % ties, 0)
+        rank = jnp.cumsum(tie.astype(jnp.int64)) - 1
+        pick = tie & (rank == k)
+        choice = jnp.argmax(pick).astype(jnp.int32)
+        found = n_feasible > 0
+        choice = jnp.where(found, choice, -1)
+        rr_next = carry.rr + jnp.where(n_feasible > 1, 1, 0)
+
+        # ---- bind: scatter-add into carry ----
+        idx = jnp.maximum(choice, 0)
+        gate = found.astype(jnp.int64)
+        new_carry = Carry(
+            used_cpu=carry.used_cpu.at[idx].add(gate * x.req_cpu),
+            used_mem=carry.used_mem.at[idx].add(gate * x.req_mem),
+            used_gpu=carry.used_gpu.at[idx].add(gate * x.req_gpu),
+            used_eph=carry.used_eph.at[idx].add(gate * x.req_eph),
+            used_scalar=carry.used_scalar.at[idx].add(gate * x.req_scalar),
+            nonzero_cpu=carry.nonzero_cpu.at[idx].add(gate * x.nz_cpu),
+            nonzero_mem=carry.nonzero_mem.at[idx].add(gate * x.nz_mem),
+            pod_count=carry.pod_count.at[idx].add(gate),
+            rr=rr_next)
+
+        # ---- failure histogram (only when unschedulable) ----
+        def reason_counts():
+            bit_ids = jnp.arange(num_bits, dtype=jnp.int64)
+            present = (reason_bits[:, None] >> bit_ids[None, :]) & 1
+            return jnp.sum(present, axis=0).astype(jnp.int32)
+
+        counts = jax.lax.cond(found,
+                              lambda: jnp.zeros(num_bits, dtype=jnp.int32),
+                              reason_counts)
+
+        return (new_carry, st), (choice, counts)
+
+    return step
+
+
+@partial(jax.jit, static_argnames=("config",))
+def schedule_scan(config: EngineConfig, carry: Carry, statics: Statics, xs: PodX):
+    """Exact sequential mode: scan the fused step over the pod axis."""
+    step = make_step(config)
+    (final_carry, _), (choices, counts) = jax.lax.scan(step, (carry, statics), xs)
+    return final_carry, choices, counts

@@ -1,0 +1,389 @@
+"""Columnar cluster state: SoA arrays + signature interning + static tables.
+
+Reference mapping (SURVEY.md §7 step 2): NodeInfo's cached aggregates
+(schedulercache/node_info.go:35-76) become per-node column vectors; the
+symbolic pod features become interned signature ids with precompiled
+[signature, node] tables (see tpusim/jaxe/__init__.py design note).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.api.types import (
+    TAINT_PREFER_NO_SCHEDULE,
+    Node,
+    Pod,
+    find_matching_untolerated_taint,
+    tolerations_tolerate_taint,
+)
+from tpusim.engine.predicates import pod_matches_node_labels
+from tpusim.engine.priorities import (
+    calculate_node_affinity_priority_map,
+    calculate_node_prefer_avoid_pods_priority_map,
+)
+from tpusim.engine.resources import (
+    NodeInfo,
+    get_nonzero_pod_request,
+    get_resource_request,
+    is_pod_best_effort,
+)
+
+# ---------------------------------------------------------------------------
+# failure reason bit layout (decoded back to error.go strings for the report)
+# ---------------------------------------------------------------------------
+
+BIT_NODE_NOT_READY = 0
+BIT_NODE_OUT_OF_DISK = 1
+BIT_NODE_NETWORK_UNAVAILABLE = 2
+BIT_NODE_UNSCHEDULABLE = 3
+BIT_INSUFFICIENT_PODS = 4
+BIT_INSUFFICIENT_CPU = 5
+BIT_INSUFFICIENT_MEMORY = 6
+BIT_INSUFFICIENT_GPU = 7
+BIT_INSUFFICIENT_EPHEMERAL = 8
+BIT_HOSTNAME_MISMATCH = 9
+BIT_NODE_SELECTOR_MISMATCH = 10
+BIT_TAINTS_NOT_TOLERATED = 11
+BIT_MEMORY_PRESSURE = 12
+BIT_DISK_PRESSURE = 13
+NUM_FIXED_BITS = 14
+# bits >= NUM_FIXED_BITS: Insufficient <scalar resource s>, per interned name
+
+REASON_STRINGS = [
+    "node(s) were not ready",
+    "node(s) were out of disk space",
+    "node(s) had unavailable network",
+    "node(s) were unschedulable",
+    "Insufficient pods",
+    "Insufficient cpu",
+    "Insufficient memory",
+    "Insufficient alpha.kubernetes.io/nvidia-gpu",
+    "Insufficient ephemeral-storage",
+    "node(s) didn't match the requested hostname",
+    "node(s) didn't match node selector",
+    "node(s) had taints that the pod didn't tolerate",
+    "node(s) had memory pressure",
+    "node(s) had disk pressure",
+]
+
+
+class Interner:
+    """Canonical-JSON signature -> dense id."""
+
+    def __init__(self):
+        self._ids: Dict[str, int] = {}
+        self.representatives: List[Pod] = []
+
+    def intern(self, signature, representative) -> int:
+        key = json.dumps(signature, sort_keys=True, default=str)
+        if key not in self._ids:
+            self._ids[key] = len(self.representatives)
+            self.representatives.append(representative)
+        return self._ids[key]
+
+    def __len__(self) -> int:
+        return len(self.representatives)
+
+
+@dataclass
+class NodeStatics:
+    """Per-node static columns (never mutated by binds)."""
+
+    names: List[str]
+    alloc_cpu: np.ndarray        # [N] int64, milli
+    alloc_mem: np.ndarray        # [N] int64, bytes
+    alloc_gpu: np.ndarray        # [N] int64
+    alloc_eph: np.ndarray        # [N] int64
+    allowed_pods: np.ndarray     # [N] int64
+    alloc_scalar: np.ndarray     # [N, S] int64
+    cond_fail_bits: np.ndarray   # [N] int64 (condition+unschedulable reason bits)
+    mem_pressure: np.ndarray     # [N] bool
+    disk_pressure: np.ndarray    # [N] bool
+
+
+@dataclass
+class SignatureTables:
+    """[signature, node] static evaluation tables."""
+
+    selector_ok: np.ndarray      # [Csel, N] bool — nodeSelector + required node affinity
+    taint_ok: np.ndarray         # [Ctol, N] bool — NoSchedule/NoExecute taints tolerated
+    intolerable: np.ndarray      # [Ctol, N] int64 — PreferNoSchedule intolerable count
+    affinity_count: np.ndarray   # [Caff, N] int64 — preferred node-affinity weight sum
+    avoid_score: np.ndarray      # [Cavoid, N] int64 — NodePreferAvoidPods (0 or 10)
+    host_ok: np.ndarray          # [Chost, N] bool — spec.nodeName pin
+
+
+@dataclass
+class PodColumns:
+    """Per-pod numeric columns + signature ids (the scan's xs)."""
+
+    req_cpu: np.ndarray          # [P] int64 milli
+    req_mem: np.ndarray          # [P] int64
+    req_gpu: np.ndarray          # [P] int64
+    req_eph: np.ndarray          # [P] int64
+    req_scalar: np.ndarray       # [P, S] int64
+    nz_cpu: np.ndarray           # [P] int64 (non-zero-default cpu, priorities only)
+    nz_mem: np.ndarray           # [P] int64
+    zero_request: np.ndarray     # [P] bool (PodFitsResources fast path)
+    best_effort: np.ndarray      # [P] bool
+    sel_id: np.ndarray           # [P] int32
+    tol_id: np.ndarray           # [P] int32
+    aff_id: np.ndarray           # [P] int32
+    avoid_id: np.ndarray         # [P] int32
+    host_id: np.ndarray          # [P] int32
+
+
+@dataclass
+class DynamicInit:
+    """Mutable aggregates seeded from pre-scheduled snapshot pods
+    (NodeInfo.AddPod accounting, node_info.go:318-398)."""
+
+    used_cpu: np.ndarray         # [N] int64
+    used_mem: np.ndarray
+    used_gpu: np.ndarray
+    used_eph: np.ndarray
+    used_scalar: np.ndarray      # [N, S] int64
+    nonzero_cpu: np.ndarray      # [N] int64
+    nonzero_mem: np.ndarray
+    pod_count: np.ndarray        # [N] int64
+
+
+@dataclass
+class CompiledCluster:
+    statics: NodeStatics
+    tables: SignatureTables
+    dynamic: DynamicInit
+    scalar_names: List[str]
+    node_index: Dict[str, int]
+    unsupported: List[str] = field(default_factory=list)  # features needing fallback
+
+
+def _selector_signature(pod: Pod):
+    aff = pod.spec.affinity
+    na = aff.node_affinity.to_obj() if (aff and aff.node_affinity) else None
+    return {"nodeSelector": pod.spec.node_selector,
+            "required": (na or {}).get("requiredDuringSchedulingIgnoredDuringExecution")}
+
+
+def _toleration_signature(pod: Pod):
+    return {"tolerations": [t.to_obj() for t in pod.spec.tolerations]}
+
+
+def _affinity_signature(pod: Pod):
+    aff = pod.spec.affinity
+    na = aff.node_affinity.to_obj() if (aff and aff.node_affinity) else None
+    return {"preferred": (na or {}).get("preferredDuringSchedulingIgnoredDuringExecution")}
+
+
+def _avoid_signature(pod: Pod):
+    ref = pod.metadata.controller_ref()
+    if ref is None or ref.kind not in ("ReplicationController", "ReplicaSet"):
+        return None
+    return {"kind": ref.kind, "uid": ref.uid}
+
+
+def _host_signature(pod: Pod):
+    return pod.spec.node_name or None
+
+
+def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[CompiledCluster, PodColumns]:
+    """Build columnar state for `pods` scheduled against `snapshot`.
+
+    Static matching reuses the parity engine's own functions (semantics match
+    by construction); only numeric aggregates stay dynamic.
+    """
+    nodes = snapshot.nodes
+    n = len(nodes)
+
+    # --- scalar resource name space (pods ∪ node allocatables) ---
+    scalar_names: List[str] = []
+    seen = set()
+
+    def _note_scalars(names):
+        for name in names:
+            if name not in seen:
+                seen.add(name)
+                scalar_names.append(name)
+
+    for pod in list(pods) + list(snapshot.pods):
+        _note_scalars(get_resource_request(pod).scalar)
+    for node in nodes:
+        probe = NodeInfo()
+        probe.set_node(node)
+        _note_scalars(probe.allocatable_resource.scalar)
+    s = len(scalar_names)
+    scalar_idx = {name: i for i, name in enumerate(scalar_names)}
+
+    # --- node statics ---
+    alloc = {k: np.zeros(n, dtype=np.int64)
+             for k in ("cpu", "mem", "gpu", "eph", "pods")}
+    alloc_scalar = np.zeros((n, s), dtype=np.int64)
+    cond_bits = np.zeros(n, dtype=np.int64)
+    mem_pressure = np.zeros(n, dtype=bool)
+    disk_pressure = np.zeros(n, dtype=bool)
+    node_infos: List[NodeInfo] = []
+    for i, node in enumerate(nodes):
+        ni = NodeInfo()
+        ni.set_node(node)
+        node_infos.append(ni)
+        r = ni.allocatable_resource
+        alloc["cpu"][i] = r.milli_cpu
+        alloc["mem"][i] = r.memory
+        alloc["gpu"][i] = r.nvidia_gpu
+        alloc["eph"][i] = r.ephemeral_storage
+        alloc["pods"][i] = r.allowed_pod_number
+        for name, v in r.scalar.items():
+            alloc_scalar[i, scalar_idx[name]] = v
+        bits = 0
+        for cond in node.status.conditions:
+            if cond.type == "Ready" and cond.status != "True":
+                bits |= 1 << BIT_NODE_NOT_READY
+            elif cond.type == "OutOfDisk" and cond.status != "False":
+                bits |= 1 << BIT_NODE_OUT_OF_DISK
+            elif cond.type == "NetworkUnavailable" and cond.status != "False":
+                bits |= 1 << BIT_NODE_NETWORK_UNAVAILABLE
+        if node.spec.unschedulable:
+            bits |= 1 << BIT_NODE_UNSCHEDULABLE
+        cond_bits[i] = bits
+        mem_pressure[i] = ni.memory_pressure
+        disk_pressure[i] = ni.disk_pressure
+
+    statics = NodeStatics(
+        names=[nd.name for nd in nodes],
+        alloc_cpu=alloc["cpu"], alloc_mem=alloc["mem"], alloc_gpu=alloc["gpu"],
+        alloc_eph=alloc["eph"], allowed_pods=alloc["pods"],
+        alloc_scalar=alloc_scalar, cond_fail_bits=cond_bits,
+        mem_pressure=mem_pressure, disk_pressure=disk_pressure)
+
+    # --- pod columns + signature interning ---
+    p = len(pods)
+    cols = PodColumns(
+        req_cpu=np.zeros(p, dtype=np.int64), req_mem=np.zeros(p, dtype=np.int64),
+        req_gpu=np.zeros(p, dtype=np.int64), req_eph=np.zeros(p, dtype=np.int64),
+        req_scalar=np.zeros((p, s), dtype=np.int64),
+        nz_cpu=np.zeros(p, dtype=np.int64), nz_mem=np.zeros(p, dtype=np.int64),
+        zero_request=np.zeros(p, dtype=bool), best_effort=np.zeros(p, dtype=bool),
+        sel_id=np.zeros(p, dtype=np.int32), tol_id=np.zeros(p, dtype=np.int32),
+        aff_id=np.zeros(p, dtype=np.int32), avoid_id=np.zeros(p, dtype=np.int32),
+        host_id=np.zeros(p, dtype=np.int32))
+
+    sel_i, tol_i, aff_i, avoid_i, host_i = (Interner() for _ in range(5))
+    unsupported: List[str] = []
+    for j, pod in enumerate(pods):
+        req = get_resource_request(pod)
+        cols.req_cpu[j] = req.milli_cpu
+        cols.req_mem[j] = req.memory
+        cols.req_gpu[j] = req.nvidia_gpu
+        cols.req_eph[j] = req.ephemeral_storage
+        for name, v in req.scalar.items():
+            cols.req_scalar[j, scalar_idx[name]] = v
+        cols.zero_request[j] = (req.milli_cpu == 0 and req.memory == 0
+                                and req.nvidia_gpu == 0 and req.ephemeral_storage == 0
+                                and not req.scalar)
+        nz = get_nonzero_pod_request(pod)
+        cols.nz_cpu[j] = nz.milli_cpu
+        cols.nz_mem[j] = nz.memory
+        cols.best_effort[j] = is_pod_best_effort(pod)
+        cols.sel_id[j] = sel_i.intern(_selector_signature(pod), pod)
+        cols.tol_id[j] = tol_i.intern(_toleration_signature(pod), pod)
+        cols.aff_id[j] = aff_i.intern(_affinity_signature(pod), pod)
+        cols.avoid_id[j] = avoid_i.intern(_avoid_signature(pod), pod)
+        cols.host_id[j] = host_i.intern(_host_signature(pod), pod)
+        aff = pod.spec.affinity
+        if aff is not None and (aff.pod_affinity is not None
+                                or aff.pod_anti_affinity is not None):
+            unsupported.append(f"pod {pod.name}: inter-pod (anti)affinity")
+        for c in pod.spec.containers:
+            if any(port.host_port > 0 for port in c.ports):
+                unsupported.append(f"pod {pod.name}: host ports")
+
+    for existing in snapshot.pods:
+        aff = existing.spec.affinity
+        # anti-affinity gates the predicate; required affinity feeds the
+        # symmetric hard-affinity weight of InterPodAffinityPriority; preferred
+        # terms feed its soft scoring — all need device state we don't carry yet
+        if aff is not None and (aff.pod_anti_affinity is not None
+                                or aff.pod_affinity is not None):
+            unsupported.append(f"existing pod {existing.name}: inter-pod (anti)affinity")
+    if snapshot.services:
+        unsupported.append("services (SelectorSpreadPriority is non-constant)")
+
+    # --- static [signature, node] tables ---
+    def table(interner: Interner, fn, dtype):
+        t = np.zeros((max(len(interner), 1), n), dtype=dtype)
+        for sig_id, rep in enumerate(interner.representatives):
+            for i in range(n):
+                t[sig_id, i] = fn(rep, i)
+        return t
+
+    def selector_fn(rep: Optional[Pod], i: int) -> bool:
+        return pod_matches_node_labels(rep, nodes[i])
+
+    def taint_ok_fn(rep: Pod, i: int) -> bool:
+        return find_matching_untolerated_taint(
+            node_infos[i].taints, rep.spec.tolerations,
+            lambda t: t.effect in ("NoSchedule", "NoExecute")) is None
+
+    def intolerable_fn(rep: Pod, i: int) -> int:
+        tols = [t for t in rep.spec.tolerations
+                if not t.effect or t.effect == TAINT_PREFER_NO_SCHEDULE]
+        return sum(1 for taint in node_infos[i].taints
+                   if taint.effect == TAINT_PREFER_NO_SCHEDULE
+                   and not tolerations_tolerate_taint(tols, taint))
+
+    def affinity_fn(rep: Pod, i: int) -> int:
+        return calculate_node_affinity_priority_map(rep, None, node_infos[i]).score
+
+    def avoid_fn(rep: Pod, i: int) -> int:
+        return calculate_node_prefer_avoid_pods_priority_map(rep, None, node_infos[i]).score
+
+    def host_fn(rep: Pod, i: int) -> bool:
+        return (not rep.spec.node_name) or rep.spec.node_name == nodes[i].name
+
+    tables = SignatureTables(
+        selector_ok=table(sel_i, selector_fn, bool),
+        taint_ok=table(tol_i, taint_ok_fn, bool),
+        intolerable=table(tol_i, intolerable_fn, np.int64),
+        affinity_count=table(aff_i, affinity_fn, np.int64),
+        avoid_score=table(avoid_i, avoid_fn, np.int64),
+        host_ok=table(host_i, host_fn, bool),
+    )
+
+    # --- dynamic aggregates from pre-scheduled pods ---
+    node_index = {nd.name: i for i, nd in enumerate(nodes)}
+    dyn = DynamicInit(
+        used_cpu=np.zeros(n, dtype=np.int64), used_mem=np.zeros(n, dtype=np.int64),
+        used_gpu=np.zeros(n, dtype=np.int64), used_eph=np.zeros(n, dtype=np.int64),
+        used_scalar=np.zeros((n, s), dtype=np.int64),
+        nonzero_cpu=np.zeros(n, dtype=np.int64), nonzero_mem=np.zeros(n, dtype=np.int64),
+        pod_count=np.zeros(n, dtype=np.int64))
+    for existing in snapshot.pods:
+        i = node_index.get(existing.spec.node_name)
+        if i is None:
+            continue
+        req = get_resource_request(existing)
+        dyn.used_cpu[i] += req.milli_cpu
+        dyn.used_mem[i] += req.memory
+        dyn.used_gpu[i] += req.nvidia_gpu
+        dyn.used_eph[i] += req.ephemeral_storage
+        for name, v in req.scalar.items():
+            dyn.used_scalar[i, scalar_idx[name]] += v
+        nz = get_nonzero_pod_request(existing)
+        dyn.nonzero_cpu[i] += nz.milli_cpu
+        dyn.nonzero_mem[i] += nz.memory
+        dyn.pod_count[i] += 1
+
+    compiled = CompiledCluster(statics=statics, tables=tables, dynamic=dyn,
+                               scalar_names=scalar_names, node_index=node_index,
+                               unsupported=unsupported)
+    return compiled, cols
+
+
+def reason_strings(scalar_names: List[str]) -> List[str]:
+    return REASON_STRINGS + [f"Insufficient {name}" for name in scalar_names]

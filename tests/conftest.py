@@ -3,16 +3,23 @@
 Multi-chip TPU hardware is unavailable in CI; sharding correctness is validated
 on a host-platform virtual device mesh (the driver separately dry-run-compiles
 the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the axon TPU plugin force-appends itself to jax_platforms, overriding the
+JAX_PLATFORMS env var — so the platform must be pinned via jax.config after
+import, and the host-device-count flag before the backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,235 @@
+"""Orchestrator + framework layer tests: store events, strategy, LIFO feed,
+seams, report, and reference-vs-jax simulation equality."""
+
+import io
+
+from tpusim.api.podspec import expand_simulation_pods, parse_simulation_pods
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod, synthetic_cluster
+from tpusim.api.types import ResourceType
+from tpusim.framework.events import Recorder, WatchBuffer, watch_resource
+from tpusim.framework.fake import FakeResourceStore
+from tpusim.framework.report import get_report, review_to_string
+from tpusim.framework.store import ADDED, DELETED, MODIFIED, PodQueue, ResourceStore
+from tpusim.framework.strategy import PredictiveStrategy
+from tpusim.simulator import (
+    ClusterCapacity,
+    SchedulerServerConfig,
+    run_simulation,
+)
+
+QUICKSTART_YAML = """
+- name: A
+  num: 10
+  pod:
+    spec:
+      containers:
+      - resources:
+          requests:
+            cpu: 1
+            memory: 1
+- name: B
+  num: 10
+  pod:
+    spec:
+      containers:
+      - resources:
+          requests:
+            cpu: 100
+            memory: 1000
+"""
+
+
+def quickstart_pods():
+    return expand_simulation_pods(parse_simulation_pods(QUICKSTART_YAML),
+                                  deterministic_ids=True)
+
+
+# --- framework layer ---
+
+
+def test_store_events_and_lifo_queue():
+    store = ResourceStore()
+    events = []
+    store.register_event_handler(ResourceType.PODS,
+                                 lambda e, o: events.append((e, o.name)))
+    p1, p2 = make_pod("p1"), make_pod("p2")
+    store.add(ResourceType.PODS, p1)
+    store.update(ResourceType.PODS, p1)
+    store.delete(ResourceType.PODS, p1)
+    assert events == [(ADDED, "p1"), (MODIFIED, "p1"), (DELETED, "p1")]
+    q = PodQueue([p1, p2])
+    assert q.pop().name == "p2"  # LIFO: last element first (store.go:223-233)
+    assert q.pop().name == "p1"
+    assert q.pop() is None
+
+
+def test_watch_buffer_replays_and_streams():
+    store = ResourceStore()
+    store.add(ResourceType.NODES, make_node("n1"))
+    buf = watch_resource(store, ResourceType.NODES)
+    store.add(ResourceType.NODES, make_node("n2"))
+    events = list(buf)
+    assert [(e.type, e.object.name) for e in events] == [
+        (ADDED, "n1"), (ADDED, "n2")]
+    frame = events[0].to_frame()
+    assert '"type": "Added"' in frame and '"n1"' in frame
+
+
+def test_watch_buffer_close():
+    buf = WatchBuffer()
+    buf.emit(ADDED, make_node("n"))
+    buf.close()
+    buf.emit(ADDED, make_node("dropped"))
+    assert buf.read() is not None
+    assert buf.read() is None
+
+
+def test_strategy_marks_running_and_emits_modified():
+    store = ResourceStore()
+    seen = []
+    store.register_event_handler(ResourceType.PODS, lambda e, o: seen.append(e))
+    pod = make_pod("p", node_name="n1")
+    pod.status.phase = ""
+    PredictiveStrategy(store).add(pod)
+    assert pod.status.phase == "Running"
+    assert seen == [MODIFIED]
+    import pytest
+
+    with pytest.raises(ValueError):
+        PredictiveStrategy(store).add(make_pod("unbound"))
+
+
+def test_recorder_bounded():
+    rec = Recorder(2)
+    for i in range(5):
+        rec.eventf(make_pod(f"p{i}"), "Normal", "Scheduled", "msg %s", i)
+    assert rec.drain_one().message == "msg 0"
+    assert rec.drain_one() is not None
+    assert rec.drain_one() is None  # only 2 buffered
+
+
+def test_fake_resource_store():
+    fake = FakeResourceStore(pods_data=lambda: [make_pod("p1")],
+                             nodes_data=lambda: [make_node("n1")])
+    assert [p.name for p in fake.list(ResourceType.PODS)] == ["p1"]
+    obj, ok = fake.get(ResourceType.NODES, "n1")
+    assert ok and obj.name == "n1"
+    _, ok = fake.get(ResourceType.NODES, "missing")
+    assert not ok
+    fake.add(ResourceType.PODS, make_pod("px"))  # no-op
+    assert len(fake.list(ResourceType.PODS)) == 1
+
+
+# --- orchestrator ---
+
+
+def test_cluster_capacity_quickstart():
+    snap = synthetic_cluster(4, milli_cpu=4000, memory=16 * 1024**3)
+    cc = ClusterCapacity(SchedulerServerConfig(), quickstart_pods(),
+                         scheduled_pods=[], nodes=snap.nodes)
+    cc.run()
+    assert len(cc.status.successful_pods) == 10
+    assert len(cc.status.failed_pods) == 10
+    # LIFO: B pods (pushed last) scheduled first -> they are the failed ones
+    assert all(p.metadata.labels["SimulationName"] == "B"
+               for p in cc.status.failed_pods)
+    # Update path drained the queue last? no — last popped is A-0, which binds
+    assert cc.status.stop_reason == "fail to get next pod: No pods left\n"
+    # bound pods landed in the store as Running
+    stored, ok = cc.resource_store.get(ResourceType.PODS,
+                                       cc.status.successful_pods[0].key())
+    assert ok and stored.status.phase == "Running"
+    report = cc.get_report()
+    assert len(report.review["success"].status.pods) == 10
+    assert report.fail_reason.fail_message == cc.status.stop_reason
+
+
+def test_stop_reason_failed_path():
+    # single pod that cannot fit -> Update's deferred nextPod drains the queue
+    snap = ClusterSnapshot(nodes=[make_node("n1", milli_cpu=100)])
+    cc = ClusterCapacity(SchedulerServerConfig(), [make_pod("p", milli_cpu=5000)],
+                         [], snap.nodes)
+    cc.run()
+    assert cc.status.stop_reason == "Fail to get next pod: No pods left\n"
+
+
+def test_empty_pod_list():
+    cc = ClusterCapacity(SchedulerServerConfig(), [], [], [make_node("n1")])
+    cc.run()
+    assert cc.status.stop_reason == "fail to get next pod: No pods left\n"
+    assert cc.closed
+
+
+def test_prescheduled_pods_reported_and_consume_capacity():
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    existing = make_pod("e", milli_cpu=900, node_name="n1", phase="Running")
+    cc = ClusterCapacity(SchedulerServerConfig(), [make_pod("p", milli_cpu=500)],
+                         [existing], [node])
+    cc.run()
+    assert len(cc.status.scheduled_pods) == 1
+    assert len(cc.status.failed_pods) == 1
+    assert "Insufficient cpu" in cc.status.failed_pods[0].status.conditions[-1].message
+
+
+def test_run_simulation_jax_matches_reference():
+    snap = synthetic_cluster(4, milli_cpu=4000, memory=16 * 1024**3)
+    pods = quickstart_pods()
+    ref_status = run_simulation(pods, snap, backend="reference")
+    jax_status = run_simulation(pods, snap, backend="jax")
+    assert ([p.spec.node_name for p in ref_status.successful_pods]
+            == [p.spec.node_name for p in jax_status.successful_pods])
+    assert ([p.name for p in ref_status.failed_pods]
+            == [p.name for p in jax_status.failed_pods])
+    assert ref_status.stop_reason == jax_status.stop_reason
+
+
+def test_report_printing():
+    snap = synthetic_cluster(4, milli_cpu=4000, memory=16 * 1024**3)
+    status = run_simulation(quickstart_pods(), snap, backend="reference")
+    text = review_to_string(get_report(status))
+    assert "================================= Successful Pods" in text
+    assert "================================= Failed Pods" in text
+    assert "Pods summary:" in text
+    assert "- Unschedulable: 10" in text
+    assert "| REQUIREMENTS" in text and "| HOST" in text
+    assert "CPU: 1, Memory: 1" in text
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    from tpusim.cli import main
+
+    spec = tmp_path / "pod.yaml"
+    spec.write_text(QUICKSTART_YAML)
+    rc = main(["--podspec", str(spec), "--synthetic-nodes", "4",
+               "--backend", "reference"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "10 pod(s) scheduled, 10 unschedulable" in out
+    assert "StopReason: fail to get next pod: No pods left" in out
+
+
+def test_cli_errors(tmp_path, capsys):
+    from tpusim.cli import main
+
+    spec = tmp_path / "pod.yaml"
+    spec.write_text(QUICKSTART_YAML)
+    assert main(["--podspec", str(spec)]) == 2  # no nodes
+    assert main(["--podspec", str(spec), "--kubeconfig", "/tmp/kc",
+                 "--synthetic-nodes", "2"]) == 2  # live cluster unsupported
+    err = capsys.readouterr().err
+    assert "no cluster nodes" in err
+    assert "kubectl get nodes" in err
+
+
+def test_cli_snapshot_file(tmp_path, capsys):
+    from tpusim.cli import main
+
+    snap = synthetic_cluster(3, milli_cpu=4000, memory=16 * 1024**3)
+    snap_file = tmp_path / "snap.json"
+    snap.save(str(snap_file))
+    spec = tmp_path / "pod.yaml"
+    spec.write_text(QUICKSTART_YAML)
+    rc = main(["--podspec", str(spec), "--snapshot", str(snap_file),
+               "--backend", "jax", "--quiet"])
+    assert rc == 0
+    assert "scheduled" in capsys.readouterr().out

@@ -1,0 +1,68 @@
+"""Wavefront (batched) mode tests: conservation properties + agreement with
+exact mode where pods commute."""
+
+import numpy as np
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod, synthetic_cluster
+from tpusim.backends import ReferenceBackend
+from tpusim.jaxe.backend import JaxBackend
+
+
+def test_wavefront_uniform_pods_counts_match_exact():
+    # uniform pods commute: total scheduled count must equal the exact mode's
+    snap = synthetic_cluster(8, milli_cpu=4000, memory=8 * 1024**3)
+    pods = [make_pod(f"p{i}", milli_cpu=500, memory=512 * 2**20) for i in range(80)]
+    exact = JaxBackend(fallback="error").schedule(pods, snap)
+    wave = JaxBackend(fallback="error", batch_size=16).schedule(pods, snap)
+    assert (sum(p.scheduled for p in exact) == sum(p.scheduled for p in wave)
+            == 8 * 8)  # 4000/500 = 8 per node
+
+
+def test_wavefront_spreads_within_wave():
+    # all nodes tie: the rr bookkeeping must spread a wave across nodes, not
+    # pile everything on node 0
+    snap = synthetic_cluster(4, milli_cpu=4000, memory=16 * 1024**3)
+    pods = [make_pod(f"p{i}", milli_cpu=1, memory=1) for i in range(4)]
+    wave = JaxBackend(fallback="error", batch_size=4).schedule(pods, snap)
+    assert len({p.node_name for p in wave}) == 4
+
+
+def test_wavefront_respects_capacity_between_waves():
+    # one node, capacity 2 pods per wave boundary: waves of 2 can never
+    # overcommit because binds apply between waves
+    snap = ClusterSnapshot(nodes=[make_node("n", milli_cpu=1000, memory=16 * 1024**3)])
+    pods = [make_pod(f"p{i}", milli_cpu=400) for i in range(6)]
+    wave = JaxBackend(fallback="error", batch_size=2).schedule(pods, snap)
+    scheduled = [p for p in wave if p.scheduled]
+    # 1000/400 = 2 fit exactly; wave 1 binds 2, wave 2+ see the node full...
+    # except in-wave overcommit: wave 1's two pods both saw an empty node and
+    # both fit (400+400 <= 1000), so 2 scheduled; wave 2 sees 800 used -> fails
+    assert len(scheduled) == 2
+    assert all("Insufficient cpu" in p.message for p in wave if not p.scheduled)
+
+
+def test_wavefront_overcommit_is_bounded_by_wave():
+    # the documented approximation: within one wave two pods can double-book a
+    # node that fits only one — never more than one wave's worth
+    snap = ClusterSnapshot(nodes=[make_node("n", milli_cpu=1000, memory=16 * 1024**3)])
+    pods = [make_pod(f"p{i}", milli_cpu=600) for i in range(4)]
+    wave = JaxBackend(fallback="error", batch_size=2).schedule(pods, snap)
+    # both wave-1 pods pass the filter against the frozen empty node
+    assert sum(p.scheduled for p in wave) == 2
+    exact = JaxBackend(fallback="error").schedule(pods, snap)
+    assert sum(p.scheduled for p in exact) == 1  # exact mode admits only one
+
+
+def test_wavefront_batch_larger_than_pod_count():
+    snap = synthetic_cluster(2, milli_cpu=4000, memory=8 * 1024**3)
+    pods = [make_pod(f"p{i}", milli_cpu=100) for i in range(3)]
+    wave = JaxBackend(fallback="error", batch_size=64).schedule(pods, snap)
+    assert len(wave) == 3 and all(p.scheduled for p in wave)
+
+
+def test_wavefront_failure_messages_match_reference_format():
+    snap = ClusterSnapshot(nodes=[make_node("n", milli_cpu=100, memory=1024**3)])
+    pods = [make_pod(f"p{i}", milli_cpu=5000) for i in range(3)]
+    wave = JaxBackend(fallback="error", batch_size=2).schedule(pods, snap)
+    ref = ReferenceBackend().schedule(pods, snap)
+    assert [p.message for p in wave] == [p.message for p in ref]

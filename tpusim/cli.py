@@ -1,0 +1,140 @@
+"""Command-line entry.
+
+Reference: cmd/app/server.go + cmd/app/options/options.go. Flag surface kept
+(--kubeconfig --podspec --algorithmprovider), extended per BASELINE.json with
+--backend and --batch-size, plus snapshot sources replacing the live-cluster
+List (this environment has no kube apiserver): --snapshot / --nodes / --pods /
+--synthetic-nodes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from tpusim.api.podspec import expand_simulation_pods, load_simulation_pods
+from tpusim.api.snapshot import (
+    ClusterSnapshot,
+    load_nodes_checkpoint,
+    load_pods_checkpoint,
+    synthetic_cluster,
+)
+from tpusim.framework.report import (
+    cluster_capacity_review_print,
+    get_report,
+    spec_print,
+)
+from tpusim.simulator import run_simulation
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusim",
+        description="Cluster-capacity schedule simulation on a TPU-native engine")
+    # reference flags (options.go:67-71)
+    parser.add_argument("--kubeconfig", default="",
+                        help="Path to kubeconfig for a live-cluster snapshot "
+                             "(not supported in this offline build; use --snapshot)")
+    parser.add_argument("--podspec", required=True,
+                        help="YAML/JSON file with [{name, pod, num}] entries")
+    parser.add_argument("--algorithmprovider", default="DefaultProvider",
+                        help="DefaultProvider | ClusterAutoscalerProvider | "
+                             "TalkintDataProvider")
+    parser.add_argument("--namespace", default="default",
+                        help="Namespace stamped onto simulated pods")
+    # new flags (BASELINE.json)
+    parser.add_argument("--backend", default="jax", choices=["reference", "jax"],
+                        help="Scheduling engine: jax (TPU batched) or reference "
+                             "(pure-Python parity loop)")
+    parser.add_argument("--batch-size", type=int, default=0,
+                        help="Wavefront batch size for the jax backend "
+                             "(0 = exact sequential mode)")
+    # snapshot sources
+    parser.add_argument("--snapshot", default="",
+                        help="Combined ClusterSnapshot JSON ({nodes, pods, services})")
+    parser.add_argument("--nodes", default="", help="nodes.json checkpoint")
+    parser.add_argument("--pods", default="", help="pods.json checkpoint (Running pods)")
+    parser.add_argument("--synthetic-nodes", type=int, default=0,
+                        help="Generate N homogeneous synthetic nodes")
+    parser.add_argument("--synthetic-milli-cpu", type=int, default=4000)
+    parser.add_argument("--synthetic-memory", type=int, default=16 * 1024**3)
+    parser.add_argument("--print-requirements", action="store_true",
+                        help="Also print per-pod requirement spec")
+    parser.add_argument("--quiet", action="store_true",
+                        help="Only print the summary counts and timing")
+    return parser
+
+
+def load_snapshot(args) -> ClusterSnapshot:
+    if args.snapshot:
+        return ClusterSnapshot.load(args.snapshot)
+    snapshot = ClusterSnapshot()
+    if args.nodes:
+        snapshot.nodes = load_nodes_checkpoint(args.nodes)
+    elif args.synthetic_nodes:
+        snapshot.nodes = synthetic_cluster(
+            args.synthetic_nodes, milli_cpu=args.synthetic_milli_cpu,
+            memory=args.synthetic_memory).nodes
+    if args.pods:
+        snapshot.pods = load_pods_checkpoint(args.pods)
+    return snapshot
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.kubeconfig or os.environ.get("CC_INCLUSTER"):
+        print("error: live-cluster snapshots need a kube apiserver, which this "
+              "offline build does not ship. Snapshot the cluster with "
+              "`kubectl get nodes -o json > nodes.json` and "
+              "`kubectl get pods --all-namespaces "
+              "--field-selector=status.phase=Running -o json > pods.json`, "
+              "then pass --nodes/--pods.", file=sys.stderr)
+        return 2
+
+    try:
+        snapshot = load_snapshot(args)
+    except (OSError, ValueError) as exc:
+        print(f"error: failed to load cluster snapshot: {exc}", file=sys.stderr)
+        return 2
+    if not snapshot.nodes:
+        print("error: no cluster nodes; pass --snapshot, --nodes, or "
+              "--synthetic-nodes", file=sys.stderr)
+        return 2
+
+    try:
+        sim_pods = load_simulation_pods(args.podspec)
+    except (OSError, ValueError) as exc:
+        print(f"error: failed to parse podspec: {exc}", file=sys.stderr)
+        return 2
+    pods = expand_simulation_pods(sim_pods, namespace=args.namespace)
+
+    if args.batch_size and args.backend != "jax":
+        print("error: --batch-size requires --backend jax", file=sys.stderr)
+        return 2
+
+    start = time.perf_counter()
+    status = run_simulation(pods, snapshot, provider=args.algorithmprovider,
+                            backend=args.backend, batch_size=args.batch_size)
+    elapsed = time.perf_counter() - start
+
+    report = get_report(status)
+    if args.print_requirements and not args.quiet:
+        spec_print(report.review["success"].spec)
+        spec_print(report.review["failed"].spec)
+    if not args.quiet:
+        cluster_capacity_review_print(report)
+    n_ok = len(status.successful_pods)
+    n_fail = len(status.failed_pods)
+    rate = (n_ok + n_fail) / elapsed if elapsed > 0 else 0.0
+    print(f"\n{n_ok} pod(s) scheduled, {n_fail} unschedulable, "
+          f"{len(status.scheduled_pods)} pre-scheduled "
+          f"[{args.backend} backend, {elapsed:.3f}s, {rate:.0f} pods/s]")
+    print(f"StopReason: {status.stop_reason.strip()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+"""Cluster-state emulation: store, watch events, recorder, strategy, report.
+
+Reference: pkg/framework/. The fake-REST/HTTP-body machinery is deliberately
+not ported (SURVEY.md §7 design stance) — its semantics (snapshot in, watch
+events out, placements mutate only in-memory state) are re-founded on a
+synchronous in-process event bus; SURVEY.md §5 explicitly flags the reference's
+hand-rolled WatchBuffer locking as worth not reproducing.
+"""

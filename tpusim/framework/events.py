@@ -1,0 +1,115 @@
+"""Watch streams + event recorder.
+
+Reference: pkg/framework/watch/watch.go (WatchBuffer — an io.ReadCloser JSON
+frame stream fed by EmitWatchEvent) and pkg/framework/record/recorder.go
+(channel-backed EventRecorder, buffer 10, drained one event per Bind/Update).
+
+The WatchBuffer here is a bounded queue of (type, object) frames with
+replay-current-objects-as-Added semantics on subscribe (restclient.go:380-426),
+instead of the reference's hand-rolled reader/writer lock dance that SURVEY.md
+§5 flags as fragile.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from tpusim.api.types import ResourceType
+from tpusim.framework.store import ADDED, ResourceStore
+
+
+@dataclass
+class WatchEvent:
+    type: str   # ADDED | MODIFIED | DELETED
+    object: object
+
+    def to_frame(self) -> str:
+        """The JSON wire frame the reference streams (watch.go:99-125);
+        event types are capitalized on the wire ("Added"/"Modified"/"Deleted")."""
+        return json.dumps({"type": self.type.capitalize(),
+                           "object": self.object.to_obj()}, sort_keys=True)
+
+
+class WatchBuffer:
+    """An unbounded FIFO of watch events; close() wakes readers."""
+
+    _CLOSED = object()
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self.closed = False
+
+    def emit(self, event_type: str, obj) -> None:
+        if not self.closed:
+            self._q.put(WatchEvent(event_type, obj))
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._q.put(self._CLOSED)
+
+    def read(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is self._CLOSED:
+            return None
+        return item
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            ev = self.read(timeout=0)
+            if ev is None:
+                return
+            yield ev
+
+
+def watch_resource(store: ResourceStore, resource: ResourceType) -> WatchBuffer:
+    """Subscribe to a resource: current objects replay as ADDED, then live
+    events stream (restclient.go:380-426 list+watch semantics)."""
+    buf = WatchBuffer()
+    for obj in store.list(resource):
+        buf.emit(ADDED, obj)
+    store.register_event_handler(resource, buf.emit)
+    return buf
+
+
+@dataclass
+class Event:
+    """client-go record.Event essentials."""
+
+    object_kind: str = ""
+    object_name: str = ""
+    event_type: str = ""   # Normal | Warning
+    reason: str = ""
+    message: str = ""
+
+
+class Recorder:
+    """Bounded event sink. Reference: record/recorder.go:33-61 — the simulator
+    creates it with capacity 10 (simulator.go:240) and drains one event per
+    Bind/Update completion."""
+
+    def __init__(self, buffer_size: int = 10):
+        self.events: queue.Queue = queue.Queue(maxsize=buffer_size)
+
+    def eventf(self, obj, event_type: str, reason: str, message_fmt: str,
+               *args) -> None:
+        event = Event(object_kind=getattr(obj, "kind", ""),
+                      object_name=getattr(obj, "name", ""),
+                      event_type=event_type, reason=reason,
+                      message=(message_fmt % args) if args else message_fmt)
+        try:
+            self.events.put_nowait(event)
+        except queue.Full:
+            pass  # reference behavior: the channel blocks; we drop instead of deadlock
+
+    def drain_one(self, timeout: float = 0.0) -> Optional[Event]:
+        try:
+            return self.events.get(timeout=timeout) if timeout else self.events.get_nowait()
+        except queue.Empty:
+            return None

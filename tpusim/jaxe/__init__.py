@@ -25,7 +25,19 @@ uses float64 exactly like Go. Memory quantities are byte-exact int64.
 
 import jax
 
-# Go semantics are 64-bit; placement parity requires byte-exact memory sums and
-# int64 score arithmetic. On TPU, int64 is emulated 32-bit-pairwise — the fast
-# path can later narrow where ranges allow.
-jax.config.update("jax_enable_x64", True)
+_x64_enabled = False
+
+
+def ensure_x64() -> None:
+    """Enable 64-bit JAX types (process-global) before building device state.
+
+    Go semantics are 64-bit; placement parity requires byte-exact memory sums
+    and int64 score arithmetic. On TPU, int64 is emulated 32-bit-pairwise — the
+    fast path can later narrow where ranges allow. Called explicitly from the
+    backend entry points instead of at import so that importing tpusim never
+    flips global JAX config for a host application.
+    """
+    global _x64_enabled
+    if not _x64_enabled:
+        jax.config.update("jax_enable_x64", True)
+        _x64_enabled = True

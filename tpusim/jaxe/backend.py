@@ -26,11 +26,13 @@ from tpusim.engine.providers import (
     DEFAULT_PROVIDER,
     TD_PROVIDER,
 )
+from tpusim.jaxe import ensure_x64
 from tpusim.jaxe.kernels import (
     EngineConfig,
     carry_init,
     pod_columns_to_device,
     schedule_scan,
+    schedule_wavefront,
     statics_to_device,
 )
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
@@ -53,14 +55,20 @@ class JaxBackend:
     name = "jax"
 
     def __init__(self, provider: str = DEFAULT_PROVIDER, fallback: str = "reference",
-                 hard_pod_affinity_symmetric_weight: int = 10):
+                 hard_pod_affinity_symmetric_weight: int = 10, batch_size: int = 0):
+        """batch_size=0: exact sequential scan. batch_size=K>0: wavefront mode —
+        waves of K pods against frozen snapshots (fast, approximate: pods in a
+        wave don't see each other's binds)."""
         if provider not in _KNOWN_PROVIDERS:
             raise KeyError(f"plugin {provider!r} has not been registered")
         if fallback not in ("reference", "error"):
             raise ValueError("fallback must be 'reference' or 'error'")
+        if batch_size < 0:
+            raise ValueError("batch_size must be >= 0")
         self.provider = provider
         self.fallback = fallback
         self.hard_pod_affinity_symmetric_weight = hard_pod_affinity_symmetric_weight
+        self.batch_size = batch_size
 
     def schedule(self, pods: List[Pod], snapshot: ClusterSnapshot) -> List[Placement]:
         if not pods:
@@ -87,10 +95,15 @@ class JaxBackend:
             most_requested=self.provider in _MOST_REQUESTED_PROVIDERS,
             num_reason_bits=num_bits)
 
+        ensure_x64()
         carry = carry_init(compiled)
         statics = statics_to_device(compiled)
         xs = pod_columns_to_device(cols)
-        _, choices, counts = schedule_scan(config, carry, statics, xs)
+        if self.batch_size > 0:
+            _, choices, counts = schedule_wavefront(config, carry, statics, xs,
+                                                    self.batch_size)
+        else:
+            _, choices, counts = schedule_scan(config, carry, statics, xs)
         choices = np.asarray(choices)
         counts = np.asarray(counts)
 

@@ -1,4 +1,4 @@
-"""Device kernels: the fused filter→score→select→bind scan step.
+"""Device kernels: the fused filter→score→select→bind pipeline.
 
 Reference mapping:
   findNodesThatFit (generic_scheduler.go:289-377)  -> staged fail masks + reason bits
@@ -6,8 +6,16 @@ Reference mapping:
   selectHost       (generic_scheduler.go:183-198)  -> masked argmax + round-robin tie pick
   assume/bind      (scheduler.go:431-497)          -> scatter-add into the carry
 
-One `lax.scan` step fuses the whole per-pod pipeline; the carry holds only the
-dynamic aggregates (requested/nonzero resources, pod counts, rr counter).
+Two execution modes (SURVEY.md §7 step 5):
+  schedule_scan      — EXACT: one lax.scan step per pod; pod t's bind is seen
+                       by pod t+1, identical to the Go loop.
+  schedule_wavefront — FAST/approximate: K pods evaluated against a frozen
+                       snapshot per wave (vmap), binds applied between waves.
+                       Within a wave pods don't see each other's binds, so a
+                       nearly-full node can be overcommitted; exact when pods
+                       in a wave commute (uniform workloads). The rr counter
+                       bookkeeping matches the sequential rule given the
+                       frozen state (exclusive cumsum of "selectHost called").
 """
 
 from __future__ import annotations
@@ -18,7 +26,6 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from tpusim.jaxe.state import (
     BIT_DISK_PRESSURE,
@@ -71,7 +78,7 @@ class Statics(NamedTuple):
 
 
 class PodX(NamedTuple):
-    """One scan step's xs slice."""
+    """One pod's columns (scan xs slice / wavefront row)."""
 
     req_cpu: jnp.ndarray
     req_mem: jnp.ndarray
@@ -140,11 +147,9 @@ def _ratio_score(requested, capacity, most: bool):
     """least_requested.go:41-52 / most_requested.go:44-55, elementwise."""
     valid = (capacity > 0) & (requested <= capacity)
     if most:
-        raw = jnp.where(valid, (requested * MAX_PRIORITY) // jnp.maximum(capacity, 1), 0)
-    else:
-        raw = jnp.where(
-            valid, ((capacity - requested) * MAX_PRIORITY) // jnp.maximum(capacity, 1), 0)
-    return raw
+        return jnp.where(valid, (requested * MAX_PRIORITY) // jnp.maximum(capacity, 1), 0)
+    return jnp.where(
+        valid, ((capacity - requested) * MAX_PRIORITY) // jnp.maximum(capacity, 1), 0)
 
 
 def _balanced_score(req_cpu, req_mem, alloc_cpu, alloc_mem):
@@ -158,110 +163,114 @@ def _balanced_score(req_cpu, req_mem, alloc_cpu, alloc_mem):
     return jnp.where((cpu_frac >= 1) | (mem_frac >= 1), 0, score)
 
 
-def make_step(config: EngineConfig):
-    """Build the scan step: (carry, PodX) -> (carry', (choice, reason_counts))."""
+def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
+    """Filter + score one pod against the carried aggregates.
 
-    num_bits = config.num_reason_bits
+    Returns (feasible[N], reason_bits[N], score[N], n_feasible)."""
+    # ---- filter: staged fail masks in predicatesOrdering ----
+    fail_cond = st.cond_fail_bits != 0
+
+    insuff_pods = (carry.pod_count + 1) > st.allowed_pods
+    check_res = ~x.zero_request
+    insuff_cpu = check_res & (st.alloc_cpu < x.req_cpu + carry.used_cpu)
+    insuff_mem = check_res & (st.alloc_mem < x.req_mem + carry.used_mem)
+    insuff_gpu = check_res & (st.alloc_gpu < x.req_gpu + carry.used_gpu)
+    insuff_eph = check_res & (st.alloc_eph < x.req_eph + carry.used_eph)
+    insuff_scalar = check_res[..., None] & (
+        st.alloc_scalar < x.req_scalar[None, :] + carry.used_scalar)
+    host_bad = ~st.host_ok[x.host_id]
+    sel_bad = ~st.selector_ok[x.sel_id]
+    fail_general = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
+                    | insuff_eph | jnp.any(insuff_scalar, axis=-1)
+                    | host_bad | sel_bad)
+    bits_general = (
+        insuff_pods.astype(jnp.int64) << BIT_INSUFFICIENT_PODS
+        | insuff_cpu.astype(jnp.int64) << BIT_INSUFFICIENT_CPU
+        | insuff_mem.astype(jnp.int64) << BIT_INSUFFICIENT_MEMORY
+        | insuff_gpu.astype(jnp.int64) << BIT_INSUFFICIENT_GPU
+        | insuff_eph.astype(jnp.int64) << BIT_INSUFFICIENT_EPHEMERAL
+        | host_bad.astype(jnp.int64) << BIT_HOSTNAME_MISMATCH
+        | sel_bad.astype(jnp.int64) << BIT_NODE_SELECTOR_MISMATCH)
+    if st.alloc_scalar.shape[-1] > 0:
+        scalar_bits = (insuff_scalar.astype(jnp.int64)
+                       << (NUM_FIXED_BITS + jnp.arange(st.alloc_scalar.shape[-1],
+                                                       dtype=jnp.int64)))
+        bits_general = bits_general | jnp.sum(scalar_bits, axis=-1)
+
+    fail_taint = ~st.taint_ok[x.tol_id]
+    fail_mem_pressure = st.mem_pressure & x.best_effort
+    fail_disk_pressure = st.disk_pressure
+
+    feasible = ~(fail_cond | fail_general | fail_taint
+                 | fail_mem_pressure | fail_disk_pressure)
+    # short-circuit reason selection: first failing stage wins
+    reason_bits = jnp.where(
+        fail_cond, st.cond_fail_bits,
+        jnp.where(fail_general, bits_general,
+                  jnp.where(fail_taint, jnp.int64(1) << BIT_TAINTS_NOT_TOLERATED,
+                            jnp.where(fail_mem_pressure,
+                                      jnp.int64(1) << BIT_MEMORY_PRESSURE,
+                                      jnp.where(fail_disk_pressure,
+                                                jnp.int64(1) << BIT_DISK_PRESSURE,
+                                                jnp.int64(0))))))
+    n_feasible = jnp.sum(feasible)
+
+    # ---- score ----
+    total_cpu = x.nz_cpu + carry.nonzero_cpu
+    total_mem = x.nz_mem + carry.nonzero_mem
+    ratio = (_ratio_score(total_cpu, st.alloc_cpu, config.most_requested)
+             + _ratio_score(total_mem, st.alloc_mem, config.most_requested)) // 2
+    balanced = _balanced_score(total_cpu, total_mem, st.alloc_cpu, st.alloc_mem)
+
+    # NodeAffinityPriority: NormalizeReduce(10, False) over feasible nodes
+    aff = st.affinity_count[x.aff_id]
+    aff_max = jnp.max(jnp.where(feasible, aff, 0))
+    aff_norm = jnp.where(aff_max > 0, MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
+
+    # TaintTolerationPriority: NormalizeReduce(10, True) over feasible nodes
+    intol = st.intolerable[x.tol_id]
+    intol_max = jnp.max(jnp.where(feasible, intol, 0))
+    taint_norm = jnp.where(
+        intol_max > 0,
+        MAX_PRIORITY - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
+        MAX_PRIORITY)
+
+    avoid = st.avoid_score[x.avoid_id] * AVOID_PODS_WEIGHT
+    score = ratio + balanced + aff_norm + taint_norm + avoid
+    return feasible, reason_bits, score, n_feasible
+
+
+def _select(feasible, score, n_feasible, rr):
+    """selectHost (generic_scheduler.go:183-198): stable-desc + round-robin
+    among max-score ties; rr is consumed only when >1 node passed the filter
+    (with one feasible node scheduleOne returns it directly, :176-180)."""
+    masked = jnp.where(feasible, score, jnp.int64(-1))
+    max_score = jnp.max(masked)
+    tie = feasible & (masked == max_score)
+    ties = jnp.maximum(jnp.sum(tie), 1)
+    k = jnp.where(n_feasible > 1, rr % ties, 0)
+    rank = jnp.cumsum(tie.astype(jnp.int64)) - 1
+    pick = tie & (rank == k)
+    choice = jnp.argmax(pick).astype(jnp.int32)
+    found = n_feasible > 0
+    return jnp.where(found, choice, -1), found
+
+
+def _reason_histogram(reason_bits, num_bits: int):
+    bit_ids = jnp.arange(num_bits, dtype=jnp.int64)
+    present = (reason_bits[:, None] >> bit_ids[None, :]) & 1
+    return jnp.sum(present, axis=0).astype(jnp.int32)
+
+
+def make_step(config: EngineConfig):
+    """The exact sequential scan step: (carry, PodX) -> (carry', (choice, counts))."""
 
     def step(state: tuple, x: PodX):
-        carry, st = state  # st: Statics closed into carry tuple for sharding ease
-
-        # ---- filter: staged fail masks in predicatesOrdering ----
-        # stage 0: CheckNodeCondition (static)
-        fail_cond = st.cond_fail_bits != 0
-
-        # stage 1: GeneralPredicates (PodFitsResources + Host + Ports + Selector)
-        insuff_pods = (carry.pod_count + 1) > st.allowed_pods
-        check_res = ~x.zero_request
-        insuff_cpu = check_res & (st.alloc_cpu < x.req_cpu + carry.used_cpu)
-        insuff_mem = check_res & (st.alloc_mem < x.req_mem + carry.used_mem)
-        insuff_gpu = check_res & (st.alloc_gpu < x.req_gpu + carry.used_gpu)
-        insuff_eph = check_res & (st.alloc_eph < x.req_eph + carry.used_eph)
-        # scalars: [N, S] comparison
-        insuff_scalar = check_res[..., None] & (
-            st.alloc_scalar < x.req_scalar[None, :] + carry.used_scalar)
-        host_bad = ~st.host_ok[x.host_id]
-        sel_bad = ~st.selector_ok[x.sel_id]
-        fail_general = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
-                        | insuff_eph | jnp.any(insuff_scalar, axis=-1)
-                        | host_bad | sel_bad)
-        bits_general = (
-            insuff_pods.astype(jnp.int64) << BIT_INSUFFICIENT_PODS
-            | insuff_cpu.astype(jnp.int64) << BIT_INSUFFICIENT_CPU
-            | insuff_mem.astype(jnp.int64) << BIT_INSUFFICIENT_MEMORY
-            | insuff_gpu.astype(jnp.int64) << BIT_INSUFFICIENT_GPU
-            | insuff_eph.astype(jnp.int64) << BIT_INSUFFICIENT_EPHEMERAL
-            | host_bad.astype(jnp.int64) << BIT_HOSTNAME_MISMATCH
-            | sel_bad.astype(jnp.int64) << BIT_NODE_SELECTOR_MISMATCH)
-        if st.alloc_scalar.shape[-1] > 0:
-            scalar_bits = (insuff_scalar.astype(jnp.int64)
-                           << (NUM_FIXED_BITS + jnp.arange(st.alloc_scalar.shape[-1],
-                                                           dtype=jnp.int64)))
-            bits_general = bits_general | jnp.sum(scalar_bits, axis=-1)
-
-        # stage 2: PodToleratesNodeTaints (static per toleration signature)
-        fail_taint = ~st.taint_ok[x.tol_id]
-        # stage 3/4: memory / disk pressure
-        fail_mem_pressure = st.mem_pressure & x.best_effort
-        fail_disk_pressure = st.disk_pressure
-
-        feasible = ~(fail_cond | fail_general | fail_taint
-                     | fail_mem_pressure | fail_disk_pressure)
-        # short-circuit reason selection: first failing stage wins
-        reason_bits = jnp.where(
-            fail_cond, st.cond_fail_bits,
-            jnp.where(fail_general, bits_general,
-                      jnp.where(fail_taint, jnp.int64(1) << BIT_TAINTS_NOT_TOLERATED,
-                                jnp.where(fail_mem_pressure,
-                                          jnp.int64(1) << BIT_MEMORY_PRESSURE,
-                                          jnp.where(fail_disk_pressure,
-                                                    jnp.int64(1) << BIT_DISK_PRESSURE,
-                                                    jnp.int64(0))))))
-
-        n_feasible = jnp.sum(feasible)
-
-        # ---- score (only feasible nodes matter) ----
-        total_cpu = x.nz_cpu + carry.nonzero_cpu
-        total_mem = x.nz_mem + carry.nonzero_mem
-        ratio = (_ratio_score(total_cpu, st.alloc_cpu, config.most_requested)
-                 + _ratio_score(total_mem, st.alloc_mem, config.most_requested)) // 2
-        balanced = _balanced_score(total_cpu, total_mem, st.alloc_cpu, st.alloc_mem)
-
-        # NodeAffinityPriority: NormalizeReduce(10, False) over feasible nodes
-        aff = st.affinity_count[x.aff_id]
-        aff_max = jnp.max(jnp.where(feasible, aff, 0))
-        aff_norm = jnp.where(aff_max > 0,
-                             MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
-
-        # TaintTolerationPriority: NormalizeReduce(10, True) over feasible nodes
-        intol = st.intolerable[x.tol_id]
-        intol_max = jnp.max(jnp.where(feasible, intol, 0))
-        taint_norm = jnp.where(
-            intol_max > 0,
-            MAX_PRIORITY - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
-            MAX_PRIORITY)
-
-        avoid = st.avoid_score[x.avoid_id] * AVOID_PODS_WEIGHT
-
-        score = ratio + balanced + aff_norm + taint_norm + avoid
-
-        # ---- select: stable-desc + round-robin among max ties ----
-        masked_score = jnp.where(feasible, score, jnp.int64(-1))
-        max_score = jnp.max(masked_score)
-        tie = feasible & (masked_score == max_score)
-        ties = jnp.maximum(jnp.sum(tie), 1)
-        # selectHost is only invoked when >1 node passed the filter; with exactly
-        # one feasible node scheduleOne returns it directly and the rr counter is
-        # NOT advanced (generic_scheduler.go:176-180).
-        k = jnp.where(n_feasible > 1, carry.rr % ties, 0)
-        rank = jnp.cumsum(tie.astype(jnp.int64)) - 1
-        pick = tie & (rank == k)
-        choice = jnp.argmax(pick).astype(jnp.int32)
-        found = n_feasible > 0
-        choice = jnp.where(found, choice, -1)
+        carry, st = state
+        feasible, reason_bits, score, n_feasible = _evaluate(config, carry, st, x)
+        choice, found = _select(feasible, score, n_feasible, carry.rr)
         rr_next = carry.rr + jnp.where(n_feasible > 1, 1, 0)
 
-        # ---- bind: scatter-add into carry ----
         idx = jnp.maximum(choice, 0)
         gate = found.astype(jnp.int64)
         new_carry = Carry(
@@ -275,16 +284,10 @@ def make_step(config: EngineConfig):
             pod_count=carry.pod_count.at[idx].add(gate),
             rr=rr_next)
 
-        # ---- failure histogram (only when unschedulable) ----
-        def reason_counts():
-            bit_ids = jnp.arange(num_bits, dtype=jnp.int64)
-            present = (reason_bits[:, None] >> bit_ids[None, :]) & 1
-            return jnp.sum(present, axis=0).astype(jnp.int32)
-
-        counts = jax.lax.cond(found,
-                              lambda: jnp.zeros(num_bits, dtype=jnp.int32),
-                              reason_counts)
-
+        counts = jax.lax.cond(
+            found,
+            lambda: jnp.zeros(config.num_reason_bits, dtype=jnp.int32),
+            lambda: _reason_histogram(reason_bits, config.num_reason_bits))
         return (new_carry, st), (choice, counts)
 
     return step
@@ -296,3 +299,74 @@ def schedule_scan(config: EngineConfig, carry: Carry, statics: Statics, xs: PodX
     step = make_step(config)
     (final_carry, _), (choices, counts) = jax.lax.scan(step, (carry, statics), xs)
     return final_carry, choices, counts
+
+
+def make_wavefront_step(config: EngineConfig):
+    """One wave: evaluate K pods against the frozen carry, then apply binds."""
+
+    def step(state: tuple, wave):
+        carry, st = state
+        xs, valid = wave  # PodX with leading K axis, valid[K] (padding mask)
+
+        feasible, reason_bits, score, n_feasible = jax.vmap(
+            lambda x: _evaluate(config, carry, st, x))(xs)
+
+        # rr bookkeeping: pod k sees rr advanced by every prior in-wave pod
+        # that would have invoked selectHost (n_feasible > 1), matching the
+        # sequential rule against the frozen snapshot.
+        advances = ((n_feasible > 1) & valid).astype(jnp.int64)
+        rr_offsets = carry.rr + jnp.cumsum(advances) - advances
+        choices, founds = jax.vmap(_select)(feasible, score, n_feasible, rr_offsets)
+
+        gate = (founds & valid).astype(jnp.int64)
+        n = carry.used_cpu.shape[0]
+        seg = jnp.where(gate == 1, choices, n)  # padding/unschedulable -> dump row
+
+        def scatter(amounts, target):
+            return target + jax.ops.segment_sum(amounts * gate, seg,
+                                                num_segments=n + 1)[:n]
+
+        new_carry = Carry(
+            used_cpu=scatter(xs.req_cpu, carry.used_cpu),
+            used_mem=scatter(xs.req_mem, carry.used_mem),
+            used_gpu=scatter(xs.req_gpu, carry.used_gpu),
+            used_eph=scatter(xs.req_eph, carry.used_eph),
+            used_scalar=carry.used_scalar + jax.ops.segment_sum(
+                xs.req_scalar * gate[:, None], seg, num_segments=n + 1)[:n],
+            nonzero_cpu=scatter(xs.nz_cpu, carry.nonzero_cpu),
+            nonzero_mem=scatter(xs.nz_mem, carry.nonzero_mem),
+            pod_count=scatter(jnp.ones_like(gate), carry.pod_count),
+            rr=carry.rr + jnp.sum(advances))
+
+        counts = jnp.where(
+            (founds | ~valid)[:, None],
+            jnp.zeros((1, config.num_reason_bits), dtype=jnp.int32),
+            jax.vmap(lambda b: _reason_histogram(b, config.num_reason_bits))(reason_bits))
+        choices = jnp.where(valid, choices, -1)  # _select already yields -1 on not-found
+        return (new_carry, st), (choices, counts)
+
+    return step
+
+
+@partial(jax.jit, static_argnames=("config", "batch_size"))
+def schedule_wavefront(config: EngineConfig, carry: Carry, statics: Statics,
+                       xs: PodX, batch_size: int):
+    """Fast mode: waves of `batch_size` pods against frozen snapshots."""
+    p = xs.req_cpu.shape[0]
+    num_waves = -(-p // batch_size)
+    padded = num_waves * batch_size
+    pad = padded - p
+
+    def pad_field(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths).reshape((num_waves, batch_size) + a.shape[1:])
+
+    xs_w = PodX(*(pad_field(f) for f in xs))
+    valid = pad_field(jnp.ones(p, dtype=bool))
+
+    step = make_wavefront_step(config)
+    (final_carry, _), (choices, counts) = jax.lax.scan(
+        step, (carry, statics), (xs_w, valid))
+    return (final_carry,
+            choices.reshape(padded)[:p],
+            counts.reshape(padded, config.num_reason_bits)[:p])

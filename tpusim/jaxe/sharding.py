@@ -1,0 +1,147 @@
+"""Multi-chip execution: shard the node axis (and the snapshot axis) over a
+device mesh.
+
+Design (SURVEY.md §5 "distributed communication backend"): the reference's
+scaling axes are pods × nodes (16 goroutines per pod scan) and independent
+cluster snapshots (the multi-tenant what-if). On TPU these map to:
+
+  "node" mesh axis — node-column arrays ([N] carries, [sig, N] tables) are
+      sharded over ICI; per-step reductions (max score, tie counts, cumsum
+      ranks) become XLA collectives inserted by GSPMD — nothing hand-rolled.
+  "snap" mesh axis — the 50-snapshot what-if (BASELINE.json config 5) is
+      embarrassingly parallel: snapshots are batched on a leading axis and
+      sharded across the mesh; zero cross-snapshot communication.
+
+Single-host multi-chip and multi-host (ICI+DCN) use the same code path: a
+jax.sharding.Mesh over jax.devices() — on multi-host, `jax.distributed` brings
+up the fleet and the Mesh spans hosts, with XLA routing collectives over
+ICI/DCN (this replaces the reference's in-process watch-event fabric; there is
+no NCCL/MPI analog to port, SURVEY.md §2 note).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpusim.jaxe.kernels import Carry, PodX, Statics
+
+
+def make_mesh(n_devices: Optional[int] = None, snap: int = 1) -> Mesh:
+    """A ("snap", "node") mesh over the first n_devices devices."""
+    devices = jax.devices()[: (n_devices or len(jax.devices()))]
+    n = len(devices)
+    if n % snap != 0:
+        raise ValueError(f"{n} devices do not factor into snap={snap}")
+    grid = np.array(devices).reshape(snap, n // snap)
+    return Mesh(grid, ("snap", "node"))
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+def pad_node_axis(statics: Statics, carry: Carry, n_shards: int
+                  ) -> Tuple[Statics, Carry, int]:
+    """Pad the node axis so it divides the mesh.
+
+    Padded nodes are made permanently infeasible through a sentinel condition
+    bit (bit 62): feasibility tests cond_fail_bits != 0, while the reason
+    histogram only decodes bits [0, num_reason_bits), so the sentinel never
+    shows up in failure messages and the padded nodes can never be selected.
+    Returns the padded arrays plus the real node count."""
+    n = statics.alloc_cpu.shape[0]
+    padded = _pad_to(n, n_shards)
+    pad = padded - n
+    if pad == 0:
+        return statics, carry, n
+
+    def pad1(a, fill=0):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths, constant_values=fill)
+
+    def pad_last(a, fill=0):
+        widths = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
+        return jnp.pad(a, widths, constant_values=fill)
+
+    sentinel = jnp.int64(1) << 62
+    statics = Statics(
+        alloc_cpu=pad1(statics.alloc_cpu), alloc_mem=pad1(statics.alloc_mem),
+        alloc_gpu=pad1(statics.alloc_gpu), alloc_eph=pad1(statics.alloc_eph),
+        allowed_pods=pad1(statics.allowed_pods),
+        alloc_scalar=pad1(statics.alloc_scalar),
+        cond_fail_bits=jnp.concatenate(
+            [statics.cond_fail_bits, jnp.full(pad, sentinel, dtype=jnp.int64)]),
+        mem_pressure=pad1(statics.mem_pressure),
+        disk_pressure=pad1(statics.disk_pressure),
+        selector_ok=pad_last(statics.selector_ok),
+        taint_ok=pad_last(statics.taint_ok),
+        intolerable=pad_last(statics.intolerable),
+        affinity_count=pad_last(statics.affinity_count),
+        avoid_score=pad_last(statics.avoid_score),
+        host_ok=pad_last(statics.host_ok))
+    carry = Carry(
+        used_cpu=pad1(carry.used_cpu), used_mem=pad1(carry.used_mem),
+        used_gpu=pad1(carry.used_gpu), used_eph=pad1(carry.used_eph),
+        used_scalar=pad1(carry.used_scalar),
+        nonzero_cpu=pad1(carry.nonzero_cpu), nonzero_mem=pad1(carry.nonzero_mem),
+        pod_count=pad1(carry.pod_count), rr=carry.rr)
+    return statics, carry, n
+
+
+def node_shardings(mesh: Mesh) -> Tuple[Statics, Carry]:
+    """NamedShardings for statics/carry pytrees: node axis sharded, signature
+    and scalar axes replicated."""
+    node = NamedSharding(mesh, P("node"))
+    sig_node = NamedSharding(mesh, P(None, "node"))
+    node_scalar = NamedSharding(mesh, P("node", None))
+    scalar = NamedSharding(mesh, P())
+    statics = Statics(
+        alloc_cpu=node, alloc_mem=node, alloc_gpu=node, alloc_eph=node,
+        allowed_pods=node, alloc_scalar=node_scalar, cond_fail_bits=node,
+        mem_pressure=node, disk_pressure=node, selector_ok=sig_node,
+        taint_ok=sig_node, intolerable=sig_node, affinity_count=sig_node,
+        avoid_score=sig_node, host_ok=sig_node)
+    carry = Carry(used_cpu=node, used_mem=node, used_gpu=node, used_eph=node,
+                  used_scalar=node_scalar, nonzero_cpu=node, nonzero_mem=node,
+                  pod_count=node, rr=scalar)
+    return statics, carry
+
+
+def shard_for_mesh(mesh: Mesh, statics: Statics, carry: Carry, xs: PodX
+                   ) -> Tuple[Statics, Carry, PodX]:
+    """Place arrays: node columns sharded over the "node" axis, pod columns
+    replicated (every shard sees every pod; the per-pod work is the reduction
+    over its node shard)."""
+    n_node_shards = mesh.shape["node"]
+    statics, carry, _ = pad_node_axis(statics, carry, n_node_shards)
+    st_spec, ca_spec = node_shardings(mesh)
+    statics = jax.tree.map(jax.device_put, statics, st_spec)
+    carry = jax.tree.map(jax.device_put, carry, ca_spec)
+    replicated = NamedSharding(mesh, P())
+    xs = jax.tree.map(lambda a: jax.device_put(a, replicated), xs)
+    return statics, carry, xs
+
+
+def snap_shardings(mesh: Mesh) -> Tuple[Statics, Carry, object]:
+    """Shardings for the multi-snapshot what-if: leading snapshot axis sharded
+    over "snap", node axis over "node"."""
+    sn = NamedSharding(mesh, P("snap", "node"))
+    s_sig_node = NamedSharding(mesh, P("snap", None, "node"))
+    s_node_scalar = NamedSharding(mesh, P("snap", "node", None))
+    s_only = NamedSharding(mesh, P("snap"))
+    statics = Statics(
+        alloc_cpu=sn, alloc_mem=sn, alloc_gpu=sn, alloc_eph=sn,
+        allowed_pods=sn, alloc_scalar=s_node_scalar, cond_fail_bits=sn,
+        mem_pressure=sn, disk_pressure=sn, selector_ok=s_sig_node,
+        taint_ok=s_sig_node, intolerable=s_sig_node, affinity_count=s_sig_node,
+        avoid_score=s_sig_node, host_ok=s_sig_node)
+    carry = Carry(used_cpu=sn, used_mem=sn, used_gpu=sn, used_eph=sn,
+                  used_scalar=s_node_scalar, nonzero_cpu=sn, nonzero_mem=sn,
+                  pod_count=sn, rr=s_only)
+    xs_sharding = NamedSharding(mesh, P("snap"))
+    return statics, carry, xs_sharding

@@ -201,7 +201,15 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
     nodes = snapshot.nodes
     n = len(nodes)
 
-    # --- scalar resource name space (pods ∪ node allocatables) ---
+    # single pass: NodeInfos, per-pod requests, and the scalar name space
+    node_infos: List[NodeInfo] = []
+    for node in nodes:
+        ni = NodeInfo()
+        ni.set_node(node)
+        node_infos.append(ni)
+    pod_requests = [get_resource_request(pod) for pod in pods]
+    existing_requests = [get_resource_request(pod) for pod in snapshot.pods]
+
     scalar_names: List[str] = []
     seen = set()
 
@@ -211,12 +219,10 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
                 seen.add(name)
                 scalar_names.append(name)
 
-    for pod in list(pods) + list(snapshot.pods):
-        _note_scalars(get_resource_request(pod).scalar)
-    for node in nodes:
-        probe = NodeInfo()
-        probe.set_node(node)
-        _note_scalars(probe.allocatable_resource.scalar)
+    for req in pod_requests + existing_requests:
+        _note_scalars(req.scalar)
+    for ni in node_infos:
+        _note_scalars(ni.allocatable_resource.scalar)
     s = len(scalar_names)
     scalar_idx = {name: i for i, name in enumerate(scalar_names)}
 
@@ -227,11 +233,8 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
     cond_bits = np.zeros(n, dtype=np.int64)
     mem_pressure = np.zeros(n, dtype=bool)
     disk_pressure = np.zeros(n, dtype=bool)
-    node_infos: List[NodeInfo] = []
     for i, node in enumerate(nodes):
-        ni = NodeInfo()
-        ni.set_node(node)
-        node_infos.append(ni)
+        ni = node_infos[i]
         r = ni.allocatable_resource
         alloc["cpu"][i] = r.milli_cpu
         alloc["mem"][i] = r.memory
@@ -276,7 +279,7 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
     sel_i, tol_i, aff_i, avoid_i, host_i = (Interner() for _ in range(5))
     unsupported: List[str] = []
     for j, pod in enumerate(pods):
-        req = get_resource_request(pod)
+        req = pod_requests[j]
         cols.req_cpu[j] = req.milli_cpu
         cols.req_mem[j] = req.memory
         cols.req_gpu[j] = req.nvidia_gpu
@@ -363,11 +366,11 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
         used_scalar=np.zeros((n, s), dtype=np.int64),
         nonzero_cpu=np.zeros(n, dtype=np.int64), nonzero_mem=np.zeros(n, dtype=np.int64),
         pod_count=np.zeros(n, dtype=np.int64))
-    for existing in snapshot.pods:
+    for k, existing in enumerate(snapshot.pods):
         i = node_index.get(existing.spec.node_name)
         if i is None:
             continue
-        req = get_resource_request(existing)
+        req = existing_requests[k]
         dyn.used_cpu[i] += req.milli_cpu
         dyn.used_mem[i] += req.memory
         dyn.used_gpu[i] += req.nvidia_gpu

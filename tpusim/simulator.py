@@ -1,0 +1,230 @@
+"""ClusterCapacity: the simulation orchestrator.
+
+Reference: pkg/scheduler/simulator.go. The control-flow inversion documented in
+SURVEY.md §1 is preserved in-process and synchronously: pods are pushed into
+the store, store events drive the scheduler, and the engine calls back up
+through the two injected seams — Bind (GetBinder) and Update
+(PodConditionUpdater) (simulator.go:247-255) — so placements mutate only the
+in-memory store. The LIFO pod feed (store.go:223-233) and stop-reason strings
+are reproduced exactly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.api.types import Node, Pod, PodCondition, ResourceType
+from tpusim.engine.generic_scheduler import FitError, GenericScheduler, SchedulingError
+from tpusim.engine.providers import (
+    DEFAULT_PROVIDER,
+    PluginFactoryArgs,
+    create_from_provider,
+)
+from tpusim.engine.resources import NodeInfo
+from tpusim.framework.events import Recorder
+from tpusim.framework.report import GeneralReview, Status, get_report
+from tpusim.framework.store import ADDED, MODIFIED, PodQueue, ResourceStore
+from tpusim.framework.strategy import PredictiveStrategy
+
+DEFAULT_SCHEDULER_NAME = "TD-Scheduler"  # options.go:49
+
+
+@dataclass
+class SchedulerServerConfig:
+    """The slice of componentconfig.KubeSchedulerConfiguration the simulator
+    reads (options.go:47-61)."""
+
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    algorithm_provider: str = DEFAULT_PROVIDER
+    hard_pod_affinity_symmetric_weight: int = 10
+
+
+class ClusterCapacity:
+    """Reference: simulator.go:63-342."""
+
+    def __init__(self, config: SchedulerServerConfig, new_pods: List[Pod],
+                 scheduled_pods: List[Pod], nodes: List[Node],
+                 services: Optional[list] = None):
+        self.config = config
+        self.status = Status()
+        self.report: Optional[GeneralReview] = None
+        self.closed = False
+
+        # --- store + queue + strategy + recorder (simulator.go:286-342) ---
+        self.resource_store = ResourceStore()
+        self.strategy = PredictiveStrategy(self.resource_store)
+        self.pod_queue = PodQueue(new_pods)
+        self.recorder = Recorder(10)
+
+        # --- the scheduler cache, maintained by store event handlers exactly
+        # like factory.go's informer handlers (factory.go:139-299) ---
+        self.node_info_map: Dict[str, NodeInfo] = {}
+        self._bound_keys: set = set()
+        self.resource_store.register_event_handler(ResourceType.PODS, self._on_pod_event)
+        self.resource_store.register_event_handler(ResourceType.NODES, self._on_node_event)
+
+        # --- seed cluster state (simulator.go:315-322) ---
+        for node in nodes:
+            self.resource_store.add(ResourceType.NODES, node)
+        for pod in scheduled_pods:
+            self.resource_store.add(ResourceType.PODS, pod)
+            self.status.scheduled_pods.append(pod)
+        for svc in services or []:
+            self.resource_store.add(ResourceType.SERVICES, svc)
+        self.nodes = nodes
+
+        # --- build the engine with store-backed listers (SchedulerConfigLocal,
+        # simulator.go:345-428: fake empty RC/RS/StatefulSet listers, simulated
+        # pod/node/service listers) ---
+        args = PluginFactoryArgs(
+            pod_lister=lambda: self.resource_store.list(ResourceType.PODS),
+            service_lister=lambda: self.resource_store.list(ResourceType.SERVICES),
+            node_info_getter=lambda name: self.node_info_map.get(name),
+            hard_pod_affinity_symmetric_weight=config.hard_pod_affinity_symmetric_weight,
+        )
+        self.scheduler: GenericScheduler = create_from_provider(
+            config.algorithm_provider, args)
+
+    # --- cache event handlers ---
+
+    def _on_pod_event(self, event: str, pod: Pod) -> None:
+        if event in (ADDED, MODIFIED) and pod.spec.node_name:
+            if pod.key() not in self._bound_keys:
+                self._bound_keys.add(pod.key())
+                self.node_info_map.setdefault(pod.spec.node_name, NodeInfo()).add_pod(pod)
+
+    def _on_node_event(self, event: str, node: Node) -> None:
+        self.node_info_map.setdefault(node.name, NodeInfo()).set_node(node)
+
+    # --- the two seams (simulator.go:108-185) ---
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """SEAM 1 — Bind intercept (simulator.go:108-145)."""
+        stored, exists = self.resource_store.get(ResourceType.PODS, pod.key())
+        if not exists:
+            raise SchedulingError(f"Unable to bind, pod {pod.key()} not found")
+        updated = stored.copy()
+        updated.spec.node_name = node_name
+        updated.status.phase = "Running"
+        self.strategy.add(updated)  # -> store.update -> Modified -> cache AddPod
+        self.status.successful_pods.append(updated)
+        self.recorder.eventf(updated, "Normal", "Scheduled",
+                             "Successfully assigned %s to %s", pod.name, node_name)
+        self.recorder.drain_one()  # simulator.go:130-132
+
+    def update(self, pod: Pod, condition: PodCondition) -> None:
+        """SEAM 2 — unschedulable intercept (simulator.go:163-185)."""
+        stop = (condition.type == "PodScheduled" and condition.status == "False"
+                and condition.reason == "Unschedulable")
+        if stop:
+            pod.status.phase = "Pending"
+            pod.status.conditions.append(condition)
+            pod.status.reason = condition.reason
+            self.status.failed_pods.append(pod)
+            self.recorder.eventf(pod, "Warning", "FailedScheduling", condition.message)
+            self.recorder.drain_one()
+
+    # --- the loop (simulator.go:187-223 + scheduler.go:431-497) ---
+
+    def _next_pod(self) -> Optional[Pod]:
+        pod = self.pod_queue.pop()
+        if pod is None:
+            return None
+        self.resource_store.add(ResourceType.PODS, pod)
+        return pod
+
+    def _schedule_one(self, pod: Pod) -> str:
+        """Returns 'bound' or 'failed' — the seam whose deferred nextPod sets
+        the stop-reason string when the queue drains (simulator.go:136, :171)."""
+        try:
+            host = self.scheduler.schedule(pod, self.nodes, self.node_info_map)
+        except FitError as fit_err:
+            # scheduler.go:190-201 error arm -> PodConditionUpdater.Update
+            self.update(pod, PodCondition(type="PodScheduled", status="False",
+                                          reason="Unschedulable",
+                                          message=fit_err.error()))
+            return "failed"
+        except SchedulingError as sched_err:
+            self.update(pod, PodCondition(type="PodScheduled", status="False",
+                                          reason="Unschedulable",
+                                          message=str(sched_err)))
+            return "failed"
+        self.bind(pod, host)
+        return "bound"
+
+    STOP_REASONS = {
+        # Bind's deferred nextPod uses lowercase "fail", Update's uses "Fail"
+        "run": "fail to get next pod: No pods left\n",      # simulator.go:204
+        "bound": "fail to get next pod: No pods left\n",    # simulator.go:136
+        "failed": "Fail to get next pod: No pods left\n",   # simulator.go:171
+    }
+
+    def run(self) -> None:
+        """Reference: simulator.go:187-213 — feed one pod at a time until the
+        queue drains; the stop-reason strings match the Go format verbatim."""
+        pod = self._next_pod()
+        if pod is None:
+            self.status.stop_reason = self.STOP_REASONS["run"]
+            self.close()
+            return
+        while pod is not None:
+            outcome = self._schedule_one(pod)
+            next_pod = self._next_pod()
+            if next_pod is None:
+                self.status.stop_reason = self.STOP_REASONS[outcome]
+                self.close()
+                return
+            pod = next_pod
+
+    def close(self) -> None:
+        self.closed = True
+
+    def get_report(self) -> GeneralReview:
+        if self.report is None:
+            self.report = get_report(self.status)
+        return self.report
+
+
+def new_cluster_capacity(config: SchedulerServerConfig, new_pods: List[Pod],
+                         scheduled_pods: List[Pod], nodes: List[Node],
+                         services: Optional[list] = None) -> ClusterCapacity:
+    """Reference: scheduler.New (simulator.go:286-342)."""
+    return ClusterCapacity(config, new_pods, scheduled_pods, nodes, services)
+
+
+def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
+                   provider: str = DEFAULT_PROVIDER, backend: str = "reference",
+                   scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+                   batch_size: int = 0) -> Status:
+    """High-level entry: run `pods` (in podspec order; the LIFO feed reversal
+    happens inside, matching the reference) against `snapshot` and return the
+    final Status. backend='jax' routes the batch through the TPU engine and
+    reconstructs the same Status/report shape; batch_size>0 selects the jax
+    backend's wavefront mode."""
+    if backend == "reference":
+        cc = ClusterCapacity(
+            SchedulerServerConfig(scheduler_name=scheduler_name,
+                                  algorithm_provider=provider),
+            new_pods=pods, scheduled_pods=snapshot.pods, nodes=snapshot.nodes,
+            services=snapshot.services)
+        cc.run()
+        return cc.status
+    if backend == "jax":
+        from tpusim.backends import get_backend
+
+        jax_backend = get_backend("jax", provider=provider, batch_size=batch_size)
+        feed = list(reversed(pods))  # the LIFO queue pops the last element first
+        placements = jax_backend.schedule(feed, snapshot)
+        status = Status(scheduled_pods=list(snapshot.pods))
+        for placement in placements:
+            if placement.scheduled:
+                status.successful_pods.append(placement.pod)
+            else:
+                status.failed_pods.append(placement.pod)
+        last_failed = placements and not placements[-1].scheduled
+        status.stop_reason = ("Fail to get next pod: No pods left\n" if last_failed
+                              else "fail to get next pod: No pods left\n")
+        return status
+    raise ValueError(f"unknown backend {backend!r}")

@@ -1,0 +1,307 @@
+"""Preemption pipeline + scheduling queue + backoff + equivalence cache tests
+(reference: core/generic_scheduler.go:205-1000, core/scheduling_queue.go,
+util/backoff_utils.go, core/equivalence_cache.go)."""
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.api.types import PodDisruptionBudget
+from tpusim.engine.equivalence import EquivalenceCache, get_equivalence_hash
+from tpusim.engine.queue import FIFO, PriorityQueue, new_scheduling_queue
+from tpusim.engine.util import PodBackoff, get_pod_priority, sort_by_priority_desc
+from tpusim.simulator import ClusterCapacity, SchedulerServerConfig
+
+
+def prio_pod(name, priority, milli_cpu=500, node_name="", labels=None):
+    p = make_pod(name, milli_cpu=milli_cpu, node_name=node_name, labels=labels)
+    p.spec.priority = priority
+    return p
+
+
+# --- preemption end-to-end ---
+
+
+def test_preemption_evicts_lower_priority_victim():
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    victim = prio_pod("victim", 1, milli_cpu=800, node_name="n1")
+    victim.status.phase = "Running"
+    high = prio_pod("high", 10, milli_cpu=800)
+    cc = ClusterCapacity(SchedulerServerConfig(enable_pod_priority=True),
+                         [high], [victim], [node])
+    cc.run()
+    assert [p.name for p in cc.status.successful_pods] == ["high"]
+    assert [p.name for p in cc.status.preempted_pods] == ["victim"]
+    assert not cc.status.failed_pods
+    assert cc.status.successful_pods[0].spec.node_name == "n1"
+
+
+def test_no_preemption_when_gate_off():
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    victim = prio_pod("victim", 1, milli_cpu=800, node_name="n1")
+    high = prio_pod("high", 10, milli_cpu=800)
+    cc = ClusterCapacity(SchedulerServerConfig(), [high], [victim], [node])
+    cc.run()
+    assert not cc.status.successful_pods
+    assert [p.name for p in cc.status.failed_pods] == ["high"]
+    assert not cc.status.preempted_pods
+
+
+def test_preemption_does_not_evict_equal_or_higher_priority():
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    peer = prio_pod("peer", 10, milli_cpu=800, node_name="n1")
+    pod = prio_pod("pod", 10, milli_cpu=800)
+    cc = ClusterCapacity(SchedulerServerConfig(enable_pod_priority=True),
+                         [pod], [peer], [node])
+    cc.run()
+    assert [p.name for p in cc.status.failed_pods] == ["pod"]
+    assert not cc.status.preempted_pods
+
+
+def test_preemption_picks_node_with_fewest_cheapest_victims():
+    # n1 needs 1 low-prio victim; n2 needs 2 — criteria pick n1
+    n1 = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    n2 = make_node("n2", milli_cpu=1000, memory=16 * 1024**3)
+    v1 = prio_pod("v1", 1, milli_cpu=900, node_name="n1")
+    v2a = prio_pod("v2a", 1, milli_cpu=450, node_name="n2")
+    v2b = prio_pod("v2b", 1, milli_cpu=450, node_name="n2")
+    pod = prio_pod("pod", 10, milli_cpu=900)
+    cc = ClusterCapacity(SchedulerServerConfig(enable_pod_priority=True),
+                         [pod], [v1, v2a, v2b], [n1, n2])
+    cc.run()
+    assert cc.status.successful_pods[0].spec.node_name == "n1"
+    assert [p.name for p in cc.status.preempted_pods] == ["v1"]
+
+
+def test_preemption_prefers_lower_priority_victims_node():
+    n1 = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    n2 = make_node("n2", milli_cpu=1000, memory=16 * 1024**3)
+    v_high = prio_pod("v-high", 5, milli_cpu=900, node_name="n1")
+    v_low = prio_pod("v-low", 1, milli_cpu=900, node_name="n2")
+    pod = prio_pod("pod", 10, milli_cpu=900)
+    cc = ClusterCapacity(SchedulerServerConfig(enable_pod_priority=True),
+                         [pod], [v_high, v_low], [n1, n2])
+    cc.run()
+    # minimum highest-priority-victim criterion picks n2 (victim priority 1)
+    assert cc.status.successful_pods[0].spec.node_name == "n2"
+    assert [p.name for p in cc.status.preempted_pods] == ["v-low"]
+
+
+def test_preemption_reprieves_unneeded_victims():
+    # removing both victims overshoots; only one eviction is needed
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    v1 = prio_pod("v1", 1, milli_cpu=500, node_name="n1")
+    v2 = prio_pod("v2", 2, milli_cpu=500, node_name="n1")
+    pod = prio_pod("pod", 10, milli_cpu=500)
+    cc = ClusterCapacity(SchedulerServerConfig(enable_pod_priority=True),
+                         [pod], [v1, v2], [node])
+    cc.run()
+    assert cc.status.successful_pods
+    # reprieve walks highest-priority-first, so v2 is reprieved and v1 evicted
+    assert [p.name for p in cc.status.preempted_pods] == ["v1"]
+
+
+def test_preemption_skips_unresolvable_nodes():
+    # a node failing by node selector can't be helped by eviction
+    n1 = make_node("n1", milli_cpu=1000, memory=16 * 1024**3, labels={"zone": "b"})
+    v1 = prio_pod("v1", 1, milli_cpu=900, node_name="n1")
+    pod = prio_pod("pod", 10, milli_cpu=100)
+    pod.spec.node_selector = {"zone": "a"}
+    cc = ClusterCapacity(SchedulerServerConfig(enable_pod_priority=True),
+                         [pod], [v1], [n1])
+    cc.run()
+    assert [p.name for p in cc.status.failed_pods] == ["pod"]
+    assert not cc.status.preempted_pods
+
+
+def test_preemption_respects_pdbs():
+    # two candidate nodes; n1's victim is PDB-protected -> fewest-PDB-violations
+    # criterion picks n2
+    n1 = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    n2 = make_node("n2", milli_cpu=1000, memory=16 * 1024**3)
+    protected = prio_pod("protected", 1, milli_cpu=900, node_name="n1",
+                         labels={"app": "db"})
+    plain = prio_pod("plain", 1, milli_cpu=900, node_name="n2")
+    pod = prio_pod("pod", 10, milli_cpu=900)
+    cc = ClusterCapacity(SchedulerServerConfig(enable_pod_priority=True),
+                         [pod], [protected, plain], [n1, n2])
+    cc.pdbs.append(PodDisruptionBudget.from_obj({
+        "metadata": {"name": "db-pdb", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "db"}}},
+        "status": {"disruptionsAllowed": 0}}))
+    cc.run()
+    assert [p.name for p in cc.status.preempted_pods] == ["plain"]
+    assert cc.status.successful_pods[0].spec.node_name == "n2"
+
+
+# --- queues ---
+
+
+def test_fifo_order():
+    q = FIFO()
+    a, b = make_pod("a"), make_pod("b")
+    q.add(a)
+    q.add(b)
+    q.add_if_not_present(make_pod("a"))  # dedup by key
+    assert len(q) == 2
+    assert q.pop().name == "a" and q.pop().name == "b" and q.pop() is None
+
+
+def test_priority_queue_orders_by_priority_then_fifo():
+    q = PriorityQueue()
+    q.add(prio_pod("low", 1))
+    q.add(prio_pod("high", 10))
+    q.add(prio_pod("mid-1", 5))
+    q.add(prio_pod("mid-2", 5))
+    assert [q.pop().name for _ in range(4)] == ["high", "mid-1", "mid-2", "low"]
+
+
+def test_priority_queue_unschedulable_parking_and_move():
+    q = PriorityQueue()
+    p = prio_pod("parked", 1)
+    q.add_unschedulable_if_not_present(p)
+    assert q.pop() is None
+    q.move_all_to_active_queue()
+    # while the move request is outstanding, unschedulable adds go straight to
+    # active; Pop() resets the flag (scheduling_queue.go Pop)
+    q.add_unschedulable_if_not_present(prio_pod("direct", 1))
+    assert q.pop().name == "parked"  # moved first -> earlier FIFO slot
+    assert q.pop().name == "direct"
+    q.add_unschedulable_if_not_present(prio_pod("parked-again", 1))
+    assert q.pop() is None  # flag was reset; pod parked
+
+
+def test_priority_queue_nominated_pods():
+    q = PriorityQueue()
+    p = prio_pod("nom", 5)
+    p.status.nominated_node_name = "n1"
+    q.add_unschedulable_if_not_present(p)
+    assert [x.name for x in q.waiting_pods_for_node("n1")] == ["nom"]
+    assert q.waiting_pods_for_node("other") == []
+    q.delete(p)
+    assert q.waiting_pods_for_node("n1") == []
+
+
+def test_new_scheduling_queue_gate():
+    assert isinstance(new_scheduling_queue(False), FIFO)
+    assert isinstance(new_scheduling_queue(True), PriorityQueue)
+
+
+# --- backoff ---
+
+
+def test_pod_backoff_doubles_to_max():
+    clock = [0.0]
+    b = PodBackoff(default_duration=1.0, max_duration=4.0, clock=lambda: clock[0])
+    assert b.get_backoff_time("p") == 1.0
+    assert b.get_backoff_time("p") == 2.0
+    assert b.get_backoff_time("p") == 4.0
+    assert b.get_backoff_time("p") == 4.0  # capped
+    b.clear_pod_backoff("p")
+    assert b.get_backoff_time("p") == 1.0
+
+
+def test_pod_backoff_gc():
+    clock = [0.0]
+    b = PodBackoff(clock=lambda: clock[0])
+    b.get_backoff_time("old")
+    clock[0] = 120.0
+    b.gc(max_age=60.0)
+    assert "old" not in b._entries
+
+
+# --- equivalence cache ---
+
+
+def test_equivalence_hash_requires_owner_refs():
+    assert get_equivalence_hash(make_pod("plain")) is None
+    p1, p2 = make_pod("rs-a"), make_pod("rs-b")
+    from tpusim.api.types import OwnerReference
+
+    for p in (p1, p2):
+        p.metadata.owner_references = [OwnerReference(kind="ReplicaSet", name="rs",
+                                                      uid="u1", controller=True)]
+    assert get_equivalence_hash(p1) == get_equivalence_hash(p2)
+
+
+def test_equivalence_cache_hit_and_invalidate():
+    cache = EquivalenceCache()
+    calls = []
+
+    def pred(pod, meta, node_info):
+        calls.append(pod.name)
+        return True, []
+
+    from tpusim.engine.resources import NodeInfo
+
+    ni = NodeInfo()
+    ni.set_node(make_node("n1"))
+    pod = make_pod("p")
+    fit, _ = cache.run_predicate(pred, "PodFitsResources", pod, None, ni, 42)
+    fit2, _ = cache.run_predicate(pred, "PodFitsResources", pod, None, ni, 42)
+    assert fit and fit2 and len(calls) == 1  # second call served from cache
+    assert cache.hits == 1
+    cache.invalidate_predicates_on_node("n1", ["PodFitsResources"])
+    cache.run_predicate(pred, "PodFitsResources", pod, None, ni, 42)
+    assert len(calls) == 2
+
+
+def test_helpers():
+    pods = [prio_pod("a", 1), prio_pod("b", 9), prio_pod("c", 5), make_pod("d")]
+    assert [p.name for p in sort_by_priority_desc(pods)] == ["b", "c", "a", "d"]
+    assert get_pod_priority(make_pod("x")) == 0
+
+
+def test_preempted_queue_victim_removed_from_successful(
+):
+    """Regression (review): a victim that was bound THIS run must leave
+    successful_pods when preempted."""
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    low = prio_pod("low", 0, milli_cpu=800)
+    high = prio_pod("high", 10, milli_cpu=800)
+    # LIFO: feed [high, low] so low pops first, binds, then high preempts it
+    cc = ClusterCapacity(SchedulerServerConfig(enable_pod_priority=True),
+                         [high, low], [], [node])
+    cc.run()
+    assert [p.name for p in cc.status.successful_pods] == ["high"]
+    assert [p.name for p in cc.status.preempted_pods] == ["low"]
+
+
+def test_preempted_snapshot_victim_removed_from_scheduled():
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    victim = prio_pod("victim", 1, milli_cpu=800, node_name="n1")
+    victim.status.phase = "Running"
+    high = prio_pod("high", 10, milli_cpu=800)
+    cc = ClusterCapacity(SchedulerServerConfig(enable_pod_priority=True),
+                         [high], [victim], [node])
+    cc.run()
+    assert cc.status.scheduled_pods == []  # evicted from the pre-scheduled bucket
+    assert [p.name for p in cc.status.preempted_pods] == ["victim"]
+
+
+def test_equivalence_cache_invalidated_on_bind():
+    """Regression (review): two same-controller pods on a one-pod node; the
+    second must NOT reuse the first's cached fit after the bind."""
+    from tpusim.api.types import OwnerReference
+
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    pods = []
+    for i in range(2):
+        p = make_pod(f"rs-{i}", milli_cpu=700)
+        p.metadata.owner_references = [OwnerReference(
+            kind="ReplicaSet", name="rs", uid="u1", controller=True)]
+        pods.append(p)
+    cc = ClusterCapacity(SchedulerServerConfig(enable_equivalence_cache=True),
+                         pods, [], [node])
+    cc.run()
+    assert len(cc.status.successful_pods) == 1
+    assert len(cc.status.failed_pods) == 1
+    assert "Insufficient cpu" in cc.status.failed_pods[0].status.conditions[-1].message
+    # and the cache did serve at least one hit across the run
+    assert cc.scheduler.equivalence_cache.hits + cc.scheduler.equivalence_cache.misses > 0
+
+
+def test_failed_pods_parked_in_unschedulable_queue():
+    node = make_node("n1", milli_cpu=100)
+    cc = ClusterCapacity(SchedulerServerConfig(), [make_pod("p", milli_cpu=5000)],
+                         [], [node])
+    cc.run()
+    assert len(cc.scheduling_queue) == 1  # parked, visible to later pods
+    assert cc.pod_backoff.get_entry("default/p").backoff > 1.0  # backoff recorded

@@ -895,6 +895,42 @@ class StorageClass:
         return self.metadata.name
 
 
+@dataclass
+class PodDisruptionBudget:
+    """Minimal policy/v1beta1 PDB: the scheduler reads namespace, selector, and
+    status.disruptionsAllowed (preemption victim filtering,
+    core/generic_scheduler.go filterPodsWithPDBViolation)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    disruptions_allowed: int = 0
+
+    kind = "PodDisruptionBudget"
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "PodDisruptionBudget":
+        return cls(metadata=ObjectMeta.from_obj(o.get("metadata")),
+                   selector=LabelSelector.from_obj(_get(o, "spec", "selector")),
+                   disruptions_allowed=int(
+                       _get(o, "status", "disruptionsAllowed", default=0) or 0))
+
+    def to_obj(self) -> dict:
+        o: dict[str, Any] = {"apiVersion": "policy/v1beta1",
+                             "kind": "PodDisruptionBudget",
+                             "metadata": self.metadata.to_obj(), "spec": {},
+                             "status": {"disruptionsAllowed": self.disruptions_allowed}}
+        if self.selector is not None:
+            o["spec"]["selector"] = self.selector.to_obj()
+        return o
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace or DEFAULT_NAMESPACE
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.metadata.name}"
+
+
 _RESOURCE_OBJECT_TYPES = {
     ResourceType.PODS: Pod,
     ResourceType.PERSISTENT_VOLUMES: PersistentVolume,

@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Generate N homogeneous synthetic nodes")
     parser.add_argument("--synthetic-milli-cpu", type=int, default=4000)
     parser.add_argument("--synthetic-memory", type=int, default=16 * 1024**3)
+    parser.add_argument("--enable-pod-priority", action="store_true",
+                        help="Enable the PodPriority feature gate (preemption); "
+                             "reference backend only")
     parser.add_argument("--print-requirements", action="store_true",
                         help="Also print per-pod requirement spec")
     parser.add_argument("--quiet", action="store_true",
@@ -114,10 +117,15 @@ def main(argv=None) -> int:
     if args.batch_size and args.backend != "jax":
         print("error: --batch-size requires --backend jax", file=sys.stderr)
         return 2
+    if args.enable_pod_priority and args.backend != "reference":
+        print("error: --enable-pod-priority requires --backend reference "
+              "(preemption is not batched yet)", file=sys.stderr)
+        return 2
 
     start = time.perf_counter()
     status = run_simulation(pods, snapshot, provider=args.algorithmprovider,
-                            backend=args.backend, batch_size=args.batch_size)
+                            backend=args.backend, batch_size=args.batch_size,
+                            enable_pod_priority=args.enable_pod_priority)
     elapsed = time.perf_counter() - start
 
     report = get_report(status)

@@ -18,6 +18,8 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from tpusim.api.types import Node, Pod
+from tpusim.engine import errors as err
+from tpusim.engine.equivalence import get_equivalence_hash
 from tpusim.engine.errors import PredicateFailureReason
 from tpusim.engine.predicates import (
     PREDICATES_ORDERING,
@@ -26,6 +28,11 @@ from tpusim.engine.predicates import (
 )
 from tpusim.engine.priorities import HostPriority, PriorityConfig
 from tpusim.engine.resources import NodeInfo
+from tpusim.engine.util import (
+    MAX_INT32,
+    get_pod_priority as util_get_pod_priority,
+    sort_by_priority_desc,
+)
 
 NO_NODE_AVAILABLE_MSG = "0/{} nodes are available"
 
@@ -77,6 +84,9 @@ class GenericScheduler:
         priority_meta_producer: Optional[Callable] = None,
         extenders: Optional[list] = None,
         always_check_all_predicates: bool = False,
+        equivalence_cache=None,
+        scheduling_queue=None,
+        pdb_lister: Optional[Callable[[], list]] = None,
     ):
         self.predicates = predicates
         self.prioritizers = prioritizers
@@ -84,28 +94,66 @@ class GenericScheduler:
         self.priority_meta_producer = priority_meta_producer
         self.extenders = extenders or []
         self.always_check_all_predicates = always_check_all_predicates
+        self.equivalence_cache = equivalence_cache
+        self.scheduling_queue = scheduling_queue
+        self.pdb_lister = pdb_lister or (lambda: [])
         self.last_node_index = 0  # persistent round-robin counter (:97)
 
     # --- filter phase ---
 
+    def _add_nominated_pods(self, pod_priority: int,
+                            meta: Optional[PredicateMetadata],
+                            node_info: NodeInfo):
+        """generic_scheduler.go addNominatedPods: clone state with the node's
+        nominated pods of >= priority added; returns (added, meta', info')."""
+        if self.scheduling_queue is None or node_info.node is None:
+            return False, meta, node_info
+        nominated = self.scheduling_queue.waiting_pods_for_node(node_info.node.name)
+        nominated = [p for p in nominated
+                     if util_get_pod_priority(p) >= pod_priority]
+        if not nominated:
+            return False, meta, node_info
+        meta_copy = meta.shallow_copy() if meta is not None else None
+        info_copy = node_info.clone()
+        for p in nominated:
+            info_copy.add_pod(p)
+            if meta_copy is not None:
+                meta_copy.add_pod(p, info_copy.node)
+        return True, meta_copy, info_copy
+
     def pod_fits_on_node(self, pod: Pod, meta: Optional[PredicateMetadata],
                          node_info: NodeInfo) -> tuple[bool, List[PredicateFailureReason]]:
-        """Reference: generic_scheduler.go:420-534, with the nominated-pods
-        double-pass elided (pod priority is feature-gated off in the simulator,
-        so no nominated pods exist; SURVEY.md §3.3)."""
+        """Reference: generic_scheduler.go:420-534 — predicates run in
+        PREDICATES_ORDERING with short-circuit; when nominated pods exist the
+        loop runs twice (once with them added, once without) and the
+        equivalence cache is consulted only on the clean pass."""
         fails: List[PredicateFailureReason] = []
-        fits = True
-        for pred_key in PREDICATES_ORDERING:
-            predicate = self.predicates.get(pred_key)
-            if predicate is None:
-                continue
-            fit, reasons = predicate(pod, meta, node_info)
-            if not fit:
-                fits = False
-                fails.extend(reasons)
-                if not self.always_check_all_predicates:
-                    break
-        return fits, fails
+        pods_added = False
+        ecache = self.equivalence_cache
+        equiv_hash = get_equivalence_hash(pod) if ecache is not None else None
+        for i in range(2):
+            meta_to_use, info_to_use = meta, node_info
+            if i == 0:
+                pods_added, meta_to_use, info_to_use = self._add_nominated_pods(
+                    util_get_pod_priority(pod), meta, node_info)
+            elif not pods_added or fails:
+                break
+            ecache_available = ecache is not None and not pods_added
+            for pred_key in PREDICATES_ORDERING:
+                predicate = self.predicates.get(pred_key)
+                if predicate is None:
+                    continue
+                if ecache_available:
+                    fit, reasons = ecache.run_predicate(
+                        predicate, pred_key, pod, meta_to_use, info_to_use,
+                        equiv_hash)
+                else:
+                    fit, reasons = predicate(pod, meta_to_use, info_to_use)
+                if not fit:
+                    fails.extend(reasons)
+                    if not self.always_check_all_predicates:
+                        break
+        return (not fails), fails
 
     def find_nodes_that_fit(self, pod: Pod, nodes: List[Node],
                             node_info_map: Dict[str, NodeInfo]
@@ -204,10 +252,221 @@ class GenericScheduler:
         priority_list = self.prioritize_nodes(pod, node_info_map, filtered)
         return self.select_host(priority_list)
 
+    # --- preemption (generic_scheduler.go:205-1000) ---
+    # Dormant by default: pod priority is feature-gated off at the reference's
+    # defaults (scheduler.go:210-213 via util.PodPriorityEnabled); the
+    # simulator enables it through SchedulerServerConfig.enable_pod_priority.
+
+    # predicate failures that removing pods can never fix
+    # (nodesWherePreemptionMightHelp)
+    _UNRESOLVABLE = {
+        err.ERR_NODE_SELECTOR_NOT_MATCH, err.ERR_POD_NOT_MATCH_HOST_NAME,
+        err.ERR_TAINTS_TOLERATIONS_NOT_MATCH, err.ERR_NODE_LABEL_PRESENCE_VIOLATED,
+        err.ERR_NODE_NOT_READY, err.ERR_NODE_NETWORK_UNAVAILABLE,
+        err.ERR_NODE_UNSCHEDULABLE, err.ERR_NODE_UNKNOWN_CONDITION,
+        err.ERR_VOLUME_ZONE_CONFLICT, err.ERR_VOLUME_NODE_CONFLICT,
+        err.ERR_VOLUME_BIND_CONFLICT,
+    }
+
     def preempt(self, pod: Pod, nodes: List[Node],
                 node_info_map: Dict[str, NodeInfo], schedule_err: Exception):
-        """Reference: generic_scheduler.go:205-262. Pod priority is feature-gated
-        off at the reference's defaults (scheduler.go:210-213 short-circuits via
-        util.PodPriorityEnabled), so preemption never fires in simulation runs;
-        the full victim-selection pipeline is tracked for a later milestone."""
+        """Returns (node, victims, nominated_pods_to_clear)."""
+        if not isinstance(schedule_err, FitError):
+            return None, [], []
+        if not self._pod_eligible_to_preempt_others(pod, node_info_map):
+            return None, [], []
+        if not nodes:
+            raise ERR_NO_NODES_AVAILABLE
+        potential = self._nodes_where_preemption_might_help(
+            nodes, schedule_err.failed_predicates)
+        if not potential:
+            # clean up any existing nominated node name of the pod (:231-234)
+            return None, [], [pod]
+        pdbs = self.pdb_lister()
+        node_to_victims = self._select_nodes_for_preemption(
+            pod, node_info_map, potential, pdbs)
+        by_name = {n.name: n for n in nodes}
+        while node_to_victims:
+            name = self._pick_one_node_for_preemption(node_to_victims)
+            if name is None:
+                return None, [], []
+            victims, _ = node_to_victims[name]
+            if self._node_passes_extenders_for_preemption(pod, name, victims,
+                                                          node_info_map):
+                nominated = self._get_lower_priority_nominated_pods(pod, name)
+                return by_name[name], victims, nominated
+            del node_to_victims[name]
         return None, [], []
+
+    def _pod_eligible_to_preempt_others(self, pod: Pod,
+                                        node_info_map: Dict[str, NodeInfo]) -> bool:
+        """podEligibleToPreemptOthers: don't preempt again while a prior
+        preemption's victims are still terminating on the nominated node.
+        The offline simulator deletes victims synchronously, so the terminating
+        state never materializes and this returns True (matching the reference
+        when no DeletionTimestamp is set)."""
+        nom = pod.status.nominated_node_name
+        if nom and nom in node_info_map:
+            for p in node_info_map[nom].pods:
+                if (getattr(p.metadata, "deletion_timestamp", None) is not None
+                        and util_get_pod_priority(p) < util_get_pod_priority(pod)):
+                    return False
+        return True
+
+    def _nodes_where_preemption_might_help(self, nodes: List[Node],
+                                           failed_predicates) -> List[Node]:
+        potential = []
+        for node in nodes:
+            fails = failed_predicates.get(node.name, [])
+            if any(f in self._UNRESOLVABLE for f in fails):
+                continue
+            potential.append(node)
+        return potential
+
+    def _select_nodes_for_preemption(self, pod: Pod, node_info_map, potential,
+                                     pdbs) -> Dict[str, tuple]:
+        """selectNodesForPreemption: node name -> (victims, num_pdb_violations).
+        Keyed by name with insertion in node-list order for deterministic
+        pick-one tie-breaking (Go iterates a map in random order)."""
+        meta = self.predicate_meta_producer(pod, node_info_map)
+        result: Dict[str, tuple] = {}
+        for node in potential:
+            meta_copy = meta.shallow_copy() if meta is not None else None
+            victims, violations, fits = self._select_victims_on_node(
+                pod, meta_copy, node_info_map[node.name], pdbs)
+            if fits:
+                result[node.name] = (victims, violations)
+        return result
+
+    def _select_victims_on_node(self, pod: Pod, meta, node_info: NodeInfo,
+                                pdbs) -> tuple:
+        """selectVictimsOnNode: remove all lower-priority pods, check fit, then
+        reprieve as many as possible (PDB-violating victims first, each group
+        highest-priority first)."""
+        info_copy = node_info.clone()
+
+        def remove_pod(p):
+            info_copy.remove_pod(p)
+            if meta is not None:
+                meta.remove_pod(p)
+
+        def add_pod(p):
+            info_copy.add_pod(p)
+            if meta is not None:
+                meta.add_pod(p, info_copy.node)
+
+        pod_priority = util_get_pod_priority(pod)
+        potential_victims = []
+        for p in list(info_copy.pods):
+            if util_get_pod_priority(p) < pod_priority:
+                potential_victims.append(p)
+                remove_pod(p)
+        potential_victims = sort_by_priority_desc(potential_victims)
+
+        fits, _ = self._fits_sans_nominated(pod, meta, info_copy)
+        if not fits:
+            return None, 0, False
+
+        victims: List[Pod] = []
+        num_violating = 0
+        violating, non_violating = self._filter_pods_with_pdb_violation(
+            potential_victims, pdbs)
+
+        def reprieve(p) -> bool:
+            add_pod(p)
+            fits, _ = self._fits_sans_nominated(pod, meta, info_copy)
+            if not fits:
+                remove_pod(p)
+                victims.append(p)
+            return fits
+
+        for p in violating:
+            if not reprieve(p):
+                num_violating += 1
+        for p in non_violating:
+            reprieve(p)
+        return victims, num_violating, True
+
+    def _fits_sans_nominated(self, pod, meta, node_info):
+        """podFitsOnNode with queue=nil and no ecache (the preemption calls)."""
+        fails: List[PredicateFailureReason] = []
+        for pred_key in PREDICATES_ORDERING:
+            predicate = self.predicates.get(pred_key)
+            if predicate is None:
+                continue
+            fit, reasons = predicate(pod, meta, node_info)
+            if not fit:
+                fails.extend(reasons)
+                break
+        return (not fails), fails
+
+    @staticmethod
+    def _filter_pods_with_pdb_violation(pods, pdbs):
+        """filterPodsWithPDBViolation — order within each bucket preserved."""
+        violating, non_violating = [], []
+        for pod in pods:
+            violated = False
+            if pod.metadata.labels:
+                for pdb in pdbs:
+                    if pdb.namespace != pod.namespace or pdb.selector is None:
+                        continue
+                    if (not pdb.selector.match_labels
+                            and not pdb.selector.match_expressions):
+                        continue  # empty selector matches nothing here
+                    if not pdb.selector.matches(pod.metadata.labels):
+                        continue
+                    if pdb.disruptions_allowed <= 0:
+                        violated = True
+                        break
+            (violating if violated else non_violating).append(pod)
+        return violating, non_violating
+
+    def _pick_one_node_for_preemption(self, node_to_victims: Dict[str, tuple]
+                                      ) -> Optional[str]:
+        """pickOneNodeForPreemption's 5 criteria: fewest PDB violations, lowest
+        highest-priority victim, smallest priority sum, fewest victims, first.
+        Returns the chosen node name (Go returns the map key's node; map order
+        is random there — we use node-list insertion order deterministically)."""
+        if not node_to_victims:
+            return None
+        names = list(node_to_victims.keys())
+        for name in names:
+            victims, _ = node_to_victims[name]
+            if not victims:
+                return name
+        min_violations = min(v[1] for v in node_to_victims.values())
+        names = [n for n in names if node_to_victims[n][1] == min_violations]
+        if len(names) > 1:
+            highest = {n: util_get_pod_priority(node_to_victims[n][0][0])
+                       for n in names}
+            min_highest = min(highest.values())
+            names = [n for n in names if highest[n] == min_highest]
+        if len(names) > 1:
+            sums = {n: sum(util_get_pod_priority(p) + MAX_INT32 + 1
+                           for p in node_to_victims[n][0]) for n in names}
+            min_sum = min(sums.values())
+            names = [n for n in names if sums[n] == min_sum]
+        if len(names) > 1:
+            counts = {n: len(node_to_victims[n][0]) for n in names}
+            min_count = min(counts.values())
+            names = [n for n in names if counts[n] == min_count]
+        return names[0]
+
+    def _node_passes_extenders_for_preemption(self, pod, node_name, victims,
+                                              node_info_map) -> bool:
+        for extender in self.extenders:
+            supports = getattr(extender, "supports_preemption", False)
+            if not supports:
+                continue
+            if not extender.process_preemption(pod, node_name, victims,
+                                               node_info_map):
+                return False
+        return True
+
+    def _get_lower_priority_nominated_pods(self, pod: Pod,
+                                           node_name: str) -> List[Pod]:
+        if self.scheduling_queue is None:
+            return []
+        pods = self.scheduling_queue.waiting_pods_for_node(node_name)
+        priority = util_get_pod_priority(pod)
+        return [p for p in pods if util_get_pod_priority(p) < priority]

@@ -1,0 +1,199 @@
+"""Scheduling queues: FIFO and the priority queue.
+
+Reference: core/scheduling_queue.go — `NewSchedulingQueue` returns a plain FIFO
+unless pod priority is enabled, else the PriorityQueue with an active heap,
+an unschedulable map, a nominated-pods index, and the receivedMoveRequest flag
+(:49-340). The simulator runs one pod in flight so the queues are small, but
+the semantics (ordering, unschedulable parking, move-to-active) are preserved.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, Dict, List, Optional
+
+from tpusim.api.types import Pod
+from tpusim.engine.util import get_pod_priority
+
+
+class SchedulingQueue:
+    """Reference: scheduling_queue.go:49-61 (interface)."""
+
+    def add(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def add_if_not_present(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def add_unschedulable_if_not_present(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def pop(self) -> Optional[Pod]:
+        raise NotImplementedError
+
+    def update(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def delete(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def move_all_to_active_queue(self) -> None:
+        raise NotImplementedError
+
+    def waiting_pods_for_node(self, node_name: str) -> List[Pod]:
+        raise NotImplementedError
+
+
+class FIFO(SchedulingQueue):
+    """Reference: scheduling_queue.go:73-139 — wrapper over cache.FIFO."""
+
+    def __init__(self):
+        self._order: List[str] = []
+        self._items: Dict[str, Pod] = {}
+
+    def add(self, pod: Pod) -> None:
+        key = pod.key()
+        if key not in self._items:
+            self._order.append(key)
+        self._items[key] = pod
+
+    def add_if_not_present(self, pod: Pod) -> None:
+        if pod.key() not in self._items:
+            self.add(pod)
+
+    # FIFO treats unschedulable pods like any other (scheduling_queue.go:87-92)
+    def add_unschedulable_if_not_present(self, pod: Pod) -> None:
+        self.add_if_not_present(pod)
+
+    def pop(self) -> Optional[Pod]:
+        while self._order:
+            key = self._order.pop(0)
+            pod = self._items.pop(key, None)
+            if pod is not None:
+                return pod
+        return None
+
+    def update(self, pod: Pod) -> None:
+        self.add(pod)
+
+    def delete(self, pod: Pod) -> None:
+        self._items.pop(pod.key(), None)
+
+    def move_all_to_active_queue(self) -> None:
+        pass
+
+    def waiting_pods_for_node(self, node_name: str) -> List[Pod]:
+        return []
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class PriorityQueue(SchedulingQueue):
+    """Reference: scheduling_queue.go:147-340 — activeQ heap ordered by pod
+    priority (ties FIFO by insertion), unschedulableQ parking lot, nominated
+    pods index, receivedMoveRequest."""
+
+    def __init__(self):
+        self._counter = itertools.count()
+        self._active: List[tuple] = []  # (-priority, seq, key)
+        self._active_items: Dict[str, Pod] = {}
+        self._unschedulable: Dict[str, Pod] = {}
+        self._nominated: Dict[str, List[Pod]] = {}  # node name -> pods
+        self.received_move_request = False
+
+    # --- nominated-pods index ---
+
+    def _nominated_node(self, pod: Pod) -> str:
+        return pod.status.nominated_node_name
+
+    def _add_nominated(self, pod: Pod) -> None:
+        node = self._nominated_node(pod)
+        if node:
+            self._nominated.setdefault(node, []).append(pod)
+
+    def _delete_nominated(self, pod: Pod) -> None:
+        node = self._nominated_node(pod)
+        if node and node in self._nominated:
+            self._nominated[node] = [p for p in self._nominated[node]
+                                     if p.key() != pod.key()]
+            if not self._nominated[node]:
+                del self._nominated[node]
+
+    # --- queue ops ---
+
+    def add(self, pod: Pod) -> None:
+        key = pod.key()
+        if key in self._unschedulable:
+            del self._unschedulable[key]
+            self._delete_nominated(pod)
+        if key not in self._active_items:
+            heapq.heappush(self._active,
+                           (-get_pod_priority(pod), next(self._counter), key))
+        self._active_items[key] = pod
+        self._add_nominated(pod)
+
+    def add_if_not_present(self, pod: Pod) -> None:
+        key = pod.key()
+        if key in self._unschedulable or key in self._active_items:
+            return
+        self.add(pod)
+
+    def add_unschedulable_if_not_present(self, pod: Pod) -> None:
+        """scheduling_queue.go:214-235: park unless a move request arrived
+        while this pod was being scheduled."""
+        key = pod.key()
+        if key in self._unschedulable or key in self._active_items:
+            return
+        if self.received_move_request:
+            self.add(pod)
+        else:
+            self._unschedulable[key] = pod
+            self._add_nominated(pod)
+
+    def pop(self) -> Optional[Pod]:
+        while self._active:
+            _, _, key = heapq.heappop(self._active)
+            pod = self._active_items.pop(key, None)
+            if pod is not None:
+                self.received_move_request = False
+                return pod
+        return None
+
+    def update(self, pod: Pod) -> None:
+        key = pod.key()
+        if key in self._active_items:
+            self._active_items[key] = pod
+            return
+        if key in self._unschedulable:
+            # updates that may make the pod schedulable move it to active
+            del self._unschedulable[key]
+        self.add(pod)
+
+    def delete(self, pod: Pod) -> None:
+        key = pod.key()
+        self._delete_nominated(pod)
+        self._active_items.pop(key, None)
+        self._unschedulable.pop(key, None)
+
+    def move_all_to_active_queue(self) -> None:
+        for pod in list(self._unschedulable.values()):
+            key = pod.key()
+            if key not in self._active_items:
+                heapq.heappush(self._active,
+                               (-get_pod_priority(pod), next(self._counter), key))
+                self._active_items[key] = pod
+        self._unschedulable.clear()
+        self.received_move_request = True
+
+    def waiting_pods_for_node(self, node_name: str) -> List[Pod]:
+        return list(self._nominated.get(node_name, []))
+
+    def __len__(self) -> int:
+        return len(self._active_items) + len(self._unschedulable)
+
+
+def new_scheduling_queue(pod_priority_enabled: bool) -> SchedulingQueue:
+    """Reference: scheduling_queue.go:64-70."""
+    return PriorityQueue() if pod_priority_enabled else FIFO()

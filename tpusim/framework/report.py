@@ -18,12 +18,14 @@ from tpusim.api.types import RESOURCE_NVIDIA_GPU, Pod, is_scalar_resource_name
 
 @dataclass
 class Status:
-    """Reference: report.go:240-245."""
+    """Reference: report.go:240-245 (+ preempted_pods, an extension populated
+    only when the PodPriority gate is on)."""
 
     successful_pods: List[Pod] = field(default_factory=list)
     failed_pods: List[Pod] = field(default_factory=list)
     scheduled_pods: List[Pod] = field(default_factory=list)
     stop_reason: str = ""
+    preempted_pods: List[Pod] = field(default_factory=list)
 
 
 @dataclass

@@ -16,7 +16,10 @@ from typing import Dict, List, Optional
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.api.types import Node, Pod, PodCondition, ResourceType
+from tpusim.engine.equivalence import EquivalenceCache
 from tpusim.engine.generic_scheduler import FitError, GenericScheduler, SchedulingError
+from tpusim.engine.queue import new_scheduling_queue
+from tpusim.engine.util import PodBackoff
 from tpusim.engine.providers import (
     DEFAULT_PROVIDER,
     PluginFactoryArgs,
@@ -25,7 +28,7 @@ from tpusim.engine.providers import (
 from tpusim.engine.resources import NodeInfo
 from tpusim.framework.events import Recorder
 from tpusim.framework.report import GeneralReview, Status, get_report
-from tpusim.framework.store import ADDED, MODIFIED, PodQueue, ResourceStore
+from tpusim.framework.store import ADDED, DELETED, MODIFIED, PodQueue, ResourceStore
 from tpusim.framework.strategy import PredictiveStrategy
 
 DEFAULT_SCHEDULER_NAME = "TD-Scheduler"  # options.go:49
@@ -34,11 +37,15 @@ DEFAULT_SCHEDULER_NAME = "TD-Scheduler"  # options.go:49
 @dataclass
 class SchedulerServerConfig:
     """The slice of componentconfig.KubeSchedulerConfiguration the simulator
-    reads (options.go:47-61)."""
+    reads (options.go:47-61), plus the two feature gates the engine consults:
+    PodPriority (preemption; off by default like the reference's 1.10 gates,
+    scheduler.go:210-213) and EnableEquivalenceClassCache (simulator.go:369)."""
 
     scheduler_name: str = DEFAULT_SCHEDULER_NAME
     algorithm_provider: str = DEFAULT_PROVIDER
     hard_pod_affinity_symmetric_weight: int = 10
+    enable_pod_priority: bool = False
+    enable_equivalence_cache: bool = False
 
 
 class ClusterCapacity:
@@ -84,8 +91,17 @@ class ClusterCapacity:
             node_info_getter=lambda name: self.node_info_map.get(name),
             hard_pod_affinity_symmetric_weight=config.hard_pod_affinity_symmetric_weight,
         )
+        self.scheduling_queue = new_scheduling_queue(config.enable_pod_priority)
+        self.pod_backoff = PodBackoff()  # MakeDefaultErrorFunc's backoff state
         self.scheduler: GenericScheduler = create_from_provider(
             config.algorithm_provider, args)
+        self.scheduler.scheduling_queue = self.scheduling_queue
+        if config.enable_equivalence_cache:
+            self.scheduler.equivalence_cache = EquivalenceCache()
+        # PDBs come from the fake informer in the reference (empty,
+        # simulator.go:352-366) but can be injected for preemption studies
+        self.pdbs: list = []
+        self.scheduler.pdb_lister = lambda: list(self.pdbs)
 
     # --- cache event handlers ---
 
@@ -94,9 +110,26 @@ class ClusterCapacity:
             if pod.key() not in self._bound_keys:
                 self._bound_keys.add(pod.key())
                 self.node_info_map.setdefault(pod.spec.node_name, NodeInfo()).add_pod(pod)
+                self._invalidate_ecache_for_node(pod.spec.node_name)
+        elif event == DELETED and pod.key() in self._bound_keys:
+            self._bound_keys.discard(pod.key())
+            info = self.node_info_map.get(pod.spec.node_name)
+            if info is not None:
+                info.remove_pod(pod)
+            self._invalidate_ecache_for_node(pod.spec.node_name)
+
+    def _invalidate_ecache_for_node(self, node_name: str) -> None:
+        """The factory event handlers invalidate cached predicate results when
+        a node's pod set changes (factory.go:596-631 + ecache hooks); the
+        conservative whole-node invalidation keeps the cache correct."""
+        # handlers also fire during __init__ seeding, before the engine exists
+        scheduler = getattr(self, "scheduler", None)
+        if scheduler is not None and scheduler.equivalence_cache is not None:
+            scheduler.equivalence_cache.invalidate_all_on_node(node_name)
 
     def _on_node_event(self, event: str, node: Node) -> None:
         self.node_info_map.setdefault(node.name, NodeInfo()).set_node(node)
+        self._invalidate_ecache_for_node(node.name)
 
     # --- the two seams (simulator.go:108-185) ---
 
@@ -109,6 +142,8 @@ class ClusterCapacity:
         updated.spec.node_name = node_name
         updated.status.phase = "Running"
         self.strategy.add(updated)  # -> store.update -> Modified -> cache AddPod
+        self.scheduling_queue.delete(updated)
+        self.pod_backoff.clear_pod_backoff(updated.key())
         self.status.successful_pods.append(updated)
         self.recorder.eventf(updated, "Normal", "Scheduled",
                              "Successfully assigned %s to %s", pod.name, node_name)
@@ -122,6 +157,11 @@ class ClusterCapacity:
             pod.status.phase = "Pending"
             pod.status.conditions.append(condition)
             pod.status.reason = condition.reason
+            # MakeDefaultErrorFunc (factory.go:1259-1341): record backoff and
+            # park the pod in the unschedulable queue — its nominated-node
+            # state stays visible to later pods' feasibility double-pass
+            self.pod_backoff.get_backoff_time(pod.key())
+            self.scheduling_queue.add_unschedulable_if_not_present(pod)
             self.status.failed_pods.append(pod)
             self.recorder.eventf(pod, "Warning", "FailedScheduling", condition.message)
             self.recorder.drain_one()
@@ -135,12 +175,40 @@ class ClusterCapacity:
         self.resource_store.add(ResourceType.PODS, pod)
         return pod
 
-    def _schedule_one(self, pod: Pod) -> str:
+    def _schedule_one(self, pod: Pod, preempt_budget: int = 1) -> str:
         """Returns 'bound' or 'failed' — the seam whose deferred nextPod sets
-        the stop-reason string when the queue drains (simulator.go:136, :171)."""
+        the stop-reason string when the queue drains (simulator.go:136, :171).
+
+        With the PodPriority gate on, a FitError triggers the preemption
+        pipeline (scheduler.go:449-455): victims are deleted from the store
+        (mutating the cache through the DELETED event) and the pod retries —
+        synchronously here, since the one-pod-in-flight feed would pop it right
+        back anyway. Deviation from the reference, documented: the transient
+        Unschedulable condition the Go scheduler sets before a successful
+        preemption is not recorded in FailedPods."""
         try:
             host = self.scheduler.schedule(pod, self.nodes, self.node_info_map)
         except FitError as fit_err:
+            if self.config.enable_pod_priority and preempt_budget > 0:
+                node, victims, to_clear = self.scheduler.preempt(
+                    pod, self.nodes, self.node_info_map, fit_err)
+                for p in to_clear:
+                    p.status.nominated_node_name = ""
+                if node is not None:
+                    pod.status.nominated_node_name = node.name
+                    for victim in victims:
+                        self.resource_store.delete(ResourceType.PODS, victim)
+                        self.status.preempted_pods.append(victim)
+                        # an evicted pod is no longer placed: drop it from the
+                        # success/pre-scheduled buckets so the report balances
+                        key = victim.key()
+                        self.status.successful_pods = [
+                            p for p in self.status.successful_pods if p.key() != key]
+                        self.status.scheduled_pods = [
+                            p for p in self.status.scheduled_pods if p.key() != key]
+                        self.recorder.eventf(victim, "Normal", "Preempted",
+                                             "by %s on node %s", pod.name, node.name)
+                    return self._schedule_one(pod, preempt_budget - 1)
             # scheduler.go:190-201 error arm -> PodConditionUpdater.Update
             self.update(pod, PodCondition(type="PodScheduled", status="False",
                                           reason="Unschedulable",
@@ -197,7 +265,7 @@ def new_cluster_capacity(config: SchedulerServerConfig, new_pods: List[Pod],
 def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    provider: str = DEFAULT_PROVIDER, backend: str = "reference",
                    scheduler_name: str = DEFAULT_SCHEDULER_NAME,
-                   batch_size: int = 0) -> Status:
+                   batch_size: int = 0, enable_pod_priority: bool = False) -> Status:
     """High-level entry: run `pods` (in podspec order; the LIFO feed reversal
     happens inside, matching the reference) against `snapshot` and return the
     final Status. backend='jax' routes the batch through the TPU engine and
@@ -206,7 +274,8 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
     if backend == "reference":
         cc = ClusterCapacity(
             SchedulerServerConfig(scheduler_name=scheduler_name,
-                                  algorithm_provider=provider),
+                                  algorithm_provider=provider,
+                                  enable_pod_priority=enable_pod_priority),
             new_pods=pods, scheduled_pods=snapshot.pods, nodes=snapshot.nodes,
             services=snapshot.services)
         cc.run()

@@ -31,9 +31,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpusim.jaxe.kernels import Carry, PodX, Statics
 
 
-def make_mesh(n_devices: Optional[int] = None, snap: int = 1) -> Mesh:
+def make_mesh(n_devices: Optional[int] = None, snap: int = 1,
+              devices: Optional[list] = None) -> Mesh:
     """A ("snap", "node") mesh over the first n_devices devices."""
-    devices = jax.devices()[: (n_devices or len(jax.devices()))]
+    if devices is None:
+        devices = jax.devices()
+    devices = devices[: (n_devices or len(devices))]
     n = len(devices)
     if n % snap != 0:
         raise ValueError(f"{n} devices do not factor into snap={snap}")

@@ -1,0 +1,296 @@
+"""Scheduler extender tests.
+
+Reference behaviors pinned: core/extender.go:105-293 (Filter/Prioritize/Bind
+wire protocol, nodeCacheCapable encoding, IsInterested managed-resource gate),
+generic_scheduler.go:355-376 (filter failure append), :640-667 (prioritize
+merge, errors ignored), :842-874 (preemption re-filter with victims removed),
+factory.go:971-1000 (extender construction from policy, ignored resources).
+"""
+
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.engine.extender import ExtenderError, new_http_extender
+from tpusim.engine.policy import (
+    ExtenderConfig,
+    ExtenderManagedResource,
+    Policy,
+    PredicatePolicy,
+)
+from tpusim.engine.providers import PluginFactoryArgs, create_from_config
+from tpusim.engine.resources import NodeInfo
+from tpusim.simulator import SchedulerServerConfig, new_cluster_capacity
+
+
+def _nodes(n=3, **kwargs):
+    return [make_node(f"n{i}", milli_cpu=4000, memory=2**33, **kwargs)
+            for i in range(n)]
+
+
+def _info_map(nodes, pods=()):
+    infos = {}
+    for node in nodes:
+        info = NodeInfo()
+        info.set_node(node)
+        infos[node.name] = info
+    for pod in pods:
+        infos[pod.spec.node_name].add_pod(pod)
+    return infos
+
+
+class RecordingTransport:
+    """In-process transport: records calls, replies from a handler map."""
+
+    def __init__(self, handlers):
+        self.handlers = handlers
+        self.calls = []
+
+    def __call__(self, verb, args):
+        self.calls.append((verb, args))
+        handler = self.handlers[verb]
+        return handler(args) if callable(handler) else handler
+
+
+class TestFilter:
+    def test_filter_subsets_and_reports_failures(self):
+        nodes = _nodes(3)
+        transport = RecordingTransport({"filter": lambda args: {
+            "nodes": {"items": [n for n in args["nodes"]["items"]
+                                if n["metadata"]["name"] != "n1"]},
+            "failedNodes": {"n1": "extender says no"},
+        }})
+        ext = new_http_extender(
+            ExtenderConfig(url_prefix="http://e", filter_verb="filter"),
+            transport=transport)
+        filtered, failed = ext.filter(make_pod("p"), nodes, _info_map(nodes))
+        assert [n.name for n in filtered] == ["n0", "n2"]
+        assert failed == {"n1": "extender says no"}
+        # wire shape: full node objects when not nodeCacheCapable
+        verb, args = transport.calls[0]
+        assert verb == "filter"
+        assert args["nodeNames"] is None
+        assert len(args["nodes"]["items"]) == 3
+
+    def test_node_cache_capable_sends_names_only(self):
+        nodes = _nodes(2)
+        transport = RecordingTransport({"filter": lambda args: {
+            "nodeNames": [args["nodeNames"][0]]}})
+        ext = new_http_extender(
+            ExtenderConfig(url_prefix="http://e", filter_verb="filter",
+                           node_cache_capable=True),
+            transport=transport)
+        filtered, failed = ext.filter(make_pod("p"), nodes, _info_map(nodes))
+        assert [n.name for n in filtered] == ["n0"]
+        _, args = transport.calls[0]
+        assert args["nodes"] is None
+        assert args["nodeNames"] == ["n0", "n1"]
+
+    def test_no_filter_verb_passthrough(self):
+        nodes = _nodes(2)
+        ext = new_http_extender(ExtenderConfig(url_prefix="http://e"),
+                                transport=RecordingTransport({}))
+        filtered, failed = ext.filter(make_pod("p"), nodes, _info_map(nodes))
+        assert filtered == nodes and failed == {}
+
+    def test_error_result_raises(self):
+        nodes = _nodes(1)
+        ext = new_http_extender(
+            ExtenderConfig(url_prefix="http://e", filter_verb="filter"),
+            transport=RecordingTransport({"filter": {"error": "boom"}}))
+        with pytest.raises(ExtenderError, match="boom"):
+            ext.filter(make_pod("p"), nodes, _info_map(nodes))
+
+
+class TestPrioritizeBindInterest:
+    def test_prioritize_returns_scores_and_weight(self):
+        nodes = _nodes(2)
+        ext = new_http_extender(
+            ExtenderConfig(url_prefix="http://e", prioritize_verb="prioritize",
+                           weight=3),
+            transport=RecordingTransport({"prioritize": [
+                {"host": "n0", "score": 5}, {"host": "n1", "score": 2}]}))
+        scores, weight = ext.prioritize(make_pod("p"), nodes)
+        assert weight == 3
+        assert [(hp.host, hp.score) for hp in scores] == [("n0", 5), ("n1", 2)]
+
+    def test_prioritize_without_verb_scores_zero(self):
+        nodes = _nodes(2)
+        ext = new_http_extender(ExtenderConfig(url_prefix="http://e"),
+                                transport=RecordingTransport({}))
+        scores, weight = ext.prioritize(make_pod("p"), nodes)
+        assert weight == 0 and all(hp.score == 0 for hp in scores)
+
+    def test_bind_sends_binding_args(self):
+        transport = RecordingTransport({"bind": {}})
+        ext = new_http_extender(
+            ExtenderConfig(url_prefix="http://e", bind_verb="bind"),
+            transport=transport)
+        assert ext.is_binder()
+        ext.bind(make_pod("p"), "n0")
+        verb, args = transport.calls[0]
+        assert verb == "bind"
+        assert args["podName"] == "p" and args["node"] == "n0"
+
+    def test_is_interested_managed_resources(self):
+        config = ExtenderConfig(
+            url_prefix="http://e", filter_verb="filter",
+            managed_resources=[ExtenderManagedResource(name="example.com/foo")])
+        ext = new_http_extender(config, transport=RecordingTransport({}))
+        plain = make_pod("plain", milli_cpu=100)
+        assert not ext.is_interested(plain)
+        from tpusim.api.quantity import parse_quantity
+        fancy = make_pod("fancy", milli_cpu=100)
+        fancy.spec.containers[0].requests["example.com/foo"] = parse_quantity("1")
+        assert ext.is_interested(fancy)
+        # no managed resources → interested in everything
+        ext_all = new_http_extender(ExtenderConfig(url_prefix="http://e"),
+                                    transport=RecordingTransport({}))
+        assert ext_all.is_interested(plain)
+
+
+def _policy_with_extender(transport_handlers, **ext_kwargs):
+    return Policy(
+        predicates=[PredicatePolicy(name="PodFitsResources")],
+        priorities=[],
+        extender_configs=[ExtenderConfig(url_prefix="http://e", **ext_kwargs)])
+
+
+class TestEngineIntegration:
+    def test_extender_filter_in_scheduling(self):
+        """The extender vetoes all but one node; its failure message appears in
+        the FitError when everything is filtered out."""
+        transport = RecordingTransport({"filter": lambda args: {
+            "nodes": {"items": [n for n in args["nodes"]["items"]
+                                if n["metadata"]["name"] == "n2"]},
+            "failedNodes": {"n0": "gpu fragmentation", "n1": "gpu fragmentation"},
+        }})
+        policy = _policy_with_extender(None, filter_verb="filter")
+        config = SchedulerServerConfig(policy=policy,
+                                       extender_transport=transport)
+        cc = new_cluster_capacity(config, [make_pod("p", milli_cpu=100, memory=1)],
+                                  [], _nodes(3))
+        cc.run()
+        assert len(cc.status.successful_pods) == 1
+        assert cc.status.successful_pods[0].spec.node_name == "n2"
+
+    def test_extender_failure_reasons_in_report(self):
+        transport = RecordingTransport({"filter": lambda args: {
+            "nodes": {"items": []},
+            "failedNodes": {n["metadata"]["name"]: "extender vetoed"
+                            for n in args["nodes"]["items"]},
+        }})
+        policy = _policy_with_extender(None, filter_verb="filter")
+        config = SchedulerServerConfig(policy=policy,
+                                       extender_transport=transport)
+        cc = new_cluster_capacity(config, [make_pod("p", milli_cpu=100, memory=1)],
+                                  [], _nodes(2))
+        cc.run()
+        [failed] = cc.status.failed_pods
+        msg = failed.status.conditions[0].message
+        assert "extender vetoed" in msg
+
+    def test_extender_prioritize_steers_choice(self):
+        transport = RecordingTransport({"prioritize": lambda args: [
+            {"host": name, "score": 10 if name == "n1" else 0}
+            for name in (n["metadata"]["name"] for n in args["nodes"]["items"])]})
+        policy = Policy(
+            predicates=[PredicatePolicy(name="PodFitsResources")],
+            priorities=[],
+            extender_configs=[ExtenderConfig(url_prefix="http://e",
+                                             prioritize_verb="prioritize",
+                                             weight=2)])
+        config = SchedulerServerConfig(policy=policy,
+                                       extender_transport=transport)
+        cc = new_cluster_capacity(config, [make_pod("p", milli_cpu=100, memory=1)],
+                                  [], _nodes(3))
+        cc.run()
+        assert cc.status.successful_pods[0].spec.node_name == "n1"
+
+    def test_prioritize_errors_ignored(self):
+        def boom(args):
+            raise ExtenderError("down")
+        transport = RecordingTransport({"prioritize": boom})
+        policy = Policy(
+            predicates=[PredicatePolicy(name="PodFitsResources")],
+            priorities=[],
+            extender_configs=[ExtenderConfig(url_prefix="http://e",
+                                             prioritize_verb="prioritize",
+                                             weight=2)])
+        config = SchedulerServerConfig(policy=policy,
+                                       extender_transport=transport)
+        cc = new_cluster_capacity(config, [make_pod("p", milli_cpu=100, memory=1)],
+                                  [], _nodes(2))
+        cc.run()
+        assert len(cc.status.successful_pods) == 1  # scheduling still succeeds
+
+    def test_filter_transport_error_fails_pod_not_run(self):
+        """A filter transport failure marks the pod unschedulable; the
+        simulation itself survives (generic_scheduler.go:360-363 error arm →
+        scheduleOne → PodConditionUpdater)."""
+        def boom(args):
+            raise ExtenderError("connection refused")
+        policy = _policy_with_extender(None, filter_verb="filter")
+        config = SchedulerServerConfig(
+            policy=policy, extender_transport=RecordingTransport({"filter": boom}))
+        cc = new_cluster_capacity(
+            config,
+            [make_pod("p1", milli_cpu=100, memory=1),
+             make_pod("p2", milli_cpu=100, memory=1)],
+            [], _nodes(2))
+        cc.run()
+        assert len(cc.status.failed_pods) == 2
+        assert "connection refused" in cc.status.failed_pods[0].status.conditions[0].message
+
+    def test_prioritize_unknown_host_ignored(self):
+        transport = RecordingTransport({"prioritize": lambda args: [
+            {"host": "no-such-node", "score": 99}]})
+        policy = Policy(
+            predicates=[PredicatePolicy(name="PodFitsResources")],
+            priorities=[],
+            extender_configs=[ExtenderConfig(url_prefix="http://e",
+                                             prioritize_verb="prioritize",
+                                             weight=2)])
+        config = SchedulerServerConfig(policy=policy,
+                                       extender_transport=transport)
+        cc = new_cluster_capacity(config, [make_pod("p", milli_cpu=100, memory=1)],
+                                  [], _nodes(2))
+        cc.run()
+        assert len(cc.status.successful_pods) == 1
+
+    def test_ignored_extended_resources_skip_fit_check(self):
+        """A resource managed by an IgnoredByScheduler extender does not fail
+        PodFitsResources even though no node allocates it
+        (factory.go:984-988, predicates.go:754-761)."""
+        policy = Policy(
+            predicates=[PredicatePolicy(name="PodFitsResources")],
+            priorities=[],
+            extender_configs=[ExtenderConfig(
+                url_prefix="http://e",
+                managed_resources=[ExtenderManagedResource(
+                    name="example.com/foo", ignored_by_scheduler=True)])])
+        sched = create_from_config(policy, PluginFactoryArgs(),
+                                   extender_transport=RecordingTransport({}))
+        from tpusim.api.quantity import parse_quantity
+        pod = make_pod("p", milli_cpu=100, memory=1)
+        pod.spec.containers[0].requests["example.com/foo"] = parse_quantity("2")
+        nodes = _nodes(1)
+        fits, failed = sched.find_nodes_that_fit(pod, nodes, _info_map(nodes))
+        assert [n.name for n in fits] == ["n0"]
+
+    def test_preemption_extender_gate(self):
+        nodes = _nodes(1)
+        info_map = _info_map(nodes)
+        vetoes = RecordingTransport({"filter": lambda args: {
+            "nodes": {"items": []}, "failedNodes": {"n0": "no"}}})
+        policy = _policy_with_extender(None, filter_verb="filter")
+        sched = create_from_config(policy, PluginFactoryArgs(),
+                                   extender_transport=vetoes)
+        victim = make_pod("victim", milli_cpu=100, memory=1, node_name="n0",
+                          phase="Running")
+        info_map["n0"].add_pod(victim)
+        ok = sched._node_passes_extenders_for_preemption(
+            make_pod("p"), "n0", [victim], info_map)
+        assert ok is False
+        # and the victims really were removed for the extender's benefit
+        _, args = vetoes.calls[0]
+        assert args["nodes"]["items"][0]["metadata"]["name"] == "n0"

@@ -1,0 +1,227 @@
+"""Policy-as-data config tests.
+
+Reference behaviors pinned: api/types.go:52-160 Policy schema,
+api/validation/validation.go ValidatePolicy, factory.go CreateFromConfig:
+933-1000 (nil-vs-empty list semantics, custom predicate/priority args,
+policy weight override, HardPodAffinitySymmetricWeight precedence),
+simulator.go:383-424 (file + ConfigMap sourcing).
+"""
+
+import json
+
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.engine.policy import (
+    ExtenderConfig,
+    LabelsPresenceArg,
+    Policy,
+    PolicyError,
+    PredicateArgument,
+    PredicatePolicy,
+    PriorityArgument,
+    PriorityPolicy,
+    ServiceAntiAffinityArg,
+    decode_policy,
+    load_policy_file,
+    policy_from_configmap,
+    validate_policy,
+)
+from tpusim.engine.providers import PluginFactoryArgs, create_from_config
+from tpusim.simulator import SchedulerServerConfig, run_simulation
+
+POLICY_JSON = {
+    "kind": "Policy",
+    "apiVersion": "v1",
+    "predicates": [
+        {"name": "PodFitsResources"},
+        {"name": "TestLabelsPresence",
+         "argument": {"labelsPresence": {"labels": ["zone"], "presence": True}}},
+    ],
+    "priorities": [
+        {"name": "LeastRequestedPriority", "weight": 2},
+        {"name": "RackSpread", "weight": 1,
+         "argument": {"serviceAntiAffinity": {"label": "rack"}}},
+    ],
+    "hardPodAffinitySymmetricWeight": 30,
+    "alwaysCheckAllPredicates": True,
+}
+
+
+class TestDecode:
+    def test_decode_full_policy(self):
+        policy = decode_policy(POLICY_JSON)
+        assert [p.name for p in policy.predicates] == [
+            "PodFitsResources", "TestLabelsPresence"]
+        assert policy.predicates[1].argument.labels_presence.labels == ["zone"]
+        assert policy.predicates[1].argument.labels_presence.presence is True
+        assert policy.priorities[0].weight == 2
+        assert policy.priorities[1].argument.service_anti_affinity.label == "rack"
+        assert policy.hard_pod_affinity_symmetric_weight == 30
+        assert policy.always_check_all_predicates is True
+
+    def test_nil_vs_empty_lists(self):
+        # absent → None (provider defaults); [] → empty (bypass)
+        p = decode_policy({"kind": "Policy"})
+        assert p.predicates is None and p.priorities is None
+        p = decode_policy({"kind": "Policy", "predicates": [], "priorities": []})
+        assert p.predicates == [] and p.priorities == []
+
+    def test_wrong_kind_rejected(self):
+        with pytest.raises(PolicyError):
+            decode_policy({"kind": "ConfigMap"})
+
+    def test_load_policy_file_json(self, tmp_path):
+        path = tmp_path / "policy.json"
+        path.write_text(json.dumps(POLICY_JSON))
+        policy = load_policy_file(str(path))
+        assert policy.priorities[0].name == "LeastRequestedPriority"
+
+    def test_load_policy_file_yaml(self, tmp_path):
+        import yaml
+        path = tmp_path / "policy.yaml"
+        path.write_text(yaml.safe_dump(POLICY_JSON))
+        assert load_policy_file(str(path)).hard_pod_affinity_symmetric_weight == 30
+
+    def test_policy_from_configmap(self):
+        cm = {"kind": "ConfigMap",
+              "data": {"policy.cfg": json.dumps(POLICY_JSON)}}
+        assert policy_from_configmap(cm).always_check_all_predicates is True
+
+    def test_configmap_missing_key(self):
+        with pytest.raises(PolicyError, match="policy.cfg"):
+            policy_from_configmap({"kind": "ConfigMap", "data": {}})
+
+    def test_malformed_file_raises_policy_error(self, tmp_path):
+        path = tmp_path / "bad.yaml"
+        path.write_text("{predicates: [")  # invalid JSON and invalid YAML
+        with pytest.raises(PolicyError):
+            load_policy_file(str(path))
+        listy = tmp_path / "list.yaml"
+        listy.write_text("- a\n- b\n")  # parses, but not a mapping
+        with pytest.raises(PolicyError):
+            load_policy_file(str(listy))
+
+    def test_configmap_file_loader(self, tmp_path):
+        from tpusim.engine.policy import load_policy_configmap_file
+        path = tmp_path / "cm.json"
+        path.write_text(json.dumps(
+            {"kind": "ConfigMap", "data": {"policy.cfg": json.dumps(POLICY_JSON)}}))
+        assert load_policy_configmap_file(str(path)).hard_pod_affinity_symmetric_weight == 30
+        empty = tmp_path / "empty.yaml"
+        empty.write_text("")
+        with pytest.raises(PolicyError):
+            load_policy_configmap_file(str(empty))
+
+
+class TestValidation:
+    def test_nonpositive_priority_weight(self):
+        policy = Policy(priorities=[PriorityPolicy(name="x", weight=0)])
+        with pytest.raises(PolicyError, match="positive weight"):
+            validate_policy(policy)
+
+    def test_extender_prioritize_needs_weight(self):
+        policy = Policy(extender_configs=[
+            ExtenderConfig(url_prefix="http://e", prioritize_verb="prioritize")])
+        with pytest.raises(PolicyError, match="positive weight"):
+            validate_policy(policy)
+
+    def test_only_one_binder(self):
+        policy = Policy(extender_configs=[
+            ExtenderConfig(url_prefix="http://a", bind_verb="bind"),
+            ExtenderConfig(url_prefix="http://b", bind_verb="bind")])
+        with pytest.raises(PolicyError, match="one extender can implement bind"):
+            validate_policy(policy)
+
+
+def _sched(policy):
+    return create_from_config(policy, PluginFactoryArgs())
+
+
+class TestCreateFromConfig:
+    def test_explicit_predicates_only(self):
+        policy = Policy(predicates=[PredicatePolicy(name="PodFitsResources")],
+                        priorities=[])
+        sched = _sched(policy)
+        # mandatory CheckNodeCondition is always included (plugins.go:176-185)
+        assert set(sched.predicates) == {"PodFitsResources", "CheckNodeCondition"}
+        assert sched.prioritizers == []
+
+    def test_nil_lists_use_default_provider(self):
+        sched = _sched(Policy())
+        assert "GeneralPredicates" in sched.predicates
+        assert any(c.name == "LeastRequestedPriority" for c in sched.prioritizers)
+
+    def test_unknown_predicate_rejected(self):
+        with pytest.raises(KeyError, match="Predicate type not found"):
+            _sched(Policy(predicates=[PredicatePolicy(name="NoSuchPredicate")]))
+
+    def test_priority_weight_override(self):
+        policy = Policy(predicates=[],
+                        priorities=[PriorityPolicy(name="LeastRequestedPriority",
+                                                   weight=7)])
+        sched = _sched(policy)
+        [config] = sched.prioritizers
+        assert config.weight == 7
+
+    def test_labels_presence_predicate(self):
+        policy = Policy(
+            predicates=[PredicatePolicy(
+                name="ZoneRequired",
+                argument=PredicateArgument(
+                    labels_presence=LabelsPresenceArg(labels=["zone"],
+                                                      presence=True)))],
+            priorities=[])
+        sched = _sched(policy)
+        assert "ZoneRequired" in sched.predicates
+        node_ok = make_node("a", milli_cpu=1000, memory=2**30,
+                            labels={"zone": "z1"})
+        node_bad = make_node("b", milli_cpu=1000, memory=2**30)
+        snapshot = ClusterSnapshot(nodes=[node_ok, node_bad])
+        status = run_simulation([make_pod("p", milli_cpu=100, memory=1)],
+                                snapshot, policy=policy)
+        assert len(status.successful_pods) == 1
+        assert status.successful_pods[0].spec.node_name == "a"
+        # and with no zone-labeled node at all, the custom predicate vetoes
+        # everything (1.11 semantics; the 1.10 vintage silently skipped
+        # custom-named predicates — see pod_fits_on_node)
+        status = run_simulation([make_pod("p2", milli_cpu=100, memory=1)],
+                                ClusterSnapshot(nodes=[node_bad]), policy=policy)
+        assert len(status.failed_pods) == 1
+
+    def test_service_anti_affinity_spreads_by_label(self):
+        # two racks; rack r1 already hosts the service's pod → new pod → r2
+        policy = Policy(
+            predicates=[PredicatePolicy(name="PodFitsResources")],
+            priorities=[PriorityPolicy(
+                name="RackSpread", weight=1,
+                argument=PriorityArgument(
+                    service_anti_affinity=ServiceAntiAffinityArg(label="rack")))])
+        nodes = [make_node("n1", milli_cpu=4000, memory=2**33, labels={"rack": "r1"}),
+                 make_node("n2", milli_cpu=4000, memory=2**33, labels={"rack": "r2"})]
+        existing = make_pod("svc-1", milli_cpu=100, memory=1, node_name="n1",
+                            phase="Running", labels={"app": "web"})
+        from tpusim.api.types import Service
+        svc = Service.from_obj({
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"selector": {"app": "web"}}})
+        snapshot = ClusterSnapshot(nodes=nodes, pods=[existing], services=[svc])
+        new_pod = make_pod("svc-2", milli_cpu=100, memory=1,
+                           labels={"app": "web"})
+        status = run_simulation([new_pod], snapshot, policy=policy)
+        assert len(status.successful_pods) == 1
+        assert status.successful_pods[0].spec.node_name == "n2"
+
+    def test_policy_requires_reference_backend(self):
+        snapshot = ClusterSnapshot(nodes=[make_node("n", milli_cpu=1000,
+                                                    memory=2**30)])
+        with pytest.raises(ValueError, match="reference backend"):
+            run_simulation([make_pod("p", milli_cpu=1, memory=1)], snapshot,
+                           backend="jax", policy=Policy())
+
+    def test_always_check_all_predicates_reports_all_failures(self):
+        # a pod too big on CPU AND memory: with the flag, both reasons appear
+        policy = Policy(predicates=[PredicatePolicy(name="PodFitsResources")],
+                        priorities=[], always_check_all_predicates=True)
+        sched = _sched(policy)
+        assert sched.always_check_all_predicates is True

@@ -42,6 +42,14 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--algorithmprovider", default="DefaultProvider",
                         help="DefaultProvider | ClusterAutoscalerProvider | "
                              "TalkintDataProvider")
+    # AlgorithmSource.Policy analog (simulator.go:383-424): policy from a
+    # serialized file, or from a ConfigMap object saved as JSON/YAML
+    parser.add_argument("--scheduler-policy-file", default="",
+                        help="schedulerapi/v1 Policy file (kind: Policy) "
+                             "overriding the algorithm provider")
+    parser.add_argument("--scheduler-policy-configmap-file", default="",
+                        help="ConfigMap object (JSON/YAML) carrying the policy "
+                             "under data['policy.cfg']")
     parser.add_argument("--namespace", default="default",
                         help="Namespace stamped onto simulated pods")
     # new flags (BASELINE.json)
@@ -114,6 +122,30 @@ def main(argv=None) -> int:
         return 2
     pods = expand_simulation_pods(sim_pods, namespace=args.namespace)
 
+    policy = None
+    if args.scheduler_policy_file or args.scheduler_policy_configmap_file:
+        from tpusim.engine.policy import (
+            PolicyError,
+            load_policy_configmap_file,
+            load_policy_file,
+        )
+        try:
+            if args.scheduler_policy_file:
+                policy = load_policy_file(args.scheduler_policy_file)
+            else:
+                policy = load_policy_configmap_file(
+                    args.scheduler_policy_configmap_file)
+        except (OSError, PolicyError) as exc:
+            print(f"error: invalid scheduler policy: {exc}", file=sys.stderr)
+            return 2
+        if args.backend != "reference":
+            flag = ("--scheduler-policy-file" if args.scheduler_policy_file
+                    else "--scheduler-policy-configmap-file")
+            print(f"error: {flag} requires --backend reference "
+                  "(policies can add extenders and custom predicates that are "
+                  "not batched)", file=sys.stderr)
+            return 2
+
     if args.batch_size and args.backend != "jax":
         print("error: --batch-size requires --backend jax", file=sys.stderr)
         return 2
@@ -123,9 +155,14 @@ def main(argv=None) -> int:
         return 2
 
     start = time.perf_counter()
-    status = run_simulation(pods, snapshot, provider=args.algorithmprovider,
-                            backend=args.backend, batch_size=args.batch_size,
-                            enable_pod_priority=args.enable_pod_priority)
+    try:
+        status = run_simulation(pods, snapshot, provider=args.algorithmprovider,
+                                backend=args.backend, batch_size=args.batch_size,
+                                enable_pod_priority=args.enable_pod_priority,
+                                policy=policy)
+    except ValueError as exc:  # invalid policy/provider surfaced at build time
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
     elapsed = time.perf_counter() - start
 
     report = get_report(status)

@@ -20,7 +20,7 @@ from typing import Callable, Dict, List, Optional
 from tpusim.api.types import Node, Pod
 from tpusim.engine import errors as err
 from tpusim.engine.equivalence import get_equivalence_hash
-from tpusim.engine.errors import PredicateFailureReason
+from tpusim.engine.errors import FailureReason, PredicateFailureReason
 from tpusim.engine.predicates import (
     PREDICATES_ORDERING,
     PredicateMetadata,
@@ -98,6 +98,14 @@ class GenericScheduler:
         self.scheduling_queue = scheduling_queue
         self.pdb_lister = pdb_lister or (lambda: [])
         self.last_node_index = 0  # persistent round-robin counter (:97)
+        # Ordered keys first; then custom (policy-registered) keys that are not
+        # in the fixed ordering, alphabetically. DELIBERATE DEVIATION: the
+        # reference vintage iterates only predicates.Ordering()
+        # (generic_scheduler.go:467), silently skipping custom policy
+        # predicates — a known kube bug fixed in 1.11 by evaluating the extra
+        # keys; reproducing it would make PredicateArgument configs dead weight.
+        self._predicate_key_order = list(PREDICATES_ORDERING) + sorted(
+            k for k in self.predicates if k not in PREDICATES_ORDERING)
 
     # --- filter phase ---
 
@@ -139,7 +147,7 @@ class GenericScheduler:
             elif not pods_added or fails:
                 break
             ecache_available = ecache is not None and not pods_added
-            for pred_key in PREDICATES_ORDERING:
+            for pred_key in self._predicate_key_order:
                 predicate = self.predicates.get(pred_key)
                 if predicate is None:
                     continue
@@ -173,10 +181,23 @@ class GenericScheduler:
                 else:
                     failed[node.name] = fails
         if filtered and self.extenders:
+            # extender filters run after the built-in predicates; failures are
+            # appended as plain-message reasons (generic_scheduler.go:355-376)
             for extender in self.extenders:
-                filtered, failed_map = extender.filter(pod, filtered, node_info_map)
-                for name, reason in failed_map.items():
-                    failed[name] = [reason]
+                if not extender.is_interested(pod):
+                    continue
+                try:
+                    filtered, failed_map = extender.filter(pod, filtered,
+                                                           node_info_map)
+                except SchedulingError:
+                    raise
+                except Exception as exc:
+                    # a filter transport/result error fails this pod's
+                    # scheduling attempt, never the whole simulation
+                    # (generic_scheduler.go:360-363 → scheduleOne error arm)
+                    raise SchedulingError(f"extender filter failed: {exc}")
+                for name, msg in failed_map.items():
+                    failed.setdefault(name, []).append(FailureReason(msg))
                 if not filtered:
                     break
         return filtered, failed
@@ -213,11 +234,22 @@ class GenericScheduler:
             result.append(HostPriority(node.name, total))
 
         if self.extenders:
+            # extender prioritize errors are ignored — k8s/other extenders
+            # determine the priorities (generic_scheduler.go:649-653)
             combined = {hp.host: hp.score for hp in result}
             for extender in self.extenders:
-                prioritized_list, weight = extender.prioritize(pod, nodes)
+                if not extender.is_interested(pod):
+                    continue
+                try:
+                    prioritized_list, weight = extender.prioritize(pod, nodes)
+                except Exception:
+                    continue
                 for hp in prioritized_list:
-                    combined[hp.host] += hp.score * weight
+                    # hosts outside the candidate list are harmless, matching
+                    # the Go map semantics (combinedScores auto-zeroes and is
+                    # only read back for candidate hosts)
+                    if hp.host in combined:
+                        combined[hp.host] += hp.score * weight
             result = [HostPriority(n.name, combined[n.name]) for n in nodes]
         return result
 
@@ -454,14 +486,34 @@ class GenericScheduler:
 
     def _node_passes_extenders_for_preemption(self, pod, node_name, victims,
                                               node_info_map) -> bool:
-        for extender in self.extenders:
-            supports = getattr(extender, "supports_preemption", False)
-            if not supports:
-                continue
-            if not extender.process_preemption(pod, node_name, victims,
-                                               node_info_map):
-                return False
-        return True
+        """nodePassesExtendersForPreemption (generic_scheduler.go:842-874):
+        re-run each extender's Filter on the node with the victims removed."""
+        if not self.extenders:
+            return True
+        original = node_info_map[node_name]
+        info_copy = original.clone()
+        for victim in victims:
+            info_copy.remove_pod(victim)
+        node_info_map[node_name] = info_copy
+        try:
+            filtered = [info_copy.node]
+            for extender in self.extenders:
+                if not extender.is_interested(pod):
+                    continue
+                try:
+                    filtered, failed_map = extender.filter(pod, filtered,
+                                                           node_info_map)
+                except Exception as exc:
+                    # same per-pod containment as the filter phase: an
+                    # extender error fails this preemption attempt, not the
+                    # whole simulation
+                    raise SchedulingError(
+                        f"extender filter failed during preemption: {exc}")
+                if node_name in failed_map or not filtered:
+                    return False
+            return True
+        finally:
+            node_info_map[node_name] = original
 
     def _get_lower_priority_nominated_pods(self, pod: Pod,
                                            node_name: str) -> List[Pod]:

@@ -90,6 +90,10 @@ class PredicateMetadata:
     # existing-pod full name -> [MatchingAntiAffinityTerm] whose selector matched self.pod
     matching_anti_affinity_terms: Dict[str, List[MatchingAntiAffinityTerm]] = field(
         default_factory=dict)
+    # extended resources managed (and ignored) by an extender
+    # (RegisterPredicateMetadataProducerWithExtendedResourceOptions,
+    # predicates.go:718-725)
+    ignored_extended_resources: Optional[set] = None
 
     def add_pod(self, added_pod: Pod, node: Node) -> None:
         """metadata.go AddPod — incremental update for preemption simulations."""
@@ -182,7 +186,9 @@ def get_matching_anti_affinity_terms(
 
 
 def get_predicate_metadata(pod: Pod,
-                           node_info_map: Dict[str, NodeInfo]) -> PredicateMetadata:
+                           node_info_map: Dict[str, NodeInfo],
+                           ignored_extended_resources: Optional[set] = None
+                           ) -> PredicateMetadata:
     """The PredicateMetadataProducer (metadata.go:47-75)."""
     return PredicateMetadata(
         pod=pod,
@@ -190,6 +196,7 @@ def get_predicate_metadata(pod: Pod,
         pod_request=get_resource_request(pod),
         pod_ports=get_container_ports(pod),
         matching_anti_affinity_terms=get_matching_anti_affinity_terms(pod, node_info_map),
+        ignored_extended_resources=ignored_extended_resources,
     )
 
 
@@ -229,7 +236,12 @@ def pod_fits_resources(pod: Pod, meta, node_info: NodeInfo) -> PredicateResult:
         fails.append(err.InsufficientResourceError(
             RESOURCE_EPHEMERAL_STORAGE, pod_request.ephemeral_storage,
             used.ephemeral_storage, alloc.ephemeral_storage))
+    ignored = getattr(meta, "ignored_extended_resources", None) or set()
     for name, quant in pod_request.scalar.items():
+        # extended resources managed by an IgnoredByScheduler extender are
+        # skipped (predicates.go:754-761)
+        if "/" in name and name in ignored:
+            continue
         if alloc.scalar.get(name, 0) < quant + used.scalar.get(name, 0):
             fails.append(err.InsufficientResourceError(
                 name, quant, used.scalar.get(name, 0), alloc.scalar.get(name, 0)))

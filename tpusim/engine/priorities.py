@@ -408,6 +408,85 @@ class SelectorSpread:
 
 
 # ---------------------------------------------------------------------------
+# service anti-affinity (selector_spreading.go:176-280; policy-configured via
+# PriorityArgument.ServiceAntiAffinity)
+# ---------------------------------------------------------------------------
+
+
+class ServiceAntiAffinity:
+    """Spread pods of the first matching service across node groups identified
+    by a node label (selector_spreading.go:176-280)."""
+
+    def __init__(self, pod_lister, service_lister, label: str):
+        self.pod_lister = pod_lister        # () -> [Pod] (unused; node_info has pods)
+        self.service_lister = service_lister  # () -> [Service]
+        self.label = label
+
+    def _first_service_selector(self, pod: Pod) -> Optional[dict]:
+        """getFirstServiceSelector — selector of the first service whose
+        selector matches the pod's labels, in lister order."""
+        for svc in self.service_lister():
+            if (svc.namespace == pod.namespace and svc.selector
+                    and all(pod.metadata.labels.get(k) == v
+                            for k, v in svc.selector.items())):
+                return dict(svc.selector)
+        return None
+
+    def calculate_anti_affinity_priority_map(self, pod: Pod, meta,
+                                             node_info: NodeInfo) -> HostPriority:
+        """Score = count of same-namespace pods on this node matching the
+        pod's first-service selector (selector_spreading.go:223-244)."""
+        node = node_info.node
+        if node is None:
+            raise ValueError("node not found")
+        selector = self._first_service_selector(pod)
+        if selector is None:
+            return HostPriority(node.name, 0)
+        count = sum(
+            1 for node_pod in node_info.pods
+            if node_pod.namespace == pod.namespace
+            and all(node_pod.metadata.labels.get(k) == v
+                    for k, v in selector.items()))
+        return HostPriority(node.name, count)
+
+    def calculate_anti_affinity_priority_reduce(self, pod: Pod, meta,
+                                                node_info_map: Dict[str, NodeInfo],
+                                                result: List[HostPriority]) -> None:
+        """Nodes without the label score 0; labeled nodes score
+        MaxPriority * (total - podsInGroup) / total (selector_spreading.go:
+        246-280)."""
+        num_service_pods = 0
+        pod_counts: Dict[str, int] = {}
+        label_of_host: Dict[str, str] = {}
+        for hp in result:
+            num_service_pods += hp.score
+            info = node_info_map.get(hp.host)
+            node = info.node if info else None
+            if node is None or self.label not in node.metadata.labels:
+                continue
+            label = node.metadata.labels[self.label]
+            label_of_host[hp.host] = label
+            pod_counts[label] = pod_counts.get(label, 0) + hp.score
+        for hp in result:
+            label = label_of_host.get(hp.host)
+            if label is None:
+                hp.score = 0
+                continue
+            f_score = float(MAX_PRIORITY)
+            if num_service_pods > 0:
+                f_score = MAX_PRIORITY * (
+                    (num_service_pods - pod_counts[label]) / num_service_pods)
+            hp.score = int(f_score)
+
+
+def make_service_anti_affinity_priority(pod_lister, service_lister, label: str):
+    """NewServiceAntiAffinityPriority (selector_spreading.go:183-192)."""
+    anti = ServiceAntiAffinity(pod_lister, service_lister, label)
+    return (anti.calculate_anti_affinity_priority_map,
+            anti.calculate_anti_affinity_priority_reduce)
+
+
+# ---------------------------------------------------------------------------
 # inter-pod affinity priority (interpod_affinity.go:118+, legacy Function form)
 # ---------------------------------------------------------------------------
 

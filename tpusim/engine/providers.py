@@ -36,6 +36,9 @@ class PluginFactoryArgs:
     stateful_set_lister: Callable[[], list] = field(default=lambda: [])
     node_info_getter: Callable[[str], object] = field(default=lambda name: None)
     hard_pod_affinity_symmetric_weight: int = DEFAULT_HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
+    # extended resources ignored in PodFitsResources because an extender
+    # manages them (factory.go:984-988)
+    ignored_extended_resources: Optional[Set[str]] = None
 
     def selector_spread(self) -> "prios.SelectorSpread":
         """One shared SelectorSpread per factory args, so the map/reduce fns and
@@ -264,15 +267,140 @@ def create_from_provider(provider: str, args: PluginFactoryArgs,
     """factory.go CreateFromProvider → CreateFromKeys."""
     registry = registry or default_registry()
     pred_keys, pri_keys = registry.get_algorithm_provider(provider)
+    return _create_from_keys(registry, pred_keys, pri_keys, args,
+                             always_check_all_predicates=always_check_all_predicates)
+
+
+def _create_from_keys(registry: AlgorithmRegistry, pred_keys: Set[str],
+                      pri_keys: Set[str], args: PluginFactoryArgs,
+                      extenders: Optional[list] = None,
+                      always_check_all_predicates: bool = False) -> GenericScheduler:
+    """factory.go CreateFromKeys:1021-1082."""
     predicates = registry.build_predicates(pred_keys, args)
     prioritizers = registry.build_prioritizers(pri_keys, args)
 
     def priority_meta_producer(pod):
         return prios.get_priority_metadata(pod, args.selector_spread())
 
+    def predicate_meta_producer(pod, node_info_map):
+        return preds.get_predicate_metadata(
+            pod, node_info_map,
+            ignored_extended_resources=args.ignored_extended_resources)
+
     return GenericScheduler(
         predicates=predicates,
         prioritizers=prioritizers,
+        predicate_meta_producer=predicate_meta_producer,
         priority_meta_producer=priority_meta_producer,
+        extenders=extenders,
         always_check_all_predicates=always_check_all_predicates,
     )
+
+
+# ---------------------------------------------------------------------------
+# policy-as-data assembly (factory.go CreateFromConfig:933-1000,
+# plugins.go RegisterCustomFitPredicate:197-240 /
+# RegisterCustomPriorityFunction:302-348)
+# ---------------------------------------------------------------------------
+
+
+def register_custom_fit_predicate(registry: AlgorithmRegistry,
+                                  pred_policy) -> str:
+    """plugins.go RegisterCustomFitPredicate:197-240: a policy entry either
+    instantiates a parameterized predicate (ServiceAffinity / LabelsPresence)
+    under the policy's name, or references a pre-registered predicate."""
+    arg = pred_policy.argument
+    if arg is not None:
+        if arg.service_affinity is not None:
+            labels = list(arg.service_affinity.labels)
+            factory = lambda args: preds.make_service_affinity_predicate(  # noqa: E731
+                labels, args.pod_lister, args.service_lister,
+                args.node_info_getter)
+            return registry.register_fit_predicate_factory(pred_policy.name, factory)
+        if arg.labels_presence is not None:
+            labels = list(arg.labels_presence.labels)
+            presence = arg.labels_presence.presence
+            factory = lambda args: preds.make_node_label_presence_predicate(  # noqa: E731
+                labels, presence)
+            return registry.register_fit_predicate_factory(pred_policy.name, factory)
+    if pred_policy.name in registry.fit_predicates \
+            or pred_policy.name in registry.fit_predicate_factories:
+        return pred_policy.name  # pre-defined predicate requested: reuse
+    raise KeyError("Invalid configuration: Predicate type not found for "
+                   f"{pred_policy.name}")
+
+
+def register_custom_priority_function(registry: AlgorithmRegistry,
+                                      pri_policy) -> str:
+    """plugins.go RegisterCustomPriorityFunction:302-348."""
+    arg = pri_policy.argument
+    factory: Optional[PriorityConfigFactory] = None
+    if arg is not None:
+        if arg.service_anti_affinity is not None:
+            label = arg.service_anti_affinity.label
+            factory = PriorityConfigFactory(
+                map_reduce_function=lambda args, label=label:
+                    prios.make_service_anti_affinity_priority(
+                        args.pod_lister, args.service_lister, label),
+                weight=pri_policy.weight)
+        elif arg.label_preference is not None:
+            label = arg.label_preference.label
+            presence = arg.label_preference.presence
+            factory = PriorityConfigFactory(
+                map_reduce_function=lambda args, label=label, presence=presence:
+                    (prios.make_node_label_priority_map(label, presence), None),
+                weight=pri_policy.weight)
+    elif pri_policy.name in registry.priority_factories:
+        existing = registry.priority_factories[pri_policy.name]
+        # reuse the registered function, but take the policy's weight
+        factory = PriorityConfigFactory(
+            map_reduce_function=existing.map_reduce_function,
+            function=existing.function, weight=pri_policy.weight)
+    if factory is None:
+        raise KeyError("Invalid configuration: Priority type not found for "
+                       f"{pri_policy.name}")
+    return registry.register_priority_config_factory(pri_policy.name, factory)
+
+
+def create_from_config(policy, args: PluginFactoryArgs,
+                       registry: Optional[AlgorithmRegistry] = None,
+                       extender_transport=None) -> GenericScheduler:
+    """factory.go CreateFromConfig:933-1000.
+
+    policy.predicates None → DefaultProvider predicate keys; [] → mandatory
+    only. policy.priorities None → DefaultProvider priority keys; [] → none.
+    Extenders are built from ExtenderConfigs; a policy-provided
+    HardPodAffinitySymmetricWeight overrides the CLI/config value, and
+    AlwaysCheckAllPredicates can only be switched on, never off.
+    """
+    from tpusim.engine.extender import new_http_extender
+    from tpusim.engine.policy import validate_policy
+
+    validate_policy(policy)
+    registry = registry or default_registry()
+
+    if policy.predicates is None:
+        pred_keys, _ = registry.get_algorithm_provider(DEFAULT_PROVIDER)
+    else:
+        pred_keys = {register_custom_fit_predicate(registry, p)
+                     for p in policy.predicates}
+    if policy.priorities is None:
+        _, pri_keys = registry.get_algorithm_provider(DEFAULT_PROVIDER)
+    else:
+        pri_keys = {register_custom_priority_function(registry, p)
+                    for p in policy.priorities}
+
+    extenders = [new_http_extender(cfg, transport=extender_transport)
+                 for cfg in policy.extender_configs]
+    # predicates skip resources ignored by an extender (factory.go:984-988)
+    ignored = {r.name for cfg in policy.extender_configs
+               for r in cfg.managed_resources if r.ignored_by_scheduler}
+    if ignored:
+        args.ignored_extended_resources = ignored
+
+    if policy.hard_pod_affinity_symmetric_weight != 0:
+        args.hard_pod_affinity_symmetric_weight = \
+            policy.hard_pod_affinity_symmetric_weight
+    return _create_from_keys(
+        registry, pred_keys, pri_keys, args, extenders=extenders,
+        always_check_all_predicates=policy.always_check_all_predicates)

@@ -20,9 +20,11 @@ from tpusim.engine.equivalence import EquivalenceCache
 from tpusim.engine.generic_scheduler import FitError, GenericScheduler, SchedulingError
 from tpusim.engine.queue import new_scheduling_queue
 from tpusim.engine.util import PodBackoff
+from tpusim.engine.policy import Policy
 from tpusim.engine.providers import (
     DEFAULT_PROVIDER,
     PluginFactoryArgs,
+    create_from_config,
     create_from_provider,
 )
 from tpusim.engine.resources import NodeInfo
@@ -43,6 +45,10 @@ class SchedulerServerConfig:
 
     scheduler_name: str = DEFAULT_SCHEDULER_NAME
     algorithm_provider: str = DEFAULT_PROVIDER
+    # AlgorithmSource.Policy analog (simulator.go:383-424): when set, the
+    # scheduler is built from the policy instead of the named provider
+    policy: Optional[Policy] = None
+    extender_transport: Optional[object] = None  # in-process extender seam
     hard_pod_affinity_symmetric_weight: int = 10
     enable_pod_priority: bool = False
     enable_equivalence_cache: bool = False
@@ -93,8 +99,15 @@ class ClusterCapacity:
         )
         self.scheduling_queue = new_scheduling_queue(config.enable_pod_priority)
         self.pod_backoff = PodBackoff()  # MakeDefaultErrorFunc's backoff state
-        self.scheduler: GenericScheduler = create_from_provider(
-            config.algorithm_provider, args)
+        if config.policy is not None:
+            # AlgorithmSource.Policy path (simulator.go:383-424 →
+            # factory.go CreateFromConfig)
+            self.scheduler: GenericScheduler = create_from_config(
+                config.policy, args,
+                extender_transport=config.extender_transport)
+        else:
+            self.scheduler = create_from_provider(
+                config.algorithm_provider, args)
         self.scheduler.scheduling_queue = self.scheduling_queue
         if config.enable_equivalence_cache:
             self.scheduler.equivalence_cache = EquivalenceCache()
@@ -265,16 +278,21 @@ def new_cluster_capacity(config: SchedulerServerConfig, new_pods: List[Pod],
 def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    provider: str = DEFAULT_PROVIDER, backend: str = "reference",
                    scheduler_name: str = DEFAULT_SCHEDULER_NAME,
-                   batch_size: int = 0, enable_pod_priority: bool = False) -> Status:
+                   batch_size: int = 0, enable_pod_priority: bool = False,
+                   policy: Optional[Policy] = None) -> Status:
     """High-level entry: run `pods` (in podspec order; the LIFO feed reversal
     happens inside, matching the reference) against `snapshot` and return the
     final Status. backend='jax' routes the batch through the TPU engine and
     reconstructs the same Status/report shape; batch_size>0 selects the jax
     backend's wavefront mode."""
+    if policy is not None and backend != "reference":
+        raise ValueError("scheduler policy configs (custom predicate/priority "
+                         "sets, extenders) run on the reference backend")
     if backend == "reference":
         cc = ClusterCapacity(
             SchedulerServerConfig(scheduler_name=scheduler_name,
                                   algorithm_provider=provider,
+                                  policy=policy,
                                   enable_pod_priority=enable_pod_priority),
             new_pods=pods, scheduled_pods=snapshot.pods, nodes=snapshot.nodes,
             services=snapshot.services)

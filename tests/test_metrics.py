@@ -1,0 +1,109 @@
+"""Metrics + tracing subsystem tests.
+
+Reference behaviors pinned: metrics/metrics.go:29-113 (metric names,
+ExponentialBuckets(1000,2,15), SinceInMicroseconds), the observation seams
+scheduler.go:425,452-457,492 + generic_scheduler.go:148,154,163, and
+utiltrace (trace.go) with the 100ms slow-schedule threshold
+(generic_scheduler.go:113-114).
+"""
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.engine.trace import Trace
+from tpusim.framework.metrics import (
+    SchedulerMetrics,
+    exponential_buckets,
+    register,
+)
+from tpusim.simulator import run_simulation
+
+
+class TestPrimitives:
+    def test_exponential_buckets(self):
+        assert exponential_buckets(1000, 2, 4) == [1000, 2000, 4000, 8000]
+
+    def test_histogram_observe_and_expose(self):
+        m = SchedulerMetrics()
+        h = m.binding_latency
+        h.observe(1500)   # falls into le=2000 and above
+        h.observe(500)    # falls into every bucket
+        assert h.count == 2
+        text = m.expose()
+        assert 'scheduler_binding_latency_microseconds_bucket{le="1000"} 1' in text
+        assert 'scheduler_binding_latency_microseconds_bucket{le="2000"} 2' in text
+        assert "scheduler_binding_latency_microseconds_count 2" in text
+
+    def test_counter_and_gauge(self):
+        m = SchedulerMetrics()
+        m.preemption_attempts.inc()
+        m.preemption_attempts.inc()
+        m.preemption_victims.set(3)
+        text = m.expose()
+        assert "scheduler_total_preemption_attempts 2" in text
+        assert "scheduler_pod_preemption_victims 3" in text
+
+    def test_metric_names_match_reference(self):
+        # names pinned to metrics.go:29-91 so existing dashboards keep working
+        text = SchedulerMetrics().expose()
+        for name in [
+            "scheduler_e2e_scheduling_latency_microseconds",
+            "scheduler_scheduling_algorithm_latency_microseconds",
+            "scheduler_scheduling_algorithm_predicate_evaluation",
+            "scheduler_scheduling_algorithm_priority_evaluation",
+            "scheduler_scheduling_algorithm_preemption_evaluation",
+            "scheduler_binding_latency_microseconds",
+            "scheduler_pod_preemption_victims",
+            "scheduler_total_preemption_attempts",
+        ]:
+            assert f"# TYPE {name} " in text
+
+
+class TestObservationSeams:
+    def test_simulation_observes_phases(self):
+        register().reset()
+        nodes = [make_node(f"n{i}", milli_cpu=4000, memory=2**33)
+                 for i in range(3)]
+        pods = [make_pod(f"p{i}", milli_cpu=100, memory=1) for i in range(4)]
+        run_simulation(pods, ClusterSnapshot(nodes=nodes))
+        m = register()
+        assert m.scheduling_algorithm_latency.count == 4
+        assert m.predicate_evaluation.count == 4
+        assert m.priority_evaluation.count == 4
+        assert m.binding_latency.count == 4
+        assert m.e2e_scheduling_latency.count == 4
+        # e2e >= algorithm for each pod; totals preserve that ordering
+        assert (m.e2e_scheduling_latency.total
+                >= m.scheduling_algorithm_latency.total)
+
+    def test_preemption_metrics(self):
+        register().reset()
+        node = make_node("n0", milli_cpu=1000, memory=2**30)
+        victim = make_pod("victim", milli_cpu=900, memory=1, node_name="n0",
+                          phase="Running")
+        victim.spec.priority = 0
+        contender = make_pod("contender", milli_cpu=900, memory=1)
+        contender.spec.priority = 100
+        run_simulation([contender], ClusterSnapshot(nodes=[node], pods=[victim]),
+                       enable_pod_priority=True)
+        m = register()
+        assert m.preemption_attempts.value >= 1
+        assert m.preemption_evaluation.count >= 1
+
+
+class TestTrace:
+    def test_log_if_long_under_threshold_silent(self):
+        t = Trace("Scheduling default/p")
+        t.step("Computing predicates")
+        assert t.log_if_long(10.0) is None  # 10s threshold: silent
+
+    def test_log_if_long_formats_steps(self):
+        clock = iter([0.0, 0.05, 0.2, 0.25, 0.25]).__next__
+        t = Trace("Scheduling default/p", _now=clock)
+        t.step("Computing predicates")   # at 0.05 (+50ms)
+        t.step("Prioritizing")           # at 0.2  (+150ms)
+        text = t.log_if_long(0.1)        # total 250ms >= 100ms → logged
+        assert text is not None
+        assert '"Scheduling default/p"' in text
+        assert "Prioritizing" in text
+        # the 50ms step is under the per-step threshold share and elided
+        # (trace.go:79-85: threshold / (len(steps)+1) = 33ms)... 50 > 33, kept
+        assert "Computing predicates" in text

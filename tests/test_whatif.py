@@ -1,0 +1,103 @@
+"""Multi-snapshot what-if batching tests (BASELINE.json config 5).
+
+Correctness bar: the batched, shape-unified, mesh-sharded run must produce
+exactly the same placements as running each scenario alone through JaxBackend
+(which itself is differentially tested against the reference loop in
+test_jax_parity.py).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.backends import get_backend
+from tpusim.jaxe.sharding import make_mesh
+from tpusim.jaxe.whatif import run_what_if
+
+needs_8_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh")
+
+
+def scenario(seed: int, num_nodes: int, num_pods: int):
+    rng = np.random.RandomState(seed)
+    nodes = []
+    for i in range(num_nodes):
+        taints = ([{"key": "dedicated", "value": "batch",
+                    "effect": "NoSchedule"}] if i % 4 == 0 else None)
+        nodes.append(make_node(
+            f"s{seed}-n{i}", milli_cpu=int(rng.choice([2000, 4000, 8000])),
+            memory=int(rng.choice([4, 8, 16])) * 1024**3,
+            labels={"zone": f"z{i % 3}"}, taints=taints))
+    pods = []
+    for i in range(num_pods):
+        kwargs = {}
+        if i % 3 == 0:
+            kwargs["tolerations"] = [{"key": "dedicated", "operator": "Equal",
+                                      "value": "batch", "effect": "NoSchedule"}]
+        if i % 5 == 0:
+            kwargs["node_selector"] = {"zone": f"z{i % 3}"}
+        pods.append(make_pod(f"s{seed}-p{i}", milli_cpu=int(rng.randint(100, 1500)),
+                             memory=int(rng.randint(2**20, 2**30)), **kwargs))
+    return ClusterSnapshot(nodes=nodes), pods
+
+
+def placements_key(placements):
+    return [(p.pod.name, p.node_name, p.message) for p in placements]
+
+
+def singleton_results(scenarios, provider="DefaultProvider"):
+    backend = get_backend("jax", provider=provider)
+    return [placements_key(backend.schedule(pods, snap))
+            for snap, pods in scenarios]
+
+
+class TestWhatIf:
+    def test_heterogeneous_scenarios_match_singleton_runs(self):
+        # different node counts, pod counts, and scalar/signature spaces
+        scenarios = [scenario(0, 12, 9), scenario(1, 7, 14), scenario(2, 20, 5)]
+        batched = run_what_if(scenarios)
+        singles = singleton_results(scenarios)
+        assert len(batched) == 3
+        for got, want in zip(batched, singles):
+            assert placements_key(got.placements) == want
+
+    def test_counts(self):
+        snap, pods = scenario(3, 6, 8)
+        # an impossible pod: bigger than every node
+        pods.append(make_pod("impossible", milli_cpu=10**9, memory=2**50))
+        [result] = run_what_if([(snap, pods)])
+        assert result.total == len(pods)
+        assert result.unschedulable >= 1
+        impossible = result.placements[-1]
+        assert impossible.reason == "Unschedulable"
+        assert "Insufficient cpu" in impossible.message
+
+    def test_provider_validation(self):
+        with pytest.raises(KeyError):
+            run_what_if([scenario(0, 3, 2)], provider="NoSuchProvider")
+
+    def test_empty(self):
+        assert run_what_if([]) == []
+
+    @needs_8_devices
+    def test_mesh_sharded_matches_singleton_runs(self):
+        # 3 scenarios on a (snap=2, node=4) mesh: scenario axis padded to 4
+        scenarios = [scenario(10, 16, 10), scenario(11, 9, 6),
+                     scenario(12, 24, 12)]
+        mesh = make_mesh(8, snap=2)
+        batched = run_what_if(scenarios, mesh=mesh)
+        singles = singleton_results(scenarios)
+        assert len(batched) == 3
+        for got, want in zip(batched, singles):
+            assert placements_key(got.placements) == want
+
+    @needs_8_devices
+    def test_mesh_td_provider(self):
+        scenarios = [scenario(20, 8, 6), scenario(21, 8, 6)]
+        mesh = make_mesh(8, snap=2)
+        batched = run_what_if(scenarios, provider="TalkintDataProvider",
+                              mesh=mesh)
+        singles = singleton_results(scenarios, provider="TalkintDataProvider")
+        for got, want in zip(batched, singles):
+            assert placements_key(got.placements) == want

@@ -37,7 +37,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--kubeconfig", default="",
                         help="Path to kubeconfig for a live-cluster snapshot "
                              "(not supported in this offline build; use --snapshot)")
-    parser.add_argument("--podspec", required=True,
+    parser.add_argument("--podspec", default="",
                         help="YAML/JSON file with [{name, pod, num}] entries")
     parser.add_argument("--algorithmprovider", default="DefaultProvider",
                         help="DefaultProvider | ClusterAutoscalerProvider | "
@@ -68,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Generate N homogeneous synthetic nodes")
     parser.add_argument("--synthetic-milli-cpu", type=int, default=4000)
     parser.add_argument("--synthetic-memory", type=int, default=16 * 1024**3)
+    parser.add_argument("--what-if", default="",
+                        help="Manifest JSON [{snapshot, podspec}, ...]: run "
+                             "all scenarios as ONE batched device program "
+                             "(jax backend; snapshot axis shardable over a "
+                             "mesh). Ignores --podspec/--snapshot.")
     parser.add_argument("--enable-pod-priority", action="store_true",
                         help="Enable the PodPriority feature gate (preemption); "
                              "reference backend only")
@@ -93,9 +98,53 @@ def load_snapshot(args) -> ClusterSnapshot:
     return snapshot
 
 
+def run_what_if_cli(args) -> int:
+    """Batched multi-snapshot mode (BASELINE.json config 5)."""
+    import json
+
+    from tpusim.jaxe.whatif import run_what_if
+
+    try:
+        with open(args.what_if) as f:
+            manifest = json.load(f)
+        if not isinstance(manifest, list) or not manifest:
+            raise ValueError("manifest must be a non-empty JSON list")
+        scenarios = []
+        for entry in manifest:
+            snapshot = ClusterSnapshot.load(entry["snapshot"])
+            sim_pods = load_simulation_pods(entry["podspec"])
+            pods = expand_simulation_pods(sim_pods, namespace=args.namespace)
+            # match run_simulation's LIFO feed order
+            scenarios.append((snapshot, list(reversed(pods))))
+    except (OSError, KeyError, TypeError, ValueError) as exc:
+        print(f"error: invalid what-if manifest: {exc}", file=sys.stderr)
+        return 2
+
+    start = time.perf_counter()
+    try:
+        results = run_what_if(scenarios, provider=args.algorithmprovider)
+    except (KeyError, NotImplementedError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - start
+    total = sum(r.total for r in results)
+    for i, result in enumerate(results):
+        print(f"scenario {i}: {result.scheduled} scheduled, "
+              f"{result.unschedulable} unschedulable")
+    rate = total / elapsed if elapsed > 0 else 0.0
+    print(f"\n{len(results)} scenarios, {total} pods in one batched dispatch "
+          f"[{elapsed:.3f}s, {rate:.0f} pods/s]")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    if args.what_if:
+        return run_what_if_cli(args)
+    if not args.podspec:
+        print("error: --podspec is required (or use --what-if)", file=sys.stderr)
+        return 2
     if args.kubeconfig or os.environ.get("CC_INCLUSTER"):
         print("error: live-cluster snapshots need a kube apiserver, which this "
               "offline build does not ship. Snapshot the cluster with "

@@ -15,6 +15,7 @@ keep node-list order), which both backends implement identically.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from time import perf_counter as _now
 from typing import Callable, Dict, List, Optional
 
 from tpusim.api.types import Node, Pod
@@ -28,6 +29,8 @@ from tpusim.engine.predicates import (
 )
 from tpusim.engine.priorities import HostPriority, PriorityConfig
 from tpusim.engine.resources import NodeInfo
+from tpusim.engine.trace import Trace
+from tpusim.framework.metrics import register as register_metrics, since_in_microseconds
 from tpusim.engine.util import (
     MAX_INT32,
     get_pod_priority as util_get_pod_priority,
@@ -106,6 +109,7 @@ class GenericScheduler:
         # keys; reproducing it would make PredicateArgument configs dead weight.
         self._predicate_key_order = list(PREDICATES_ORDERING) + sorted(
             k for k in self.predicates if k not in PREDICATES_ORDERING)
+        self._metrics = register_metrics()
 
     # --- filter phase ---
 
@@ -273,16 +277,33 @@ class GenericScheduler:
 
     def schedule(self, pod: Pod, nodes: List[Node],
                  node_info_map: Dict[str, NodeInfo]) -> str:
-        """Reference: generic_scheduler.go:112-180."""
-        if not nodes:
-            raise ERR_NO_NODES_AVAILABLE
-        filtered, failed_predicate_map = self.find_nodes_that_fit(pod, nodes, node_info_map)
-        if not filtered:
-            raise FitError(pod, len(nodes), failed_predicate_map)
-        if len(filtered) == 1:
-            return filtered[0].name
-        priority_list = self.prioritize_nodes(pod, node_info_map, filtered)
-        return self.select_host(priority_list)
+        """Reference: generic_scheduler.go:112-180 — incl. the per-pod
+        utiltrace ("Scheduling ns/name", logged >100ms, :113-114) and the
+        predicate/priority evaluation histograms (:148,154,163)."""
+        trace = Trace(f"Scheduling {pod.namespace}/{pod.name}")
+        metrics = self._metrics
+        try:
+            if not nodes:
+                raise ERR_NO_NODES_AVAILABLE
+            start = _now()
+            filtered, failed_predicate_map = self.find_nodes_that_fit(
+                pod, nodes, node_info_map)
+            metrics.predicate_evaluation.observe(since_in_microseconds(start))
+            trace.step("Computing predicates")
+            if not filtered:
+                raise FitError(pod, len(nodes), failed_predicate_map)
+            start = _now()
+            if len(filtered) == 1:
+                metrics.priority_evaluation.observe(since_in_microseconds(start))
+                return filtered[0].name
+            priority_list = self.prioritize_nodes(pod, node_info_map, filtered)
+            metrics.priority_evaluation.observe(since_in_microseconds(start))
+            trace.step("Prioritizing")
+            host = self.select_host(priority_list)
+            trace.step("Selecting host")
+            return host
+        finally:
+            trace.log_if_long()
 
     # --- preemption (generic_scheduler.go:205-1000) ---
     # Dormant by default: pod priority is feature-gated off at the reference's

@@ -1,0 +1,182 @@
+"""Scheduler metrics: per-phase latency histograms and preemption counters.
+
+Reference: vendor/k8s.io/kubernetes/pkg/scheduler/metrics/metrics.go:25-113 —
+Prometheus histograms with ExponentialBuckets(1000, 2, 15) (microseconds,
+smallest bucket 1ms) under the "scheduler" subsystem, observed at
+scheduler.go:425,452-457,492 and core/generic_scheduler.go:148,154,163. The
+reference registers these but never serves them (the simulator starts no
+metrics HTTP server); here the registry is in-process and can be dumped in
+Prometheus text exposition format for the same scrape shape.
+
+The metric names are kept identical so dashboards keyed on the reference's
+names keep working.
+
+On TPU the per-phase split changes meaning: the whole
+filter→score→select→bind step is one fused device program, so the jax backend
+observes per-batch device-dispatch walltime into the same histograms
+(SURVEY.md §5 tracing note) rather than per-phase host time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+SCHEDULER_SUBSYSTEM = "scheduler"
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
+    """prometheus.ExponentialBuckets."""
+    return [start * factor**i for i in range(count)]
+
+
+class Histogram:
+    def __init__(self, name: str, help_text: str, buckets: List[float]):
+        self.name = name
+        self.help = help_text
+        self.buckets = sorted(buckets)
+        self.bucket_counts = [0] * len(self.buckets)
+        self.count = 0
+        self.total = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += value
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self.bucket_counts[i] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self.bucket_counts = [0] * len(self.buckets)
+            self.count = 0
+            self.total = 0.0
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        cumulative = 0
+        for bound, bucket_count in zip(self.buckets, self.bucket_counts):
+            cumulative = bucket_count  # bucket_counts are already cumulative
+            lines.append(f'{self.name}_bucket{{le="{bound:g}"}} {cumulative}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
+        lines.append(f"{self.name}_sum {self.total:g}")
+        lines.append(f"{self.name}_count {self.count}")
+        return lines
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def reset(self) -> None:
+        with self._lock:
+            self.value = 0.0
+
+    def expose(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} counter",
+                f"{self.name} {self.value:g}"]
+
+
+class Gauge:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+    def reset(self) -> None:
+        self.value = 0.0
+
+    def expose(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} gauge",
+                f"{self.name} {self.value:g}"]
+
+
+_LATENCY_BUCKETS = exponential_buckets(1000, 2, 15)
+
+
+class SchedulerMetrics:
+    """The metric set of metrics/metrics.go:29-91, names preserved."""
+
+    def __init__(self):
+        s = SCHEDULER_SUBSYSTEM
+        self.e2e_scheduling_latency = Histogram(
+            f"{s}_e2e_scheduling_latency_microseconds",
+            "E2e scheduling latency (scheduling algorithm + binding)",
+            _LATENCY_BUCKETS)
+        self.scheduling_algorithm_latency = Histogram(
+            f"{s}_scheduling_algorithm_latency_microseconds",
+            "Scheduling algorithm latency", _LATENCY_BUCKETS)
+        self.predicate_evaluation = Histogram(
+            f"{s}_scheduling_algorithm_predicate_evaluation",
+            "Scheduling algorithm predicate evaluation duration",
+            _LATENCY_BUCKETS)
+        self.priority_evaluation = Histogram(
+            f"{s}_scheduling_algorithm_priority_evaluation",
+            "Scheduling algorithm priority evaluation duration",
+            _LATENCY_BUCKETS)
+        self.preemption_evaluation = Histogram(
+            f"{s}_scheduling_algorithm_preemption_evaluation",
+            "Scheduling algorithm preemption evaluation duration",
+            _LATENCY_BUCKETS)
+        self.binding_latency = Histogram(
+            f"{s}_binding_latency_microseconds", "Binding latency",
+            _LATENCY_BUCKETS)
+        self.preemption_victims = Gauge(
+            f"{s}_pod_preemption_victims",
+            "Number of selected preemption victims")
+        self.preemption_attempts = Counter(
+            f"{s}_total_preemption_attempts",
+            "Total preemption attempts in the cluster till now")
+
+    def _all(self):
+        return [self.e2e_scheduling_latency, self.scheduling_algorithm_latency,
+                self.binding_latency, self.predicate_evaluation,
+                self.priority_evaluation, self.preemption_evaluation,
+                self.preemption_victims, self.preemption_attempts]
+
+    def reset(self) -> None:
+        for metric in self._all():
+            metric.reset()
+
+    def expose(self) -> str:
+        """Prometheus text exposition format (the scrape body the reference
+        would have served had it started its metrics server)."""
+        lines: List[str] = []
+        for metric in self._all():
+            lines.extend(metric.expose())
+        return "\n".join(lines) + "\n"
+
+
+# module-level default registry, mirroring the Go package-level metrics +
+# metrics.Register() sync.Once (metrics.go:95-109)
+_default: Optional[SchedulerMetrics] = None
+_default_lock = threading.Lock()
+
+
+def register() -> SchedulerMetrics:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = SchedulerMetrics()
+        return _default
+
+
+def since_in_microseconds(start: float) -> float:
+    """metrics.go SinceInMicroseconds; start is a time.perf_counter() value."""
+    return (time.perf_counter() - start) * 1e6

@@ -99,6 +99,15 @@ class JaxBackend:
         carry = carry_init(compiled)
         statics = statics_to_device(compiled)
         xs = pod_columns_to_device(cols)
+        # On TPU the per-pod filter→score→select→bind pipeline is one fused
+        # device program, so the whole batch dispatch lands in the algorithm
+        # histogram (the per-phase split of metrics.go has no device analog);
+        # e2e additionally covers host-side result materialization.
+        from time import perf_counter
+
+        from tpusim.framework.metrics import register, since_in_microseconds
+        metrics = register()
+        dispatch_start = perf_counter()
         if self.batch_size > 0:
             _, choices, counts = schedule_wavefront(config, carry, statics, xs,
                                                     self.batch_size)
@@ -106,6 +115,8 @@ class JaxBackend:
             _, choices, counts = schedule_scan(config, carry, statics, xs)
         choices = np.asarray(choices)
         counts = np.asarray(counts)
+        metrics.scheduling_algorithm_latency.observe(
+            since_in_microseconds(dispatch_start))
 
         strings = reason_strings(compiled.scalar_names)
         names = compiled.statics.names
@@ -120,4 +131,7 @@ class JaxBackend:
                 msg = format_fit_error(n, counts[j], strings)
                 placements.append(Placement(pod=mark_unschedulable(pod, msg),
                                             reason="Unschedulable", message=msg))
+        # e2e additionally covers host-side result materialization
+        metrics.e2e_scheduling_latency.observe(
+            since_in_microseconds(dispatch_start))
         return placements

@@ -1,0 +1,226 @@
+"""Multi-snapshot what-if: batch independent cluster scenarios over the mesh.
+
+BASELINE.json config 5 ("Multi-tenant what-if: 50 concurrent cluster snapshots
+× 20k pods each, batched over TPU"). The reference has no analog — each run is
+one process over one snapshot; what-if studies mean re-running the binary
+(SURVEY.md §5 checkpoint note). Here scenarios are compiled to a common array
+shape, stacked on a leading snapshot axis, and dispatched as ONE device
+program: vmap over the snapshot axis, with the axis sharded over the mesh's
+"snap" dimension (zero cross-snapshot communication — the dp analog) and node
+columns over "node" (ICI collectives inserted by GSPMD).
+
+Shape unification:
+  * node axis — padded to the common max (and the mesh's node-shard multiple)
+    with sentinel-infeasible nodes (sharding.pad_node_axis).
+  * signature tables — padded on the signature axis with unreferenced rows.
+  * scalar-resource columns — padded to the widest scenario; a scenario's
+    reason-bit space stays its own (unused high bits never fire).
+  * pod axis — padded with ghost pods whose CPU request exceeds any node
+    (infeasible everywhere: no bind scatter, no round-robin advance), dropped
+    on decode.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.api.types import Pod
+from tpusim.backends import Placement, bind_pod, mark_unschedulable
+from tpusim.jaxe import ensure_x64
+from tpusim.jaxe.backend import (
+    _KNOWN_PROVIDERS,
+    _MOST_REQUESTED_PROVIDERS,
+    format_fit_error,
+)
+from tpusim.jaxe.kernels import (
+    Carry,
+    EngineConfig,
+    PodX,
+    Statics,
+    carry_init,
+    make_step,
+    pod_columns_to_device,
+    statics_to_device,
+)
+from tpusim.jaxe.sharding import pad_node_axis, snap_shardings
+from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
+
+GHOST_CPU = np.int64(1) << 61  # larger than any allocatable: never feasible
+
+
+@dataclass
+class WhatIfResult:
+    """Per-scenario outcome."""
+
+    placements: List[Placement]
+    scheduled: int
+    unschedulable: int
+
+    @property
+    def total(self) -> int:
+        return self.scheduled + self.unschedulable
+
+
+def _pad_axis(a: np.ndarray, axis: int, target: int, fill=0) -> np.ndarray:
+    pad = target - a.shape[axis]
+    if pad <= 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return np.pad(a, widths, constant_values=fill)
+
+
+def _unify(statics: Statics, carry: Carry, xs: PodX, sig_max: dict,
+           s_max: int, p_max: int) -> Tuple[Statics, Carry, PodX]:
+    """Pad signature / scalar / pod axes to the common shape (host-side)."""
+    st = statics._replace(
+        alloc_scalar=jnp.asarray(_pad_axis(np.asarray(statics.alloc_scalar), 1, s_max)),
+        selector_ok=jnp.asarray(_pad_axis(np.asarray(statics.selector_ok), 0,
+                                          sig_max["sel"])),
+        taint_ok=jnp.asarray(_pad_axis(np.asarray(statics.taint_ok), 0,
+                                       sig_max["tol"])),
+        intolerable=jnp.asarray(_pad_axis(np.asarray(statics.intolerable), 0,
+                                          sig_max["tol"])),
+        affinity_count=jnp.asarray(_pad_axis(np.asarray(statics.affinity_count), 0,
+                                             sig_max["aff"])),
+        avoid_score=jnp.asarray(_pad_axis(np.asarray(statics.avoid_score), 0,
+                                          sig_max["avoid"])),
+        host_ok=jnp.asarray(_pad_axis(np.asarray(statics.host_ok), 0,
+                                      sig_max["host"])))
+    ca = carry._replace(
+        used_scalar=jnp.asarray(_pad_axis(np.asarray(carry.used_scalar), 1, s_max)))
+
+    p = xs.req_cpu.shape[0]
+    fields = {}
+    for name, arr in xs._asdict().items():
+        arr = np.asarray(arr)
+        if name == "req_scalar":
+            arr = _pad_axis(arr, 1, s_max)
+        fields[name] = _pad_axis(arr, 0, p_max)
+    if p_max > p:
+        # ghost pods: infeasible everywhere, never advance rr or bind
+        fields["req_cpu"][p:] = GHOST_CPU
+        fields["zero_request"][p:] = False
+    return st, ca, PodX(**{k: jnp.asarray(v) for k, v in fields.items()})
+
+
+def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
+                provider: str = "DefaultProvider",
+                mesh: Optional[object] = None) -> List[WhatIfResult]:
+    """Run independent (snapshot, pods) scenarios as one batched device
+    program. Pods are fed in podspec order (callers wanting reference LIFO
+    parity pass the reversed list, as run_simulation does).
+
+    mesh: an optional ("snap", "node") jax.sharding.Mesh (sharding.make_mesh);
+    None runs single-device. The scenario count need not divide the snap axis —
+    the batch is padded with a replica of the first scenario and the padding
+    dropped on decode.
+    """
+    if provider not in _KNOWN_PROVIDERS:
+        raise KeyError(f"plugin {provider!r} has not been registered")
+    if not scenarios:
+        return []
+    ensure_x64()
+
+    compiled_list = []
+    for snapshot, pods in scenarios:
+        compiled, cols = compile_cluster(snapshot, pods)
+        if compiled.unsupported:
+            detail = "; ".join(sorted(set(compiled.unsupported))[:5])
+            raise NotImplementedError(
+                "what-if batching requires jax-compilable scenarios; "
+                f"unsupported: {detail} (run this scenario on the reference "
+                "backend instead)")
+        compiled_list.append((compiled, cols))
+
+    n_snap_shards = mesh.shape["snap"] if mesh is not None else 1
+    n_node_shards = mesh.shape["node"] if mesh is not None else 1
+
+    # common shapes
+    sig_max = {
+        "sel": max(c.tables.selector_ok.shape[0] for c, _ in compiled_list),
+        "tol": max(c.tables.taint_ok.shape[0] for c, _ in compiled_list),
+        "aff": max(c.tables.affinity_count.shape[0] for c, _ in compiled_list),
+        "avoid": max(c.tables.avoid_score.shape[0] for c, _ in compiled_list),
+        "host": max(c.tables.host_ok.shape[0] for c, _ in compiled_list),
+    }
+    s_max = max(len(c.scalar_names) for c, _ in compiled_list)
+    p_max = max(len(pods) for _, pods in scenarios)
+    n_max = max(c.statics.alloc_cpu.shape[0] for c, _ in compiled_list)
+    # one pad target: max nodes rounded up to the node-shard multiple
+    n_target = -(-n_max // n_node_shards) * n_node_shards
+
+    per_scenario = []
+    for compiled, cols in compiled_list:
+        statics = statics_to_device(compiled)
+        carry = carry_init(compiled)
+        statics, carry, xs = _unify(statics, carry, pod_columns_to_device(cols),
+                                    sig_max, s_max, p_max)
+        statics, carry, _ = pad_node_axis(statics, carry, n_target)
+        per_scenario.append((carry, statics, xs))
+
+    # pad the scenario axis to the snap-shard multiple with replicas
+    real_count = len(per_scenario)
+    while len(per_scenario) % n_snap_shards != 0:
+        per_scenario.append(per_scenario[0])
+
+    stack = lambda trees: jax.tree.map(lambda *a: jnp.stack(a), *trees)  # noqa: E731
+    carries = stack([t[0] for t in per_scenario])
+    statics_b = stack([t[1] for t in per_scenario])
+    xs_b = stack([t[2] for t in per_scenario])
+
+    if mesh is not None:
+        st_spec, ca_spec, xs_spec = snap_shardings(mesh)
+        carries = jax.tree.map(jax.device_put, carries, ca_spec)
+        statics_b = jax.tree.map(jax.device_put, statics_b, st_spec)
+        xs_b = jax.tree.map(lambda a: jax.device_put(a, xs_spec), xs_b)
+
+    config = EngineConfig(
+        most_requested=provider in _MOST_REQUESTED_PROVIDERS,
+        num_reason_bits=NUM_FIXED_BITS + s_max)
+    step = make_step(config)
+
+    @jax.jit
+    def batched(carries, statics_b, xs_b):
+        def one(carry, st, xs):
+            (final_carry, _), (choices, counts) = jax.lax.scan(
+                step, (carry, st), xs)
+            return choices, counts
+        return jax.vmap(one)(carries, statics_b, xs_b)
+
+    if mesh is not None:
+        with mesh:
+            choices_b, counts_b = batched(carries, statics_b, xs_b)
+            choices_b = np.asarray(choices_b)
+    else:
+        choices_b, counts_b = batched(carries, statics_b, xs_b)
+        choices_b = np.asarray(choices_b)
+    counts_b = np.asarray(counts_b)
+
+    results: List[WhatIfResult] = []
+    for i in range(real_count):
+        compiled, _ = compiled_list[i]
+        _, pods = scenarios[i]
+        names = compiled.statics.names
+        strings = reason_strings(compiled.scalar_names)
+        placements: List[Placement] = []
+        scheduled = 0
+        for j, pod in enumerate(pods):
+            c = int(choices_b[i, j])
+            if c >= 0:
+                scheduled += 1
+                placements.append(Placement(pod=bind_pod(pod, names[c]),
+                                            node_name=names[c]))
+            else:
+                msg = format_fit_error(len(names), counts_b[i, j], strings)
+                placements.append(Placement(pod=mark_unschedulable(pod, msg),
+                                            reason="Unschedulable", message=msg))
+        results.append(WhatIfResult(placements=placements, scheduled=scheduled,
+                                    unschedulable=len(pods) - scheduled))
+    return results

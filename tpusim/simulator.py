@@ -12,6 +12,7 @@ are reproduced exactly.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from time import perf_counter
 from typing import Dict, List, Optional
 
 from tpusim.api.snapshot import ClusterSnapshot
@@ -29,6 +30,7 @@ from tpusim.engine.providers import (
 )
 from tpusim.engine.resources import NodeInfo
 from tpusim.framework.events import Recorder
+from tpusim.framework.metrics import register as register_metrics, since_in_microseconds
 from tpusim.framework.report import GeneralReview, Status, get_report
 from tpusim.framework.store import ADDED, DELETED, MODIFIED, PodQueue, ResourceStore
 from tpusim.framework.strategy import PredictiveStrategy
@@ -115,6 +117,7 @@ class ClusterCapacity:
         # simulator.go:352-366) but can be injected for preemption studies
         self.pdbs: list = []
         self.scheduler.pdb_lister = lambda: list(self.pdbs)
+        self.metrics = register_metrics()
 
     # --- cache event handlers ---
 
@@ -199,12 +202,28 @@ class ClusterCapacity:
         back anyway. Deviation from the reference, documented: the transient
         Unschedulable condition the Go scheduler sets before a successful
         preemption is not recorded in FailedPods."""
+        metrics = self.metrics
+        e2e_start = algo_start = perf_counter()
         try:
             host = self.scheduler.schedule(pod, self.nodes, self.node_info_map)
+            metrics.scheduling_algorithm_latency.observe(
+                since_in_microseconds(algo_start))
         except FitError as fit_err:
             if self.config.enable_pod_priority and preempt_budget > 0:
-                node, victims, to_clear = self.scheduler.preempt(
-                    pod, self.nodes, self.node_info_map, fit_err)
+                # scheduler.go:449-455: preemption attempt counter + duration
+                preemption_start = perf_counter()
+                metrics.preemption_attempts.inc()
+                try:
+                    node, victims, to_clear = self.scheduler.preempt(
+                        pod, self.nodes, self.node_info_map, fit_err)
+                except SchedulingError:
+                    # a failed preemption attempt (e.g. extender error) is
+                    # logged-and-dropped in the reference (scheduler.go:
+                    # 449-451); the pod still gets its Unschedulable condition
+                    node, victims, to_clear = None, [], []
+                metrics.preemption_evaluation.observe(
+                    since_in_microseconds(preemption_start))
+                metrics.preemption_victims.set(len(victims))
                 for p in to_clear:
                     p.status.nominated_node_name = ""
                 if node is not None:
@@ -232,7 +251,11 @@ class ClusterCapacity:
                                           reason="Unschedulable",
                                           message=str(sched_err)))
             return "failed"
+        # binding latency + e2e (scheduler.go:425,492)
+        binding_start = perf_counter()
         self.bind(pod, host)
+        metrics.binding_latency.observe(since_in_microseconds(binding_start))
+        metrics.e2e_scheduling_latency.observe(since_in_microseconds(e2e_start))
         return "bound"
 
     STOP_REASONS = {

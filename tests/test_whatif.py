@@ -101,3 +101,22 @@ class TestWhatIf:
         singles = singleton_results(scenarios, provider="TalkintDataProvider")
         for got, want in zip(batched, singles):
             assert placements_key(got.placements) == want
+
+    def test_zero_node_scenario_mixed_into_batch(self):
+        # a zero-node scenario must resolve host-side (like the backend's
+        # empty guard) while the others run batched on device
+        empty = (ClusterSnapshot(nodes=[]), [make_pod("lonely", milli_cpu=100)])
+        scenarios = [scenario(30, 8, 5), empty, scenario(31, 6, 4)]
+        results = run_what_if(scenarios)
+        assert len(results) == 3
+        assert results[1].scheduled == 0 and results[1].unschedulable == 1
+        assert results[1].placements[0].message == \
+            "no nodes available to schedule pods"
+        singles = singleton_results([scenarios[0], scenarios[2]])
+        assert placements_key(results[0].placements) == singles[0]
+        assert placements_key(results[2].placements) == singles[1]
+
+    def test_all_scenarios_zero_nodes(self):
+        empty = (ClusterSnapshot(nodes=[]), [make_pod("p", milli_cpu=10)])
+        results = run_what_if([empty, empty])
+        assert [r.unschedulable for r in results] == [1, 1]

@@ -58,10 +58,10 @@ class Histogram:
     def expose(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
-        cumulative = 0
+        # bucket_counts are already cumulative (observe() increments every
+        # bucket whose bound covers the value)
         for bound, bucket_count in zip(self.buckets, self.bucket_counts):
-            cumulative = bucket_count  # bucket_counts are already cumulative
-            lines.append(f'{self.name}_bucket{{le="{bound:g}"}} {cumulative}')
+            lines.append(f'{self.name}_bucket{{le="{bound:g}"}} {bucket_count}')
         lines.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
         lines.append(f"{self.name}_sum {self.total:g}")
         lines.append(f"{self.name}_count {self.count}")

@@ -51,6 +51,25 @@ def format_fit_error(num_nodes: int, counts: np.ndarray, strings: List[str]) -> 
             + ": " + ", ".join(reason_strs) + ".")
 
 
+def decode_placements(pods: List[Pod], choices: np.ndarray, counts: np.ndarray,
+                      names: List[str], strings: List[str]
+                      ) -> tuple[List[Placement], int]:
+    """Device results -> Placement list (shared by JaxBackend and run_what_if)."""
+    placements: List[Placement] = []
+    scheduled = 0
+    for j, pod in enumerate(pods):
+        c = int(choices[j])
+        if c >= 0:
+            scheduled += 1
+            placements.append(Placement(pod=bind_pod(pod, names[c]),
+                                        node_name=names[c]))
+        else:
+            msg = format_fit_error(len(names), counts[j], strings)
+            placements.append(Placement(pod=mark_unschedulable(pod, msg),
+                                        reason="Unschedulable", message=msg))
+    return placements, scheduled
+
+
 class JaxBackend:
     name = "jax"
 
@@ -119,18 +138,8 @@ class JaxBackend:
             since_in_microseconds(dispatch_start))
 
         strings = reason_strings(compiled.scalar_names)
-        names = compiled.statics.names
-        n = len(names)
-        placements: List[Placement] = []
-        for j, pod in enumerate(pods):
-            c = int(choices[j])
-            if c >= 0:
-                placements.append(Placement(pod=bind_pod(pod, names[c]),
-                                            node_name=names[c]))
-            else:
-                msg = format_fit_error(n, counts[j], strings)
-                placements.append(Placement(pod=mark_unschedulable(pod, msg),
-                                            reason="Unschedulable", message=msg))
+        placements, _ = decode_placements(pods, choices, counts,
+                                          compiled.statics.names, strings)
         # e2e additionally covers host-side result materialization
         metrics.e2e_scheduling_latency.observe(
             since_in_microseconds(dispatch_start))

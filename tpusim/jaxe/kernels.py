@@ -26,6 +26,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpusim.jaxe.state import (
     BIT_DISK_PRESSURE,
@@ -104,43 +105,86 @@ class EngineConfig:
     num_reason_bits: int = NUM_FIXED_BITS
 
 
-def statics_to_device(compiled: CompiledCluster) -> Statics:
+# ---------------------------------------------------------------------------
+# Axis registries: for each pytree field, a tuple naming every array axis.
+# sharding.py pads/shards the "node" axis; whatif.py unifies every *other*
+# named axis to a common cross-scenario size. PodX omits its leading pod axis.
+# Adding a field to a NamedTuple requires only a matching entry here.
+# ---------------------------------------------------------------------------
+
+STATICS_AXES = dict(
+    alloc_cpu=("node",), alloc_mem=("node",), alloc_gpu=("node",),
+    alloc_eph=("node",), allowed_pods=("node",), alloc_scalar=("node", "scalar"),
+    cond_fail_bits=("node",), mem_pressure=("node",), disk_pressure=("node",),
+    selector_ok=("sig_sel", "node"), taint_ok=("sig_tol", "node"),
+    intolerable=("sig_tol", "node"), affinity_count=("sig_aff", "node"),
+    avoid_score=("sig_avoid", "node"), host_ok=("sig_host", "node"),
+)
+CARRY_AXES = dict(
+    used_cpu=("node",), used_mem=("node",), used_gpu=("node",), used_eph=("node",),
+    used_scalar=("node", "scalar"), nonzero_cpu=("node",), nonzero_mem=("node",),
+    pod_count=("node",), rr=(),
+)
+PODX_AXES = dict(
+    req_cpu=(), req_mem=(), req_gpu=(), req_eph=(), req_scalar=("scalar",),
+    nz_cpu=(), nz_mem=(), zero_request=(), best_effort=(), sel_id=(),
+    tol_id=(), aff_id=(), avoid_id=(), host_id=(),
+)
+# Node-axis pad fill per field (default 0). Exception: cond_fail_bits is
+# special-cased in sharding._pad_node_tree with a lazily-built infeasible
+# sentinel (1<<62 needs x64 enabled), so padded nodes can never be selected.
+PAD_FILLS: dict = {}
+
+
+def statics_to_host(compiled: CompiledCluster) -> Statics:
+    """Statics pytree over host numpy arrays (no device transfer)."""
     s, t = compiled.statics, compiled.tables
     return Statics(
-        alloc_cpu=jnp.asarray(s.alloc_cpu), alloc_mem=jnp.asarray(s.alloc_mem),
-        alloc_gpu=jnp.asarray(s.alloc_gpu), alloc_eph=jnp.asarray(s.alloc_eph),
-        allowed_pods=jnp.asarray(s.allowed_pods),
-        alloc_scalar=jnp.asarray(s.alloc_scalar),
-        cond_fail_bits=jnp.asarray(s.cond_fail_bits),
-        mem_pressure=jnp.asarray(s.mem_pressure),
-        disk_pressure=jnp.asarray(s.disk_pressure),
-        selector_ok=jnp.asarray(t.selector_ok), taint_ok=jnp.asarray(t.taint_ok),
-        intolerable=jnp.asarray(t.intolerable),
-        affinity_count=jnp.asarray(t.affinity_count),
-        avoid_score=jnp.asarray(t.avoid_score), host_ok=jnp.asarray(t.host_ok))
+        alloc_cpu=s.alloc_cpu, alloc_mem=s.alloc_mem,
+        alloc_gpu=s.alloc_gpu, alloc_eph=s.alloc_eph,
+        allowed_pods=s.allowed_pods, alloc_scalar=s.alloc_scalar,
+        cond_fail_bits=s.cond_fail_bits, mem_pressure=s.mem_pressure,
+        disk_pressure=s.disk_pressure,
+        selector_ok=t.selector_ok, taint_ok=t.taint_ok,
+        intolerable=t.intolerable, affinity_count=t.affinity_count,
+        avoid_score=t.avoid_score, host_ok=t.host_ok)
+
+
+def carry_init_host(compiled: CompiledCluster) -> Carry:
+    """Initial carry over host numpy arrays (no device transfer)."""
+    d = compiled.dynamic
+    return Carry(
+        used_cpu=d.used_cpu, used_mem=d.used_mem, used_gpu=d.used_gpu,
+        used_eph=d.used_eph, used_scalar=d.used_scalar,
+        nonzero_cpu=d.nonzero_cpu, nonzero_mem=d.nonzero_mem,
+        pod_count=d.pod_count, rr=np.int64(0))
+
+
+def pod_columns_to_host(cols: PodColumns) -> PodX:
+    """PodX pytree over host numpy arrays (no device transfer)."""
+    return PodX(
+        req_cpu=cols.req_cpu, req_mem=cols.req_mem, req_gpu=cols.req_gpu,
+        req_eph=cols.req_eph, req_scalar=cols.req_scalar,
+        nz_cpu=cols.nz_cpu, nz_mem=cols.nz_mem,
+        zero_request=cols.zero_request, best_effort=cols.best_effort,
+        sel_id=cols.sel_id, tol_id=cols.tol_id, aff_id=cols.aff_id,
+        avoid_id=cols.avoid_id, host_id=cols.host_id)
+
+
+def _tree_to_device(tree):
+    return type(tree)(*(jnp.asarray(a) for a in tree))
+
+
+def statics_to_device(compiled: CompiledCluster) -> Statics:
+    return _tree_to_device(statics_to_host(compiled))
 
 
 def carry_init(compiled: CompiledCluster) -> Carry:
-    d = compiled.dynamic
-    return Carry(
-        used_cpu=jnp.asarray(d.used_cpu), used_mem=jnp.asarray(d.used_mem),
-        used_gpu=jnp.asarray(d.used_gpu), used_eph=jnp.asarray(d.used_eph),
-        used_scalar=jnp.asarray(d.used_scalar),
-        nonzero_cpu=jnp.asarray(d.nonzero_cpu), nonzero_mem=jnp.asarray(d.nonzero_mem),
-        pod_count=jnp.asarray(d.pod_count), rr=jnp.asarray(0, dtype=jnp.int64))
+    return _tree_to_device(carry_init_host(compiled))
 
 
 def pod_columns_to_device(cols: PodColumns) -> PodX:
-    return PodX(
-        req_cpu=jnp.asarray(cols.req_cpu), req_mem=jnp.asarray(cols.req_mem),
-        req_gpu=jnp.asarray(cols.req_gpu), req_eph=jnp.asarray(cols.req_eph),
-        req_scalar=jnp.asarray(cols.req_scalar),
-        nz_cpu=jnp.asarray(cols.nz_cpu), nz_mem=jnp.asarray(cols.nz_mem),
-        zero_request=jnp.asarray(cols.zero_request),
-        best_effort=jnp.asarray(cols.best_effort),
-        sel_id=jnp.asarray(cols.sel_id), tol_id=jnp.asarray(cols.tol_id),
-        aff_id=jnp.asarray(cols.aff_id), avoid_id=jnp.asarray(cols.avoid_id),
-        host_id=jnp.asarray(cols.host_id))
+    return _tree_to_device(pod_columns_to_host(cols))
 
 
 def _ratio_score(requested, capacity, most: bool):

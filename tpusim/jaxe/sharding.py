@@ -17,6 +17,9 @@ jax.sharding.Mesh over jax.devices() — on multi-host, `jax.distributed` brings
 up the fleet and the Mesh spans hosts, with XLA routing collectives over
 ICI/DCN (this replaces the reference's in-process watch-event fabric; there is
 no NCCL/MPI analog to port, SURVEY.md §2 note).
+
+Axis placement is derived from the kernels.STATICS_AXES / CARRY_AXES
+registries, so new state fields inherit padding + sharding automatically.
 """
 
 from __future__ import annotations
@@ -28,7 +31,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpusim.jaxe.kernels import Carry, PodX, Statics
+from tpusim.jaxe.kernels import (
+    CARRY_AXES,
+    PAD_FILLS,
+    STATICS_AXES,
+    Carry,
+    PodX,
+    Statics,
+)
+
+def _infeasible_sentinel():
+    # computed lazily: jnp.int64 truncates to int32 before ensure_x64() runs
+    return jnp.int64(1) << 62
 
 
 def make_mesh(n_devices: Optional[int] = None, snap: int = 1,
@@ -48,6 +62,28 @@ def _pad_to(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
 
 
+def _pad_node_tree(tree, axes_map, pad: int):
+    fields = {}
+    for name, arr in tree._asdict().items():
+        spec = axes_map[name]
+        if "node" not in spec:
+            fields[name] = arr
+            continue
+        # stay on host for numpy inputs (the what-if path pads before upload)
+        xp = np if isinstance(arr, np.ndarray) else jnp
+        ax = spec.index("node")
+        widths = [(0, 0)] * arr.ndim
+        widths[ax] = (0, pad)
+        if name == "cond_fail_bits":
+            sentinel = (np.int64(1) << 62) if xp is np else _infeasible_sentinel()
+            fields[name] = xp.concatenate(
+                [arr, xp.full(pad, sentinel, dtype=xp.int64)])
+        else:
+            fields[name] = xp.pad(arr, widths,
+                                  constant_values=PAD_FILLS.get(name, 0))
+    return type(tree)(**fields)
+
+
 def pad_node_axis(statics: Statics, carry: Carry, n_shards: int
                   ) -> Tuple[Statics, Carry, int]:
     """Pad the node axis so it divides the mesh.
@@ -62,57 +98,24 @@ def pad_node_axis(statics: Statics, carry: Carry, n_shards: int
     pad = padded - n
     if pad == 0:
         return statics, carry, n
+    return (_pad_node_tree(statics, STATICS_AXES, pad),
+            _pad_node_tree(carry, CARRY_AXES, pad), n)
 
-    def pad1(a, fill=0):
-        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-        return jnp.pad(a, widths, constant_values=fill)
 
-    def pad_last(a, fill=0):
-        widths = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
-        return jnp.pad(a, widths, constant_values=fill)
-
-    sentinel = jnp.int64(1) << 62
-    statics = Statics(
-        alloc_cpu=pad1(statics.alloc_cpu), alloc_mem=pad1(statics.alloc_mem),
-        alloc_gpu=pad1(statics.alloc_gpu), alloc_eph=pad1(statics.alloc_eph),
-        allowed_pods=pad1(statics.allowed_pods),
-        alloc_scalar=pad1(statics.alloc_scalar),
-        cond_fail_bits=jnp.concatenate(
-            [statics.cond_fail_bits, jnp.full(pad, sentinel, dtype=jnp.int64)]),
-        mem_pressure=pad1(statics.mem_pressure),
-        disk_pressure=pad1(statics.disk_pressure),
-        selector_ok=pad_last(statics.selector_ok),
-        taint_ok=pad_last(statics.taint_ok),
-        intolerable=pad_last(statics.intolerable),
-        affinity_count=pad_last(statics.affinity_count),
-        avoid_score=pad_last(statics.avoid_score),
-        host_ok=pad_last(statics.host_ok))
-    carry = Carry(
-        used_cpu=pad1(carry.used_cpu), used_mem=pad1(carry.used_mem),
-        used_gpu=pad1(carry.used_gpu), used_eph=pad1(carry.used_eph),
-        used_scalar=pad1(carry.used_scalar),
-        nonzero_cpu=pad1(carry.nonzero_cpu), nonzero_mem=pad1(carry.nonzero_mem),
-        pod_count=pad1(carry.pod_count), rr=carry.rr)
-    return statics, carry, n
+def _sharding_tree(tree_cls, axes_map, mesh: Mesh, leading: Optional[str] = None):
+    fields = {}
+    for name, spec in axes_map.items():
+        parts = ([leading] if leading is not None else []) + [
+            "node" if a == "node" else None for a in spec]
+        fields[name] = NamedSharding(mesh, P(*parts))
+    return tree_cls(**fields)
 
 
 def node_shardings(mesh: Mesh) -> Tuple[Statics, Carry]:
     """NamedShardings for statics/carry pytrees: node axis sharded, signature
     and scalar axes replicated."""
-    node = NamedSharding(mesh, P("node"))
-    sig_node = NamedSharding(mesh, P(None, "node"))
-    node_scalar = NamedSharding(mesh, P("node", None))
-    scalar = NamedSharding(mesh, P())
-    statics = Statics(
-        alloc_cpu=node, alloc_mem=node, alloc_gpu=node, alloc_eph=node,
-        allowed_pods=node, alloc_scalar=node_scalar, cond_fail_bits=node,
-        mem_pressure=node, disk_pressure=node, selector_ok=sig_node,
-        taint_ok=sig_node, intolerable=sig_node, affinity_count=sig_node,
-        avoid_score=sig_node, host_ok=sig_node)
-    carry = Carry(used_cpu=node, used_mem=node, used_gpu=node, used_eph=node,
-                  used_scalar=node_scalar, nonzero_cpu=node, nonzero_mem=node,
-                  pod_count=node, rr=scalar)
-    return statics, carry
+    return (_sharding_tree(Statics, STATICS_AXES, mesh),
+            _sharding_tree(Carry, CARRY_AXES, mesh))
 
 
 def shard_for_mesh(mesh: Mesh, statics: Statics, carry: Carry, xs: PodX
@@ -133,18 +136,7 @@ def shard_for_mesh(mesh: Mesh, statics: Statics, carry: Carry, xs: PodX
 def snap_shardings(mesh: Mesh) -> Tuple[Statics, Carry, object]:
     """Shardings for the multi-snapshot what-if: leading snapshot axis sharded
     over "snap", node axis over "node"."""
-    sn = NamedSharding(mesh, P("snap", "node"))
-    s_sig_node = NamedSharding(mesh, P("snap", None, "node"))
-    s_node_scalar = NamedSharding(mesh, P("snap", "node", None))
-    s_only = NamedSharding(mesh, P("snap"))
-    statics = Statics(
-        alloc_cpu=sn, alloc_mem=sn, alloc_gpu=sn, alloc_eph=sn,
-        allowed_pods=sn, alloc_scalar=s_node_scalar, cond_fail_bits=sn,
-        mem_pressure=sn, disk_pressure=sn, selector_ok=s_sig_node,
-        taint_ok=s_sig_node, intolerable=s_sig_node, affinity_count=s_sig_node,
-        avoid_score=s_sig_node, host_ok=s_sig_node)
-    carry = Carry(used_cpu=sn, used_mem=sn, used_gpu=sn, used_eph=sn,
-                  used_scalar=s_node_scalar, nonzero_cpu=sn, nonzero_mem=sn,
-                  pod_count=sn, rr=s_only)
+    statics = _sharding_tree(Statics, STATICS_AXES, mesh, leading="snap")
+    carry = _sharding_tree(Carry, CARRY_AXES, mesh, leading="snap")
     xs_sharding = NamedSharding(mesh, P("snap"))
     return statics, carry, xs_sharding

@@ -31,22 +31,25 @@ import numpy as np
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.api.types import Pod
-from tpusim.backends import Placement, bind_pod, mark_unschedulable
+from tpusim.backends import Placement, mark_unschedulable
 from tpusim.jaxe import ensure_x64
 from tpusim.jaxe.backend import (
     _KNOWN_PROVIDERS,
     _MOST_REQUESTED_PROVIDERS,
-    format_fit_error,
+    decode_placements,
 )
 from tpusim.jaxe.kernels import (
+    CARRY_AXES,
+    PODX_AXES,
+    STATICS_AXES,
     Carry,
     EngineConfig,
     PodX,
     Statics,
-    carry_init,
+    carry_init_host,
     make_step,
-    pod_columns_to_device,
-    statics_to_device,
+    pod_columns_to_host,
+    statics_to_host,
 )
 from tpusim.jaxe.sharding import pad_node_axis, snap_shardings
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
@@ -76,38 +79,52 @@ def _pad_axis(a: np.ndarray, axis: int, target: int, fill=0) -> np.ndarray:
     return np.pad(a, widths, constant_values=fill)
 
 
-def _unify(statics: Statics, carry: Carry, xs: PodX, sig_max: dict,
-           s_max: int, p_max: int) -> Tuple[Statics, Carry, PodX]:
-    """Pad signature / scalar / pod axes to the common shape (host-side)."""
-    st = statics._replace(
-        alloc_scalar=jnp.asarray(_pad_axis(np.asarray(statics.alloc_scalar), 1, s_max)),
-        selector_ok=jnp.asarray(_pad_axis(np.asarray(statics.selector_ok), 0,
-                                          sig_max["sel"])),
-        taint_ok=jnp.asarray(_pad_axis(np.asarray(statics.taint_ok), 0,
-                                       sig_max["tol"])),
-        intolerable=jnp.asarray(_pad_axis(np.asarray(statics.intolerable), 0,
-                                          sig_max["tol"])),
-        affinity_count=jnp.asarray(_pad_axis(np.asarray(statics.affinity_count), 0,
-                                             sig_max["aff"])),
-        avoid_score=jnp.asarray(_pad_axis(np.asarray(statics.avoid_score), 0,
-                                          sig_max["avoid"])),
-        host_ok=jnp.asarray(_pad_axis(np.asarray(statics.host_ok), 0,
-                                      sig_max["host"])))
-    ca = carry._replace(
-        used_scalar=jnp.asarray(_pad_axis(np.asarray(carry.used_scalar), 1, s_max)))
+def _axis_targets(host_trees) -> dict:
+    """Max size per named (non-node) axis across scenarios, derived from the
+    kernels axis registries — new state fields unify automatically."""
+    targets: dict = {}
+    for statics, carry, xs in host_trees:
+        trees = [(statics, STATICS_AXES, 0), (carry, CARRY_AXES, 0),
+                 (xs, PODX_AXES, 1)]
+        for tree, axes_map, offset in trees:
+            for name, arr in tree._asdict().items():
+                for i, axis in enumerate(axes_map[name]):
+                    if axis == "node":
+                        continue
+                    size = np.asarray(arr).shape[i + offset]
+                    targets[axis] = max(targets.get(axis, 0), size)
+    return targets
 
-    p = xs.req_cpu.shape[0]
+
+def _unify_tree(tree, axes_map, targets: dict, axis_offset: int = 0):
     fields = {}
-    for name, arr in xs._asdict().items():
+    for name, arr in tree._asdict().items():
         arr = np.asarray(arr)
-        if name == "req_scalar":
-            arr = _pad_axis(arr, 1, s_max)
-        fields[name] = _pad_axis(arr, 0, p_max)
+        for i, axis in enumerate(axes_map[name]):
+            if axis == "node":
+                continue
+            arr = _pad_axis(arr, i + axis_offset, targets[axis])
+        fields[name] = arr
+    return fields
+
+
+def _unify(statics: Statics, carry: Carry, xs: PodX, targets: dict,
+           p_max: int) -> Tuple[Statics, Carry, PodX]:
+    """Pad signature / scalar / pod axes to the common shape (host-side)."""
+    st_fields = _unify_tree(statics, STATICS_AXES, targets)
+    ca_fields = _unify_tree(carry, CARRY_AXES, targets)
+
+    p = np.asarray(xs.req_cpu).shape[0]
+    fields = _unify_tree(xs, PODX_AXES, targets, axis_offset=1)
+    fields = {k: _pad_axis(v, 0, p_max) for k, v in fields.items()}
     if p_max > p:
         # ghost pods: infeasible everywhere, never advance rr or bind
+        fields["req_cpu"] = fields["req_cpu"].copy()
         fields["req_cpu"][p:] = GHOST_CPU
+        fields["zero_request"] = fields["zero_request"].copy()
         fields["zero_request"][p:] = False
-    return st, ca, PodX(**{k: jnp.asarray(v) for k, v in fields.items()})
+    # stays on host: the single device upload happens after scenario stacking
+    return Statics(**st_fields), Carry(**ca_fields), PodX(**fields)
 
 
 def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
@@ -128,8 +145,20 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         return []
     ensure_x64()
 
+    # zero-node scenarios can't join the batch (no node axis to pad onto);
+    # resolve them host-side exactly like JaxBackend.schedule's empty guard
+    empty_results: dict = {}
+    batch_indices: List[int] = []
     compiled_list = []
-    for snapshot, pods in scenarios:
+    for i, (snapshot, pods) in enumerate(scenarios):
+        if not snapshot.nodes:
+            msg = "no nodes available to schedule pods"
+            placements = [Placement(pod=mark_unschedulable(p, msg),
+                                    reason="Unschedulable", message=msg)
+                          for p in pods]
+            empty_results[i] = WhatIfResult(placements=placements, scheduled=0,
+                                            unschedulable=len(pods))
+            continue
         compiled, cols = compile_cluster(snapshot, pods)
         if compiled.unsupported:
             detail = "; ".join(sorted(set(compiled.unsupported))[:5])
@@ -137,31 +166,30 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
                 "what-if batching requires jax-compilable scenarios; "
                 f"unsupported: {detail} (run this scenario on the reference "
                 "backend instead)")
+        batch_indices.append(i)
         compiled_list.append((compiled, cols))
+    if not compiled_list:
+        return [empty_results[i] for i in range(len(scenarios))]
 
     n_snap_shards = mesh.shape["snap"] if mesh is not None else 1
     n_node_shards = mesh.shape["node"] if mesh is not None else 1
 
+    # host-side trees: unify + pad on numpy, upload once after stacking
+    host_trees = [(statics_to_host(compiled), carry_init_host(compiled),
+                   pod_columns_to_host(cols)) for compiled, cols in compiled_list]
+
     # common shapes
-    sig_max = {
-        "sel": max(c.tables.selector_ok.shape[0] for c, _ in compiled_list),
-        "tol": max(c.tables.taint_ok.shape[0] for c, _ in compiled_list),
-        "aff": max(c.tables.affinity_count.shape[0] for c, _ in compiled_list),
-        "avoid": max(c.tables.avoid_score.shape[0] for c, _ in compiled_list),
-        "host": max(c.tables.host_ok.shape[0] for c, _ in compiled_list),
-    }
+    targets = _axis_targets(host_trees)
     s_max = max(len(c.scalar_names) for c, _ in compiled_list)
-    p_max = max(len(pods) for _, pods in scenarios)
+    p_max = max(len(pods) for i, (_, pods) in enumerate(scenarios)
+                if i in set(batch_indices))
     n_max = max(c.statics.alloc_cpu.shape[0] for c, _ in compiled_list)
     # one pad target: max nodes rounded up to the node-shard multiple
     n_target = -(-n_max // n_node_shards) * n_node_shards
 
     per_scenario = []
-    for compiled, cols in compiled_list:
-        statics = statics_to_device(compiled)
-        carry = carry_init(compiled)
-        statics, carry, xs = _unify(statics, carry, pod_columns_to_device(cols),
-                                    sig_max, s_max, p_max)
+    for statics, carry, xs in host_trees:
+        statics, carry, xs = _unify(statics, carry, xs, targets, p_max)
         statics, carry, _ = pad_node_axis(statics, carry, n_target)
         per_scenario.append((carry, statics, xs))
 
@@ -170,7 +198,9 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     while len(per_scenario) % n_snap_shards != 0:
         per_scenario.append(per_scenario[0])
 
-    stack = lambda trees: jax.tree.map(lambda *a: jnp.stack(a), *trees)  # noqa: E731
+    # np.stack keeps this on host; jnp.asarray below is the single upload
+    stack = lambda trees: jax.tree.map(  # noqa: E731
+        lambda *a: jnp.asarray(np.stack([np.asarray(x) for x in a])), *trees)
     carries = stack([t[0] for t in per_scenario])
     statics_b = stack([t[1] for t in per_scenario])
     xs_b = stack([t[2] for t in per_scenario])
@@ -203,24 +233,16 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         choices_b = np.asarray(choices_b)
     counts_b = np.asarray(counts_b)
 
-    results: List[WhatIfResult] = []
-    for i in range(real_count):
-        compiled, _ = compiled_list[i]
+    batch_results: dict = {}
+    for b in range(real_count):
+        i = batch_indices[b]
+        compiled, _ = compiled_list[b]
         _, pods = scenarios[i]
-        names = compiled.statics.names
-        strings = reason_strings(compiled.scalar_names)
-        placements: List[Placement] = []
-        scheduled = 0
-        for j, pod in enumerate(pods):
-            c = int(choices_b[i, j])
-            if c >= 0:
-                scheduled += 1
-                placements.append(Placement(pod=bind_pod(pod, names[c]),
-                                            node_name=names[c]))
-            else:
-                msg = format_fit_error(len(names), counts_b[i, j], strings)
-                placements.append(Placement(pod=mark_unschedulable(pod, msg),
-                                            reason="Unschedulable", message=msg))
-        results.append(WhatIfResult(placements=placements, scheduled=scheduled,
-                                    unschedulable=len(pods) - scheduled))
-    return results
+        placements, scheduled = decode_placements(
+            pods, choices_b[b], counts_b[b], compiled.statics.names,
+            reason_strings(compiled.scalar_names))
+        batch_results[i] = WhatIfResult(placements=placements,
+                                        scheduled=scheduled,
+                                        unschedulable=len(pods) - scheduled)
+    batch_results.update(empty_results)
+    return [batch_results[i] for i in range(len(scenarios))]

@@ -75,7 +75,7 @@ def main() -> None:
     from tpusim.jaxe import ensure_x64
     from tpusim.jaxe.backend import _MOST_REQUESTED_PROVIDERS  # noqa: F401
     from tpusim.jaxe.kernels import (
-        EngineConfig,
+        config_for,
         carry_init,
         pod_columns_to_device,
         schedule_scan,
@@ -107,8 +107,9 @@ def main() -> None:
     compiled, cols = compile_cluster(snapshot, pods)
     log(f"host compile (intern+tables): {time.perf_counter() - t0:.1f}s")
 
-    config = EngineConfig(most_requested=False,
-                          num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+    config = config_for(
+        [compiled], most_requested=False,
+        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
     carry = carry_init(compiled)
     statics = statics_to_device(compiled)
     xs = pod_columns_to_device(cols)

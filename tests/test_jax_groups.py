@@ -1,0 +1,409 @@
+"""Differential parity for the pod-group device features: host ports,
+SelectorSpreadPriority, and inter-pod (anti)affinity (predicate + priority).
+
+Every case runs the same workload through ReferenceBackend (the Go-semantics
+oracle) and JaxBackend(fallback="error") — no silent fallback — and asserts
+byte-identical placements and failure messages.
+"""
+
+import random
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.api.types import (
+    LABEL_ZONE_FAILURE_DOMAIN,
+    LABEL_ZONE_REGION,
+    Pod,
+    Service,
+)
+from tpusim.backends import ReferenceBackend, placement_hash
+from tpusim.jaxe.backend import JaxBackend
+
+
+def assert_parity(pods, snapshot, provider="DefaultProvider", hard_weight=10):
+    ref = ReferenceBackend(
+        provider=provider,
+        hard_pod_affinity_symmetric_weight=hard_weight).schedule(pods, snapshot)
+    jx = JaxBackend(
+        provider=provider, fallback="error",
+        hard_pod_affinity_symmetric_weight=hard_weight).schedule(pods, snapshot)
+    for i, (r, j) in enumerate(zip(ref, jx)):
+        assert (r.node_name, r.reason) == (j.node_name, j.reason), (
+            f"pod {i} ({r.pod.name}): ref={r.node_name or r.message!r} "
+            f"jax={j.node_name or j.message!r}")
+        assert r.message == j.message, f"pod {i}: {r.message!r} != {j.message!r}"
+    assert placement_hash(ref) == placement_hash(jx)
+    return ref
+
+
+def port_pod(name, port, milli_cpu=100, host_ip="", protocol="", node_name="",
+             phase=""):
+    obj = {
+        "metadata": {"name": name, "namespace": "default", "uid": name,
+                     "labels": {}},
+        "spec": {"containers": [{
+            "name": "c",
+            "ports": [{k: v for k, v in [("hostPort", port), ("hostIP", host_ip),
+                                         ("protocol", protocol)] if v}],
+            "resources": {"requests": {"cpu": f"{milli_cpu}m"}}}]},
+        "status": {},
+    }
+    if node_name:
+        obj["spec"]["nodeName"] = node_name
+    if phase:
+        obj["status"]["phase"] = phase
+    return Pod.from_obj(obj)
+
+
+def service(name, selector, namespace="default"):
+    return Service.from_obj({"metadata": {"name": name, "namespace": namespace},
+                             "spec": {"selector": selector}})
+
+
+# ---------------------------------------------------------------------------
+# host ports
+# ---------------------------------------------------------------------------
+
+
+def test_host_ports_one_per_node():
+    snap = ClusterSnapshot(nodes=[make_node(f"n{i}") for i in range(3)])
+    pods = [port_pod(f"p{i}", 8080) for i in range(5)]
+    placements = assert_parity(pods, snap)
+    assert sum(1 for p in placements if p.scheduled) == 3
+    assert "didn't have free ports" in placements[4].message
+
+
+def test_host_ports_seeded_from_existing_pods():
+    nodes = [make_node("a"), make_node("b")]
+    existing = [port_pod("e0", 9000, node_name="a", phase="Running")]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing)
+    placements = assert_parity([port_pod("p0", 9000)], snap)
+    assert placements[0].node_name == "b"
+
+
+def test_host_ports_wildcard_ip_semantics():
+    """0.0.0.0 conflicts with any IP; distinct IPs coexist; protocols differ."""
+    snap = ClusterSnapshot(nodes=[make_node("only")])
+    cases = [
+        # specific ip then wildcard same port: conflict
+        ([port_pod("a1", 80, host_ip="10.0.0.1"), port_pod("a2", 80)], 1),
+        # two distinct specific ips: both fit
+        ([port_pod("b1", 80, host_ip="10.0.0.1"),
+          port_pod("b2", 80, host_ip="10.0.0.2")], 2),
+        # same port different protocol: both fit
+        ([port_pod("c1", 80), port_pod("c2", 80, protocol="UDP")], 2),
+    ]
+    for pods, want in cases:
+        placements = assert_parity(pods, snap)
+        assert sum(1 for p in placements if p.scheduled) == want, pods[0].name
+
+
+# ---------------------------------------------------------------------------
+# selector spreading
+# ---------------------------------------------------------------------------
+
+
+def test_selector_spread_prefers_empty_nodes():
+    nodes = [make_node(f"n{i}") for i in range(3)]
+    existing = [make_pod("e0", node_name="n0", phase="Running",
+                         labels={"app": "web"})]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing,
+                           services=[service("web", {"app": "web"})])
+    placements = assert_parity(
+        [make_pod(f"p{i}", milli_cpu=10, labels={"app": "web"})
+         for i in range(2)], snap)
+    assert all(p.node_name != "n0" for p in placements)
+
+
+def test_selector_spread_with_zones():
+    nodes = []
+    for i in range(4):
+        nodes.append(make_node(f"n{i}", labels={
+            LABEL_ZONE_REGION: "r1",
+            LABEL_ZONE_FAILURE_DOMAIN: f"z{i % 2}"}))
+    existing = [make_pod(f"e{i}", node_name=f"n{i % 2}", phase="Running",
+                         labels={"app": "api"}) for i in range(3)]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing,
+                           services=[service("api", {"app": "api"})])
+    assert_parity([make_pod(f"p{i}", milli_cpu=10, labels={"app": "api"})
+                   for i in range(6)], snap)
+
+
+def test_selector_spread_namespace_scoped():
+    """A service only selects same-namespace pods; other-namespace twins with
+    identical labels must not count."""
+    nodes = [make_node(f"n{i}") for i in range(2)]
+    existing = [
+        make_pod("same-ns", node_name="n0", phase="Running", labels={"app": "x"}),
+        make_pod("other-ns", node_name="n1", phase="Running",
+                 namespace="prod", labels={"app": "x"}),
+    ]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing,
+                           services=[service("x", {"app": "x"})])
+    placements = assert_parity([make_pod("p", milli_cpu=10,
+                                         labels={"app": "x"})], snap)
+    assert placements[0].node_name == "n1"
+
+
+# ---------------------------------------------------------------------------
+# inter-pod affinity predicate
+# ---------------------------------------------------------------------------
+
+
+def _anti(selector, key="kubernetes.io/hostname"):
+    return {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+        {"labelSelector": {"matchLabels": selector}, "topologyKey": key}]}}
+
+
+def _aff(selector, key="kubernetes.io/hostname"):
+    return {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+        {"labelSelector": {"matchLabels": selector}, "topologyKey": key}]}}
+
+
+def test_required_affinity_zone_topology():
+    nodes = [make_node(f"n{i}", labels={"zone": f"z{i % 2}"}) for i in range(4)]
+    existing = [make_pod("db", node_name="n1", phase="Running",
+                         labels={"app": "db"})]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing)
+    placements = assert_parity(
+        [make_pod(f"w{i}", milli_cpu=10, labels={"app": "web"},
+                  affinity=_aff({"app": "db"}, key="zone")) for i in range(3)],
+        snap)
+    # zone z1 = {n1, n3}; all web pods must land there
+    assert all(p.node_name in ("n1", "n3") for p in placements)
+
+
+def test_required_affinity_first_pod_self_match():
+    """First pod of its group: no matching pod exists anywhere, but the pod
+    matches its own term -> schedulable (predicates.go:1303-1320)."""
+    snap = ClusterSnapshot(nodes=[make_node("a"), make_node("b")])
+    pods = [make_pod(f"g{i}", milli_cpu=10, labels={"app": "grp"},
+                     affinity=_aff({"app": "grp"}, key="kubernetes.io/hostname"))
+            for i in range(3)]
+    placements = assert_parity(pods, snap)
+    # pod 0 seeds a node; the rest must co-locate on it
+    assert placements[0].scheduled
+    hosts = {p.node_name for p in placements}
+    assert len(hosts) == 1
+
+
+def test_required_affinity_no_self_match_unschedulable():
+    """Pod requires affinity to a group it doesn't belong to and none exists:
+    unschedulable with pod-affinity-rules reason."""
+    snap = ClusterSnapshot(nodes=[make_node("a")])
+    pod = make_pod("p", milli_cpu=10, labels={"app": "web"},
+                   affinity=_aff({"app": "db"}))
+    placements = assert_parity([pod], snap)
+    assert not placements[0].scheduled
+    assert "didn't match pod affinity rules" in placements[0].message
+
+
+def test_existing_pods_anti_affinity_symmetric():
+    """An existing pod's required anti-affinity blocks the NEW pod (the
+    symmetric check, predicates.go _satisfies_existing_pods_anti_affinity)."""
+    nodes = [make_node(f"n{i}", labels={"zone": f"z{i % 2}"}) for i in range(4)]
+    guard = make_pod("guard", node_name="n0", phase="Running",
+                     labels={"app": "guard"})
+    guard.spec.affinity = None
+    guard = Pod.from_obj({**guard.to_obj(),
+                          "spec": {**guard.to_obj()["spec"],
+                                   "affinity": _anti({"app": "web"}, key="zone")}})
+    snap = ClusterSnapshot(nodes=nodes, pods=[guard])
+    placements = assert_parity(
+        [make_pod("w", milli_cpu=10, labels={"app": "web"})], snap)
+    # zone z0 = {n0, n2} is forbidden by the guard's anti-affinity
+    assert placements[0].node_name in ("n1", "n3")
+
+
+def test_anti_affinity_among_new_pods_zone():
+    nodes = [make_node(f"n{i}", labels={"zone": f"z{i}"}) for i in range(3)]
+    snap = ClusterSnapshot(nodes=nodes)
+    pods = [make_pod(f"p{i}", milli_cpu=10, labels={"app": "spread"},
+                     affinity=_anti({"app": "spread"}, key="zone"))
+            for i in range(4)]
+    placements = assert_parity(pods, snap)
+    assert sum(1 for p in placements if p.scheduled) == 3
+    assert {p.node_name for p in placements if p.scheduled} == {"n0", "n1", "n2"}
+
+
+def test_anti_affinity_nodes_missing_topology_label():
+    """Nodes without the topology label never match NodesHaveSameTopologyKey —
+    anti-affinity cannot fire there."""
+    nodes = [make_node("labeled", labels={"rack": "r1"}), make_node("bare")]
+    existing = [make_pod("e", node_name="labeled", phase="Running",
+                         labels={"app": "x"})]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing)
+    placements = assert_parity(
+        [make_pod(f"p{i}", milli_cpu=10, labels={"app": "x"},
+                  affinity=_anti({"app": "x"}, key="rack")) for i in range(2)],
+        snap)
+    # "labeled" is blocked; "bare" has no rack label so the term can't match
+    assert all(p.node_name == "bare" for p in placements)
+
+
+def test_pending_snapshot_pod_does_not_block_self_match():
+    """Regression (review finding): a PENDING snapshot pod (no nodeName) is
+    dropped by the reference pod lister and must not make 'matching pod
+    exists' true — the first-pod self-match escape still applies."""
+    nodes = [make_node("a", labels={"zone": "z1"}),
+             make_node("b", labels={"zone": "z2"})]
+    pending = make_pod("pending", labels={"app": "web"})  # no nodeName
+    snap = ClusterSnapshot(nodes=nodes, pods=[pending])
+    pod = make_pod("p", milli_cpu=10, labels={"app": "web"},
+                   affinity=_aff({"app": "web"}, key="zone"))
+    placements = assert_parity([pod], snap)
+    assert placements[0].scheduled
+
+
+def test_unplaced_snapshot_pod_feeds_matching_exists():
+    """A snapshot pod on an unknown node still makes 'matching pod exists'
+    true for the first-pod special case -> new pod becomes unschedulable."""
+    snap = ClusterSnapshot(
+        nodes=[make_node("a")],
+        pods=[make_pod("ghost", node_name="gone-node", phase="Running",
+                       labels={"app": "grp"})])
+    pod = make_pod("p", milli_cpu=10, labels={"app": "grp"},
+                   affinity=_aff({"app": "grp"}, key="zone"))
+    placements = assert_parity([pod], snap)
+    assert not placements[0].scheduled
+
+
+# ---------------------------------------------------------------------------
+# inter-pod affinity priority (preferred terms + symmetric hard weight)
+# ---------------------------------------------------------------------------
+
+
+def test_preferred_affinity_attracts():
+    nodes = [make_node(f"n{i}", labels={"zone": f"z{i}"}) for i in range(3)]
+    existing = [make_pod("cache", node_name="n2", phase="Running",
+                         labels={"app": "cache"})]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing)
+    pod = make_pod("p", milli_cpu=10, labels={"app": "web"}, affinity={
+        "podAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 100, "podAffinityTerm": {
+                "labelSelector": {"matchLabels": {"app": "cache"}},
+                "topologyKey": "zone"}}]}})
+    placements = assert_parity([pod], snap)
+    assert placements[0].node_name == "n2"
+
+
+def test_preferred_anti_affinity_repels():
+    nodes = [make_node(f"n{i}", labels={"zone": f"z{i % 2}"}) for i in range(4)]
+    existing = [make_pod("noisy", node_name="n0", phase="Running",
+                         labels={"app": "noisy"})]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing)
+    pod = make_pod("p", milli_cpu=10, labels={"app": "quiet"}, affinity={
+        "podAntiAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 100, "podAffinityTerm": {
+                "labelSelector": {"matchLabels": {"app": "noisy"}},
+                "topologyKey": "zone"}}]}})
+    placements = assert_parity([pod], snap)
+    assert placements[0].node_name in ("n1", "n3")  # zone z1, away from noisy
+
+
+def test_hard_weight_zero_disables_symmetric_attraction():
+    nodes = [make_node("a", labels={"zone": "z1"}),
+             make_node("b", labels={"zone": "z2"})]
+    peer = Pod.from_obj({
+        "metadata": {"name": "peer", "namespace": "default", "uid": "peer",
+                     "labels": {"app": "db"}},
+        "spec": {"nodeName": "b", "affinity": _aff({"app": "web"}, key="zone"),
+                 "containers": [{"name": "c", "resources": {}}]},
+        "status": {"phase": "Running"}})
+    snap = ClusterSnapshot(nodes=nodes, pods=[peer])
+    pod = make_pod("p", milli_cpu=100, labels={"app": "web"})
+    assert_parity([pod], snap, hard_weight=0)
+    assert_parity([pod], snap, hard_weight=50)
+
+
+def test_existing_preferred_terms_score_new_pod():
+    """Existing pods' PREFERRED (anti)affinity terms also score the incoming
+    pod (interpod_affinity.go processPod ex_has_* branches)."""
+    nodes = [make_node(f"n{i}", labels={"zone": f"z{i}"}) for i in range(2)]
+    hater = Pod.from_obj({
+        "metadata": {"name": "hater", "namespace": "default", "uid": "hater",
+                     "labels": {"app": "hater"}},
+        "spec": {"nodeName": "n0", "containers": [{"name": "c", "resources": {}}],
+                 "affinity": {"podAntiAffinity": {
+                     "preferredDuringSchedulingIgnoredDuringExecution": [
+                         {"weight": 77, "podAffinityTerm": {
+                             "labelSelector": {"matchLabels": {"app": "victim"}},
+                             "topologyKey": "zone"}}]}}},
+        "status": {"phase": "Running"}})
+    snap = ClusterSnapshot(nodes=nodes, pods=[hater])
+    placements = assert_parity(
+        [make_pod("v", milli_cpu=10, labels={"app": "victim"})], snap)
+    assert placements[0].node_name == "n1"
+
+
+# ---------------------------------------------------------------------------
+# randomized differential sweep + wavefront/what-if coverage
+# ---------------------------------------------------------------------------
+
+
+def test_randomized_mixed_groups_parity():
+    rng = random.Random(7)
+    zones = ["za", "zb", "zc"]
+    nodes = [make_node(f"n{i}", milli_cpu=rng.choice([2000, 4000]),
+                       memory=rng.choice([4, 8]) * 1024**3,
+                       labels={"zone": rng.choice(zones),
+                               LABEL_ZONE_REGION: "r",
+                               LABEL_ZONE_FAILURE_DOMAIN: rng.choice(zones)})
+             for i in range(12)]
+    existing = []
+    for i in range(8):
+        p = make_pod(f"e{i}", milli_cpu=rng.randrange(100, 500),
+                     node_name=f"n{rng.randrange(12)}", phase="Running",
+                     labels={"app": rng.choice(["web", "db", "cache"])})
+        existing.append(p)
+    services = [service("web", {"app": "web"}), service("db", {"app": "db"})]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing, services=services)
+
+    pods = []
+    for i in range(40):
+        app = rng.choice(["web", "db", "cache"])
+        kwargs = {"labels": {"app": app}}
+        roll = rng.random()
+        if roll < 0.25:
+            kwargs["affinity"] = _anti({"app": app},
+                                       key=rng.choice(["zone",
+                                                       "kubernetes.io/hostname"]))
+        elif roll < 0.45:
+            kwargs["affinity"] = _aff({"app": rng.choice(["web", "db"])},
+                                      key="zone")
+        elif roll < 0.6:
+            kwargs["affinity"] = {
+                "podAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": rng.randrange(1, 100), "podAffinityTerm": {
+                        "labelSelector": {"matchLabels": {"app": "db"}},
+                        "topologyKey": "zone"}}]}}
+        pods.append(make_pod(f"p{i}", milli_cpu=rng.randrange(50, 600),
+                             memory=rng.randrange(2**20, 2**28), **kwargs))
+    assert_parity(pods, snap)
+
+
+def test_wavefront_runs_with_groups():
+    """Wavefront mode threads the presence state between waves (approximate
+    within a wave, like resources; just assert it executes and is sane)."""
+    snap = ClusterSnapshot(nodes=[make_node(f"n{i}") for i in range(4)])
+    pods = [make_pod(f"p{i}", milli_cpu=10, labels={"app": "s"},
+                     affinity=_anti({"app": "s"})) for i in range(8)]
+    placements = JaxBackend(fallback="error", batch_size=2).schedule(pods, snap)
+    assert sum(1 for p in placements if p.scheduled) <= 4
+    assert sum(1 for p in placements if p.scheduled) >= 2
+
+
+def test_what_if_with_groups():
+    from tpusim.jaxe.whatif import run_what_if
+
+    scen_a = (ClusterSnapshot(nodes=[make_node(f"a{i}") for i in range(3)]),
+              [make_pod(f"p{i}", milli_cpu=10, labels={"app": "x"},
+                        affinity=_anti({"app": "x"})) for i in range(5)])
+    scen_b = (ClusterSnapshot(nodes=[make_node(f"b{i}") for i in range(2)]),
+              [port_pod(f"q{i}", 8080) for i in range(4)])
+    results = run_what_if([scen_a, scen_b])
+    assert results[0].scheduled == 3 and results[0].unschedulable == 2
+    assert results[1].scheduled == 2 and results[1].unschedulable == 2
+    # must match per-scenario reference runs exactly
+    for (snap, pods), res in zip([scen_a, scen_b], results):
+        ref = ReferenceBackend().schedule(list(pods), snap)
+        assert placement_hash(ref) == placement_hash(res.placements)

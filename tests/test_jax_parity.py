@@ -162,21 +162,24 @@ def test_parity_prescheduled_pods():
     assert_parity(pods, snap)
 
 
-def test_fallback_on_interpod_affinity():
+def test_interpod_affinity_native():
+    """Inter-pod anti-affinity now runs natively on the jax backend (no
+    fallback): fallback='error' must succeed and match the reference."""
     from tpusim.api.types import Affinity
 
     snap = synthetic_cluster(3)
-    pod = make_pod("p", milli_cpu=100, labels={"app": "web"})
-    pod.spec.affinity = Affinity.from_obj({
-        "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
-            {"labelSelector": {"matchLabels": {"app": "web"}},
-             "topologyKey": "kubernetes.io/hostname"}]}})
-    with pytest.raises(NotImplementedError):
-        JaxBackend(fallback="error").schedule([pod], snap)
-    # default fallback matches reference exactly
-    ref = ReferenceBackend().schedule([pod], snap)
-    jx = JaxBackend().schedule([pod], snap)
-    assert placement_hash(ref) == placement_hash(jx)
+    pods = []
+    for i in range(5):
+        pod = make_pod(f"p{i}", milli_cpu=100, labels={"app": "web"})
+        pod.spec.affinity = Affinity.from_obj({
+            "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"app": "web"}},
+                 "topologyKey": "kubernetes.io/hostname"}]}})
+        pods.append(pod)
+    placements = assert_parity(pods, snap)
+    # 3 nodes, one web pod each; pods 4 and 5 violate anti-affinity everywhere
+    assert sum(1 for p in placements if p.scheduled) == 3
+    assert "didn't match pod affinity/anti-affinity" in placements[4].message
 
 
 def test_jax_backend_no_nodes():
@@ -195,9 +198,9 @@ def test_node_only_scalar_resource_no_crash():
     assert_parity([make_pod("p", milli_cpu=100)], snap)
 
 
-def test_fallback_on_existing_pod_required_affinity():
-    """Regression: existing pods with REQUIRED pod affinity feed the symmetric
-    hard-affinity weight — must fall back, not silently diverge (review finding)."""
+def test_existing_pod_required_affinity_native():
+    """Existing pods with REQUIRED pod affinity feed the symmetric
+    hard-affinity weight of InterPodAffinityPriority — natively on device."""
     from tpusim.api.types import Affinity
 
     nodes = [make_node("a", labels={"zone": "z1"}),
@@ -209,9 +212,21 @@ def test_fallback_on_existing_pod_required_affinity():
              "topologyKey": "zone"}]}})
     snap = ClusterSnapshot(nodes=nodes, pods=[peer])
     pod = make_pod("p", milli_cpu=100, labels={"app": "web"})
+    placements = assert_parity([pod], snap)
+    assert placements[0].node_name == "b"  # symmetric weight attracts to the peer's zone
+
+
+def test_fallback_on_group_blowup():
+    """The only remaining compile-time fallback: more distinct pod-group
+    signatures than state.MAX_GROUPS."""
+    from tpusim.jaxe.state import MAX_GROUPS
+
+    snap = synthetic_cluster(2)
+    pods = [make_pod(f"p{i}", milli_cpu=1, labels={"uniq": f"u{i}"},
+                     affinity={"podAntiAffinity": {
+                         "requiredDuringSchedulingIgnoredDuringExecution": [
+                             {"labelSelector": {"matchLabels": {"uniq": f"u{i}"}},
+                              "topologyKey": "kubernetes.io/hostname"}]}})
+            for i in range(MAX_GROUPS + 1)]
     with pytest.raises(NotImplementedError):
-        JaxBackend(fallback="error").schedule([pod], snap)
-    ref = ReferenceBackend().schedule([pod], snap)
-    jx = JaxBackend().schedule([pod], snap)
-    assert placement_hash(ref) == placement_hash(jx)
-    assert ref[0].node_name == "b"  # symmetric weight attracts to the peer's zone
+        JaxBackend(fallback="error").schedule(pods, snap)

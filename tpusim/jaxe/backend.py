@@ -1,12 +1,13 @@
 """JaxBackend: the SimulatorBackend implementation running on TPU/XLA.
 
-Exactness contract: for workloads within the compiled feature set (resources,
-node conditions/pressure, taints/tolerations, node selectors, node affinity,
-hostname pins, scalar resources, controller-avoid annotations) placements are
-IDENTICAL to ReferenceBackend — verified by randomized differential tests.
-Features whose state the device kernels don't carry yet (inter-pod
-(anti)affinity, host ports, services/selector-spreading) are detected at
-compile time and routed to the reference backend (fallback="reference") or
+Exactness contract: placements are IDENTICAL to ReferenceBackend — verified by
+randomized differential tests — across the full DefaultProvider feature set:
+resources/conditions/pressure, taints/tolerations, node selectors, node
+affinity, hostname pins, scalar resources, controller-avoid annotations, host
+ports, services/selector-spreading, and inter-pod (anti)affinity (pod-group
+presence state carried on device; state.GroupTables). The only compile-time
+fallback left is a group-count blowup (> state.MAX_GROUPS distinct pod
+signatures), routed to the reference backend (fallback="reference") or
 rejected (fallback="error").
 """
 
@@ -28,8 +29,8 @@ from tpusim.engine.providers import (
 )
 from tpusim.jaxe import ensure_x64
 from tpusim.jaxe.kernels import (
-    EngineConfig,
     carry_init,
+    config_for,
     pod_columns_to_device,
     schedule_scan,
     schedule_wavefront,
@@ -110,9 +111,11 @@ class JaxBackend:
             ).schedule(pods, snapshot)
 
         num_bits = NUM_FIXED_BITS + len(compiled.scalar_names)
-        config = EngineConfig(
+        config = config_for(
+            [compiled],
             most_requested=self.provider in _MOST_REQUESTED_PROVIDERS,
-            num_reason_bits=num_bits)
+            num_reason_bits=num_bits,
+            hard_weight=self.hard_pod_affinity_symmetric_weight)
 
         ensure_x64()
         carry = carry_init(compiled)

@@ -28,9 +28,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpusim.engine.priorities import ZONE_WEIGHTING
 from tpusim.jaxe.state import (
+    BIT_AFFINITY_NOT_MATCH,
+    BIT_AFFINITY_RULES,
+    BIT_ANTI_AFFINITY_RULES,
     BIT_DISK_PRESSURE,
+    BIT_EXISTING_ANTI_AFFINITY,
     BIT_HOSTNAME_MISMATCH,
+    BIT_HOST_PORTS,
     BIT_INSUFFICIENT_CPU,
     BIT_INSUFFICIENT_EPHEMERAL,
     BIT_INSUFFICIENT_GPU,
@@ -57,6 +63,8 @@ class Carry(NamedTuple):
     nonzero_cpu: jnp.ndarray
     nonzero_mem: jnp.ndarray
     pod_count: jnp.ndarray
+    presence: jnp.ndarray      # [G, N] int32 — pods per (group, node)
+    presence_dom: jnp.ndarray  # [G, K, D] int32 — presence summed per topo domain
     rr: jnp.ndarray            # scalar int64 — selectHost's lastNodeIndex
 
 
@@ -76,6 +84,28 @@ class Statics(NamedTuple):
     affinity_count: jnp.ndarray
     avoid_score: jnp.ndarray
     host_ok: jnp.ndarray
+    # pod-group tables (state.GroupTables; zero-size-semantics dummies when off)
+    port_conflict: jnp.ndarray
+    ss_match: jnp.ndarray
+    zone_dom: jnp.ndarray
+    topo_dom: jnp.ndarray
+    aff_valid: jnp.ndarray
+    aff_err: jnp.ndarray
+    aff_empty: jnp.ndarray
+    aff_match: jnp.ndarray
+    aff_key: jnp.ndarray
+    aff_hostname: jnp.ndarray
+    aff_self: jnp.ndarray
+    aff_unplaced: jnp.ndarray
+    anti_valid: jnp.ndarray
+    anti_err: jnp.ndarray
+    anti_empty: jnp.ndarray
+    anti_match: jnp.ndarray
+    anti_key: jnp.ndarray
+    anti_hostname: jnp.ndarray
+    pref_w: jnp.ndarray
+    pref_match: jnp.ndarray
+    pref_key: jnp.ndarray
 
 
 class PodX(NamedTuple):
@@ -95,6 +125,7 @@ class PodX(NamedTuple):
     aff_id: jnp.ndarray
     avoid_id: jnp.ndarray
     host_id: jnp.ndarray
+    group_id: jnp.ndarray
 
 
 @dataclass(frozen=True)
@@ -103,6 +134,13 @@ class EngineConfig:
 
     most_requested: bool = False  # LeastRequested -> MostRequested swap (TD/autoscaler)
     num_reason_bits: int = NUM_FIXED_BITS
+    # pod-group features — compiled in only when the workload needs them
+    has_ports: bool = False
+    has_services: bool = False
+    has_interpod: bool = False
+    hard_weight: int = 10         # HardPodAffinitySymmetricWeight
+    n_topo_doms: int = 1          # segment counts (incl. the invalid-0 bucket)
+    n_zone_doms: int = 1
 
 
 # ---------------------------------------------------------------------------
@@ -119,16 +157,28 @@ STATICS_AXES = dict(
     selector_ok=("sig_sel", "node"), taint_ok=("sig_tol", "node"),
     intolerable=("sig_tol", "node"), affinity_count=("sig_aff", "node"),
     avoid_score=("sig_avoid", "node"), host_ok=("sig_host", "node"),
+    port_conflict=("group", "group"), ss_match=("group", "group"),
+    zone_dom=("node",), topo_dom=("topo_key", "node"),
+    aff_valid=("group", "aff_term"), aff_err=("group",),
+    aff_empty=("group", "aff_term"), aff_match=("group", "aff_term", "group"),
+    aff_key=("group", "aff_term"), aff_hostname=("group", "aff_term"),
+    aff_self=("group", "aff_term"), aff_unplaced=("group", "aff_term"),
+    anti_valid=("group", "anti_term"), anti_err=("group",),
+    anti_empty=("group", "anti_term"), anti_match=("group", "anti_term", "group"),
+    anti_key=("group", "anti_term"), anti_hostname=("group", "anti_term"),
+    pref_w=("group", "pref_term"), pref_match=("group", "pref_term", "group"),
+    pref_key=("group", "pref_term"),
 )
 CARRY_AXES = dict(
     used_cpu=("node",), used_mem=("node",), used_gpu=("node",), used_eph=("node",),
     used_scalar=("node", "scalar"), nonzero_cpu=("node",), nonzero_mem=("node",),
-    pod_count=("node",), rr=(),
+    pod_count=("node",), presence=("group", "node"),
+    presence_dom=("group", "topo_key", "topo_dom"), rr=(),
 )
 PODX_AXES = dict(
     req_cpu=(), req_mem=(), req_gpu=(), req_eph=(), req_scalar=("scalar",),
     nz_cpu=(), nz_mem=(), zero_request=(), best_effort=(), sel_id=(),
-    tol_id=(), aff_id=(), avoid_id=(), host_id=(),
+    tol_id=(), aff_id=(), avoid_id=(), host_id=(), group_id=(),
 )
 # Node-axis pad fill per field (default 0). Exception: cond_fail_bits is
 # special-cased in sharding._pad_node_tree with a lazily-built infeasible
@@ -136,9 +186,24 @@ PODX_AXES = dict(
 PAD_FILLS: dict = {}
 
 
+def config_for(compiled_list, most_requested: bool, num_reason_bits: int,
+               hard_weight: int = 10) -> EngineConfig:
+    """Union EngineConfig across one or more CompiledClusters (the what-if
+    batch shares one jitted program; zero-filled tables are no-ops)."""
+    return EngineConfig(
+        most_requested=most_requested,
+        num_reason_bits=num_reason_bits,
+        has_ports=any(c.has_ports for c in compiled_list),
+        has_services=any(c.has_services for c in compiled_list),
+        has_interpod=any(c.has_interpod for c in compiled_list),
+        hard_weight=hard_weight,
+        n_topo_doms=max(c.n_topo_doms for c in compiled_list),
+        n_zone_doms=max(c.n_zone_doms for c in compiled_list))
+
+
 def statics_to_host(compiled: CompiledCluster) -> Statics:
     """Statics pytree over host numpy arrays (no device transfer)."""
-    s, t = compiled.statics, compiled.tables
+    s, t, gt = compiled.statics, compiled.tables, compiled.groups
     return Statics(
         alloc_cpu=s.alloc_cpu, alloc_mem=s.alloc_mem,
         alloc_gpu=s.alloc_gpu, alloc_eph=s.alloc_eph,
@@ -147,17 +212,42 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         disk_pressure=s.disk_pressure,
         selector_ok=t.selector_ok, taint_ok=t.taint_ok,
         intolerable=t.intolerable, affinity_count=t.affinity_count,
-        avoid_score=t.avoid_score, host_ok=t.host_ok)
+        avoid_score=t.avoid_score, host_ok=t.host_ok,
+        port_conflict=gt.port_conflict, ss_match=gt.ss_match,
+        zone_dom=gt.zone_dom, topo_dom=gt.topo_dom,
+        aff_valid=gt.aff_valid, aff_err=gt.aff_err, aff_empty=gt.aff_empty,
+        aff_match=gt.aff_match, aff_key=gt.aff_key,
+        aff_hostname=gt.aff_hostname, aff_self=gt.aff_self,
+        aff_unplaced=gt.aff_unplaced,
+        anti_valid=gt.anti_valid, anti_err=gt.anti_err,
+        anti_empty=gt.anti_empty, anti_match=gt.anti_match,
+        anti_key=gt.anti_key, anti_hostname=gt.anti_hostname,
+        pref_w=gt.pref_w, pref_match=gt.pref_match, pref_key=gt.pref_key)
+
+
+def _presence_dom_init(presence: np.ndarray, topo_dom: np.ndarray,
+                       n_doms: int) -> np.ndarray:
+    """presence_dom[g, k, d] = sum of presence[g, n] over nodes in domain d."""
+    g, _ = presence.shape
+    k = topo_dom.shape[0]
+    pd = np.zeros((g, k, n_doms), dtype=np.int32)
+    for ki in range(k):
+        np.add.at(pd[:, ki, :], (slice(None), topo_dom[ki]), presence)
+    return pd
 
 
 def carry_init_host(compiled: CompiledCluster) -> Carry:
     """Initial carry over host numpy arrays (no device transfer)."""
-    d = compiled.dynamic
+    d, gt = compiled.dynamic, compiled.groups
     return Carry(
         used_cpu=d.used_cpu, used_mem=d.used_mem, used_gpu=d.used_gpu,
         used_eph=d.used_eph, used_scalar=d.used_scalar,
         nonzero_cpu=d.nonzero_cpu, nonzero_mem=d.nonzero_mem,
-        pod_count=d.pod_count, rr=np.int64(0))
+        pod_count=d.pod_count,
+        presence=gt.presence,
+        presence_dom=_presence_dom_init(gt.presence, gt.topo_dom,
+                                        compiled.n_topo_doms),
+        rr=np.int64(0))
 
 
 def pod_columns_to_host(cols: PodColumns) -> PodX:
@@ -168,7 +258,7 @@ def pod_columns_to_host(cols: PodColumns) -> PodX:
         nz_cpu=cols.nz_cpu, nz_mem=cols.nz_mem,
         zero_request=cols.zero_request, best_effort=cols.best_effort,
         sel_id=cols.sel_id, tol_id=cols.tol_id, aff_id=cols.aff_id,
-        avoid_id=cols.avoid_id, host_id=cols.host_id)
+        avoid_id=cols.avoid_id, host_id=cols.host_id, group_id=cols.group_id)
 
 
 def _tree_to_device(tree):
@@ -207,6 +297,13 @@ def _balanced_score(req_cpu, req_mem, alloc_cpu, alloc_mem):
     return jnp.where((cpu_frac >= 1) | (mem_frac >= 1), 0, score)
 
 
+def _seg_rows(values, doms, num_segments: int):
+    """Row-wise segment sums: [T, N] values × [T, N] domain ids -> [T, D]."""
+    return jax.vmap(
+        lambda v, d: jax.ops.segment_sum(v, d, num_segments=num_segments)
+    )(values, doms)
+
+
 def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     """Filter + score one pod against the carried aggregates.
 
@@ -240,13 +337,84 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
                        << (NUM_FIXED_BITS + jnp.arange(st.alloc_scalar.shape[-1],
                                                        dtype=jnp.int64)))
         bits_general = bits_general | jnp.sum(scalar_bits, axis=-1)
+    if config.has_ports:
+        # PodFitsHostPorts (predicates.go:1019-1039), part of GeneralPredicates:
+        # a wanted port of my group conflicts with occupancy of any group present
+        port_bad = jnp.any(st.port_conflict[x.group_id][:, None]
+                           & (carry.presence > 0), axis=0)
+        fail_general = fail_general | port_bad
+        bits_general = bits_general | (
+            port_bad.astype(jnp.int64) << BIT_HOST_PORTS)
 
     fail_taint = ~st.taint_ok[x.tol_id]
     fail_mem_pressure = st.mem_pressure & x.best_effort
     fail_disk_pressure = st.disk_pressure
 
+    if config.has_interpod:
+        # MatchInterPodAffinity (predicates.go:1125-1450) — last in
+        # predicatesOrdering. Group-space matching is precompiled; here only
+        # presence/topology aggregation runs.
+        g = x.group_id
+        presence_f = carry.presence.astype(jnp.float64)
+        pd_f = carry.presence_dom.astype(jnp.float64)
+        k_count = st.topo_dom.shape[0]
+
+        # own required affinity terms (_satisfies_pods_affinity_anti_affinity)
+        mcount = st.aff_match[g].astype(jnp.float64) @ presence_f   # [Ta, N]
+        dom_rows = st.topo_dom[st.aff_key[g]]                       # [Ta, N]
+        valid_dom = dom_rows > 0
+        dc_at = jnp.take_along_axis(
+            _seg_rows(mcount, dom_rows, config.n_topo_doms), dom_rows, axis=1)
+        is_host = st.aff_hostname[g][:, None]
+        on_node = mcount > 0.5
+        term_matches = jnp.where(is_host, valid_dom & on_node,
+                                 valid_dom & (dc_at > 0.5))
+        # hostname terms scan only this node's pods (predicates.go: topologyKey
+        # == hostname restricts the search), so "matching pod exists" is
+        # per-node there and global (incl. unplaced snapshot pods) otherwise
+        exists = jnp.where(
+            is_host, on_node,
+            ((jnp.sum(mcount, axis=1) > 0.5) | st.aff_unplaced[g])[:, None])
+        term_ok = term_matches | ((~exists) & st.aff_self[g][:, None])
+        aff_fail = jnp.any(st.aff_valid[g][:, None] & ~term_ok,
+                           axis=0) | st.aff_err[g]
+
+        # own required anti-affinity terms
+        bmcount = st.anti_match[g].astype(jnp.float64) @ presence_f
+        bdom_rows = st.topo_dom[st.anti_key[g]]
+        bvalid = bdom_rows > 0
+        bdc_at = jnp.take_along_axis(
+            _seg_rows(bmcount, bdom_rows, config.n_topo_doms), bdom_rows, axis=1)
+        b_is_host = st.anti_hostname[g][:, None]
+        b_matches = jnp.where(b_is_host, bvalid & (bmcount > 0.5),
+                              bvalid & (bdc_at > 0.5))
+        anti_fail = jnp.any(st.anti_valid[g][:, None] & b_matches,
+                            axis=0) | st.anti_err[g]
+
+        # existing pods' anti-affinity vs me (symmetric check; runs first)
+        w = st.anti_valid & st.anti_match[:, :, g]                  # [G, Tb]
+        grp_present = jnp.sum(carry.presence, axis=1) > 0           # [G]
+        fail_all = jnp.any(w & st.anti_empty & grp_present[:, None])
+        key_oh = jax.nn.one_hot(st.anti_key, k_count, dtype=jnp.float64)
+        bad_dom = jnp.einsum("gtk,gt,gkd->kd", key_oh,
+                             (w & ~st.anti_empty).astype(jnp.float64), pd_f)
+        bad_at = jnp.take_along_axis(bad_dom, st.topo_dom, axis=1)  # [K, N]
+        exist_fail = jnp.any((st.topo_dom > 0) & (bad_at > 0.5),
+                             axis=0) | fail_all
+
+        fail_interpod = exist_fail | aff_fail | anti_fail
+        # two reasons per failure: the umbrella + the specific rule, in the
+        # engine's check order (existing-anti, affinity, anti-affinity)
+        interpod_bits = (jnp.int64(1) << BIT_AFFINITY_NOT_MATCH) | jnp.where(
+            exist_fail, jnp.int64(1) << BIT_EXISTING_ANTI_AFFINITY,
+            jnp.where(aff_fail, jnp.int64(1) << BIT_AFFINITY_RULES,
+                      jnp.int64(1) << BIT_ANTI_AFFINITY_RULES))
+    else:
+        fail_interpod = jnp.zeros_like(fail_cond)
+        interpod_bits = jnp.int64(0)
+
     feasible = ~(fail_cond | fail_general | fail_taint
-                 | fail_mem_pressure | fail_disk_pressure)
+                 | fail_mem_pressure | fail_disk_pressure | fail_interpod)
     # short-circuit reason selection: first failing stage wins
     reason_bits = jnp.where(
         fail_cond, st.cond_fail_bits,
@@ -256,7 +424,9 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
                                       jnp.int64(1) << BIT_MEMORY_PRESSURE,
                                       jnp.where(fail_disk_pressure,
                                                 jnp.int64(1) << BIT_DISK_PRESSURE,
-                                                jnp.int64(0))))))
+                                                jnp.where(fail_interpod,
+                                                          interpod_bits,
+                                                          jnp.int64(0)))))))
     n_feasible = jnp.sum(feasible)
 
     # ---- score ----
@@ -281,6 +451,62 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
 
     avoid = st.avoid_score[x.avoid_id] * AVOID_PODS_WEIGHT
     score = ratio + balanced + aff_norm + taint_norm + avoid
+
+    if config.has_services:
+        # SelectorSpreadPriority (selector_spreading.go:66-175): per-node count
+        # of same-namespace pods matched by my services' selectors, then the
+        # node/zone-blended normalize over feasible nodes
+        cnt = st.ss_match[x.group_id].astype(jnp.float64) @ \
+            carry.presence.astype(jnp.float64)                       # [N]
+        fcnt = jnp.where(feasible, cnt, 0.0)
+        max_node = jnp.max(fcnt)
+        zdom = st.zone_dom
+        zvalid = zdom > 0
+        zcnt = jax.ops.segment_sum(fcnt, zdom,
+                                   num_segments=config.n_zone_doms).at[0].set(0.0)
+        have_zones = jnp.any(feasible & zvalid)
+        max_zone = jnp.max(zcnt)
+        fscore = jnp.where(max_node > 0,
+                           MAX_PRIORITY * ((max_node - cnt) / max_node),
+                           float(MAX_PRIORITY))
+        zscore = jnp.where(max_zone > 0,
+                           MAX_PRIORITY * ((max_zone - zcnt[zdom]) / max_zone),
+                           float(MAX_PRIORITY))
+        blended = jnp.where(
+            have_zones & zvalid,
+            fscore * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zscore, fscore)
+        score = score + blended.astype(jnp.int64)
+
+    if config.has_interpod:
+        # InterPodAffinityPriority (interpod_affinity.go:118+): float64 counts
+        # from (a) my preferred terms over existing pods, (b) existing pods'
+        # preferred terms over me, (c) their required affinity × hard weight;
+        # all contributions are integer-valued so summation order is exact
+        p_w = st.pref_w[g]                                          # [Tp]
+        pcount = st.pref_match[g].astype(jnp.float64) @ presence_f  # [Tp, N]
+        pdom = st.topo_dom[st.pref_key[g]]                          # [Tp, N]
+        pdc_at = jnp.take_along_axis(
+            _seg_rows(pcount, pdom, config.n_topo_doms), pdom, axis=1)
+        counts = jnp.sum(p_w[:, None] * jnp.where(pdom > 0, pdc_at, 0.0), axis=0)
+
+        wb = st.pref_w * st.pref_match[:, :, g]                     # [G, Tp]
+        wc = float(config.hard_weight) * (
+            st.aff_valid & ~st.aff_empty
+            & st.aff_match[:, :, g]).astype(jnp.float64)            # [G, Ta]
+        key_oh_p = jax.nn.one_hot(st.pref_key, k_count, dtype=jnp.float64)
+        key_oh_a = jax.nn.one_hot(st.aff_key, k_count, dtype=jnp.float64)
+        wsum = (jnp.einsum("gtk,gt,gkd->kd", key_oh_p, wb, pd_f)
+                + jnp.einsum("gtk,gt,gkd->kd", key_oh_a, wc, pd_f))  # [K, D]
+        wsum_at = jnp.take_along_axis(wsum, st.topo_dom, axis=1)     # [K, N]
+        counts = counts + jnp.sum(
+            jnp.where(st.topo_dom > 0, wsum_at, 0.0), axis=0)
+
+        maxc = jnp.maximum(jnp.max(jnp.where(feasible, counts, -jnp.inf)), 0.0)
+        minc = jnp.minimum(jnp.min(jnp.where(feasible, counts, jnp.inf)), 0.0)
+        rng = maxc - minc
+        ip = jnp.where(rng > 0, MAX_PRIORITY * ((counts - minc) / rng), 0.0)
+        score = score + ip.astype(jnp.int64)
+
     return feasible, reason_bits, score, n_feasible
 
 
@@ -317,6 +543,18 @@ def make_step(config: EngineConfig):
 
         idx = jnp.maximum(choice, 0)
         gate = found.astype(jnp.int64)
+        gate32 = found.astype(jnp.int32)
+        if config.has_ports or config.has_services or config.has_interpod:
+            presence = carry.presence.at[x.group_id, idx].add(gate32)
+        else:
+            presence = carry.presence
+        if config.has_interpod:
+            k_count = st.topo_dom.shape[0]
+            dom_at = st.topo_dom[:, idx]                    # [K]
+            presence_dom = carry.presence_dom.at[
+                x.group_id, jnp.arange(k_count), dom_at].add(gate32)
+        else:
+            presence_dom = carry.presence_dom
         new_carry = Carry(
             used_cpu=carry.used_cpu.at[idx].add(gate * x.req_cpu),
             used_mem=carry.used_mem.at[idx].add(gate * x.req_mem),
@@ -326,6 +564,7 @@ def make_step(config: EngineConfig):
             nonzero_cpu=carry.nonzero_cpu.at[idx].add(gate * x.nz_cpu),
             nonzero_mem=carry.nonzero_mem.at[idx].add(gate * x.nz_mem),
             pod_count=carry.pod_count.at[idx].add(gate),
+            presence=presence, presence_dom=presence_dom,
             rr=rr_next)
 
         counts = jax.lax.cond(
@@ -370,6 +609,20 @@ def make_wavefront_step(config: EngineConfig):
             return target + jax.ops.segment_sum(amounts * gate, seg,
                                                 num_segments=n + 1)[:n]
 
+        gate32 = gate.astype(jnp.int32)
+        idxs = jnp.maximum(choices, 0)
+        if config.has_ports or config.has_services or config.has_interpod:
+            presence = carry.presence.at[xs.group_id, idxs].add(gate32)
+        else:
+            presence = carry.presence
+        if config.has_interpod:
+            k_count = st.topo_dom.shape[0]
+            dom_at = st.topo_dom[:, idxs]                   # [K, W]
+            presence_dom = carry.presence_dom.at[
+                xs.group_id[:, None], jnp.arange(k_count)[None, :],
+                dom_at.T].add(gate32[:, None])
+        else:
+            presence_dom = carry.presence_dom
         new_carry = Carry(
             used_cpu=scatter(xs.req_cpu, carry.used_cpu),
             used_mem=scatter(xs.req_mem, carry.used_mem),
@@ -380,6 +633,7 @@ def make_wavefront_step(config: EngineConfig):
             nonzero_cpu=scatter(xs.nz_cpu, carry.nonzero_cpu),
             nonzero_mem=scatter(xs.nz_mem, carry.nonzero_mem),
             pod_count=scatter(jnp.ones_like(gate), carry.pod_count),
+            presence=presence, presence_dom=presence_dom,
             rr=carry.rr + jnp.sum(advances))
 
         counts = jnp.where(

@@ -16,16 +16,24 @@ import numpy as np
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.api.types import (
+    LABEL_HOSTNAME,
     TAINT_PREFER_NO_SCHEDULE,
     Node,
     Pod,
     find_matching_untolerated_taint,
     tolerations_tolerate_taint,
 )
-from tpusim.engine.predicates import pod_matches_node_labels
+from tpusim.engine.predicates import (
+    get_namespaces_from_pod_affinity_term,
+    get_pod_affinity_terms,
+    get_pod_anti_affinity_terms,
+    pod_matches_node_labels,
+    pod_matches_term_namespace_and_selector,
+)
 from tpusim.engine.priorities import (
     calculate_node_affinity_priority_map,
     calculate_node_prefer_avoid_pods_priority_map,
+    get_zone_key,
 )
 from tpusim.engine.resources import (
     NodeInfo,
@@ -52,7 +60,12 @@ BIT_NODE_SELECTOR_MISMATCH = 10
 BIT_TAINTS_NOT_TOLERATED = 11
 BIT_MEMORY_PRESSURE = 12
 BIT_DISK_PRESSURE = 13
-NUM_FIXED_BITS = 14
+BIT_HOST_PORTS = 14
+BIT_AFFINITY_NOT_MATCH = 15     # MatchInterPodAffinity umbrella reason
+BIT_EXISTING_ANTI_AFFINITY = 16
+BIT_AFFINITY_RULES = 17
+BIT_ANTI_AFFINITY_RULES = 18
+NUM_FIXED_BITS = 19
 # bits >= NUM_FIXED_BITS: Insufficient <scalar resource s>, per interned name
 
 REASON_STRINGS = [
@@ -70,7 +83,15 @@ REASON_STRINGS = [
     "node(s) had taints that the pod didn't tolerate",
     "node(s) had memory pressure",
     "node(s) had disk pressure",
+    "node(s) didn't have free ports for the requested pod ports",
+    "node(s) didn't match pod affinity/anti-affinity",
+    "node(s) didn't satisfy existing pods anti-affinity rules",
+    "node(s) didn't match pod affinity rules",
+    "node(s) didn't match pod anti-affinity rules",
 ]
+
+# pod-group tables become O(G^2)/O(G^2·T): past this the backend falls back
+MAX_GROUPS = 512
 
 
 class Interner:
@@ -120,6 +141,50 @@ class SignatureTables:
 
 
 @dataclass
+class GroupTables:
+    """Pod-group tables for the features whose state depends on which pods sit
+    where: host ports (predicates.go:1019-1039), SelectorSpreadPriority
+    (selector_spreading.go:66-175), and inter-pod (anti)affinity
+    (predicates.go:1125-1450, interpod_affinity.go).
+
+    A "group" is an interned (namespace, labels, pod-(anti)affinity, host-ports)
+    pod signature over new + placed-existing pods; the device carries a
+    presence[G, N] count matrix plus per-topology-domain sums, and all symbolic
+    matching below is precompiled host-side with the parity engine's matchers.
+
+    Topology domains: for each used topologyKey k, topo_dom[k, n] interns the
+    node's label value, with 0 reserved for "label missing" (never matches,
+    NodesHaveSameTopologyKey semantics). zone_dom likewise interns
+    utilnode.GetZoneKey with 0 = no zone. Term tensors are padded on the term
+    axis with valid=False rows; match[a, t, b] means "a pod of group b matches
+    (namespaces+selector of) term t defined by group a"."""
+
+    group_of_pod: np.ndarray     # [P] int32 — new pods' group ids
+    presence: np.ndarray         # [G, N] int32 — placed existing pods per group
+    port_conflict: np.ndarray    # [G, G] bool — wanted ports of a hit ports of b
+    ss_match: np.ndarray         # [G, G] bool — b counts toward a's spread score
+    zone_dom: np.ndarray         # [N] int32
+    topo_dom: np.ndarray         # [K, N] int32
+    aff_valid: np.ndarray        # [G, Ta] bool — required pod-affinity terms
+    aff_err: np.ndarray          # [G] bool — any term with empty topologyKey
+    aff_empty: np.ndarray        # [G, Ta] bool — per-term empty topologyKey
+    aff_match: np.ndarray        # [G, Ta, G] bool
+    aff_key: np.ndarray          # [G, Ta] int32 (into K)
+    aff_hostname: np.ndarray     # [G, Ta] bool — topologyKey == kubernetes.io/hostname
+    aff_self: np.ndarray         # [G, Ta] bool — the pod matches its own term
+    aff_unplaced: np.ndarray     # [G, Ta] bool — an unplaced snapshot pod matches
+    anti_valid: np.ndarray       # [G, Tb] bool — required pod-anti-affinity terms
+    anti_err: np.ndarray         # [G] bool
+    anti_empty: np.ndarray       # [G, Tb] bool
+    anti_match: np.ndarray       # [G, Tb, G] bool
+    anti_key: np.ndarray         # [G, Tb] int32
+    anti_hostname: np.ndarray    # [G, Tb] bool
+    pref_w: np.ndarray           # [G, Tp] float64 — preferred terms, signed weight
+    pref_match: np.ndarray       # [G, Tp, G] bool
+    pref_key: np.ndarray         # [G, Tp] int32
+
+
+@dataclass
 class PodColumns:
     """Per-pod numeric columns + signature ids (the scan's xs)."""
 
@@ -137,6 +202,7 @@ class PodColumns:
     aff_id: np.ndarray           # [P] int32
     avoid_id: np.ndarray         # [P] int32
     host_id: np.ndarray          # [P] int32
+    group_id: np.ndarray         # [P] int32 — pod-group id (GroupTables)
 
 
 @dataclass
@@ -158,9 +224,15 @@ class DynamicInit:
 class CompiledCluster:
     statics: NodeStatics
     tables: SignatureTables
+    groups: GroupTables
     dynamic: DynamicInit
     scalar_names: List[str]
     node_index: Dict[str, int]
+    has_ports: bool = False
+    has_services: bool = False
+    has_interpod: bool = False
+    n_topo_doms: int = 1         # segment count for topo_dom (incl. invalid 0)
+    n_zone_doms: int = 1
     unsupported: List[str] = field(default_factory=list)  # features needing fallback
 
 
@@ -190,6 +262,271 @@ def _avoid_signature(pod: Pod):
 
 def _host_signature(pod: Pod):
     return pod.spec.node_name or None
+
+
+# ---------------------------------------------------------------------------
+# pod-group compilation (host ports / selector spreading / inter-pod affinity)
+# ---------------------------------------------------------------------------
+
+_ANY_IP = "0.0.0.0"
+
+
+def _sanitized_ports(pod: Pod) -> list:
+    """Wanted (ip, protocol, port) triples, HostPortInfo-sanitized
+    (util/utils.go:51-137: ip defaults 0.0.0.0, protocol TCP, port>0 only)."""
+    out = set()
+    for c in pod.spec.containers:
+        for p in c.ports:
+            if p.host_port > 0:
+                out.add((p.host_ip or _ANY_IP, p.protocol or "TCP", p.host_port))
+    return sorted(out)
+
+
+def _ports_conflict(wants: list, occupied: list) -> bool:
+    """check_conflict over a full pod pair: 0.0.0.0 wildcards either side."""
+    for wip, wproto, wport in wants:
+        for oip, oproto, oport in occupied:
+            if (wport == oport and wproto == oproto
+                    and (wip == _ANY_IP or oip == _ANY_IP or wip == oip)):
+                return True
+    return False
+
+
+def _group_signature(pod: Pod):
+    aff = pod.spec.affinity
+    return {
+        "ns": pod.namespace,
+        "labels": pod.metadata.labels,
+        "aff": aff.pod_affinity.to_obj() if (aff and aff.pod_affinity) else None,
+        "anti": (aff.pod_anti_affinity.to_obj()
+                 if (aff and aff.pod_anti_affinity) else None),
+        "ports": _sanitized_ports(pod),
+    }
+
+
+def _has_interpod_terms(pod: Pod) -> bool:
+    a = pod.spec.affinity
+    return a is not None and (a.pod_affinity is not None
+                              or a.pod_anti_affinity is not None)
+
+
+def _req_aff_terms(pod: Pod) -> list:
+    a = pod.spec.affinity
+    return get_pod_affinity_terms(a.pod_affinity) if a else []
+
+
+def _req_anti_terms(pod: Pod) -> list:
+    a = pod.spec.affinity
+    return get_pod_anti_affinity_terms(a.pod_anti_affinity) if a else []
+
+
+def _pref_terms(pod: Pod) -> list:
+    """Signed (weight, term): preferred affinity positive, anti negative
+    (interpod_affinity.go processWeightedTerms multipliers)."""
+    a = pod.spec.affinity
+    out = []
+    if a and a.pod_affinity:
+        out += [(wt.weight, wt.pod_affinity_term) for wt in a.pod_affinity.preferred]
+    if a and a.pod_anti_affinity:
+        out += [(-wt.weight, wt.pod_affinity_term)
+                for wt in a.pod_anti_affinity.preferred]
+    return out
+
+
+def _trivial_groups(num_pods: int, n: int) -> "GroupTables":
+    z = np.zeros
+    return GroupTables(
+        group_of_pod=z(num_pods, np.int32), presence=z((1, n), np.int32),
+        port_conflict=z((1, 1), bool), ss_match=z((1, 1), bool),
+        zone_dom=z(n, np.int32), topo_dom=z((1, n), np.int32),
+        aff_valid=z((1, 1), bool), aff_err=z(1, bool), aff_empty=z((1, 1), bool),
+        aff_match=z((1, 1, 1), bool), aff_key=z((1, 1), np.int32),
+        aff_hostname=z((1, 1), bool), aff_self=z((1, 1), bool),
+        aff_unplaced=z((1, 1), bool),
+        anti_valid=z((1, 1), bool), anti_err=z(1, bool), anti_empty=z((1, 1), bool),
+        anti_match=z((1, 1, 1), bool), anti_key=z((1, 1), np.int32),
+        anti_hostname=z((1, 1), bool),
+        pref_w=z((1, 1), np.float64), pref_match=z((1, 1, 1), bool),
+        pref_key=z((1, 1), np.int32))
+
+
+def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
+                    nodes: List[Node], node_index: Dict[str, int]):
+    """Build GroupTables + feature flags. Returns
+    (tables, has_ports, has_services, has_interpod, n_topo_doms, n_zone_doms,
+    unsupported)."""
+    n = len(nodes)
+    placed = [p for p in snapshot.pods if p.spec.node_name in node_index]
+    # pods with an unknown-but-set nodeName still count for "matching pod
+    # exists"; nodeName-less (pending) pods are dropped by the reference's pod
+    # lister (backends.py scheduled-pod filter) and must not count
+    unplaced = [p for p in snapshot.pods
+                if p.spec.node_name and p.spec.node_name not in node_index]
+
+    has_ports = any(_sanitized_ports(p) for p in pods) \
+        or any(_sanitized_ports(p) for p in placed)
+    has_interpod = any(_has_interpod_terms(p) for p in pods) \
+        or any(_has_interpod_terms(p) for p in placed)
+    has_services = bool(snapshot.services)
+    if not (has_ports or has_interpod or has_services):
+        return _trivial_groups(len(pods), n), False, False, False, 1, 1, []
+
+    gi = Interner()
+    group_of_pod = np.array([gi.intern(_group_signature(p), p) for p in pods],
+                            dtype=np.int32)
+    placed_gid = [gi.intern(_group_signature(p), p) for p in placed]
+    g = len(gi)
+    if g > MAX_GROUPS:
+        return (_trivial_groups(len(pods), n), False, False, False, 1, 1,
+                [f"{g} distinct pod groups exceed the jax backend limit "
+                 f"({MAX_GROUPS})"])
+    reps = gi.representatives
+
+    presence = np.zeros((g, n), dtype=np.int32)
+    for gid, p in zip(placed_gid, placed):
+        presence[gid, node_index[p.spec.node_name]] += 1
+
+    port_conflict = np.zeros((g, g), dtype=bool)
+    if has_ports:
+        ports_of = [_sanitized_ports(rep) for rep in reps]
+        for a in range(g):
+            if not ports_of[a]:
+                continue
+            for b in range(g):
+                port_conflict[a, b] = bool(ports_of[b]) and _ports_conflict(
+                    ports_of[a], ports_of[b])
+
+    ss_match = np.zeros((g, g), dtype=bool)
+    zone_dom = np.zeros(n, dtype=np.int32)
+    n_zone_doms = 1
+    if has_services:
+        # selectors of group a: services in a's namespace selecting a's labels
+        # (selector_spreading.go getSelectors; the simulator wires only the
+        # services informer with real data, simulator.go:352-366)
+        selectors_of = []
+        for rep in reps:
+            sels = []
+            for svc in snapshot.services:
+                if (svc.namespace == rep.namespace and svc.selector
+                        and all(rep.metadata.labels.get(k) == v
+                                for k, v in svc.selector.items())):
+                    sels.append(dict(svc.selector))
+            selectors_of.append(sels)
+        for a in range(g):
+            if not selectors_of[a]:
+                continue
+            for b in range(g):
+                ss_match[a, b] = reps[b].namespace == reps[a].namespace and any(
+                    all(reps[b].metadata.labels.get(k) == v for k, v in sel.items())
+                    for sel in selectors_of[a])
+        zvals: Dict[str, int] = {}
+        for i, node in enumerate(nodes):
+            z = get_zone_key(node)
+            if z:
+                zone_dom[i] = zvals.setdefault(z, len(zvals) + 1)
+        n_zone_doms = len(zvals) + 1
+
+    # --- inter-pod affinity term tensors ---
+    topo_keys: List[str] = []
+    if has_interpod:
+        seen_keys = set()
+        for rep in reps:
+            for term in _req_aff_terms(rep) + _req_anti_terms(rep):
+                if term.topology_key and term.topology_key not in seen_keys:
+                    seen_keys.add(term.topology_key)
+                    topo_keys.append(term.topology_key)
+            for _, term in _pref_terms(rep):
+                if term.topology_key and term.topology_key not in seen_keys:
+                    seen_keys.add(term.topology_key)
+                    topo_keys.append(term.topology_key)
+    k_count = max(len(topo_keys), 1)
+    key_idx = {key: i for i, key in enumerate(topo_keys)}
+
+    topo_dom = np.zeros((k_count, n), dtype=np.int32)
+    n_topo_doms = 1
+    for k, key in enumerate(topo_keys):
+        vals: Dict[str, int] = {}
+        for i, node in enumerate(nodes):
+            v = node.metadata.labels.get(key)
+            if v is not None:
+                topo_dom[k, i] = vals.setdefault(v, len(vals) + 1)
+        n_topo_doms = max(n_topo_doms, len(vals) + 1)
+
+    ta = max([1] + [len(_req_aff_terms(r)) for r in reps])
+    tb = max([1] + [len(_req_anti_terms(r)) for r in reps])
+    tp = max([1] + [len(_pref_terms(r)) for r in reps])
+    aff_valid = np.zeros((g, ta), bool)
+    aff_err = np.zeros(g, bool)
+    aff_empty = np.zeros((g, ta), bool)
+    aff_match = np.zeros((g, ta, g), bool)
+    aff_key = np.zeros((g, ta), np.int32)
+    aff_hostname = np.zeros((g, ta), bool)
+    aff_self = np.zeros((g, ta), bool)
+    aff_unplaced = np.zeros((g, ta), bool)
+    anti_valid = np.zeros((g, tb), bool)
+    anti_err = np.zeros(g, bool)
+    anti_empty = np.zeros((g, tb), bool)
+    anti_match = np.zeros((g, tb, g), bool)
+    anti_key = np.zeros((g, tb), np.int32)
+    anti_hostname = np.zeros((g, tb), bool)
+    pref_w = np.zeros((g, tp), np.float64)
+    pref_match = np.zeros((g, tp, g), bool)
+    pref_key = np.zeros((g, tp), np.int32)
+
+    if has_interpod:
+        for a, rep in enumerate(reps):
+            for t, term in enumerate(_req_aff_terms(rep)):
+                aff_valid[a, t] = True
+                namespaces = get_namespaces_from_pod_affinity_term(rep, term)
+                if not term.topology_key:
+                    # _any_pod_matches_term raises -> whole predicate fails
+                    aff_empty[a, t] = True
+                    aff_err[a] = True
+                else:
+                    aff_key[a, t] = key_idx[term.topology_key]
+                    aff_hostname[a, t] = term.topology_key == LABEL_HOSTNAME
+                aff_self[a, t] = pod_matches_term_namespace_and_selector(
+                    rep, namespaces, term.label_selector)
+                aff_unplaced[a, t] = any(
+                    pod_matches_term_namespace_and_selector(
+                        u, namespaces, term.label_selector) for u in unplaced)
+                for b, other in enumerate(reps):
+                    aff_match[a, t, b] = pod_matches_term_namespace_and_selector(
+                        other, namespaces, term.label_selector)
+            for t, term in enumerate(_req_anti_terms(rep)):
+                anti_valid[a, t] = True
+                namespaces = get_namespaces_from_pod_affinity_term(rep, term)
+                if not term.topology_key:
+                    anti_empty[a, t] = True
+                    anti_err[a] = True
+                else:
+                    anti_key[a, t] = key_idx[term.topology_key]
+                    anti_hostname[a, t] = term.topology_key == LABEL_HOSTNAME
+                for b, other in enumerate(reps):
+                    anti_match[a, t, b] = pod_matches_term_namespace_and_selector(
+                        other, namespaces, term.label_selector)
+            for t, (w, term) in enumerate(_pref_terms(rep)):
+                if not term.topology_key:
+                    continue  # NodesHaveSameTopologyKey("") is always False
+                pref_w[a, t] = float(w)
+                pref_key[a, t] = key_idx[term.topology_key]
+                namespaces = get_namespaces_from_pod_affinity_term(rep, term)
+                for b, other in enumerate(reps):
+                    pref_match[a, t, b] = pod_matches_term_namespace_and_selector(
+                        other, namespaces, term.label_selector)
+
+    tables = GroupTables(
+        group_of_pod=group_of_pod, presence=presence,
+        port_conflict=port_conflict, ss_match=ss_match,
+        zone_dom=zone_dom, topo_dom=topo_dom,
+        aff_valid=aff_valid, aff_err=aff_err, aff_empty=aff_empty,
+        aff_match=aff_match, aff_key=aff_key, aff_hostname=aff_hostname,
+        aff_self=aff_self, aff_unplaced=aff_unplaced,
+        anti_valid=anti_valid, anti_err=anti_err, anti_empty=anti_empty,
+        anti_match=anti_match, anti_key=anti_key, anti_hostname=anti_hostname,
+        pref_w=pref_w, pref_match=pref_match, pref_key=pref_key)
+    return (tables, has_ports, has_services, has_interpod,
+            n_topo_doms, n_zone_doms, [])
 
 
 def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[CompiledCluster, PodColumns]:
@@ -274,7 +611,7 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
         zero_request=np.zeros(p, dtype=bool), best_effort=np.zeros(p, dtype=bool),
         sel_id=np.zeros(p, dtype=np.int32), tol_id=np.zeros(p, dtype=np.int32),
         aff_id=np.zeros(p, dtype=np.int32), avoid_id=np.zeros(p, dtype=np.int32),
-        host_id=np.zeros(p, dtype=np.int32))
+        host_id=np.zeros(p, dtype=np.int32), group_id=np.zeros(p, dtype=np.int32))
 
     sel_i, tol_i, aff_i, avoid_i, host_i = (Interner() for _ in range(5))
     unsupported: List[str] = []
@@ -298,24 +635,12 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
         cols.aff_id[j] = aff_i.intern(_affinity_signature(pod), pod)
         cols.avoid_id[j] = avoid_i.intern(_avoid_signature(pod), pod)
         cols.host_id[j] = host_i.intern(_host_signature(pod), pod)
-        aff = pod.spec.affinity
-        if aff is not None and (aff.pod_affinity is not None
-                                or aff.pod_anti_affinity is not None):
-            unsupported.append(f"pod {pod.name}: inter-pod (anti)affinity")
-        for c in pod.spec.containers:
-            if any(port.host_port > 0 for port in c.ports):
-                unsupported.append(f"pod {pod.name}: host ports")
 
-    for existing in snapshot.pods:
-        aff = existing.spec.affinity
-        # anti-affinity gates the predicate; required affinity feeds the
-        # symmetric hard-affinity weight of InterPodAffinityPriority; preferred
-        # terms feed its soft scoring — all need device state we don't carry yet
-        if aff is not None and (aff.pod_anti_affinity is not None
-                                or aff.pod_affinity is not None):
-            unsupported.append(f"existing pod {existing.name}: inter-pod (anti)affinity")
-    if snapshot.services:
-        unsupported.append("services (SelectorSpreadPriority is non-constant)")
+    node_index = {nd.name: i for i, nd in enumerate(nodes)}
+    (groups, has_ports, has_services, has_interpod, n_topo_doms, n_zone_doms,
+     group_unsupported) = _compile_groups(snapshot, pods, nodes, node_index)
+    unsupported.extend(group_unsupported)
+    cols.group_id = groups.group_of_pod
 
     # --- static [signature, node] tables ---
     def table(interner: Interner, fn, dtype):
@@ -359,7 +684,6 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
     )
 
     # --- dynamic aggregates from pre-scheduled pods ---
-    node_index = {nd.name: i for i, nd in enumerate(nodes)}
     dyn = DynamicInit(
         used_cpu=np.zeros(n, dtype=np.int64), used_mem=np.zeros(n, dtype=np.int64),
         used_gpu=np.zeros(n, dtype=np.int64), used_eph=np.zeros(n, dtype=np.int64),
@@ -382,8 +706,12 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
         dyn.nonzero_mem[i] += nz.memory
         dyn.pod_count[i] += 1
 
-    compiled = CompiledCluster(statics=statics, tables=tables, dynamic=dyn,
-                               scalar_names=scalar_names, node_index=node_index,
+    compiled = CompiledCluster(statics=statics, tables=tables, groups=groups,
+                               dynamic=dyn, scalar_names=scalar_names,
+                               node_index=node_index,
+                               has_ports=has_ports, has_services=has_services,
+                               has_interpod=has_interpod,
+                               n_topo_doms=n_topo_doms, n_zone_doms=n_zone_doms,
                                unsupported=unsupported)
     return compiled, cols
 

@@ -43,10 +43,10 @@ from tpusim.jaxe.kernels import (
     PODX_AXES,
     STATICS_AXES,
     Carry,
-    EngineConfig,
     PodX,
     Statics,
     carry_init_host,
+    config_for,
     make_step,
     pod_columns_to_host,
     statics_to_host,
@@ -129,7 +129,8 @@ def _unify(statics: Statics, carry: Carry, xs: PodX, targets: dict,
 
 def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
                 provider: str = "DefaultProvider",
-                mesh: Optional[object] = None) -> List[WhatIfResult]:
+                mesh: Optional[object] = None,
+                hard_pod_affinity_symmetric_weight: int = 10) -> List[WhatIfResult]:
     """Run independent (snapshot, pods) scenarios as one batched device
     program. Pods are fed in podspec order (callers wanting reference LIFO
     parity pass the reversed list, as run_simulation does).
@@ -181,8 +182,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     # common shapes
     targets = _axis_targets(host_trees)
     s_max = max(len(c.scalar_names) for c, _ in compiled_list)
-    p_max = max(len(pods) for i, (_, pods) in enumerate(scenarios)
-                if i in set(batch_indices))
+    p_max = max(len(scenarios[i][1]) for i in batch_indices)
     n_max = max(c.statics.alloc_cpu.shape[0] for c, _ in compiled_list)
     # one pad target: max nodes rounded up to the node-shard multiple
     n_target = -(-n_max // n_node_shards) * n_node_shards
@@ -211,9 +211,11 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         statics_b = jax.tree.map(jax.device_put, statics_b, st_spec)
         xs_b = jax.tree.map(lambda a: jax.device_put(a, xs_spec), xs_b)
 
-    config = EngineConfig(
+    config = config_for(
+        [c for c, _ in compiled_list],
         most_requested=provider in _MOST_REQUESTED_PROVIDERS,
-        num_reason_bits=NUM_FIXED_BITS + s_max)
+        num_reason_bits=NUM_FIXED_BITS + s_max,
+        hard_weight=hard_pod_affinity_symmetric_weight)
     step = make_step(config)
 
     @jax.jit

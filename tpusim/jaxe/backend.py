@@ -90,7 +90,12 @@ class JaxBackend:
         self.hard_pod_affinity_symmetric_weight = hard_pod_affinity_symmetric_weight
         self.batch_size = batch_size
 
-    def schedule(self, pods: List[Pod], snapshot: ClusterSnapshot) -> List[Placement]:
+    def schedule(self, pods: List[Pod], snapshot: ClusterSnapshot,
+                 precompiled=None) -> List[Placement]:
+        """precompiled: an optional (CompiledCluster, PodColumns) pair for
+        `pods` against `snapshot` — the incremental event-log path
+        (jaxe.delta.IncrementalCluster.compile) hands its cached state in
+        here instead of recompiling."""
         if not pods:
             return []
         if not snapshot.nodes:
@@ -98,7 +103,7 @@ class JaxBackend:
             return [Placement(pod=mark_unschedulable(p, msg),
                               reason="Unschedulable", message=msg) for p in pods]
 
-        compiled, cols = compile_cluster(snapshot, pods)
+        compiled, cols = precompiled or compile_cluster(snapshot, pods)
         if compiled.unsupported:
             detail = "; ".join(sorted(set(compiled.unsupported))[:5])
             if self.fallback == "error":

@@ -529,6 +529,91 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
             n_topo_doms, n_zone_doms, [])
 
 
+def node_static_row(node: Node, ni: NodeInfo, scalar_idx: Dict[str, int],
+                    s: int):
+    """One node's static column values (shared with the incremental path):
+    (cpu, mem, gpu, eph, pods, scalar_row[s], cond_bits, mem_p, disk_p)."""
+    r = ni.allocatable_resource
+    scalar_row = np.zeros(s, dtype=np.int64)
+    for name, v in r.scalar.items():
+        scalar_row[scalar_idx[name]] = v
+    bits = 0
+    for cond in node.status.conditions:
+        if cond.type == "Ready" and cond.status != "True":
+            bits |= 1 << BIT_NODE_NOT_READY
+        elif cond.type == "OutOfDisk" and cond.status != "False":
+            bits |= 1 << BIT_NODE_OUT_OF_DISK
+        elif cond.type == "NetworkUnavailable" and cond.status != "False":
+            bits |= 1 << BIT_NODE_NETWORK_UNAVAILABLE
+    if node.spec.unschedulable:
+        bits |= 1 << BIT_NODE_UNSCHEDULABLE
+    return (r.milli_cpu, r.memory, r.nvidia_gpu, r.ephemeral_storage,
+            r.allowed_pod_number, scalar_row, bits, ni.memory_pressure,
+            ni.disk_pressure)
+
+
+def signature_row_fns(nodes: List[Node], node_infos: List["NodeInfo"]):
+    """Per-signature-table row evaluators: kind -> (fn(rep, node_idx), dtype).
+
+    Shared by compile_cluster and the incremental delta path (delta.py), so
+    both compute table cells with exactly the same engine matchers. The
+    sel/tol/aff/avoid/host interner each table reads from is fixed:
+    selector_ok<-sel, taint_ok+intolerable<-tol, affinity_count<-aff,
+    avoid_score<-avoid, host_ok<-host."""
+
+    def selector_fn(rep: Optional[Pod], i: int) -> bool:
+        return pod_matches_node_labels(rep, nodes[i])
+
+    def taint_ok_fn(rep: Pod, i: int) -> bool:
+        return find_matching_untolerated_taint(
+            node_infos[i].taints, rep.spec.tolerations,
+            lambda t: t.effect in ("NoSchedule", "NoExecute")) is None
+
+    def intolerable_fn(rep: Pod, i: int) -> int:
+        tols = [t for t in rep.spec.tolerations
+                if not t.effect or t.effect == TAINT_PREFER_NO_SCHEDULE]
+        return sum(1 for taint in node_infos[i].taints
+                   if taint.effect == TAINT_PREFER_NO_SCHEDULE
+                   and not tolerations_tolerate_taint(tols, taint))
+
+    def affinity_fn(rep: Pod, i: int) -> int:
+        return calculate_node_affinity_priority_map(rep, None, node_infos[i]).score
+
+    def avoid_fn(rep: Pod, i: int) -> int:
+        return calculate_node_prefer_avoid_pods_priority_map(rep, None, node_infos[i]).score
+
+    def host_fn(rep: Pod, i: int) -> bool:
+        return (not rep.spec.node_name) or rep.spec.node_name == nodes[i].name
+
+    return {
+        "selector_ok": (selector_fn, bool),
+        "taint_ok": (taint_ok_fn, bool),
+        "intolerable": (intolerable_fn, np.int64),
+        "affinity_count": (affinity_fn, np.int64),
+        "avoid_score": (avoid_fn, np.int64),
+        "host_ok": (host_fn, bool),
+    }
+
+
+def fill_pod_request_row(cols: PodColumns, j: int, pod: Pod, req,
+                         scalar_idx: Dict[str, int]) -> None:
+    """Fill one pod's numeric request columns (shared with delta.py so the
+    incremental path can never drift from the fresh-compile semantics)."""
+    cols.req_cpu[j] = req.milli_cpu
+    cols.req_mem[j] = req.memory
+    cols.req_gpu[j] = req.nvidia_gpu
+    cols.req_eph[j] = req.ephemeral_storage
+    for name, v in req.scalar.items():
+        cols.req_scalar[j, scalar_idx[name]] = v
+    cols.zero_request[j] = (req.milli_cpu == 0 and req.memory == 0
+                            and req.nvidia_gpu == 0 and req.ephemeral_storage == 0
+                            and not req.scalar)
+    nz = get_nonzero_pod_request(pod)
+    cols.nz_cpu[j] = nz.milli_cpu
+    cols.nz_mem[j] = nz.memory
+    cols.best_effort[j] = is_pod_best_effort(pod)
+
+
 def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[CompiledCluster, PodColumns]:
     """Build columnar state for `pods` scheduled against `snapshot`.
 
@@ -572,27 +657,11 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
     disk_pressure = np.zeros(n, dtype=bool)
     for i, node in enumerate(nodes):
         ni = node_infos[i]
-        r = ni.allocatable_resource
-        alloc["cpu"][i] = r.milli_cpu
-        alloc["mem"][i] = r.memory
-        alloc["gpu"][i] = r.nvidia_gpu
-        alloc["eph"][i] = r.ephemeral_storage
-        alloc["pods"][i] = r.allowed_pod_number
-        for name, v in r.scalar.items():
-            alloc_scalar[i, scalar_idx[name]] = v
-        bits = 0
-        for cond in node.status.conditions:
-            if cond.type == "Ready" and cond.status != "True":
-                bits |= 1 << BIT_NODE_NOT_READY
-            elif cond.type == "OutOfDisk" and cond.status != "False":
-                bits |= 1 << BIT_NODE_OUT_OF_DISK
-            elif cond.type == "NetworkUnavailable" and cond.status != "False":
-                bits |= 1 << BIT_NODE_NETWORK_UNAVAILABLE
-        if node.spec.unschedulable:
-            bits |= 1 << BIT_NODE_UNSCHEDULABLE
-        cond_bits[i] = bits
-        mem_pressure[i] = ni.memory_pressure
-        disk_pressure[i] = ni.disk_pressure
+        row = node_static_row(node, ni, scalar_idx, s)
+        alloc["cpu"][i], alloc["mem"][i], alloc["gpu"][i] = row[0], row[1], row[2]
+        alloc["eph"][i], alloc["pods"][i] = row[3], row[4]
+        alloc_scalar[i] = row[5]
+        cond_bits[i], mem_pressure[i], disk_pressure[i] = row[6], row[7], row[8]
 
     statics = NodeStatics(
         names=[nd.name for nd in nodes],
@@ -616,20 +685,7 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
     sel_i, tol_i, aff_i, avoid_i, host_i = (Interner() for _ in range(5))
     unsupported: List[str] = []
     for j, pod in enumerate(pods):
-        req = pod_requests[j]
-        cols.req_cpu[j] = req.milli_cpu
-        cols.req_mem[j] = req.memory
-        cols.req_gpu[j] = req.nvidia_gpu
-        cols.req_eph[j] = req.ephemeral_storage
-        for name, v in req.scalar.items():
-            cols.req_scalar[j, scalar_idx[name]] = v
-        cols.zero_request[j] = (req.milli_cpu == 0 and req.memory == 0
-                                and req.nvidia_gpu == 0 and req.ephemeral_storage == 0
-                                and not req.scalar)
-        nz = get_nonzero_pod_request(pod)
-        cols.nz_cpu[j] = nz.milli_cpu
-        cols.nz_mem[j] = nz.memory
-        cols.best_effort[j] = is_pod_best_effort(pod)
+        fill_pod_request_row(cols, j, pod, pod_requests[j], scalar_idx)
         cols.sel_id[j] = sel_i.intern(_selector_signature(pod), pod)
         cols.tol_id[j] = tol_i.intern(_toleration_signature(pod), pod)
         cols.aff_id[j] = aff_i.intern(_affinity_signature(pod), pod)
@@ -643,44 +699,23 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
     cols.group_id = groups.group_of_pod
 
     # --- static [signature, node] tables ---
-    def table(interner: Interner, fn, dtype):
+    row_fns = signature_row_fns(nodes, node_infos)
+
+    def table(interner: Interner, kind: str):
+        fn, dtype = row_fns[kind]
         t = np.zeros((max(len(interner), 1), n), dtype=dtype)
         for sig_id, rep in enumerate(interner.representatives):
             for i in range(n):
                 t[sig_id, i] = fn(rep, i)
         return t
 
-    def selector_fn(rep: Optional[Pod], i: int) -> bool:
-        return pod_matches_node_labels(rep, nodes[i])
-
-    def taint_ok_fn(rep: Pod, i: int) -> bool:
-        return find_matching_untolerated_taint(
-            node_infos[i].taints, rep.spec.tolerations,
-            lambda t: t.effect in ("NoSchedule", "NoExecute")) is None
-
-    def intolerable_fn(rep: Pod, i: int) -> int:
-        tols = [t for t in rep.spec.tolerations
-                if not t.effect or t.effect == TAINT_PREFER_NO_SCHEDULE]
-        return sum(1 for taint in node_infos[i].taints
-                   if taint.effect == TAINT_PREFER_NO_SCHEDULE
-                   and not tolerations_tolerate_taint(tols, taint))
-
-    def affinity_fn(rep: Pod, i: int) -> int:
-        return calculate_node_affinity_priority_map(rep, None, node_infos[i]).score
-
-    def avoid_fn(rep: Pod, i: int) -> int:
-        return calculate_node_prefer_avoid_pods_priority_map(rep, None, node_infos[i]).score
-
-    def host_fn(rep: Pod, i: int) -> bool:
-        return (not rep.spec.node_name) or rep.spec.node_name == nodes[i].name
-
     tables = SignatureTables(
-        selector_ok=table(sel_i, selector_fn, bool),
-        taint_ok=table(tol_i, taint_ok_fn, bool),
-        intolerable=table(tol_i, intolerable_fn, np.int64),
-        affinity_count=table(aff_i, affinity_fn, np.int64),
-        avoid_score=table(avoid_i, avoid_fn, np.int64),
-        host_ok=table(host_i, host_fn, bool),
+        selector_ok=table(sel_i, "selector_ok"),
+        taint_ok=table(tol_i, "taint_ok"),
+        intolerable=table(tol_i, "intolerable"),
+        affinity_count=table(aff_i, "affinity_count"),
+        avoid_score=table(avoid_i, "avoid_score"),
+        host_ok=table(host_i, "host_ok"),
     )
 
     # --- dynamic aggregates from pre-scheduled pods ---

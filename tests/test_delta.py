@@ -119,6 +119,21 @@ def test_affinity_pods_through_event_log():
     assert sum(1 for p in placements if p.scheduled) == 2
 
 
+def test_signature_kind_collision_regression():
+    """Regression (review finding): _avoid_signature and _host_signature both
+    serialize None identically; without kind-prefixed memo keys a nodeName-
+    pinned pod became the host representative for ALL pods."""
+    inc = IncrementalCluster(ClusterSnapshot(
+        nodes=[make_node(f"n{i}") for i in range(3)]))
+    pinned = make_pod("pinned", milli_cpu=10, node_name="n1")
+    free = make_pod("free", milli_cpu=10)
+    placements = assert_equiv(inc, [pinned, free])
+    assert placements[0].node_name == "n1"
+    compiled, cols = inc.compile([pinned, free])
+    # the free pod must get an all-True host row, not the pinned pod's
+    assert compiled.tables.host_ok[cols.host_id[1]].all()
+
+
 def test_node_added_with_new_scalar_resource():
     """Regression (review finding): a node ADDED event carrying a
     previously-unseen extended resource must widen the scalar axis without a

@@ -1,0 +1,228 @@
+"""Live-cluster snapshotter (api/kubeclient.py) against a local fake
+apiserver — reference semantics: Running pods (fieldSelector) + all nodes
+(cmd/app/server.go:104-118), kubeconfig or in-cluster auth."""
+
+import base64
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+import yaml
+
+from tpusim.api.kubeclient import (
+    KubeClient,
+    KubeConfigError,
+    get_checkpoints,
+    in_cluster_config,
+    load_kubeconfig,
+    snapshot_from_cluster,
+)
+from tpusim.api.snapshot import make_node, make_pod
+
+
+class FakeApiServer:
+    """Minimal /api/v1 list endpoints with request capture."""
+
+    def __init__(self, pods, nodes):
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                outer.requests.append(
+                    (parsed.path, query, self.headers.get("Authorization")))
+                if parsed.path == "/api/v1/nodes":
+                    items = [n.to_obj() for n in nodes]
+                elif parsed.path == "/api/v1/pods":
+                    items = [p.to_obj() for p in pods
+                             if self._phase_ok(query, p)]
+                elif parsed.path.startswith("/api/v1/namespaces/") \
+                        and parsed.path.endswith("/pods"):
+                    ns = parsed.path.split("/")[4]
+                    items = [p.to_obj() for p in pods
+                             if p.namespace == ns and self._phase_ok(query, p)]
+                else:
+                    self.send_error(404)
+                    return
+                body = json.dumps({"items": items}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            @staticmethod
+            def _phase_ok(query, pod):
+                sel = query.get("fieldSelector", "")
+                if sel == "status.phase=Running":
+                    return pod.status.phase == "Running"
+                return True
+
+            def log_message(self, *args):
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def fake_cluster():
+    pods = [
+        make_pod("run-1", milli_cpu=500, node_name="n0", phase="Running"),
+        make_pod("run-2", milli_cpu=250, node_name="n1", phase="Running",
+                 namespace="prod"),
+        make_pod("pending", milli_cpu=100),  # phase "" -> filtered out
+    ]
+    nodes = [make_node("n0"), make_node("n1")]
+    server = FakeApiServer(pods, nodes)
+    yield server
+    server.stop()
+
+
+def write_kubeconfig(tmp_path, server_url, token="secrettoken"):
+    doc = {
+        "current-context": "sim",
+        "contexts": [{"name": "sim",
+                      "context": {"cluster": "c1", "user": "u1"}}],
+        "clusters": [{"name": "c1", "cluster": {"server": server_url}}],
+        "users": [{"name": "u1", "user": {"token": token}}],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(doc))
+    return str(path)
+
+
+def test_get_checkpoints_semantics(fake_cluster, tmp_path):
+    cfg = load_kubeconfig(write_kubeconfig(tmp_path, fake_cluster.url))
+    client = KubeClient(cfg)
+    pods, nodes = get_checkpoints(client)
+    # Running pods only, across all namespaces; all nodes
+    assert sorted(p.name for p in pods) == ["run-1", "run-2"]
+    assert sorted(n.name for n in nodes) == ["n0", "n1"]
+    # the reference's exact field selector + bearer auth hit the wire
+    pod_reqs = [r for r in fake_cluster.requests if r[0] == "/api/v1/pods"]
+    assert pod_reqs[0][1] == {"fieldSelector": "status.phase=Running"}
+    assert pod_reqs[0][2] == "Bearer secrettoken"
+
+
+def test_namespaced_pod_list(fake_cluster, tmp_path):
+    cfg = load_kubeconfig(write_kubeconfig(tmp_path, fake_cluster.url))
+    pods = KubeClient(cfg).list_running_pods("prod")
+    assert [p.name for p in pods] == ["run-2"]
+
+
+def test_snapshot_from_cluster_end_to_end(fake_cluster, tmp_path, capsys):
+    path = write_kubeconfig(tmp_path, fake_cluster.url)
+    snap = snapshot_from_cluster(kubeconfig=path)
+    assert len(snap.nodes) == 2 and len(snap.pods) == 2
+
+    # full CLI flow: live snapshot -> simulate -> report
+    from tpusim.cli import main
+
+    podspec = tmp_path / "podspec.yaml"
+    podspec.write_text(
+        "- name: A\n  num: 2\n  pod:\n    spec:\n      containers:\n"
+        "      - resources:\n          requests:\n            cpu: 1\n")
+    rc = main(["--kubeconfig", path, "--podspec", str(podspec),
+               "--backend", "reference", "--quiet"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "2 pod(s) scheduled" in out
+
+
+def test_kubeconfig_base64_data_and_basic_auth(fake_cluster, tmp_path):
+    doc = {
+        "current-context": "sim",
+        "contexts": [{"name": "sim",
+                      "context": {"cluster": "c1", "user": "u1"}}],
+        "clusters": [{"name": "c1", "cluster": {
+            "server": fake_cluster.url,
+            # CA data is parsed/materialized even for http servers
+            "certificate-authority-data":
+                base64.b64encode(b"fake-ca").decode()}}],
+        "users": [{"name": "u1", "user": {"username": "alice",
+                                          "password": "pw"}}],
+    }
+    path = tmp_path / "kc"
+    path.write_text(yaml.safe_dump(doc))
+    cfg = load_kubeconfig(str(path))
+    assert cfg.ca_file and open(cfg.ca_file, "rb").read() == b"fake-ca"
+    KubeClient(cfg).list_nodes()
+    auth = [r[2] for r in fake_cluster.requests if r[0] == "/api/v1/nodes"][0]
+    assert auth == "Basic " + base64.b64encode(b"alice:pw").decode()
+
+
+def test_kubeconfig_errors(tmp_path):
+    bad = tmp_path / "bad"
+    bad.write_text(yaml.safe_dump({"clusters": []}))
+    with pytest.raises(KubeConfigError):
+        load_kubeconfig(str(bad))
+    # malformed YAML is wrapped (review finding: the CLI catches ValueError)
+    malformed = tmp_path / "malformed"
+    malformed.write_text("{unclosed: [")
+    with pytest.raises(KubeConfigError):
+        load_kubeconfig(str(malformed))
+
+
+def test_materialized_key_files_cleaned_up(fake_cluster, tmp_path):
+    """Review finding: decoded client keys must not linger in tempdir."""
+    import os
+
+    doc = {
+        "current-context": "sim",
+        "contexts": [{"name": "sim",
+                      "context": {"cluster": "c1", "user": "u1"}}],
+        "clusters": [{"name": "c1", "cluster": {"server": fake_cluster.url}}],
+        "users": [{"name": "u1", "user": {"token": "t"}}],
+    }
+    doc["clusters"][0]["cluster"]["certificate-authority-data"] = \
+        base64.b64encode(b"ca").decode()
+    path = tmp_path / "kc"
+    path.write_text(yaml.safe_dump(doc))
+    cfg = load_kubeconfig(str(path))
+    assert cfg._temp_files and all(os.path.exists(p) for p in cfg._temp_files)
+    files = list(cfg._temp_files)
+    cfg.cleanup()
+    assert not cfg._temp_files and not any(os.path.exists(p) for p in files)
+
+
+def test_cli_conflicting_snapshot_sources(tmp_path, capsys):
+    from tpusim.cli import main
+
+    podspec = tmp_path / "p.yaml"
+    podspec.write_text(
+        "- name: A\n  num: 1\n  pod:\n    spec:\n      containers:\n"
+        "      - resources:\n          requests:\n            cpu: 1\n")
+    rc = main(["--kubeconfig", "/tmp/some-kc", "--snapshot", "/tmp/some-snap",
+               "--podspec", str(podspec)])
+    assert rc == 2
+    assert "conflicts" in capsys.readouterr().err
+
+
+def test_in_cluster_config(tmp_path, fake_cluster):
+    root = tmp_path / "sa"
+    root.mkdir()
+    (root / "token").write_text("sa-token\n")
+    host, port = fake_cluster.server.server_address
+    env = {"KUBERNETES_SERVICE_HOST": str(host),
+           "KUBERNETES_SERVICE_PORT": str(port)}
+    cfg = in_cluster_config(root=str(root), environ=env)
+    assert cfg.token == "sa-token"
+    assert cfg.server == f"https://{host}:{port}"
+    with pytest.raises(KubeConfigError):
+        in_cluster_config(root=str(root), environ={})

@@ -214,11 +214,12 @@ def test_cli_errors(tmp_path, capsys):
     spec = tmp_path / "pod.yaml"
     spec.write_text(QUICKSTART_YAML)
     assert main(["--podspec", str(spec)]) == 2  # no nodes
-    assert main(["--podspec", str(spec), "--kubeconfig", "/tmp/kc",
-                 "--synthetic-nodes", "2"]) == 2  # live cluster unsupported
+    missing_kc = tmp_path / "missing-kubeconfig"
+    assert main(["--podspec", str(spec), "--kubeconfig", str(missing_kc),
+                 "--synthetic-nodes", "2"]) == 2  # unreadable kubeconfig
     err = capsys.readouterr().err
     assert "no cluster nodes" in err
-    assert "kubectl get nodes" in err
+    assert "failed to load cluster snapshot" in err
 
 
 def test_cli_snapshot_file(tmp_path, capsys):

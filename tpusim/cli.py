@@ -36,7 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
     # reference flags (options.go:67-71)
     parser.add_argument("--kubeconfig", default="",
                         help="Path to kubeconfig for a live-cluster snapshot "
-                             "(not supported in this offline build; use --snapshot)")
+                             "(Running pods across all namespaces + all nodes, "
+                             "server.go:104-118); CC_INCLUSTER=1 uses the "
+                             "in-cluster service-account config instead")
     parser.add_argument("--podspec", default="",
                         help="YAML/JSON file with [{name, pod, num}] entries")
     parser.add_argument("--algorithmprovider", default="DefaultProvider",
@@ -84,6 +86,19 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def load_snapshot(args) -> ClusterSnapshot:
+    if args.kubeconfig or os.environ.get("CC_INCLUSTER"):
+        if args.snapshot or args.nodes or args.pods or args.synthetic_nodes:
+            raise ValueError(
+                "--kubeconfig/CC_INCLUSTER conflicts with "
+                "--snapshot/--nodes/--pods/--synthetic-nodes; pick one "
+                "snapshot source")
+        # the reference's only real network I/O: the initial checkpoint
+        # (server.go:75-118); its Namespace field is never flag-bound, so the
+        # pod list always spans all namespaces — --namespace here only stamps
+        # the simulated pods
+        from tpusim.api.kubeclient import snapshot_from_cluster
+
+        return snapshot_from_cluster(kubeconfig=args.kubeconfig)
     if args.snapshot:
         return ClusterSnapshot.load(args.snapshot)
     snapshot = ClusterSnapshot()
@@ -144,14 +159,6 @@ def main(argv=None) -> int:
         return run_what_if_cli(args)
     if not args.podspec:
         print("error: --podspec is required (or use --what-if)", file=sys.stderr)
-        return 2
-    if args.kubeconfig or os.environ.get("CC_INCLUSTER"):
-        print("error: live-cluster snapshots need a kube apiserver, which this "
-              "offline build does not ship. Snapshot the cluster with "
-              "`kubectl get nodes -o json > nodes.json` and "
-              "`kubectl get pods --all-namespaces "
-              "--field-selector=status.phase=Running -o json > pods.json`, "
-              "then pass --nodes/--pods.", file=sys.stderr)
         return 2
 
     try:

@@ -479,7 +479,10 @@ class IncrementalCluster:
             fill_pod_request_row(cols, j, pod, get_resource_request(pod),
                                  self._scalar_idx)
             for name, sig_fn, _kinds in _SIG_KINDS:
-                sig_key = _key(sig_fn(pod))
+                # family-prefixed: _avoid_signature and _host_signature both
+                # serialize None to "null" — without the prefix one pod would
+                # become the representative for BOTH kinds (review finding)
+                sig_key = f"{name}:{_key(sig_fn(pod))}"
                 ids = batch_keys[name]
                 if sig_key not in ids:
                     ids[sig_key] = len(ids)

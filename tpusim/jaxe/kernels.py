@@ -141,6 +141,10 @@ class EngineConfig:
     hard_weight: int = 10         # HardPodAffinitySymmetricWeight
     n_topo_doms: int = 1          # segment counts (incl. the invalid-0 bucket)
     n_zone_doms: int = 1
+    # lax.scan unroll factor for the exact sequential mode: semantically
+    # identical, amortizes per-step dispatch overhead at the cost of compile
+    # time; tune via TPUSIM_SCAN_UNROLL (backend reads the env)
+    scan_unroll: int = 1
 
 
 # ---------------------------------------------------------------------------
@@ -186,6 +190,15 @@ PODX_AXES = dict(
 PAD_FILLS: dict = {}
 
 
+def scan_unroll_from_env() -> int:
+    import os
+
+    try:
+        return max(1, int(os.environ.get("TPUSIM_SCAN_UNROLL", "1")))
+    except ValueError:
+        return 1
+
+
 def config_for(compiled_list, most_requested: bool, num_reason_bits: int,
                hard_weight: int = 10) -> EngineConfig:
     """Union EngineConfig across one or more CompiledClusters (the what-if
@@ -198,7 +211,8 @@ def config_for(compiled_list, most_requested: bool, num_reason_bits: int,
         has_interpod=any(c.has_interpod for c in compiled_list),
         hard_weight=hard_weight,
         n_topo_doms=max(c.n_topo_doms for c in compiled_list),
-        n_zone_doms=max(c.n_zone_doms for c in compiled_list))
+        n_zone_doms=max(c.n_zone_doms for c in compiled_list),
+        scan_unroll=scan_unroll_from_env())
 
 
 def statics_to_host(compiled: CompiledCluster) -> Statics:
@@ -580,7 +594,8 @@ def make_step(config: EngineConfig):
 def schedule_scan(config: EngineConfig, carry: Carry, statics: Statics, xs: PodX):
     """Exact sequential mode: scan the fused step over the pod axis."""
     step = make_step(config)
-    (final_carry, _), (choices, counts) = jax.lax.scan(step, (carry, statics), xs)
+    (final_carry, _), (choices, counts) = jax.lax.scan(
+        step, (carry, statics), xs, unroll=config.scan_unroll)
     return final_carry, choices, counts
 
 

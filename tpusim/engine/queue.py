@@ -23,6 +23,11 @@ class SchedulingQueue:
     def add(self, pod: Pod) -> None:
         raise NotImplementedError
 
+    def has_nominated_pods(self) -> bool:
+        """True when any parked pod carries a nominated node (those feed the
+        feasibility double-pass of later pods, generic_scheduler.go:420-534)."""
+        return False
+
     def add_if_not_present(self, pod: Pod) -> None:
         raise NotImplementedError
 
@@ -112,6 +117,9 @@ class PriorityQueue(SchedulingQueue):
         node = self._nominated_node(pod)
         if node:
             self._nominated.setdefault(node, []).append(pod)
+
+    def has_nominated_pods(self) -> bool:
+        return bool(self._nominated)
 
     def _delete_nominated(self, pod: Pod) -> None:
         node = self._nominated_node(pod)

@@ -5,19 +5,25 @@ numbers and ships no buildable toolchain here; see BASELINE.md).
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N}
 vs_baseline = jax rate / reference-loop rate on the same workload shape (>1 is
-faster). Details go to stderr.
+faster). Details go to stderr. Never exits non-zero: on failure the JSON line
+carries an "error" field instead (the TPU tunnel here can hang indefinitely
+inside backend init, so all jax work runs in timeout-guarded subprocesses with
+bounded retries and a CPU fallback).
 
 Workload: BASELINE.md config 3 — mixed Zipf-sized pods onto heterogeneous
 nodes (with a taint/toleration slice), exact sequential semantics.
 
 Env knobs: TPUSIM_BENCH_PODS (default 100000), TPUSIM_BENCH_NODES (5000),
-TPUSIM_BENCH_BASELINE_PODS (200), TPUSIM_BENCH_BATCH (0 = exact scan).
+TPUSIM_BENCH_BASELINE_PODS (200), TPUSIM_BENCH_BATCH (0 = exact scan),
+TPUSIM_BENCH_PROBE_TIMEOUT (150s), TPUSIM_BENCH_RUN_TIMEOUT (2400s),
+TPUSIM_BENCH_CPU_PODS/_NODES (smaller shape used on the CPU fallback).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -27,6 +33,10 @@ import numpy as np
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
+
+# --------------------------------------------------------------------------
+# workload
+# --------------------------------------------------------------------------
 
 def build_workload(num_pods: int, num_nodes: int):
     from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
@@ -63,17 +73,32 @@ def build_workload(num_pods: int, num_nodes: int):
     return ClusterSnapshot(nodes=nodes), pods
 
 
-def main() -> None:
+# --------------------------------------------------------------------------
+# child: the actual measurement (runs inside a timeout-guarded subprocess)
+# --------------------------------------------------------------------------
+
+def run_child(platform: str) -> None:
     num_pods = int(os.environ.get("TPUSIM_BENCH_PODS", 100_000))
     num_nodes = int(os.environ.get("TPUSIM_BENCH_NODES", 5_000))
+    if platform == "cpu":
+        # smaller default shape on the fallback so the run fits the timeout;
+        # explicit env overrides win
+        num_pods = int(os.environ.get("TPUSIM_BENCH_CPU_PODS",
+                                      os.environ.get("TPUSIM_BENCH_PODS", 20_000)))
+        num_nodes = int(os.environ.get("TPUSIM_BENCH_CPU_NODES",
+                                       os.environ.get("TPUSIM_BENCH_NODES", 2_000)))
     baseline_pods = int(os.environ.get("TPUSIM_BENCH_BASELINE_PODS", 200))
     batch = int(os.environ.get("TPUSIM_BENCH_BATCH", 0))
 
     import jax
 
+    if platform == "cpu":
+        # The axon TPU plugin force-appends itself to jax_platforms, overriding
+        # the JAX_PLATFORMS env var; pin via jax.config instead.
+        jax.config.update("jax_platforms", "cpu")
+
     from tpusim.backends import ReferenceBackend
     from tpusim.jaxe import ensure_x64
-    from tpusim.jaxe.backend import _MOST_REQUESTED_PROVIDERS  # noqa: F401
     from tpusim.jaxe.kernels import (
         config_for,
         carry_init,
@@ -85,7 +110,9 @@ def main() -> None:
     from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster
 
     ensure_x64()
-    log(f"devices: {jax.devices()}")
+    devices = jax.devices()
+    real_platform = devices[0].platform
+    log(f"devices: {devices}")
     log(f"workload: {num_pods} pods x {num_nodes} nodes "
         f"({'exact scan' if batch == 0 else f'wavefront K={batch}'})")
 
@@ -114,30 +141,41 @@ def main() -> None:
     statics = statics_to_device(compiled)
     xs = pod_columns_to_device(cols)
 
+    import jax.numpy as jnp
+
     def run():
+        """One full scheduling pass; returns (choices ref, checksum int).
+
+        The checksum is a device-side reduction over the decision vector,
+        fetched as a host scalar: fetching it provably forces the whole
+        computation (choices feeds the sum), unlike block_until_ready on
+        the axon runtime, which has been observed returning early.
+        """
         if batch > 0:
             _, choices, counts = schedule_wavefront(config, carry, statics, xs, batch)
         else:
             _, choices, counts = schedule_scan(config, carry, statics, xs)
-        # NB: on the axon TPU runtime block_until_ready() returns before the
-        # computation finishes; fetching the values is what actually blocks,
-        # so time the full dispatch+fetch (which the simulator needs anyway).
-        return np.asarray(choices)
+        checksum = int(jnp.sum(jnp.where(choices >= 0, choices, -1)))
+        return choices, checksum
 
     t0 = time.perf_counter()
-    choices = run()
+    choices_dev, checksum = run()
     cold = time.perf_counter() - t0
-    log(f"device cold (incl XLA compile): {cold:.1f}s")
+    log(f"device cold (incl XLA compile): {cold:.1f}s (checksum={checksum})")
 
-    # the first warm repeat right after compile can report a bogus ~0s on the
-    # axon runtime; take the median of 3 timed runs
+    # median of 3 timed runs; each run re-dispatches and fetches the checksum
     warm_times = []
+    drift = False
     for _ in range(3):
         t0 = time.perf_counter()
-        choices = run()
+        choices_dev, cs = run()
         warm_times.append(time.perf_counter() - t0)
+        if cs != checksum:
+            drift = True
+            log(f"WARNING: checksum drift {checksum} -> {cs}")
     warm = float(np.median(warm_times))
     rate = num_pods / warm
+    choices = np.asarray(choices_dev)
     scheduled = int(np.sum(choices >= 0))
     log(f"device warm (median of {[f'{t:.3f}' for t in warm_times]}): "
         f"{num_pods} pods in {warm:.2f}s = {rate:.0f} pods/s "
@@ -151,14 +189,136 @@ def main() -> None:
     log(f"parity check on first {baseline_pods} pods: {mismatches} mismatches")
 
     mode = "exact scan" if batch == 0 else f"wavefront K={batch}"
-    print(json.dumps({
+    result = {
         "metric": f"scheduled pods/sec ({num_pods // 1000}k Zipf pods, "
                   f"{num_nodes} heterogeneous nodes, {mode}, "
+                  f"platform={real_platform}, "
                   f"parity_mismatches={mismatches})",
         "value": round(rate, 1),
         "unit": "pods/s",
         "vs_baseline": round(rate / ref_rate, 2),
-    }))
+    }
+    if drift:
+        # runtime-integrity failure: the rate may be measured on incomplete
+        # execution — surface it in the artifact, not just stderr
+        result["error"] = "checksum drift across timed runs; rate unreliable"
+    print(json.dumps(result), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent: probe + orchestrate with timeouts, retries, and CPU fallback
+# --------------------------------------------------------------------------
+
+_PROBE_CODE = "import jax; d = jax.devices(); print(d[0].platform, flush=True)"
+
+
+def probe_default_backend(timeout: float) -> str | None:
+    """Try initializing the default jax backend in a subprocess.
+
+    Returns the platform name on success, None on failure/timeout. Runs out
+    of process because a hung TPU tunnel blocks jax.devices() indefinitely
+    with the GIL held — no in-process timeout can recover from that.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        log(f"probe: backend init timed out after {timeout:.0f}s")
+        return None
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        log("probe: backend init failed: " + " | ".join(tail))
+        return None
+    platform = proc.stdout.strip().split()[-1] if proc.stdout.strip() else ""
+    log(f"probe: default backend platform = {platform!r}")
+    return platform or None
+
+
+def run_bench_subprocess(platform: str, timeout: float):
+    """Run the measurement child; returns (parsed_json | None, error | None)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", platform]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout,
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired as e:
+        for stream in (e.stderr, e.stdout):
+            if stream:
+                text = stream.decode() if isinstance(stream, bytes) else stream
+                for line in text.strip().splitlines()[-10:]:
+                    log(f"  [child] {line}")
+        return None, f"bench run on {platform!r} timed out after {timeout:.0f}s"
+    for line in (proc.stderr or "").strip().splitlines():
+        log(f"  [child] {line}")
+    if proc.returncode != 0:
+        return None, f"bench run on {platform!r} exited rc={proc.returncode}"
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"bench run on {platform!r} produced no JSON line"
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        run_child(sys.argv[2] if len(sys.argv) > 2 else "default")
+        return
+
+    probe_timeout = float(os.environ.get("TPUSIM_BENCH_PROBE_TIMEOUT", 150))
+    run_timeout = float(os.environ.get("TPUSIM_BENCH_RUN_TIMEOUT", 2400))
+    retries = int(os.environ.get("TPUSIM_BENCH_PROBE_RETRIES", 3))
+
+    errors: list[str] = []
+
+    # 1) probe the default (TPU) backend with bounded retries
+    platform = None
+    for attempt in range(1, retries + 1):
+        log(f"probe attempt {attempt}/{retries} (timeout {probe_timeout:.0f}s)")
+        platform = probe_default_backend(probe_timeout)
+        if platform:
+            break
+        if attempt < retries:
+            backoff = 10.0 * attempt
+            log(f"probe: retrying in {backoff:.0f}s")
+            time.sleep(backoff)
+    if not platform:
+        errors.append(f"default backend unavailable after {retries} probes")
+    elif platform == "cpu":
+        # a "default" backend that is really the CPU (e.g. plugin init failed
+        # with a warning-level fallback) must not run the TPU-sized workload
+        errors.append("default backend probed as cpu; using cpu-sized workload")
+        platform = None
+
+    # 2) run the measurement on the probed backend, then fall back to CPU
+    attempts = []
+    if platform:
+        attempts.append("default")
+    attempts.append("cpu")
+    for target in attempts:
+        label = platform if target == "default" else "cpu"
+        log(f"running benchmark on {label} (timeout {run_timeout:.0f}s)")
+        result, err = run_bench_subprocess(target, run_timeout)
+        if result is not None:
+            if errors:
+                result["note"] = "; ".join(errors)
+            print(json.dumps(result), flush=True)
+            return
+        errors.append(err)
+        log(f"FAILED: {err}")
+
+    # 3) everything failed: still emit one valid JSON line, rc 0
+    print(json.dumps({
+        "metric": "scheduled pods/sec (benchmark failed)",
+        "value": 0,
+        "unit": "pods/s",
+        "vs_baseline": 0,
+        "error": "; ".join(errors),
+    }), flush=True)
 
 
 if __name__ == "__main__":

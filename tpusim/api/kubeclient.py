@@ -103,27 +103,34 @@ def load_kubeconfig(path: str, context: str = "") -> KubeClientConfig:
     cfg = KubeClientConfig(
         server=server.rstrip("/"),
         insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")))
-    if cluster.get("certificate-authority"):
-        cfg.ca_file = cluster["certificate-authority"]
-    elif cluster.get("certificate-authority-data"):
-        cfg.ca_file = _materialize(cluster["certificate-authority-data"],
-                                   ".crt", cfg)
-    token = user.get("token") or ""
-    if not token and user.get("tokenFile"):
-        with open(user["tokenFile"]) as f:
-            token = f.read().strip()
-    cfg.token = token
-    cfg.username = user.get("username") or ""
-    cfg.password = user.get("password") or ""
-    if user.get("client-certificate"):
-        cfg.client_cert_file = user["client-certificate"]
-    elif user.get("client-certificate-data"):
-        cfg.client_cert_file = _materialize(user["client-certificate-data"],
-                                            ".crt", cfg)
-    if user.get("client-key"):
-        cfg.client_key_file = user["client-key"]
-    elif user.get("client-key-data"):
-        cfg.client_key_file = _materialize(user["client-key-data"], ".key", cfg)
+    try:
+        if cluster.get("certificate-authority"):
+            cfg.ca_file = cluster["certificate-authority"]
+        elif cluster.get("certificate-authority-data"):
+            cfg.ca_file = _materialize(cluster["certificate-authority-data"],
+                                       ".crt", cfg)
+        token = user.get("token") or ""
+        if not token and user.get("tokenFile"):
+            with open(user["tokenFile"]) as f:
+                token = f.read().strip()
+        cfg.token = token
+        cfg.username = user.get("username") or ""
+        cfg.password = user.get("password") or ""
+        if user.get("client-certificate"):
+            cfg.client_cert_file = user["client-certificate"]
+        elif user.get("client-certificate-data"):
+            cfg.client_cert_file = _materialize(user["client-certificate-data"],
+                                                ".crt", cfg)
+        if user.get("client-key"):
+            cfg.client_key_file = user["client-key"]
+        elif user.get("client-key-data"):
+            cfg.client_key_file = _materialize(user["client-key-data"], ".key",
+                                               cfg)
+    except Exception:
+        # materialized *-data temp files can hold client TLS keys; don't
+        # leave them behind when the rest of the config fails to parse
+        cfg.cleanup()
+        raise
     return cfg
 
 
@@ -140,6 +147,9 @@ def in_cluster_config(root: str = SERVICE_ACCOUNT_ROOT,
     ca_path = os.path.join(root, "ca.crt")
     with open(token_path) as f:
         token = f.read().strip()
+    # net.JoinHostPort semantics: bracket IPv6 hosts
+    if ":" in host and not host.startswith("["):
+        host = f"[{host}]"
     return KubeClientConfig(server=f"https://{host}:{port}", token=token,
                             ca_file=ca_path if os.path.exists(ca_path) else "")
 

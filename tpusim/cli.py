@@ -78,6 +78,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--enable-pod-priority", action="store_true",
                         help="Enable the PodPriority feature gate (preemption); "
                              "reference backend only")
+    parser.add_argument("--platform", default=os.environ.get("TPUSIM_PLATFORM", ""),
+                        help="Pin the jax platform (e.g. cpu) — needed because "
+                             "the TPU plugin can override JAX_PLATFORMS; default "
+                             "auto (TPUSIM_PLATFORM env)")
     parser.add_argument("--print-requirements", action="store_true",
                         help="Also print per-pod requirement spec")
     parser.add_argument("--quiet", action="store_true",
@@ -154,6 +158,11 @@ def run_what_if_cli(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     if args.what_if:
         return run_what_if_cli(args)

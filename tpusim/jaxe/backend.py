@@ -1,14 +1,21 @@
 """JaxBackend: the SimulatorBackend implementation running on TPU/XLA.
 
-Exactness contract: placements are IDENTICAL to ReferenceBackend — verified by
-randomized differential tests — across the full DefaultProvider feature set:
-resources/conditions/pressure, taints/tolerations, node selectors, node
-affinity, hostname pins, scalar resources, controller-avoid annotations, host
-ports, services/selector-spreading, and inter-pod (anti)affinity (pod-group
-presence state carried on device; state.GroupTables). The only compile-time
-fallback left is a group-count blowup (> state.MAX_GROUPS distinct pod
-signatures), routed to the reference backend (fallback="reference") or
-rejected (fallback="error").
+Exactness contract (default sequential scan, batch_size=0): placements are
+IDENTICAL to ReferenceBackend — verified by randomized differential tests —
+across the full DefaultProvider feature set: resources/conditions/pressure,
+taints/tolerations, node selectors, node affinity, hostname pins, scalar
+resources, controller-avoid annotations, host ports,
+services/selector-spreading, and inter-pod (anti)affinity (pod-group presence
+state carried on device; state.GroupTables).
+
+Wavefront mode (batch_size=K>0) is fast but approximate: carry state is frozen
+within a wave, so same-wave pods do not see each other's resource usage,
+host-port occupancy, anti-affinity presence, or spreading counts; the
+exactness contract holds only across wave boundaries.
+
+The only compile-time fallback left is a group-count blowup (> state.MAX_GROUPS
+distinct pod signatures), routed to the reference backend
+(fallback="reference") or rejected (fallback="error").
 """
 
 from __future__ import annotations

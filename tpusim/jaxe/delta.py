@@ -430,21 +430,24 @@ class IncrementalCluster:
         rows = []
         for sig_key in interned_keys:
             cache_key = (kind, sig_key)
-            row = self._sig_rows.get(cache_key)
+            row = self._sig_rows.pop(cache_key, None)
             if row is None:
                 rep = self._sig_reps[sig_key]
                 row = np.fromiter((fn(rep, i) for i in range(n)),
                                   dtype=dtype, count=n)
-                self._sig_rows[cache_key] = row
                 self.sig_row_computations += n
+            # re-insert (move-to-end) so eviction is LRU, not FIFO — the
+            # upstream equivalence cache this mirrors is an LRU
+            self._sig_rows[cache_key] = row
             rows.append(row)
         if not rows:
             return np.zeros((1, n), dtype=dtype)
         return np.stack(rows)
 
     def _evict_sig_rows(self) -> None:
-        """Bound the signature-row memo (FIFO) and drop representatives that
-        no cached row references anymore."""
+        """Bound the signature-row memo (LRU: hits are re-inserted at the end
+        by _sig_table, so the head is least-recently-used) and drop
+        representatives that no cached row references anymore."""
         if len(self._sig_rows) <= MAX_SIG_ROWS:
             return
         overflow = len(self._sig_rows) - MAX_SIG_ROWS

@@ -1,0 +1,523 @@
+"""Volume subsystem: the four volume predicates + the volume binder.
+
+Golden cases ported from the reference's upstream tables:
+  predicates_test.go TestGCEDiskConflicts:669 / TestAWSDiskConflicts:722 /
+  TestRBDDiskConflicts:775 / TestISCSIDiskConflicts:834,
+  TestEBSVolumeCountConflicts:1622-2060, TestVolumeZonePredicate:3694,
+  TestVolumeZonePredicateMultiZone:3822,
+  TestVolumeZonePredicateWithVolumeBinding:3915.
+"""
+
+import pytest
+
+from tpusim.api.snapshot import (
+    ClusterSnapshot,
+    make_node,
+    make_pod,
+    make_pod_volume,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+)
+from tpusim.api.types import Pod
+from tpusim.engine import errors as err
+from tpusim.engine.predicates import (
+    make_check_volume_binding_predicate,
+    make_max_pd_volume_count_predicate,
+    make_no_volume_zone_conflict_predicate,
+    no_disk_conflict,
+)
+from tpusim.engine.resources import NodeInfo
+from tpusim.engine.volume import VolumeBinder
+from tpusim.simulator import run_simulation
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+REGION = "failure-domain.beta.kubernetes.io/region"
+
+
+def pod_with_volumes(name, *volumes):
+    return make_pod(name, volumes=list(volumes))
+
+
+def node_info_with(*pods):
+    info = NodeInfo()
+    for p in pods:
+        info.add_pod(p)
+    return info
+
+
+# ---------------------------------------------------------------------------
+# NoDiskConflict (TestGCE/AWS/RBD/ISCSIDiskConflicts)
+# ---------------------------------------------------------------------------
+
+
+GCE_FOO = {"gcePersistentDisk": {"pdName": "foo"}}
+GCE_BAR = {"gcePersistentDisk": {"pdName": "bar"}}
+EBS_FOO = {"awsElasticBlockStore": {"volumeID": "foo"}}
+EBS_BAR = {"awsElasticBlockStore": {"volumeID": "bar"}}
+RBD_A = {"rbd": {"monitors": ["a", "b"], "pool": "test", "image": "bar"}}
+RBD_SAME = {"rbd": {"monitors": ["c", "b"], "pool": "test", "image": "bar"}}
+RBD_DIFF_IMAGE = {"rbd": {"monitors": ["a", "b"], "pool": "test", "image": "foo"}}
+RBD_DIFF_POOL = {"rbd": {"monitors": ["c", "b"], "pool": "test2", "image": "bar"}}
+ISCSI_A = {"iscsi": {"targetPortal": "127.0.0.1:3260", "iqn": "iqn.2016-12.server:storage.target01", "lun": 0}}
+ISCSI_SAME = {"iscsi": {"targetPortal": "127.0.0.1:3260", "iqn": "iqn.2016-12.server:storage.target01", "lun": 0}}
+ISCSI_DIFF = {"iscsi": {"targetPortal": "127.0.0.1:3260", "iqn": "iqn.2017-12.server:storage.target01", "lun": 0}}
+
+
+@pytest.mark.parametrize("new_sources,existing_sources,fits", [
+    # GCE: read-write sharing conflicts; different disks don't
+    ([], [GCE_FOO], True),
+    ([GCE_FOO], [GCE_FOO], False),
+    ([GCE_BAR], [GCE_FOO], True),
+    # AWS EBS: any sharing conflicts
+    ([EBS_FOO], [EBS_FOO], False),
+    ([EBS_BAR], [EBS_FOO], True),
+    # RBD: overlapping monitors + same pool/image
+    ([RBD_SAME], [RBD_A], False),
+    ([RBD_DIFF_IMAGE], [RBD_A], True),
+    ([RBD_DIFF_POOL], [RBD_A], True),
+    # ISCSI: same IQN
+    ([ISCSI_SAME], [ISCSI_A], False),
+    ([ISCSI_DIFF], [ISCSI_A], True),
+])
+def test_no_disk_conflict(new_sources, existing_sources, fits):
+    new_pod = pod_with_volumes(
+        "new", *[make_pod_volume(f"v{i}", source=s)
+                 for i, s in enumerate(new_sources)])
+    existing = pod_with_volumes(
+        "old", *[make_pod_volume(f"e{i}", source=s)
+                 for i, s in enumerate(existing_sources)])
+    ok, reasons = no_disk_conflict(new_pod, None, node_info_with(existing))
+    assert ok == fits
+    if not fits:
+        assert reasons == [err.ERR_DISK_CONFLICT]
+
+
+def test_no_disk_conflict_read_only_gce():
+    """GCE PDs may be shared when every mount is read-only (predicates.go:227-230)."""
+    ro = {"gcePersistentDisk": {"pdName": "foo", "readOnly": True}}
+    rw = {"gcePersistentDisk": {"pdName": "foo"}}
+    existing = pod_with_volumes("old", make_pod_volume("e", source=ro))
+    ok, _ = no_disk_conflict(pod_with_volumes("n", make_pod_volume("v", source=ro)),
+                             None, node_info_with(existing))
+    assert ok
+    ok, _ = no_disk_conflict(pod_with_volumes("n", make_pod_volume("v", source=rw)),
+                             None, node_info_with(existing))
+    assert not ok
+
+
+def test_no_disk_conflict_empty_node():
+    ok, _ = no_disk_conflict(Pod(), None, NodeInfo())
+    assert ok
+
+
+# ---------------------------------------------------------------------------
+# MaxPDVolumeCount (TestEBSVolumeCountConflicts)
+# ---------------------------------------------------------------------------
+
+
+def _ebs_fixtures():
+    pvs = [make_pv("someEBSVol", source={"awsElasticBlockStore": {"volumeID": "ebsVol"}}),
+           make_pv("someNonEBSVol")]
+    pvcs = [make_pvc("someEBSVol", volume_name="someEBSVol"),
+            make_pvc("someNonEBSVol", volume_name="someNonEBSVol"),
+            make_pvc("pvcWithDeletedPV", volume_name="pvcWithDeletedPV"),
+            make_pvc("anotherPVCWithDeletedPV", volume_name="anotherPVCWithDeletedPV"),
+            make_pvc("unboundPVC", volume_name=""),
+            make_pvc("anotherUnboundPVC", volume_name="")]
+    binder = VolumeBinder(pvs, pvcs, [])
+    return binder
+
+
+ONE_VOL = pod_with_volumes("one", make_pod_volume("v", source={"awsElasticBlockStore": {"volumeID": "ovp"}}))
+TWO_VOL = pod_with_volumes(
+    "two",
+    make_pod_volume("v1", source={"awsElasticBlockStore": {"volumeID": "tvp1"}}),
+    make_pod_volume("v2", source={"awsElasticBlockStore": {"volumeID": "tvp2"}}))
+SPLIT_VOL = pod_with_volumes(
+    "split", make_pod_volume("v1", source={"hostPath": {"path": "/x"}}),
+    make_pod_volume("v2", source={"awsElasticBlockStore": {"volumeID": "svp"}}))
+NON_APPLICABLE = pod_with_volumes(
+    "na", make_pod_volume("v", source={"hostPath": {"path": "/x"}}))
+EMPTY_POD = make_pod("empty")
+EBS_PVC_POD = pod_with_volumes("pvc", make_pod_volume("v", pvc="someEBSVol"))
+SPLIT_PVC_POD = pod_with_volumes(
+    "splitpvc", make_pod_volume("v1", pvc="someNonEBSVol"),
+    make_pod_volume("v2", pvc="someEBSVol"))
+DELETED_PVC_POD = pod_with_volumes("delpvc", make_pod_volume("v", pvc="deletedPVC"))
+TWO_DELETED_PVC_POD = pod_with_volumes(
+    "twodelpvc", make_pod_volume("v1", pvc="deletedPVC"),
+    make_pod_volume("v2", pvc="anotherDeletedPVC"))
+DELETED_PV_POD = pod_with_volumes("delpv", make_pod_volume("v", pvc="pvcWithDeletedPV"))
+DELETED_PV_POD2 = pod_with_volumes("delpv2", make_pod_volume("v", pvc="pvcWithDeletedPV"))
+ANOTHER_DELETED_PV_POD = pod_with_volumes(
+    "delpv3", make_pod_volume("v", pvc="anotherPVCWithDeletedPV"))
+UNBOUND_PVC_POD = pod_with_volumes("ub", make_pod_volume("v", pvc="unboundPVC"))
+UNBOUND_PVC_POD2 = pod_with_volumes("ub2", make_pod_volume("v", pvc="unboundPVC"))
+ANOTHER_UNBOUND_PVC_POD = pod_with_volumes(
+    "ub3", make_pod_volume("v", pvc="anotherUnboundPVC"))
+
+
+@pytest.mark.parametrize("new_pod,existing,max_vols,fits,label", [
+    (ONE_VOL, [TWO_VOL], 4, True, "fits when not exceeding the max"),
+    (TWO_VOL, [ONE_VOL], 2, False, "doesn't fit when exceeding the max"),
+    (ONE_VOL, [ONE_VOL], 2, True, "same EBS volume not counted twice"),
+    (SPLIT_VOL, [TWO_VOL], 3, True, "new pod ignores non-EBS volumes"),
+    (TWO_VOL, [SPLIT_VOL, NON_APPLICABLE, EMPTY_POD], 3, True,
+     "existing counts ignore non-EBS"),
+    (EBS_PVC_POD, [SPLIT_VOL, NON_APPLICABLE, EMPTY_POD], 3, True,
+     "PVC backed by EBS counted"),
+    (SPLIT_PVC_POD, [SPLIT_VOL, ONE_VOL], 3, True,
+     "PVCs not backed by EBS ignored"),
+    (TWO_VOL, [ONE_VOL, EBS_PVC_POD], 3, False,
+     "existing PVC-backed EBS counted"),
+    (TWO_VOL, [ONE_VOL, TWO_VOL, EBS_PVC_POD], 4, True,
+     "already-mounted volumes always ok"),
+    (SPLIT_VOL, [ONE_VOL, ONE_VOL, EBS_PVC_POD], 3, True,
+     "same EBS volumes not counted multiple times"),
+    (EBS_PVC_POD, [ONE_VOL, DELETED_PVC_POD], 2, False,
+     "missing PVC counted (max 2)"),
+    (EBS_PVC_POD, [ONE_VOL, DELETED_PVC_POD], 3, True,
+     "missing PVC counted (max 3)"),
+    (EBS_PVC_POD, [ONE_VOL, TWO_DELETED_PVC_POD], 3, False,
+     "two missing PVCs counted twice"),
+    (EBS_PVC_POD, [ONE_VOL, DELETED_PV_POD], 2, False,
+     "missing PV counted (max 2)"),
+    (EBS_PVC_POD, [ONE_VOL, DELETED_PV_POD], 3, True,
+     "missing PV counted (max 3)"),
+    (DELETED_PV_POD2, [ONE_VOL, DELETED_PV_POD], 2, True,
+     "same missing PV counted once"),
+    (ANOTHER_DELETED_PV_POD, [ONE_VOL, DELETED_PV_POD], 2, False,
+     "different missing PVs counted twice"),
+    (EBS_PVC_POD, [ONE_VOL, UNBOUND_PVC_POD], 2, False,
+     "unbound PVC counted (max 2)"),
+    (EBS_PVC_POD, [ONE_VOL, UNBOUND_PVC_POD], 3, True,
+     "unbound PVC counted (max 3)"),
+    (UNBOUND_PVC_POD2, [ONE_VOL, UNBOUND_PVC_POD], 2, True,
+     "same unbound PVC counted once"),
+    (ANOTHER_UNBOUND_PVC_POD, [ONE_VOL, UNBOUND_PVC_POD], 2, False,
+     "different unbound PVCs counted twice"),
+])
+def test_ebs_volume_count(new_pod, existing, max_vols, fits, label):
+    binder = _ebs_fixtures()
+    pred = make_max_pd_volume_count_predicate(
+        "EBS", binder.get_pvc, binder.get_pv, max_volumes=max_vols)
+    ok, reasons = pred(new_pod, None, node_info_with(*existing))
+    assert ok == fits, label
+    if not fits:
+        assert reasons == [err.ERR_MAX_VOLUME_COUNT_EXCEEDED]
+
+
+def test_max_vols_env_override(monkeypatch):
+    from tpusim.engine.predicates import get_max_vols
+
+    monkeypatch.setenv("KUBE_MAX_PD_VOLS", "4")
+    assert get_max_vols(39) == 4
+    monkeypatch.setenv("KUBE_MAX_PD_VOLS", "-2")
+    assert get_max_vols(39) == 39
+    monkeypatch.setenv("KUBE_MAX_PD_VOLS", "junk")
+    assert get_max_vols(39) == 39
+
+
+# ---------------------------------------------------------------------------
+# NoVolumeZoneConflict (TestVolumeZonePredicate + MultiZone + WithVolumeBinding)
+# ---------------------------------------------------------------------------
+
+
+def _zone_binder(enabled=False, classes=None):
+    pvs = [make_pv("Vol_1", labels={ZONE: "us-west1-a"}),
+           make_pv("Vol_2", labels={REGION: "us-west1-b", "uselessLabel": "none"}),
+           make_pv("Vol_3", labels={ZONE: "us-west1-c__us-west1-a"})]
+    pvcs = [make_pvc("PVC_1", volume_name="Vol_1"),
+            make_pvc("PVC_2", volume_name="Vol_2"),
+            make_pvc("PVC_3", volume_name="Vol_3"),
+            make_pvc("PVC_4", volume_name="Vol_not_exist")]
+    return VolumeBinder(pvs, pvcs, classes or [], enabled=enabled)
+
+
+def _zone_pred(binder, enabled=False):
+    return make_no_volume_zone_conflict_predicate(
+        binder.get_pvc, binder.get_pv, binder.get_class,
+        volume_scheduling_enabled=enabled)
+
+
+def _zone_node_info(labels):
+    info = NodeInfo()
+    info.set_node(make_node("host1", labels=labels))
+    return info
+
+
+@pytest.mark.parametrize("pvc,node_labels,fits", [
+    (None, {ZONE: "us-west1-a"}, True),                      # pod without volume
+    ("PVC_1", {}, True),                                     # node without labels
+    ("PVC_1", {ZONE: "us-west1-a", "uselessLabel": "none"}, True),
+    ("PVC_2", {REGION: "us-west1-b", "uselessLabel": "none"}, True),
+    ("PVC_2", {REGION: "no_us-west1-b", "uselessLabel": "none"}, False),
+    ("PVC_1", {ZONE: "no_us-west1-a", "uselessLabel": "none"}, False),
+    # multi-zone PV label (Vol_3: us-west1-c__us-west1-a)
+    ("PVC_3", {}, True),
+    ("PVC_3", {ZONE: "us-west1-a", "uselessLabel": "none"}, True),
+    ("PVC_3", {ZONE: "us-west1-b", "uselessLabel": "none"}, False),
+])
+def test_volume_zone(pvc, node_labels, fits):
+    pred = _zone_pred(_zone_binder())
+    pod = (make_pod("pod_1") if pvc is None
+           else pod_with_volumes("pod_1", make_pod_volume("vol_1", pvc=pvc)))
+    ok, reasons = pred(pod, None, _zone_node_info(node_labels))
+    assert ok == fits
+    if not fits:
+        assert reasons == [err.ERR_VOLUME_ZONE_CONFLICT]
+
+
+def test_volume_zone_missing_pvc_errors():
+    pred = _zone_pred(_zone_binder())
+    pod = pod_with_volumes("p", make_pod_volume("v", pvc="missing"))
+    with pytest.raises(err.PredicateError, match="was not found"):
+        pred(pod, None, _zone_node_info({ZONE: "us-west1-a"}))
+
+
+def test_volume_zone_missing_pv_errors():
+    pred = _zone_pred(_zone_binder())
+    pod = pod_with_volumes("p", make_pod_volume("v", pvc="PVC_4"))
+    with pytest.raises(err.PredicateError, match="PersistentVolume not found"):
+        pred(pod, None, _zone_node_info({ZONE: "us-west1-a"}))
+
+
+def test_volume_zone_with_volume_binding():
+    """TestVolumeZonePredicateWithVolumeBinding:3915 — gate on."""
+    classes = [make_storage_class("Class_Immediate"),
+               make_storage_class("Class_Wait", binding_mode="WaitForFirstConsumer")]
+    pvs = [make_pv("Vol_1", labels={ZONE: "us-west1-a"})]
+    pvcs = [make_pvc("PVC_1", volume_name="Vol_1"),
+            make_pvc("PVC_NoSC", storage_class="Class_0"),
+            make_pvc("PVC_EmptySC"),
+            make_pvc("PVC_WaitSC", storage_class="Class_Wait"),
+            make_pvc("PVC_ImmediateSC", storage_class="Class_Immediate")]
+    binder = VolumeBinder(pvs, pvcs, classes, enabled=True)
+    pred = _zone_pred(binder, enabled=True)
+    info = _zone_node_info({ZONE: "us-west1-a", "uselessLabel": "none"})
+
+    ok, _ = pred(pod_with_volumes("p", make_pod_volume("v", pvc="PVC_1")), None, info)
+    assert ok
+    for pvc_name in ("PVC_EmptySC", "PVC_NoSC", "PVC_ImmediateSC"):
+        with pytest.raises(err.PredicateError):
+            pred(pod_with_volumes("p", make_pod_volume("v", pvc=pvc_name)),
+                 None, info)
+    # WaitForFirstConsumer unbound claims are skipped
+    ok, _ = pred(pod_with_volumes("p", make_pod_volume("v", pvc="PVC_WaitSC")),
+                 None, info)
+    assert ok
+
+
+# ---------------------------------------------------------------------------
+# CheckVolumeBinding + VolumeBinder (scheduler_binder.go semantics)
+# ---------------------------------------------------------------------------
+
+
+def _binding_world(enabled=True):
+    classes = [make_storage_class("wait", binding_mode="WaitForFirstConsumer")]
+    pvs = [
+        make_pv("pv-a", storage="10Gi", storage_class="wait",
+                node_affinity_terms=[{"matchExpressions": [
+                    {"key": "zone", "operator": "In", "values": ["a"]}]}]),
+        make_pv("pv-b", storage="5Gi", storage_class="wait",
+                node_affinity_terms=[{"matchExpressions": [
+                    {"key": "zone", "operator": "In", "values": ["b"]}]}]),
+        make_pv("pv-bound", storage="1Gi",
+                claim_ref={"name": "claim-bound", "namespace": "default"},
+                node_affinity_terms=[{"matchExpressions": [
+                    {"key": "zone", "operator": "In", "values": ["a"]}]}]),
+    ]
+    pvcs = [make_pvc("claim-wait", storage="2Gi", storage_class="wait"),
+            make_pvc("claim-bound", volume_name="pv-bound")]
+    return VolumeBinder(pvs, pvcs, classes, enabled=enabled)
+
+
+def test_check_volume_binding_gate_off_trivially_true():
+    binder = _binding_world(enabled=False)
+    pred = make_check_volume_binding_predicate(binder)
+    pod = pod_with_volumes("p", make_pod_volume("v", pvc="nonexistent"))
+    info = NodeInfo()
+    info.set_node(make_node("n1"))
+    ok, reasons = pred(pod, None, info)
+    assert ok and reasons == []
+
+
+def test_check_volume_binding_bound_affinity():
+    binder = _binding_world()
+    pred = make_check_volume_binding_predicate(binder)
+    pod = pod_with_volumes("p", make_pod_volume("v", pvc="claim-bound"))
+    good = NodeInfo()
+    good.set_node(make_node("n1", labels={"zone": "a"}))
+    bad = NodeInfo()
+    bad.set_node(make_node("n2", labels={"zone": "b"}))
+    ok, _ = pred(pod, None, good)
+    assert ok
+    ok, reasons = pred(pod, None, bad)
+    assert not ok and reasons == [err.ERR_VOLUME_NODE_CONFLICT]
+
+
+def test_check_volume_binding_unbound_matching():
+    binder = _binding_world()
+    pred = make_check_volume_binding_predicate(binder)
+    pod = pod_with_volumes("p", make_pod_volume("v", pvc="claim-wait"))
+    node_a = NodeInfo()
+    node_a.set_node(make_node("na", labels={"zone": "a"}))
+    node_c = NodeInfo()
+    node_c.set_node(make_node("nc", labels={"zone": "c"}))
+    ok, _ = pred(pod, None, node_a)
+    assert ok
+    ok, reasons = pred(pod, None, node_c)
+    assert not ok and reasons == [err.ERR_VOLUME_BIND_CONFLICT]
+
+
+def test_assume_consumes_pv():
+    """After Assume, the chosen PV is claimed: a second identical claim no
+    longer finds a PV on the same node (pvCache.Assume analog)."""
+    classes = [make_storage_class("wait", binding_mode="WaitForFirstConsumer")]
+    pvs = [make_pv("only-pv", storage="5Gi", storage_class="wait")]
+    pvcs = [make_pvc("c1", storage="1Gi", storage_class="wait"),
+            make_pvc("c2", storage="1Gi", storage_class="wait")]
+    binder = VolumeBinder(pvs, pvcs, classes, enabled=True)
+    node = make_node("n1")
+    pod1 = pod_with_volumes("p1", make_pod_volume("v", pvc="c1"))
+    pod2 = pod_with_volumes("p2", make_pod_volume("v", pvc="c2"))
+    unbound_ok, bound_ok = binder.find_pod_volumes(pod1, node)
+    assert unbound_ok and bound_ok
+    binder.assume_pod_volumes(pod1, "n1")
+    assert binder.get_pv("only-pv").claim_ref is not None
+    unbound_ok, _ = binder.find_pod_volumes(pod2, node)
+    assert not unbound_ok
+
+
+def test_find_matching_volume_prefers_smallest():
+    from tpusim.engine.volume import find_matching_volume
+
+    pvs = [make_pv("big", storage="100Gi", storage_class="sc"),
+           make_pv("small", storage="2Gi", storage_class="sc"),
+           make_pv("tiny", storage="1Gi", storage_class="sc")]
+    claim = make_pvc("c", storage="2Gi", storage_class="sc")
+    pv = find_matching_volume(claim, pvs, make_node("n1"), {}, True)
+    assert pv.name == "small"
+
+
+def test_find_matching_volume_pv_controller_path_skips_delayed():
+    """node=None + delayBinding: the PV controller leaves delayed claims to
+    the scheduler (index.go:206-211)."""
+    from tpusim.engine.volume import find_matching_volume
+
+    pvs = [make_pv("small", storage="2Gi", storage_class="sc")]
+    claim = make_pvc("c", storage="2Gi", storage_class="sc")
+    assert find_matching_volume(claim, pvs, None, {}, True) is None
+    assert find_matching_volume(claim, pvs, None, {}, False).name == "small"
+
+
+def test_unbound_immediate_claim_errors():
+    """Immediate-binding unbound claims abort scheduling
+    (scheduler_binder.go:145-147)."""
+    from tpusim.engine.volume import VolumeBinderError
+
+    binder = VolumeBinder([], [make_pvc("c", storage="1Gi")], [], enabled=True)
+    pod = pod_with_volumes("p", make_pod_volume("v", pvc="c"))
+    with pytest.raises(VolumeBinderError, match="unbound PersistentVolumeClaims"):
+        binder.find_pod_volumes(pod, make_node("n1"))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the simulation pipeline with volumes
+# ---------------------------------------------------------------------------
+
+
+def _volume_snapshot():
+    nodes = [make_node(f"n{i}", labels={ZONE: "us-west1-a" if i < 2 else "us-west1-b"})
+             for i in range(4)]
+    pvs = [make_pv("vol-a", labels={ZONE: "us-west1-a"}),
+           make_pv("vol-b", labels={ZONE: "us-west1-b"})]
+    pvcs = [make_pvc("claim-a", volume_name="vol-a"),
+            make_pvc("claim-b", volume_name="vol-b")]
+    return ClusterSnapshot(nodes=nodes, pvs=pvs, pvcs=pvcs)
+
+
+def test_simulation_zone_constrained_placement():
+    """Zone-labeled PVs constrain pods to matching-zone nodes end-to-end."""
+    snapshot = _volume_snapshot()
+    pods = [make_pod("pod-a", milli_cpu=100,
+                     volumes=[make_pod_volume("v", pvc="claim-a")]),
+            make_pod("pod-b", milli_cpu=100,
+                     volumes=[make_pod_volume("v", pvc="claim-b")])]
+    status = run_simulation(pods, snapshot, backend="reference")
+    assert len(status.successful_pods) == 2
+    hosts = {p.name: p.spec.node_name for p in status.successful_pods}
+    assert hosts["pod-a"] in ("n0", "n1")
+    assert hosts["pod-b"] in ("n2", "n3")
+
+
+def test_simulation_disk_conflict_spreads_then_fails():
+    """Same RW GCE PD: one pod per cluster; the second becomes Unschedulable
+    with the NoDiskConflict reason on every node."""
+    snapshot = ClusterSnapshot(nodes=[make_node("n0"), make_node("n1")])
+    disk = {"gcePersistentDisk": {"pdName": "shared"}}
+    pods = [make_pod(f"p{i}", milli_cpu=10,
+                     volumes=[make_pod_volume("v", source=dict(disk))])
+            for i in range(3)]
+    status = run_simulation(pods, snapshot, backend="reference")
+    assert len(status.successful_pods) == 2
+    assert len(status.failed_pods) == 1
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert "node(s) had no available disk" in msg
+
+
+def test_simulation_max_pd_limit(monkeypatch):
+    monkeypatch.setenv("KUBE_MAX_PD_VOLS", "1")
+    snapshot = ClusterSnapshot(nodes=[make_node("n0")])
+    pods = [make_pod(f"p{i}", milli_cpu=10, volumes=[
+        make_pod_volume("v", source={"awsElasticBlockStore": {"volumeID": f"vol{i}"}})])
+        for i in range(2)]
+    status = run_simulation(pods, snapshot, backend="reference")
+    assert len(status.successful_pods) == 1
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert "node(s) exceed max volume count" in msg
+
+
+def test_simulation_volume_scheduling_gate():
+    """--enable-volume-scheduling: WaitForFirstConsumer claims steer pods to
+    PV-affine nodes and consume PVs across binds."""
+    classes = [make_storage_class("wait", binding_mode="WaitForFirstConsumer")]
+    nodes = [make_node("n0", labels={"zone": "a"}),
+             make_node("n1", labels={"zone": "b"})]
+    pvs = [make_pv("pv-a", storage="5Gi", storage_class="wait",
+                   node_affinity_terms=[{"matchExpressions": [
+                       {"key": "zone", "operator": "In", "values": ["a"]}]}])]
+    pvcs = [make_pvc("c1", storage="1Gi", storage_class="wait"),
+            make_pvc("c2", storage="1Gi", storage_class="wait")]
+    snapshot = ClusterSnapshot(nodes=nodes, pvs=pvs, pvcs=pvcs,
+                               storage_classes=classes)
+    pods = [make_pod("p1", milli_cpu=10,
+                     volumes=[make_pod_volume("v", pvc="c1")]),
+            make_pod("p2", milli_cpu=10,
+                     volumes=[make_pod_volume("v", pvc="c2")])]
+    status = run_simulation(pods, snapshot, backend="reference",
+                            enable_volume_scheduling=True)
+    # LIFO feed: p2 runs first, takes the only matching PV on n0; p1 then has
+    # no bindable PV anywhere
+    assert len(status.successful_pods) == 1
+    assert status.successful_pods[0].spec.node_name == "n0"
+    assert len(status.failed_pods) == 1
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert "didn't find available persistent volumes to bind" in msg
+
+
+def test_jax_backend_falls_back_on_volumes():
+    """The jax backend routes volume workloads to the parity engine; placements
+    match the reference backend exactly."""
+    from tpusim.backends import ReferenceBackend, placement_hash
+    from tpusim.jaxe.backend import JaxBackend
+
+    snapshot = _volume_snapshot()
+    pods = [make_pod("pod-a", milli_cpu=100,
+                     volumes=[make_pod_volume("v", pvc="claim-a")]),
+            make_pod("pod-b", milli_cpu=100,
+                     volumes=[make_pod_volume("v", pvc="claim-b")])]
+    ref = ReferenceBackend().schedule(pods, snapshot)
+    jax_placements = JaxBackend().schedule(pods, snapshot)
+    assert placement_hash(ref) == placement_hash(jax_placements)

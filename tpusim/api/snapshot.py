@@ -12,7 +12,15 @@ from dataclasses import dataclass, field
 import json
 from typing import List, Optional
 
-from tpusim.api.types import LABEL_HOSTNAME, Node, Pod, Service
+from tpusim.api.types import (
+    LABEL_HOSTNAME,
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    Service,
+    StorageClass,
+)
 
 
 @dataclass
@@ -22,13 +30,23 @@ class ClusterSnapshot:
     nodes: List[Node] = field(default_factory=list)
     pods: List[Pod] = field(default_factory=list)  # already-scheduled (Running) pods
     services: List[Service] = field(default_factory=list)
+    pvs: List[PersistentVolume] = field(default_factory=list)
+    pvcs: List[PersistentVolumeClaim] = field(default_factory=list)
+    storage_classes: List[StorageClass] = field(default_factory=list)
 
     def to_obj(self) -> dict:
-        return {
+        o = {
             "nodes": [n.to_obj() for n in self.nodes],
             "pods": [p.to_obj() for p in self.pods],
             "services": [s.to_obj() for s in self.services],
         }
+        if self.pvs:
+            o["persistentVolumes"] = [pv.to_obj() for pv in self.pvs]
+        if self.pvcs:
+            o["persistentVolumeClaims"] = [pvc.to_obj() for pvc in self.pvcs]
+        if self.storage_classes:
+            o["storageClasses"] = [sc.to_obj() for sc in self.storage_classes]
+        return o
 
     @classmethod
     def from_obj(cls, o: dict) -> "ClusterSnapshot":
@@ -36,6 +54,12 @@ class ClusterSnapshot:
             nodes=[Node.from_obj(n) for n in o.get("nodes") or []],
             pods=[Pod.from_obj(p) for p in o.get("pods") or []],
             services=[Service.from_obj(s) for s in o.get("services") or []],
+            pvs=[PersistentVolume.from_obj(v)
+                 for v in o.get("persistentVolumes") or []],
+            pvcs=[PersistentVolumeClaim.from_obj(v)
+                  for v in o.get("persistentVolumeClaims") or []],
+            storage_classes=[StorageClass.from_obj(v)
+                             for v in o.get("storageClasses") or []],
         )
 
     def save(self, path: str) -> None:
@@ -116,6 +140,7 @@ def make_pod(
     node_selector: Optional[dict] = None,
     tolerations: Optional[list] = None,
     affinity: Optional[dict] = None,
+    volumes: Optional[list] = None,
 ) -> Pod:
     """Build a pod fixture (reference: pkg/main.go:189-198 newSamplePod)."""
     requests = {}
@@ -141,7 +166,78 @@ def make_pod(
         obj["spec"]["tolerations"] = tolerations
     if affinity:
         obj["spec"]["affinity"] = affinity
+    if volumes:
+        obj["spec"]["volumes"] = volumes
     return Pod.from_obj(obj)
+
+
+def make_pod_volume(name: str, source: Optional[dict] = None,
+                    pvc: str = "") -> dict:
+    """A pod .spec.volumes entry: either a direct source dict (e.g.
+    {"gcePersistentDisk": {...}}) or a PVC reference."""
+    obj: dict = {"name": name}
+    if pvc:
+        obj["persistentVolumeClaim"] = {"claimName": pvc}
+    if source:
+        obj.update(source)
+    return obj
+
+
+def make_pv(
+    name: str,
+    storage: str = "1Gi",
+    labels: Optional[dict] = None,
+    storage_class: str = "",
+    access_modes: Optional[list] = None,
+    claim_ref: Optional[dict] = None,
+    node_affinity_terms: Optional[list] = None,
+    source: Optional[dict] = None,
+) -> PersistentVolume:
+    """Build a PersistentVolume fixture."""
+    spec: dict = {"capacity": {"storage": storage}}
+    if storage_class:
+        spec["storageClassName"] = storage_class
+    if access_modes:
+        spec["accessModes"] = list(access_modes)
+    if claim_ref:
+        spec["claimRef"] = dict(claim_ref)
+    if node_affinity_terms is not None:
+        spec["nodeAffinity"] = {
+            "required": {"nodeSelectorTerms": node_affinity_terms}}
+    if source:
+        spec.update(source)
+    return PersistentVolume.from_obj(
+        {"metadata": {"name": name, "labels": labels or {}}, "spec": spec})
+
+
+def make_pvc(
+    name: str,
+    namespace: str = "default",
+    volume_name: str = "",
+    storage: str = "1Gi",
+    storage_class: Optional[str] = None,
+    access_modes: Optional[list] = None,
+    selector: Optional[dict] = None,
+) -> PersistentVolumeClaim:
+    """Build a PersistentVolumeClaim fixture; volume_name='' = unbound."""
+    spec: dict = {"resources": {"requests": {"storage": storage}}}
+    if volume_name:
+        spec["volumeName"] = volume_name
+    if storage_class is not None:
+        spec["storageClassName"] = storage_class
+    if access_modes:
+        spec["accessModes"] = list(access_modes)
+    if selector:
+        spec["selector"] = dict(selector)
+    return PersistentVolumeClaim.from_obj(
+        {"metadata": {"name": name, "namespace": namespace}, "spec": spec})
+
+
+def make_storage_class(name: str, binding_mode: str = "") -> StorageClass:
+    obj: dict = {"metadata": {"name": name}}
+    if binding_mode:
+        obj["volumeBindingMode"] = binding_mode
+    return StorageClass.from_obj(obj)
 
 
 def synthetic_cluster(

@@ -539,6 +539,52 @@ class Container:
 
 
 @dataclass
+class Volume:
+    """A pod volume. Only the sources the scheduler reads are typed
+    (NoDiskConflict: GCE PD / AWS EBS / RBD / ISCSI, predicates.go:220-276;
+    MaxPDVolumeCount filters + PVC references, predicates.go:361-460); the
+    raw object is kept for round-trip."""
+
+    name: str = ""
+    raw: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_obj(cls, o: dict) -> "Volume":
+        return cls(name=o.get("name", ""), raw=dict(o))
+
+    def to_obj(self) -> dict:
+        return dict(self.raw)
+
+    @property
+    def gce_persistent_disk(self) -> Optional[dict]:
+        return self.raw.get("gcePersistentDisk")
+
+    @property
+    def aws_elastic_block_store(self) -> Optional[dict]:
+        return self.raw.get("awsElasticBlockStore")
+
+    @property
+    def rbd(self) -> Optional[dict]:
+        return self.raw.get("rbd")
+
+    @property
+    def iscsi(self) -> Optional[dict]:
+        return self.raw.get("iscsi")
+
+    @property
+    def azure_disk(self) -> Optional[dict]:
+        return self.raw.get("azureDisk")
+
+    @property
+    def pvc_name(self) -> Optional[str]:
+        """persistentVolumeClaim.claimName; None when not a PVC volume."""
+        pvc = self.raw.get("persistentVolumeClaim")
+        if pvc is None:
+            return None
+        return pvc.get("claimName", "")
+
+
+@dataclass
 class PodSpec:
     containers: list = field(default_factory=list)
     init_containers: list = field(default_factory=list)
@@ -549,6 +595,7 @@ class PodSpec:
     scheduler_name: str = ""
     priority: Optional[int] = None
     host_network: bool = False
+    volumes: list = field(default_factory=list)
 
     @classmethod
     def from_obj(cls, o: Optional[dict]) -> "PodSpec":
@@ -563,6 +610,7 @@ class PodSpec:
             scheduler_name=o.get("schedulerName", ""),
             priority=o.get("priority"),
             host_network=bool(o.get("hostNetwork", False)),
+            volumes=[Volume.from_obj(v) for v in o.get("volumes") or []],
         )
 
     def to_obj(self) -> dict:
@@ -583,6 +631,8 @@ class PodSpec:
             o["priority"] = self.priority
         if self.host_network:
             o["hostNetwork"] = True
+        if self.volumes:
+            o["volumes"] = [v.to_obj() for v in self.volumes]
         return o
 
 
@@ -813,6 +863,16 @@ class Service:
         return f"{self.namespace}/{self.metadata.name}"
 
 
+# beta annotation override for StorageClassName (v1helper
+# GetPersistentVolume(Claim)Class reads it before the spec field)
+ANN_STORAGE_CLASS = "volume.beta.kubernetes.io/storage-class"
+# alpha node-affinity annotation on PVs (volumehelper checkAlphaNodeAffinity)
+ANN_ALPHA_NODE_AFFINITY = "volume.alpha.kubernetes.io/node-affinity"
+
+VOLUME_BINDING_IMMEDIATE = "Immediate"
+VOLUME_BINDING_WAIT = "WaitForFirstConsumer"
+
+
 @dataclass
 class PersistentVolume:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -837,6 +897,73 @@ class PersistentVolume:
 
     def key(self) -> str:
         return self.metadata.name
+
+    def copy(self) -> "PersistentVolume":
+        return PersistentVolume.from_obj(self.to_obj())
+
+    # --- typed spec accessors the scheduler reads ---
+
+    @property
+    def spec_raw(self) -> dict:
+        return self.raw.get("spec") or {}
+
+    @property
+    def capacity_storage(self) -> int:
+        """spec.capacity.storage in bytes (Quantity.Value semantics)."""
+        qty = (self.spec_raw.get("capacity") or {}).get("storage")
+        if qty is None:
+            return 0
+        return parse_quantity(str(qty)).value()
+
+    @property
+    def claim_ref(self) -> Optional[dict]:
+        return self.spec_raw.get("claimRef")
+
+    @property
+    def access_modes(self) -> list:
+        return list(self.spec_raw.get("accessModes") or [])
+
+    @property
+    def volume_mode(self) -> str:
+        return self.spec_raw.get("volumeMode") or "Filesystem"
+
+    @property
+    def storage_class_name(self) -> str:
+        """v1helper.GetPersistentVolumeClass: beta annotation FIRST, then the
+        spec field (helpers.go:398-405)."""
+        if ANN_STORAGE_CLASS in self.metadata.annotations:
+            return self.metadata.annotations[ANN_STORAGE_CLASS]
+        return self.spec_raw.get("storageClassName") or ""
+
+    @property
+    def gce_persistent_disk(self) -> Optional[dict]:
+        return self.spec_raw.get("gcePersistentDisk")
+
+    @property
+    def aws_elastic_block_store(self) -> Optional[dict]:
+        return self.spec_raw.get("awsElasticBlockStore")
+
+    @property
+    def azure_disk(self) -> Optional[dict]:
+        return self.spec_raw.get("azureDisk")
+
+    def node_affinity_terms(self) -> Optional[list]:
+        """Required node-affinity terms (ORed NodeSelectorTerm list) from
+        spec.nodeAffinity.required, else the alpha annotation
+        (volumeutil.CheckNodeAffinity reads both). None = unconstrained."""
+        na = self.spec_raw.get("nodeAffinity")
+        req = (na or {}).get("required")
+        if req is None:
+            ann = self.metadata.annotations.get(ANN_ALPHA_NODE_AFFINITY)
+            if ann:
+                import json as _json
+
+                affinity = _json.loads(ann)
+                req = affinity.get("requiredDuringSchedulingIgnoredDuringExecution")
+        if req is None:
+            return None
+        return [NodeSelectorTerm.from_obj(t)
+                for t in req.get("nodeSelectorTerms") or []]
 
 
 @dataclass
@@ -868,6 +995,46 @@ class PersistentVolumeClaim:
     def key(self) -> str:
         return f"{self.namespace}/{self.metadata.name}"
 
+    def copy(self) -> "PersistentVolumeClaim":
+        return PersistentVolumeClaim.from_obj(self.to_obj())
+
+    # --- typed spec accessors the scheduler reads ---
+
+    @property
+    def spec_raw(self) -> dict:
+        return self.raw.get("spec") or {}
+
+    @property
+    def volume_name(self) -> str:
+        return self.spec_raw.get("volumeName") or ""
+
+    @property
+    def access_modes(self) -> list:
+        return list(self.spec_raw.get("accessModes") or [])
+
+    @property
+    def volume_mode(self) -> str:
+        return self.spec_raw.get("volumeMode") or "Filesystem"
+
+    @property
+    def storage_class_name(self) -> str:
+        """v1helper.GetPersistentVolumeClaimClass: beta annotation FIRST, then
+        the spec field, which may be an explicit "" (helpers.go:409-420)."""
+        if ANN_STORAGE_CLASS in self.metadata.annotations:
+            return self.metadata.annotations[ANN_STORAGE_CLASS]
+        sc = self.spec_raw.get("storageClassName")
+        return sc if sc is not None else ""
+
+    @property
+    def request_storage(self) -> int:
+        qty = ((self.spec_raw.get("resources") or {}).get("requests") or {}).get("storage")
+        if qty is None:
+            return 0
+        return parse_quantity(str(qty)).value()
+
+    def selector(self) -> Optional["LabelSelector"]:
+        return LabelSelector.from_obj(self.spec_raw.get("selector"))
+
 
 @dataclass
 class StorageClass:
@@ -893,6 +1060,12 @@ class StorageClass:
 
     def key(self) -> str:
         return self.metadata.name
+
+    @property
+    def volume_binding_mode(self) -> Optional[str]:
+        """None when unset — shouldDelayBinding errors on a gate-on class with
+        no mode (pv_controller.go:290-292)."""
+        return self.raw.get("volumeBindingMode")
 
 
 @dataclass

@@ -73,22 +73,36 @@ class ReferenceBackend:
 
     def __init__(self, provider: str = DEFAULT_PROVIDER,
                  hard_pod_affinity_symmetric_weight: int = 10,
-                 registry=None, always_check_all_predicates: bool = False):
+                 registry=None, always_check_all_predicates: bool = False,
+                 volume_scheduling_enabled: bool = False):
         self.provider = provider
         self.hard_pod_affinity_symmetric_weight = hard_pod_affinity_symmetric_weight
         self.registry = registry
         self.always_check_all_predicates = always_check_all_predicates
+        # the VolumeScheduling feature gate (off by default, like the
+        # reference's utilfeature defaults; scheduler.go:175)
+        self.volume_scheduling_enabled = volume_scheduling_enabled
 
     def schedule(self, pods: List[Pod], snapshot: ClusterSnapshot) -> List[Placement]:
+        from tpusim.engine.volume import VolumeBinder
+
         node_info_map = new_node_info_map(snapshot.nodes, snapshot.pods)
         nodes = list(snapshot.nodes)
 
         cluster_pods: List[Pod] = [p for p in snapshot.pods if p.spec.node_name]
+        binder = VolumeBinder(snapshot.pvs, snapshot.pvcs,
+                              snapshot.storage_classes,
+                              enabled=self.volume_scheduling_enabled)
 
         args = PluginFactoryArgs(
             pod_lister=lambda: list(cluster_pods),
             service_lister=lambda: list(snapshot.services),
             node_info_getter=lambda name: node_info_map.get(name),
+            pvc_getter=binder.get_pvc,
+            pv_getter=binder.get_pv,
+            storage_class_getter=binder.get_class,
+            volume_binder=binder,
+            volume_scheduling_enabled=self.volume_scheduling_enabled,
             hard_pod_affinity_symmetric_weight=self.hard_pod_affinity_symmetric_weight,
         )
         scheduler = create_from_provider(
@@ -109,6 +123,10 @@ class ReferenceBackend:
                                             reason="Unschedulable",
                                             message=str(sched_err)))
                 continue
+            if self.volume_scheduling_enabled:
+                # scheduleOne assumeAndBindVolumes (scheduler.go:367-398):
+                # consume the matched PVs so later pods see the binding
+                binder.assume_pod_volumes(pod, host)
             bound = bind_pod(pod, host)
             node_info_map[host].add_pod(bound)
             cluster_pods.append(bound)

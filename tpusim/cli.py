@@ -78,6 +78,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--enable-pod-priority", action="store_true",
                         help="Enable the PodPriority feature gate (preemption); "
                              "reference backend only")
+    parser.add_argument("--enable-volume-scheduling", action="store_true",
+                        help="Enable the VolumeScheduling feature gate "
+                             "(CheckVolumeBinding + delayed PV binding); "
+                             "reference backend only")
     parser.add_argument("--platform", default=os.environ.get("TPUSIM_PLATFORM", ""),
                         help="Pin the jax platform (e.g. cpu) — needed because "
                              "the TPU plugin can override JAX_PLATFORMS; default "
@@ -224,6 +228,7 @@ def main(argv=None) -> int:
         status = run_simulation(pods, snapshot, provider=args.algorithmprovider,
                                 backend=args.backend, batch_size=args.batch_size,
                                 enable_pod_priority=args.enable_pod_priority,
+                                enable_volume_scheduling=args.enable_volume_scheduling,
                                 policy=policy)
     except ValueError as exc:  # invalid policy/provider surfaced at build time
         print(f"error: {exc}", file=sys.stderr)

@@ -9,6 +9,12 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 
+class PredicateError(Exception):
+    """A hard predicate-evaluation error (the Go predicate's non-nil err
+    return): findNodesThatFit aggregates these per message and aborts the
+    pod's scheduling (generic_scheduler.go:330-352)."""
+
+
 class PredicateFailureReason:
     def get_reason(self) -> str:
         raise NotImplementedError

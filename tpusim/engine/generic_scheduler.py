@@ -21,7 +21,11 @@ from typing import Callable, Dict, List, Optional
 from tpusim.api.types import Node, Pod
 from tpusim.engine import errors as err
 from tpusim.engine.equivalence import get_equivalence_hash
-from tpusim.engine.errors import FailureReason, PredicateFailureReason
+from tpusim.engine.errors import (
+    FailureReason,
+    PredicateError,
+    PredicateFailureReason,
+)
 from tpusim.engine.predicates import (
     PREDICATES_ORDERING,
     PredicateMetadata,
@@ -178,12 +182,28 @@ class GenericScheduler:
             meta = self.predicate_meta_producer(pod, node_info_map)
             filtered = []
             failed = {}
+            errs: Dict[str, int] = {}
             for node in nodes:
-                fits, fails = self.pod_fits_on_node(pod, meta, node_info_map[node.name])
+                try:
+                    fits, fails = self.pod_fits_on_node(
+                        pod, meta, node_info_map[node.name])
+                except PredicateError as exc:
+                    # checkNode error arm: the message is counted, the node is
+                    # neither fit nor failed (generic_scheduler.go:330-340)
+                    errs[str(exc)] = errs.get(str(exc), 0) + 1
+                    continue
                 if fits:
                     filtered.append(node)
                 else:
                     failed[node.name] = fails
+            if errs:
+                # CreateAggregateFromMessageCountMap: scheduling of the pod
+                # aborts with the aggregated message (generic_scheduler.go:341-343)
+                messages = [m if c == 1 else f"{m} (repeated {c} times)"
+                            for m, c in errs.items()]
+                raise SchedulingError(
+                    messages[0] if len(messages) == 1
+                    else "[" + ", ".join(messages) + "]")
         if filtered and self.extenders:
             # extender filters run after the built-in predicates; failures are
             # appended as plain-message reasons (generic_scheduler.go:355-376)

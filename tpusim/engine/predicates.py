@@ -377,33 +377,275 @@ def check_node_unschedulable(pod: Pod, meta, node_info: NodeInfo) -> PredicateRe
 
 
 # ---------------------------------------------------------------------------
-# volume predicates — the simulator models no volumes, so these reproduce the
-# no-volume fast paths (pods without volumes pass trivially; see SURVEY.md §7
-# step 3 "Defer: volume predicates (no-op without PVs — matches simulator
-# default)").
+# volume predicates (predicates.go:220-276, 288-533, 1563-1619)
 # ---------------------------------------------------------------------------
 
 
+def _have_overlap(a: list, b: list) -> bool:
+    """predicates.go haveOverlap — any shared element."""
+    if len(a) > len(b):
+        a, b = b, a
+    s = set(a)
+    return any(x in s for x in b)
+
+
+def is_volume_conflict(volume, pod: Pod) -> bool:
+    """predicates.go isVolumeConflict:220-264 — GCE PD (read-only OK),
+    AWS EBS (any sharing conflicts), ISCSI (same IQN, not both read-only),
+    RBD (overlapping monitors + same pool/image, not both read-only)."""
+    gce, ebs = volume.gce_persistent_disk, volume.aws_elastic_block_store
+    rbd, iscsi = volume.rbd, volume.iscsi
+    if gce is None and ebs is None and rbd is None and iscsi is None:
+        return False
+    for existing in pod.spec.volumes:
+        egce = existing.gce_persistent_disk
+        if gce is not None and egce is not None:
+            if gce.get("pdName") == egce.get("pdName") and not (
+                    gce.get("readOnly") and egce.get("readOnly")):
+                return True
+        eebs = existing.aws_elastic_block_store
+        if ebs is not None and eebs is not None:
+            if ebs.get("volumeID") == eebs.get("volumeID"):
+                return True
+        eiscsi = existing.iscsi
+        if iscsi is not None and eiscsi is not None:
+            if iscsi.get("iqn") == eiscsi.get("iqn") and not (
+                    iscsi.get("readOnly") and eiscsi.get("readOnly")):
+                return True
+        erbd = existing.rbd
+        if rbd is not None and erbd is not None:
+            if (_have_overlap(rbd.get("monitors") or [], erbd.get("monitors") or [])
+                    and rbd.get("pool") == erbd.get("pool")
+                    and rbd.get("image") == erbd.get("image")
+                    and not (rbd.get("readOnly") and erbd.get("readOnly"))):
+                return True
+    return False
+
+
 def no_disk_conflict(pod: Pod, meta, node_info: NodeInfo) -> PredicateResult:
-    """Reference: predicates.go NoDiskConflict — conflicts only arise from
-    GCEPersistentDisk/AWSEBS/RBD/ISCSI volumes, which the domain model does not
-    carry; a volume-less pod always fits."""
+    """Reference: predicates.go NoDiskConflict:266-276."""
+    for volume in pod.spec.volumes:
+        for existing in node_info.pods:
+            if is_volume_conflict(volume, existing):
+                return False, [err.ERR_DISK_CONFLICT]
     return True, []
 
 
-def make_max_pd_volume_count_predicate(filter_type: str) -> FitPredicate:
+# MaxPDVolumeCount (predicates.go:288-460)
+
+EBS_VOLUME_FILTER_TYPE = "EBS"
+GCE_PD_VOLUME_FILTER_TYPE = "GCE"
+AZURE_DISK_VOLUME_FILTER_TYPE = "AzureDisk"
+
+DEFAULT_MAX_EBS_VOLUMES = 39
+DEFAULT_MAX_GCE_PD_VOLUMES = 16
+DEFAULT_MAX_AZURE_DISK_VOLUMES = 16
+KUBE_MAX_PD_VOLS_ENV = "KUBE_MAX_PD_VOLS"
+
+_VOLUME_FILTERS = {
+    # (volume source accessor, PV source accessor, id field)
+    EBS_VOLUME_FILTER_TYPE: (
+        lambda v: v.aws_elastic_block_store, lambda pv: pv.aws_elastic_block_store,
+        "volumeID", DEFAULT_MAX_EBS_VOLUMES),
+    GCE_PD_VOLUME_FILTER_TYPE: (
+        lambda v: v.gce_persistent_disk, lambda pv: pv.gce_persistent_disk,
+        "pdName", DEFAULT_MAX_GCE_PD_VOLUMES),
+    AZURE_DISK_VOLUME_FILTER_TYPE: (
+        lambda v: v.azure_disk, lambda pv: pv.azure_disk,
+        "diskName", DEFAULT_MAX_AZURE_DISK_VOLUMES),
+}
+
+
+def get_max_vols(default: int) -> int:
+    """predicates.go getMaxVols: KUBE_MAX_PD_VOLS env override when valid."""
+    import os
+
+    raw = os.environ.get(KUBE_MAX_PD_VOLS_ENV, "")
+    if raw:
+        try:
+            parsed = int(raw)
+        except ValueError:
+            return default
+        if parsed > 0:
+            return parsed
+    return default
+
+
+def make_max_pd_volume_count_predicate(
+        filter_type: str, pvc_getter=None, pv_getter=None,
+        max_volumes: Optional[int] = None) -> FitPredicate:
+    """Reference: predicates.go NewMaxPDVolumeCountPredicate:306-345 +
+    filterVolumes:361-420 + predicate:422-460. Counts unique relevant volume
+    ids (direct + resolved through PVC->PV); unresolvable PVCs count
+    conservatively under a synthetic id."""
+    if filter_type not in _VOLUME_FILTERS:
+        raise KeyError(
+            f"Wrong filterName, Only Support {EBS_VOLUME_FILTER_TYPE} "
+            f"{GCE_PD_VOLUME_FILTER_TYPE} {AZURE_DISK_VOLUME_FILTER_TYPE}")
+    vol_src, pv_src, id_field, default_max = _VOLUME_FILTERS[filter_type]
+    limit = max_volumes if max_volumes is not None else get_max_vols(default_max)
+    pvc_getter = pvc_getter or (lambda namespace, name: None)
+    pv_getter = pv_getter or (lambda name: None)
+
+    def filter_volumes(volumes, namespace: str, filtered: set) -> None:
+        for vol in volumes:
+            src = vol_src(vol)
+            if src is not None:
+                filtered.add((filter_type, src.get(id_field, "")))
+                continue
+            pvc_name = vol.pvc_name
+            if pvc_name is None:
+                continue
+            if pvc_name == "":
+                raise err.PredicateError("PersistentVolumeClaim had no name")
+            # stand-in id: unresolvable claims count toward the limit
+            # (predicates.go:379-410 logs and assumes relevant)
+            pvc_id = ("pvc", f"{namespace}/{pvc_name}")
+            pvc = pvc_getter(namespace, pvc_name)
+            if pvc is None:
+                filtered.add(pvc_id)
+                continue
+            pv_name = pvc.volume_name
+            if not pv_name:
+                filtered.add(pvc_id)
+                continue
+            pv = pv_getter(pv_name)
+            if pv is None:
+                filtered.add(pvc_id)
+                continue
+            pv_source = pv_src(pv)
+            if pv_source is not None:
+                filtered.add((filter_type, pv_source.get(id_field, "")))
+
     def max_pd_volume_count(pod: Pod, meta, node_info: NodeInfo) -> PredicateResult:
+        if not pod.spec.volumes:
+            return True, []
+        new_volumes: set = set()
+        filter_volumes(pod.spec.volumes, pod.namespace, new_volumes)
+        if not new_volumes:
+            return True, []
+        existing: set = set()
+        for existing_pod in node_info.pods:
+            filter_volumes(existing_pod.spec.volumes, existing_pod.namespace,
+                           existing)
+        if len(existing | new_volumes) > limit:
+            return False, [err.ERR_MAX_VOLUME_COUNT_EXCEEDED]
         return True, []
+
     max_pd_volume_count.__name__ = f"max_{filter_type.lower()}_volume_count"
     return max_pd_volume_count
 
 
-def no_volume_zone_conflict(pod: Pod, meta, node_info: NodeInfo) -> PredicateResult:
-    return True, []
+# NoVolumeZoneConflict (predicates.go:510-533 VolumeZoneChecker.predicate)
+
+LABEL_ZONE_FAILURE_DOMAIN = "failure-domain.beta.kubernetes.io/zone"
+LABEL_ZONE_REGION = "failure-domain.beta.kubernetes.io/region"
+_ZONE_LABELS = (LABEL_ZONE_FAILURE_DOMAIN, LABEL_ZONE_REGION)
 
 
-def check_volume_binding(pod: Pod, meta, node_info: NodeInfo) -> PredicateResult:
-    return True, []
+def label_zones_to_set(value: str) -> set:
+    """volumeutil.LabelZonesToSet: '__'-separated zone list; raises on an
+    empty element (ZonesToSet errors)."""
+    zones = set()
+    for zone in value.split("__"):
+        if zone == "":
+            raise ValueError(
+                f"{value} content is not valid, content should not be empty")
+        zones.add(zone)
+    return zones
+
+
+def make_no_volume_zone_conflict_predicate(
+        pvc_getter=None, pv_getter=None, class_getter=None,
+        volume_scheduling_enabled: bool = False) -> FitPredicate:
+    """Reference: predicates.go VolumeZoneChecker.predicate:510-533 — bound
+    PVs' zone/region labels must include the node's value for the same label."""
+    pvc_getter = pvc_getter or (lambda namespace, name: None)
+    pv_getter = pv_getter or (lambda name: None)
+    class_getter = class_getter or (lambda name: None)
+
+    def no_volume_zone_conflict(pod: Pod, meta, node_info: NodeInfo) -> PredicateResult:
+        if not pod.spec.volumes:
+            return True, []
+        node = node_info.node
+        if node is None:
+            raise err.PredicateError("node not found")
+        constraints = {k: v for k, v in node.metadata.labels.items()
+                       if k in _ZONE_LABELS}
+        if not constraints:
+            return True, []
+        for volume in pod.spec.volumes:
+            pvc_name = volume.pvc_name
+            if pvc_name is None:
+                continue
+            if pvc_name == "":
+                raise err.PredicateError("PersistentVolumeClaim had no name")
+            pvc = pvc_getter(pod.namespace, pvc_name)
+            if pvc is None:
+                raise err.PredicateError(
+                    f'PersistentVolumeClaim was not found: "{pvc_name}"')
+            pv_name = pvc.volume_name
+            if not pv_name:
+                if volume_scheduling_enabled:
+                    sc_name = pvc.storage_class_name
+                    if sc_name:
+                        sc = class_getter(sc_name)
+                        if sc is not None:
+                            from tpusim.api.types import VOLUME_BINDING_WAIT
+
+                            if sc.volume_binding_mode is None:
+                                raise err.PredicateError(
+                                    "VolumeBindingMode not set for "
+                                    f'StorageClass "{sc_name}"')
+                            if sc.volume_binding_mode == VOLUME_BINDING_WAIT:
+                                continue  # skip unbound delayed-binding volumes
+                raise err.PredicateError(
+                    f'PersistentVolumeClaim is not bound: "{pvc_name}"')
+            pv = pv_getter(pv_name)
+            if pv is None:
+                raise err.PredicateError(
+                    f'PersistentVolume not found: "{pv_name}"')
+            for k, v in pv.metadata.labels.items():
+                if k not in _ZONE_LABELS:
+                    continue
+                node_value = constraints.get(k)
+                try:
+                    volume_zones = label_zones_to_set(v)
+                except ValueError:
+                    continue  # unparsable label ignored (predicates.go:555-558)
+                if node_value not in volume_zones:
+                    return False, [err.ERR_VOLUME_ZONE_CONFLICT]
+        return True, []
+
+    return no_volume_zone_conflict
+
+
+def make_check_volume_binding_predicate(binder) -> FitPredicate:
+    """Reference: predicates.go VolumeBindingChecker.predicate:1586-1619 over a
+    volume.VolumeBinder; trivially true while the VolumeScheduling feature gate
+    is off (the reference's default)."""
+    from tpusim.engine.volume import VolumeBinderError
+
+    def check_volume_binding(pod: Pod, meta, node_info: NodeInfo) -> PredicateResult:
+        if binder is None or not binder.enabled:
+            return True, []
+        node = node_info.node
+        if node is None:
+            raise err.PredicateError("node not found")
+        try:
+            unbound_ok, bound_ok = binder.find_pod_volumes(pod, node)
+        except VolumeBinderError as exc:
+            raise err.PredicateError(str(exc))
+        reasons = []
+        if not bound_ok:
+            reasons.append(err.ERR_VOLUME_NODE_CONFLICT)
+        if not unbound_ok:
+            reasons.append(err.ERR_VOLUME_BIND_CONFLICT)
+        if reasons:
+            return False, reasons
+        return True, []
+
+    return check_volume_binding
 
 
 # ---------------------------------------------------------------------------

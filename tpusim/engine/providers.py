@@ -35,6 +35,13 @@ class PluginFactoryArgs:
     replica_set_lister: Callable[[], list] = field(default=lambda: [])
     stateful_set_lister: Callable[[], list] = field(default=lambda: [])
     node_info_getter: Callable[[str], object] = field(default=lambda name: None)
+    # volume listers (factory.go pVLister/pVCLister/storageClassLister) + the
+    # scheduler-side binder (factory.go:252-259); None binder = gate off
+    pvc_getter: Callable[[str, str], object] = field(default=lambda ns, name: None)
+    pv_getter: Callable[[str], object] = field(default=lambda name: None)
+    storage_class_getter: Callable[[str], object] = field(default=lambda name: None)
+    volume_binder: Optional[object] = None
+    volume_scheduling_enabled: bool = False
     hard_pod_affinity_symmetric_weight: int = DEFAULT_HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
     # extended resources ignored in PodFitsResources because an extender
     # manages them (factory.go:984-988)
@@ -143,16 +150,22 @@ def default_registry() -> AlgorithmRegistry:
 
     # --- predicates (defaults.go:113-178 + init extras) ---
     r.register_fit_predicate_factory(
-        preds.NO_VOLUME_ZONE_CONFLICT_PRED, lambda args: preds.no_volume_zone_conflict)
+        preds.NO_VOLUME_ZONE_CONFLICT_PRED,
+        lambda args: preds.make_no_volume_zone_conflict_predicate(
+            args.pvc_getter, args.pv_getter, args.storage_class_getter,
+            volume_scheduling_enabled=args.volume_scheduling_enabled))
     r.register_fit_predicate_factory(
         preds.MAX_EBS_VOLUME_COUNT_PRED,
-        lambda args: preds.make_max_pd_volume_count_predicate("EBS"))
+        lambda args: preds.make_max_pd_volume_count_predicate(
+            "EBS", args.pvc_getter, args.pv_getter))
     r.register_fit_predicate_factory(
         preds.MAX_GCE_PD_VOLUME_COUNT_PRED,
-        lambda args: preds.make_max_pd_volume_count_predicate("GCE"))
+        lambda args: preds.make_max_pd_volume_count_predicate(
+            "GCE", args.pvc_getter, args.pv_getter))
     r.register_fit_predicate_factory(
         preds.MAX_AZURE_DISK_VOLUME_COUNT_PRED,
-        lambda args: preds.make_max_pd_volume_count_predicate("AzureDisk"))
+        lambda args: preds.make_max_pd_volume_count_predicate(
+            "AzureDisk", args.pvc_getter, args.pv_getter))
     r.register_fit_predicate_factory(
         preds.MATCH_INTERPOD_AFFINITY_PRED,
         lambda args: preds.make_pod_affinity_predicate(args.node_info_getter,
@@ -168,7 +181,8 @@ def default_registry() -> AlgorithmRegistry:
     r.register_fit_predicate(preds.POD_TOLERATES_NODE_TAINTS_PRED,
                              preds.pod_tolerates_node_taints)
     r.register_fit_predicate_factory(
-        preds.CHECK_VOLUME_BINDING_PRED, lambda args: preds.check_volume_binding)
+        preds.CHECK_VOLUME_BINDING_PRED,
+        lambda args: preds.make_check_volume_binding_predicate(args.volume_binder))
     # registered-but-not-default predicates (defaults.go init():60-111)
     r.register_fit_predicate(preds.POD_FITS_RESOURCES_PRED, preds.pod_fits_resources)
     r.register_fit_predicate(preds.HOSTNAME_PRED, preds.pod_fits_host)

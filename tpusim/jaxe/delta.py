@@ -67,6 +67,7 @@ from tpusim.jaxe.state import (
     fill_pod_request_row,
     node_static_row,
     signature_row_fns,
+    volume_unsupported,
 )
 
 _SIG_KINDS = (
@@ -559,13 +560,15 @@ class IncrementalCluster:
             nonzero_mem=dyn.nonzero_mem.copy(),
             pod_count=dyn.pod_count.copy())
 
+        unsupported = list(unsupported)
+        unsupported.extend(volume_unsupported(pods, self._pods.values()))
         compiled = CompiledCluster(
             statics=statics_out, tables=tables, groups=groups_out,
             dynamic=dyn_out, scalar_names=list(self._scalar_names),
             node_index=dict(self._node_index),
             has_ports=has_ports, has_services=has_services,
             has_interpod=has_interpod, n_topo_doms=n_topo, n_zone_doms=n_zone,
-            unsupported=list(unsupported))
+            unsupported=unsupported)
         return compiled, cols
 
     # -- scheduling ---------------------------------------------------------

@@ -94,6 +94,19 @@ REASON_STRINGS = [
 MAX_GROUPS = 512
 
 
+def volume_unsupported(new_pods: List[Pod], cluster_pods) -> List[str]:
+    """Volume predicates are host-side for now (NoDiskConflict /
+    MaxPDVolumeCount / NoVolumeZoneConflict read PV/PVC state and per-node
+    mounted-volume sets): volume-using workloads route to the parity engine so
+    placements stay identical. Shared by compile_cluster and the incremental
+    path (delta.py) so the two can't drift."""
+    if any(p.spec.volumes for p in new_pods) \
+            or any(p.spec.volumes for p in cluster_pods):
+        return ["pod volumes (NoDiskConflict/MaxPDVolumeCount/"
+                "NoVolumeZoneConflict/CheckVolumeBinding)"]
+    return []
+
+
 class Interner:
     """Canonical-JSON signature -> dense id."""
 
@@ -684,6 +697,7 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
 
     sel_i, tol_i, aff_i, avoid_i, host_i = (Interner() for _ in range(5))
     unsupported: List[str] = []
+    unsupported.extend(volume_unsupported(pods, snapshot.pods))
     for j, pod in enumerate(pods):
         fill_pod_request_row(cols, j, pod, pod_requests[j], scalar_idx)
         cols.sel_id[j] = sel_i.intern(_selector_signature(pod), pod)

@@ -54,6 +54,9 @@ class SchedulerServerConfig:
     hard_pod_affinity_symmetric_weight: int = 10
     enable_pod_priority: bool = False
     enable_equivalence_cache: bool = False
+    # VolumeScheduling feature gate (scheduler.go:175; off in the reference's
+    # 1.10 defaults): enables CheckVolumeBinding + delayed-binding semantics
+    enable_volume_scheduling: bool = False
 
 
 class ClusterCapacity:
@@ -61,7 +64,9 @@ class ClusterCapacity:
 
     def __init__(self, config: SchedulerServerConfig, new_pods: List[Pod],
                  scheduled_pods: List[Pod], nodes: List[Node],
-                 services: Optional[list] = None):
+                 services: Optional[list] = None,
+                 pvs: Optional[list] = None, pvcs: Optional[list] = None,
+                 storage_classes: Optional[list] = None):
         self.config = config
         self.status = Status()
         self.report: Optional[GeneralReview] = None
@@ -88,7 +93,22 @@ class ClusterCapacity:
             self.status.scheduled_pods.append(pod)
         for svc in services or []:
             self.resource_store.add(ResourceType.SERVICES, svc)
+        for pv in pvs or []:
+            self.resource_store.add(ResourceType.PERSISTENT_VOLUMES, pv)
+        for pvc in pvcs or []:
+            self.resource_store.add(ResourceType.PERSISTENT_VOLUME_CLAIMS, pvc)
         self.nodes = nodes
+
+        # --- volume binder over the seeded PV/PVC/StorageClass state
+        # (simulator SchedulerConfigLocal wires PV/PVC informers,
+        # simulator.go:355-366; the binder itself is factory.go:252-259) ---
+        from tpusim.engine.volume import VolumeBinder
+
+        self.volume_binder = VolumeBinder(
+            self.resource_store.list(ResourceType.PERSISTENT_VOLUMES),
+            self.resource_store.list(ResourceType.PERSISTENT_VOLUME_CLAIMS),
+            storage_classes or [],
+            enabled=config.enable_volume_scheduling)
 
         # --- build the engine with store-backed listers (SchedulerConfigLocal,
         # simulator.go:345-428: fake empty RC/RS/StatefulSet listers, simulated
@@ -97,6 +117,11 @@ class ClusterCapacity:
             pod_lister=lambda: self.resource_store.list(ResourceType.PODS),
             service_lister=lambda: self.resource_store.list(ResourceType.SERVICES),
             node_info_getter=lambda name: self.node_info_map.get(name),
+            pvc_getter=self.volume_binder.get_pvc,
+            pv_getter=self.volume_binder.get_pv,
+            storage_class_getter=self.volume_binder.get_class,
+            volume_binder=self.volume_binder,
+            volume_scheduling_enabled=config.enable_volume_scheduling,
             hard_pod_affinity_symmetric_weight=config.hard_pod_affinity_symmetric_weight,
         )
         self.scheduling_queue = new_scheduling_queue(config.enable_pod_priority)
@@ -251,6 +276,24 @@ class ClusterCapacity:
                                           reason="Unschedulable",
                                           message=str(sched_err)))
             return "failed"
+        # assumeAndBindVolumes (scheduler.go:367-398): with the gate on, the
+        # matched PVs are consumed before the pod binds
+        if self.config.enable_volume_scheduling:
+            self.volume_binder.assume_pod_volumes(pod, host)
+            if self.scheduler.equivalence_cache is not None:
+                # PV claimRef changes invalidate volume predicates everywhere,
+                # like the factory's PV/PVC event hooks (factory.go
+                # invalidatePredicatesForPv/Pvc)
+                from tpusim.engine import predicates as preds
+
+                self.scheduler.equivalence_cache \
+                    .invalidate_cached_predicate_item_of_all_nodes([
+                        preds.MAX_EBS_VOLUME_COUNT_PRED,
+                        preds.MAX_GCE_PD_VOLUME_COUNT_PRED,
+                        preds.MAX_AZURE_DISK_VOLUME_COUNT_PRED,
+                        preds.NO_VOLUME_ZONE_CONFLICT_PRED,
+                        preds.CHECK_VOLUME_BINDING_PRED,
+                    ])
         # binding latency + e2e (scheduler.go:425,492)
         binding_start = perf_counter()
         self.bind(pod, host)
@@ -302,6 +345,7 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    provider: str = DEFAULT_PROVIDER, backend: str = "reference",
                    scheduler_name: str = DEFAULT_SCHEDULER_NAME,
                    batch_size: int = 0, enable_pod_priority: bool = False,
+                   enable_volume_scheduling: bool = False,
                    policy: Optional[Policy] = None) -> Status:
     """High-level entry: run `pods` (in podspec order; the LIFO feed reversal
     happens inside, matching the reference) against `snapshot` and return the
@@ -316,14 +360,20 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
             SchedulerServerConfig(scheduler_name=scheduler_name,
                                   algorithm_provider=provider,
                                   policy=policy,
-                                  enable_pod_priority=enable_pod_priority),
+                                  enable_pod_priority=enable_pod_priority,
+                                  enable_volume_scheduling=enable_volume_scheduling),
             new_pods=pods, scheduled_pods=snapshot.pods, nodes=snapshot.nodes,
-            services=snapshot.services)
+            services=snapshot.services, pvs=snapshot.pvs, pvcs=snapshot.pvcs,
+            storage_classes=snapshot.storage_classes)
         cc.run()
         return cc.status
     if backend == "jax":
         from tpusim.backends import get_backend
 
+        if enable_volume_scheduling:
+            raise ValueError("--enable-volume-scheduling requires --backend "
+                             "reference (delayed PV binding is stateful "
+                             "host-side matching)")
         jax_backend = get_backend("jax", provider=provider, batch_size=batch_size)
         feed = list(reversed(pods))  # the LIFO queue pops the last element first
         placements = jax_backend.schedule(feed, snapshot)

@@ -371,6 +371,23 @@ def test_check_volume_binding_unbound_matching():
     assert not ok and reasons == [err.ERR_VOLUME_BIND_CONFLICT]
 
 
+def test_assume_does_not_mutate_snapshot_pvs():
+    """The binder deep-copies PVs: assume writes claimRef into its own copy,
+    so re-running a simulation over the same snapshot starts fresh."""
+    classes = [make_storage_class("wait", binding_mode="WaitForFirstConsumer")]
+    pv = make_pv("only-pv", storage="5Gi", storage_class="wait")
+    pvcs = [make_pvc("c1", storage="1Gi", storage_class="wait")]
+    pod = pod_with_volumes("p1", make_pod_volume("v", pvc="c1"))
+    binder = VolumeBinder([pv], pvcs, classes, enabled=True)
+    binder.find_pod_volumes(pod, make_node("n1"))
+    binder.assume_pod_volumes(pod, "n1")
+    assert binder.get_pv("only-pv").claim_ref is not None
+    assert pv.claim_ref is None
+    unbound_ok, _ = VolumeBinder([pv], pvcs, classes,
+                                 enabled=True).find_pod_volumes(pod, make_node("n1"))
+    assert unbound_ok
+
+
 def test_assume_consumes_pv():
     """After Assume, the chosen PV is claimed: a second identical claim no
     longer finds a PV on the same node (pvCache.Assume analog)."""

@@ -899,7 +899,12 @@ class PersistentVolume:
         return self.metadata.name
 
     def copy(self) -> "PersistentVolume":
-        return PersistentVolume.from_obj(self.to_obj())
+        """Deep copy: raw holds nested spec dicts, and the binder's assume path
+        mutates spec.claimRef — a shallow dict() would alias the original."""
+        import copy as _copy
+
+        return PersistentVolume(metadata=ObjectMeta.from_obj(self.metadata.to_obj()),
+                                raw=_copy.deepcopy(self.raw))
 
     # --- typed spec accessors the scheduler reads ---
 
@@ -909,11 +914,14 @@ class PersistentVolume:
 
     @property
     def capacity_storage(self) -> int:
-        """spec.capacity.storage in bytes (Quantity.Value semantics)."""
-        qty = (self.spec_raw.get("capacity") or {}).get("storage")
-        if qty is None:
-            return 0
-        return parse_quantity(str(qty)).value()
+        """spec.capacity.storage in bytes (Quantity.Value semantics); memoized —
+        it sits in the per-pod-per-node CheckVolumeBinding hot path."""
+        v = self.__dict__.get("_capacity_storage")
+        if v is None:
+            qty = (self.spec_raw.get("capacity") or {}).get("storage")
+            v = 0 if qty is None else parse_quantity(str(qty)).value()
+            self.__dict__["_capacity_storage"] = v
+        return v
 
     @property
     def claim_ref(self) -> Optional[dict]:
@@ -950,7 +958,10 @@ class PersistentVolume:
     def node_affinity_terms(self) -> Optional[list]:
         """Required node-affinity terms (ORed NodeSelectorTerm list) from
         spec.nodeAffinity.required, else the alpha annotation
-        (volumeutil.CheckNodeAffinity reads both). None = unconstrained."""
+        (volumeutil.CheckNodeAffinity reads both). None = unconstrained.
+        Memoized — evaluated per pod per node by CheckVolumeBinding."""
+        if "_node_affinity_terms" in self.__dict__:
+            return self.__dict__["_node_affinity_terms"]
         na = self.spec_raw.get("nodeAffinity")
         req = (na or {}).get("required")
         if req is None:
@@ -960,10 +971,11 @@ class PersistentVolume:
 
                 affinity = _json.loads(ann)
                 req = affinity.get("requiredDuringSchedulingIgnoredDuringExecution")
-        if req is None:
-            return None
-        return [NodeSelectorTerm.from_obj(t)
-                for t in req.get("nodeSelectorTerms") or []]
+        terms = None if req is None else [
+            NodeSelectorTerm.from_obj(t)
+            for t in req.get("nodeSelectorTerms") or []]
+        self.__dict__["_node_affinity_terms"] = terms
+        return terms
 
 
 @dataclass
@@ -1027,13 +1039,19 @@ class PersistentVolumeClaim:
 
     @property
     def request_storage(self) -> int:
-        qty = ((self.spec_raw.get("resources") or {}).get("requests") or {}).get("storage")
-        if qty is None:
-            return 0
-        return parse_quantity(str(qty)).value()
+        v = self.__dict__.get("_request_storage")
+        if v is None:
+            qty = ((self.spec_raw.get("resources") or {}).get("requests")
+                   or {}).get("storage")
+            v = 0 if qty is None else parse_quantity(str(qty)).value()
+            self.__dict__["_request_storage"] = v
+        return v
 
     def selector(self) -> Optional["LabelSelector"]:
-        return LabelSelector.from_obj(self.spec_raw.get("selector"))
+        if "_selector" not in self.__dict__:
+            self.__dict__["_selector"] = LabelSelector.from_obj(
+                self.spec_raw.get("selector"))
+        return self.__dict__["_selector"]
 
 
 @dataclass

@@ -13,9 +13,12 @@ within a wave, so same-wave pods do not see each other's resource usage,
 host-port occupancy, anti-affinity presence, or spreading counts; the
 exactness contract holds only across wave boundaries.
 
-The only compile-time fallback left is a group-count blowup (> state.MAX_GROUPS
-distinct pod signatures), routed to the reference backend
-(fallback="reference") or rejected (fallback="error").
+Compile-time fallbacks route to the reference backend (fallback="reference")
+or raise (fallback="error"): pod-group budget overruns (merged groups >
+TPUSIM_MAX_GROUPS, raw signatures > TPUSIM_MAX_RAW_GROUPS, matcher precompute
+> TPUSIM_MAX_MATCH_WORK, presence bytes > TPUSIM_MAX_PRESENCE_BYTES — groups
+merge by match profile first, so only behaviorally distinct classes count) and
+volume-using workloads (state.volume_unsupported).
 """
 
 from __future__ import annotations

@@ -511,24 +511,18 @@ class IncrementalCluster:
                 or batch_group_keys != self._groups_batch_keys):
             snapshot = self.to_snapshot()
             (groups, has_ports, has_services, has_interpod, n_topo, n_zone,
-             unsupported) = _compile_groups(snapshot, pods, self.nodes,
-                                            self._node_index)
+             unsupported, sig_to_gid) = _compile_groups(
+                 snapshot, pods, self.nodes, self._node_index)
             self._groups = groups
             self._groups_meta = (has_ports, has_services, has_interpod,
                                  n_topo, n_zone, unsupported)
             self._groups_batch_keys = batch_group_keys
             self._groups_active = has_ports or has_services or has_interpod
             self._presence = groups.presence
-            # reconstruct the group-id space in _compile_groups' interning
-            # order: new-pod signatures first, then placed snapshot pods
-            self._groups_sig_keys = {k: i for i, k in enumerate(batch_group_keys)}
-            if self._groups_active:
-                for pod in self._pods.values():
-                    if pod.spec.node_name not in self._node_index:
-                        continue
-                    gk = _key(_group_signature(pod))
-                    if gk not in self._groups_sig_keys:
-                        self._groups_sig_keys[gk] = len(self._groups_sig_keys)
+            # raw canonical signature -> MERGED group id, as produced by
+            # _compile_groups' profile merge; an unseen signature later marks
+            # the tables dirty (its profile is unknown without the matchers)
+            self._groups_sig_keys = dict(sig_to_gid)
             self._groups_dirty = False
         groups = self._groups
         has_ports, has_services, has_interpod, n_topo, n_zone, unsupported = \

@@ -86,13 +86,16 @@ class Statics(NamedTuple):
     host_ok: jnp.ndarray
     # pod-group tables (state.GroupTables; zero-size-semantics dummies when off)
     port_conflict: jnp.ndarray
-    ss_match: jnp.ndarray
+    port_sig: jnp.ndarray
+    ss_rows: jnp.ndarray
+    ss_sig: jnp.ndarray
+    term_match: jnp.ndarray
     zone_dom: jnp.ndarray
     topo_dom: jnp.ndarray
     aff_valid: jnp.ndarray
     aff_err: jnp.ndarray
     aff_empty: jnp.ndarray
-    aff_match: jnp.ndarray
+    aff_term: jnp.ndarray
     aff_key: jnp.ndarray
     aff_hostname: jnp.ndarray
     aff_self: jnp.ndarray
@@ -100,11 +103,11 @@ class Statics(NamedTuple):
     anti_valid: jnp.ndarray
     anti_err: jnp.ndarray
     anti_empty: jnp.ndarray
-    anti_match: jnp.ndarray
+    anti_term: jnp.ndarray
     anti_key: jnp.ndarray
     anti_hostname: jnp.ndarray
     pref_w: jnp.ndarray
-    pref_match: jnp.ndarray
+    pref_term: jnp.ndarray
     pref_key: jnp.ndarray
 
 
@@ -161,16 +164,18 @@ STATICS_AXES = dict(
     selector_ok=("sig_sel", "node"), taint_ok=("sig_tol", "node"),
     intolerable=("sig_tol", "node"), affinity_count=("sig_aff", "node"),
     avoid_score=("sig_avoid", "node"), host_ok=("sig_host", "node"),
-    port_conflict=("group", "group"), ss_match=("group", "group"),
+    port_conflict=("port_sig", "port_sig"), port_sig=("group",),
+    ss_rows=("spread_sig", "group"), ss_sig=("group",),
+    term_match=("term_sig", "group"),
     zone_dom=("node",), topo_dom=("topo_key", "node"),
     aff_valid=("group", "aff_term"), aff_err=("group",),
-    aff_empty=("group", "aff_term"), aff_match=("group", "aff_term", "group"),
+    aff_empty=("group", "aff_term"), aff_term=("group", "aff_term"),
     aff_key=("group", "aff_term"), aff_hostname=("group", "aff_term"),
     aff_self=("group", "aff_term"), aff_unplaced=("group", "aff_term"),
     anti_valid=("group", "anti_term"), anti_err=("group",),
-    anti_empty=("group", "anti_term"), anti_match=("group", "anti_term", "group"),
+    anti_empty=("group", "anti_term"), anti_term=("group", "anti_term"),
     anti_key=("group", "anti_term"), anti_hostname=("group", "anti_term"),
-    pref_w=("group", "pref_term"), pref_match=("group", "pref_term", "group"),
+    pref_w=("group", "pref_term"), pref_term=("group", "pref_term"),
     pref_key=("group", "pref_term"),
 )
 CARRY_AXES = dict(
@@ -227,16 +232,17 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         selector_ok=t.selector_ok, taint_ok=t.taint_ok,
         intolerable=t.intolerable, affinity_count=t.affinity_count,
         avoid_score=t.avoid_score, host_ok=t.host_ok,
-        port_conflict=gt.port_conflict, ss_match=gt.ss_match,
+        port_conflict=gt.port_conflict, port_sig=gt.port_sig,
+        ss_rows=gt.ss_rows, ss_sig=gt.ss_sig, term_match=gt.term_match,
         zone_dom=gt.zone_dom, topo_dom=gt.topo_dom,
         aff_valid=gt.aff_valid, aff_err=gt.aff_err, aff_empty=gt.aff_empty,
-        aff_match=gt.aff_match, aff_key=gt.aff_key,
+        aff_term=gt.aff_term, aff_key=gt.aff_key,
         aff_hostname=gt.aff_hostname, aff_self=gt.aff_self,
         aff_unplaced=gt.aff_unplaced,
         anti_valid=gt.anti_valid, anti_err=gt.anti_err,
-        anti_empty=gt.anti_empty, anti_match=gt.anti_match,
+        anti_empty=gt.anti_empty, anti_term=gt.anti_term,
         anti_key=gt.anti_key, anti_hostname=gt.anti_hostname,
-        pref_w=gt.pref_w, pref_match=gt.pref_match, pref_key=gt.pref_key)
+        pref_w=gt.pref_w, pref_term=gt.pref_term, pref_key=gt.pref_key)
 
 
 def _presence_dom_init(presence: np.ndarray, topo_dom: np.ndarray,
@@ -353,9 +359,10 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         bits_general = bits_general | jnp.sum(scalar_bits, axis=-1)
     if config.has_ports:
         # PodFitsHostPorts (predicates.go:1019-1039), part of GeneralPredicates:
-        # a wanted port of my group conflicts with occupancy of any group present
-        port_bad = jnp.any(st.port_conflict[x.group_id][:, None]
-                           & (carry.presence > 0), axis=0)
+        # a wanted port of my group conflicts with occupancy of any group
+        # present; conflict is factored through interned port-set ids
+        conflict_row = st.port_conflict[st.port_sig[x.group_id]][st.port_sig]
+        port_bad = jnp.any(conflict_row[:, None] & (carry.presence > 0), axis=0)
         fail_general = fail_general | port_bad
         bits_general = bits_general | (
             port_bad.astype(jnp.int64) << BIT_HOST_PORTS)
@@ -374,7 +381,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         k_count = st.topo_dom.shape[0]
 
         # own required affinity terms (_satisfies_pods_affinity_anti_affinity)
-        mcount = st.aff_match[g].astype(jnp.float64) @ presence_f   # [Ta, N]
+        mcount = st.term_match[st.aff_term[g]].astype(jnp.float64) @ presence_f  # [Ta, N]
         dom_rows = st.topo_dom[st.aff_key[g]]                       # [Ta, N]
         valid_dom = dom_rows > 0
         dc_at = jnp.take_along_axis(
@@ -394,7 +401,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
                            axis=0) | st.aff_err[g]
 
         # own required anti-affinity terms
-        bmcount = st.anti_match[g].astype(jnp.float64) @ presence_f
+        bmcount = st.term_match[st.anti_term[g]].astype(jnp.float64) @ presence_f
         bdom_rows = st.topo_dom[st.anti_key[g]]
         bvalid = bdom_rows > 0
         bdc_at = jnp.take_along_axis(
@@ -406,7 +413,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
                             axis=0) | st.anti_err[g]
 
         # existing pods' anti-affinity vs me (symmetric check; runs first)
-        w = st.anti_valid & st.anti_match[:, :, g]                  # [G, Tb]
+        w = st.anti_valid & st.term_match[st.anti_term, g]          # [G, Tb]
         grp_present = jnp.sum(carry.presence, axis=1) > 0           # [G]
         fail_all = jnp.any(w & st.anti_empty & grp_present[:, None])
         key_oh = jax.nn.one_hot(st.anti_key, k_count, dtype=jnp.float64)
@@ -470,7 +477,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         # SelectorSpreadPriority (selector_spreading.go:66-175): per-node count
         # of same-namespace pods matched by my services' selectors, then the
         # node/zone-blended normalize over feasible nodes
-        cnt = st.ss_match[x.group_id].astype(jnp.float64) @ \
+        cnt = st.ss_rows[st.ss_sig[x.group_id]].astype(jnp.float64) @ \
             carry.presence.astype(jnp.float64)                       # [N]
         fcnt = jnp.where(feasible, cnt, 0.0)
         max_node = jnp.max(fcnt)
@@ -497,16 +504,16 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         # preferred terms over me, (c) their required affinity × hard weight;
         # all contributions are integer-valued so summation order is exact
         p_w = st.pref_w[g]                                          # [Tp]
-        pcount = st.pref_match[g].astype(jnp.float64) @ presence_f  # [Tp, N]
+        pcount = st.term_match[st.pref_term[g]].astype(jnp.float64) @ presence_f  # [Tp, N]
         pdom = st.topo_dom[st.pref_key[g]]                          # [Tp, N]
         pdc_at = jnp.take_along_axis(
             _seg_rows(pcount, pdom, config.n_topo_doms), pdom, axis=1)
         counts = jnp.sum(p_w[:, None] * jnp.where(pdom > 0, pdc_at, 0.0), axis=0)
 
-        wb = st.pref_w * st.pref_match[:, :, g]                     # [G, Tp]
+        wb = st.pref_w * st.term_match[st.pref_term, g]             # [G, Tp]
         wc = float(config.hard_weight) * (
             st.aff_valid & ~st.aff_empty
-            & st.aff_match[:, :, g]).astype(jnp.float64)            # [G, Ta]
+            & st.term_match[st.aff_term, g]).astype(jnp.float64)    # [G, Ta]
         key_oh_p = jax.nn.one_hot(st.pref_key, k_count, dtype=jnp.float64)
         key_oh_a = jax.nn.one_hot(st.aff_key, k_count, dtype=jnp.float64)
         wsum = (jnp.einsum("gtk,gt,gkd->kd", key_oh_p, wb, pd_f)

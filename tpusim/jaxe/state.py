@@ -90,8 +90,32 @@ REASON_STRINGS = [
     "node(s) didn't match pod anti-affinity rules",
 ]
 
-# pod-group tables become O(G^2)/O(G^2·T): past this the backend falls back
-MAX_GROUPS = 512
+# Pod-group budgets (env-overridable). Groups are merged by match profile and
+# every pairwise table is factored through interned matcher spaces, so the
+# limits bound device memory / host precompute, not workload diversity:
+#   MAX_GROUPS          — merged groups (presence rows)
+#   MAX_RAW_GROUPS      — distinct raw signatures before merging
+#   MAX_MATCH_WORK      — host matcher evaluations ((Td + Sd) * Graw)
+#   MAX_PRESENCE_BYTES  — presence[G, N] carry size
+MAX_GROUPS = 8192
+MAX_RAW_GROUPS = 262_144
+MAX_MATCH_WORK = 8_000_000
+MAX_PRESENCE_BYTES = 1 << 30
+
+
+def _group_budgets():
+    import os
+
+    def env_int(name: str, default: int) -> int:
+        try:
+            return int(os.environ.get(name, default))
+        except ValueError:
+            return default
+
+    return (env_int("TPUSIM_MAX_GROUPS", MAX_GROUPS),
+            env_int("TPUSIM_MAX_RAW_GROUPS", MAX_RAW_GROUPS),
+            env_int("TPUSIM_MAX_MATCH_WORK", MAX_MATCH_WORK),
+            env_int("TPUSIM_MAX_PRESENCE_BYTES", MAX_PRESENCE_BYTES))
 
 
 def volume_unsupported(new_pods: List[Pod], cluster_pods) -> List[str]:
@@ -161,27 +185,42 @@ class GroupTables:
     (predicates.go:1125-1450, interpod_affinity.go).
 
     A "group" is an interned (namespace, labels, pod-(anti)affinity, host-ports)
-    pod signature over new + placed-existing pods; the device carries a
-    presence[G, N] count matrix plus per-topology-domain sums, and all symbolic
-    matching below is precompiled host-side with the parity engine's matchers.
+    pod signature over new + placed-existing pods, MERGED by match profile:
+    raw signatures that every compiled matcher treats identically (same term
+    matches, same service-selector matches, same port behavior, same actor
+    terms) collapse into one group, so thousands of distinct label sets cost
+    only as many groups as there are behaviorally distinct classes.
+
+    The pairwise group tables are FACTORED through interned matcher spaces so
+    nothing is O(G^2):
+      term_match[Td, G]   — distinct (namespaces, selector) term signatures vs
+                            groups; row 0 reserved all-False (invalid/padding)
+      ss_rows[Sd, G]      — distinct (namespace, service-selector-set) spread
+                            signatures vs groups; row 0 all-False
+      port_conflict[Pp,Pp]— distinct sanitized host-port sets vs each other;
+                            index 0 = "no ports"
+    Per-group tensors then hold ids into those spaces (aff_term/anti_term/
+    pref_term -> Td, ss_sig -> Sd, port_sig -> Pp).
 
     Topology domains: for each used topologyKey k, topo_dom[k, n] interns the
     node's label value, with 0 reserved for "label missing" (never matches,
     NodesHaveSameTopologyKey semantics). zone_dom likewise interns
     utilnode.GetZoneKey with 0 = no zone. Term tensors are padded on the term
-    axis with valid=False rows; match[a, t, b] means "a pod of group b matches
-    (namespaces+selector of) term t defined by group a"."""
+    axis with valid=False rows."""
 
     group_of_pod: np.ndarray     # [P] int32 — new pods' group ids
     presence: np.ndarray         # [G, N] int32 — placed existing pods per group
-    port_conflict: np.ndarray    # [G, G] bool — wanted ports of a hit ports of b
-    ss_match: np.ndarray         # [G, G] bool — b counts toward a's spread score
+    port_conflict: np.ndarray    # [Pp, Pp] bool — wanted ports of a hit ports of b
+    port_sig: np.ndarray         # [G] int32 — group -> port-set id (0 = none)
+    ss_rows: np.ndarray          # [Sd, G] bool — b counts toward spread sig s
+    ss_sig: np.ndarray           # [G] int32 — group -> its spread sig (0 = none)
+    term_match: np.ndarray       # [Td, G] bool — term t matches a pod of group b
     zone_dom: np.ndarray         # [N] int32
     topo_dom: np.ndarray         # [K, N] int32
     aff_valid: np.ndarray        # [G, Ta] bool — required pod-affinity terms
     aff_err: np.ndarray          # [G] bool — any term with empty topologyKey
     aff_empty: np.ndarray        # [G, Ta] bool — per-term empty topologyKey
-    aff_match: np.ndarray        # [G, Ta, G] bool
+    aff_term: np.ndarray         # [G, Ta] int32 (into Td)
     aff_key: np.ndarray          # [G, Ta] int32 (into K)
     aff_hostname: np.ndarray     # [G, Ta] bool — topologyKey == kubernetes.io/hostname
     aff_self: np.ndarray         # [G, Ta] bool — the pod matches its own term
@@ -189,11 +228,11 @@ class GroupTables:
     anti_valid: np.ndarray       # [G, Tb] bool — required pod-anti-affinity terms
     anti_err: np.ndarray         # [G] bool
     anti_empty: np.ndarray       # [G, Tb] bool
-    anti_match: np.ndarray       # [G, Tb, G] bool
+    anti_term: np.ndarray        # [G, Tb] int32 (into Td)
     anti_key: np.ndarray         # [G, Tb] int32
     anti_hostname: np.ndarray    # [G, Tb] bool
     pref_w: np.ndarray           # [G, Tp] float64 — preferred terms, signed weight
-    pref_match: np.ndarray       # [G, Tp, G] bool
+    pref_term: np.ndarray        # [G, Tp] int32 (into Td)
     pref_key: np.ndarray         # [G, Tp] int32
 
 
@@ -350,16 +389,18 @@ def _trivial_groups(num_pods: int, n: int) -> "GroupTables":
     z = np.zeros
     return GroupTables(
         group_of_pod=z(num_pods, np.int32), presence=z((1, n), np.int32),
-        port_conflict=z((1, 1), bool), ss_match=z((1, 1), bool),
+        port_conflict=z((1, 1), bool), port_sig=z(1, np.int32),
+        ss_rows=z((1, 1), bool), ss_sig=z(1, np.int32),
+        term_match=z((1, 1), bool),
         zone_dom=z(n, np.int32), topo_dom=z((1, n), np.int32),
         aff_valid=z((1, 1), bool), aff_err=z(1, bool), aff_empty=z((1, 1), bool),
-        aff_match=z((1, 1, 1), bool), aff_key=z((1, 1), np.int32),
+        aff_term=z((1, 1), np.int32), aff_key=z((1, 1), np.int32),
         aff_hostname=z((1, 1), bool), aff_self=z((1, 1), bool),
         aff_unplaced=z((1, 1), bool),
         anti_valid=z((1, 1), bool), anti_err=z(1, bool), anti_empty=z((1, 1), bool),
-        anti_match=z((1, 1, 1), bool), anti_key=z((1, 1), np.int32),
+        anti_term=z((1, 1), np.int32), anti_key=z((1, 1), np.int32),
         anti_hostname=z((1, 1), bool),
-        pref_w=z((1, 1), np.float64), pref_match=z((1, 1, 1), bool),
+        pref_w=z((1, 1), np.float64), pref_term=z((1, 1), np.int32),
         pref_key=z((1, 1), np.int32))
 
 
@@ -367,7 +408,8 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
                     nodes: List[Node], node_index: Dict[str, int]):
     """Build GroupTables + feature flags. Returns
     (tables, has_ports, has_services, has_interpod, n_topo_doms, n_zone_doms,
-    unsupported)."""
+    unsupported, sig_to_gid) where sig_to_gid maps each raw canonical group
+    signature key to its merged group id (used by the incremental path)."""
     n = len(nodes)
     placed = [p for p in snapshot.pods if p.spec.node_name in node_index]
     # pods with an unknown-but-set nodeName still count for "matching pod
@@ -382,56 +424,180 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
         or any(_has_interpod_terms(p) for p in placed)
     has_services = bool(snapshot.services)
     if not (has_ports or has_interpod or has_services):
-        return _trivial_groups(len(pods), n), False, False, False, 1, 1, []
+        return (_trivial_groups(len(pods), n), False, False, False, 1, 1, [],
+                {})
 
-    gi = Interner()
-    group_of_pod = np.array([gi.intern(_group_signature(p), p) for p in pods],
-                            dtype=np.int32)
-    placed_gid = [gi.intern(_group_signature(p), p) for p in placed]
-    g = len(gi)
-    if g > MAX_GROUPS:
+    max_groups, max_raw, max_work, max_presence = _group_budgets()
+
+    def fallback(reason: str):
         return (_trivial_groups(len(pods), n), False, False, False, 1, 1,
-                [f"{g} distinct pod groups exceed the jax backend limit "
-                 f"({MAX_GROUPS})"])
-    reps = gi.representatives
+                [reason], {})
+
+    # --- 1. raw signature interning ---
+    gi = Interner()
+    raw_of_pod = [gi.intern(_group_signature(p), p) for p in pods]
+    placed_raw = [gi.intern(_group_signature(p), p) for p in placed]
+    graw = len(gi)
+    if graw > max_raw:
+        return fallback(f"{graw} distinct raw pod groups exceed the jax "
+                        f"backend limit ({max_raw})")
+    raw_reps = gi.representatives
+    raw_keys = list(gi._ids.keys())  # insertion-ordered: index == raw id
+
+    # --- 2. intern matcher spaces: terms, port sets, spread signatures ---
+    # term signature = (resolved namespaces, selector): that pair fully
+    # determines which pods a term matches (predicates.go
+    # podMatchesTermNamespaceAndSelector)
+    term_defs: List[tuple] = [None]  # index 0 reserved: matches nothing
+    term_ids: Dict[str, int] = {}
+
+    def intern_term(rep: Pod, term) -> int:
+        namespaces = get_namespaces_from_pod_affinity_term(rep, term)
+        sel = term.label_selector
+        key = json.dumps([sorted(namespaces),
+                          sel.to_obj() if sel is not None else None],
+                         sort_keys=True)
+        tid = term_ids.get(key)
+        if tid is None:
+            tid = len(term_defs)
+            term_ids[key] = tid
+            term_defs.append((namespaces, sel))
+        return tid
+
+    # raw per-group actor term lists: [(tid, topology_key, weight)] per kind
+    aff_of: List[list] = []
+    anti_of: List[list] = []
+    pref_of: List[list] = []
+    if has_interpod:
+        for rep in raw_reps:
+            aff_of.append([(intern_term(rep, t), t.topology_key)
+                           for t in _req_aff_terms(rep)])
+            anti_of.append([(intern_term(rep, t), t.topology_key)
+                            for t in _req_anti_terms(rep)])
+            pref_of.append([(intern_term(rep, t), t.topology_key, w)
+                            for w, t in _pref_terms(rep)])
+    else:
+        aff_of = anti_of = pref_of = [[] for _ in raw_reps]
+    td = len(term_defs)
+
+    # spread signature = (namespace, selected service selectors); 0 = none
+    spread_defs: List[tuple] = [None]
+    spread_ids: Dict[str, int] = {}
+    ss_sig_raw = np.zeros(graw, np.int32)
+    if has_services and len(snapshot.services) * graw > max_work:
+        # the service->group scan below is O(services * graw); budget it like
+        # the matcher rows so a huge snapshot can't hang host compile
+        return fallback(
+            f"pod-group service scan ({len(snapshot.services)} services x "
+            f"{graw} raw groups) exceeds the jax backend work budget "
+            f"({max_work})")
+    if has_services:
+        for b, rep in enumerate(raw_reps):
+            sels = [dict(svc.selector) for svc in snapshot.services
+                    if (svc.namespace == rep.namespace and svc.selector
+                        and all(rep.metadata.labels.get(k) == v
+                                for k, v in svc.selector.items()))]
+            if not sels:
+                continue
+            key = json.dumps([rep.namespace,
+                              sorted(json.dumps(s, sort_keys=True) for s in sels)])
+            sid = spread_ids.get(key)
+            if sid is None:
+                sid = len(spread_defs)
+                spread_ids[key] = sid
+                spread_defs.append((rep.namespace, sels))
+            ss_sig_raw[b] = sid
+    sd = len(spread_defs)
+
+    if (td + sd) * graw > max_work:
+        return fallback(
+            f"pod-group matcher precompute ({td} terms + {sd} spread sigs x "
+            f"{graw} raw groups) exceeds the jax backend work budget "
+            f"({max_work})")
+
+    # port-set interning; 0 = no ports
+    port_defs: List[list] = [[]]
+    port_ids: Dict[tuple, int] = {(): 0}
+    port_sig_raw = np.zeros(graw, np.int32)
+    if has_ports:
+        for b, rep in enumerate(raw_reps):
+            ports = tuple(_sanitized_ports(rep))
+            pid = port_ids.get(ports)
+            if pid is None:
+                pid = len(port_defs)
+                port_ids[ports] = pid
+                port_defs.append(list(ports))
+            port_sig_raw[b] = pid
+    pp = len(port_defs)
+    port_conflict = np.zeros((pp, pp), dtype=bool)
+    for a in range(1, pp):
+        for b in range(1, pp):
+            port_conflict[a, b] = _ports_conflict(port_defs[a], port_defs[b])
+
+    # --- 3. matcher rows over raw groups ---
+    term_match_raw = np.zeros((td, graw), dtype=bool)
+    unplaced_match = np.zeros(td, dtype=bool)
+    for tid in range(1, td):
+        namespaces, sel = term_defs[tid]
+        for b, rep in enumerate(raw_reps):
+            term_match_raw[tid, b] = pod_matches_term_namespace_and_selector(
+                rep, namespaces, sel)
+        unplaced_match[tid] = any(
+            pod_matches_term_namespace_and_selector(u, namespaces, sel)
+            for u in unplaced)
+
+    ss_rows_raw = np.zeros((sd, graw), dtype=bool)
+    for sid in range(1, sd):
+        ns, sels = spread_defs[sid]
+        for b, rep in enumerate(raw_reps):
+            ss_rows_raw[sid, b] = rep.namespace == ns and any(
+                all(rep.metadata.labels.get(k) == v for k, v in sel.items())
+                for sel in sels)
+
+    # --- 4. merge raw groups by match profile ---
+    # two raw groups are indistinguishable when every matcher treats them the
+    # same (same term/spread columns, same port set) AND they act identically
+    # (same own terms with the same topology keys/weights, same spread sig)
+    merged: Dict[tuple, int] = {}
+    gid_of_raw = np.zeros(graw, np.int32)
+    rep_raw_idx: List[int] = []
+    for b in range(graw):
+        profile = (term_match_raw[:, b].tobytes(), ss_rows_raw[:, b].tobytes(),
+                   int(port_sig_raw[b]), int(ss_sig_raw[b]),
+                   tuple(aff_of[b]), tuple(anti_of[b]), tuple(pref_of[b]))
+        gid = merged.get(profile)
+        if gid is None:
+            gid = len(rep_raw_idx)
+            merged[profile] = gid
+            rep_raw_idx.append(b)
+        gid_of_raw[b] = gid
+    g = len(rep_raw_idx)
+    if g > max_groups:
+        return fallback(f"{g} distinct pod groups exceed the jax backend "
+                        f"limit ({max_groups})")
+    if g * n * 4 > max_presence:
+        return fallback(
+            f"pod-group presence state ({g} groups x {n} nodes) exceeds the "
+            f"jax backend memory budget ({max_presence} bytes)")
+    sig_to_gid = {key: int(gid_of_raw[b]) for b, key in enumerate(raw_keys)}
+
+    group_of_pod = gid_of_raw[np.array(raw_of_pod, dtype=np.int64)] \
+        if raw_of_pod else np.zeros(0, np.int32)
+    group_of_pod = group_of_pod.astype(np.int32)
+    reps = [raw_reps[b] for b in rep_raw_idx]
+    sel_cols = np.array(rep_raw_idx, dtype=np.int64)
+    term_match = term_match_raw[:, sel_cols] if graw else term_match_raw
+    ss_rows = ss_rows_raw[:, sel_cols] if graw else ss_rows_raw
+    port_sig = port_sig_raw[sel_cols].astype(np.int32)
+    ss_sig = ss_sig_raw[sel_cols].astype(np.int32)
 
     presence = np.zeros((g, n), dtype=np.int32)
-    for gid, p in zip(placed_gid, placed):
-        presence[gid, node_index[p.spec.node_name]] += 1
+    for raw_id, p in zip(placed_raw, placed):
+        presence[gid_of_raw[raw_id], node_index[p.spec.node_name]] += 1
 
-    port_conflict = np.zeros((g, g), dtype=bool)
-    if has_ports:
-        ports_of = [_sanitized_ports(rep) for rep in reps]
-        for a in range(g):
-            if not ports_of[a]:
-                continue
-            for b in range(g):
-                port_conflict[a, b] = bool(ports_of[b]) and _ports_conflict(
-                    ports_of[a], ports_of[b])
-
-    ss_match = np.zeros((g, g), dtype=bool)
     zone_dom = np.zeros(n, dtype=np.int32)
     n_zone_doms = 1
     if has_services:
-        # selectors of group a: services in a's namespace selecting a's labels
-        # (selector_spreading.go getSelectors; the simulator wires only the
-        # services informer with real data, simulator.go:352-366)
-        selectors_of = []
-        for rep in reps:
-            sels = []
-            for svc in snapshot.services:
-                if (svc.namespace == rep.namespace and svc.selector
-                        and all(rep.metadata.labels.get(k) == v
-                                for k, v in svc.selector.items())):
-                    sels.append(dict(svc.selector))
-            selectors_of.append(sels)
-        for a in range(g):
-            if not selectors_of[a]:
-                continue
-            for b in range(g):
-                ss_match[a, b] = reps[b].namespace == reps[a].namespace and any(
-                    all(reps[b].metadata.labels.get(k) == v for k, v in sel.items())
-                    for sel in selectors_of[a])
         zvals: Dict[str, int] = {}
         for i, node in enumerate(nodes):
             z = get_zone_key(node)
@@ -439,19 +605,19 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
                 zone_dom[i] = zvals.setdefault(z, len(zvals) + 1)
         n_zone_doms = len(zvals) + 1
 
-    # --- inter-pod affinity term tensors ---
+    # --- 5. topology keys + per-group actor tensors over merged groups ---
     topo_keys: List[str] = []
     if has_interpod:
         seen_keys = set()
-        for rep in reps:
-            for term in _req_aff_terms(rep) + _req_anti_terms(rep):
-                if term.topology_key and term.topology_key not in seen_keys:
-                    seen_keys.add(term.topology_key)
-                    topo_keys.append(term.topology_key)
-            for _, term in _pref_terms(rep):
-                if term.topology_key and term.topology_key not in seen_keys:
-                    seen_keys.add(term.topology_key)
-                    topo_keys.append(term.topology_key)
+        for b in rep_raw_idx:
+            for tid, key in aff_of[b] + anti_of[b]:
+                if key and key not in seen_keys:
+                    seen_keys.add(key)
+                    topo_keys.append(key)
+            for tid, key, w in pref_of[b]:
+                if key and key not in seen_keys:
+                    seen_keys.add(key)
+                    topo_keys.append(key)
     k_count = max(len(topo_keys), 1)
     key_idx = {key: i for i, key in enumerate(topo_keys)}
 
@@ -465,13 +631,13 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
                 topo_dom[k, i] = vals.setdefault(v, len(vals) + 1)
         n_topo_doms = max(n_topo_doms, len(vals) + 1)
 
-    ta = max([1] + [len(_req_aff_terms(r)) for r in reps])
-    tb = max([1] + [len(_req_anti_terms(r)) for r in reps])
-    tp = max([1] + [len(_pref_terms(r)) for r in reps])
+    ta = max([1] + [len(aff_of[b]) for b in rep_raw_idx])
+    tb = max([1] + [len(anti_of[b]) for b in rep_raw_idx])
+    tp = max([1] + [len(pref_of[b]) for b in rep_raw_idx])
     aff_valid = np.zeros((g, ta), bool)
     aff_err = np.zeros(g, bool)
     aff_empty = np.zeros((g, ta), bool)
-    aff_match = np.zeros((g, ta, g), bool)
+    aff_term = np.zeros((g, ta), np.int32)
     aff_key = np.zeros((g, ta), np.int32)
     aff_hostname = np.zeros((g, ta), bool)
     aff_self = np.zeros((g, ta), bool)
@@ -479,67 +645,56 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
     anti_valid = np.zeros((g, tb), bool)
     anti_err = np.zeros(g, bool)
     anti_empty = np.zeros((g, tb), bool)
-    anti_match = np.zeros((g, tb, g), bool)
+    anti_term = np.zeros((g, tb), np.int32)
     anti_key = np.zeros((g, tb), np.int32)
     anti_hostname = np.zeros((g, tb), bool)
     pref_w = np.zeros((g, tp), np.float64)
-    pref_match = np.zeros((g, tp, g), bool)
+    pref_term = np.zeros((g, tp), np.int32)
     pref_key = np.zeros((g, tp), np.int32)
 
     if has_interpod:
-        for a, rep in enumerate(reps):
-            for t, term in enumerate(_req_aff_terms(rep)):
+        for a, b in enumerate(rep_raw_idx):
+            for t, (tid, key) in enumerate(aff_of[b]):
                 aff_valid[a, t] = True
-                namespaces = get_namespaces_from_pod_affinity_term(rep, term)
-                if not term.topology_key:
+                aff_term[a, t] = tid
+                if not key:
                     # _any_pod_matches_term raises -> whole predicate fails
                     aff_empty[a, t] = True
                     aff_err[a] = True
                 else:
-                    aff_key[a, t] = key_idx[term.topology_key]
-                    aff_hostname[a, t] = term.topology_key == LABEL_HOSTNAME
-                aff_self[a, t] = pod_matches_term_namespace_and_selector(
-                    rep, namespaces, term.label_selector)
-                aff_unplaced[a, t] = any(
-                    pod_matches_term_namespace_and_selector(
-                        u, namespaces, term.label_selector) for u in unplaced)
-                for b, other in enumerate(reps):
-                    aff_match[a, t, b] = pod_matches_term_namespace_and_selector(
-                        other, namespaces, term.label_selector)
-            for t, term in enumerate(_req_anti_terms(rep)):
+                    aff_key[a, t] = key_idx[key]
+                    aff_hostname[a, t] = key == LABEL_HOSTNAME
+                aff_self[a, t] = term_match[tid, a]
+                aff_unplaced[a, t] = unplaced_match[tid]
+            for t, (tid, key) in enumerate(anti_of[b]):
                 anti_valid[a, t] = True
-                namespaces = get_namespaces_from_pod_affinity_term(rep, term)
-                if not term.topology_key:
+                anti_term[a, t] = tid
+                if not key:
                     anti_empty[a, t] = True
                     anti_err[a] = True
                 else:
-                    anti_key[a, t] = key_idx[term.topology_key]
-                    anti_hostname[a, t] = term.topology_key == LABEL_HOSTNAME
-                for b, other in enumerate(reps):
-                    anti_match[a, t, b] = pod_matches_term_namespace_and_selector(
-                        other, namespaces, term.label_selector)
-            for t, (w, term) in enumerate(_pref_terms(rep)):
-                if not term.topology_key:
+                    anti_key[a, t] = key_idx[key]
+                    anti_hostname[a, t] = key == LABEL_HOSTNAME
+            for t, (tid, key, w) in enumerate(pref_of[b]):
+                if not key:
                     continue  # NodesHaveSameTopologyKey("") is always False
                 pref_w[a, t] = float(w)
-                pref_key[a, t] = key_idx[term.topology_key]
-                namespaces = get_namespaces_from_pod_affinity_term(rep, term)
-                for b, other in enumerate(reps):
-                    pref_match[a, t, b] = pod_matches_term_namespace_and_selector(
-                        other, namespaces, term.label_selector)
+                pref_term[a, t] = tid
+                pref_key[a, t] = key_idx[key]
 
     tables = GroupTables(
         group_of_pod=group_of_pod, presence=presence,
-        port_conflict=port_conflict, ss_match=ss_match,
+        port_conflict=port_conflict, port_sig=port_sig,
+        ss_rows=ss_rows, ss_sig=ss_sig, term_match=term_match,
         zone_dom=zone_dom, topo_dom=topo_dom,
         aff_valid=aff_valid, aff_err=aff_err, aff_empty=aff_empty,
-        aff_match=aff_match, aff_key=aff_key, aff_hostname=aff_hostname,
+        aff_term=aff_term, aff_key=aff_key, aff_hostname=aff_hostname,
         aff_self=aff_self, aff_unplaced=aff_unplaced,
         anti_valid=anti_valid, anti_err=anti_err, anti_empty=anti_empty,
-        anti_match=anti_match, anti_key=anti_key, anti_hostname=anti_hostname,
-        pref_w=pref_w, pref_match=pref_match, pref_key=pref_key)
+        anti_term=anti_term, anti_key=anti_key, anti_hostname=anti_hostname,
+        pref_w=pref_w, pref_term=pref_term, pref_key=pref_key)
     return (tables, has_ports, has_services, has_interpod,
-            n_topo_doms, n_zone_doms, [])
+            n_topo_doms, n_zone_doms, [], sig_to_gid)
 
 
 def node_static_row(node: Node, ni: NodeInfo, scalar_idx: Dict[str, int],
@@ -708,7 +863,7 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
 
     node_index = {nd.name: i for i, nd in enumerate(nodes)}
     (groups, has_ports, has_services, has_interpod, n_topo_doms, n_zone_doms,
-     group_unsupported) = _compile_groups(snapshot, pods, nodes, node_index)
+     group_unsupported, _) = _compile_groups(snapshot, pods, nodes, node_index)
     unsupported.extend(group_unsupported)
     cols.group_id = groups.group_of_pod
 

@@ -524,17 +524,99 @@ def test_simulation_volume_scheduling_gate():
     assert "didn't find available persistent volumes to bind" in msg
 
 
-def test_jax_backend_falls_back_on_volumes():
-    """The jax backend routes volume workloads to the parity engine; placements
-    match the reference backend exactly."""
+def test_jax_backend_native_zone_volumes():
+    """Zone-labeled PV workloads run natively on the jax backend (no
+    fallback) with placements identical to the reference."""
     from tpusim.backends import ReferenceBackend, placement_hash
     from tpusim.jaxe.backend import JaxBackend
+    from tpusim.jaxe.state import compile_cluster
 
     snapshot = _volume_snapshot()
     pods = [make_pod("pod-a", milli_cpu=100,
                      volumes=[make_pod_volume("v", pvc="claim-a")]),
             make_pod("pod-b", milli_cpu=100,
                      volumes=[make_pod_volume("v", pvc="claim-b")])]
+    compiled, _ = compile_cluster(snapshot, pods)
+    assert not compiled.unsupported
+    assert compiled.has_vol_zone
     ref = ReferenceBackend().schedule(pods, snapshot)
-    jax_placements = JaxBackend().schedule(pods, snapshot)
+    jax_placements = JaxBackend(fallback="error").schedule(pods, snapshot)
     assert placement_hash(ref) == placement_hash(jax_placements)
+    assert all(p.scheduled for p in jax_placements)
+
+
+def _parity(pods, snapshot):
+    from tpusim.backends import ReferenceBackend, placement_hash
+    from tpusim.jaxe.backend import JaxBackend
+
+    ref = ReferenceBackend().schedule(pods, snapshot)
+    jx = JaxBackend(fallback="error").schedule(pods, snapshot)
+    for r, j in zip(ref, jx):
+        assert (r.node_name, r.message) == (j.node_name, j.message), \
+            f"{r.pod.name}: ref={r.node_name or r.message!r} " \
+            f"jax={j.node_name or j.message!r}"
+    assert placement_hash(ref) == placement_hash(jx)
+    return jx
+
+
+def test_jax_native_disk_conflict():
+    """RW GCE PD conflicts evaluate on device: one pod per node, then a real
+    NoDiskConflict failure with the byte-matching reason."""
+    snapshot = ClusterSnapshot(nodes=[make_node("n0"), make_node("n1")])
+    disk = {"gcePersistentDisk": {"pdName": "shared"}}
+    pods = [make_pod(f"p{i}", milli_cpu=10,
+                     volumes=[make_pod_volume("v", source=dict(disk))])
+            for i in range(3)]
+    placements = _parity(pods, snapshot)
+    assert sum(1 for p in placements if p.scheduled) == 2
+    failed = [p for p in placements if not p.scheduled]
+    assert "node(s) had no available disk" in failed[0].message
+
+
+def test_jax_native_max_pd(monkeypatch):
+    """MaxPDVolumeCount evaluates on device via the per-node volume-id
+    matrix; unique ids are counted once."""
+    monkeypatch.setenv("KUBE_MAX_PD_VOLS", "2")
+    snapshot = ClusterSnapshot(nodes=[make_node("n0")])
+    pods = [make_pod(f"p{i}", milli_cpu=10, volumes=[
+        make_pod_volume("v", source={"awsElasticBlockStore":
+                                     {"volumeID": f"vol{i // 2}"}})])
+        for i in range(6)]  # 3 unique volume ids, each used by 2 pods
+    placements = _parity(pods, snapshot)
+    # p0/p2 place vol0/vol1; their twins hit NoDiskConflict (EBS forbids any
+    # same-ID sharing) and the 3rd unique id exceeds the max of 2
+    assert [p.scheduled for p in placements] == [True, False, True,
+                                                 False, False, False]
+    assert "node(s) had no available disk" in placements[1].message
+    assert "node(s) exceed max volume count" in placements[4].message
+
+
+def test_jax_native_mixed_volumes_random():
+    """Randomized differential: disk conflicts + MaxPD + zone volumes
+    together, jax placements byte-match the reference."""
+    import random
+
+    rng = random.Random(7)
+    nodes = [make_node(f"n{i}",
+                       labels=({ZONE: f"us-{rng.choice('ab')}"}
+                               if i % 2 else {}))
+             for i in range(6)]
+    pvs = [make_pv(f"pv{i}", labels={ZONE: f"us-{rng.choice('ab')}"})
+           for i in range(4)]
+    pvcs = [make_pvc(f"claim{i}", volume_name=f"pv{i}") for i in range(4)]
+    snapshot = ClusterSnapshot(nodes=nodes, pvs=pvs, pvcs=pvcs)
+    pods = []
+    for i in range(30):
+        vols = []
+        roll = rng.random()
+        if roll < 0.3:
+            vols.append(make_pod_volume("d", source={
+                "gcePersistentDisk": {"pdName": f"pd{rng.randrange(3)}",
+                                      "readOnly": rng.random() < 0.5}}))
+        elif roll < 0.6:
+            vols.append(make_pod_volume("c", pvc=f"claim{rng.randrange(4)}"))
+        elif roll < 0.8:
+            vols.append(make_pod_volume("e", source={
+                "awsElasticBlockStore": {"volumeID": f"ebs{rng.randrange(5)}"}}))
+        pods.append(make_pod(f"p{i}", milli_cpu=50, volumes=vols))
+    _parity(pods, snapshot)

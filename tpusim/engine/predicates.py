@@ -440,6 +440,10 @@ AZURE_DISK_VOLUME_FILTER_TYPE = "AzureDisk"
 DEFAULT_MAX_EBS_VOLUMES = 39
 DEFAULT_MAX_GCE_PD_VOLUMES = 16
 DEFAULT_MAX_AZURE_DISK_VOLUMES = 16
+# (EBS, GCE PD, AzureDisk) — the tuple order the jax backend's MaxPD kernel
+# uses; single source for both engines
+DEFAULT_MAXPD_LIMITS = (DEFAULT_MAX_EBS_VOLUMES, DEFAULT_MAX_GCE_PD_VOLUMES,
+                        DEFAULT_MAX_AZURE_DISK_VOLUMES)
 KUBE_MAX_PD_VOLS_ENV = "KUBE_MAX_PD_VOLS"
 
 _VOLUME_FILTERS = {
@@ -469,6 +473,11 @@ def get_max_vols(default: int) -> int:
         if parsed > 0:
             return parsed
     return default
+
+
+def effective_maxpd_limits() -> tuple:
+    """The three per-type limits with the env override applied."""
+    return tuple(get_max_vols(d) for d in DEFAULT_MAXPD_LIMITS)
 
 
 def make_max_pd_volume_count_predicate(

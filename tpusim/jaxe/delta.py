@@ -510,8 +510,10 @@ class IncrementalCluster:
         if (self._groups_dirty or self._groups is None
                 or batch_group_keys != self._groups_batch_keys):
             snapshot = self.to_snapshot()
+            # vol_meta is unused: the incremental path carries no PV/PVC state
+            # and volume workloads route through volume_unsupported below
             (groups, has_ports, has_services, has_interpod, n_topo, n_zone,
-             unsupported, sig_to_gid) = _compile_groups(
+             unsupported, sig_to_gid, _vol_meta) = _compile_groups(
                  snapshot, pods, self.nodes, self._node_index)
             self._groups = groups
             self._groups_meta = (has_ports, has_services, has_interpod,

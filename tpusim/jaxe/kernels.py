@@ -33,7 +33,10 @@ from tpusim.jaxe.state import (
     BIT_AFFINITY_NOT_MATCH,
     BIT_AFFINITY_RULES,
     BIT_ANTI_AFFINITY_RULES,
+    BIT_DISK_CONFLICT,
     BIT_DISK_PRESSURE,
+    BIT_MAX_VOLUME_COUNT,
+    BIT_VOLUME_ZONE_CONFLICT,
     BIT_EXISTING_ANTI_AFFINITY,
     BIT_HOSTNAME_MISMATCH,
     BIT_HOST_PORTS,
@@ -65,6 +68,7 @@ class Carry(NamedTuple):
     pod_count: jnp.ndarray
     presence: jnp.ndarray      # [G, N] int32 — pods per (group, node)
     presence_dom: jnp.ndarray  # [G, K, D] int32 — presence summed per topo domain
+    used_vols: jnp.ndarray     # [N, V] bool — MaxPD volume ids mounted per node
     rr: jnp.ndarray            # scalar int64 — selectHost's lastNodeIndex
 
 
@@ -87,6 +91,11 @@ class Statics(NamedTuple):
     # pod-group tables (state.GroupTables; zero-size-semantics dummies when off)
     port_conflict: jnp.ndarray
     port_sig: jnp.ndarray
+    disk_conflict: jnp.ndarray
+    disk_sig: jnp.ndarray
+    vol_mask: jnp.ndarray
+    vol_type: jnp.ndarray
+    zone_ok: jnp.ndarray
     ss_rows: jnp.ndarray
     ss_sig: jnp.ndarray
     term_match: jnp.ndarray
@@ -141,6 +150,10 @@ class EngineConfig:
     has_ports: bool = False
     has_services: bool = False
     has_interpod: bool = False
+    has_disk_conflict: bool = False
+    has_maxpd: bool = False
+    has_vol_zone: bool = False
+    maxpd_limits: tuple = (39, 16, 16)  # (EBS, GCE PD, AzureDisk)
     hard_weight: int = 10         # HardPodAffinitySymmetricWeight
     n_topo_doms: int = 1          # segment counts (incl. the invalid-0 bucket)
     n_zone_doms: int = 1
@@ -165,6 +178,9 @@ STATICS_AXES = dict(
     intolerable=("sig_tol", "node"), affinity_count=("sig_aff", "node"),
     avoid_score=("sig_avoid", "node"), host_ok=("sig_host", "node"),
     port_conflict=("port_sig", "port_sig"), port_sig=("group",),
+    disk_conflict=("disk_sig", "disk_sig"), disk_sig=("group",),
+    vol_mask=("group", "vol_id"), vol_type=("vol_id", "vol_filter"),
+    zone_ok=("group", "node"),
     ss_rows=("spread_sig", "group"), ss_sig=("group",),
     term_match=("term_sig", "group"),
     zone_dom=("node",), topo_dom=("topo_key", "node"),
@@ -182,7 +198,8 @@ CARRY_AXES = dict(
     used_cpu=("node",), used_mem=("node",), used_gpu=("node",), used_eph=("node",),
     used_scalar=("node", "scalar"), nonzero_cpu=("node",), nonzero_mem=("node",),
     pod_count=("node",), presence=("group", "node"),
-    presence_dom=("group", "topo_key", "topo_dom"), rr=(),
+    presence_dom=("group", "topo_key", "topo_dom"),
+    used_vols=("node", "vol_id"), rr=(),
 )
 PODX_AXES = dict(
     req_cpu=(), req_mem=(), req_gpu=(), req_eph=(), req_scalar=("scalar",),
@@ -208,12 +225,17 @@ def config_for(compiled_list, most_requested: bool, num_reason_bits: int,
                hard_weight: int = 10) -> EngineConfig:
     """Union EngineConfig across one or more CompiledClusters (the what-if
     batch shares one jitted program; zero-filled tables are no-ops)."""
+    limits = [c.maxpd_limits for c in compiled_list if c.has_maxpd]
     return EngineConfig(
         most_requested=most_requested,
         num_reason_bits=num_reason_bits,
         has_ports=any(c.has_ports for c in compiled_list),
         has_services=any(c.has_services for c in compiled_list),
         has_interpod=any(c.has_interpod for c in compiled_list),
+        has_disk_conflict=any(c.has_disk_conflict for c in compiled_list),
+        has_maxpd=any(c.has_maxpd for c in compiled_list),
+        has_vol_zone=any(c.has_vol_zone for c in compiled_list),
+        maxpd_limits=limits[0] if limits else (39, 16, 16),
         hard_weight=hard_weight,
         n_topo_doms=max(c.n_topo_doms for c in compiled_list),
         n_zone_doms=max(c.n_zone_doms for c in compiled_list),
@@ -233,6 +255,8 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         intolerable=t.intolerable, affinity_count=t.affinity_count,
         avoid_score=t.avoid_score, host_ok=t.host_ok,
         port_conflict=gt.port_conflict, port_sig=gt.port_sig,
+        disk_conflict=gt.disk_conflict, disk_sig=gt.disk_sig,
+        vol_mask=gt.vol_mask, vol_type=gt.vol_type, zone_ok=gt.zone_ok,
         ss_rows=gt.ss_rows, ss_sig=gt.ss_sig, term_match=gt.term_match,
         zone_dom=gt.zone_dom, topo_dom=gt.topo_dom,
         aff_valid=gt.aff_valid, aff_err=gt.aff_err, aff_empty=gt.aff_empty,
@@ -267,6 +291,7 @@ def carry_init_host(compiled: CompiledCluster) -> Carry:
         presence=gt.presence,
         presence_dom=_presence_dom_init(gt.presence, gt.topo_dom,
                                         compiled.n_topo_doms),
+        used_vols=gt.used_vols_init,
         rr=np.int64(0))
 
 
@@ -367,7 +392,38 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         bits_general = bits_general | (
             port_bad.astype(jnp.int64) << BIT_HOST_PORTS)
 
+    if config.has_disk_conflict:
+        # NoDiskConflict (predicates.go:266-276): my volume set conflicts with
+        # the volume set of any group present on the node; runs after
+        # GeneralPredicates/PodFitsResources in predicatesOrdering
+        disk_row = st.disk_conflict[st.disk_sig[x.group_id]][st.disk_sig]
+        fail_disk = jnp.any(disk_row[:, None] & (carry.presence > 0), axis=0)
+    else:
+        fail_disk = jnp.zeros_like(fail_cond)
+
     fail_taint = ~st.taint_ok[x.tol_id]
+
+    if config.has_maxpd:
+        # Max{EBS,GCEPD,AzureDisk}VolumeCount (predicates.go:422-460): unique
+        # relevant volume ids on the node incl. mine vs the per-type limit;
+        # a pod adding no relevant volumes passes regardless
+        mask_g = st.vol_mask[x.group_id]                       # [V]
+        type_i = st.vol_type.astype(jnp.int32)                 # [V, 3]
+        union_counts = (carry.used_vols | mask_g[None, :]).astype(jnp.int32) @ type_i
+        my_counts = mask_g.astype(jnp.int32) @ type_i          # [3]
+        limits = jnp.array(config.maxpd_limits, dtype=jnp.int32)
+        fail_maxpd = jnp.any((my_counts[None, :] > 0)
+                             & (union_counts > limits[None, :]), axis=1)
+    else:
+        fail_maxpd = jnp.zeros_like(fail_cond)
+
+    if config.has_vol_zone:
+        # NoVolumeZoneConflict (predicates.go:510-533): static per
+        # (volume-set, node) — bound PV zone labels vs node zone labels
+        fail_zone = ~st.zone_ok[x.group_id]
+    else:
+        fail_zone = jnp.zeros_like(fail_cond)
+
     fail_mem_pressure = st.mem_pressure & x.best_effort
     fail_disk_pressure = st.disk_pressure
 
@@ -434,20 +490,26 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         fail_interpod = jnp.zeros_like(fail_cond)
         interpod_bits = jnp.int64(0)
 
-    feasible = ~(fail_cond | fail_general | fail_taint
+    feasible = ~(fail_cond | fail_general | fail_disk | fail_taint
+                 | fail_maxpd | fail_zone
                  | fail_mem_pressure | fail_disk_pressure | fail_interpod)
-    # short-circuit reason selection: first failing stage wins
-    reason_bits = jnp.where(
-        fail_cond, st.cond_fail_bits,
-        jnp.where(fail_general, bits_general,
-                  jnp.where(fail_taint, jnp.int64(1) << BIT_TAINTS_NOT_TOLERATED,
-                            jnp.where(fail_mem_pressure,
-                                      jnp.int64(1) << BIT_MEMORY_PRESSURE,
-                                      jnp.where(fail_disk_pressure,
-                                                jnp.int64(1) << BIT_DISK_PRESSURE,
-                                                jnp.where(fail_interpod,
-                                                          interpod_bits,
-                                                          jnp.int64(0)))))))
+    # short-circuit reason selection in predicatesOrdering: first failing
+    # stage wins (general incl. ports -> NoDiskConflict -> taints -> MaxPD ->
+    # NoVolumeZone -> pressure -> inter-pod)
+    stages = [
+        (fail_cond, st.cond_fail_bits),
+        (fail_general, bits_general),
+        (fail_disk, jnp.int64(1) << BIT_DISK_CONFLICT),
+        (fail_taint, jnp.int64(1) << BIT_TAINTS_NOT_TOLERATED),
+        (fail_maxpd, jnp.int64(1) << BIT_MAX_VOLUME_COUNT),
+        (fail_zone, jnp.int64(1) << BIT_VOLUME_ZONE_CONFLICT),
+        (fail_mem_pressure, jnp.int64(1) << BIT_MEMORY_PRESSURE),
+        (fail_disk_pressure, jnp.int64(1) << BIT_DISK_PRESSURE),
+        (fail_interpod, interpod_bits),
+    ]
+    reason_bits = jnp.int64(0)
+    for fail, bits in reversed(stages):
+        reason_bits = jnp.where(fail, bits, reason_bits)
     n_feasible = jnp.sum(feasible)
 
     # ---- score ----
@@ -565,10 +627,18 @@ def make_step(config: EngineConfig):
         idx = jnp.maximum(choice, 0)
         gate = found.astype(jnp.int64)
         gate32 = found.astype(jnp.int32)
-        if config.has_ports or config.has_services or config.has_interpod:
+        if (config.has_ports or config.has_services or config.has_interpod
+                or config.has_disk_conflict):
             presence = carry.presence.at[x.group_id, idx].add(gate32)
         else:
             presence = carry.presence
+        if config.has_maxpd:
+            row = jnp.where(found,
+                            carry.used_vols[idx] | st.vol_mask[x.group_id],
+                            carry.used_vols[idx])
+            used_vols = carry.used_vols.at[idx].set(row)
+        else:
+            used_vols = carry.used_vols
         if config.has_interpod:
             k_count = st.topo_dom.shape[0]
             dom_at = st.topo_dom[:, idx]                    # [K]
@@ -586,6 +656,7 @@ def make_step(config: EngineConfig):
             nonzero_mem=carry.nonzero_mem.at[idx].add(gate * x.nz_mem),
             pod_count=carry.pod_count.at[idx].add(gate),
             presence=presence, presence_dom=presence_dom,
+            used_vols=used_vols,
             rr=rr_next)
 
         counts = jax.lax.cond(
@@ -633,10 +704,18 @@ def make_wavefront_step(config: EngineConfig):
 
         gate32 = gate.astype(jnp.int32)
         idxs = jnp.maximum(choices, 0)
-        if config.has_ports or config.has_services or config.has_interpod:
+        if (config.has_ports or config.has_services or config.has_interpod
+                or config.has_disk_conflict):
             presence = carry.presence.at[xs.group_id, idxs].add(gate32)
         else:
             presence = carry.presence
+        if config.has_maxpd:
+            added = jax.ops.segment_sum(
+                st.vol_mask[xs.group_id].astype(jnp.int32) * gate32[:, None],
+                seg, num_segments=n + 1)[:n] > 0
+            used_vols = carry.used_vols | added
+        else:
+            used_vols = carry.used_vols
         if config.has_interpod:
             k_count = st.topo_dom.shape[0]
             dom_at = st.topo_dom[:, idxs]                   # [K, W]
@@ -656,6 +735,7 @@ def make_wavefront_step(config: EngineConfig):
             nonzero_mem=scatter(xs.nz_mem, carry.nonzero_mem),
             pod_count=scatter(jnp.ones_like(gate), carry.pod_count),
             presence=presence, presence_dom=presence_dom,
+            used_vols=used_vols,
             rr=carry.rr + jnp.sum(advances))
 
         counts = jnp.where(

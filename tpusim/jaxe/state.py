@@ -65,7 +65,10 @@ BIT_AFFINITY_NOT_MATCH = 15     # MatchInterPodAffinity umbrella reason
 BIT_EXISTING_ANTI_AFFINITY = 16
 BIT_AFFINITY_RULES = 17
 BIT_ANTI_AFFINITY_RULES = 18
-NUM_FIXED_BITS = 19
+BIT_DISK_CONFLICT = 19          # NoDiskConflict (error.go ErrDiskConflict)
+BIT_MAX_VOLUME_COUNT = 20       # MaxPDVolumeCount
+BIT_VOLUME_ZONE_CONFLICT = 21   # NoVolumeZoneConflict
+NUM_FIXED_BITS = 22
 # bits >= NUM_FIXED_BITS: Insufficient <scalar resource s>, per interned name
 
 REASON_STRINGS = [
@@ -88,6 +91,9 @@ REASON_STRINGS = [
     "node(s) didn't satisfy existing pods anti-affinity rules",
     "node(s) didn't match pod affinity rules",
     "node(s) didn't match pod anti-affinity rules",
+    "node(s) had no available disk",
+    "node(s) exceed max volume count",
+    "node(s) had no available volume zone",
 ]
 
 # Pod-group budgets (env-overridable). Groups are merged by match profile and
@@ -119,15 +125,13 @@ def _group_budgets():
 
 
 def volume_unsupported(new_pods: List[Pod], cluster_pods) -> List[str]:
-    """Volume predicates are host-side for now (NoDiskConflict /
-    MaxPDVolumeCount / NoVolumeZoneConflict read PV/PVC state and per-node
-    mounted-volume sets): volume-using workloads route to the parity engine so
-    placements stay identical. Shared by compile_cluster and the incremental
-    path (delta.py) so the two can't drift."""
+    """Volume fallback for the INCREMENTAL path only: IncrementalCluster does
+    not ingest PV/PVC events, so it cannot resolve claims; fresh compiles
+    (compile_cluster) evaluate the volume predicates natively on device."""
     if any(p.spec.volumes for p in new_pods) \
             or any(p.spec.volumes for p in cluster_pods):
-        return ["pod volumes (NoDiskConflict/MaxPDVolumeCount/"
-                "NoVolumeZoneConflict/CheckVolumeBinding)"]
+        return ["pod volumes (the incremental event-log path carries no "
+                "PV/PVC state)"]
     return []
 
 
@@ -212,6 +216,13 @@ class GroupTables:
     presence: np.ndarray         # [G, N] int32 — placed existing pods per group
     port_conflict: np.ndarray    # [Pp, Pp] bool — wanted ports of a hit ports of b
     port_sig: np.ndarray         # [G] int32 — group -> port-set id (0 = none)
+    # volume predicates (device-native; see _compile_volumes)
+    disk_conflict: np.ndarray    # [Dv, Dv] bool — volume-set a conflicts with b
+    disk_sig: np.ndarray         # [G] int32 — group -> volume-set id (0 = none)
+    vol_mask: np.ndarray         # [G, V] bool — MaxPD-relevant volume ids used
+    vol_type: np.ndarray         # [V, 3] bool — id counts toward (EBS,GCE,Azure)
+    zone_ok: np.ndarray          # [G, N] bool — NoVolumeZoneConflict passes
+    used_vols_init: np.ndarray   # [N, V] bool — placed pods' volume ids per node
     ss_rows: np.ndarray          # [Sd, G] bool — b counts toward spread sig s
     ss_sig: np.ndarray           # [G] int32 — group -> its spread sig (0 = none)
     term_match: np.ndarray       # [Td, G] bool — term t matches a pod of group b
@@ -283,6 +294,10 @@ class CompiledCluster:
     has_ports: bool = False
     has_services: bool = False
     has_interpod: bool = False
+    has_disk_conflict: bool = False
+    has_maxpd: bool = False
+    has_vol_zone: bool = False
+    maxpd_limits: tuple = (39, 16, 16)   # (EBS, GCE PD, AzureDisk)
     n_topo_doms: int = 1         # segment count for topo_dom (incl. invalid 0)
     n_zone_doms: int = 1
     unsupported: List[str] = field(default_factory=list)  # features needing fallback
@@ -353,6 +368,10 @@ def _group_signature(pod: Pod):
         "anti": (aff.pod_anti_affinity.to_obj()
                  if (aff and aff.pod_anti_affinity) else None),
         "ports": _sanitized_ports(pod),
+        # volumes drive NoDiskConflict/MaxPDVolumeCount/NoVolumeZoneConflict;
+        # [] keeps volume-less pods in one signature class
+        "vols": sorted(json.dumps(v.to_obj(), sort_keys=True)
+                       for v in pod.spec.volumes),
     }
 
 
@@ -385,11 +404,190 @@ def _pref_terms(pod: Pod) -> list:
     return out
 
 
+class _VolumeFallback(Exception):
+    """Raised during volume compilation when the workload needs host-side
+    semantics (resolution errors the reference reports per pod) or exceeds a
+    budget; routes the batch to the parity engine."""
+
+
+_ZONE_LABELS = ("failure-domain.beta.kubernetes.io/zone",
+                "failure-domain.beta.kubernetes.io/region")
+_MAXPD_TYPES = ("EBS", "GCE", "AzureDisk")
+MAX_VOLUME_IDS = 4096
+
+
+def _compile_volumes(raw_reps: List[Pod], nodes: List[Node],
+                     snapshot: ClusterSnapshot, max_work: int):
+    """Device tables for NoDiskConflict / MaxPDVolumeCount /
+    NoVolumeZoneConflict (predicates.go:266-276, 288-460, 510-533).
+
+    Volume sets are interned per (namespace, volumes) signature; PVC->PV
+    resolution happens here against the snapshot, so the device only carries
+    a per-node used-volume-id matrix and static conflict/zone tables.
+    Returns (vsig_raw[Graw], disk_conflict[Dv,Dv], vol_mask[Dv,V],
+    vol_type[V,3], zone_rows[Dv,N], limits, flags)."""
+    import os
+
+    from tpusim.engine.predicates import (
+        _VOLUME_FILTERS,
+        get_max_vols,
+        is_volume_conflict,
+        label_zones_to_set,
+    )
+
+    graw = len(raw_reps)
+    n = len(nodes)
+    pvcs = {pvc.key(): pvc for pvc in snapshot.pvcs}
+    pvs = {pv.name: pv for pv in snapshot.pvs}
+    node_constraints = [
+        {k: v for k, v in node.metadata.labels.items() if k in _ZONE_LABELS}
+        for node in nodes]
+    any_zone_nodes = any(node_constraints)
+
+    # --- volume-set signature interning over raw groups ---
+    vsig_ids: Dict[str, int] = {"": 0}
+    vsig_reps: List[Optional[Pod]] = [None]
+    vsig_raw = np.zeros(graw, np.int32)
+    for b, rep in enumerate(raw_reps):
+        if not rep.spec.volumes:
+            continue
+        key = json.dumps([rep.namespace,
+                          sorted(json.dumps(v.to_obj(), sort_keys=True)
+                                 for v in rep.spec.volumes)])
+        vid = vsig_ids.get(key)
+        if vid is None:
+            vid = len(vsig_reps)
+            vsig_ids[key] = vid
+            vsig_reps.append(rep)
+        vsig_raw[b] = vid
+    dv = len(vsig_reps)
+    if dv * dv + dv * n > max_work:
+        raise _VolumeFallback(
+            f"volume-set precompute ({dv} sets, {n} nodes) exceeds the jax "
+            f"backend work budget ({max_work})")
+
+    # --- NoDiskConflict: pairwise conflicts between volume sets ---
+    disk_conflict = np.zeros((dv, dv), dtype=bool)
+    for a in range(1, dv):
+        for b in range(1, dv):
+            disk_conflict[a, b] = any(
+                is_volume_conflict(v, vsig_reps[b])
+                for v in vsig_reps[a].spec.volumes)
+    has_disk = bool(disk_conflict.any())
+
+    # --- MaxPDVolumeCount: per-set relevant volume ids (resolved via PVC->PV;
+    # unresolvable claims count conservatively toward every filter type) ---
+    vol_ids: Dict[tuple, int] = {}
+    set_ids: List[List[int]] = [[] for _ in range(dv)]
+    id_types: List[set] = []
+
+    def intern_vol(key: tuple, types: set) -> int:
+        vid = vol_ids.get(key)
+        if vid is None:
+            vid = len(id_types)
+            vol_ids[key] = vid
+            id_types.append(set())
+        id_types[vid] |= types
+        return vid
+
+    for s in range(1, dv):
+        rep = vsig_reps[s]
+        for vol in rep.spec.volumes:
+            direct = False
+            for t, name in enumerate(_MAXPD_TYPES):
+                vol_src, _, id_field, _ = _VOLUME_FILTERS[name]
+                src = vol_src(vol)
+                if src is not None:
+                    set_ids[s].append(intern_vol(
+                        (name, src.get(id_field, "")), {t}))
+                    direct = True
+                    break
+            if direct:
+                continue
+            pvc_name = vol.pvc_name
+            if pvc_name is None:
+                continue
+            if pvc_name == "":
+                raise _VolumeFallback(
+                    "a pod volume has a PersistentVolumeClaim with no name")
+            pvc = pvcs.get(f"{rep.namespace}/{pvc_name}")
+            pv = pvs.get(pvc.volume_name) if (pvc and pvc.volume_name) else None
+            if pv is None:
+                # missing PVC / unbound PVC / missing PV: conservative id
+                # counted toward every type (predicates.go:379-410); the zone
+                # predicate would error on these when zone constraints exist
+                if any_zone_nodes:
+                    raise _VolumeFallback(
+                        f'unresolvable PersistentVolumeClaim "{pvc_name}" with '
+                        "zone-constrained nodes (NoVolumeZoneConflict errors "
+                        "host-side)")
+                set_ids[s].append(intern_vol(
+                    ("pvc", f"{rep.namespace}/{pvc_name}"), {0, 1, 2}))
+                continue
+            for t, name in enumerate(_MAXPD_TYPES):
+                _, pv_src, id_field, _ = _VOLUME_FILTERS[name]
+                src = pv_src(pv)
+                if src is not None:
+                    set_ids[s].append(intern_vol(
+                        (name, src.get(id_field, "")), {t}))
+                    break
+    v_count = len(id_types)
+    max_vol_ids = int(os.environ.get("TPUSIM_MAX_VOLUME_IDS", MAX_VOLUME_IDS))
+    if v_count > max_vol_ids:
+        raise _VolumeFallback(
+            f"{v_count} distinct MaxPD volume ids exceed the jax backend "
+            f"limit ({max_vol_ids})")
+    v_dim = max(v_count, 1)
+    vol_mask = np.zeros((dv, v_dim), dtype=bool)
+    for s in range(dv):
+        for vid in set_ids[s]:
+            vol_mask[s, vid] = True
+    vol_type = np.zeros((v_dim, 3), dtype=bool)
+    for vid, types in enumerate(id_types):
+        for t in types:
+            vol_type[vid, t] = True
+    has_maxpd = v_count > 0
+    limits = (get_max_vols(39), get_max_vols(16), get_max_vols(16))
+
+    # --- NoVolumeZoneConflict: static (volume set, node) pass/fail ---
+    zone_rows = np.ones((dv, n), dtype=bool)
+    has_zone = False
+    if any_zone_nodes:
+        for s in range(1, dv):
+            rep = vsig_reps[s]
+            for vol in rep.spec.volumes:
+                pvc_name = vol.pvc_name
+                if not pvc_name:
+                    continue
+                pvc = pvcs[f"{rep.namespace}/{pvc_name}"]  # resolved above
+                pv = pvs[pvc.volume_name]
+                for k, v in pv.metadata.labels.items():
+                    if k not in _ZONE_LABELS:
+                        continue
+                    try:
+                        allowed = label_zones_to_set(v)
+                    except ValueError:
+                        continue  # unparsable label ignored
+                    for i, constraints in enumerate(node_constraints):
+                        if not constraints:
+                            continue  # zone-label-less node passes trivially
+                        # a constrained node missing the PV's label fails too
+                        # (nodeConstraints[k] yields "" in the reference)
+                        if constraints.get(k) not in allowed:
+                            zone_rows[s, i] = False
+                            has_zone = True
+    return (vsig_raw, disk_conflict, vol_mask, vol_type, zone_rows, limits,
+            has_disk, has_maxpd, has_zone)
+
+
 def _trivial_groups(num_pods: int, n: int) -> "GroupTables":
     z = np.zeros
     return GroupTables(
         group_of_pod=z(num_pods, np.int32), presence=z((1, n), np.int32),
         port_conflict=z((1, 1), bool), port_sig=z(1, np.int32),
+        disk_conflict=z((1, 1), bool), disk_sig=z(1, np.int32),
+        vol_mask=z((1, 1), bool), vol_type=z((1, 3), bool),
+        zone_ok=np.ones((1, n), bool), used_vols_init=z((n, 1), bool),
         ss_rows=z((1, 1), bool), ss_sig=z(1, np.int32),
         term_match=z((1, 1), bool),
         zone_dom=z(n, np.int32), topo_dom=z((1, n), np.int32),
@@ -408,9 +606,12 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
                     nodes: List[Node], node_index: Dict[str, int]):
     """Build GroupTables + feature flags. Returns
     (tables, has_ports, has_services, has_interpod, n_topo_doms, n_zone_doms,
-    unsupported, sig_to_gid) where sig_to_gid maps each raw canonical group
-    signature key to its merged group id (used by the incremental path)."""
+    unsupported, sig_to_gid, vol_meta) where sig_to_gid maps each raw
+    canonical group signature key to its merged group id (used by the
+    incremental path) and vol_meta = (has_disk_conflict, has_maxpd,
+    has_vol_zone, maxpd_limits)."""
     n = len(nodes)
+    no_vol_meta = (False, False, False, (39, 16, 16))
     placed = [p for p in snapshot.pods if p.spec.node_name in node_index]
     # pods with an unknown-but-set nodeName still count for "matching pod
     # exists"; nodeName-less (pending) pods are dropped by the reference's pod
@@ -423,15 +624,17 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
     has_interpod = any(_has_interpod_terms(p) for p in pods) \
         or any(_has_interpod_terms(p) for p in placed)
     has_services = bool(snapshot.services)
-    if not (has_ports or has_interpod or has_services):
+    has_volumes = any(p.spec.volumes for p in pods) \
+        or any(p.spec.volumes for p in placed)
+    if not (has_ports or has_interpod or has_services or has_volumes):
         return (_trivial_groups(len(pods), n), False, False, False, 1, 1, [],
-                {})
+                {}, no_vol_meta)
 
     max_groups, max_raw, max_work, max_presence = _group_budgets()
 
     def fallback(reason: str):
         return (_trivial_groups(len(pods), n), False, False, False, 1, 1,
-                [reason], {})
+                [reason], {}, no_vol_meta)
 
     # --- 1. raw signature interning ---
     gi = Interner()
@@ -443,6 +646,23 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
                         f"backend limit ({max_raw})")
     raw_reps = gi.representatives
     raw_keys = list(gi._ids.keys())  # insertion-ordered: index == raw id
+
+    # --- volume tables (NoDiskConflict / MaxPDVolumeCount / NoVolumeZone) ---
+    if has_volumes:
+        try:
+            (vsig_raw, disk_conflict, vsig_mask, vol_type, zone_rows,
+             maxpd_limits, has_disk, has_maxpd, has_zone) = _compile_volumes(
+                 raw_reps, nodes, snapshot, max_work)
+        except _VolumeFallback as exc:
+            return fallback(str(exc))
+    else:
+        vsig_raw = np.zeros(graw, np.int32)
+        disk_conflict = np.zeros((1, 1), bool)
+        vsig_mask = np.zeros((1, 1), bool)
+        vol_type = np.zeros((1, 3), bool)
+        zone_rows = np.ones((1, n), bool)
+        maxpd_limits = (39, 16, 16)
+        has_disk = has_maxpd = has_zone = False
 
     # --- 2. intern matcher spaces: terms, port sets, spread signatures ---
     # term signature = (resolved namespaces, selector): that pair fully
@@ -563,7 +783,7 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
     rep_raw_idx: List[int] = []
     for b in range(graw):
         profile = (term_match_raw[:, b].tobytes(), ss_rows_raw[:, b].tobytes(),
-                   int(port_sig_raw[b]), int(ss_sig_raw[b]),
+                   int(port_sig_raw[b]), int(ss_sig_raw[b]), int(vsig_raw[b]),
                    tuple(aff_of[b]), tuple(anti_of[b]), tuple(pref_of[b]))
         gid = merged.get(profile)
         if gid is None:
@@ -591,9 +811,17 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
     port_sig = port_sig_raw[sel_cols].astype(np.int32)
     ss_sig = ss_sig_raw[sel_cols].astype(np.int32)
 
+    disk_sig = vsig_raw[sel_cols].astype(np.int32)
+    vol_mask = vsig_mask[vsig_raw[sel_cols]]        # [G, V]
+    zone_ok = zone_rows[vsig_raw[sel_cols]]         # [G, N]
+
     presence = np.zeros((g, n), dtype=np.int32)
+    used_vols_init = np.zeros((n, vsig_mask.shape[1]), dtype=bool)
     for raw_id, p in zip(placed_raw, placed):
-        presence[gid_of_raw[raw_id], node_index[p.spec.node_name]] += 1
+        i = node_index[p.spec.node_name]
+        presence[gid_of_raw[raw_id], i] += 1
+        if has_maxpd:
+            used_vols_init[i] |= vsig_mask[vsig_raw[raw_id]]
 
     zone_dom = np.zeros(n, dtype=np.int32)
     n_zone_doms = 1
@@ -685,6 +913,9 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
     tables = GroupTables(
         group_of_pod=group_of_pod, presence=presence,
         port_conflict=port_conflict, port_sig=port_sig,
+        disk_conflict=disk_conflict, disk_sig=disk_sig,
+        vol_mask=vol_mask, vol_type=vol_type, zone_ok=zone_ok,
+        used_vols_init=used_vols_init,
         ss_rows=ss_rows, ss_sig=ss_sig, term_match=term_match,
         zone_dom=zone_dom, topo_dom=topo_dom,
         aff_valid=aff_valid, aff_err=aff_err, aff_empty=aff_empty,
@@ -694,7 +925,8 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
         anti_term=anti_term, anti_key=anti_key, anti_hostname=anti_hostname,
         pref_w=pref_w, pref_term=pref_term, pref_key=pref_key)
     return (tables, has_ports, has_services, has_interpod,
-            n_topo_doms, n_zone_doms, [], sig_to_gid)
+            n_topo_doms, n_zone_doms, [], sig_to_gid,
+            (has_disk, has_maxpd, has_zone, maxpd_limits))
 
 
 def node_static_row(node: Node, ni: NodeInfo, scalar_idx: Dict[str, int],
@@ -852,7 +1084,6 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
 
     sel_i, tol_i, aff_i, avoid_i, host_i = (Interner() for _ in range(5))
     unsupported: List[str] = []
-    unsupported.extend(volume_unsupported(pods, snapshot.pods))
     for j, pod in enumerate(pods):
         fill_pod_request_row(cols, j, pod, pod_requests[j], scalar_idx)
         cols.sel_id[j] = sel_i.intern(_selector_signature(pod), pod)
@@ -863,7 +1094,9 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
 
     node_index = {nd.name: i for i, nd in enumerate(nodes)}
     (groups, has_ports, has_services, has_interpod, n_topo_doms, n_zone_doms,
-     group_unsupported, _) = _compile_groups(snapshot, pods, nodes, node_index)
+     group_unsupported, _, vol_meta) = _compile_groups(snapshot, pods, nodes,
+                                                       node_index)
+    has_disk_conflict, has_maxpd, has_vol_zone, maxpd_limits = vol_meta
     unsupported.extend(group_unsupported)
     cols.group_id = groups.group_of_pod
 
@@ -915,6 +1148,9 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
                                node_index=node_index,
                                has_ports=has_ports, has_services=has_services,
                                has_interpod=has_interpod,
+                               has_disk_conflict=has_disk_conflict,
+                               has_maxpd=has_maxpd, has_vol_zone=has_vol_zone,
+                               maxpd_limits=maxpd_limits,
                                n_topo_doms=n_topo_doms, n_zone_doms=n_zone_doms,
                                unsupported=unsupported)
     return compiled, cols

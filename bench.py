@@ -152,9 +152,9 @@ def run_child(platform: str) -> None:
         the axon runtime, which has been observed returning early.
         """
         if batch > 0:
-            _, choices, counts = schedule_wavefront(config, carry, statics, xs, batch)
+            _, choices, counts, _ = schedule_wavefront(config, carry, statics, xs, batch)
         else:
-            _, choices, counts = schedule_scan(config, carry, statics, xs)
+            _, choices, counts, _ = schedule_scan(config, carry, statics, xs)
         checksum = int(jnp.sum(jnp.where(choices >= 0, choices, -1)))
         return choices, checksum
 

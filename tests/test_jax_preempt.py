@@ -1,0 +1,144 @@
+"""Differential tests: preemption on the jax backend (host-device hybrid,
+tpusim/jaxe/preempt.py) vs the reference ClusterCapacity run.
+
+Reference semantics under test: scheduler.go:449-455 (preempt on FitError) +
+core/generic_scheduler.go:205-1000 (Preempt/selectNodesForPreemption/
+selectVictimsOnNode/pickOneNodeForPreemption)."""
+
+import random
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.simulator import run_simulation
+
+
+def prio_pod(name, priority, milli_cpu=500, node_name="", labels=None,
+             memory=0):
+    p = make_pod(name, milli_cpu=milli_cpu, node_name=node_name, labels=labels,
+                 memory=memory)
+    p.spec.priority = priority
+    if node_name:
+        p.status.phase = "Running"
+    return p
+
+
+def status_sig(status):
+    return {
+        "success": [(p.name, p.spec.node_name) for p in status.successful_pods],
+        "failed": [(p.name, p.status.conditions[-1].message if p.status.conditions else "")
+                   for p in status.failed_pods],
+        "preempted": sorted(p.name for p in status.preempted_pods),
+        "stop": status.stop_reason,
+    }
+
+
+def assert_preempt_parity(pods, snapshot, provider="DefaultProvider"):
+    ref = run_simulation(list(pods), snapshot, provider=provider,
+                         backend="reference", enable_pod_priority=True)
+    jax_status = run_simulation(list(pods), snapshot, provider=provider,
+                                backend="jax", enable_pod_priority=True)
+    assert status_sig(jax_status) == status_sig(ref)
+    return jax_status
+
+
+def test_jax_preemption_evicts_lower_priority_victim():
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    victim = prio_pod("victim", 1, milli_cpu=800, node_name="n1")
+    high = prio_pod("high", 10, milli_cpu=800)
+    snap = ClusterSnapshot(nodes=[node], pods=[victim])
+    status = assert_preempt_parity([high], snap)
+    assert [p.name for p in status.preempted_pods] == ["victim"]
+    assert [p.name for p in status.successful_pods] == ["high"]
+
+
+def test_jax_no_preemption_among_equal_priorities():
+    node = make_node("n1", milli_cpu=1000, memory=16 * 1024**3)
+    peer = prio_pod("peer", 10, milli_cpu=800, node_name="n1")
+    pod = prio_pod("pod", 10, milli_cpu=800)
+    snap = ClusterSnapshot(nodes=[node], pods=[peer])
+    status = assert_preempt_parity([pod], snap)
+    assert not status.preempted_pods
+    assert [p.name for p in status.failed_pods] == ["pod"]
+
+
+def test_jax_preemption_mid_batch_redispatch():
+    """A preemption in the middle of the feed forces a re-dispatch; decisions
+    before the preemptor must be kept, decisions after recomputed."""
+    nodes = [make_node(f"n{i}", milli_cpu=2000, memory=16 * 1024**3)
+             for i in range(3)]
+    victims = [prio_pod(f"v{i}", 0, milli_cpu=1800, node_name=f"n{i}")
+               for i in range(3)]
+    # feed is LIFO: list order [first-fed last ... last-fed first]; build in
+    # podspec order so 'small' pods schedule first, then the preemptor fires
+    pods = [
+        prio_pod("post", 0, milli_cpu=150),
+        prio_pod("preemptor", 5, milli_cpu=1900),
+        prio_pod("small-b", 0, milli_cpu=100),
+        prio_pod("small-a", 0, milli_cpu=100),
+    ]
+    snap = ClusterSnapshot(nodes=nodes, pods=victims)
+    status = assert_preempt_parity(pods, snap)
+    assert len(status.preempted_pods) == 1
+    assert any(p.name == "preemptor" for p in status.successful_pods)
+
+
+def test_jax_preemption_cascade():
+    """Several preemptors in one batch: each success invalidates later
+    decisions, exercising repeated re-dispatch + bucket padding."""
+    nodes = [make_node(f"n{i}", milli_cpu=1000, memory=16 * 1024**3)
+             for i in range(4)]
+    victims = [prio_pod(f"v{i}", i % 3, milli_cpu=900, node_name=f"n{i}")
+               for i in range(4)]
+    pods = [prio_pod(f"h{i}", 8, milli_cpu=900) for i in range(6)]
+    snap = ClusterSnapshot(nodes=nodes, pods=victims)
+    status = assert_preempt_parity(pods, snap)
+    assert len(status.preempted_pods) == 4
+    assert len(status.successful_pods) == 4
+    assert len(status.failed_pods) == 2
+
+
+def test_jax_preemption_respects_unresolvable_nodes():
+    """Nodes failing on taints/selector are excluded from preemption
+    (nodesWherePreemptionMightHelp, generic_scheduler.go:1050-1080)."""
+    tainted = make_node("tainted", milli_cpu=4000, memory=16 * 1024**3,
+                        taints=[{"key": "k", "value": "v",
+                                 "effect": "NoSchedule"}])
+    normal = make_node("normal", milli_cpu=1000, memory=16 * 1024**3)
+    victim_t = prio_pod("vt", 0, milli_cpu=100, node_name="tainted")
+    victim_n = prio_pod("vn", 0, milli_cpu=900, node_name="normal")
+    pod = prio_pod("pod", 9, milli_cpu=900)
+    snap = ClusterSnapshot(nodes=[tainted, normal], pods=[victim_t, victim_n])
+    status = assert_preempt_parity([pod], snap)
+    assert [p.name for p in status.preempted_pods] == ["vn"]
+    assert status.successful_pods[0].spec.node_name == "normal"
+
+
+def test_jax_preemption_random_differential():
+    rng = random.Random(7)
+    for trial in range(3):
+        n_nodes = 6
+        nodes = [make_node(f"n{i}", milli_cpu=rng.choice([1000, 2000, 3000]),
+                           memory=16 * 1024**3) for i in range(n_nodes)]
+        placed = []
+        for i in range(10):
+            placed.append(prio_pod(
+                f"placed-{trial}-{i}", rng.randint(0, 5),
+                milli_cpu=rng.choice([200, 500, 900]),
+                node_name=f"n{rng.randrange(n_nodes)}"))
+        pods = [prio_pod(f"new-{trial}-{i}", rng.randint(0, 10),
+                         milli_cpu=rng.choice([300, 800, 1500, 2500]))
+                for i in range(18)]
+        snap = ClusterSnapshot(nodes=nodes, pods=placed)
+        assert_preempt_parity(pods, snap)
+
+
+def test_jax_preemption_no_nodes():
+    pod = prio_pod("pod", 5, milli_cpu=100)
+    snap = ClusterSnapshot(nodes=[], pods=[])
+    status = assert_preempt_parity([pod], snap)
+    assert [p.name for p in status.failed_pods] == ["pod"]
+
+
+def test_jax_preemption_empty_feed():
+    snap = ClusterSnapshot(nodes=[make_node("n1", milli_cpu=1000)], pods=[])
+    status = assert_preempt_parity([], snap)
+    assert status.stop_reason

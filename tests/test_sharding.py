@@ -44,12 +44,12 @@ def build(num_nodes=20, num_pods=40):
 @needs_8_devices
 def test_sharded_scan_matches_single_device():
     config, carry, statics, xs = build()
-    _, base_choices, base_counts = schedule_scan(config, carry, statics, xs)
+    _, base_choices, base_counts, _ = schedule_scan(config, carry, statics, xs)
 
     mesh = make_mesh(8, snap=1)
     st_s, ca_s, xs_s = shard_for_mesh(mesh, statics, carry, xs)
     with mesh:
-        _, sharded_choices, sharded_counts = schedule_scan(config, ca_s, st_s, xs_s)
+        _, sharded_choices, sharded_counts, _ = schedule_scan(config, ca_s, st_s, xs_s)
     np.testing.assert_array_equal(np.asarray(base_choices),
                                   np.asarray(sharded_choices))
     np.testing.assert_array_equal(np.asarray(base_counts),
@@ -71,7 +71,7 @@ def test_node_padding_keeps_reasons_clean():
     mesh = make_mesh(8, snap=1)
     st_s, ca_s, xs_s = shard_for_mesh(mesh, statics, carry, xs)
     with mesh:
-        _, choices, counts = schedule_scan(config, ca_s, st_s, xs_s)
+        _, choices, counts, _ = schedule_scan(config, ca_s, st_s, xs_s)
     assert int(choices[0]) == -1
     from tpusim.jaxe.state import BIT_INSUFFICIENT_CPU
 

@@ -70,14 +70,22 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Generate N homogeneous synthetic nodes")
     parser.add_argument("--synthetic-milli-cpu", type=int, default=4000)
     parser.add_argument("--synthetic-memory", type=int, default=16 * 1024**3)
+    parser.add_argument("--event-log", default="",
+                        help="Watch-event log (JSON lines, the WatchBuffer "
+                             "wire frames: {type: Added|Modified|Deleted, "
+                             "object: {kind: Pod|Node|Service, ...}}) "
+                             "replayed on top of the snapshot before "
+                             "scheduling; on the jax backend the replay "
+                             "drives incremental column-cache updates")
     parser.add_argument("--what-if", default="",
                         help="Manifest JSON [{snapshot, podspec}, ...]: run "
                              "all scenarios as ONE batched device program "
                              "(jax backend; snapshot axis shardable over a "
                              "mesh). Ignores --podspec/--snapshot.")
     parser.add_argument("--enable-pod-priority", action="store_true",
-                        help="Enable the PodPriority feature gate (preemption); "
-                             "reference backend only")
+                        help="Enable the PodPriority feature gate (preemption). "
+                             "On the jax backend this runs the host-device "
+                             "hybrid: device scan + exact host Preempt pipeline")
     parser.add_argument("--enable-volume-scheduling", action="store_true",
                         help="Enable the VolumeScheduling feature gate "
                              "(CheckVolumeBinding + delayed PV binding); "
@@ -169,6 +177,11 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", args.platform)
 
     if args.what_if:
+        if args.event_log:
+            print("error: --event-log cannot be combined with --what-if "
+                  "(what-if scenarios carry their own snapshots)",
+                  file=sys.stderr)
+            return 2
         return run_what_if_cli(args)
     if not args.podspec:
         print("error: --podspec is required (or use --what-if)", file=sys.stderr)
@@ -218,10 +231,15 @@ def main(argv=None) -> int:
     if args.batch_size and args.backend != "jax":
         print("error: --batch-size requires --backend jax", file=sys.stderr)
         return 2
-    if args.enable_pod_priority and args.backend != "reference":
-        print("error: --enable-pod-priority requires --backend reference "
-              "(preemption is not batched yet)", file=sys.stderr)
-        return 2
+    events = None
+    if args.event_log:
+        from tpusim.framework.events import load_event_log
+
+        try:
+            events = load_event_log(args.event_log)
+        except (OSError, ValueError) as exc:
+            print(f"error: invalid event log: {exc}", file=sys.stderr)
+            return 2
 
     start = time.perf_counter()
     try:
@@ -229,7 +247,7 @@ def main(argv=None) -> int:
                                 backend=args.backend, batch_size=args.batch_size,
                                 enable_pod_priority=args.enable_pod_priority,
                                 enable_volume_scheduling=args.enable_volume_scheduling,
-                                policy=policy)
+                                policy=policy, events=events)
     except ValueError as exc:  # invalid policy/provider surfaced at build time
         print(f"error: {exc}", file=sys.stderr)
         return 2

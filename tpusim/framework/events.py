@@ -78,6 +78,51 @@ def watch_resource(store: ResourceStore, resource: ResourceType) -> WatchBuffer:
     return buf
 
 
+def load_event_log(path: str) -> list:
+    """Parse a watch-event log: JSON lines in the reference's wire-frame shape
+    {"type": "Added|Modified|Deleted", "object": {kind, ...}} (watch.go:99-125
+    — the frames the WatchBuffer streams; WatchEvent.to_frame writes the same
+    format). Returns [(EVENT_TYPE, obj), ...] ready for
+    jaxe.delta.IncrementalCluster.apply_events / run_simulation(events=...)."""
+    import io
+
+    from tpusim.api.types import Node, Pod, Service
+    from tpusim.framework.store import DELETED, MODIFIED
+
+    kinds = {"Pod": Pod, "Node": Node, "Service": Service}
+    valid = {ADDED, MODIFIED, DELETED}
+    events = []
+    with io.open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                frame = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"{path}:{lineno}: not JSON: {exc}") from exc
+            event_type = str(frame.get("type", "")).upper()
+            if event_type not in valid:
+                raise ValueError(f"{path}:{lineno}: unknown event type "
+                                 f"{frame.get('type')!r}")
+            obj = frame.get("object") or {}
+            if not isinstance(obj, dict):
+                raise ValueError(f"{path}:{lineno}: \"object\" must be a "
+                                 f"JSON object, got {type(obj).__name__}")
+            cls = kinds.get(obj.get("kind", ""))
+            if cls is None:
+                raise ValueError(f"{path}:{lineno}: unsupported object kind "
+                                 f"{obj.get('kind')!r} (expected Pod/Node/"
+                                 "Service)")
+            try:
+                events.append((event_type, cls.from_obj(obj)))
+            except (TypeError, AttributeError, KeyError) as exc:
+                raise ValueError(
+                    f"{path}:{lineno}: malformed {obj.get('kind')} object: "
+                    f"{exc}") from exc
+    return events
+
+
 @dataclass
 class Event:
     """client-go record.Event essentials."""

@@ -146,10 +146,10 @@ class JaxBackend:
         metrics = register()
         dispatch_start = perf_counter()
         if self.batch_size > 0:
-            _, choices, counts = schedule_wavefront(config, carry, statics, xs,
-                                                    self.batch_size)
+            _, choices, counts, _ = schedule_wavefront(config, carry, statics,
+                                                       xs, self.batch_size)
         else:
-            _, choices, counts = schedule_scan(config, carry, statics, xs)
+            _, choices, counts, _ = schedule_scan(config, carry, statics, xs)
         choices = np.asarray(choices)
         counts = np.asarray(counts)
         metrics.scheduling_algorithm_latency.observe(

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpusim.engine.predicates import DEFAULT_MAXPD_LIMITS
 from tpusim.engine.priorities import ZONE_WEIGHTING
 from tpusim.jaxe.state import (
     BIT_AFFINITY_NOT_MATCH,
@@ -153,7 +154,7 @@ class EngineConfig:
     has_disk_conflict: bool = False
     has_maxpd: bool = False
     has_vol_zone: bool = False
-    maxpd_limits: tuple = (39, 16, 16)  # (EBS, GCE PD, AzureDisk)
+    maxpd_limits: tuple = DEFAULT_MAXPD_LIMITS  # (EBS, GCE PD, AzureDisk)
     hard_weight: int = 10         # HardPodAffinitySymmetricWeight
     n_topo_doms: int = 1          # segment counts (incl. the invalid-0 bucket)
     n_zone_doms: int = 1
@@ -235,7 +236,7 @@ def config_for(compiled_list, most_requested: bool, num_reason_bits: int,
         has_disk_conflict=any(c.has_disk_conflict for c in compiled_list),
         has_maxpd=any(c.has_maxpd for c in compiled_list),
         has_vol_zone=any(c.has_vol_zone for c in compiled_list),
-        maxpd_limits=limits[0] if limits else (39, 16, 16),
+        maxpd_limits=limits[0] if limits else DEFAULT_MAXPD_LIMITS,
         hard_weight=hard_weight,
         n_topo_doms=max(c.n_topo_doms for c in compiled_list),
         n_zone_doms=max(c.n_zone_doms for c in compiled_list),
@@ -663,7 +664,9 @@ def make_step(config: EngineConfig):
             found,
             lambda: jnp.zeros(config.num_reason_bits, dtype=jnp.int32),
             lambda: _reason_histogram(reason_bits, config.num_reason_bits))
-        return (new_carry, st), (choice, counts)
+        # advanced: selectHost consumed the rr counter for this pod — lets the
+        # preemption hybrid (jaxe/preempt.py) resume rr mid-batch on re-dispatch
+        return (new_carry, st), (choice, counts, n_feasible > 1)
 
     return step
 
@@ -672,9 +675,9 @@ def make_step(config: EngineConfig):
 def schedule_scan(config: EngineConfig, carry: Carry, statics: Statics, xs: PodX):
     """Exact sequential mode: scan the fused step over the pod axis."""
     step = make_step(config)
-    (final_carry, _), (choices, counts) = jax.lax.scan(
+    (final_carry, _), (choices, counts, advanced) = jax.lax.scan(
         step, (carry, statics), xs, unroll=config.scan_unroll)
-    return final_carry, choices, counts
+    return final_carry, choices, counts, advanced
 
 
 def make_wavefront_step(config: EngineConfig):
@@ -743,7 +746,7 @@ def make_wavefront_step(config: EngineConfig):
             jnp.zeros((1, config.num_reason_bits), dtype=jnp.int32),
             jax.vmap(lambda b: _reason_histogram(b, config.num_reason_bits))(reason_bits))
         choices = jnp.where(valid, choices, -1)  # _select already yields -1 on not-found
-        return (new_carry, st), (choices, counts)
+        return (new_carry, st), (choices, counts, advances > 0)
 
     return step
 
@@ -765,8 +768,9 @@ def schedule_wavefront(config: EngineConfig, carry: Carry, statics: Statics,
     valid = pad_field(jnp.ones(p, dtype=bool))
 
     step = make_wavefront_step(config)
-    (final_carry, _), (choices, counts) = jax.lax.scan(
+    (final_carry, _), (choices, counts, advanced) = jax.lax.scan(
         step, (carry, statics), (xs_w, valid))
     return (final_carry,
             choices.reshape(padded)[:p],
-            counts.reshape(padded, config.num_reason_bits)[:p])
+            counts.reshape(padded, config.num_reason_bits)[:p],
+            advanced.reshape(padded)[:p])

@@ -1,0 +1,295 @@
+"""Preemption on the jax backend: a host-device hybrid.
+
+Reference: the Preempt pipeline (core/generic_scheduler.go:205-1000) driven
+from scheduleOne's error arm (scheduler.go:449-455). Victim selection is
+inherently pod-identity-bound (remove lower-priority pods one by one, reprieve
+in priority order, PDB-aware) — state the device deliberately does not carry
+(the scan holds per-node aggregates + group presence, not per-pod rows). The
+TPU-native split is therefore:
+
+  device — the fused filter→score→select→bind scan schedules every pod that
+           fits (tpusim/jaxe/kernels.py); a pod that fails leaves the carry
+           untouched and does not advance the round-robin counter, so the
+           decisions AFTER a failed pod stay valid.
+  host   — only when a pod fails with the PodPriority gate on does the exact
+           engine pipeline (GenericScheduler.preempt — the same code the
+           reference backend runs) pick a node + victims against a host mirror
+           of the cluster.
+
+A successful preemption mutates state (victims deleted), which invalidates
+the device's decisions for every later pod — so the scan re-dispatches from
+the failed pod. The IncrementalCluster event path (tpusim/jaxe/delta.py)
+keeps compiled columns in sync: binds stream in as ADDED events, victims as
+DELETED events, so a re-dispatch recompiles only what changed (the
+watch-fabric analog powering preemption). Re-dispatch batches are padded to
+power-of-two buckets with provably-infeasible rows (req_cpu = 2^61 exceeds
+any allocatable), bounding XLA recompiles to O(log P) per run; an infeasible
+row can never bind or advance the rr counter, so padding is semantics-free.
+
+A cheap host gate skips the preemption attempt entirely when no placed pod
+has lower priority than the failed pod (selectVictimsOnNode can then never
+produce a fitting node), so equal-priority workloads pay no host cost beyond
+the mirror bookkeeping.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from typing import List
+
+import numpy as np
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.api.types import Pod, PodCondition, ResourceType
+from tpusim.engine.generic_scheduler import (
+    ERR_NO_NODES_AVAILABLE,
+    FitError,
+    SchedulingError,
+)
+from tpusim.engine.providers import DEFAULT_PROVIDER
+from tpusim.engine.util import get_pod_priority
+from tpusim.framework.report import Status
+from tpusim.framework.store import ADDED
+from tpusim.framework.store import DELETED as EV_DELETED
+from tpusim.jaxe import ensure_x64
+from tpusim.jaxe.backend import (
+    _MOST_REQUESTED_PROVIDERS,
+    format_fit_error,
+)
+from tpusim.jaxe.delta import IncrementalCluster
+from tpusim.jaxe.kernels import (
+    PodX,
+    carry_init,
+    config_for,
+    pod_columns_to_host,
+    schedule_scan,
+    schedule_wavefront,
+    statics_to_device,
+)
+from tpusim.jaxe.state import NUM_FIXED_BITS, reason_strings
+
+log = logging.getLogger(__name__)
+
+# A request no node can satisfy (allocatable milli-CPU is bounded far below
+# 2^61); used for padding rows so bucketed re-dispatch shapes are reusable.
+_INFEASIBLE_CPU = np.int64(1) << 61
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def _pad_infeasible(xs, pad: int):
+    """Append `pad` rows that fail PodFitsResources on every node: no carry
+    mutation, no rr advance (n_feasible == 0 skips both)."""
+    if pad <= 0:
+        return xs
+
+    def pad_field(name, arr):
+        fill = _INFEASIBLE_CPU if name == "req_cpu" else 0
+        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, widths, constant_values=fill)
+
+    return PodX(*(pad_field(name, arr)
+                  for name, arr in zip(PodX._fields, xs)))
+
+
+def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
+                        provider: str = DEFAULT_PROVIDER, batch_size: int = 0,
+                        hard_pod_affinity_symmetric_weight: int = 10,
+                        incremental: IncrementalCluster = None) -> Status:
+    """Run `pods` (podspec order; the LIFO feed reversal happens here, like
+    the reference's store.go:223-233 queue) with the PodPriority gate on.
+    Returns the final Status with successful/failed/preempted buckets matching
+    the reference backend's ClusterCapacity run.
+
+    incremental: an IncrementalCluster already equivalent to `snapshot` (e.g.
+    from an event-log replay) — reused instead of compiling a fresh one."""
+    # deferred import: simulator imports this module's sibling lazily too
+    from tpusim.simulator import ClusterCapacity, SchedulerServerConfig
+
+    def host_config():
+        return SchedulerServerConfig(
+            algorithm_provider=provider,
+            hard_pod_affinity_symmetric_weight=hard_pod_affinity_symmetric_weight,
+            enable_pod_priority=True)
+
+    # the host mirror: the same orchestrator the reference backend runs, fed
+    # manually — binds via the Bind seam, failures via the Update seam, and
+    # preemption via the shared attempt_preemption arm
+    cc = ClusterCapacity(host_config(), new_pods=[],
+                         scheduled_pods=snapshot.pods, nodes=snapshot.nodes,
+                         services=snapshot.services, pvs=snapshot.pvs,
+                         pvcs=snapshot.pvcs,
+                         storage_classes=snapshot.storage_classes)
+    feed = list(reversed(pods))
+    if not feed:
+        cc.status.stop_reason = cc.STOP_REASONS["run"]
+        cc.close()
+        return cc.status
+    if not snapshot.nodes:
+        # generic_scheduler raises ERR_NO_NODES_AVAILABLE — the plain
+        # SchedulingError arm, which never enters the preemption pipeline
+        for pod in feed:
+            cc.resource_store.add(ResourceType.PODS, pod)
+            cc.update(pod, PodCondition(
+                type="PodScheduled", status="False", reason="Unschedulable",
+                message=str(ERR_NO_NODES_AVAILABLE)))
+        cc.status.stop_reason = cc.STOP_REASONS["failed"]
+        cc.close()
+        return cc.status
+
+    inc = incremental if incremental is not None else IncrementalCluster(snapshot)
+    # priority histogram of placed pods — the preemption-possible gate
+    placed_priorities: Counter = Counter(
+        get_pod_priority(p) for p in snapshot.pods if p.spec.node_name)
+    attempts: dict = {}   # pod key -> preemption attempts (budget 1, like
+    #                       _schedule_one's preempt_budget)
+    remaining = feed
+    full_size = len(feed)
+    last_outcome = "run"
+    metrics = cc.metrics
+    first_dispatch = True
+    rr_start = 0
+
+    from time import perf_counter
+
+    from tpusim.framework.metrics import since_in_microseconds
+
+    while True:
+        compiled, cols = inc.compile(remaining)
+        if compiled.unsupported:
+            if not first_dispatch:
+                raise RuntimeError(
+                    "jax preemption: compile fallback after binds were made "
+                    f"({sorted(set(compiled.unsupported))[:3]})")
+            log.warning("jax backend (preemption) falling back to reference "
+                        "for: %s", "; ".join(sorted(set(compiled.unsupported))[:5]))
+            ref = ClusterCapacity(host_config(), new_pods=pods,
+                                  scheduled_pods=snapshot.pods,
+                                  nodes=snapshot.nodes,
+                                  services=snapshot.services, pvs=snapshot.pvs,
+                                  pvcs=snapshot.pvcs,
+                                  storage_classes=snapshot.storage_classes)
+            ref.run()
+            return ref.status
+
+        num_bits = NUM_FIXED_BITS + len(compiled.scalar_names)
+        config = config_for(
+            [compiled],
+            most_requested=provider in _MOST_REQUESTED_PROVIDERS,
+            num_reason_bits=num_bits,
+            hard_weight=hard_pod_affinity_symmetric_weight)
+        ensure_x64()
+        # lastNodeIndex persists across the whole run (generic_scheduler.go:97)
+        # — re-dispatches resume the rr counter at the preemption point
+        carry = carry_init(compiled)._replace(rr=np.int64(rr_start))
+        statics = statics_to_device(compiled)
+        xs_host = pod_columns_to_host(cols)
+        if not first_dispatch:
+            # bucket re-dispatch shapes so XLA recompiles O(log P) times
+            bucket = min(_next_pow2(len(remaining)), full_size)
+            xs_host = _pad_infeasible(xs_host, bucket - len(remaining))
+        first_dispatch = False
+        import jax.numpy as jnp
+
+        xs = PodX(*(jnp.asarray(a) for a in xs_host))
+
+        dispatch_start = perf_counter()
+        if batch_size > 0:
+            _, choices, counts, advanced = schedule_wavefront(
+                config, carry, statics, xs, batch_size)
+        else:
+            _, choices, counts, advanced = schedule_scan(config, carry,
+                                                         statics, xs)
+        choices = np.asarray(choices)[:len(remaining)]
+        counts = np.asarray(counts)[:len(remaining)]
+        advanced = np.asarray(advanced)[:len(remaining)]
+        metrics.scheduling_algorithm_latency.observe(
+            since_in_microseconds(dispatch_start))
+
+        strings = reason_strings(compiled.scalar_names)
+        names = compiled.statics.names
+
+        redispatch = False
+        for j, pod in enumerate(remaining):
+            cc.resource_store.add(ResourceType.PODS, pod)  # nextPod's store add
+            c = int(choices[j])
+            if c >= 0:
+                cc.bind(pod, names[c])
+                bound, _ = cc.resource_store.get(ResourceType.PODS, pod.key())
+                inc.apply(ADDED, bound)
+                placed_priorities[get_pod_priority(bound)] += 1
+                last_outcome = "bound"
+                continue
+
+            # failure: the scan left the carry untouched, so later decisions
+            # stay valid unless a preemption below mutates state
+            pod_priority = get_pod_priority(pod)
+            can_preempt = (
+                cc.config.enable_pod_priority
+                and attempts.get(pod.key(), 0) < 1
+                and any(count > 0 and pri < pod_priority
+                        for pri, count in placed_priorities.items()))
+            if not can_preempt:
+                cc.update(pod, PodCondition(
+                    type="PodScheduled", status="False",
+                    reason="Unschedulable",
+                    message=format_fit_error(len(names), counts[j], strings)))
+                last_outcome = "failed"
+                continue
+
+            # host arm: per-node failure reasons (the device ships only the
+            # aggregate histogram), then the exact Preempt pipeline
+            try:
+                filtered, failed = cc.scheduler.find_nodes_that_fit(
+                    pod, cc.nodes, cc.node_info_map)
+            except SchedulingError as exc:
+                cc.update(pod, PodCondition(
+                    type="PodScheduled", status="False",
+                    reason="Unschedulable", message=str(exc)))
+                last_outcome = "failed"
+                continue
+            if filtered:
+                # device said infeasible, host disagrees — a parity bug; keep
+                # the run coherent by trusting the host engine
+                log.error("device/host disagreement for pod %s: host found %d "
+                          "feasible nodes; using host placement", pod.key(),
+                          len(filtered))
+                cc.scheduler.last_node_index = rr_start + int(np.sum(advanced[:j]))
+                host = cc.scheduler.schedule(pod, cc.nodes, cc.node_info_map)
+                rr_start = cc.scheduler.last_node_index
+                cc.bind(pod, host)
+                bound, _ = cc.resource_store.get(ResourceType.PODS, pod.key())
+                inc.apply(ADDED, bound)
+                placed_priorities[get_pod_priority(bound)] += 1
+                last_outcome = "bound"
+                remaining = remaining[j + 1:]
+                redispatch = bool(remaining)
+                break
+            fit_err = FitError(pod, len(cc.nodes), failed)
+            node, victims = cc.attempt_preemption(pod, fit_err)
+            if node is None:
+                cc.update(pod, PodCondition(
+                    type="PodScheduled", status="False",
+                    reason="Unschedulable", message=fit_err.error()))
+                last_outcome = "failed"
+                continue
+            for victim in victims:
+                inc.apply(EV_DELETED, victim)
+                placed_priorities[get_pod_priority(victim)] -= 1
+            attempts[pod.key()] = attempts.get(pod.key(), 0) + 1
+            rr_start += int(np.sum(advanced[:j]))
+            # scheduleOne retries the nominated pod immediately
+            # (simulator _schedule_one preempt_budget arm); every later
+            # decision was computed against pre-preemption state
+            remaining = remaining[j:]
+            redispatch = True
+            break
+        if not redispatch:
+            break
+
+    cc.status.stop_reason = cc.STOP_REASONS[last_outcome]
+    cc.close()
+    return cc.status

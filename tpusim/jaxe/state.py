@@ -24,6 +24,9 @@ from tpusim.api.types import (
     tolerations_tolerate_taint,
 )
 from tpusim.engine.predicates import (
+    _ZONE_LABELS,
+    DEFAULT_MAXPD_LIMITS,
+    effective_maxpd_limits,
     get_namespaces_from_pod_affinity_term,
     get_pod_affinity_terms,
     get_pod_anti_affinity_terms,
@@ -297,7 +300,7 @@ class CompiledCluster:
     has_disk_conflict: bool = False
     has_maxpd: bool = False
     has_vol_zone: bool = False
-    maxpd_limits: tuple = (39, 16, 16)   # (EBS, GCE PD, AzureDisk)
+    maxpd_limits: tuple = DEFAULT_MAXPD_LIMITS   # (EBS, GCE PD, AzureDisk)
     n_topo_doms: int = 1         # segment count for topo_dom (incl. invalid 0)
     n_zone_doms: int = 1
     unsupported: List[str] = field(default_factory=list)  # features needing fallback
@@ -410,8 +413,6 @@ class _VolumeFallback(Exception):
     budget; routes the batch to the parity engine."""
 
 
-_ZONE_LABELS = ("failure-domain.beta.kubernetes.io/zone",
-                "failure-domain.beta.kubernetes.io/region")
 _MAXPD_TYPES = ("EBS", "GCE", "AzureDisk")
 MAX_VOLUME_IDS = 4096
 
@@ -430,7 +431,6 @@ def _compile_volumes(raw_reps: List[Pod], nodes: List[Node],
 
     from tpusim.engine.predicates import (
         _VOLUME_FILTERS,
-        get_max_vols,
         is_volume_conflict,
         label_zones_to_set,
     )
@@ -547,7 +547,7 @@ def _compile_volumes(raw_reps: List[Pod], nodes: List[Node],
         for t in types:
             vol_type[vid, t] = True
     has_maxpd = v_count > 0
-    limits = (get_max_vols(39), get_max_vols(16), get_max_vols(16))
+    limits = effective_maxpd_limits()
 
     # --- NoVolumeZoneConflict: static (volume set, node) pass/fail ---
     zone_rows = np.ones((dv, n), dtype=bool)
@@ -611,7 +611,7 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
     incremental path) and vol_meta = (has_disk_conflict, has_maxpd,
     has_vol_zone, maxpd_limits)."""
     n = len(nodes)
-    no_vol_meta = (False, False, False, (39, 16, 16))
+    no_vol_meta = (False, False, False, DEFAULT_MAXPD_LIMITS)
     placed = [p for p in snapshot.pods if p.spec.node_name in node_index]
     # pods with an unknown-but-set nodeName still count for "matching pod
     # exists"; nodeName-less (pending) pods are dropped by the reference's pod
@@ -661,7 +661,7 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
         vsig_mask = np.zeros((1, 1), bool)
         vol_type = np.zeros((1, 3), bool)
         zone_rows = np.ones((1, n), bool)
-        maxpd_limits = (39, 16, 16)
+        maxpd_limits = DEFAULT_MAXPD_LIMITS
         has_disk = has_maxpd = has_zone = False
 
     # --- 2. intern matcher spaces: terms, port sets, spread signatures ---

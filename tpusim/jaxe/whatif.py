@@ -221,7 +221,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     @jax.jit
     def batched(carries, statics_b, xs_b):
         def one(carry, st, xs):
-            (final_carry, _), (choices, counts) = jax.lax.scan(
+            (final_carry, _), (choices, counts, _adv) = jax.lax.scan(
                 step, (carry, st), xs)
             return choices, counts
         return jax.vmap(one)(carries, statics_b, xs_b)

@@ -235,36 +235,8 @@ class ClusterCapacity:
                 since_in_microseconds(algo_start))
         except FitError as fit_err:
             if self.config.enable_pod_priority and preempt_budget > 0:
-                # scheduler.go:449-455: preemption attempt counter + duration
-                preemption_start = perf_counter()
-                metrics.preemption_attempts.inc()
-                try:
-                    node, victims, to_clear = self.scheduler.preempt(
-                        pod, self.nodes, self.node_info_map, fit_err)
-                except SchedulingError:
-                    # a failed preemption attempt (e.g. extender error) is
-                    # logged-and-dropped in the reference (scheduler.go:
-                    # 449-451); the pod still gets its Unschedulable condition
-                    node, victims, to_clear = None, [], []
-                metrics.preemption_evaluation.observe(
-                    since_in_microseconds(preemption_start))
-                metrics.preemption_victims.set(len(victims))
-                for p in to_clear:
-                    p.status.nominated_node_name = ""
+                node, _victims = self.attempt_preemption(pod, fit_err)
                 if node is not None:
-                    pod.status.nominated_node_name = node.name
-                    for victim in victims:
-                        self.resource_store.delete(ResourceType.PODS, victim)
-                        self.status.preempted_pods.append(victim)
-                        # an evicted pod is no longer placed: drop it from the
-                        # success/pre-scheduled buckets so the report balances
-                        key = victim.key()
-                        self.status.successful_pods = [
-                            p for p in self.status.successful_pods if p.key() != key]
-                        self.status.scheduled_pods = [
-                            p for p in self.status.scheduled_pods if p.key() != key]
-                        self.recorder.eventf(victim, "Normal", "Preempted",
-                                             "by %s on node %s", pod.name, node.name)
                     return self._schedule_one(pod, preempt_budget - 1)
             # scheduler.go:190-201 error arm -> PodConditionUpdater.Update
             self.update(pod, PodCondition(type="PodScheduled", status="False",
@@ -300,6 +272,47 @@ class ClusterCapacity:
         metrics.binding_latency.observe(since_in_microseconds(binding_start))
         metrics.e2e_scheduling_latency.observe(since_in_microseconds(e2e_start))
         return "bound"
+
+    def attempt_preemption(self, pod: Pod, fit_err: FitError):
+        """The preemption arm of scheduleOne (scheduler.go:449-455 → the full
+        Preempt pipeline, core/generic_scheduler.go:205-262): pick a node +
+        victims, delete the victims from the store (mutating the cache through
+        the DELETED events), and nominate the pod. Returns (node, victims) —
+        node is None when preemption found nothing. Shared by the host loop
+        (_schedule_one retry) and the jax backend's host-device hybrid
+        (tpusim/jaxe/preempt.py)."""
+        metrics = self.metrics
+        preemption_start = perf_counter()
+        metrics.preemption_attempts.inc()
+        try:
+            node, victims, to_clear = self.scheduler.preempt(
+                pod, self.nodes, self.node_info_map, fit_err)
+        except SchedulingError:
+            # a failed preemption attempt (e.g. extender error) is
+            # logged-and-dropped in the reference (scheduler.go:
+            # 449-451); the pod still gets its Unschedulable condition
+            node, victims, to_clear = None, [], []
+        metrics.preemption_evaluation.observe(
+            since_in_microseconds(preemption_start))
+        metrics.preemption_victims.set(len(victims))
+        for p in to_clear:
+            p.status.nominated_node_name = ""
+        if node is None:
+            return None, []
+        pod.status.nominated_node_name = node.name
+        for victim in victims:
+            self.resource_store.delete(ResourceType.PODS, victim)
+            self.status.preempted_pods.append(victim)
+            # an evicted pod is no longer placed: drop it from the
+            # success/pre-scheduled buckets so the report balances
+            key = victim.key()
+            self.status.successful_pods = [
+                p for p in self.status.successful_pods if p.key() != key]
+            self.status.scheduled_pods = [
+                p for p in self.status.scheduled_pods if p.key() != key]
+            self.recorder.eventf(victim, "Normal", "Preempted",
+                                 "by %s on node %s", pod.name, node.name)
+        return node, victims
 
     STOP_REASONS = {
         # Bind's deferred nextPod uses lowercase "fail", Update's uses "Fail"
@@ -346,15 +359,36 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    scheduler_name: str = DEFAULT_SCHEDULER_NAME,
                    batch_size: int = 0, enable_pod_priority: bool = False,
                    enable_volume_scheduling: bool = False,
-                   policy: Optional[Policy] = None) -> Status:
+                   policy: Optional[Policy] = None,
+                   events: Optional[list] = None) -> Status:
     """High-level entry: run `pods` (in podspec order; the LIFO feed reversal
     happens inside, matching the reference) against `snapshot` and return the
     final Status. backend='jax' routes the batch through the TPU engine and
     reconstructs the same Status/report shape; batch_size>0 selects the jax
-    backend's wavefront mode."""
+    backend's wavefront mode.
+
+    events: an optional [(ADDED|MODIFIED|DELETED, Pod|Node|Service), ...]
+    watch-event log (framework.events.load_event_log) replayed on top of
+    `snapshot` before scheduling — the reference's watch fabric
+    (restclient.go:218-236 → informer cache mutations) as data. On the jax
+    backend the replay drives the IncrementalCluster column caches
+    (jaxe/delta.py), so compiled state is patched, not rebuilt."""
     if policy is not None and backend != "reference":
         raise ValueError("scheduler policy configs (custom predicate/priority "
                          "sets, extenders) run on the reference backend")
+    incremental = None
+    if events:
+        from tpusim.jaxe.delta import IncrementalCluster
+
+        incremental = IncrementalCluster(snapshot)
+        incremental.apply_events(events)
+        folded = incremental.to_snapshot()
+        # PV/PVC/StorageClass events are not part of the watch-fabric analog;
+        # the seeded volume objects pass through unchanged
+        snapshot = ClusterSnapshot(
+            nodes=folded.nodes, pods=folded.pods, services=folded.services,
+            pvs=snapshot.pvs, pvcs=snapshot.pvcs,
+            storage_classes=snapshot.storage_classes)
     if backend == "reference":
         cc = ClusterCapacity(
             SchedulerServerConfig(scheduler_name=scheduler_name,
@@ -374,9 +408,20 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
             raise ValueError("--enable-volume-scheduling requires --backend "
                              "reference (delayed PV binding is stateful "
                              "host-side matching)")
+        if enable_pod_priority:
+            # host-device hybrid: device scan schedules, the exact host
+            # Preempt pipeline fires on failures (jaxe/preempt.py)
+            from tpusim.jaxe.preempt import run_with_preemption
+
+            return run_with_preemption(pods, snapshot, provider=provider,
+                                       batch_size=batch_size,
+                                       incremental=incremental)
         jax_backend = get_backend("jax", provider=provider, batch_size=batch_size)
         feed = list(reversed(pods))  # the LIFO queue pops the last element first
-        placements = jax_backend.schedule(feed, snapshot)
+        precompiled = (incremental.compile(feed) if incremental is not None
+                       and feed and snapshot.nodes else None)
+        placements = jax_backend.schedule(feed, snapshot,
+                                          precompiled=precompiled)
         status = Status(scheduled_pods=list(snapshot.pods))
         for placement in placements:
             if placement.scheduled:

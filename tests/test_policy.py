@@ -212,12 +212,14 @@ class TestCreateFromConfig:
         assert len(status.successful_pods) == 1
         assert status.successful_pods[0].spec.node_name == "n2"
 
-    def test_policy_requires_reference_backend(self):
+    def test_policy_runs_on_jax_backend(self):
+        # an empty Policy = DefaultProvider predicate/priority sets
+        # (CreateFromConfig's nil arms); it now compiles onto the device
         snapshot = ClusterSnapshot(nodes=[make_node("n", milli_cpu=1000,
                                                     memory=2**30)])
-        with pytest.raises(ValueError, match="reference backend"):
-            run_simulation([make_pod("p", milli_cpu=1, memory=1)], snapshot,
-                           backend="jax", policy=Policy())
+        status = run_simulation([make_pod("p", milli_cpu=1, memory=1)],
+                                snapshot, backend="jax", policy=Policy())
+        assert len(status.successful_pods) == 1
 
     def test_always_check_all_predicates_reports_all_failures(self):
         # a pod too big on CPU AND memory: with the flag, both reasons appear

@@ -74,7 +74,8 @@ class ReferenceBackend:
     def __init__(self, provider: str = DEFAULT_PROVIDER,
                  hard_pod_affinity_symmetric_weight: int = 10,
                  registry=None, always_check_all_predicates: bool = False,
-                 volume_scheduling_enabled: bool = False):
+                 volume_scheduling_enabled: bool = False, policy=None,
+                 extender_transport=None):
         self.provider = provider
         self.hard_pod_affinity_symmetric_weight = hard_pod_affinity_symmetric_weight
         self.registry = registry
@@ -82,6 +83,9 @@ class ReferenceBackend:
         # the VolumeScheduling feature gate (off by default, like the
         # reference's utilfeature defaults; scheduler.go:175)
         self.volume_scheduling_enabled = volume_scheduling_enabled
+        # policy-as-data (factory.go CreateFromConfig); replaces the provider
+        self.policy = policy
+        self.extender_transport = extender_transport
 
     def schedule(self, pods: List[Pod], snapshot: ClusterSnapshot) -> List[Placement]:
         from tpusim.engine.volume import VolumeBinder
@@ -105,9 +109,20 @@ class ReferenceBackend:
             volume_scheduling_enabled=self.volume_scheduling_enabled,
             hard_pod_affinity_symmetric_weight=self.hard_pod_affinity_symmetric_weight,
         )
-        scheduler = create_from_provider(
-            self.provider, args, registry=self.registry,
-            always_check_all_predicates=self.always_check_all_predicates)
+        if self.policy is not None:
+            from tpusim.engine.providers import create_from_config
+
+            scheduler = create_from_config(
+                self.policy, args, registry=self.registry,
+                extender_transport=self.extender_transport)
+            # the flag can only be switched ON, never off (CreateFromConfig)
+            scheduler.always_check_all_predicates = (
+                scheduler.always_check_all_predicates
+                or self.always_check_all_predicates)
+        else:
+            scheduler = create_from_provider(
+                self.provider, args, registry=self.registry,
+                always_check_all_predicates=self.always_check_all_predicates)
 
         placements: List[Placement] = []
         for pod in pods:

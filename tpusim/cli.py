@@ -220,13 +220,6 @@ def main(argv=None) -> int:
         except (OSError, PolicyError) as exc:
             print(f"error: invalid scheduler policy: {exc}", file=sys.stderr)
             return 2
-        if args.backend != "reference":
-            flag = ("--scheduler-policy-file" if args.scheduler_policy_file
-                    else "--scheduler-policy-configmap-file")
-            print(f"error: {flag} requires --backend reference "
-                  "(policies can add extenders and custom predicates that are "
-                  "not batched)", file=sys.stderr)
-            return 2
 
     if args.batch_size and args.backend != "jax":
         print("error: --batch-size requires --backend jax", file=sys.stderr)

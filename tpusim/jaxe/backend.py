@@ -85,10 +85,19 @@ class JaxBackend:
     name = "jax"
 
     def __init__(self, provider: str = DEFAULT_PROVIDER, fallback: str = "reference",
-                 hard_pod_affinity_symmetric_weight: int = 10, batch_size: int = 0):
+                 hard_pod_affinity_symmetric_weight: int = 10, batch_size: int = 0,
+                 policy=None, compiled_policy=None, extender_transport=None):
         """batch_size=0: exact sequential scan. batch_size=K>0: wavefront mode —
         waves of K pods against frozen snapshots (fast, approximate: pods in a
-        wave don't see each other's binds)."""
+        wave don't see each other's binds).
+
+        policy: an engine.policy.Policy compiled to static gating + weights
+        (jaxe.policyc) — replaces the provider's predicate/priority sets like
+        factory.go CreateFromConfig; host-bound policy features (extenders,
+        ServiceAffinity, ...) route through the fallback. compiled_policy: a
+        jaxe.policyc.CompiledPolicy for `policy`, if the caller already
+        compiled it. extender_transport: the in-process extender seam handed
+        to the reference fallback (policy extenders are host-bound)."""
         if provider not in _KNOWN_PROVIDERS:
             raise KeyError(f"plugin {provider!r} has not been registered")
         if fallback not in ("reference", "error"):
@@ -99,6 +108,14 @@ class JaxBackend:
         self.fallback = fallback
         self.hard_pod_affinity_symmetric_weight = hard_pod_affinity_symmetric_weight
         self.batch_size = batch_size
+        self.policy = policy
+        self.extender_transport = extender_transport
+        if policy is not None and compiled_policy is None:
+            # compile (and validate) at build time, like CreateFromConfig
+            from tpusim.jaxe.policyc import compile_policy
+
+            compiled_policy = compile_policy(policy)
+        self._compiled_policy = compiled_policy
 
     def schedule(self, pods: List[Pod], snapshot: ClusterSnapshot,
                  precompiled=None) -> List[Placement]:
@@ -113,28 +130,51 @@ class JaxBackend:
             return [Placement(pod=mark_unschedulable(p, msg),
                               reason="Unschedulable", message=msg) for p in pods]
 
+        cp = self._compiled_policy
         compiled, cols = precompiled or compile_cluster(snapshot, pods)
-        if compiled.unsupported:
-            detail = "; ".join(sorted(set(compiled.unsupported))[:5])
+        unsupported = list(compiled.unsupported)
+        if cp is not None:
+            unsupported.extend(cp.unsupported)
+        if unsupported:
+            detail = "; ".join(sorted(set(unsupported))[:5])
             if self.fallback == "error":
                 raise NotImplementedError(
                     f"jax backend does not yet carry state for: {detail}")
             log.warning("jax backend falling back to reference for: %s", detail)
             return ReferenceBackend(
-                provider=self.provider,
+                provider=self.provider, policy=self.policy,
+                extender_transport=self.extender_transport,
                 hard_pod_affinity_symmetric_weight=self.hard_pod_affinity_symmetric_weight,
             ).schedule(pods, snapshot)
 
+        hard_weight = self.hard_pod_affinity_symmetric_weight
+        if cp is not None and cp.hard_weight is not None:
+            hard_weight = cp.hard_weight
         num_bits = NUM_FIXED_BITS + len(compiled.scalar_names)
         config = config_for(
             [compiled],
             most_requested=self.provider in _MOST_REQUESTED_PROVIDERS,
             num_reason_bits=num_bits,
-            hard_weight=self.hard_pod_affinity_symmetric_weight)
+            hard_weight=hard_weight)
+        if cp is not None:
+            from dataclasses import replace as _dc_replace
+
+            config = _dc_replace(config, policy=cp.spec)
 
         ensure_x64()
         carry = carry_init(compiled)
-        statics = statics_to_device(compiled)
+        if cp is None:
+            statics = statics_to_device(compiled)
+        else:
+            # overwrite the trivial custom-plugin rows with the policy's
+            # per-node tables (ordering by the compiled node index)
+            from tpusim.jaxe.kernels import _tree_to_device, statics_to_host
+            from tpusim.jaxe.policyc import policy_static_rows
+
+            label_ok, label_prio = policy_static_rows(
+                cp, snapshot.nodes, compiled.node_index)
+            statics = _tree_to_device(statics_to_host(compiled)._replace(
+                label_ok=label_ok, label_prio=label_prio))
         xs = pod_columns_to_device(cols)
         # On TPU the per-pod filter→score→select→bind pipeline is one fused
         # device program, so the whole batch dispatch lands in the algorithm

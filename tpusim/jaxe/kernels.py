@@ -28,7 +28,28 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpusim.engine.predicates import DEFAULT_MAXPD_LIMITS
+from tpusim.engine.predicates import (
+    CHECK_NODE_DISK_PRESSURE_PRED,
+    CHECK_NODE_LABEL_PRESENCE_PRED,
+    CHECK_NODE_MEMORY_PRESSURE_PRED,
+    CHECK_NODE_UNSCHEDULABLE_PRED,
+    CHECK_SERVICE_AFFINITY_PRED,
+    CHECK_VOLUME_BINDING_PRED,
+    DEFAULT_MAXPD_LIMITS,
+    GENERAL_PRED,
+    HOSTNAME_PRED,
+    MATCH_INTERPOD_AFFINITY_PRED,
+    MATCH_NODE_SELECTOR_PRED,
+    MAX_AZURE_DISK_VOLUME_COUNT_PRED,
+    MAX_EBS_VOLUME_COUNT_PRED,
+    MAX_GCE_PD_VOLUME_COUNT_PRED,
+    NO_DISK_CONFLICT_PRED,
+    NO_VOLUME_ZONE_CONFLICT_PRED,
+    POD_FITS_HOST_PORTS_PRED,
+    POD_FITS_RESOURCES_PRED,
+    POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+    POD_TOLERATES_NODE_TAINTS_PRED,
+)
 from tpusim.engine.priorities import ZONE_WEIGHTING
 from tpusim.jaxe.state import (
     BIT_AFFINITY_NOT_MATCH,
@@ -47,6 +68,7 @@ from tpusim.jaxe.state import (
     BIT_INSUFFICIENT_MEMORY,
     BIT_INSUFFICIENT_PODS,
     BIT_MEMORY_PRESSURE,
+    BIT_NODE_LABEL_PRESENCE,
     BIT_NODE_SELECTOR_MISMATCH,
     BIT_TAINTS_NOT_TOLERATED,
     NUM_FIXED_BITS,
@@ -119,6 +141,17 @@ class Statics(NamedTuple):
     pref_w: jnp.ndarray
     pref_term: jnp.ndarray
     pref_key: jnp.ndarray
+    # policy-configured custom plugin rows (trivial when no policy):
+    #   label_ok   — [L, N] pass masks for the policy's label-presence
+    #                predicates; PolicySpec.label_rows names each row's
+    #                ordering slot (a custom registered under a standard
+    #                PREDICATES_ORDERING name evaluates at that position in
+    #                the host's _predicate_key_order; other names run after
+    #                the fixed ordering, folded into one tail row)
+    #   label_prio — pre-weighted sum of NodeLabel/LabelPreference priority
+    #                rows (node_label.go; no normalize pass)
+    label_ok: jnp.ndarray
+    label_prio: jnp.ndarray
 
 
 class PodX(NamedTuple):
@@ -142,6 +175,33 @@ class PodX(NamedTuple):
 
 
 @dataclass(frozen=True)
+class PolicySpec:
+    """Compile-time image of a scheduler Policy (api/types.go:52-77) for the
+    device engine: which standard predicates run and each score component's
+    weight. Built by jaxe.policyc.compile_policy; None on the provider paths
+    (= provider defaults). Hashable so EngineConfig stays a valid jit static.
+
+    pred_keys: frozenset of predicate names from PREDICATES_ORDERING that the
+    policy enables (customs are carried via the has_label_* flags + Statics
+    rows, not names). CheckNodeCondition runs regardless — it is mandatory
+    (build_predicates unions mandatory_fit_predicates)."""
+
+    pred_keys: frozenset
+    w_least: int = 0
+    w_most: int = 0
+    w_balanced: int = 0
+    w_node_aff: int = 0
+    w_taint: int = 0
+    w_avoid: int = 0           # NodePreferAvoidPodsPriority policy weight
+    w_spread: int = 0
+    w_interpod: int = 0
+    # one entry per Statics.label_ok row: the PREDICATES_ORDERING name whose
+    # slot the row evaluates at, or "" for the after-the-ordering tail row
+    label_rows: tuple = ()
+    has_label_prio: bool = False
+
+
+@dataclass(frozen=True)
 class EngineConfig:
     """Static (compile-time) provider configuration."""
 
@@ -162,6 +222,8 @@ class EngineConfig:
     # identical, amortizes per-step dispatch overhead at the cost of compile
     # time; tune via TPUSIM_SCAN_UNROLL (backend reads the env)
     scan_unroll: int = 1
+    # policy-as-data overrides (None = the named provider's defaults)
+    policy: PolicySpec = None
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +256,7 @@ STATICS_AXES = dict(
     anti_key=("group", "anti_term"), anti_hostname=("group", "anti_term"),
     pref_w=("group", "pref_term"), pref_term=("group", "pref_term"),
     pref_key=("group", "pref_term"),
+    label_ok=("label_pred", "node"), label_prio=("node",),
 )
 CARRY_AXES = dict(
     used_cpu=("node",), used_mem=("node",), used_gpu=("node",), used_eph=("node",),
@@ -267,7 +330,10 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         anti_valid=gt.anti_valid, anti_err=gt.anti_err,
         anti_empty=gt.anti_empty, anti_term=gt.anti_term,
         anti_key=gt.anti_key, anti_hostname=gt.anti_hostname,
-        pref_w=gt.pref_w, pref_term=gt.pref_term, pref_key=gt.pref_key)
+        pref_w=gt.pref_w, pref_term=gt.pref_term, pref_key=gt.pref_key,
+        # trivial policy rows; jaxe.policyc overwrites them via _replace
+        label_ok=np.ones((1, len(s.alloc_cpu)), dtype=bool),
+        label_prio=np.zeros(len(s.alloc_cpu), dtype=np.int64))
 
 
 def _presence_dom_init(presence: np.ndarray, topo_dom: np.ndarray,
@@ -353,89 +419,173 @@ def _seg_rows(values, doms, num_segments: int):
 def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     """Filter + score one pod against the carried aggregates.
 
-    Returns (feasible[N], reason_bits[N], score[N], n_feasible)."""
-    # ---- filter: staged fail masks in predicatesOrdering ----
-    fail_cond = st.cond_fail_bits != 0
+    Returns (feasible[N], reason_bits[N], score[N], n_feasible).
 
-    insuff_pods = (carry.pod_count + 1) > st.allowed_pods
-    check_res = ~x.zero_request
-    insuff_cpu = check_res & (st.alloc_cpu < x.req_cpu + carry.used_cpu)
-    insuff_mem = check_res & (st.alloc_mem < x.req_mem + carry.used_mem)
-    insuff_gpu = check_res & (st.alloc_gpu < x.req_gpu + carry.used_gpu)
-    insuff_eph = check_res & (st.alloc_eph < x.req_eph + carry.used_eph)
-    insuff_scalar = check_res[..., None] & (
-        st.alloc_scalar < x.req_scalar[None, :] + carry.used_scalar)
-    host_bad = ~st.host_ok[x.host_id]
-    sel_bad = ~st.selector_ok[x.sel_id]
-    fail_general = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
-                    | insuff_eph | jnp.any(insuff_scalar, axis=-1)
-                    | host_bad | sel_bad)
-    bits_general = (
-        insuff_pods.astype(jnp.int64) << BIT_INSUFFICIENT_PODS
-        | insuff_cpu.astype(jnp.int64) << BIT_INSUFFICIENT_CPU
-        | insuff_mem.astype(jnp.int64) << BIT_INSUFFICIENT_MEMORY
-        | insuff_gpu.astype(jnp.int64) << BIT_INSUFFICIENT_GPU
-        | insuff_eph.astype(jnp.int64) << BIT_INSUFFICIENT_EPHEMERAL
-        | host_bad.astype(jnp.int64) << BIT_HOSTNAME_MISMATCH
-        | sel_bad.astype(jnp.int64) << BIT_NODE_SELECTOR_MISMATCH)
-    if st.alloc_scalar.shape[-1] > 0:
-        scalar_bits = (insuff_scalar.astype(jnp.int64)
-                       << (NUM_FIXED_BITS + jnp.arange(st.alloc_scalar.shape[-1],
-                                                       dtype=jnp.int64)))
-        bits_general = bits_general | jnp.sum(scalar_bits, axis=-1)
-    if config.has_ports:
+    With config.policy set, stages/components are statically gated to the
+    policy's predicate set and priority weights (factory.go CreateFromConfig);
+    stage order always follows PREDICATES_ORDERING so first-failure reason
+    selection matches the host engine's short-circuit."""
+    ps = config.policy
+    en = ps.pred_keys if ps is not None else None
+
+    def on(name):
+        # None = the provider's default predicate set (the full pipeline)
+        return en is None or name in en
+
+    # ---- filter: staged fail masks in predicatesOrdering ----
+    # CheckNodeCondition is mandatory (build_predicates always unions it in);
+    # CheckNodeUnschedulable adds nothing on the device: the condition bits
+    # already carry spec.unschedulable and fail first with the same reason
+    fail_cond = st.cond_fail_bits != 0
+    stages = [(fail_cond, st.cond_fail_bits)]
+
+    # policy label-presence predicates evaluate at the ordering slot of the
+    # name they were registered under (the host's _predicate_key_order slots
+    # any custom key whose name appears in PREDICATES_ORDERING); "" = tail
+    label_at: dict = {}
+    if ps is not None:
+        for i, slot in enumerate(ps.label_rows):
+            label_at.setdefault(slot, []).append(i)
+
+    def emit_label(slot_name):
+        for i in label_at.get(slot_name, ()):
+            stages.append((~st.label_ok[i],
+                           jnp.int64(1) << BIT_NODE_LABEL_PRESENCE))
+
+    emit_label(CHECK_NODE_UNSCHEDULABLE_PRED)
+
+    general_on = on(GENERAL_PRED)
+    part_on = {name: en is not None and name in en
+               for name in (HOSTNAME_PRED, POD_FITS_HOST_PORTS_PRED,
+                            MATCH_NODE_SELECTOR_PRED, POD_FITS_RESOURCES_PRED)}
+
+    if general_on or part_on[POD_FITS_RESOURCES_PRED]:
+        insuff_pods = (carry.pod_count + 1) > st.allowed_pods
+        check_res = ~x.zero_request
+        insuff_cpu = check_res & (st.alloc_cpu < x.req_cpu + carry.used_cpu)
+        insuff_mem = check_res & (st.alloc_mem < x.req_mem + carry.used_mem)
+        insuff_gpu = check_res & (st.alloc_gpu < x.req_gpu + carry.used_gpu)
+        insuff_eph = check_res & (st.alloc_eph < x.req_eph + carry.used_eph)
+        insuff_scalar = check_res[..., None] & (
+            st.alloc_scalar < x.req_scalar[None, :] + carry.used_scalar)
+        fail_res = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
+                    | insuff_eph | jnp.any(insuff_scalar, axis=-1))
+        bits_res = (
+            insuff_pods.astype(jnp.int64) << BIT_INSUFFICIENT_PODS
+            | insuff_cpu.astype(jnp.int64) << BIT_INSUFFICIENT_CPU
+            | insuff_mem.astype(jnp.int64) << BIT_INSUFFICIENT_MEMORY
+            | insuff_gpu.astype(jnp.int64) << BIT_INSUFFICIENT_GPU
+            | insuff_eph.astype(jnp.int64) << BIT_INSUFFICIENT_EPHEMERAL)
+        if st.alloc_scalar.shape[-1] > 0:
+            scalar_bits = (insuff_scalar.astype(jnp.int64)
+                           << (NUM_FIXED_BITS + jnp.arange(
+                               st.alloc_scalar.shape[-1], dtype=jnp.int64)))
+            bits_res = bits_res | jnp.sum(scalar_bits, axis=-1)
+    if general_on or part_on[HOSTNAME_PRED]:
+        host_bad = ~st.host_ok[x.host_id]
+    if general_on or part_on[MATCH_NODE_SELECTOR_PRED]:
+        sel_bad = ~st.selector_ok[x.sel_id]
+    if config.has_ports and (general_on or part_on[POD_FITS_HOST_PORTS_PRED]):
         # PodFitsHostPorts (predicates.go:1019-1039), part of GeneralPredicates:
         # a wanted port of my group conflicts with occupancy of any group
         # present; conflict is factored through interned port-set ids
         conflict_row = st.port_conflict[st.port_sig[x.group_id]][st.port_sig]
         port_bad = jnp.any(conflict_row[:, None] & (carry.presence > 0), axis=0)
-        fail_general = fail_general | port_bad
-        bits_general = bits_general | (
-            port_bad.astype(jnp.int64) << BIT_HOST_PORTS)
 
-    if config.has_disk_conflict:
+    if general_on:
+        fail_general = fail_res | host_bad | sel_bad
+        bits_general = (
+            bits_res
+            | host_bad.astype(jnp.int64) << BIT_HOSTNAME_MISMATCH
+            | sel_bad.astype(jnp.int64) << BIT_NODE_SELECTOR_MISMATCH)
+        if config.has_ports:
+            fail_general = fail_general | port_bad
+            bits_general = bits_general | (
+                port_bad.astype(jnp.int64) << BIT_HOST_PORTS)
+        stages.append((fail_general, bits_general))
+    emit_label(GENERAL_PRED)
+    # individually-named parts run as separate short-circuit stages in the
+    # ordering slots HostName → PodFitsHostPorts → MatchNodeSelector →
+    # PodFitsResources (predicates.go:130-136)
+    if part_on[HOSTNAME_PRED]:
+        stages.append((host_bad, jnp.int64(1) << BIT_HOSTNAME_MISMATCH))
+    emit_label(HOSTNAME_PRED)
+    if part_on[POD_FITS_HOST_PORTS_PRED] and config.has_ports:
+        stages.append((port_bad, jnp.int64(1) << BIT_HOST_PORTS))
+    emit_label(POD_FITS_HOST_PORTS_PRED)
+    if part_on[MATCH_NODE_SELECTOR_PRED]:
+        stages.append((sel_bad, jnp.int64(1) << BIT_NODE_SELECTOR_MISMATCH))
+    emit_label(MATCH_NODE_SELECTOR_PRED)
+    if part_on[POD_FITS_RESOURCES_PRED]:
+        stages.append((fail_res, bits_res))
+    emit_label(POD_FITS_RESOURCES_PRED)
+
+    if config.has_disk_conflict and on(NO_DISK_CONFLICT_PRED):
         # NoDiskConflict (predicates.go:266-276): my volume set conflicts with
-        # the volume set of any group present on the node; runs after
-        # GeneralPredicates/PodFitsResources in predicatesOrdering
+        # the volume set of any group present on the node
         disk_row = st.disk_conflict[st.disk_sig[x.group_id]][st.disk_sig]
         fail_disk = jnp.any(disk_row[:, None] & (carry.presence > 0), axis=0)
-    else:
-        fail_disk = jnp.zeros_like(fail_cond)
+        stages.append((fail_disk, jnp.int64(1) << BIT_DISK_CONFLICT))
+    emit_label(NO_DISK_CONFLICT_PRED)
 
-    fail_taint = ~st.taint_ok[x.tol_id]
+    if on(POD_TOLERATES_NODE_TAINTS_PRED):
+        stages.append((~st.taint_ok[x.tol_id],
+                       jnp.int64(1) << BIT_TAINTS_NOT_TOLERATED))
+    emit_label(POD_TOLERATES_NODE_TAINTS_PRED)
+    emit_label(POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED)
+    emit_label(CHECK_NODE_LABEL_PRESENCE_PRED)
+    emit_label(CHECK_SERVICE_AFFINITY_PRED)
 
-    if config.has_maxpd:
+    maxpd_on = (on(MAX_EBS_VOLUME_COUNT_PRED), on(MAX_GCE_PD_VOLUME_COUNT_PRED),
+                on(MAX_AZURE_DISK_VOLUME_COUNT_PRED))
+    if config.has_maxpd and any(maxpd_on):
         # Max{EBS,GCEPD,AzureDisk}VolumeCount (predicates.go:422-460): unique
         # relevant volume ids on the node incl. mine vs the per-type limit;
-        # a pod adding no relevant volumes passes regardless
+        # a pod adding no relevant volumes passes regardless. Disabled types
+        # get an unreachable limit.
         mask_g = st.vol_mask[x.group_id]                       # [V]
         type_i = st.vol_type.astype(jnp.int32)                 # [V, 3]
         union_counts = (carry.used_vols | mask_g[None, :]).astype(jnp.int32) @ type_i
         my_counts = mask_g.astype(jnp.int32) @ type_i          # [3]
-        limits = jnp.array(config.maxpd_limits, dtype=jnp.int32)
+        limits = jnp.array(
+            [lim if enabled else (1 << 30)
+             for lim, enabled in zip(config.maxpd_limits, maxpd_on)],
+            dtype=jnp.int32)
         fail_maxpd = jnp.any((my_counts[None, :] > 0)
                              & (union_counts > limits[None, :]), axis=1)
-    else:
-        fail_maxpd = jnp.zeros_like(fail_cond)
+        stages.append((fail_maxpd, jnp.int64(1) << BIT_MAX_VOLUME_COUNT))
+    emit_label(MAX_EBS_VOLUME_COUNT_PRED)
+    emit_label(MAX_GCE_PD_VOLUME_COUNT_PRED)
+    emit_label(MAX_AZURE_DISK_VOLUME_COUNT_PRED)
+    emit_label(CHECK_VOLUME_BINDING_PRED)
 
-    if config.has_vol_zone:
+    if config.has_vol_zone and on(NO_VOLUME_ZONE_CONFLICT_PRED):
         # NoVolumeZoneConflict (predicates.go:510-533): static per
         # (volume-set, node) — bound PV zone labels vs node zone labels
-        fail_zone = ~st.zone_ok[x.group_id]
-    else:
-        fail_zone = jnp.zeros_like(fail_cond)
+        stages.append((~st.zone_ok[x.group_id],
+                       jnp.int64(1) << BIT_VOLUME_ZONE_CONFLICT))
+    emit_label(NO_VOLUME_ZONE_CONFLICT_PRED)
 
-    fail_mem_pressure = st.mem_pressure & x.best_effort
-    fail_disk_pressure = st.disk_pressure
+    if on(CHECK_NODE_MEMORY_PRESSURE_PRED):
+        stages.append((st.mem_pressure & x.best_effort,
+                       jnp.int64(1) << BIT_MEMORY_PRESSURE))
+    emit_label(CHECK_NODE_MEMORY_PRESSURE_PRED)
+    if on(CHECK_NODE_DISK_PRESSURE_PRED):
+        stages.append((st.disk_pressure, jnp.int64(1) << BIT_DISK_PRESSURE))
+    emit_label(CHECK_NODE_DISK_PRESSURE_PRED)
 
     if config.has_interpod:
-        # MatchInterPodAffinity (predicates.go:1125-1450) — last in
-        # predicatesOrdering. Group-space matching is precompiled; here only
-        # presence/topology aggregation runs.
+        # shared prelude for the MatchInterPodAffinity predicate and the
+        # InterPodAffinityPriority score block
         g = x.group_id
         presence_f = carry.presence.astype(jnp.float64)
         pd_f = carry.presence_dom.astype(jnp.float64)
         k_count = st.topo_dom.shape[0]
+
+    if config.has_interpod and on(MATCH_INTERPOD_AFFINITY_PRED):
+        # MatchInterPodAffinity (predicates.go:1125-1450) — last in
+        # predicatesOrdering. Group-space matching is precompiled; here only
+        # presence/topology aggregation runs.
 
         # own required affinity terms (_satisfies_pods_affinity_anti_affinity)
         mcount = st.term_match[st.aff_term[g]].astype(jnp.float64) @ presence_f  # [Ta, N]
@@ -487,56 +637,79 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
             exist_fail, jnp.int64(1) << BIT_EXISTING_ANTI_AFFINITY,
             jnp.where(aff_fail, jnp.int64(1) << BIT_AFFINITY_RULES,
                       jnp.int64(1) << BIT_ANTI_AFFINITY_RULES))
-    else:
-        fail_interpod = jnp.zeros_like(fail_cond)
-        interpod_bits = jnp.int64(0)
+        stages.append((fail_interpod, interpod_bits))
+    emit_label(MATCH_INTERPOD_AFFINITY_PRED)
+    # label-presence predicates under non-ordering names run after the fixed
+    # ordering (the host appends custom keys alphabetically at the end)
+    emit_label("")
 
-    feasible = ~(fail_cond | fail_general | fail_disk | fail_taint
-                 | fail_maxpd | fail_zone
-                 | fail_mem_pressure | fail_disk_pressure | fail_interpod)
-    # short-circuit reason selection in predicatesOrdering: first failing
-    # stage wins (general incl. ports -> NoDiskConflict -> taints -> MaxPD ->
-    # NoVolumeZone -> pressure -> inter-pod)
-    stages = [
-        (fail_cond, st.cond_fail_bits),
-        (fail_general, bits_general),
-        (fail_disk, jnp.int64(1) << BIT_DISK_CONFLICT),
-        (fail_taint, jnp.int64(1) << BIT_TAINTS_NOT_TOLERATED),
-        (fail_maxpd, jnp.int64(1) << BIT_MAX_VOLUME_COUNT),
-        (fail_zone, jnp.int64(1) << BIT_VOLUME_ZONE_CONFLICT),
-        (fail_mem_pressure, jnp.int64(1) << BIT_MEMORY_PRESSURE),
-        (fail_disk_pressure, jnp.int64(1) << BIT_DISK_PRESSURE),
-        (fail_interpod, interpod_bits),
-    ]
+    fail_any = stages[0][0]
+    for fail, _ in stages[1:]:
+        fail_any = fail_any | fail
+    feasible = ~fail_any
+    # short-circuit reason selection: first failing stage wins
     reason_bits = jnp.int64(0)
     for fail, bits in reversed(stages):
         reason_bits = jnp.where(fail, bits, reason_bits)
     n_feasible = jnp.sum(feasible)
 
-    # ---- score ----
-    total_cpu = x.nz_cpu + carry.nonzero_cpu
-    total_mem = x.nz_mem + carry.nonzero_mem
-    ratio = (_ratio_score(total_cpu, st.alloc_cpu, config.most_requested)
-             + _ratio_score(total_mem, st.alloc_mem, config.most_requested)) // 2
-    balanced = _balanced_score(total_cpu, total_mem, st.alloc_cpu, st.alloc_mem)
+    # ---- score (weighted sum, generic_scheduler.go:631-639) ----
+    if ps is None:
+        w_least, w_most = (0, 1) if config.most_requested else (1, 0)
+        w_balanced = w_node_aff = w_taint = w_spread = w_interpod = 1
+        w_avoid = AVOID_PODS_WEIGHT
+        label_prio_on = False
+    else:
+        w_least, w_most = ps.w_least, ps.w_most
+        w_balanced, w_node_aff = ps.w_balanced, ps.w_node_aff
+        w_taint, w_avoid = ps.w_taint, ps.w_avoid
+        w_spread, w_interpod = ps.w_spread, ps.w_interpod
+        label_prio_on = ps.has_label_prio
 
-    # NodeAffinityPriority: NormalizeReduce(10, False) over feasible nodes
-    aff = st.affinity_count[x.aff_id]
-    aff_max = jnp.max(jnp.where(feasible, aff, 0))
-    aff_norm = jnp.where(aff_max > 0, MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
+    score = jnp.zeros_like(st.alloc_cpu)
+    if w_least or w_most or w_balanced:
+        total_cpu = x.nz_cpu + carry.nonzero_cpu
+        total_mem = x.nz_mem + carry.nonzero_mem
+    if w_least:
+        # least_requested.go:41-52
+        score = score + w_least * (
+            (_ratio_score(total_cpu, st.alloc_cpu, False)
+             + _ratio_score(total_mem, st.alloc_mem, False)) // 2)
+    if w_most:
+        # most_requested.go:44-55
+        score = score + w_most * (
+            (_ratio_score(total_cpu, st.alloc_cpu, True)
+             + _ratio_score(total_mem, st.alloc_mem, True)) // 2)
+    if w_balanced:
+        score = score + w_balanced * _balanced_score(
+            total_cpu, total_mem, st.alloc_cpu, st.alloc_mem)
 
-    # TaintTolerationPriority: NormalizeReduce(10, True) over feasible nodes
-    intol = st.intolerable[x.tol_id]
-    intol_max = jnp.max(jnp.where(feasible, intol, 0))
-    taint_norm = jnp.where(
-        intol_max > 0,
-        MAX_PRIORITY - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
-        MAX_PRIORITY)
+    if w_node_aff:
+        # NodeAffinityPriority: NormalizeReduce(10, False) over feasible nodes
+        aff = st.affinity_count[x.aff_id]
+        aff_max = jnp.max(jnp.where(feasible, aff, 0))
+        aff_norm = jnp.where(
+            aff_max > 0, MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
+        score = score + w_node_aff * aff_norm
 
-    avoid = st.avoid_score[x.avoid_id] * AVOID_PODS_WEIGHT
-    score = ratio + balanced + aff_norm + taint_norm + avoid
+    if w_taint:
+        # TaintTolerationPriority: NormalizeReduce(10, True) over feasible nodes
+        intol = st.intolerable[x.tol_id]
+        intol_max = jnp.max(jnp.where(feasible, intol, 0))
+        taint_norm = jnp.where(
+            intol_max > 0,
+            MAX_PRIORITY - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
+            MAX_PRIORITY)
+        score = score + w_taint * taint_norm
 
-    if config.has_services:
+    if w_avoid:
+        score = score + st.avoid_score[x.avoid_id] * w_avoid
+
+    if label_prio_on:
+        # NodeLabel/LabelPreference priorities: static pre-weighted rows
+        score = score + st.label_prio
+
+    if config.has_services and w_spread:
         # SelectorSpreadPriority (selector_spreading.go:66-175): per-node count
         # of same-namespace pods matched by my services' selectors, then the
         # node/zone-blended normalize over feasible nodes
@@ -559,9 +732,9 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         blended = jnp.where(
             have_zones & zvalid,
             fscore * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zscore, fscore)
-        score = score + blended.astype(jnp.int64)
+        score = score + blended.astype(jnp.int64) * w_spread
 
-    if config.has_interpod:
+    if config.has_interpod and w_interpod:
         # InterPodAffinityPriority (interpod_affinity.go:118+): float64 counts
         # from (a) my preferred terms over existing pods, (b) existing pods'
         # preferred terms over me, (c) their required affinity × hard weight;
@@ -589,7 +762,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         minc = jnp.minimum(jnp.min(jnp.where(feasible, counts, jnp.inf)), 0.0)
         rng = maxc - minc
         ip = jnp.where(rng > 0, MAX_PRIORITY * ((counts - minc) / rng), 0.0)
-        score = score + ip.astype(jnp.int64)
+        score = score + ip.astype(jnp.int64) * w_interpod
 
     return feasible, reason_bits, score, n_feasible
 

@@ -1,0 +1,246 @@
+"""Compile a scheduler Policy (api/types.go:52-77) for the device engine.
+
+Mirrors factory.go CreateFromConfig:933-1000 + plugins.go
+RegisterCustomFitPredicate:197-240 / RegisterCustomPriorityFunction:302-348,
+but instead of assembling host predicate/priority closures it produces:
+
+  * a kernels.PolicySpec — static predicate gating + score-component weights
+    baked into the jitted program (EngineConfig.policy), and
+  * per-node static rows for the policy's custom plugins
+    (CheckNodeLabelPresence masks, NodeLabel priority scores) that overwrite
+    the trivial rows in Statics.
+
+Host-bound policy features have no device encoding and fall back to the
+reference engine (the same containment as volume workloads): extenders (HTTP
+round-trips mid-filter), ServiceAffinity / ServiceAntiAffinity (label-
+consistency state over live placements), AlwaysCheckAllPredicates (the device
+reason histogram is first-failure-only), PodToleratesNodeNoExecuteTaints (a
+narrower taint filter than the compiled taint table), and ImageLocality /
+CheckServiceAffinity referenced by name. Unknown names raise the host
+registry's KeyError byte-for-byte."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from tpusim.engine import predicates as preds
+from tpusim.engine.policy import Policy, validate_policy
+from tpusim.engine.priorities import MAX_PRIORITY
+from tpusim.jaxe.kernels import AVOID_PODS_WEIGHT, PolicySpec
+
+# standard predicates the device evaluates natively, by registry name
+COMPILABLE_PREDS = frozenset({
+    preds.CHECK_NODE_CONDITION_PRED, preds.CHECK_NODE_UNSCHEDULABLE_PRED,
+    preds.GENERAL_PRED, preds.HOSTNAME_PRED, preds.POD_FITS_HOST_PORTS_PRED,
+    preds.MATCH_NODE_SELECTOR_PRED, preds.POD_FITS_RESOURCES_PRED,
+    preds.NO_DISK_CONFLICT_PRED, preds.POD_TOLERATES_NODE_TAINTS_PRED,
+    preds.MAX_EBS_VOLUME_COUNT_PRED, preds.MAX_GCE_PD_VOLUME_COUNT_PRED,
+    preds.MAX_AZURE_DISK_VOLUME_COUNT_PRED,
+    # CheckVolumeBinding is a pass with the VolumeScheduling gate off
+    # (predicates.go:1586), which is the jax backend's only mode
+    preds.CHECK_VOLUME_BINDING_PRED,
+    preds.NO_VOLUME_ZONE_CONFLICT_PRED,
+    preds.CHECK_NODE_MEMORY_PRESSURE_PRED, preds.CHECK_NODE_DISK_PRESSURE_PRED,
+    preds.MATCH_INTERPOD_AFFINITY_PRED,
+})
+# registered in the host registry but with no device encoding
+HOST_ONLY_PREDS = frozenset({preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED})
+
+# priority name -> PolicySpec weight field (EqualPriority adds the same
+# constant to every node, so it cannot change the argmax or the tie set)
+_WEIGHT_FIELDS: Dict[str, str] = {
+    "LeastRequestedPriority": "w_least",
+    "MostRequestedPriority": "w_most",
+    "BalancedResourceAllocation": "w_balanced",
+    "NodeAffinityPriority": "w_node_aff",
+    "TaintTolerationPriority": "w_taint",
+    "NodePreferAvoidPodsPriority": "w_avoid",
+    "SelectorSpreadPriority": "w_spread",
+    "InterPodAffinityPriority": "w_interpod",
+}
+COMPILABLE_PRIOS = frozenset(_WEIGHT_FIELDS) | {"EqualPriority"}
+HOST_ONLY_PRIOS = frozenset({"ImageLocalityPriority"})
+
+# the DefaultProvider weight set (defaults.go:219-259); policies that omit
+# `priorities` inherit it (CreateFromConfig → DefaultProvider keys)
+_DEFAULT_WEIGHTS = dict(w_least=1, w_most=0, w_balanced=1, w_node_aff=1,
+                        w_taint=1, w_avoid=AVOID_PODS_WEIGHT, w_spread=1,
+                        w_interpod=1)
+
+
+@dataclass
+class CompiledPolicy:
+    spec: PolicySpec
+    # policy HardPodAffinitySymmetricWeight override; None = keep config value
+    # (CreateFromConfig treats 0 as unset, providers.py:415-417)
+    hard_weight: int = None
+    # custom label-presence predicate rows, parallel to spec.label_rows: each
+    # (ordering slot name or "" for tail, [(labels, presence), ...] folded
+    # into that row)
+    label_rows: List[Tuple[str, list]] = field(default_factory=list)
+    # custom label priorities: (label, presence, weight)
+    label_prios: List[Tuple[str, bool, int]] = field(default_factory=list)
+    # host-bound features forcing the reference fallback (empty = compilable)
+    unsupported: List[str] = field(default_factory=list)
+
+
+def compile_policy(policy: Policy) -> CompiledPolicy:
+    """Raises PolicyError/KeyError exactly like the host assembly; returns a
+    CompiledPolicy whose `unsupported` lists any host-bound feature."""
+    validate_policy(policy)
+    unsupported: List[str] = []
+    if policy.extender_configs:
+        unsupported.append("policy extenders (HTTP round-trips mid-filter)")
+    if policy.always_check_all_predicates:
+        unsupported.append("alwaysCheckAllPredicates (multi-reason histogram)")
+
+    # Both registries key plugins by NAME and a later registration under the
+    # same name overwrites the earlier one, while the key set dedups
+    # (plugins.go RegisterCustomFitPredicate/RegisterCustomPriorityFunction +
+    # the {register_...} set comprehension in providers.create_from_config) —
+    # so duplicates resolve last-wins here too.
+    label_rows: List[Tuple[str, list]] = []
+    if policy.predicates is None:
+        pred_keys = None
+    else:
+        pred_by_name: Dict[str, tuple] = {}
+        for pp in policy.predicates:
+            arg = pp.argument
+            if arg is not None and arg.service_affinity is not None:
+                pred_by_name[pp.name] = ("unsupported",
+                                         f"ServiceAffinity predicate {pp.name!r} "
+                                         "(label-consistency state over live "
+                                         "placements)")
+            elif arg is not None and arg.labels_presence is not None:
+                pred_by_name[pp.name] = (
+                    "label", (tuple(arg.labels_presence.labels),
+                              bool(arg.labels_presence.presence)))
+            elif pp.name in HOST_ONLY_PREDS:
+                pred_by_name[pp.name] = (
+                    "unsupported", f"predicate {pp.name!r} (host-only)")
+            elif pp.name in COMPILABLE_PREDS:
+                pred_by_name[pp.name] = ("standard",)
+            else:
+                # plugins.go RegisterCustomFitPredicate's failure, byte-matched
+                raise KeyError("Invalid configuration: Predicate type not "
+                               f"found for {pp.name}")
+        pred_keys = set()
+        slotted: Dict[str, list] = {}
+        tail_entries: list = []
+        for name, entry in pred_by_name.items():
+            if entry[0] == "standard":
+                pred_keys.add(name)
+            elif entry[0] == "label":
+                # the host registers the custom under the policy's name: a
+                # name appearing in PREDICATES_ORDERING evaluates at that
+                # slot (generic_scheduler.py _predicate_key_order), any other
+                # name runs after the fixed ordering
+                if name == preds.CHECK_NODE_CONDITION_PRED:
+                    # would REPLACE the mandatory condition predicate the
+                    # device always evaluates — host-bound edge
+                    unsupported.append(
+                        "label predicate replacing the mandatory "
+                        "CheckNodeCondition")
+                elif name in preds.PREDICATES_ORDERING:
+                    slotted[name] = [entry[1]]
+                else:
+                    tail_entries.append(entry[1])
+            else:
+                unsupported.append(entry[1])
+        for name in preds.PREDICATES_ORDERING:
+            if name in slotted:
+                label_rows.append((name, slotted[name]))
+        if tail_entries:
+            label_rows.append(("", tail_entries))
+
+    weights = dict(_DEFAULT_WEIGHTS)
+    label_prios: List[Tuple[str, bool, int]] = []
+    if policy.priorities is not None:
+        weights = dict.fromkeys(weights, 0)
+        prio_by_name: Dict[str, tuple] = {}
+        for pr in policy.priorities:
+            arg = pr.argument
+            if arg is not None and arg.service_anti_affinity is not None:
+                prio_by_name[pr.name] = (
+                    "unsupported", f"ServiceAntiAffinity priority {pr.name!r} "
+                    "(label-group spreading over live placements)")
+            elif arg is not None and arg.label_preference is not None:
+                prio_by_name[pr.name] = (
+                    "label", (arg.label_preference.label,
+                              bool(arg.label_preference.presence), pr.weight))
+            elif pr.name in HOST_ONLY_PRIOS:
+                prio_by_name[pr.name] = (
+                    "unsupported", f"priority {pr.name!r} (host-only)")
+            elif pr.name in _WEIGHT_FIELDS:
+                # referencing a pre-registered priority takes the POLICY's
+                # weight (plugins.go:302-348 → PriorityConfigFactory.weight)
+                prio_by_name[pr.name] = ("weight", _WEIGHT_FIELDS[pr.name],
+                                         pr.weight)
+            elif pr.name == "EqualPriority":
+                prio_by_name[pr.name] = ("equal",)
+            else:
+                raise KeyError("Invalid configuration: Priority type not "
+                               f"found for {pr.name}")
+        for entry in prio_by_name.values():
+            if entry[0] == "weight":
+                weights[entry[1]] = entry[2]
+            elif entry[0] == "label":
+                label_prios.append(entry[1])
+            elif entry[0] == "unsupported":
+                unsupported.append(entry[1])
+            # "equal": constant shift; no effect on selection or ties
+
+    spec = PolicySpec(
+        pred_keys=frozenset(pred_keys) if pred_keys is not None else None,
+        label_rows=tuple(slot for slot, _ in label_rows),
+        has_label_prio=bool(label_prios),
+        **weights)
+    hard = (policy.hard_pod_affinity_symmetric_weight
+            if policy.hard_pod_affinity_symmetric_weight != 0 else None)
+    return CompiledPolicy(spec=spec, hard_weight=hard,
+                          label_rows=label_rows,
+                          label_prios=label_prios, unsupported=unsupported)
+
+
+def _label_pred_row(nodes_by_idx: list, entries) -> np.ndarray:
+    """Folded per-node pass mask for a list of label-presence predicates
+    (predicates.go NewNodeLabelPredicate: every label's existence must equal
+    `presence`)."""
+    n = len(nodes_by_idx)
+    row = np.ones(n, dtype=bool)
+    for labels, presence in entries:
+        for i, node in enumerate(nodes_by_idx):
+            node_labels = node.metadata.labels
+            for label in labels:
+                if (label in node_labels) != presence:
+                    row[i] = False
+                    break
+    return row
+
+
+def policy_static_rows(cp: CompiledPolicy, nodes,
+                       node_index: Dict[str, int]):
+    """(label_ok[L, N], label_prio[N]) in compiled node order, rows parallel
+    to spec.label_rows. `nodes` is the snapshot node list; node_index the
+    compiled order."""
+    n = len(node_index)
+    by_idx: list = [None] * n
+    for node in nodes:
+        i = node_index.get(node.name)
+        if i is not None:
+            by_idx[i] = node
+    if cp.label_rows:
+        label_ok = np.stack([_label_pred_row(by_idx, entries)
+                             for _, entries in cp.label_rows])
+    else:
+        label_ok = np.ones((1, n), dtype=bool)
+    prio = np.zeros(n, dtype=np.int64)
+    for label, presence, weight in cp.label_prios:
+        for i, node in enumerate(by_idx):
+            exists = label in node.metadata.labels
+            if exists == presence:
+                prio[i] += weight * MAX_PRIORITY
+    return label_ok, prio

@@ -71,7 +71,8 @@ BIT_ANTI_AFFINITY_RULES = 18
 BIT_DISK_CONFLICT = 19          # NoDiskConflict (error.go ErrDiskConflict)
 BIT_MAX_VOLUME_COUNT = 20       # MaxPDVolumeCount
 BIT_VOLUME_ZONE_CONFLICT = 21   # NoVolumeZoneConflict
-NUM_FIXED_BITS = 22
+BIT_NODE_LABEL_PRESENCE = 22    # CheckNodeLabelPresence (policy-configured)
+NUM_FIXED_BITS = 23
 # bits >= NUM_FIXED_BITS: Insufficient <scalar resource s>, per interned name
 
 REASON_STRINGS = [
@@ -97,6 +98,7 @@ REASON_STRINGS = [
     "node(s) had no available disk",
     "node(s) exceed max volume count",
     "node(s) had no available volume zone",
+    "node(s) didn't have the requested labels",
 ]
 
 # Pod-group budgets (env-overridable). Groups are merged by match profile and

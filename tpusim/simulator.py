@@ -373,9 +373,26 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
     (restclient.go:218-236 → informer cache mutations) as data. On the jax
     backend the replay drives the IncrementalCluster column caches
     (jaxe/delta.py), so compiled state is patched, not rebuilt."""
-    if policy is not None and backend != "reference":
-        raise ValueError("scheduler policy configs (custom predicate/priority "
-                         "sets, extenders) run on the reference backend")
+    compiled_policy = None
+    if policy is not None and backend == "jax":
+        # compile (and validate) the policy for the device engine; host-bound
+        # features (extenders, ServiceAffinity/ServiceAntiAffinity, always-
+        # check-all) route to the reference orchestrator, which has the full
+        # plugin registry and the in-process extender seam
+        import logging
+
+        from tpusim.jaxe.policyc import compile_policy
+
+        compiled_policy = compile_policy(policy)
+        if compiled_policy.unsupported or enable_pod_priority:
+            reason = ("preemption with a policy scheduler"
+                      if not compiled_policy.unsupported else
+                      "; ".join(sorted(set(compiled_policy.unsupported))[:5]))
+            logging.getLogger(__name__).warning(
+                "policy is host-bound (%s): running the reference "
+                "orchestrator instead of the jax backend%s", reason,
+                "; --batch-size is ignored" if batch_size else "")
+            backend = "reference"
     incremental = None
     if events:
         from tpusim.jaxe.delta import IncrementalCluster
@@ -416,7 +433,9 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
             return run_with_preemption(pods, snapshot, provider=provider,
                                        batch_size=batch_size,
                                        incremental=incremental)
-        jax_backend = get_backend("jax", provider=provider, batch_size=batch_size)
+        jax_backend = get_backend("jax", provider=provider,
+                                  batch_size=batch_size, policy=policy,
+                                  compiled_policy=compiled_policy)
         feed = list(reversed(pods))  # the LIFO queue pops the last element first
         precompiled = (incremental.compile(feed) if incremental is not None
                        and feed and snapshot.nodes else None)

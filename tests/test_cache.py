@@ -1,0 +1,190 @@
+"""SchedulerCache lifecycle tests — assume/finishBinding/forget/confirm/TTL
+expiry and generation-based snapshots.
+
+Reference: schedulercache/cache.go (AssumePod:125, expiry:434-470, snapshot
+:83-97) and its table-driven cache_test.go (TestAssumePodScheduled,
+TestExpirePod, TestAddPodWillConfirm, TestForgetPod, ...)."""
+
+import pytest
+
+from tpusim.api.snapshot import make_node, make_pod
+from tpusim.engine.cache import CacheError, SchedulerCache
+from tpusim.simulator import ClusterCapacity, SchedulerServerConfig
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def new_cache(ttl=30.0):
+    clock = Clock()
+    cache = SchedulerCache(ttl=ttl, now=clock)
+    cache.add_node(make_node("n1", milli_cpu=4000))
+    return cache, clock
+
+
+def bound_pod(name, milli_cpu=500, node="n1"):
+    return make_pod(name, milli_cpu=milli_cpu, node_name=node)
+
+
+def test_assume_pod_counts_immediately():
+    cache, _ = new_cache()
+    cache.assume_pod(bound_pod("p", 700))
+    info = cache.nodes["n1"]
+    assert info.requested_resource.milli_cpu == 700
+    assert len(info.pods) == 1
+    assert cache.is_assumed_pod(bound_pod("p"))
+
+
+def test_assume_twice_errors():
+    cache, _ = new_cache()
+    cache.assume_pod(bound_pod("p"))
+    with pytest.raises(CacheError, match="can't be assumed"):
+        cache.assume_pod(bound_pod("p"))
+
+
+def test_expire_after_finish_binding_ttl():
+    cache, clock = new_cache(ttl=30.0)
+    pod = bound_pod("p", 700)
+    cache.assume_pod(pod)
+    cache.finish_binding(pod)
+    clock.t = 29.0
+    assert cache.cleanup_assumed_pods() == 0
+    clock.t = 31.0
+    assert cache.cleanup_assumed_pods() == 1
+    assert "p" not in [p.name for i in cache.nodes.values() for p in i.pods]
+    assert cache.nodes["n1"].requested_resource.milli_cpu == 0
+
+
+def test_no_expiry_before_binding_finished():
+    # TestExpirePod's not-yet-finished case: without FinishBinding the
+    # deadline is unarmed and the pod never expires
+    cache, clock = new_cache(ttl=30.0)
+    cache.assume_pod(bound_pod("p"))
+    clock.t = 1e6
+    assert cache.cleanup_assumed_pods() == 0
+    assert cache.is_assumed_pod(bound_pod("p"))
+
+
+def test_add_pod_confirms_and_survives_expiry():
+    # TestAddPodWillConfirm: a confirmed pod never expires
+    cache, clock = new_cache(ttl=30.0)
+    pod = bound_pod("p", 700)
+    cache.assume_pod(pod)
+    cache.finish_binding(pod)
+    cache.add_pod(bound_pod("p", 700))
+    clock.t = 1e6
+    assert cache.cleanup_assumed_pods() == 0
+    assert not cache.is_assumed_pod(pod)
+    assert cache.nodes["n1"].requested_resource.milli_cpu == 700
+
+
+def test_add_pod_confirm_moves_to_actual_node():
+    # the apiserver bound the pod elsewhere: accounting moves with it
+    cache, _ = new_cache()
+    cache.add_node(make_node("n2", milli_cpu=4000))
+    cache.assume_pod(bound_pod("p", 700, node="n1"))
+    cache.add_pod(bound_pod("p", 700, node="n2"))
+    assert cache.nodes["n1"].requested_resource.milli_cpu == 0
+    assert cache.nodes["n2"].requested_resource.milli_cpu == 700
+
+
+def test_forget_pod_returns_resources():
+    cache, _ = new_cache()
+    pod = bound_pod("p", 700)
+    cache.assume_pod(pod)
+    cache.forget_pod(pod)
+    assert cache.nodes["n1"].requested_resource.milli_cpu == 0
+    assert not cache.pod_states
+
+
+def test_forget_confirmed_pod_errors():
+    cache, _ = new_cache()
+    cache.add_pod(bound_pod("p"))
+    with pytest.raises(CacheError, match="assumed"):
+        cache.forget_pod(bound_pod("p"))
+
+
+def test_update_assumed_pod_errors():
+    cache, _ = new_cache()
+    cache.assume_pod(bound_pod("p"))
+    with pytest.raises(CacheError, match="should not be updated"):
+        cache.update_pod(bound_pod("p"), bound_pod("p", 900))
+
+
+def test_expired_pod_can_be_readded():
+    # cache.go:243-246: an Add arriving after expiry re-inserts the pod
+    cache, clock = new_cache(ttl=30.0)
+    pod = bound_pod("p", 700)
+    cache.assume_pod(pod)
+    cache.finish_binding(pod)
+    clock.t = 31.0
+    cache.cleanup_assumed_pods()
+    cache.add_pod(pod)
+    assert cache.nodes["n1"].requested_resource.milli_cpu == 700
+    assert not cache.is_assumed_pod(pod)
+
+
+def test_generation_snapshot_clones_only_changed_nodes():
+    cache, _ = new_cache()
+    cache.add_node(make_node("n2", milli_cpu=4000))
+    snap = cache.update_node_name_to_info_map({})
+    n1_before, n2_before = snap["n1"], snap["n2"]
+    # mutating a snapshot clone must not touch the live cache (and its bumped
+    # generation makes the next refresh re-clone that entry)
+    snap["n2"].add_pod(bound_pod("ghost", node="n2"))
+    assert not cache.nodes["n2"].pods
+    cache.add_pod(bound_pod("p", 700, node="n2"))
+    snap = cache.update_node_name_to_info_map(snap)
+    assert snap["n2"] is not n2_before          # generation moved: re-cloned
+    assert snap["n1"] is n1_before              # untouched: same object
+    assert snap["n2"].requested_resource.milli_cpu == 700
+    cache.remove_pod(bound_pod("p", 700, node="n2"))
+    cache.remove_node(make_node("n2"))
+    snap = cache.update_node_name_to_info_map(snap)
+    assert "n2" not in snap and "n1" in snap
+
+
+def test_remove_node_with_pods_keeps_entry_until_empty():
+    # cache.go:329-345: a deleted node's entry survives while pods remain
+    cache, _ = new_cache()
+    cache.add_pod(bound_pod("p", 700))
+    cache.remove_node(make_node("n1"))
+    assert "n1" in cache.nodes and cache.nodes["n1"].node is None
+    cache.remove_pod(bound_pod("p", 700))
+    assert "n1" not in cache.nodes
+
+
+def test_cluster_capacity_confirms_assumed_pods_synchronously():
+    """End-to-end: after a run, nothing is left assumed and the cache view
+    matches the placements (the synchronous Bind confirms via the store's
+    Modified event)."""
+    nodes = [make_node(f"n{i}", milli_cpu=2000) for i in range(3)]
+    pods = [make_pod(f"p{i}", milli_cpu=600) for i in range(6)]
+    cc = ClusterCapacity(SchedulerServerConfig(), pods, [], nodes)
+    cc.run()
+    assert len(cc.status.successful_pods) == 6
+    assert not cc.cache.assumed_pods
+    total = sum(i.requested_resource.milli_cpu for i in cc.cache.nodes.values())
+    assert total == 6 * 600
+    # the snapshot view agrees with the live view
+    snap = cc.refresh_node_info_snapshot()
+    assert {n: i.generation for n, i in snap.items()} == \
+        {n: i.generation for n, i in cc.cache.nodes.items()}
+
+
+def test_duplicate_pod_key_fails_gracefully():
+    """A fed pod colliding with an already-cached key is reported failed
+    (the assume error arm, scheduler.go:377-380), not a crashed run."""
+    node = make_node("n1", milli_cpu=4000)
+    placed = make_pod("dup", milli_cpu=100, node_name="n1", phase="Running")
+    again = make_pod("dup", milli_cpu=100)
+    cc = ClusterCapacity(SchedulerServerConfig(), [again], [placed], [node])
+    cc.run()
+    assert [p.name for p in cc.status.failed_pods] == ["dup"]
+    assert "can't be assumed" in cc.status.failed_pods[0].status.conditions[-1].message
+    assert cc.status.stop_reason

@@ -7,6 +7,8 @@ algorithm/priorities/util/non_zero.go (non-zero request defaults).
 
 from __future__ import annotations
 
+import itertools
+
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -192,6 +194,17 @@ class HostPortInfo:
         return h
 
 
+_generation_counter = itertools.count(1)
+
+
+def _next_generation() -> int:
+    """Globally monotonic NodeInfo generation. A shared counter (instead of
+    per-instance increments) makes generations unique across instances, so a
+    mutated snapshot clone can never collide with the live cache entry in
+    SchedulerCache.update_node_name_to_info_map's equality check."""
+    return next(_generation_counter)
+
+
 class NodeInfo:
     """Aggregated per-node scheduling state.
 
@@ -224,7 +237,7 @@ class NodeInfo:
             c.type == "MemoryPressure" and c.status == "True" for c in node.status.conditions)
         self.disk_pressure = any(
             c.type == "DiskPressure" and c.status == "True" for c in node.status.conditions)
-        self.generation += 1
+        self.generation = _next_generation()
 
     def remove_node(self) -> None:
         self.node = None
@@ -232,7 +245,7 @@ class NodeInfo:
         self.taints = []
         self.memory_pressure = False
         self.disk_pressure = False
-        self.generation += 1
+        self.generation = _next_generation()
 
     def add_pod(self, pod: Pod) -> None:
         res = get_resource_request(pod)
@@ -243,7 +256,7 @@ class NodeInfo:
         self.pods.append(pod)
         for port in get_container_ports(pod):
             self.used_ports.add(port.host_ip, port.protocol, port.host_port)
-        self.generation += 1
+        self.generation = _next_generation()
 
     def remove_pod(self, pod: Pod) -> None:
         key = pod.key()
@@ -260,7 +273,7 @@ class NodeInfo:
         self.nonzero_request.memory -= non0.memory
         for port in get_container_ports(pod):
             self.used_ports.remove(port.host_ip, port.protocol, port.host_port)
-        self.generation += 1
+        self.generation = _next_generation()
 
     # --- views ---
 

@@ -241,10 +241,13 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                 continue
 
             # host arm: per-node failure reasons (the device ships only the
-            # aggregate histogram), then the exact Preempt pipeline
+            # aggregate histogram), then the exact Preempt pipeline — both
+            # against the cache's generation-checked snapshot, like the host
+            # engine's g.cachedNodeInfoMap
+            node_infos = cc.refresh_node_info_snapshot()
             try:
                 filtered, failed = cc.scheduler.find_nodes_that_fit(
-                    pod, cc.nodes, cc.node_info_map)
+                    pod, cc.nodes, node_infos)
             except SchedulingError as exc:
                 cc.update(pod, PodCondition(
                     type="PodScheduled", status="False",
@@ -258,7 +261,7 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                           "feasible nodes; using host placement", pod.key(),
                           len(filtered))
                 cc.scheduler.last_node_index = rr_start + int(np.sum(advanced[:j]))
-                host = cc.scheduler.schedule(pod, cc.nodes, cc.node_info_map)
+                host = cc.scheduler.schedule(pod, cc.nodes, node_infos)
                 rr_start = cc.scheduler.last_node_index
                 cc.bind(pod, host)
                 bound, _ = cc.resource_store.get(ResourceType.PODS, pod.key())

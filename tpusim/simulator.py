@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.api.types import Node, Pod, PodCondition, ResourceType
+from tpusim.engine.cache import CacheError, SchedulerCache
 from tpusim.engine.equivalence import EquivalenceCache
 from tpusim.engine.generic_scheduler import FitError, GenericScheduler, SchedulingError
 from tpusim.engine.queue import new_scheduling_queue
@@ -79,9 +80,11 @@ class ClusterCapacity:
         self.recorder = Recorder(10)
 
         # --- the scheduler cache, maintained by store event handlers exactly
-        # like factory.go's informer handlers (factory.go:139-299) ---
-        self.node_info_map: Dict[str, NodeInfo] = {}
-        self._bound_keys: set = set()
+        # like factory.go's informer handlers (factory.go:139-299); carries
+        # the assumed-pod lifecycle + generation-based snapshots
+        # (schedulercache/cache.go, engine/cache.py) ---
+        self.cache = SchedulerCache()
+        self._cached_node_infos: Dict[str, NodeInfo] = {}
         self.resource_store.register_event_handler(ResourceType.PODS, self._on_pod_event)
         self.resource_store.register_event_handler(ResourceType.NODES, self._on_node_event)
 
@@ -146,17 +149,28 @@ class ClusterCapacity:
 
     # --- cache event handlers ---
 
+    @property
+    def node_info_map(self) -> Dict[str, NodeInfo]:
+        """The cache's live per-node view (schedulerCache.nodes)."""
+        return self.cache.nodes
+
+    def refresh_node_info_snapshot(self) -> Dict[str, NodeInfo]:
+        """Expire overdue assumed pods, then refresh the generation-checked
+        snapshot the algorithm runs against (generic_scheduler.go:129 →
+        cache.go UpdateNodeNameToInfoMap:83-97)."""
+        self.cache.cleanup_assumed_pods()
+        return self.cache.update_node_name_to_info_map(self._cached_node_infos)
+
     def _on_pod_event(self, event: str, pod: Pod) -> None:
         if event in (ADDED, MODIFIED) and pod.spec.node_name:
-            if pod.key() not in self._bound_keys:
-                self._bound_keys.add(pod.key())
-                self.node_info_map.setdefault(pod.spec.node_name, NodeInfo()).add_pod(pod)
+            # a bound pod confirms its assumed entry; re-delivered Modified
+            # events for an already-confirmed pod are ignored by the cache
+            if self.cache.is_assumed_pod(pod) \
+                    or pod.key() not in self.cache.pod_states:
+                self.cache.add_pod(pod)
                 self._invalidate_ecache_for_node(pod.spec.node_name)
-        elif event == DELETED and pod.key() in self._bound_keys:
-            self._bound_keys.discard(pod.key())
-            info = self.node_info_map.get(pod.spec.node_name)
-            if info is not None:
-                info.remove_pod(pod)
+        elif event == DELETED and pod.key() in self.cache.pod_states:
+            self.cache.remove_pod(pod)
             self._invalidate_ecache_for_node(pod.spec.node_name)
 
     def _invalidate_ecache_for_node(self, node_name: str) -> None:
@@ -169,7 +183,10 @@ class ClusterCapacity:
             scheduler.equivalence_cache.invalidate_all_on_node(node_name)
 
     def _on_node_event(self, event: str, node: Node) -> None:
-        self.node_info_map.setdefault(node.name, NodeInfo()).set_node(node)
+        if event == DELETED:
+            self.cache.remove_node(node)
+        else:
+            self.cache.add_node(node)
         self._invalidate_ecache_for_node(node.name)
 
     # --- the two seams (simulator.go:108-185) ---
@@ -229,8 +246,11 @@ class ClusterCapacity:
         preemption is not recorded in FailedPods."""
         metrics = self.metrics
         e2e_start = algo_start = perf_counter()
+        # the algorithm runs against the cache's generation-checked snapshot,
+        # not the live view (generic_scheduler.go:129)
+        node_infos = self.refresh_node_info_snapshot()
         try:
-            host = self.scheduler.schedule(pod, self.nodes, self.node_info_map)
+            host = self.scheduler.schedule(pod, self.nodes, node_infos)
             metrics.scheduling_algorithm_latency.observe(
                 since_in_microseconds(algo_start))
         except FitError as fit_err:
@@ -266,9 +286,30 @@ class ClusterCapacity:
                         preds.NO_VOLUME_ZONE_CONFLICT_PRED,
                         preds.CHECK_VOLUME_BINDING_PRED,
                     ])
+        # assume (scheduler.go:366-398 → cache.AssumePod): later pods see the
+        # placement immediately; the synchronous Bind's store event confirms it
+        assumed = pod.copy()
+        assumed.spec.node_name = host
+        try:
+            self.cache.assume_pod(assumed)
+        except CacheError as cache_err:
+            # assume error arm (scheduler.go:377-380 → config.Error): the pod
+            # is reported failed, the run continues — e.g. a fed pod whose
+            # namespace/name collides with an already-cached pod
+            self.update(pod, PodCondition(type="PodScheduled", status="False",
+                                          reason="Unschedulable",
+                                          message=str(cache_err)))
+            return "failed"
         # binding latency + e2e (scheduler.go:425,492)
         binding_start = perf_counter()
-        self.bind(pod, host)
+        try:
+            self.bind(pod, host)
+        except SchedulingError:
+            # bind error arm (scheduler.go:484-496): forget the assumed pod
+            # so its resources are returned, then surface the error
+            self.cache.forget_pod(assumed)
+            raise
+        self.cache.finish_binding(assumed)  # no-op once confirmed
         metrics.binding_latency.observe(since_in_microseconds(binding_start))
         metrics.e2e_scheduling_latency.observe(since_in_microseconds(e2e_start))
         return "bound"
@@ -285,8 +326,10 @@ class ClusterCapacity:
         preemption_start = perf_counter()
         metrics.preemption_attempts.inc()
         try:
+            # Preempt runs against the same cached snapshot the failed
+            # Schedule used (g.cachedNodeInfoMap, generic_scheduler.go:205)
             node, victims, to_clear = self.scheduler.preempt(
-                pod, self.nodes, self.node_info_map, fit_err)
+                pod, self.nodes, self._cached_node_infos, fit_err)
         except SchedulingError:
             # a failed preemption attempt (e.g. extender error) is
             # logged-and-dropped in the reference (scheduler.go:

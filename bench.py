@@ -6,21 +6,34 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N}
 vs_baseline = jax rate / reference-loop rate on the same workload shape (>1 is
 faster). Details go to stderr. Never exits non-zero: on failure the JSON line
-carries an "error" field instead (the TPU tunnel here can hang indefinitely
-inside backend init, so all jax work runs in timeout-guarded subprocesses with
-bounded retries and a CPU fallback).
+carries an "error" field instead.
 
-Workload: BASELINE.md config 3 — mixed Zipf-sized pods onto heterogeneous
-nodes (with a taint/toleration slice), exact sequential semantics.
+Robustness model (the TPU tunnel here can wedge INSIDE backend init, with the
+GIL held, at any attempt — including after a successful probe): the
+measurement runs in a child process whose stderr is streamed through a stall
+watchdog; no output for TPUSIM_BENCH_STALL_TIMEOUT seconds kills the child
+and retries (bounded), then falls back to a CPU-sized run. The child prints a
+JSON line after EACH completed stage (small → headline), so a later hang
+still leaves the best completed result on stdout — the parent takes the last
+JSON line, even from a killed child.
+
+Workloads (BASELINE.md config ladder): the headline is config 3 — 100k mixed
+Zipf-sized pods onto 5k heterogeneous nodes (taints/tolerations slice), exact
+sequential semantics. `python bench.py --ladder` measures all five configs
+(20-pod quickstart; 1k uniform/100; 100k Zipf/5k; 1M/10k with
+taints+affinity via the chunked donated scan; 50×20k batched what-if) and
+prints one JSON line per config plus a summary line.
 
 Env knobs: TPUSIM_BENCH_PODS (default 100000), TPUSIM_BENCH_NODES (5000),
 TPUSIM_BENCH_BASELINE_PODS (200), TPUSIM_BENCH_BATCH (0 = exact scan),
-TPUSIM_BENCH_PROBE_TIMEOUT (150s), TPUSIM_BENCH_RUN_TIMEOUT (2400s),
-TPUSIM_BENCH_CPU_PODS/_NODES (smaller shape used on the CPU fallback).
+TPUSIM_BENCH_STALL_TIMEOUT (240s), TPUSIM_BENCH_RUN_TIMEOUT (2400s),
+TPUSIM_BENCH_RETRIES (2), TPUSIM_BENCH_CPU_PODS/_NODES (CPU-fallback shape),
+TPUSIM_BENCH_CHUNK (65536; chunked-scan chunk length), TPUSIM_SCAN_UNROLL.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import subprocess
@@ -35,13 +48,16 @@ def log(msg: str) -> None:
 
 
 # --------------------------------------------------------------------------
-# workload
+# workloads (BASELINE.md config ladder)
 # --------------------------------------------------------------------------
 
-def build_workload(num_pods: int, num_nodes: int):
+def build_workload(num_pods: int, num_nodes: int, affinity: bool = False,
+                   seed: int = 12345):
+    """Config-3 shape: heterogeneous nodes (taint slice, zone labels) + Zipf
+    pods; affinity=True adds the config-4 node-affinity slice."""
     from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
 
-    rng = np.random.RandomState(12345)
+    rng = np.random.RandomState(seed)
     nodes = []
     for i in range(num_nodes):
         shape = i % 3
@@ -53,7 +69,6 @@ def build_workload(num_pods: int, num_nodes: int):
         nodes.append(make_node(f"node-{i}", milli_cpu=milli_cpu, memory=memory,
                                pods=110, labels={"zone": f"z{i % 4}"}, taints=taints))
 
-    # Zipf-ish request sizes over discrete buckets
     cpu_buckets = np.array([50, 100, 250, 500, 1000, 2000, 4000])
     mem_buckets = np.array([64, 128, 256, 512, 1024, 2048, 4096]) * 2**20
     weights = 1.0 / np.arange(1, len(cpu_buckets) + 1) ** 1.1
@@ -61,6 +76,7 @@ def build_workload(num_pods: int, num_nodes: int):
     cpu_idx = rng.choice(len(cpu_buckets), size=num_pods, p=weights)
     mem_idx = rng.choice(len(mem_buckets), size=num_pods, p=weights)
     tolerate = rng.rand(num_pods) < 0.1
+    want_zone = rng.randint(0, 8, size=num_pods) if affinity else None
 
     pods = []
     for i in range(num_pods):
@@ -68,27 +84,189 @@ def build_workload(num_pods: int, num_nodes: int):
         if tolerate[i]:
             kwargs["tolerations"] = [{"key": "dedicated", "operator": "Equal",
                                       "value": "batch", "effect": "NoSchedule"}]
+        if affinity and want_zone[i] < 4:
+            # config 4: half the pods pin a zone via required node affinity
+            kwargs["affinity"] = {"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [
+                        {"key": "zone", "operator": "In",
+                         "values": [f"z{want_zone[i]}"]}]}]}}}
         pods.append(make_pod(f"p-{i}", milli_cpu=int(cpu_buckets[cpu_idx[i]]),
                              memory=int(mem_buckets[mem_idx[i]]), **kwargs))
     return ClusterSnapshot(nodes=nodes), pods
 
 
+def uniform_workload(num_pods: int, num_nodes: int):
+    from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+
+    nodes = [make_node(f"node-{i}", milli_cpu=4000, memory=16 * 1024**3)
+             for i in range(num_nodes)]
+    pods = [make_pod(f"p-{i}", milli_cpu=1000, memory=1 * 2**30)
+            for i in range(num_pods)]
+    return ClusterSnapshot(nodes=nodes), pods
+
+
 # --------------------------------------------------------------------------
-# child: the actual measurement (runs inside a timeout-guarded subprocess)
+# child: the measurements (inside the watchdogged subprocess)
 # --------------------------------------------------------------------------
 
-def run_child(platform: str) -> None:
+def _prepare(snapshot, pods, provider_most_requested=False, to_device=True):
+    """to_device=False keeps the pod columns host-side — the chunked scan
+    uploads them chunk by chunk, so the full [P]-row PodX never lands in HBM
+    at once (the point of the donated chunk loop)."""
+    from tpusim.jaxe.kernels import (
+        carry_init,
+        config_for,
+        pod_columns_to_device,
+        pod_columns_to_host,
+        statics_to_device,
+    )
+    from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster
+
+    t0 = time.perf_counter()
+    compiled, cols = compile_cluster(snapshot, pods)
+    log(f"  host compile (intern+tables): {time.perf_counter() - t0:.1f}s")
+    config = config_for(
+        [compiled], most_requested=provider_most_requested,
+        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+    carry = carry_init(compiled)
+    statics = statics_to_device(compiled)
+    xs = (pod_columns_to_device(cols) if to_device
+          else pod_columns_to_host(cols))
+    return compiled, config, carry, statics, xs
+
+
+def _run_once(config, carry, statics, xs, batch: int, chunk: int):
+    """One full scheduling pass; returns (choices np, checksum int, counts).
+
+    The checksum is a device-side reduction fetched as a host scalar: fetching
+    it provably forces the whole computation (choices feeds the sum), unlike
+    block_until_ready on the axon runtime, which has been observed returning
+    early. Batches longer than `chunk` run through the donated-carry chunked
+    scan (bounded HBM churn, progress logging)."""
+    import jax.numpy as jnp
+
+    from tpusim.jaxe.kernels import (
+        PodX,
+        pad_infeasible_rows,
+        schedule_scan,
+        schedule_scan_donated,
+        schedule_wavefront,
+    )
+
+    p = int(xs.req_cpu.shape[0])
+    if batch > 0:
+        _, choices, counts, _ = schedule_wavefront(config, carry, statics, xs, batch)
+    elif chunk and p > chunk:
+        xs_host = xs  # host columns (measure_config keeps big batches on host)
+        pad = (-p) % chunk
+        if pad:
+            xs_host = pad_infeasible_rows(xs_host, pad)
+        num_chunks = (p + pad) // chunk
+        choice_parts, count_parts = [], []
+        t0 = time.perf_counter()
+        for ci in range(num_chunks):
+            sl = slice(ci * chunk, (ci + 1) * chunk)
+            xs_c = PodX(*(jnp.asarray(a[sl]) for a in xs_host))
+            carry, ch, cnt, _ = schedule_scan_donated(config, carry, statics, xs_c)
+            choice_parts.append(np.asarray(ch))   # forces this chunk
+            count_parts.append(cnt)
+            done = min((ci + 1) * chunk, p)
+            log(f"  chunk {ci + 1}/{num_chunks}: {done}/{p} pods "
+                f"({time.perf_counter() - t0:.1f}s)")
+        choices = np.concatenate(choice_parts)[:p]
+        counts = np.concatenate([np.asarray(c) for c in count_parts])[:p]
+        return choices, int(np.sum(np.where(choices >= 0, choices, -1))), counts
+    else:
+        _, choices, counts, _ = schedule_scan(config, carry, statics, xs)
+    checksum = int(jnp.sum(jnp.where(choices >= 0, choices, -1)))
+    return np.asarray(choices), checksum, np.asarray(counts)
+
+
+def measure_config(name: str, snapshot, pods, platform: str, batch: int,
+                   baseline_pods: int, chunk: int, timed_runs: int = 3):
+    """Measure one ladder config; returns the result dict."""
+    from tpusim.backends import ReferenceBackend
+    from tpusim.jaxe.kernels import carry_init
+
+    num_pods, num_nodes = len(pods), len(snapshot.nodes)
+    log(f"[{name}] {num_pods} pods x {num_nodes} nodes")
+
+    ref_rate = None
+    mismatches = None
+    sub = min(baseline_pods, num_pods)
+    if sub:
+        t0 = time.perf_counter()
+        ref_placements = ReferenceBackend().schedule(pods[:sub], snapshot)
+        ref_elapsed = max(time.perf_counter() - t0, 1e-9)
+        ref_rate = sub / ref_elapsed
+        log(f"  reference loop: {sub} pods in {ref_elapsed:.1f}s "
+            f"= {ref_rate:.1f} pods/s")
+
+    use_chunks = batch == 0 and chunk and num_pods > chunk
+    compiled, config, carry, statics, xs = _prepare(snapshot, pods,
+                                                    to_device=not use_chunks)
+    if compiled.unsupported:
+        return {"metric": f"{name} (unsupported: {compiled.unsupported})",
+                "value": 0, "unit": "pods/s", "vs_baseline": 0}
+
+    t0 = time.perf_counter()
+    choices, checksum, counts = _run_once(config, carry, statics, xs, batch, chunk)
+    cold = time.perf_counter() - t0
+    log(f"  device cold (incl XLA compile): {cold:.1f}s (checksum={checksum})")
+
+    warm_times = []
+    drift = False
+    for _ in range(timed_runs):
+        carry = carry_init(compiled)  # fresh carry (the donated one is gone)
+        t0 = time.perf_counter()
+        choices, cs, counts = _run_once(config, carry, statics, xs, batch, chunk)
+        warm_times.append(time.perf_counter() - t0)
+        if cs != checksum:
+            drift = True
+            log(f"  WARNING: checksum drift {checksum} -> {cs}")
+    warm = float(np.median(warm_times))
+    rate = num_pods / warm
+    scheduled = int(np.sum(choices >= 0))
+    phash = hashlib.sha256(choices.tobytes()).hexdigest()[:16]
+    log(f"  device warm (median of {[f'{t:.3f}' for t in warm_times]}): "
+        f"{num_pods} pods in {warm:.2f}s = {rate:.0f} pods/s "
+        f"({scheduled} scheduled, {num_pods - scheduled} unschedulable) "
+        f"placement_hash={phash}")
+
+    if sub:
+        names = compiled.statics.names
+        mismatches = sum(
+            1 for i in range(sub)
+            if (names[choices[i]] if choices[i] >= 0 else "")
+            != ref_placements[i].node_name)
+        log(f"  parity check on first {sub} pods: {mismatches} mismatches")
+
+    mode = "exact scan" if batch == 0 else f"wavefront K={batch}"
+    result = {
+        "metric": f"scheduled pods/sec ({name}, {mode}, platform={platform}"
+                  + (f", parity_mismatches={mismatches}" if mismatches is not None else "")
+                  + f", placement_hash={phash})",
+        "value": round(rate, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(rate / ref_rate, 2) if ref_rate else 0,
+    }
+    if drift:
+        result["error"] = "checksum drift across timed runs; rate unreliable"
+    return result
+
+
+def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
     num_pods = int(os.environ.get("TPUSIM_BENCH_PODS", 100_000))
     num_nodes = int(os.environ.get("TPUSIM_BENCH_NODES", 5_000))
     if platform == "cpu":
-        # smaller default shape on the fallback so the run fits the timeout;
-        # explicit env overrides win
         num_pods = int(os.environ.get("TPUSIM_BENCH_CPU_PODS",
                                       os.environ.get("TPUSIM_BENCH_PODS", 20_000)))
         num_nodes = int(os.environ.get("TPUSIM_BENCH_CPU_NODES",
                                        os.environ.get("TPUSIM_BENCH_NODES", 2_000)))
     baseline_pods = int(os.environ.get("TPUSIM_BENCH_BASELINE_PODS", 200))
     batch = int(os.environ.get("TPUSIM_BENCH_BATCH", 0))
+    chunk = int(os.environ.get("TPUSIM_BENCH_CHUNK", 65536))
 
     import jax
 
@@ -97,221 +275,361 @@ def run_child(platform: str) -> None:
         # the JAX_PLATFORMS env var; pin via jax.config instead.
         jax.config.update("jax_platforms", "cpu")
 
-    from tpusim.backends import ReferenceBackend
     from tpusim.jaxe import ensure_x64
-    from tpusim.jaxe.kernels import (
-        config_for,
-        carry_init,
-        pod_columns_to_device,
-        schedule_scan,
-        schedule_wavefront,
-        statics_to_device,
-    )
-    from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster
 
     ensure_x64()
+    log("initializing backend...")
     devices = jax.devices()
     real_platform = devices[0].platform
     log(f"devices: {devices}")
-    log(f"workload: {num_pods} pods x {num_nodes} nodes "
-        f"({'exact scan' if batch == 0 else f'wavefront K={batch}'})")
 
-    t0 = time.perf_counter()
+    if phases:
+        run_phases(real_platform, chunk)
+        return
+    if ladder:
+        run_ladder(real_platform, batch, baseline_pods, chunk)
+        return
+
+    # stage 1: a small same-shape run — completes fast, leaves a valid JSON
+    # line on stdout even if the full-size run later wedges
+    small_snapshot, small_pods = build_workload(2_000, 500)
+    small = measure_config("staged 2k Zipf pods, 500 nodes", small_snapshot,
+                           small_pods, real_platform, batch, baseline_pods,
+                           chunk, timed_runs=1)
+    small["note"] = "staged small run; full-size run follows"
+    print(json.dumps(small), flush=True)
+
+    # stage 2: the headline config
     snapshot, pods = build_workload(num_pods, num_nodes)
-    log(f"workload build: {time.perf_counter() - t0:.1f}s")
-
-    # --- python reference-loop baseline on a subsample ---
-    t0 = time.perf_counter()
-    ref_placements = ReferenceBackend().schedule(pods[:baseline_pods], snapshot)
-    ref_elapsed = time.perf_counter() - t0
-    ref_rate = baseline_pods / ref_elapsed
-    log(f"reference loop: {baseline_pods} pods in {ref_elapsed:.1f}s "
-        f"= {ref_rate:.1f} pods/s "
-        f"({sum(p.scheduled for p in ref_placements)} scheduled)")
-
-    # --- jax backend ---
-    t0 = time.perf_counter()
-    compiled, cols = compile_cluster(snapshot, pods)
-    log(f"host compile (intern+tables): {time.perf_counter() - t0:.1f}s")
-
-    config = config_for(
-        [compiled], most_requested=False,
-        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
-    carry = carry_init(compiled)
-    statics = statics_to_device(compiled)
-    xs = pod_columns_to_device(cols)
-
-    import jax.numpy as jnp
-
-    def run():
-        """One full scheduling pass; returns (choices ref, checksum int).
-
-        The checksum is a device-side reduction over the decision vector,
-        fetched as a host scalar: fetching it provably forces the whole
-        computation (choices feeds the sum), unlike block_until_ready on
-        the axon runtime, which has been observed returning early.
-        """
-        if batch > 0:
-            _, choices, counts, _ = schedule_wavefront(config, carry, statics, xs, batch)
-        else:
-            _, choices, counts, _ = schedule_scan(config, carry, statics, xs)
-        checksum = int(jnp.sum(jnp.where(choices >= 0, choices, -1)))
-        return choices, checksum
-
-    t0 = time.perf_counter()
-    choices_dev, checksum = run()
-    cold = time.perf_counter() - t0
-    log(f"device cold (incl XLA compile): {cold:.1f}s (checksum={checksum})")
-
-    # median of 3 timed runs; each run re-dispatches and fetches the checksum
-    warm_times = []
-    drift = False
-    for _ in range(3):
-        t0 = time.perf_counter()
-        choices_dev, cs = run()
-        warm_times.append(time.perf_counter() - t0)
-        if cs != checksum:
-            drift = True
-            log(f"WARNING: checksum drift {checksum} -> {cs}")
-    warm = float(np.median(warm_times))
-    rate = num_pods / warm
-    choices = np.asarray(choices_dev)
-    scheduled = int(np.sum(choices >= 0))
-    log(f"device warm (median of {[f'{t:.3f}' for t in warm_times]}): "
-        f"{num_pods} pods in {warm:.2f}s = {rate:.0f} pods/s "
-        f"({scheduled} scheduled, {num_pods - scheduled} unschedulable)")
-
-    # sanity: jax choices agree with the reference loop on the subsample
-    names = compiled.statics.names
-    mismatches = sum(
-        1 for i in range(baseline_pods)
-        if (names[choices[i]] if choices[i] >= 0 else "") != ref_placements[i].node_name)
-    log(f"parity check on first {baseline_pods} pods: {mismatches} mismatches")
-
-    mode = "exact scan" if batch == 0 else f"wavefront K={batch}"
-    result = {
-        "metric": f"scheduled pods/sec ({num_pods // 1000}k Zipf pods, "
-                  f"{num_nodes} heterogeneous nodes, {mode}, "
-                  f"platform={real_platform}, "
-                  f"parity_mismatches={mismatches})",
-        "value": round(rate, 1),
-        "unit": "pods/s",
-        "vs_baseline": round(rate / ref_rate, 2),
-    }
-    if drift:
-        # runtime-integrity failure: the rate may be measured on incomplete
-        # execution — surface it in the artifact, not just stderr
-        result["error"] = "checksum drift across timed runs; rate unreliable"
+    result = measure_config(
+        f"{num_pods // 1000}k Zipf pods, {num_nodes} heterogeneous nodes",
+        snapshot, pods, real_platform, batch, baseline_pods, chunk)
     print(json.dumps(result), flush=True)
 
 
-# --------------------------------------------------------------------------
-# parent: probe + orchestrate with timeouts, retries, and CPU fallback
-# --------------------------------------------------------------------------
+def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> None:
+    """BASELINE.md configs 1-5; one JSON line each."""
+    from tpusim.api.podspec import expand_simulation_pods, parse_simulation_pods
+    from tpusim.api.snapshot import synthetic_cluster
 
-_PROBE_CODE = "import jax; d = jax.devices(); print(d[0].platform, flush=True)"
+    results = []
 
-
-def probe_default_backend(timeout: float) -> str | None:
-    """Try initializing the default jax backend in a subprocess.
-
-    Returns the platform name on success, None on failure/timeout. Runs out
-    of process because a hung TPU tunnel blocks jax.devices() indefinitely
-    with the GIL held — no in-process timeout can recover from that.
-    """
+    # 1. quickstart: etc/pod.yaml 20 pods vs synthetic nodes (falls back to
+    # the equivalent synthetic spec when the reference checkout is absent)
+    quickstart = os.environ.get("TPUSIM_BENCH_QUICKSTART",
+                                "/root/reference/etc/pod.yaml")
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_CODE],
-            capture_output=True, text=True, timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+        with open(quickstart) as f:
+            sim_pods = parse_simulation_pods(f.read())
+        quick_pods = list(reversed(expand_simulation_pods(sim_pods)))
+    except OSError:
+        from tpusim.api.snapshot import make_pod
+
+        log(f"  quickstart spec {quickstart!r} unavailable; using the "
+            "equivalent synthetic 10 small + 10 oversized pods")
+        quick_pods = ([make_pod(f"small-{i}", milli_cpu=100, memory=1024)
+                       for i in range(10)]
+                      + [make_pod(f"big-{i}", milli_cpu=100_000, memory=1024)
+                         for i in range(10)])
+    results.append(measure_config(
+        "config 1: quickstart 20 pods, 100 synthetic nodes",
+        synthetic_cluster(100, milli_cpu=4000, memory=16 * 1024**3),
+        quick_pods, platform, batch, baseline_pods, chunk))
+    print(json.dumps(results[-1]), flush=True)
+
+    # 2. 1k uniform pods / 100 nodes
+    snapshot, pods = uniform_workload(1_000, 100)
+    results.append(measure_config("config 2: 1k uniform pods, 100 nodes",
+                                  snapshot, pods, platform, batch,
+                                  baseline_pods, chunk))
+    print(json.dumps(results[-1]), flush=True)
+
+    # 3. 100k Zipf / 5k heterogeneous
+    snapshot, pods = build_workload(100_000, 5_000)
+    results.append(measure_config(
+        "config 3: 100k Zipf pods, 5k heterogeneous nodes",
+        snapshot, pods, platform, batch, baseline_pods, chunk))
+    print(json.dumps(results[-1]), flush=True)
+
+    # 4. 1M pods / 10k nodes with taints+tolerations and node affinity
+    # (CPU fallback runs a scaled shape so the watchdog never fires)
+    p4, n4 = (1_000_000, 10_000) if platform != "cpu" else (100_000, 2_000)
+    snapshot, pods = build_workload(p4, n4, affinity=True)
+    results.append(measure_config(
+        f"config 4: {p4 // 1000}k Zipf pods, {n4} nodes, taints+node-affinity",
+        snapshot, pods, platform, batch, baseline_pods, chunk, timed_runs=1))
+    print(json.dumps(results[-1]), flush=True)
+
+    # 5. multi-tenant what-if: 50 snapshots x 20k pods, one batched program
+    from tpusim.jaxe.whatif import run_what_if
+
+    n_scen, p_scen, n_nodes5 = (50, 20_000, 1_000) if platform != "cpu" \
+        else (8, 5_000, 500)
+    scenarios = []
+    for s in range(n_scen):
+        snap, pods = build_workload(p_scen, n_nodes5, seed=1000 + s)
+        scenarios.append((snap, pods))
+    t0 = time.perf_counter()
+    run_what_if(scenarios)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run_what_if(scenarios)
+    warm = time.perf_counter() - t0
+    total = n_scen * p_scen
+    log(f"[config 5] {n_scen}x{p_scen // 1000}k what-if: cold {cold:.1f}s, "
+        f"warm {warm:.1f}s")
+    results.append({
+        "metric": f"scheduled pods/sec (config 5: {n_scen}x"
+                  f"{p_scen // 1000}k batched what-if, platform={platform})",
+        "value": round(total / warm, 1), "unit": "pods/s", "vs_baseline": 0})
+    print(json.dumps(results[-1]), flush=True)
+
+
+def run_phases(platform: str, chunk: int) -> None:
+    """Per-phase time split + tuning sweeps (BASELINE.md 'per-phase time
+    split'; VERDICT round-1 item 9).
+
+    The production pipeline is ONE fused device program (filter→score→
+    select→bind), so phases have no individually observable device time
+    there; the split below times phase-isolated jitted programs over the same
+    pods against a frozen snapshot (wavefront-style vmap): filter-only (score
+    ops dead-code-eliminated by XLA), filter+score, +select, and the full
+    step incl. the bind scatters. Also sweeps TPUSIM_SCAN_UNROLL and
+    wavefront K for the exact/wavefront modes."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpusim.jaxe.kernels import (
+        _evaluate,
+        _select,
+        carry_init,
+        make_wavefront_step,
+        schedule_scan,
+        schedule_wavefront,
+    )
+
+    num_pods = int(os.environ.get("TPUSIM_BENCH_PHASE_PODS", 20_000))
+    num_nodes = int(os.environ.get("TPUSIM_BENCH_NODES", 5_000))
+    if platform == "cpu":
+        num_pods, num_nodes = 5_000, 1_000
+    snapshot, pods = build_workload(num_pods, num_nodes)
+    compiled, config, carry, statics, xs = _prepare(snapshot, pods)
+
+    def timeit(fn, *args, reps=3):
+        out = fn(*args)           # compile
+        jax.tree_util.tree_map(np.asarray, out)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.tree_util.tree_map(np.asarray, out)  # force
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times))
+
+    # --- phase-isolated programs (vmapped over the pod axis, frozen carry) ---
+    filter_fn = jax.jit(lambda c, s, x: jax.vmap(
+        lambda xi: _evaluate(config, c, s, xi)[:2])(x))
+    eval_fn = jax.jit(lambda c, s, x: jax.vmap(
+        lambda xi: _evaluate(config, c, s, xi))(x))
+
+    def select_stage(c, s, x):
+        feasible, _, score, n_feasible = jax.vmap(
+            lambda xi: _evaluate(config, c, s, xi))(x)
+        rr = jnp.arange(feasible.shape[0], dtype=jnp.int64)
+        return jax.vmap(_select)(feasible, score, n_feasible, rr)
+
+    select_fn = jax.jit(select_stage)
+    wave_step = jax.jit(lambda c, s, x, v: make_wavefront_step(config)(
+        (c, s), (x, v)))
+    valid = jnp.ones(num_pods, dtype=bool)
+
+    t_filter = timeit(filter_fn, carry, statics, xs)
+    t_eval = timeit(eval_fn, carry, statics, xs)
+    t_select = timeit(select_fn, carry, statics, xs)
+    t_full = timeit(wave_step, carry, statics, xs, valid)
+    phases = {
+        "filter_us_per_pod": round(1e6 * t_filter / num_pods, 3),
+        "score_us_per_pod": round(1e6 * max(t_eval - t_filter, 0.0) / num_pods, 3),
+        "select_us_per_pod": round(1e6 * max(t_select - t_eval, 0.0) / num_pods, 3),
+        "bind_us_per_pod": round(1e6 * max(t_full - t_select, 0.0) / num_pods, 3),
+    }
+    log(f"[phases] {num_pods} pods x {num_nodes} nodes (frozen snapshot): "
+        f"filter {t_filter:.3f}s, +score {t_eval:.3f}s, "
+        f"+select {t_select:.3f}s, full step {t_full:.3f}s")
+    log(f"[phases] per-pod split: {phases}")
+
+    # --- exact-scan unroll sweep ---
+    unroll_results = {}
+    for unroll in (1, 2, 4, 8):
+        cfg_u = dataclasses.replace(config, scan_unroll=unroll)
+        t = timeit(lambda cu=cfg_u: schedule_scan(cu, carry_init(compiled),
+                                                  statics, xs)[1], reps=3)
+        unroll_results[str(unroll)] = round(num_pods / t, 1)
+        log(f"[unroll {unroll}] exact scan: {num_pods / t:.0f} pods/s")
+    best_unroll = max(unroll_results, key=lambda k: unroll_results[k])
+
+    # --- wavefront K sweep ---
+    k_results = {}
+    for k in (64, 256, 1024, 4096):
+        t = timeit(lambda kk=k: schedule_wavefront(
+            config, carry_init(compiled), statics, xs, kk)[1], reps=3)
+        k_results[str(k)] = round(num_pods / t, 1)
+        log(f"[wavefront K={k}] {num_pods / t:.0f} pods/s")
+    best_k = max(k_results, key=lambda k: k_results[k])
+
+    print(json.dumps({
+        "metric": f"per-phase split + tuning ({num_pods // 1000}k pods, "
+                  f"{num_nodes} nodes, platform={platform})",
+        "value": unroll_results[best_unroll],
+        "unit": "pods/s",
+        "vs_baseline": 0,
+        "phases": phases,
+        "exact_scan_unroll_pods_per_s": unroll_results,
+        "best_unroll": int(best_unroll),
+        "wavefront_k_pods_per_s": k_results,
+        "best_wavefront_k": int(best_k),
+    }), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent: watchdogged child with retries + CPU fallback
+# --------------------------------------------------------------------------
+
+def run_watchdogged(cmd, stall_timeout: float, total_timeout: float):
+    """Run `cmd`, streaming its stderr; kill it if no output arrives for
+    `stall_timeout` seconds or the total exceeds `total_timeout`. Returns
+    (json_lines_from_stdout, error | None) — partial results from a killed
+    child still count. Per-stream reader threads feed a queue so a child
+    that wedges mid-line (or bursts multiple lines) can neither block the
+    watchdog nor strand buffered output."""
+    import queue
+    import threading
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True,
+                            cwd=os.path.dirname(os.path.abspath(__file__)))
+    q: queue.Queue = queue.Queue()
+
+    def pump(stream, tag):
+        for line in iter(stream.readline, ""):
+            q.put((tag, line.rstrip("\n")))
+        q.put((tag, None))
+
+    threads = [threading.Thread(target=pump, args=(proc.stdout, "out"), daemon=True),
+               threading.Thread(target=pump, args=(proc.stderr, "err"), daemon=True)]
+    for t in threads:
+        t.start()
+
+    start = last_output = time.monotonic()
+    json_lines = []
+    error = None
+    open_streams = 2
+    while open_streams:
+        now = time.monotonic()
+        if now - last_output > stall_timeout:
+            error = f"no output for {stall_timeout:.0f}s (stalled); killed"
+            proc.kill()
+            break
+        if now - start > total_timeout:
+            error = f"exceeded total timeout {total_timeout:.0f}s; killed"
+            proc.kill()
+            break
+        try:
+            tag, line = q.get(timeout=5.0)
+        except queue.Empty:
+            continue
+        if line is None:
+            open_streams -= 1
+            continue
+        last_output = time.monotonic()
+        if tag == "out":
+            if line.strip().startswith("{"):
+                try:
+                    json_lines.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        else:
+            log(f"  [child] {line}")
+    try:
+        proc.wait(timeout=10)
     except subprocess.TimeoutExpired:
-        log(f"probe: backend init timed out after {timeout:.0f}s")
-        return None
-    if proc.returncode != 0:
-        tail = (proc.stderr or "").strip().splitlines()[-3:]
-        log("probe: backend init failed: " + " | ".join(tail))
-        return None
-    platform = proc.stdout.strip().split()[-1] if proc.stdout.strip() else ""
-    log(f"probe: default backend platform = {platform!r}")
-    return platform or None
-
-
-def run_bench_subprocess(platform: str, timeout: float):
-    """Run the measurement child; returns (parsed_json | None, error | None)."""
-    cmd = [sys.executable, os.path.abspath(__file__), "--child", platform]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout,
-                              cwd=os.path.dirname(os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired as e:
-        for stream in (e.stderr, e.stdout):
-            if stream:
-                text = stream.decode() if isinstance(stream, bytes) else stream
-                for line in text.strip().splitlines()[-10:]:
-                    log(f"  [child] {line}")
-        return None, f"bench run on {platform!r} timed out after {timeout:.0f}s"
-    for line in (proc.stderr or "").strip().splitlines():
-        log(f"  [child] {line}")
-    if proc.returncode != 0:
-        return None, f"bench run on {platform!r} exited rc={proc.returncode}"
-    for line in reversed((proc.stdout or "").strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
+        proc.kill()
+        proc.wait()
+    # drain anything the pumps captured before the kill
+    while True:
+        try:
+            tag, line = q.get_nowait()
+        except queue.Empty:
+            break
+        if tag == "out" and line and line.strip().startswith("{"):
             try:
-                return json.loads(line), None
+                json_lines.append(json.loads(line))
             except json.JSONDecodeError:
-                continue
-    return None, f"bench run on {platform!r} produced no JSON line"
+                pass
+        elif tag == "err" and line:
+            log(f"  [child] {line}")
+    if error is None and proc.returncode != 0:
+        error = f"child exited rc={proc.returncode}"
+    if json_lines and error is not None:
+        last = json_lines[-1]
+        last["note"] = (last.get("note", "") + "; " if last.get("note")
+                        else "") + f"partial: {error}"
+        return json_lines, None
+    if json_lines:
+        return json_lines, None
+    return [], error or "child produced no JSON line"
 
 
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        run_child(sys.argv[2] if len(sys.argv) > 2 else "default")
+        run_child(sys.argv[2] if len(sys.argv) > 2 else "default",
+                  ladder="--ladder" in sys.argv,
+                  phases="--phases" in sys.argv)
         return
+    ladder = "--ladder" in sys.argv
+    phases = "--phases" in sys.argv
 
-    probe_timeout = float(os.environ.get("TPUSIM_BENCH_PROBE_TIMEOUT", 150))
+    stall_timeout = float(os.environ.get("TPUSIM_BENCH_STALL_TIMEOUT", 240))
     run_timeout = float(os.environ.get("TPUSIM_BENCH_RUN_TIMEOUT", 2400))
-    retries = int(os.environ.get("TPUSIM_BENCH_PROBE_RETRIES", 3))
+    retries = int(os.environ.get("TPUSIM_BENCH_RETRIES", 2))
 
     errors: list[str] = []
-
-    # 1) probe the default (TPU) backend with bounded retries
-    platform = None
-    for attempt in range(1, retries + 1):
-        log(f"probe attempt {attempt}/{retries} (timeout {probe_timeout:.0f}s)")
-        platform = probe_default_backend(probe_timeout)
-        if platform:
-            break
-        if attempt < retries:
-            backoff = 10.0 * attempt
-            log(f"probe: retrying in {backoff:.0f}s")
-            time.sleep(backoff)
-    if not platform:
-        errors.append(f"default backend unavailable after {retries} probes")
-    elif platform == "cpu":
-        # a "default" backend that is really the CPU (e.g. plugin init failed
-        # with a warning-level fallback) must not run the TPU-sized workload
-        errors.append("default backend probed as cpu; using cpu-sized workload")
-        platform = None
-
-    # 2) run the measurement on the probed backend, then fall back to CPU
-    attempts = []
-    if platform:
-        attempts.append("default")
-    attempts.append("cpu")
-    for target in attempts:
-        label = platform if target == "default" else "cpu"
-        log(f"running benchmark on {label} (timeout {run_timeout:.0f}s)")
-        result, err = run_bench_subprocess(target, run_timeout)
-        if result is not None:
+    attempts = [("default", a) for a in range(1, retries + 1)] + [("cpu", 1)]
+    for target, attempt in attempts:
+        log(f"benchmark on {target!r} (attempt {attempt}, "
+            f"stall timeout {stall_timeout:.0f}s, total {run_timeout:.0f}s)")
+        cmd = [sys.executable, os.path.abspath(__file__), "--child", target]
+        if ladder:
+            cmd.append("--ladder")
+        if phases:
+            cmd.append("--phases")
+        json_lines, err = run_watchdogged(cmd, stall_timeout, run_timeout)
+        if json_lines:
+            if ladder:
+                # one line per completed config + a best-rate summary line
+                for line in json_lines:
+                    print(json.dumps(line), flush=True)
+                best = max(json_lines, key=lambda r: r.get("value", 0))
+                summary = dict(best)
+                summary["metric"] = (f"ladder best of {len(json_lines)} "
+                                     f"configs: " + summary["metric"])
+                result = summary
+            else:
+                result = json_lines[-1]
             if errors:
-                result["note"] = "; ".join(errors)
+                result["note"] = (result.get("note", "") + "; " if
+                                  result.get("note") else "") + "; ".join(errors)
             print(json.dumps(result), flush=True)
             return
-        errors.append(err)
+        errors.append(f"{target} attempt {attempt}: {err}")
         log(f"FAILED: {err}")
+        if target == "default" and attempt < retries:
+            backoff = 20.0 * attempt
+            log(f"retrying in {backoff:.0f}s")
+            time.sleep(backoff)
 
-    # 3) everything failed: still emit one valid JSON line, rc 0
     print(json.dumps({
         "metric": "scheduled pods/sec (benchmark failed)",
         "value": 0,

@@ -844,13 +844,41 @@ def make_step(config: EngineConfig):
     return step
 
 
-@partial(jax.jit, static_argnames=("config",))
-def schedule_scan(config: EngineConfig, carry: Carry, statics: Statics, xs: PodX):
-    """Exact sequential mode: scan the fused step over the pod axis."""
+def _schedule_scan_impl(config: EngineConfig, carry: Carry, statics: Statics,
+                        xs: PodX):
     step = make_step(config)
     (final_carry, _), (choices, counts, advanced) = jax.lax.scan(
         step, (carry, statics), xs, unroll=config.scan_unroll)
     return final_carry, choices, counts, advanced
+
+
+# Exact sequential mode: scan the fused step over the pod axis.
+schedule_scan = partial(jax.jit, static_argnames=("config",))(_schedule_scan_impl)
+
+# Chunked-driver variant: the carry buffers are donated, so a host loop
+# feeding pod chunks (carry, ch = scan(carry, chunk)) updates the [N]-sized
+# state in place instead of churning fresh HBM allocations per chunk
+# (SURVEY.md §7 hard part 6 — 1M-pod batches).
+schedule_scan_donated = jax.jit(_schedule_scan_impl,
+                                static_argnames=("config",),
+                                donate_argnums=(1,))
+
+
+def pad_infeasible_rows(xs, pad: int):
+    """Append `pad` PodX rows that fail PodFitsResources on every node
+    (req_cpu = 2^61 exceeds any allocatable): no carry mutation, no rr
+    advance (n_feasible == 0 skips both), so shape padding is semantics-free.
+    Host-numpy in, host-numpy out."""
+    if pad <= 0:
+        return xs
+
+    def pad_field(name, arr):
+        fill = (np.int64(1) << 61) if name == "req_cpu" else 0
+        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, widths, constant_values=fill)
+
+    return PodX(*(pad_field(name, arr)
+                  for name, arr in zip(PodX._fields, xs)))
 
 
 def make_wavefront_step(config: EngineConfig):

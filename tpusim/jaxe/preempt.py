@@ -61,6 +61,7 @@ from tpusim.jaxe.delta import IncrementalCluster
 from tpusim.jaxe.kernels import (
     PodX,
     carry_init,
+    pad_infeasible_rows,
     config_for,
     pod_columns_to_host,
     schedule_scan,
@@ -71,28 +72,8 @@ from tpusim.jaxe.state import NUM_FIXED_BITS, reason_strings
 
 log = logging.getLogger(__name__)
 
-# A request no node can satisfy (allocatable milli-CPU is bounded far below
-# 2^61); used for padding rows so bucketed re-dispatch shapes are reusable.
-_INFEASIBLE_CPU = np.int64(1) << 61
-
-
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
-
-
-def _pad_infeasible(xs, pad: int):
-    """Append `pad` rows that fail PodFitsResources on every node: no carry
-    mutation, no rr advance (n_feasible == 0 skips both)."""
-    if pad <= 0:
-        return xs
-
-    def pad_field(name, arr):
-        fill = _INFEASIBLE_CPU if name == "req_cpu" else 0
-        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
-        return np.pad(arr, widths, constant_values=fill)
-
-    return PodX(*(pad_field(name, arr)
-                  for name, arr in zip(PodX._fields, xs)))
 
 
 def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
@@ -190,7 +171,7 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
         if not first_dispatch:
             # bucket re-dispatch shapes so XLA recompiles O(log P) times
             bucket = min(_next_pow2(len(remaining)), full_size)
-            xs_host = _pad_infeasible(xs_host, bucket - len(remaining))
+            xs_host = pad_infeasible_rows(xs_host, bucket - len(remaining))
         first_dispatch = False
         import jax.numpy as jnp
 

@@ -282,6 +282,12 @@ def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
     devices = jax.devices()
     real_platform = devices[0].platform
     log(f"devices: {devices}")
+    if platform != "cpu" and real_platform == "cpu":
+        # the requested accelerator silently fell back to CPU (e.g. the axon
+        # plugin failed init with a warning): use the CPU-sized workload
+        log("default backend resolved to cpu; using the cpu-sized workload")
+        num_pods = int(os.environ.get("TPUSIM_BENCH_CPU_PODS", 20_000))
+        num_nodes = int(os.environ.get("TPUSIM_BENCH_CPU_NODES", 2_000))
 
     if phases:
         run_phases(real_platform, chunk)
@@ -369,19 +375,20 @@ def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> Non
     for s in range(n_scen):
         snap, pods = build_workload(p_scen, n_nodes5, seed=1000 + s)
         scenarios.append((snap, pods))
+    # run_what_if compiles per invocation (the jitted program is built
+    # inside), so every call pays host interning + XLA compile: the honest
+    # metric is end-to-end including those costs
     t0 = time.perf_counter()
     run_what_if(scenarios)
-    cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    run_what_if(scenarios)
-    warm = time.perf_counter() - t0
+    e2e = time.perf_counter() - t0
     total = n_scen * p_scen
-    log(f"[config 5] {n_scen}x{p_scen // 1000}k what-if: cold {cold:.1f}s, "
-        f"warm {warm:.1f}s")
+    log(f"[config 5] {n_scen}x{p_scen // 1000}k what-if: "
+        f"{e2e:.1f}s end-to-end (incl. compile + host interning)")
     results.append({
         "metric": f"scheduled pods/sec (config 5: {n_scen}x"
-                  f"{p_scen // 1000}k batched what-if, platform={platform})",
-        "value": round(total / warm, 1), "unit": "pods/s", "vs_baseline": 0})
+                  f"{p_scen // 1000}k batched what-if, end-to-end incl. "
+                  f"compile, platform={platform})",
+        "value": round(total / e2e, 1), "unit": "pods/s", "vs_baseline": 0})
     print(json.dumps(results[-1]), flush=True)
 
 
@@ -608,13 +615,17 @@ def main() -> None:
         json_lines, err = run_watchdogged(cmd, stall_timeout, run_timeout)
         if json_lines:
             if ladder:
-                # one line per completed config + a best-rate summary line
+                # one line per completed config, then the HEADLINE config
+                # (3: 100k Zipf / 5k nodes) as the summary line — not the
+                # best rate, which a toy config would trivially win
                 for line in json_lines:
                     print(json.dumps(line), flush=True)
-                best = max(json_lines, key=lambda r: r.get("value", 0))
-                summary = dict(best)
-                summary["metric"] = (f"ladder best of {len(json_lines)} "
-                                     f"configs: " + summary["metric"])
+                headline = next((r for r in json_lines
+                                 if "config 3" in r.get("metric", "")),
+                                json_lines[-1])
+                summary = dict(headline)
+                summary["metric"] = (f"ladder ({len(json_lines)} configs), "
+                                     f"headline: " + summary["metric"])
                 result = summary
             else:
                 result = json_lines[-1]

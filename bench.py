@@ -256,14 +256,19 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
     return result
 
 
+def _cpu_sized_workload() -> tuple:
+    """CPU-shape knobs; explicit TPUSIM_BENCH_PODS/_NODES overrides win."""
+    return (int(os.environ.get("TPUSIM_BENCH_CPU_PODS",
+                               os.environ.get("TPUSIM_BENCH_PODS", 20_000))),
+            int(os.environ.get("TPUSIM_BENCH_CPU_NODES",
+                               os.environ.get("TPUSIM_BENCH_NODES", 2_000))))
+
+
 def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
     num_pods = int(os.environ.get("TPUSIM_BENCH_PODS", 100_000))
     num_nodes = int(os.environ.get("TPUSIM_BENCH_NODES", 5_000))
     if platform == "cpu":
-        num_pods = int(os.environ.get("TPUSIM_BENCH_CPU_PODS",
-                                      os.environ.get("TPUSIM_BENCH_PODS", 20_000)))
-        num_nodes = int(os.environ.get("TPUSIM_BENCH_CPU_NODES",
-                                       os.environ.get("TPUSIM_BENCH_NODES", 2_000)))
+        num_pods, num_nodes = _cpu_sized_workload()
     baseline_pods = int(os.environ.get("TPUSIM_BENCH_BASELINE_PODS", 200))
     batch = int(os.environ.get("TPUSIM_BENCH_BATCH", 0))
     chunk = int(os.environ.get("TPUSIM_BENCH_CHUNK", 65536))
@@ -286,8 +291,7 @@ def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
         # the requested accelerator silently fell back to CPU (e.g. the axon
         # plugin failed init with a warning): use the CPU-sized workload
         log("default backend resolved to cpu; using the cpu-sized workload")
-        num_pods = int(os.environ.get("TPUSIM_BENCH_CPU_PODS", 20_000))
-        num_nodes = int(os.environ.get("TPUSIM_BENCH_CPU_NODES", 2_000))
+        num_pods, num_nodes = _cpu_sized_workload()
 
     if phases:
         run_phases(real_platform, chunk)

@@ -226,3 +226,80 @@ def test_policy_duplicate_name_last_wins():
     cp = compile_policy(policy)
     assert cp.spec.w_least == 7
     assert_policy_parity(workload(6), mixed_cluster(), policy)
+
+
+def test_policy_image_locality_on_device():
+    """ImageLocalityPriority compiles to a static (pod-image-set, node)
+    table (image_locality.go thresholds) and matches the host engine."""
+    from tpusim.api.types import ContainerImage
+
+    mb = 1024 * 1024
+    nodes = []
+    for i in range(4):
+        node = make_node(f"n{i}", milli_cpu=4000)
+        if i % 2 == 0:
+            node.status.images = [
+                ContainerImage(names=[f"registry/app:v1"],
+                               size_bytes=600 * mb),
+                ContainerImage(names=["registry/sidecar:v2"],
+                               size_bytes=120 * mb)]
+        nodes.append(node)
+    snap = ClusterSnapshot(nodes=nodes)
+    pods = []
+    for i in range(6):
+        p = make_pod(f"p{i}", milli_cpu=300)
+        p.spec.containers[0].image = "registry/app:v1"
+        pods.append(p)
+    policy = Policy(
+        predicates=[PredicatePolicy(name="PodFitsResources")],
+        priorities=[PriorityPolicy(name="ImageLocalityPriority", weight=4)])
+    cp = compile_policy(policy)
+    assert not cp.unsupported and cp.spec.w_image == 4
+    status = assert_policy_parity(pods, snap, policy)
+    # the image-bearing nodes win every placement
+    assert all(p.spec.node_name in ("n0", "n2")
+               for p in status.successful_pods)
+
+
+def test_policy_always_check_all_on_device():
+    """alwaysCheckAllPredicates: a node failing several predicates reports
+    every reason (podFitsOnNode keeps evaluating past the first failure)."""
+    policy = Policy(
+        predicates=[PredicatePolicy(name="PodFitsResources"),
+                    PredicatePolicy(name="PodToleratesNodeTaints")],
+        priorities=[],
+        always_check_all_predicates=True)
+    cp = compile_policy(policy)
+    assert not cp.unsupported and cp.spec.always_check_all
+    node = make_node("n", milli_cpu=100,
+                     taints=[{"key": "k", "value": "v",
+                              "effect": "NoSchedule"}])
+    status = assert_policy_parity([make_pod("p", milli_cpu=500)],
+                                  ClusterSnapshot(nodes=[node]), policy)
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert "Insufficient cpu" in msg and "taints" in msg
+
+
+def test_policy_always_check_all_fallback_shapes():
+    """Host reason multiplicity the device bit-histogram can't represent
+    routes to the reference engine."""
+    aca = dict(always_check_all_predicates=True)
+    two_labels = Policy(predicates=[
+        PredicatePolicy(name="LblA", argument=PredicateArgument(
+            labels_presence=LabelsPresenceArg(labels=["x"], presence=True))),
+        PredicatePolicy(name="LblB", argument=PredicateArgument(
+            labels_presence=LabelsPresenceArg(labels=["y"], presence=True))),
+    ], priorities=[], **aca)
+    assert compile_policy(two_labels).unsupported
+    umbrella_plus_part = Policy(predicates=[
+        PredicatePolicy(name="GeneralPredicates"),
+        PredicatePolicy(name="PodFitsResources")], priorities=[], **aca)
+    assert compile_policy(umbrella_plus_part).unsupported
+    unsched = Policy(predicates=[
+        PredicatePolicy(name="CheckNodeUnschedulable")], priorities=[], **aca)
+    assert compile_policy(unsched).unsupported
+    # the same shapes WITHOUT the flag stay on device
+    assert not compile_policy(Policy(predicates=[
+        PredicatePolicy(name="GeneralPredicates"),
+        PredicatePolicy(name="PodFitsResources")],
+        priorities=[])).unsupported

@@ -167,10 +167,12 @@ class FakeRESTClient:
         # (restclient.go:380-426 keys watchers per resource+fieldSelector)
         self._watchers: Dict[Tuple[str, str, str],
                              Tuple[FieldSelector, WatchBuffer]] = {}
+        self._handlers = []
         for rt in resources:
-            self.store.register_event_handler(
-                rt, lambda event, obj, rt=rt: self.emit_object_watch_event(
-                    rt, event, obj))
+            handler = (lambda event, obj, rt=rt:
+                       self.emit_object_watch_event(rt, event, obj))
+            self._handlers.append((rt, handler))
+            self.store.register_event_handler(rt, handler)
 
     # --- request builder entry (client-go Client.Get()) ---
 
@@ -284,6 +286,11 @@ class FakeRESTClient:
         for _, buf in self._watchers.values():
             buf.close()
         self._watchers.clear()
+        # detach from the store so a shared ResourceStore doesn't keep dead
+        # clients alive (and pay per-event fan-out to them)
+        for rt, handler in self._handlers:
+            self.store.unregister_event_handler(rt, handler)
+        self._handlers = []
 
 
 def decode_list(body: dict, rt: ResourceType) -> list:

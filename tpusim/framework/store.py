@@ -40,6 +40,16 @@ class ResourceStore:
                                handler: EventHandler) -> None:
         self._handlers[resource].append(handler)
 
+    def unregister_event_handler(self, resource: ResourceType,
+                                 handler: EventHandler) -> None:
+        """Detach a handler (no client-go analog — informers live as long as
+        their store — but per-client consumers like FakeRESTClient.close()
+        need it to avoid leaking dead closures on a shared store)."""
+        try:
+            self._handlers[resource].remove(handler)
+        except ValueError:
+            pass
+
     def _emit(self, resource: ResourceType, event: str, obj) -> None:
         for handler in self._handlers[resource]:
             handler(event, obj)

@@ -169,12 +169,22 @@ class JaxBackend:
             # overwrite the trivial custom-plugin rows with the policy's
             # per-node tables (ordering by the compiled node index)
             from tpusim.jaxe.kernels import _tree_to_device, statics_to_host
-            from tpusim.jaxe.policyc import policy_static_rows
+            from tpusim.jaxe.policyc import (
+                image_locality_columns,
+                policy_static_rows,
+            )
 
             label_ok, label_prio = policy_static_rows(
                 cp, snapshot.nodes, compiled.node_index)
-            statics = _tree_to_device(statics_to_host(compiled)._replace(
-                label_ok=label_ok, label_prio=label_prio))
+            host_statics = statics_to_host(compiled)._replace(
+                label_ok=label_ok, label_prio=label_prio)
+            if cp.spec.w_image:
+                # ImageLocality rides an interned pod-image signature table;
+                # the pod column is filled here (state leaves it zeroed)
+                cols.img_id, image_score = image_locality_columns(
+                    pods, snapshot.nodes, compiled.node_index)
+                host_statics = host_statics._replace(image_score=image_score)
+            statics = _tree_to_device(host_statics)
         xs = pod_columns_to_device(cols)
         # On TPU the per-pod filter→score→select→bind pipeline is one fused
         # device program, so the whole batch dispatch lands in the algorithm

@@ -150,8 +150,11 @@ class Statics(NamedTuple):
     #                the fixed ordering, folded into one tail row)
     #   label_prio — pre-weighted sum of NodeLabel/LabelPreference priority
     #                rows (node_label.go; no normalize pass)
+    #   image_score — [Si, N] ImageLocalityPriority map scores per interned
+    #                pod-image-set signature (image_locality.go; static)
     label_ok: jnp.ndarray
     label_prio: jnp.ndarray
+    image_score: jnp.ndarray
 
 
 class PodX(NamedTuple):
@@ -172,6 +175,7 @@ class PodX(NamedTuple):
     avoid_id: jnp.ndarray
     host_id: jnp.ndarray
     group_id: jnp.ndarray
+    img_id: jnp.ndarray
 
 
 @dataclass(frozen=True)
@@ -195,6 +199,10 @@ class PolicySpec:
     w_avoid: int = 0           # NodePreferAvoidPodsPriority policy weight
     w_spread: int = 0
     w_interpod: int = 0
+    w_image: int = 0           # ImageLocalityPriority (table-driven)
+    # first-failure reason selection becomes collect-all-failures
+    # (generic_scheduler.go alwaysCheckAllPredicates)
+    always_check_all: bool = False
     # one entry per Statics.label_ok row: the PREDICATES_ORDERING name whose
     # slot the row evaluates at, or "" for the after-the-ordering tail row
     label_rows: tuple = ()
@@ -257,6 +265,7 @@ STATICS_AXES = dict(
     pref_w=("group", "pref_term"), pref_term=("group", "pref_term"),
     pref_key=("group", "pref_term"),
     label_ok=("label_pred", "node"), label_prio=("node",),
+    image_score=("sig_img", "node"),
 )
 CARRY_AXES = dict(
     used_cpu=("node",), used_mem=("node",), used_gpu=("node",), used_eph=("node",),
@@ -268,7 +277,7 @@ CARRY_AXES = dict(
 PODX_AXES = dict(
     req_cpu=(), req_mem=(), req_gpu=(), req_eph=(), req_scalar=("scalar",),
     nz_cpu=(), nz_mem=(), zero_request=(), best_effort=(), sel_id=(),
-    tol_id=(), aff_id=(), avoid_id=(), host_id=(), group_id=(),
+    tol_id=(), aff_id=(), avoid_id=(), host_id=(), group_id=(), img_id=(),
 )
 # Node-axis pad fill per field (default 0). Exception: cond_fail_bits is
 # special-cased in sharding._pad_node_tree with a lazily-built infeasible
@@ -333,7 +342,8 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         pref_w=gt.pref_w, pref_term=gt.pref_term, pref_key=gt.pref_key,
         # trivial policy rows; jaxe.policyc overwrites them via _replace
         label_ok=np.ones((1, len(s.alloc_cpu)), dtype=bool),
-        label_prio=np.zeros(len(s.alloc_cpu), dtype=np.int64))
+        label_prio=np.zeros(len(s.alloc_cpu), dtype=np.int64),
+        image_score=np.zeros((1, len(s.alloc_cpu)), dtype=np.int64))
 
 
 def _presence_dom_init(presence: np.ndarray, topo_dom: np.ndarray,
@@ -370,7 +380,8 @@ def pod_columns_to_host(cols: PodColumns) -> PodX:
         nz_cpu=cols.nz_cpu, nz_mem=cols.nz_mem,
         zero_request=cols.zero_request, best_effort=cols.best_effort,
         sel_id=cols.sel_id, tol_id=cols.tol_id, aff_id=cols.aff_id,
-        avoid_id=cols.avoid_id, host_id=cols.host_id, group_id=cols.group_id)
+        avoid_id=cols.avoid_id, host_id=cols.host_id, group_id=cols.group_id,
+        img_id=cols.img_id)
 
 
 def _tree_to_device(tree):
@@ -647,10 +658,16 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     for fail, _ in stages[1:]:
         fail_any = fail_any | fail
     feasible = ~fail_any
-    # short-circuit reason selection: first failing stage wins
     reason_bits = jnp.int64(0)
-    for fail, bits in reversed(stages):
-        reason_bits = jnp.where(fail, bits, reason_bits)
+    if ps is not None and ps.always_check_all:
+        # alwaysCheckAllPredicates: every failing stage contributes its
+        # reasons (podFitsOnNode keeps evaluating past the first failure)
+        for fail, bits in stages:
+            reason_bits = reason_bits | jnp.where(fail, bits, jnp.int64(0))
+    else:
+        # short-circuit reason selection: first failing stage wins
+        for fail, bits in reversed(stages):
+            reason_bits = jnp.where(fail, bits, reason_bits)
     n_feasible = jnp.sum(feasible)
 
     # ---- score (weighted sum, generic_scheduler.go:631-639) ----
@@ -708,6 +725,11 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     if label_prio_on:
         # NodeLabel/LabelPreference priorities: static pre-weighted rows
         score = score + st.label_prio
+
+    if ps is not None and ps.w_image:
+        # ImageLocalityPriority (image_locality.go): static per
+        # (pod-image-set, node) score row
+        score = score + st.image_score[x.img_id] * ps.w_image
 
     if config.has_services and w_spread:
         # SelectorSpreadPriority (selector_spreading.go:66-175): per-node count

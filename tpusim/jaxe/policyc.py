@@ -13,11 +13,13 @@ but instead of assembling host predicate/priority closures it produces:
 Host-bound policy features have no device encoding and fall back to the
 reference engine (the same containment as volume workloads): extenders (HTTP
 round-trips mid-filter), ServiceAffinity / ServiceAntiAffinity (label-
-consistency state over live placements), AlwaysCheckAllPredicates (the device
-reason histogram is first-failure-only), PodToleratesNodeNoExecuteTaints (a
-narrower taint filter than the compiled taint table), and ImageLocality /
-CheckServiceAffinity referenced by name. Unknown names raise the host
-registry's KeyError byte-for-byte."""
+consistency state over live placements), PodToleratesNodeNoExecuteTaints (a
+narrower taint filter than the compiled taint table), and the few
+alwaysCheckAllPredicates shapes where the host can emit one reason string
+twice per node (the device histogram is bit-per-string). ImageLocality
+compiles to a static pod-image-signature table; alwaysCheckAllPredicates
+otherwise runs on device (reason bits OR over all failing stages). Unknown
+names raise the host registry's KeyError byte-for-byte."""
 
 from __future__ import annotations
 
@@ -61,8 +63,10 @@ _WEIGHT_FIELDS: Dict[str, str] = {
     "SelectorSpreadPriority": "w_spread",
     "InterPodAffinityPriority": "w_interpod",
 }
-COMPILABLE_PRIOS = frozenset(_WEIGHT_FIELDS) | {"EqualPriority"}
-HOST_ONLY_PRIOS = frozenset({"ImageLocalityPriority"})
+# every priority the 1.10 registry knows now compiles (ImageLocality rides a
+# static signature table); custom args route by kind below
+COMPILABLE_PRIOS = frozenset(_WEIGHT_FIELDS) | {"EqualPriority",
+                                                "ImageLocalityPriority"}
 
 # the DefaultProvider weight set (defaults.go:219-259); policies that omit
 # `priorities` inherit it (CreateFromConfig → DefaultProvider keys)
@@ -94,8 +98,6 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
     unsupported: List[str] = []
     if policy.extender_configs:
         unsupported.append("policy extenders (HTTP round-trips mid-filter)")
-    if policy.always_check_all_predicates:
-        unsupported.append("alwaysCheckAllPredicates (multi-reason histogram)")
 
     # Both registries key plugins by NAME and a later registration under the
     # same name overwrites the earlier one, while the key set dedups
@@ -158,6 +160,7 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
 
     weights = dict(_DEFAULT_WEIGHTS)
     label_prios: List[Tuple[str, bool, int]] = []
+    image_weight = 0
     if policy.priorities is not None:
         weights = dict.fromkeys(weights, 0)
         prio_by_name: Dict[str, tuple] = {}
@@ -171,14 +174,13 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                 prio_by_name[pr.name] = (
                     "label", (arg.label_preference.label,
                               bool(arg.label_preference.presence), pr.weight))
-            elif pr.name in HOST_ONLY_PRIOS:
-                prio_by_name[pr.name] = (
-                    "unsupported", f"priority {pr.name!r} (host-only)")
             elif pr.name in _WEIGHT_FIELDS:
                 # referencing a pre-registered priority takes the POLICY's
                 # weight (plugins.go:302-348 → PriorityConfigFactory.weight)
                 prio_by_name[pr.name] = ("weight", _WEIGHT_FIELDS[pr.name],
                                          pr.weight)
+            elif pr.name == "ImageLocalityPriority":
+                prio_by_name[pr.name] = ("image", pr.weight)
             elif pr.name == "EqualPriority":
                 prio_by_name[pr.name] = ("equal",)
             else:
@@ -189,14 +191,40 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                 weights[entry[1]] = entry[2]
             elif entry[0] == "label":
                 label_prios.append(entry[1])
+            elif entry[0] == "image":
+                image_weight = entry[1]
             elif entry[0] == "unsupported":
                 unsupported.append(entry[1])
             # "equal": constant shift; no effect on selection or ties
 
+    aca = bool(policy.always_check_all_predicates)
+    if aca:
+        # the device reason histogram counts each reason STRING at most once
+        # per node; with always-check-all the host can emit the same string
+        # twice for one node in exactly these shapes — fall back there
+        n_label_entries = sum(len(entries) for _, entries in label_rows)
+        if n_label_entries > 1:
+            unsupported.append("alwaysCheckAllPredicates with multiple "
+                               "label-presence predicates (duplicate reason "
+                               "strings per node)")
+        if pred_keys:
+            parts = {preds.HOSTNAME_PRED, preds.POD_FITS_HOST_PORTS_PRED,
+                     preds.MATCH_NODE_SELECTOR_PRED,
+                     preds.POD_FITS_RESOURCES_PRED}
+            if preds.GENERAL_PRED in pred_keys and pred_keys & parts:
+                unsupported.append(
+                    "alwaysCheckAllPredicates with GeneralPredicates plus an "
+                    "individually-named part (duplicate reason strings)")
+            if preds.CHECK_NODE_UNSCHEDULABLE_PRED in pred_keys:
+                unsupported.append(
+                    "alwaysCheckAllPredicates with CheckNodeUnschedulable "
+                    "(duplicates the mandatory condition check's reason)")
     spec = PolicySpec(
         pred_keys=frozenset(pred_keys) if pred_keys is not None else None,
         label_rows=tuple(slot for slot, _ in label_rows),
         has_label_prio=bool(label_prios),
+        w_image=image_weight,
+        always_check_all=aca,
         **weights)
     hard = (policy.hard_pod_affinity_symmetric_weight
             if policy.hard_pod_affinity_symmetric_weight != 0 else None)
@@ -219,6 +247,41 @@ def _label_pred_row(nodes_by_idx: list, entries) -> np.ndarray:
                     row[i] = False
                     break
     return row
+
+
+def image_locality_columns(pods, nodes, node_index: Dict[str, int]):
+    """(img_id[P] int32, image_score[Si, N] int64): pod container-image
+    multisets interned to signature ids, with the ImageLocalityPriority map
+    score (image_locality.go thresholds) precomputed per (signature, node).
+    Reuses the host map function for exactness."""
+    from types import SimpleNamespace
+
+    from tpusim.engine.priorities import image_locality_priority_map
+
+    n = len(node_index)
+    by_idx: list = [None] * n
+    for node in nodes:
+        i = node_index.get(node.name)
+        if i is not None:
+            by_idx[i] = node
+
+    sig_ids: Dict[tuple, int] = {}
+    reps: List = []
+    img_id = np.zeros(len(pods), dtype=np.int32)
+    for j, pod in enumerate(pods):
+        # a multiset: two containers sharing an image each add its size
+        sig = tuple(sorted(c.image for c in pod.spec.containers))
+        if sig not in sig_ids:
+            sig_ids[sig] = len(reps)
+            reps.append(pod)
+        img_id[j] = sig_ids[sig]
+
+    table = np.zeros((max(len(reps), 1), n), dtype=np.int64)
+    for s, rep in enumerate(reps):
+        for i, node in enumerate(by_idx):
+            info = SimpleNamespace(node=node)
+            table[s, i] = image_locality_priority_map(rep, None, info).score
+    return img_id, table
 
 
 def policy_static_rows(cp: CompiledPolicy, nodes,

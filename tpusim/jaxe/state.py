@@ -271,6 +271,9 @@ class PodColumns:
     avoid_id: np.ndarray         # [P] int32
     host_id: np.ndarray          # [P] int32
     group_id: np.ndarray         # [P] int32 — pod-group id (GroupTables)
+    # pod-image-set signature id (ImageLocalityPriority table; zeros unless a
+    # policy enables the priority — jaxe.policyc fills it then)
+    img_id: np.ndarray           # [P] int32
 
 
 @dataclass
@@ -1082,7 +1085,8 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
         zero_request=np.zeros(p, dtype=bool), best_effort=np.zeros(p, dtype=bool),
         sel_id=np.zeros(p, dtype=np.int32), tol_id=np.zeros(p, dtype=np.int32),
         aff_id=np.zeros(p, dtype=np.int32), avoid_id=np.zeros(p, dtype=np.int32),
-        host_id=np.zeros(p, dtype=np.int32), group_id=np.zeros(p, dtype=np.int32))
+        host_id=np.zeros(p, dtype=np.int32), group_id=np.zeros(p, dtype=np.int32),
+        img_id=np.zeros(p, dtype=np.int32))
 
     sel_i, tol_i, aff_i, avoid_i, host_i = (Interner() for _ in range(5))
     unsupported: List[str] = []

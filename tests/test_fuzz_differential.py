@@ -1,0 +1,176 @@
+"""Cross-feature randomized differential fuzz: every device-engine feature
+mixed in one workload must still place byte-identically to the reference
+engine (placements AND failure messages). This is the BASELINE.json
+"placement-parity" metric as a property test; the narrower per-feature
+differentials live in test_jax_parity.py / test_jax_groups.py /
+test_jax_policy.py / test_jax_preempt.py."""
+
+import random
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.api.types import ContainerImage, Service
+from tpusim.engine.policy import (
+    LabelsPresenceArg,
+    Policy,
+    PredicateArgument,
+    PredicatePolicy,
+    PriorityPolicy,
+)
+from tpusim.simulator import run_simulation
+
+PROVIDERS = ["DefaultProvider", "ClusterAutoscalerProvider",
+             "TalkintDataProvider"]
+MB = 1024 * 1024
+
+
+def random_cluster(rng: random.Random):
+    n_nodes = rng.randint(8, 14)
+    nodes = []
+    for i in range(n_nodes):
+        labels = {"zone": f"z{rng.randrange(3)}"}
+        if rng.random() < 0.5:
+            labels["disktype"] = rng.choice(["ssd", "hdd"])
+        taints = None
+        if rng.random() < 0.2:
+            taints = [{"key": "team", "value": rng.choice(["a", "b"]),
+                       "effect": rng.choice(["NoSchedule",
+                                             "PreferNoSchedule"])}]
+        node = make_node(
+            f"n{i}", milli_cpu=rng.choice([2000, 4000, 8000]),
+            memory=rng.choice([8, 16]) * 1024**3,
+            pods=rng.choice([10, 110]),
+            labels=labels, taints=taints,
+            unschedulable=rng.random() < 0.05,
+            ready=rng.random() > 0.05)
+        if rng.random() < 0.4:
+            node.status.images = [ContainerImage(
+                names=[f"img-{rng.randrange(3)}:v1"],
+                size_bytes=rng.choice([50, 300, 900]) * MB)]
+        nodes.append(node)
+
+    services = []
+    for s in range(rng.randint(0, 2)):
+        services.append(Service.from_obj({
+            "metadata": {"name": f"svc{s}", "namespace": "default"},
+            "spec": {"selector": {"app": f"app{s}"}}}))
+
+    placed = []
+    for i in range(rng.randint(0, 10)):
+        labels = {"app": f"app{rng.randrange(3)}"} if rng.random() < 0.7 else None
+        p = make_pod(f"placed-{i}", milli_cpu=rng.choice([100, 500, 1200]),
+                     memory=rng.choice([128, 512]) * MB,
+                     node_name=f"n{rng.randrange(n_nodes)}", phase="Running",
+                     labels=labels)
+        placed.append(p)
+    return ClusterSnapshot(nodes=nodes, pods=placed, services=services)
+
+
+def random_pods(rng: random.Random, count: int):
+    pods = []
+    for i in range(count):
+        kwargs = {}
+        labels = {}
+        if rng.random() < 0.5:
+            labels["app"] = f"app{rng.randrange(3)}"
+        if rng.random() < 0.3:
+            kwargs["node_selector"] = {"disktype": rng.choice(["ssd", "hdd"])}
+        if rng.random() < 0.3:
+            kwargs["tolerations"] = [{"key": "team", "operator": "Equal",
+                                      "value": rng.choice(["a", "b"]),
+                                      "effect": "NoSchedule"}]
+        if rng.random() < 0.2:
+            kwargs["affinity"] = {"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [
+                        {"key": "zone", "operator": rng.choice(["In", "NotIn"]),
+                         "values": [f"z{rng.randrange(3)}"]}]}]},
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": rng.randint(1, 50),
+                     "preference": {"matchExpressions": [
+                         {"key": "disktype", "operator": "Exists"}]}}]}}
+        elif rng.random() < 0.15:
+            kwargs["affinity"] = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels":
+                                       {"app": f"app{rng.randrange(3)}"}},
+                     "topologyKey": "kubernetes.io/hostname"}]}}
+        p = make_pod(f"pod-{i}", milli_cpu=rng.choice([100, 400, 900, 2500]),
+                     memory=rng.choice([64, 256, 1024, 4096]) * MB,
+                     labels=labels or None, **kwargs)
+        if rng.random() < 0.2:
+            from tpusim.api.types import ContainerPort
+
+            p.spec.containers[0].ports = [ContainerPort.from_obj(
+                {"containerPort": 8080,
+                 "hostPort": rng.choice([8080, 9090])})]
+        if rng.random() < 0.3:
+            p.spec.containers[0].image = f"img-{rng.randrange(3)}:v1"
+        pods.append(p)
+    return pods
+
+
+def sig(status):
+    return ([(p.name, p.spec.node_name) for p in status.successful_pods],
+            [(p.name, p.status.conditions[-1].message if p.status.conditions
+              else "") for p in status.failed_pods],
+            sorted(p.name for p in status.preempted_pods))
+
+
+def test_fuzz_provider_parity():
+    for seed in range(6):
+        rng = random.Random(1000 + seed)
+        snapshot = random_cluster(rng)
+        pods = random_pods(rng, rng.randint(20, 30))
+        provider = rng.choice(PROVIDERS)
+        ref = run_simulation(list(pods), snapshot, provider=provider,
+                             backend="reference")
+        jx = run_simulation(list(pods), snapshot, provider=provider,
+                            backend="jax")
+        assert sig(jx) == sig(ref), f"seed {seed} provider {provider}"
+
+
+def test_fuzz_policy_parity():
+    pred_pool = ["GeneralPredicates", "PodFitsResources",
+                 "PodToleratesNodeTaints", "MatchNodeSelector",
+                 "CheckNodeMemoryPressure", "CheckNodeDiskPressure",
+                 "MatchInterPodAffinity", "PodFitsHostPorts", "HostName"]
+    prio_pool = ["LeastRequestedPriority", "MostRequestedPriority",
+                 "BalancedResourceAllocation", "NodeAffinityPriority",
+                 "TaintTolerationPriority", "SelectorSpreadPriority",
+                 "InterPodAffinityPriority", "ImageLocalityPriority"]
+    for seed in range(4):
+        rng = random.Random(2000 + seed)
+        snapshot = random_cluster(rng)
+        pods = random_pods(rng, rng.randint(15, 25))
+        preds = [PredicatePolicy(name=n) for n in
+                 rng.sample(pred_pool, rng.randint(2, 5))]
+        if rng.random() < 0.6:
+            preds.append(PredicatePolicy(
+                name="NeedsDisk", argument=PredicateArgument(
+                    labels_presence=LabelsPresenceArg(
+                        labels=["disktype"],
+                        presence=rng.random() < 0.7))))
+        prios = [PriorityPolicy(name=n, weight=rng.randint(1, 5)) for n in
+                 rng.sample(prio_pool, rng.randint(1, 4))]
+        policy = Policy(predicates=preds, priorities=prios)
+        ref = run_simulation(list(pods), snapshot, backend="reference",
+                             policy=policy)
+        jx = run_simulation(list(pods), snapshot, backend="jax",
+                            policy=policy)
+        assert sig(jx) == sig(ref), f"seed {seed}"
+
+
+def test_fuzz_preemption_parity():
+    for seed in range(3):
+        rng = random.Random(3000 + seed)
+        snapshot = random_cluster(rng)
+        for p in snapshot.pods:
+            p.spec.priority = rng.randint(0, 5)
+        pods = random_pods(rng, rng.randint(15, 20))
+        for p in pods:
+            p.spec.priority = rng.randint(0, 10)
+        ref = run_simulation(list(pods), snapshot, backend="reference",
+                             enable_pod_priority=True)
+        jx = run_simulation(list(pods), snapshot, backend="jax",
+                            enable_pod_priority=True)
+        assert sig(jx) == sig(ref), f"seed {seed}"

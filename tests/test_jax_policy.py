@@ -303,3 +303,36 @@ def test_policy_always_check_all_fallback_shapes():
         PredicatePolicy(name="GeneralPredicates"),
         PredicatePolicy(name="PodFitsResources")],
         priorities=[])).unsupported
+
+
+def test_policy_no_execute_taints_on_device():
+    """PodToleratesNodeNoExecuteTaints: NoExecute taints filter, NoSchedule
+    taints do not (the policy-registered narrow variant)."""
+    policy = Policy(
+        predicates=[PredicatePolicy(name="PodFitsResources"),
+                    PredicatePolicy(name="PodToleratesNodeNoExecuteTaints")],
+        priorities=[PriorityPolicy(name="LeastRequestedPriority", weight=1)])
+    cp = compile_policy(policy)
+    assert not cp.unsupported
+    nodes = [
+        make_node("evict", milli_cpu=8000,
+                  taints=[{"key": "k", "value": "v", "effect": "NoExecute"}]),
+        make_node("soft", milli_cpu=2000,
+                  taints=[{"key": "k", "value": "v", "effect": "NoSchedule"}]),
+    ]
+    pods = [make_pod(f"p{i}", milli_cpu=400) for i in range(3)]
+    tol = [{"key": "k", "operator": "Equal", "value": "v",
+            "effect": "NoExecute"}]
+    pods.append(make_pod("tolerant", milli_cpu=400, tolerations=tol))
+    status = assert_policy_parity(pods, ClusterSnapshot(nodes=nodes), policy)
+    by_name = {p.name: p.spec.node_name for p in status.successful_pods}
+    # intolerant pods avoid the NoExecute node but CAN land on the
+    # NoSchedule node (the narrow variant ignores NoSchedule)
+    assert by_name["p0"] == "soft" and by_name["p1"] == "soft"
+    assert by_name["tolerant"] == "evict"
+    # with always-check-all plus BOTH taint predicates: host-bound
+    both = Policy(predicates=[
+        PredicatePolicy(name="PodToleratesNodeTaints"),
+        PredicatePolicy(name="PodToleratesNodeNoExecuteTaints")],
+        priorities=[], always_check_all_predicates=True)
+    assert compile_policy(both).unsupported

@@ -131,7 +131,19 @@ class JaxBackend:
                               reason="Unschedulable", message=msg) for p in pods]
 
         cp = self._compiled_policy
-        compiled, cols = precompiled or compile_cluster(snapshot, pods)
+        from tpusim.engine.predicates import (
+            POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+        )
+
+        need_noexec = (cp is not None and cp.spec.pred_keys is not None
+                       and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
+                       in cp.spec.pred_keys)
+        compiled, cols = precompiled or compile_cluster(snapshot, pods,
+                                                        need_noexec=need_noexec)
+        if need_noexec and not compiled.has_noexec_table:
+            # a precompiled (event-log/incremental) state built without the
+            # policy-only table: recompile fresh for this rare combination
+            compiled, cols = compile_cluster(snapshot, pods, need_noexec=True)
         unsupported = list(compiled.unsupported)
         if cp is not None:
             unsupported.extend(cp.unsupported)

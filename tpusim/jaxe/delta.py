@@ -73,7 +73,8 @@ from tpusim.jaxe.state import (
 _SIG_KINDS = (
     # (pod-column name, signature fn, table kinds fed by that signature)
     ("sel_id", _selector_signature, ("selector_ok",)),
-    ("tol_id", _toleration_signature, ("taint_ok", "intolerable")),
+    ("tol_id", _toleration_signature,
+     ("taint_ok", "taint_ok_noexec", "intolerable")),
     ("aff_id", _affinity_signature, ("affinity_count",)),
     ("avoid_id", _avoid_signature, ("avoid_score",)),
     ("host_id", _host_signature, ("host_ok",)),
@@ -457,9 +458,12 @@ class IncrementalCluster:
         live = {sig for (_, sig) in self._sig_rows}
         self._sig_reps = {k: v for k, v in self._sig_reps.items() if k in live}
 
-    def compile(self, pods: List[Pod]) -> Tuple[CompiledCluster, PodColumns]:
+    def compile(self, pods: List[Pod], need_noexec: bool = False
+                ) -> Tuple[CompiledCluster, PodColumns]:
         """Compile a new-pod batch against the current cluster picture.
-        Returns fresh array copies (later events do not mutate the result)."""
+        Returns fresh array copies (later events do not mutate the result).
+        need_noexec: compute the policy-only NoExecute taint table (the
+        default ships an all-pass dummy; see state.compile_cluster)."""
         for pod in pods:
             self._note_pod_scalars(pod)
         statics = self._ensure_statics()
@@ -498,6 +502,11 @@ class IncrementalCluster:
         tables = SignatureTables(
             selector_ok=self._sig_table("selector_ok", key_lists["sel_id"]),
             taint_ok=self._sig_table("taint_ok", key_lists["tol_id"]),
+            taint_ok_noexec=(
+                self._sig_table("taint_ok_noexec", key_lists["tol_id"])
+                if need_noexec else
+                np.ones((max(len(key_lists["tol_id"]), 1), len(self.nodes)),
+                        dtype=bool)),
             intolerable=self._sig_table("intolerable", key_lists["tol_id"]),
             affinity_count=self._sig_table("affinity_count", key_lists["aff_id"]),
             avoid_score=self._sig_table("avoid_score", key_lists["avoid_id"]),
@@ -564,7 +573,8 @@ class IncrementalCluster:
             dynamic=dyn_out, scalar_names=list(self._scalar_names),
             node_index=dict(self._node_index),
             has_ports=has_ports, has_services=has_services,
-            has_interpod=has_interpod, n_topo_doms=n_topo, n_zone_doms=n_zone,
+            has_interpod=has_interpod, has_noexec_table=need_noexec,
+            n_topo_doms=n_topo, n_zone_doms=n_zone,
             unsupported=unsupported)
         return compiled, cols
 

@@ -107,6 +107,7 @@ class Statics(NamedTuple):
     disk_pressure: jnp.ndarray
     selector_ok: jnp.ndarray
     taint_ok: jnp.ndarray
+    taint_ok_noexec: jnp.ndarray
     intolerable: jnp.ndarray
     affinity_count: jnp.ndarray
     avoid_score: jnp.ndarray
@@ -246,7 +247,7 @@ STATICS_AXES = dict(
     alloc_eph=("node",), allowed_pods=("node",), alloc_scalar=("node", "scalar"),
     cond_fail_bits=("node",), mem_pressure=("node",), disk_pressure=("node",),
     selector_ok=("sig_sel", "node"), taint_ok=("sig_tol", "node"),
-    intolerable=("sig_tol", "node"), affinity_count=("sig_aff", "node"),
+    taint_ok_noexec=("sig_tol", "node"), intolerable=("sig_tol", "node"), affinity_count=("sig_aff", "node"),
     avoid_score=("sig_avoid", "node"), host_ok=("sig_host", "node"),
     port_conflict=("port_sig", "port_sig"), port_sig=("group",),
     disk_conflict=("disk_sig", "disk_sig"), disk_sig=("group",),
@@ -325,7 +326,7 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         cond_fail_bits=s.cond_fail_bits, mem_pressure=s.mem_pressure,
         disk_pressure=s.disk_pressure,
         selector_ok=t.selector_ok, taint_ok=t.taint_ok,
-        intolerable=t.intolerable, affinity_count=t.affinity_count,
+        taint_ok_noexec=t.taint_ok_noexec, intolerable=t.intolerable, affinity_count=t.affinity_count,
         avoid_score=t.avoid_score, host_ok=t.host_ok,
         port_conflict=gt.port_conflict, port_sig=gt.port_sig,
         disk_conflict=gt.disk_conflict, disk_sig=gt.disk_sig,
@@ -543,6 +544,10 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         stages.append((~st.taint_ok[x.tol_id],
                        jnp.int64(1) << BIT_TAINTS_NOT_TOLERATED))
     emit_label(POD_TOLERATES_NODE_TAINTS_PRED)
+    if en is not None and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED in en:
+        # policy-registered NoExecute-only variant (not in any provider set)
+        stages.append((~st.taint_ok_noexec[x.tol_id],
+                       jnp.int64(1) << BIT_TAINTS_NOT_TOLERATED))
     emit_label(POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED)
     emit_label(CHECK_NODE_LABEL_PRESENCE_PRED)
     emit_label(CHECK_SERVICE_AFFINITY_PRED)

@@ -12,14 +12,15 @@ but instead of assembling host predicate/priority closures it produces:
 
 Host-bound policy features have no device encoding and fall back to the
 reference engine (the same containment as volume workloads): extenders (HTTP
-round-trips mid-filter), ServiceAffinity / ServiceAntiAffinity (label-
-consistency state over live placements), PodToleratesNodeNoExecuteTaints (a
-narrower taint filter than the compiled taint table), and the few
+round-trips mid-filter), ServiceAffinity / ServiceAntiAffinity (both depend
+on lister-ORDER over live placements — the first matching pod/service defines
+the constraint — which presence counts cannot represent), and the few
 alwaysCheckAllPredicates shapes where the host can emit one reason string
-twice per node (the device histogram is bit-per-string). ImageLocality
-compiles to a static pod-image-signature table; alwaysCheckAllPredicates
-otherwise runs on device (reason bits OR over all failing stages). Unknown
-names raise the host registry's KeyError byte-for-byte."""
+twice per node (the device histogram is bit-per-string). Everything else in
+the 1.10 registry compiles: ImageLocality and the NoExecute taint variant
+ride static signature tables, and alwaysCheckAllPredicates otherwise runs on
+device (reason bits OR over all failing stages). Unknown names raise the host
+registry's KeyError byte-for-byte."""
 
 from __future__ import annotations
 
@@ -47,9 +48,9 @@ COMPILABLE_PREDS = frozenset({
     preds.NO_VOLUME_ZONE_CONFLICT_PRED,
     preds.CHECK_NODE_MEMORY_PRESSURE_PRED, preds.CHECK_NODE_DISK_PRESSURE_PRED,
     preds.MATCH_INTERPOD_AFFINITY_PRED,
+    # NoExecute-only taint variant (policy-registered): its own static table
+    preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
 })
-# registered in the host registry but with no device encoding
-HOST_ONLY_PREDS = frozenset({preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED})
 
 # priority name -> PolicySpec weight field (EqualPriority adds the same
 # constant to every node, so it cannot change the argmax or the tie set)
@@ -120,9 +121,6 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                 pred_by_name[pp.name] = (
                     "label", (tuple(arg.labels_presence.labels),
                               bool(arg.labels_presence.presence)))
-            elif pp.name in HOST_ONLY_PREDS:
-                pred_by_name[pp.name] = (
-                    "unsupported", f"predicate {pp.name!r} (host-only)")
             elif pp.name in COMPILABLE_PREDS:
                 pred_by_name[pp.name] = ("standard",)
             else:
@@ -219,6 +217,12 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                 unsupported.append(
                     "alwaysCheckAllPredicates with CheckNodeUnschedulable "
                     "(duplicates the mandatory condition check's reason)")
+            if {preds.POD_TOLERATES_NODE_TAINTS_PRED,
+                    preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED} \
+                    <= pred_keys:
+                unsupported.append(
+                    "alwaysCheckAllPredicates with both taint predicates "
+                    "(duplicate reason strings per node)")
     spec = PolicySpec(
         pred_keys=frozenset(pred_keys) if pred_keys is not None else None,
         label_rows=tuple(slot for slot, _ in label_rows),

@@ -180,6 +180,7 @@ class SignatureTables:
 
     selector_ok: np.ndarray      # [Csel, N] bool — nodeSelector + required node affinity
     taint_ok: np.ndarray         # [Ctol, N] bool — NoSchedule/NoExecute taints tolerated
+    taint_ok_noexec: np.ndarray  # [Ctol, N] bool — NoExecute-only variant (policy pred)
     intolerable: np.ndarray      # [Ctol, N] int64 — PreferNoSchedule intolerable count
     affinity_count: np.ndarray   # [Caff, N] int64 — preferred node-affinity weight sum
     avoid_score: np.ndarray      # [Cavoid, N] int64 — NodePreferAvoidPods (0 or 10)
@@ -305,6 +306,9 @@ class CompiledCluster:
     has_disk_conflict: bool = False
     has_maxpd: bool = False
     has_vol_zone: bool = False
+    # taint_ok_noexec holds real rows (vs the all-pass dummy the no-policy
+    # path ships); jaxe.backend recompiles when a policy needs them
+    has_noexec_table: bool = False
     maxpd_limits: tuple = DEFAULT_MAXPD_LIMITS   # (EBS, GCE PD, AzureDisk)
     n_topo_doms: int = 1         # segment count for topo_dom (incl. invalid 0)
     n_zone_doms: int = 1
@@ -974,6 +978,12 @@ def signature_row_fns(nodes: List[Node], node_infos: List["NodeInfo"]):
             node_infos[i].taints, rep.spec.tolerations,
             lambda t: t.effect in ("NoSchedule", "NoExecute")) is None
 
+    def taint_ok_noexec_fn(rep: Pod, i: int) -> bool:
+        # PodToleratesNodeNoExecuteTaints (policy-registered): NoExecute only
+        return find_matching_untolerated_taint(
+            node_infos[i].taints, rep.spec.tolerations,
+            lambda t: t.effect == "NoExecute") is None
+
     def intolerable_fn(rep: Pod, i: int) -> int:
         tols = [t for t in rep.spec.tolerations
                 if not t.effect or t.effect == TAINT_PREFER_NO_SCHEDULE]
@@ -993,6 +1003,7 @@ def signature_row_fns(nodes: List[Node], node_infos: List["NodeInfo"]):
     return {
         "selector_ok": (selector_fn, bool),
         "taint_ok": (taint_ok_fn, bool),
+        "taint_ok_noexec": (taint_ok_noexec_fn, bool),
         "intolerable": (intolerable_fn, np.int64),
         "affinity_count": (affinity_fn, np.int64),
         "avoid_score": (avoid_fn, np.int64),
@@ -1019,11 +1030,16 @@ def fill_pod_request_row(cols: PodColumns, j: int, pod: Pod, req,
     cols.best_effort[j] = is_pod_best_effort(pod)
 
 
-def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[CompiledCluster, PodColumns]:
+def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod],
+                    need_noexec: bool = False
+                    ) -> Tuple[CompiledCluster, PodColumns]:
     """Build columnar state for `pods` scheduled against `snapshot`.
 
     Static matching reuses the parity engine's own functions (semantics match
-    by construction); only numeric aggregates stay dynamic.
+    by construction); only numeric aggregates stay dynamic. need_noexec:
+    compute the PodToleratesNodeNoExecuteTaints table — only a policy can
+    enable that predicate, so the default path skips the row work and ships
+    an all-pass dummy of the right shape.
     """
     nodes = snapshot.nodes
     n = len(nodes)
@@ -1120,6 +1136,8 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
     tables = SignatureTables(
         selector_ok=table(sel_i, "selector_ok"),
         taint_ok=table(tol_i, "taint_ok"),
+        taint_ok_noexec=(table(tol_i, "taint_ok_noexec") if need_noexec else
+                         np.ones((max(len(tol_i), 1), n), dtype=bool)),
         intolerable=table(tol_i, "intolerable"),
         affinity_count=table(aff_i, "affinity_count"),
         avoid_score=table(avoid_i, "avoid_score"),
@@ -1156,6 +1174,7 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod]) -> Tuple[Compile
                                has_interpod=has_interpod,
                                has_disk_conflict=has_disk_conflict,
                                has_maxpd=has_maxpd, has_vol_zone=has_vol_zone,
+                               has_noexec_table=need_noexec,
                                maxpd_limits=maxpd_limits,
                                n_topo_doms=n_topo_doms, n_zone_doms=n_zone_doms,
                                unsupported=unsupported)

@@ -27,7 +27,8 @@ def random_cluster(rng: random.Random):
     n_nodes = rng.randint(8, 14)
     nodes = []
     for i in range(n_nodes):
-        labels = {"zone": f"z{rng.randrange(3)}"}
+        labels = {"zone": f"z{rng.randrange(3)}",
+                  "cores": str(rng.choice([4, 16, 64]))}
         if rng.random() < 0.5:
             labels["disktype"] = rng.choice(["ssd", "hdd"])
         taints = None
@@ -78,12 +79,20 @@ def random_pods(rng: random.Random, count: int):
             kwargs["tolerations"] = [{"key": "team", "operator": "Equal",
                                       "value": rng.choice(["a", "b"]),
                                       "effect": "NoSchedule"}]
-        if rng.random() < 0.2:
+        if rng.random() < 0.25:
+            # the full NodeSelectorRequirement operator set, incl. the
+            # numeric comparisons (Gt/Lt) and existence checks
+            expr = rng.choice([
+                {"key": "zone", "operator": rng.choice(["In", "NotIn"]),
+                 "values": [f"z{rng.randrange(3)}"]},
+                {"key": "cores", "operator": rng.choice(["Gt", "Lt"]),
+                 "values": [str(rng.choice([8, 32]))]},
+                {"key": "disktype",
+                 "operator": rng.choice(["Exists", "DoesNotExist"])},
+            ])
             kwargs["affinity"] = {"nodeAffinity": {
                 "requiredDuringSchedulingIgnoredDuringExecution": {
-                    "nodeSelectorTerms": [{"matchExpressions": [
-                        {"key": "zone", "operator": rng.choice(["In", "NotIn"]),
-                         "values": [f"z{rng.randrange(3)}"]}]}]},
+                    "nodeSelectorTerms": [{"matchExpressions": [expr]}]},
                 "preferredDuringSchedulingIgnoredDuringExecution": [
                     {"weight": rng.randint(1, 50),
                      "preference": {"matchExpressions": [

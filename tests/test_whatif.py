@@ -120,3 +120,79 @@ class TestWhatIf:
         empty = (ClusterSnapshot(nodes=[]), [make_pod("p", milli_cpu=10)])
         results = run_what_if([empty, empty])
         assert [r.unschedulable for r in results] == [1, 1]
+
+
+def test_what_if_with_policy_matches_per_scenario_runs():
+    """A batch-wide policy: each scenario's what-if placements equal a
+    standalone jax policy run over the same snapshot+pods."""
+    from tpusim.engine.policy import (
+        LabelsPresenceArg,
+        Policy,
+        PredicateArgument,
+        PredicatePolicy,
+        PriorityPolicy,
+    )
+    from tpusim.simulator import run_simulation
+
+    policy = Policy(
+        predicates=[
+            PredicatePolicy(name="PodFitsResources"),
+            PredicatePolicy(name="NeedsDisk", argument=PredicateArgument(
+                labels_presence=LabelsPresenceArg(labels=["disktype"],
+                                                  presence=True))),
+        ],
+        priorities=[PriorityPolicy(name="MostRequestedPriority", weight=2)])
+    scenarios = []
+    for s in range(3):
+        nodes = [make_node(f"s{s}-n{i}", milli_cpu=2000 + 1000 * s,
+                           labels={"disktype": "ssd"} if i % 2 == 0 else None)
+                 for i in range(4 + s)]
+        pods = [make_pod(f"s{s}-p{i}", milli_cpu=700) for i in range(6)]
+        scenarios.append((ClusterSnapshot(nodes=nodes), pods))
+
+    results = run_what_if([(snap, list(reversed(pods)))
+                           for snap, pods in scenarios], policy=policy)
+    for (snap, pods), result in zip(scenarios, results):
+        solo = run_simulation(list(pods), snap, backend="jax", policy=policy)
+        batch_placed = sorted((p.pod.name, p.node_name)
+                              for p in result.placements if p.scheduled)
+        solo_placed = sorted((p.name, p.spec.node_name)
+                             for p in solo.successful_pods)
+        assert batch_placed == solo_placed
+        # the label predicate held batch-wide
+        assert all("-n" in node and int(node.split("-n")[1]) % 2 == 0
+                   for _, node in batch_placed)
+
+
+def test_what_if_rejects_host_bound_policy():
+    from tpusim.engine.policy import ExtenderConfig, Policy
+
+    policy = Policy(extender_configs=[ExtenderConfig(url_prefix="http://x",
+                                                     filter_verb="filter")])
+    snap = ClusterSnapshot(nodes=[make_node("n1", milli_cpu=1000)])
+    with pytest.raises(NotImplementedError, match="host-bound"):
+        run_what_if([(snap, [make_pod("p", milli_cpu=10)])], policy=policy)
+
+
+def test_what_if_aca_policy_padding_nodes_stay_invisible():
+    """Node-axis padding must not leak into always-check-all reason counts:
+    a 2-node scenario batched with a 5-node one reports reasons over 2 nodes
+    only."""
+    from tpusim.engine.policy import Policy, PredicatePolicy
+    from tpusim.simulator import run_simulation
+
+    policy = Policy(predicates=[PredicatePolicy(name="PodFitsResources")],
+                    priorities=[], always_check_all_predicates=True)
+    small = ClusterSnapshot(nodes=[make_node(f"a{i}", milli_cpu=100)
+                                   for i in range(2)])
+    big = ClusterSnapshot(nodes=[make_node(f"b{i}", milli_cpu=100)
+                                 for i in range(5)])
+    pod = make_pod("p", milli_cpu=5000)
+    results = run_what_if([(small, [pod]), (big, [pod])], policy=policy)
+    msg_small = results[0].placements[0].message
+    assert msg_small.startswith("0/2 nodes are available")
+    assert "2 Insufficient cpu" in msg_small and "5 " not in msg_small
+    assert "Insufficient pods" not in msg_small
+    # matches the standalone jax policy run byte-for-byte
+    solo = run_simulation([pod], small, backend="jax", policy=policy)
+    assert solo.failed_pods[0].status.conditions[-1].message == msg_small

@@ -129,6 +129,25 @@ def load_snapshot(args) -> ClusterSnapshot:
     return snapshot
 
 
+def load_policy_from_args(args):
+    """(policy | None, error string | None) from the two policy flags."""
+    if not (args.scheduler_policy_file or args.scheduler_policy_configmap_file):
+        return None, None
+    from tpusim.engine.policy import (
+        PolicyError,
+        load_policy_configmap_file,
+        load_policy_file,
+    )
+    try:
+        policy = (load_policy_file(args.scheduler_policy_file)
+                  if args.scheduler_policy_file else
+                  load_policy_configmap_file(
+                      args.scheduler_policy_configmap_file))
+    except (OSError, PolicyError) as exc:
+        return None, f"invalid scheduler policy: {exc}"
+    return policy, None
+
+
 def run_what_if_cli(args) -> int:
     """Batched multi-snapshot mode (BASELINE.json config 5)."""
     import json
@@ -151,10 +170,19 @@ def run_what_if_cli(args) -> int:
         print(f"error: invalid what-if manifest: {exc}", file=sys.stderr)
         return 2
 
+    policy, policy_err = load_policy_from_args(args)
+    if policy_err:
+        print(f"error: {policy_err}", file=sys.stderr)
+        return 2
+
     start = time.perf_counter()
     try:
-        results = run_what_if(scenarios, provider=args.algorithmprovider)
-    except (KeyError, NotImplementedError) as exc:
+        results = run_what_if(scenarios, provider=args.algorithmprovider,
+                              policy=policy)
+    except (KeyError, ValueError, NotImplementedError) as exc:
+        # KeyError: unknown provider/plugin name; ValueError incl. PolicyError
+        # from compile_policy's validation — same contract as the single-run
+        # path's build-time error arm
         print(f"error: {exc}", file=sys.stderr)
         return 2
     elapsed = time.perf_counter() - start
@@ -204,22 +232,10 @@ def main(argv=None) -> int:
         return 2
     pods = expand_simulation_pods(sim_pods, namespace=args.namespace)
 
-    policy = None
-    if args.scheduler_policy_file or args.scheduler_policy_configmap_file:
-        from tpusim.engine.policy import (
-            PolicyError,
-            load_policy_configmap_file,
-            load_policy_file,
-        )
-        try:
-            if args.scheduler_policy_file:
-                policy = load_policy_file(args.scheduler_policy_file)
-            else:
-                policy = load_policy_configmap_file(
-                    args.scheduler_policy_configmap_file)
-        except (OSError, PolicyError) as exc:
-            print(f"error: invalid scheduler policy: {exc}", file=sys.stderr)
-            return 2
+    policy, policy_err = load_policy_from_args(args)
+    if policy_err:
+        print(f"error: {policy_err}", file=sys.stderr)
+        return 2
 
     if args.batch_size and args.backend != "jax":
         print("error: --batch-size requires --backend jax", file=sys.stderr)
@@ -241,7 +257,9 @@ def main(argv=None) -> int:
                                 enable_pod_priority=args.enable_pod_priority,
                                 enable_volume_scheduling=args.enable_volume_scheduling,
                                 policy=policy, events=events)
-    except ValueError as exc:  # invalid policy/provider surfaced at build time
+    except (ValueError, KeyError) as exc:
+        # invalid policy/provider/plugin names surfaced at build time
+        # (PolicyError is a ValueError; the registry raises KeyError)
         print(f"error: {exc}", file=sys.stderr)
         return 2
     elapsed = time.perf_counter() - start

@@ -666,11 +666,17 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     reason_bits = jnp.int64(0)
     if ps is not None and ps.always_check_all:
         # alwaysCheckAllPredicates: every failing stage contributes its
-        # reasons (podFitsOnNode keeps evaluating past the first failure)
+        # reasons (podFitsOnNode keeps evaluating past the first failure).
+        # Sentinel-padded nodes (sharding/what-if node-axis padding: condition
+        # bit 62, never decoded) must contribute NOTHING else, or phantom
+        # nodes would inflate the reason histogram.
         for fail, bits in stages:
             reason_bits = reason_bits | jnp.where(fail, bits, jnp.int64(0))
+        is_pad = (st.cond_fail_bits & (jnp.int64(1) << 62)) != 0
+        reason_bits = jnp.where(is_pad, st.cond_fail_bits, reason_bits)
     else:
-        # short-circuit reason selection: first failing stage wins
+        # short-circuit reason selection: first failing stage wins (padded
+        # nodes fail at the cond stage, whose sentinel bit is never decoded)
         for fail, bits in reversed(stages):
             reason_bits = jnp.where(fail, bits, reason_bits)
     n_feasible = jnp.sum(feasible)

@@ -130,7 +130,8 @@ def _unify(statics: Statics, carry: Carry, xs: PodX, targets: dict,
 def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
                 provider: str = "DefaultProvider",
                 mesh: Optional[object] = None,
-                hard_pod_affinity_symmetric_weight: int = 10) -> List[WhatIfResult]:
+                hard_pod_affinity_symmetric_weight: int = 10,
+                policy=None) -> List[WhatIfResult]:
     """Run independent (snapshot, pods) scenarios as one batched device
     program. Pods are fed in podspec order (callers wanting reference LIFO
     parity pass the reversed list, as run_simulation does).
@@ -139,9 +140,32 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     None runs single-device. The scenario count need not divide the snap axis —
     the batch is padded with a replica of the first scenario and the padding
     dropped on decode.
+
+    policy: an engine.policy.Policy applied to EVERY scenario (one jitted
+    program serves the batch, so the policy is batch-wide); host-bound policy
+    features raise — what-if has no per-scenario host fallback.
     """
     if provider not in _KNOWN_PROVIDERS:
         raise KeyError(f"plugin {provider!r} has not been registered")
+    cp = None
+    if policy is not None:
+        from tpusim.jaxe.policyc import compile_policy
+
+        cp = compile_policy(policy)
+        if cp.unsupported:
+            detail = "; ".join(sorted(set(cp.unsupported))[:5])
+            raise NotImplementedError(
+                "what-if batching requires a jax-compilable policy; "
+                f"host-bound: {detail}")
+        if cp.hard_weight is not None:
+            hard_pod_affinity_symmetric_weight = cp.hard_weight
+    from tpusim.engine.predicates import (
+        POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+    )
+
+    need_noexec = (cp is not None and cp.spec.pred_keys is not None
+                   and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
+                   in cp.spec.pred_keys)
     if not scenarios:
         return []
     ensure_x64()
@@ -160,7 +184,8 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
             empty_results[i] = WhatIfResult(placements=placements, scheduled=0,
                                             unschedulable=len(pods))
             continue
-        compiled, cols = compile_cluster(snapshot, pods)
+        compiled, cols = compile_cluster(snapshot, pods,
+                                         need_noexec=need_noexec)
         if compiled.unsupported:
             detail = "; ".join(sorted(set(compiled.unsupported))[:5])
             raise NotImplementedError(
@@ -176,8 +201,26 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     n_node_shards = mesh.shape["node"] if mesh is not None else 1
 
     # host-side trees: unify + pad on numpy, upload once after stacking
-    host_trees = [(statics_to_host(compiled), carry_init_host(compiled),
-                   pod_columns_to_host(cols)) for compiled, cols in compiled_list]
+    host_trees = []
+    for b, (compiled, cols) in enumerate(compiled_list):
+        host_statics = statics_to_host(compiled)
+        if cp is not None:
+            from tpusim.jaxe.policyc import (
+                image_locality_columns,
+                policy_static_rows,
+            )
+
+            snapshot, pods = scenarios[batch_indices[b]]
+            label_ok, label_prio = policy_static_rows(
+                cp, snapshot.nodes, compiled.node_index)
+            host_statics = host_statics._replace(label_ok=label_ok,
+                                                 label_prio=label_prio)
+            if cp.spec.w_image:
+                cols.img_id, image_score = image_locality_columns(
+                    pods, snapshot.nodes, compiled.node_index)
+                host_statics = host_statics._replace(image_score=image_score)
+        host_trees.append((host_statics, carry_init_host(compiled),
+                           pod_columns_to_host(cols)))
 
     # common shapes
     targets = _axis_targets(host_trees)
@@ -216,6 +259,10 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         most_requested=provider in _MOST_REQUESTED_PROVIDERS,
         num_reason_bits=NUM_FIXED_BITS + s_max,
         hard_weight=hard_pod_affinity_symmetric_weight)
+    if cp is not None:
+        from dataclasses import replace as _dc_replace
+
+        config = _dc_replace(config, policy=cp.spec)
     step = make_step(config)
 
     @jax.jit

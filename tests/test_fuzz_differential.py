@@ -161,6 +161,17 @@ def test_fuzz_policy_parity():
                         presence=rng.random() < 0.7))))
         prios = [PriorityPolicy(name=n, weight=rng.randint(1, 5)) for n in
                  rng.sample(prio_pool, rng.randint(1, 4))]
+        if rng.random() < 0.5:
+            from tpusim.engine.policy import (
+                PriorityArgument,
+                ServiceAntiAffinityArg,
+            )
+
+            prios.append(PriorityPolicy(
+                name="SpreadByZone", weight=rng.randint(1, 4),
+                argument=PriorityArgument(
+                    service_anti_affinity=ServiceAntiAffinityArg(
+                        label="zone"))))
         policy = Policy(predicates=preds, priorities=prios)
         ref = run_simulation(list(pods), snapshot, backend="reference",
                              policy=policy)

@@ -336,3 +336,73 @@ def test_policy_no_execute_taints_on_device():
         PredicatePolicy(name="PodToleratesNodeNoExecuteTaints")],
         priorities=[], always_check_all_predicates=True)
     assert compile_policy(both).unsupported
+
+
+def _saa_world(rng_seed=0):
+    from tpusim.api.types import Service
+
+    import random as _random
+    rng = _random.Random(rng_seed)
+    nodes = []
+    for i in range(8):
+        labels = {}
+        if i < 6:
+            labels["rack"] = f"r{i % 3}"
+        nodes.append(make_node(f"n{i}", milli_cpu=4000, labels=labels or None))
+    svc = Service.from_obj({"metadata": {"name": "db", "namespace": "default"},
+                            "spec": {"selector": {"app": "db"}}})
+    svc2 = Service.from_obj({"metadata": {"name": "db2",
+                                          "namespace": "default"},
+                             "spec": {"selector": {"tier": "data"}}})
+    placed = [make_pod(f"seed-{i}", milli_cpu=100,
+                       node_name=f"n{rng.randrange(6)}", phase="Running",
+                       labels={"app": "db"}) for i in range(4)]
+    pods = [make_pod(f"p{i}", milli_cpu=300,
+                     labels={"app": "db"} if i % 2 == 0 else
+                     {"tier": "data"}) for i in range(10)]
+    return ClusterSnapshot(nodes=nodes, pods=placed,
+                           services=[svc, svc2]), pods
+
+
+def test_policy_service_anti_affinity_on_device():
+    """ServiceAntiAffinity compiles: first-matching-service selectors are
+    static, so spreading over the policy label's node groups runs on device
+    and matches the host map/reduce exactly."""
+    from tpusim.engine.policy import ServiceAntiAffinityArg
+
+    policy = Policy(
+        predicates=[PredicatePolicy(name="PodFitsResources")],
+        priorities=[
+            PriorityPolicy(name="SpreadByRack", weight=3,
+                           argument=PriorityArgument(
+                               service_anti_affinity=ServiceAntiAffinityArg(
+                                   label="rack"))),
+            PriorityPolicy(name="LeastRequestedPriority", weight=1),
+        ])
+    cp = compile_policy(policy)
+    assert not cp.unsupported and cp.spec.saa_weights == (3,)
+    snap, pods = _saa_world()
+    status = assert_policy_parity(pods, snap, policy)
+    # the dominating spread weight keeps db pods on labeled racks
+    assert status.successful_pods
+    placed_nodes = {p.spec.node_name for p in status.successful_pods}
+    assert placed_nodes <= {f"n{i}" for i in range(6)}
+
+
+def test_policy_service_anti_affinity_no_services():
+    """Without any matching service the host still scores labeled nodes 10
+    and unlabeled 0 — reproduced on device with zero-count tables."""
+    from tpusim.engine.policy import ServiceAntiAffinityArg
+
+    policy = Policy(
+        predicates=[PredicatePolicy(name="PodFitsResources")],
+        priorities=[PriorityPolicy(name="Spread", weight=2,
+                                   argument=PriorityArgument(
+                                       service_anti_affinity=
+                                       ServiceAntiAffinityArg(label="rack")))])
+    nodes = [make_node("labeled", milli_cpu=1000, labels={"rack": "r0"}),
+             make_node("bare", milli_cpu=8000)]
+    pods = [make_pod(f"p{i}", milli_cpu=100) for i in range(3)]
+    status = assert_policy_parity(pods, ClusterSnapshot(nodes=nodes), policy)
+    # labeled node wins despite less capacity (score 10*2 vs 0)
+    assert all(p.spec.node_name == "labeled" for p in status.successful_pods)

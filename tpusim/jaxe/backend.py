@@ -138,12 +138,16 @@ class JaxBackend:
         need_noexec = (cp is not None and cp.spec.pred_keys is not None
                        and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
                        in cp.spec.pred_keys)
-        compiled, cols = precompiled or compile_cluster(snapshot, pods,
-                                                        need_noexec=need_noexec)
-        if need_noexec and not compiled.has_noexec_table:
+        need_saa = cp is not None and bool(cp.spec.saa_weights)
+        compiled, cols = precompiled or compile_cluster(
+            snapshot, pods, need_noexec=need_noexec, need_saa=need_saa)
+        if (need_noexec and not compiled.has_noexec_table) \
+                or (need_saa and not compiled.has_saa_table):
             # a precompiled (event-log/incremental) state built without the
-            # policy-only table: recompile fresh for this rare combination
-            compiled, cols = compile_cluster(snapshot, pods, need_noexec=True)
+            # policy-only tables: recompile fresh for this rare combination
+            compiled, cols = compile_cluster(snapshot, pods,
+                                             need_noexec=need_noexec,
+                                             need_saa=need_saa)
         unsupported = list(compiled.unsupported)
         if cp is not None:
             unsupported.extend(cp.unsupported)
@@ -172,6 +176,12 @@ class JaxBackend:
             from dataclasses import replace as _dc_replace
 
             config = _dc_replace(config, policy=cp.spec)
+            if cp.saa_entries:
+                from tpusim.jaxe.policyc import saa_dom_rows
+
+                saa_dom, n_saa_doms = saa_dom_rows(cp, snapshot.nodes,
+                                                   compiled.node_index)
+                config = _dc_replace(config, n_saa_doms=n_saa_doms)
 
         ensure_x64()
         carry = carry_init(compiled)
@@ -196,6 +206,8 @@ class JaxBackend:
                 cols.img_id, image_score = image_locality_columns(
                     pods, snapshot.nodes, compiled.node_index)
                 host_statics = host_statics._replace(image_score=image_score)
+            if cp.saa_entries:
+                host_statics = host_statics._replace(saa_dom=saa_dom)
             statics = _tree_to_device(host_statics)
         xs = pod_columns_to_device(cols)
         # On TPU the per-pod filter→score→select→bind pipeline is one fused

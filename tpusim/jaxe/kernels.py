@@ -122,6 +122,8 @@ class Statics(NamedTuple):
     zone_ok: jnp.ndarray
     ss_rows: jnp.ndarray
     ss_sig: jnp.ndarray
+    saa_rows: jnp.ndarray
+    saa_sig: jnp.ndarray
     term_match: jnp.ndarray
     zone_dom: jnp.ndarray
     topo_dom: jnp.ndarray
@@ -156,6 +158,9 @@ class Statics(NamedTuple):
     label_ok: jnp.ndarray
     label_prio: jnp.ndarray
     image_score: jnp.ndarray
+    #   saa_dom — [E, N] per-ServiceAntiAffinity-entry node label-value domain
+    #             ids (0 = label absent), from jaxe.policyc
+    saa_dom: jnp.ndarray
 
 
 class PodX(NamedTuple):
@@ -201,6 +206,9 @@ class PolicySpec:
     w_spread: int = 0
     w_interpod: int = 0
     w_image: int = 0           # ImageLocalityPriority (table-driven)
+    # ServiceAntiAffinity custom priorities: one weight per entry, parallel
+    # to the Statics.saa_dom rows (selector_spreading.go:176-280)
+    saa_weights: tuple = ()
     # first-failure reason selection becomes collect-all-failures
     # (generic_scheduler.go alwaysCheckAllPredicates)
     always_check_all: bool = False
@@ -233,6 +241,9 @@ class EngineConfig:
     scan_unroll: int = 1
     # policy-as-data overrides (None = the named provider's defaults)
     policy: PolicySpec = None
+    # segment count for the ServiceAntiAffinity label domains (incl. the
+    # invalid-0 bucket); set by the backend from the compiled node labels
+    n_saa_doms: int = 1
 
 
 # ---------------------------------------------------------------------------
@@ -254,6 +265,7 @@ STATICS_AXES = dict(
     vol_mask=("group", "vol_id"), vol_type=("vol_id", "vol_filter"),
     zone_ok=("group", "node"),
     ss_rows=("spread_sig", "group"), ss_sig=("group",),
+    saa_rows=("saa_sig", "group"), saa_sig=("group",),
     term_match=("term_sig", "group"),
     zone_dom=("node",), topo_dom=("topo_key", "node"),
     aff_valid=("group", "aff_term"), aff_err=("group",),
@@ -266,7 +278,7 @@ STATICS_AXES = dict(
     pref_w=("group", "pref_term"), pref_term=("group", "pref_term"),
     pref_key=("group", "pref_term"),
     label_ok=("label_pred", "node"), label_prio=("node",),
-    image_score=("sig_img", "node"),
+    image_score=("sig_img", "node"), saa_dom=("saa_entry", "node"),
 )
 CARRY_AXES = dict(
     used_cpu=("node",), used_mem=("node",), used_gpu=("node",), used_eph=("node",),
@@ -331,7 +343,8 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         port_conflict=gt.port_conflict, port_sig=gt.port_sig,
         disk_conflict=gt.disk_conflict, disk_sig=gt.disk_sig,
         vol_mask=gt.vol_mask, vol_type=gt.vol_type, zone_ok=gt.zone_ok,
-        ss_rows=gt.ss_rows, ss_sig=gt.ss_sig, term_match=gt.term_match,
+        ss_rows=gt.ss_rows, ss_sig=gt.ss_sig,
+        saa_rows=gt.saa_rows, saa_sig=gt.saa_sig, term_match=gt.term_match,
         zone_dom=gt.zone_dom, topo_dom=gt.topo_dom,
         aff_valid=gt.aff_valid, aff_err=gt.aff_err, aff_empty=gt.aff_empty,
         aff_term=gt.aff_term, aff_key=gt.aff_key,
@@ -344,7 +357,8 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         # trivial policy rows; jaxe.policyc overwrites them via _replace
         label_ok=np.ones((1, len(s.alloc_cpu)), dtype=bool),
         label_prio=np.zeros(len(s.alloc_cpu), dtype=np.int64),
-        image_score=np.zeros((1, len(s.alloc_cpu)), dtype=np.int64))
+        image_score=np.zeros((1, len(s.alloc_cpu)), dtype=np.int64),
+        saa_dom=np.zeros((1, len(s.alloc_cpu)), dtype=np.int32))
 
 
 def _presence_dom_init(presence: np.ndarray, topo_dom: np.ndarray,
@@ -741,6 +755,29 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         # ImageLocalityPriority (image_locality.go): static per
         # (pod-image-set, node) score row
         score = score + st.image_score[x.img_id] * ps.w_image
+
+    if ps is not None and ps.saa_weights:
+        # ServiceAntiAffinity (selector_spreading.go:176-280): spread the
+        # pods matching MY first service's selector across node groups
+        # identified by the policy label. cnt counts such pods per node;
+        # the reduce is over feasible nodes (the host maps over filtered
+        # nodes only); unlabeled nodes score 0.
+        saa_cnt = st.saa_rows[st.saa_sig[x.group_id]].astype(jnp.float64) @ \
+            carry.presence.astype(jnp.float64)                  # [N]
+        saa_fcnt = jnp.where(feasible, saa_cnt, 0.0)
+        saa_total = jnp.sum(saa_fcnt)
+        for e, w_saa in enumerate(ps.saa_weights):
+            dom = st.saa_dom[e]
+            labeled = dom > 0
+            grp = jax.ops.segment_sum(
+                jnp.where(labeled, saa_fcnt, 0.0), dom,
+                num_segments=config.n_saa_doms).at[0].set(0.0)
+            f_score = jnp.where(
+                saa_total > 0,
+                MAX_PRIORITY * ((saa_total - grp[dom]) / saa_total),
+                float(MAX_PRIORITY))
+            score = score + jnp.where(labeled, f_score.astype(jnp.int64),
+                                      0) * w_saa
 
     if config.has_services and w_spread:
         # SelectorSpreadPriority (selector_spreading.go:66-175): per-node count

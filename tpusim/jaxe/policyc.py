@@ -12,15 +12,18 @@ but instead of assembling host predicate/priority closures it produces:
 
 Host-bound policy features have no device encoding and fall back to the
 reference engine (the same containment as volume workloads): extenders (HTTP
-round-trips mid-filter), ServiceAffinity / ServiceAntiAffinity (both depend
-on lister-ORDER over live placements — the first matching pod/service defines
-the constraint — which presence counts cannot represent), and the few
+round-trips mid-filter), the ServiceAffinity PREDICATE (its constraint is the
+node of the first matching POD in lister order — a property of live
+placements that presence counts cannot represent), and the few
 alwaysCheckAllPredicates shapes where the host can emit one reason string
 twice per node (the device histogram is bit-per-string). Everything else in
 the 1.10 registry compiles: ImageLocality and the NoExecute taint variant
-ride static signature tables, and alwaysCheckAllPredicates otherwise runs on
-device (reason bits OR over all failing stages). Unknown names raise the host
-registry's KeyError byte-for-byte."""
+ride static signature tables; ServiceAntiAffinity compiles because services
+are static during a run, so its first-matching-SERVICE selector interns at
+group-compile time (state._compile_groups saa tables); and
+alwaysCheckAllPredicates otherwise runs on device (reason bits OR over all
+failing stages). Unknown names raise the host registry's KeyError
+byte-for-byte."""
 
 from __future__ import annotations
 
@@ -88,6 +91,9 @@ class CompiledPolicy:
     label_rows: List[Tuple[str, list]] = field(default_factory=list)
     # custom label priorities: (label, presence, weight)
     label_prios: List[Tuple[str, bool, int]] = field(default_factory=list)
+    # ServiceAntiAffinity entries: (node label, weight), parallel to
+    # spec.saa_weights
+    saa_entries: List[Tuple[str, int]] = field(default_factory=list)
     # host-bound features forcing the reference fallback (empty = compilable)
     unsupported: List[str] = field(default_factory=list)
 
@@ -158,6 +164,7 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
 
     weights = dict(_DEFAULT_WEIGHTS)
     label_prios: List[Tuple[str, bool, int]] = []
+    saa_entries: List[Tuple[str, int]] = []
     image_weight = 0
     if policy.priorities is not None:
         weights = dict.fromkeys(weights, 0)
@@ -166,8 +173,7 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
             arg = pr.argument
             if arg is not None and arg.service_anti_affinity is not None:
                 prio_by_name[pr.name] = (
-                    "unsupported", f"ServiceAntiAffinity priority {pr.name!r} "
-                    "(label-group spreading over live placements)")
+                    "saa", (arg.service_anti_affinity.label, pr.weight))
             elif arg is not None and arg.label_preference is not None:
                 prio_by_name[pr.name] = (
                     "label", (arg.label_preference.label,
@@ -191,6 +197,8 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                 label_prios.append(entry[1])
             elif entry[0] == "image":
                 image_weight = entry[1]
+            elif entry[0] == "saa":
+                saa_entries.append(entry[1])
             elif entry[0] == "unsupported":
                 unsupported.append(entry[1])
             # "equal": constant shift; no effect on selection or ties
@@ -228,13 +236,15 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
         label_rows=tuple(slot for slot, _ in label_rows),
         has_label_prio=bool(label_prios),
         w_image=image_weight,
+        saa_weights=tuple(w for _, w in saa_entries),
         always_check_all=aca,
         **weights)
     hard = (policy.hard_pod_affinity_symmetric_weight
             if policy.hard_pod_affinity_symmetric_weight != 0 else None)
     return CompiledPolicy(spec=spec, hard_weight=hard,
                           label_rows=label_rows,
-                          label_prios=label_prios, unsupported=unsupported)
+                          label_prios=label_prios, saa_entries=saa_entries,
+                          unsupported=unsupported)
 
 
 def _label_pred_row(nodes_by_idx: list, entries) -> np.ndarray:
@@ -286,6 +296,32 @@ def image_locality_columns(pods, nodes, node_index: Dict[str, int]):
             info = SimpleNamespace(node=node)
             table[s, i] = image_locality_priority_map(rep, None, info).score
     return img_id, table
+
+
+def saa_dom_rows(cp: CompiledPolicy, nodes, node_index: Dict[str, int]):
+    """(saa_dom [E, N] int32, n_doms int): per-ServiceAntiAffinity-entry
+    node label-value domains (0 = label absent; values interned per entry,
+    one shared segment count)."""
+    n = len(node_index)
+    e_count = max(len(cp.saa_entries), 1)
+    dom = np.zeros((e_count, n), dtype=np.int32)
+    n_doms = 1
+    for e, (label, _w) in enumerate(cp.saa_entries):
+        values: Dict[str, int] = {}
+        for node in nodes:
+            i = node_index.get(node.name)
+            if i is None:
+                continue
+            value = node.metadata.labels.get(label)
+            if value is None:
+                continue
+            vid = values.get(value)
+            if vid is None:
+                vid = len(values) + 1
+                values[value] = vid
+            dom[e, i] = vid
+        n_doms = max(n_doms, len(values) + 1)
+    return dom, n_doms
 
 
 def policy_static_rows(cp: CompiledPolicy, nodes,

@@ -231,6 +231,11 @@ class GroupTables:
     used_vols_init: np.ndarray   # [N, V] bool — placed pods' volume ids per node
     ss_rows: np.ndarray          # [Sd, G] bool — b counts toward spread sig s
     ss_sig: np.ndarray           # [G] int32 — group -> its spread sig (0 = none)
+    # ServiceAntiAffinity (policy): first-matching-service selector signatures
+    # (getFirstServiceSelector is lister-order-first, and services are static
+    # during a run, so "first" is a compile-time property)
+    saa_rows: np.ndarray         # [Fd, G] bool — b counts toward first-sel f
+    saa_sig: np.ndarray          # [G] int32 — group -> its first-sel sig (0 = none)
     term_match: np.ndarray       # [Td, G] bool — term t matches a pod of group b
     zone_dom: np.ndarray         # [N] int32
     topo_dom: np.ndarray         # [K, N] int32
@@ -306,9 +311,10 @@ class CompiledCluster:
     has_disk_conflict: bool = False
     has_maxpd: bool = False
     has_vol_zone: bool = False
-    # taint_ok_noexec holds real rows (vs the all-pass dummy the no-policy
-    # path ships); jaxe.backend recompiles when a policy needs them
+    # taint_ok_noexec / saa tables hold real rows (vs the dummies the
+    # no-policy path ships); jaxe.backend recompiles when a policy needs them
     has_noexec_table: bool = False
+    has_saa_table: bool = False
     maxpd_limits: tuple = DEFAULT_MAXPD_LIMITS   # (EBS, GCE PD, AzureDisk)
     n_topo_doms: int = 1         # segment count for topo_dom (incl. invalid 0)
     n_zone_doms: int = 1
@@ -598,6 +604,7 @@ def _trivial_groups(num_pods: int, n: int) -> "GroupTables":
         vol_mask=z((1, 1), bool), vol_type=z((1, 3), bool),
         zone_ok=np.ones((1, n), bool), used_vols_init=z((n, 1), bool),
         ss_rows=z((1, 1), bool), ss_sig=z(1, np.int32),
+        saa_rows=z((1, 1), bool), saa_sig=z(1, np.int32),
         term_match=z((1, 1), bool),
         zone_dom=z(n, np.int32), topo_dom=z((1, n), np.int32),
         aff_valid=z((1, 1), bool), aff_err=z(1, bool), aff_empty=z((1, 1), bool),
@@ -612,7 +619,8 @@ def _trivial_groups(num_pods: int, n: int) -> "GroupTables":
 
 
 def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
-                    nodes: List[Node], node_index: Dict[str, int]):
+                    nodes: List[Node], node_index: Dict[str, int],
+                    need_saa: bool = False):
     """Build GroupTables + feature flags. Returns
     (tables, has_ports, has_services, has_interpod, n_topo_doms, n_zone_doms,
     unsupported, sig_to_gid, vol_meta) where sig_to_gid maps each raw
@@ -720,6 +728,13 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
             f"pod-group service scan ({len(snapshot.services)} services x "
             f"{graw} raw groups) exceeds the jax backend work budget "
             f"({max_work})")
+    # ServiceAntiAffinity first-service signature rides the same scan: the
+    # spread loop builds `sels` in lister order, so the FIRST matching
+    # service's selector (priorities.ServiceAntiAffinity
+    # ._first_service_selector) is sels[0]; policy-only (need_saa)
+    saa_defs: List[tuple] = [None]
+    saa_ids: Dict[str, int] = {}
+    saa_sig_raw = np.zeros(graw, np.int32)
     if has_services:
         for b, rep in enumerate(raw_reps):
             sels = [dict(svc.selector) for svc in snapshot.services
@@ -736,13 +751,23 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
                 spread_ids[key] = sid
                 spread_defs.append((rep.namespace, sels))
             ss_sig_raw[b] = sid
+            if need_saa:
+                fkey = json.dumps([rep.namespace,
+                                   json.dumps(sels[0], sort_keys=True)])
+                fid = saa_ids.get(fkey)
+                if fid is None:
+                    fid = len(saa_defs)
+                    saa_ids[fkey] = fid
+                    saa_defs.append((rep.namespace, sels[0]))
+                saa_sig_raw[b] = fid
     sd = len(spread_defs)
+    fd = len(saa_defs)
 
-    if (td + sd) * graw > max_work:
+    if (td + sd + (fd - 1)) * graw > max_work:
         return fallback(
-            f"pod-group matcher precompute ({td} terms + {sd} spread sigs x "
-            f"{graw} raw groups) exceeds the jax backend work budget "
-            f"({max_work})")
+            f"pod-group matcher precompute ({td} terms + {sd} spread sigs + "
+            f"{fd - 1} service-anti-affinity sigs x {graw} raw groups) "
+            f"exceeds the jax backend work budget ({max_work})")
 
     # port-set interning; 0 = no ports
     port_defs: List[list] = [[]]
@@ -783,6 +808,13 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
                 all(rep.metadata.labels.get(k) == v for k, v in sel.items())
                 for sel in sels)
 
+    saa_rows_raw = np.zeros((fd, graw), dtype=bool)
+    for fid in range(1, fd):
+        ns, sel = saa_defs[fid]
+        for b, rep in enumerate(raw_reps):
+            saa_rows_raw[fid, b] = rep.namespace == ns and all(
+                rep.metadata.labels.get(k) == v for k, v in sel.items())
+
     # --- 4. merge raw groups by match profile ---
     # two raw groups are indistinguishable when every matcher treats them the
     # same (same term/spread columns, same port set) AND they act identically
@@ -792,7 +824,9 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
     rep_raw_idx: List[int] = []
     for b in range(graw):
         profile = (term_match_raw[:, b].tobytes(), ss_rows_raw[:, b].tobytes(),
-                   int(port_sig_raw[b]), int(ss_sig_raw[b]), int(vsig_raw[b]),
+                   saa_rows_raw[:, b].tobytes(),
+                   int(port_sig_raw[b]), int(ss_sig_raw[b]),
+                   int(saa_sig_raw[b]), int(vsig_raw[b]),
                    tuple(aff_of[b]), tuple(anti_of[b]), tuple(pref_of[b]))
         gid = merged.get(profile)
         if gid is None:
@@ -817,8 +851,10 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
     sel_cols = np.array(rep_raw_idx, dtype=np.int64)
     term_match = term_match_raw[:, sel_cols] if graw else term_match_raw
     ss_rows = ss_rows_raw[:, sel_cols] if graw else ss_rows_raw
+    saa_rows = saa_rows_raw[:, sel_cols] if graw else saa_rows_raw
     port_sig = port_sig_raw[sel_cols].astype(np.int32)
     ss_sig = ss_sig_raw[sel_cols].astype(np.int32)
+    saa_sig = saa_sig_raw[sel_cols].astype(np.int32)
 
     disk_sig = vsig_raw[sel_cols].astype(np.int32)
     vol_mask = vsig_mask[vsig_raw[sel_cols]]        # [G, V]
@@ -925,7 +961,8 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
         disk_conflict=disk_conflict, disk_sig=disk_sig,
         vol_mask=vol_mask, vol_type=vol_type, zone_ok=zone_ok,
         used_vols_init=used_vols_init,
-        ss_rows=ss_rows, ss_sig=ss_sig, term_match=term_match,
+        ss_rows=ss_rows, ss_sig=ss_sig,
+        saa_rows=saa_rows, saa_sig=saa_sig, term_match=term_match,
         zone_dom=zone_dom, topo_dom=topo_dom,
         aff_valid=aff_valid, aff_err=aff_err, aff_empty=aff_empty,
         aff_term=aff_term, aff_key=aff_key, aff_hostname=aff_hostname,
@@ -1031,7 +1068,7 @@ def fill_pod_request_row(cols: PodColumns, j: int, pod: Pod, req,
 
 
 def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod],
-                    need_noexec: bool = False
+                    need_noexec: bool = False, need_saa: bool = False
                     ) -> Tuple[CompiledCluster, PodColumns]:
     """Build columnar state for `pods` scheduled against `snapshot`.
 
@@ -1117,7 +1154,8 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod],
     node_index = {nd.name: i for i, nd in enumerate(nodes)}
     (groups, has_ports, has_services, has_interpod, n_topo_doms, n_zone_doms,
      group_unsupported, _, vol_meta) = _compile_groups(snapshot, pods, nodes,
-                                                       node_index)
+                                                       node_index,
+                                                       need_saa=need_saa)
     has_disk_conflict, has_maxpd, has_vol_zone, maxpd_limits = vol_meta
     unsupported.extend(group_unsupported)
     cols.group_id = groups.group_of_pod
@@ -1175,6 +1213,7 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod],
                                has_disk_conflict=has_disk_conflict,
                                has_maxpd=has_maxpd, has_vol_zone=has_vol_zone,
                                has_noexec_table=need_noexec,
+                               has_saa_table=need_saa,
                                maxpd_limits=maxpd_limits,
                                n_topo_doms=n_topo_doms, n_zone_doms=n_zone_doms,
                                unsupported=unsupported)

@@ -166,6 +166,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     need_noexec = (cp is not None and cp.spec.pred_keys is not None
                    and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
                    in cp.spec.pred_keys)
+    need_saa = cp is not None and bool(cp.spec.saa_weights)
     if not scenarios:
         return []
     ensure_x64()
@@ -185,7 +186,8 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
                                             unschedulable=len(pods))
             continue
         compiled, cols = compile_cluster(snapshot, pods,
-                                         need_noexec=need_noexec)
+                                         need_noexec=need_noexec,
+                                         need_saa=need_saa)
         if compiled.unsupported:
             detail = "; ".join(sorted(set(compiled.unsupported))[:5])
             raise NotImplementedError(
@@ -201,6 +203,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     n_node_shards = mesh.shape["node"] if mesh is not None else 1
 
     # host-side trees: unify + pad on numpy, upload once after stacking
+    n_saa_doms = 1
     host_trees = []
     for b, (compiled, cols) in enumerate(compiled_list):
         host_statics = statics_to_host(compiled)
@@ -208,6 +211,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
             from tpusim.jaxe.policyc import (
                 image_locality_columns,
                 policy_static_rows,
+                saa_dom_rows,
             )
 
             snapshot, pods = scenarios[batch_indices[b]]
@@ -219,6 +223,11 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
                 cols.img_id, image_score = image_locality_columns(
                     pods, snapshot.nodes, compiled.node_index)
                 host_statics = host_statics._replace(image_score=image_score)
+            if cp.saa_entries:
+                saa_dom, doms = saa_dom_rows(cp, snapshot.nodes,
+                                             compiled.node_index)
+                host_statics = host_statics._replace(saa_dom=saa_dom)
+                n_saa_doms = max(n_saa_doms, doms)
         host_trees.append((host_statics, carry_init_host(compiled),
                            pod_columns_to_host(cols)))
 
@@ -262,7 +271,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     if cp is not None:
         from dataclasses import replace as _dc_replace
 
-        config = _dc_replace(config, policy=cp.spec)
+        config = _dc_replace(config, policy=cp.spec, n_saa_doms=n_saa_doms)
     step = make_step(config)
 
     @jax.jit

@@ -159,6 +159,13 @@ def test_fuzz_policy_parity():
                     labels_presence=LabelsPresenceArg(
                         labels=["disktype"],
                         presence=rng.random() < 0.7))))
+        if rng.random() < 0.5:
+            from tpusim.engine.policy import ServiceAffinityArg
+
+            preds.append(PredicatePolicy(
+                name="StickToZone", argument=PredicateArgument(
+                    service_affinity=ServiceAffinityArg(
+                        labels=[rng.choice(["zone", "disktype"])]))))
         prios = [PriorityPolicy(name=n, weight=rng.randint(1, 5)) for n in
                  rng.sample(prio_pool, rng.randint(1, 4))]
         if rng.random() < 0.5:

@@ -196,3 +196,54 @@ def test_what_if_aca_policy_padding_nodes_stay_invisible():
     # matches the standalone jax policy run byte-for-byte
     solo = run_simulation([pod], small, backend="jax", policy=policy)
     assert solo.failed_pods[0].status.conditions[-1].message == msg_small
+
+
+def test_what_if_service_affinity_policy_matches_solo_runs():
+    """Service(Anti)Affinity in batched mode: per-scenario locks/domains ride
+    the snapshot axis and match standalone jax runs."""
+    from tpusim.api.types import Service
+    from tpusim.engine.policy import (
+        Policy,
+        PredicateArgument,
+        PredicatePolicy,
+        PriorityArgument,
+        PriorityPolicy,
+        ServiceAffinityArg,
+        ServiceAntiAffinityArg,
+    )
+    from tpusim.simulator import run_simulation
+
+    policy = Policy(
+        predicates=[
+            PredicatePolicy(name="PodFitsResources"),
+            PredicatePolicy(name="ByZone", argument=PredicateArgument(
+                service_affinity=ServiceAffinityArg(labels=["zone"]))),
+        ],
+        priorities=[PriorityPolicy(name="SpreadByZone", weight=2,
+                                   argument=PriorityArgument(
+                                       service_anti_affinity=
+                                       ServiceAntiAffinityArg(label="zone")))])
+    svc = Service.from_obj({"metadata": {"name": "db", "namespace": "default"},
+                            "spec": {"selector": {"app": "db"}}})
+    scenarios = []
+    for s in range(3):
+        nodes = [make_node(f"s{s}n{i}", milli_cpu=6000,
+                           labels={"zone": f"z{i % (2 + s)}"})
+                 for i in range(4 + s)]
+        seed = make_pod(f"s{s}-seed", milli_cpu=100, node_name=f"s{s}n0",
+                        phase="Running", labels={"app": "db"})
+        pods = [make_pod(f"s{s}-p{i}", milli_cpu=300,
+                         labels={"app": "db"} if i % 2 == 0 else None)
+                for i in range(6)]
+        scenarios.append((ClusterSnapshot(nodes=nodes, pods=[seed],
+                                          services=[svc]), pods))
+
+    results = run_what_if([(snap, list(reversed(pods)))
+                           for snap, pods in scenarios], policy=policy)
+    for (snap, pods), result in zip(scenarios, results):
+        solo = run_simulation(list(pods), snap, backend="jax", policy=policy)
+        batch_placed = sorted((p.pod.name, p.node_name)
+                              for p in result.placements if p.scheduled)
+        solo_placed = sorted((p.name, p.spec.node_name)
+                             for p in solo.successful_pods)
+        assert batch_placed == solo_placed

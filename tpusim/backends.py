@@ -93,6 +93,12 @@ class ReferenceBackend:
         node_info_map = new_node_info_map(snapshot.nodes, snapshot.pods)
         nodes = list(snapshot.nodes)
 
+        # the plugin pod lister is the SCHEDULER CACHE, not the store
+        # (factory.go:166 podLister: schedulerCache): assigned pods only —
+        # seeded placed pods in snapshot order, then bound pods in bind
+        # order (the cache's deterministic stand-in for Go's random map
+        # iteration; DEVIATIONS.md #4). "First matching pod" consumers (the
+        # ServiceAffinity predicate) depend on this order.
         cluster_pods: List[Pod] = [p for p in snapshot.pods if p.spec.node_name]
         binder = VolumeBinder(snapshot.pvs, snapshot.pvcs,
                               snapshot.storage_classes,
@@ -144,7 +150,7 @@ class ReferenceBackend:
                 binder.assume_pod_volumes(pod, host)
             bound = bind_pod(pod, host)
             node_info_map[host].add_pod(bound)
-            cluster_pods.append(bound)
+            cluster_pods.append(bound)  # enters the cache view on bind
             placements.append(Placement(pod=bound, node_name=host))
         return placements
 

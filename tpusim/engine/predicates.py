@@ -713,10 +713,15 @@ def make_service_affinity_predicate(affinity_labels: List[str],
                     first = service_pods[0]
                     if first.spec.node_name:
                         other = node_getter(first.spec.node_name)
-                        if other is not None:
+                        # the factory wires the scheduler cache's NodeInfo
+                        # getter (providers.py register_custom_fit_predicate);
+                        # accept a bare Node too
+                        other_node = getattr(other, "node", other)
+                        if other_node is not None:
+                            labels = other_node.metadata.labels
                             for l in unresolved:
-                                if l in other.metadata.labels:
-                                    affinity_selector[l] = other.metadata.labels[l]
+                                if l in labels:
+                                    affinity_selector[l] = labels[l]
         node_labels = node_info.node.metadata.labels
         for k, v in affinity_selector.items():
             if node_labels.get(k) != v:

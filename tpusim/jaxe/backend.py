@@ -138,7 +138,8 @@ class JaxBackend:
         need_noexec = (cp is not None and cp.spec.pred_keys is not None
                        and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
                        in cp.spec.pred_keys)
-        need_saa = cp is not None and bool(cp.spec.saa_weights)
+        need_saa = cp is not None and (bool(cp.spec.saa_weights)
+                                       or cp.spec.sa_enabled)
         compiled, cols = precompiled or compile_cluster(
             snapshot, pods, need_noexec=need_noexec, need_saa=need_saa)
         if (need_noexec and not compiled.has_noexec_table) \
@@ -184,7 +185,7 @@ class JaxBackend:
                 config = _dc_replace(config, n_saa_doms=n_saa_doms)
 
         ensure_x64()
-        carry = carry_init(compiled)
+        sa_lock_init = None
         if cp is None:
             statics = statics_to_device(compiled)
         else:
@@ -208,7 +209,19 @@ class JaxBackend:
                 host_statics = host_statics._replace(image_score=image_score)
             if cp.saa_entries:
                 host_statics = host_statics._replace(saa_dom=saa_dom)
+            if cp.spec.sa_enabled:
+                from tpusim.jaxe.policyc import service_affinity_columns
+
+                (cols.sa_self_id, sa_self_ok, sa_unres, sa_val,
+                 sa_lock_init) = service_affinity_columns(
+                    cp, pods, snapshot, compiled.node_index,
+                    compiled.groups.saa_defs)
+                host_statics = host_statics._replace(
+                    sa_self_ok=sa_self_ok, sa_unres=sa_unres, sa_val=sa_val)
             statics = _tree_to_device(host_statics)
+        carry = carry_init(compiled)
+        if sa_lock_init is not None:
+            carry = carry._replace(sa_lock=sa_lock_init)
         xs = pod_columns_to_device(cols)
         # On TPU the per-pod filter→score→select→bind pipeline is one fused
         # device program, so the whole batch dispatch lands in the algorithm

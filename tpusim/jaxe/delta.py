@@ -481,7 +481,8 @@ class IncrementalCluster:
             sel_id=np.zeros(p, np.int32), tol_id=np.zeros(p, np.int32),
             aff_id=np.zeros(p, np.int32), avoid_id=np.zeros(p, np.int32),
             host_id=np.zeros(p, np.int32), group_id=np.zeros(p, np.int32),
-            img_id=np.zeros(p, np.int32))
+            img_id=np.zeros(p, np.int32),
+            sa_self_id=np.zeros(p, np.int32))
         batch_keys: Dict[str, Dict[str, int]] = {name: {} for name, _, _ in _SIG_KINDS}
         key_lists: Dict[str, List[str]] = {name: [] for name, _, _ in _SIG_KINDS}
         for j, pod in enumerate(pods):

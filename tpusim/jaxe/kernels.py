@@ -70,6 +70,7 @@ from tpusim.jaxe.state import (
     BIT_MEMORY_PRESSURE,
     BIT_NODE_LABEL_PRESENCE,
     BIT_NODE_SELECTOR_MISMATCH,
+    BIT_SERVICE_AFFINITY,
     BIT_TAINTS_NOT_TOLERATED,
     NUM_FIXED_BITS,
     CompiledCluster,
@@ -92,6 +93,10 @@ class Carry(NamedTuple):
     presence: jnp.ndarray      # [G, N] int32 — pods per (group, node)
     presence_dom: jnp.ndarray  # [G, K, D] int32 — presence summed per topo domain
     used_vols: jnp.ndarray     # [N, V] bool — MaxPD volume ids mounted per node
+    # ServiceAffinity (policy): per first-service-sig lock = node index of the
+    # designated first matching pod once it binds; -1 = not yet locked,
+    # -2 = permanently unpinned (the first pod's node is unknowable)
+    sa_lock: jnp.ndarray       # [Fd] int32
     rr: jnp.ndarray            # scalar int64 — selectHost's lastNodeIndex
 
 
@@ -160,7 +165,14 @@ class Statics(NamedTuple):
     image_score: jnp.ndarray
     #   saa_dom — [E, N] per-ServiceAntiAffinity-entry node label-value domain
     #             ids (0 = label absent), from jaxe.policyc
+    #   ServiceAffinity predicate (policy): sa_val [La, N] interned node
+    #   values per policy affinity label (0 = absent); sa_self_ok [Cs, N] the
+    #   pod's own nodeSelector pins over those labels; sa_unres [Cs, La]
+    #   which labels the pod left unpinned
     saa_dom: jnp.ndarray
+    sa_val: jnp.ndarray
+    sa_self_ok: jnp.ndarray
+    sa_unres: jnp.ndarray
 
 
 class PodX(NamedTuple):
@@ -182,6 +194,8 @@ class PodX(NamedTuple):
     host_id: jnp.ndarray
     group_id: jnp.ndarray
     img_id: jnp.ndarray
+    # ServiceAffinity (policy): own-nodeSelector-pin signature
+    sa_self_id: jnp.ndarray
 
 
 @dataclass(frozen=True)
@@ -209,6 +223,11 @@ class PolicySpec:
     # ServiceAntiAffinity custom priorities: one weight per entry, parallel
     # to the Statics.saa_dom rows (selector_spreading.go:176-280)
     saa_weights: tuple = ()
+    # ServiceAffinity predicate (policy): enabled + its ordering slot (the
+    # canonical name "CheckServiceAffinity" evaluates at its ordering
+    # position; any other policy name runs after the fixed ordering)
+    sa_enabled: bool = False
+    sa_slot: str = ""
     # first-failure reason selection becomes collect-all-failures
     # (generic_scheduler.go alwaysCheckAllPredicates)
     always_check_all: bool = False
@@ -279,18 +298,21 @@ STATICS_AXES = dict(
     pref_key=("group", "pref_term"),
     label_ok=("label_pred", "node"), label_prio=("node",),
     image_score=("sig_img", "node"), saa_dom=("saa_entry", "node"),
+    sa_val=("sa_label", "node"),
+    sa_self_ok=("sig_sa_self", "node"), sa_unres=("sig_sa_self", "sa_label"),
 )
 CARRY_AXES = dict(
     used_cpu=("node",), used_mem=("node",), used_gpu=("node",), used_eph=("node",),
     used_scalar=("node", "scalar"), nonzero_cpu=("node",), nonzero_mem=("node",),
     pod_count=("node",), presence=("group", "node"),
     presence_dom=("group", "topo_key", "topo_dom"),
-    used_vols=("node", "vol_id"), rr=(),
+    used_vols=("node", "vol_id"), sa_lock=("saa_sig",), rr=(),
 )
 PODX_AXES = dict(
     req_cpu=(), req_mem=(), req_gpu=(), req_eph=(), req_scalar=("scalar",),
     nz_cpu=(), nz_mem=(), zero_request=(), best_effort=(), sel_id=(),
     tol_id=(), aff_id=(), avoid_id=(), host_id=(), group_id=(), img_id=(),
+    sa_self_id=(),
 )
 # Node-axis pad fill per field (default 0). Exception: cond_fail_bits is
 # special-cased in sharding._pad_node_tree with a lazily-built infeasible
@@ -358,7 +380,10 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         label_ok=np.ones((1, len(s.alloc_cpu)), dtype=bool),
         label_prio=np.zeros(len(s.alloc_cpu), dtype=np.int64),
         image_score=np.zeros((1, len(s.alloc_cpu)), dtype=np.int64),
-        saa_dom=np.zeros((1, len(s.alloc_cpu)), dtype=np.int32))
+        saa_dom=np.zeros((1, len(s.alloc_cpu)), dtype=np.int32),
+        sa_val=np.zeros((1, len(s.alloc_cpu)), dtype=np.int32),
+        sa_self_ok=np.ones((1, len(s.alloc_cpu)), dtype=bool),
+        sa_unres=np.zeros((1, 1), dtype=bool))
 
 
 def _presence_dom_init(presence: np.ndarray, topo_dom: np.ndarray,
@@ -384,6 +409,7 @@ def carry_init_host(compiled: CompiledCluster) -> Carry:
         presence_dom=_presence_dom_init(gt.presence, gt.topo_dom,
                                         compiled.n_topo_doms),
         used_vols=gt.used_vols_init,
+        sa_lock=np.full(gt.saa_rows.shape[0], -1, dtype=np.int32),
         rr=np.int64(0))
 
 
@@ -396,7 +422,7 @@ def pod_columns_to_host(cols: PodColumns) -> PodX:
         zero_request=cols.zero_request, best_effort=cols.best_effort,
         sel_id=cols.sel_id, tol_id=cols.tol_id, aff_id=cols.aff_id,
         avoid_id=cols.avoid_id, host_id=cols.host_id, group_id=cols.group_id,
-        img_id=cols.img_id)
+        img_id=cols.img_id, sa_self_id=cols.sa_self_id)
 
 
 def _tree_to_device(tree):
@@ -473,10 +499,30 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         for i, slot in enumerate(ps.label_rows):
             label_at.setdefault(slot, []).append(i)
 
+    def sa_fail():
+        # ServiceAffinity predicate (predicates.py check_service_affinity):
+        # the candidate node must match (a) the labels the pod pins via its
+        # own nodeSelector and (b), for the remaining policy labels, the
+        # values on the locked first-service-pod's node — when a lock exists
+        # and the locked node carries the label
+        f = st.saa_sig[x.group_id]
+        lock = carry.sa_lock[f]
+        own_ok = st.sa_self_ok[x.sa_self_id]            # [N]
+        unres = st.sa_unres[x.sa_self_id]               # [La]
+        li = jnp.maximum(lock, 0)
+        locked_vals = st.sa_val[:, li]                  # [La]
+        pin = unres & (locked_vals > 0)                 # label pinned by lock
+        match = st.sa_val == locked_vals[:, None]       # [La, N]
+        lock_ok = jnp.all(~pin[:, None] | match, axis=0)
+        ok = own_ok & (lock_ok | (lock < 0))
+        return ~ok
+
     def emit_label(slot_name):
         for i in label_at.get(slot_name, ()):
             stages.append((~st.label_ok[i],
                            jnp.int64(1) << BIT_NODE_LABEL_PRESENCE))
+        if ps is not None and ps.sa_enabled and ps.sa_slot == slot_name:
+            stages.append((sa_fail(), jnp.int64(1) << BIT_SERVICE_AFFINITY))
 
     emit_label(CHECK_NODE_UNSCHEDULABLE_PRED)
 
@@ -669,9 +715,12 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
                       jnp.int64(1) << BIT_ANTI_AFFINITY_RULES))
         stages.append((fail_interpod, interpod_bits))
     emit_label(MATCH_INTERPOD_AFFINITY_PRED)
-    # label-presence predicates under non-ordering names run after the fixed
-    # ordering (the host appends custom keys alphabetically at the end)
+    # customs under non-ordering names run after the fixed ordering in the
+    # host's ALPHABETICAL name order: label rows sorting before a tail
+    # ServiceAffinity ride slot "", the SA stage follows (emit_label checks
+    # sa_slot == ""), and later-sorting label rows ride slot "post"
     emit_label("")
+    emit_label("post")
 
     fail_any = stages[0][0]
     for fail, _ in stages[1:]:
@@ -890,6 +939,16 @@ def make_step(config: EngineConfig):
                 x.group_id, jnp.arange(k_count), dom_at].add(gate32)
         else:
             presence_dom = carry.presence_dom
+        if config.policy is not None and config.policy.sa_enabled:
+            # the first ASSIGNED pod matching a selector defines its pin (the
+            # plugin pod lister is the scheduler cache, factory.go:166), and
+            # assigned order == bind order here — so the first matching BIND
+            # locks each still-unlocked sig to the chosen node
+            match_f = st.saa_rows[:, x.group_id] & found      # [F]
+            sa_lock = jnp.where((carry.sa_lock == -1) & match_f,
+                                idx.astype(jnp.int32), carry.sa_lock)
+        else:
+            sa_lock = carry.sa_lock
         new_carry = Carry(
             used_cpu=carry.used_cpu.at[idx].add(gate * x.req_cpu),
             used_mem=carry.used_mem.at[idx].add(gate * x.req_mem),
@@ -900,7 +959,7 @@ def make_step(config: EngineConfig):
             nonzero_mem=carry.nonzero_mem.at[idx].add(gate * x.nz_mem),
             pod_count=carry.pod_count.at[idx].add(gate),
             presence=presence, presence_dom=presence_dom,
-            used_vols=used_vols,
+            used_vols=used_vols, sa_lock=sa_lock,
             rr=rr_next)
 
         counts = jax.lax.cond(
@@ -998,6 +1057,17 @@ def make_wavefront_step(config: EngineConfig):
                 dom_at.T].add(gate32[:, None])
         else:
             presence_dom = carry.presence_dom
+        if config.policy is not None and config.policy.sa_enabled:
+            # earliest matching bind in the wave locks each sig (assigned
+            # order == bind order == wave position)
+            match_fw = st.saa_rows[:, xs.group_id] & (gate == 1)[None, :]
+            has = jnp.any(match_fw, axis=1)                     # [F]
+            first_w = jnp.argmax(match_fw, axis=1)              # [F]
+            cand = idxs[first_w].astype(jnp.int32)
+            sa_lock = jnp.where((carry.sa_lock == -1) & has, cand,
+                                carry.sa_lock)
+        else:
+            sa_lock = carry.sa_lock
         new_carry = Carry(
             used_cpu=scatter(xs.req_cpu, carry.used_cpu),
             used_mem=scatter(xs.req_mem, carry.used_mem),
@@ -1009,7 +1079,7 @@ def make_wavefront_step(config: EngineConfig):
             nonzero_mem=scatter(xs.nz_mem, carry.nonzero_mem),
             pod_count=scatter(jnp.ones_like(gate), carry.pod_count),
             presence=presence, presence_dom=presence_dom,
-            used_vols=used_vols,
+            used_vols=used_vols, sa_lock=sa_lock,
             rr=carry.rr + jnp.sum(advances))
 
         counts = jnp.where(

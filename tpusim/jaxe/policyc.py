@@ -12,15 +12,16 @@ but instead of assembling host predicate/priority closures it produces:
 
 Host-bound policy features have no device encoding and fall back to the
 reference engine (the same containment as volume workloads): extenders (HTTP
-round-trips mid-filter), the ServiceAffinity PREDICATE (its constraint is the
-node of the first matching POD in lister order — a property of live
-placements that presence counts cannot represent), and the few
-alwaysCheckAllPredicates shapes where the host can emit one reason string
-twice per node (the device histogram is bit-per-string). Everything else in
-the 1.10 registry compiles: ImageLocality and the NoExecute taint variant
-ride static signature tables; ServiceAntiAffinity compiles because services
-are static during a run, so its first-matching-SERVICE selector interns at
-group-compile time (state._compile_groups saa tables); and
+round-trips mid-filter), multiple ServiceAffinity predicates in one policy
+(the device carries one first-pod lock per first-service signature), and the
+few alwaysCheckAllPredicates shapes where the host can emit one reason
+string twice per node (the device histogram is bit-per-string). Everything
+else in the 1.10 registry compiles: ImageLocality and the NoExecute taint
+variant ride static signature tables; Service(Anti)Affinity compile because
+services are static during a run (the first-matching-SERVICE selector
+interns at group-compile time) and the ServiceAffinity first matching POD is
+a static property of snapshot+feed order (service_affinity_columns — a
+seeded pod is a static lock, a fed pod locks the carry when it binds); and
 alwaysCheckAllPredicates otherwise runs on device (reason bits OR over all
 failing stages). Unknown names raise the host registry's KeyError
 byte-for-byte."""
@@ -94,6 +95,8 @@ class CompiledPolicy:
     # ServiceAntiAffinity entries: (node label, weight), parallel to
     # spec.saa_weights
     saa_entries: List[Tuple[str, int]] = field(default_factory=list)
+    # ServiceAffinity predicate: the policy's affinity label list
+    sa_labels: tuple = ()
     # host-bound features forcing the reference fallback (empty = compilable)
     unsupported: List[str] = field(default_factory=list)
 
@@ -112,6 +115,9 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
     # the {register_...} set comprehension in providers.create_from_config) —
     # so duplicates resolve last-wins here too.
     label_rows: List[Tuple[str, list]] = []
+    sa_enabled = False
+    sa_slot = ""
+    sa_labels: tuple = ()
     if policy.predicates is None:
         pred_keys = None
     else:
@@ -119,10 +125,8 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
         for pp in policy.predicates:
             arg = pp.argument
             if arg is not None and arg.service_affinity is not None:
-                pred_by_name[pp.name] = ("unsupported",
-                                         f"ServiceAffinity predicate {pp.name!r} "
-                                         "(label-consistency state over live "
-                                         "placements)")
+                pred_by_name[pp.name] = (
+                    "sa", tuple(arg.service_affinity.labels))
             elif arg is not None and arg.labels_presence is not None:
                 pred_by_name[pp.name] = (
                     "label", (tuple(arg.labels_presence.labels),
@@ -136,9 +140,16 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
         pred_keys = set()
         slotted: Dict[str, list] = {}
         tail_entries: list = []
+        sa_found: List[Tuple[str, tuple]] = []
         for name, entry in pred_by_name.items():
             if entry[0] == "standard":
                 pred_keys.add(name)
+            elif entry[0] == "sa":
+                if name == preds.CHECK_NODE_CONDITION_PRED:
+                    unsupported.append("ServiceAffinity predicate replacing "
+                                       "the mandatory CheckNodeCondition")
+                else:
+                    sa_found.append((name, entry[1]))
             elif entry[0] == "label":
                 # the host registers the custom under the policy's name: a
                 # name appearing in PREDICATES_ORDERING evaluates at that
@@ -153,14 +164,37 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                 elif name in preds.PREDICATES_ORDERING:
                     slotted[name] = [entry[1]]
                 else:
-                    tail_entries.append(entry[1])
+                    tail_entries.append((name, entry[1]))
             else:
                 unsupported.append(entry[1])
+        if len(sa_found) > 1:
+            unsupported.append(
+                "multiple ServiceAffinity predicates (the device carries one "
+                "first-pod lock per first-service signature)")
+            sa_found = []
+        sa_name = None
+        if sa_found:
+            sa_name, sa_labels = sa_found[0]
+            sa_slot = sa_name if sa_name in preds.PREDICATES_ORDERING else ""
+            sa_enabled = True
         for name in preds.PREDICATES_ORDERING:
             if name in slotted:
                 label_rows.append((name, slotted[name]))
         if tail_entries:
-            label_rows.append(("", tail_entries))
+            # the host runs tail customs in ALPHABETICAL name order
+            # (generic_scheduler.py _predicate_key_order); label-vs-label
+            # order is invisible (one shared reason string), but a tail
+            # ServiceAffinity splits them into before/after rows
+            tail_entries.sort(key=lambda pair: pair[0])
+            if sa_enabled and sa_slot == "" and sa_name is not None:
+                pre = [e for n, e in tail_entries if n < sa_name]
+                post = [e for n, e in tail_entries if n > sa_name]
+                if pre:
+                    label_rows.append(("", pre))
+                if post:
+                    label_rows.append(("post", post))
+            else:
+                label_rows.append(("", [e for _, e in tail_entries]))
 
     weights = dict(_DEFAULT_WEIGHTS)
     label_prios: List[Tuple[str, bool, int]] = []
@@ -237,6 +271,7 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
         has_label_prio=bool(label_prios),
         w_image=image_weight,
         saa_weights=tuple(w for _, w in saa_entries),
+        sa_enabled=sa_enabled, sa_slot=sa_slot,
         always_check_all=aca,
         **weights)
     hard = (policy.hard_pod_affinity_symmetric_weight
@@ -244,6 +279,7 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
     return CompiledPolicy(spec=spec, hard_weight=hard,
                           label_rows=label_rows,
                           label_prios=label_prios, saa_entries=saa_entries,
+                          sa_labels=sa_labels,
                           unsupported=unsupported)
 
 
@@ -298,30 +334,110 @@ def image_locality_columns(pods, nodes, node_index: Dict[str, int]):
     return img_id, table
 
 
+def _nodes_by_index(nodes, node_index: Dict[str, int]) -> list:
+    by_idx: list = [None] * len(node_index)
+    for node in nodes:
+        i = node_index.get(node.name)
+        if i is not None:
+            by_idx[i] = node
+    return by_idx
+
+
+def _label_value_row(by_idx: list, label: str):
+    """Intern one node label's values into an int32 row (0 = absent);
+    returns (row[N], number of distinct values + 1)."""
+    row = np.zeros(len(by_idx), dtype=np.int32)
+    values: Dict[str, int] = {}
+    for i, node in enumerate(by_idx):
+        value = node.metadata.labels.get(label)
+        if value is None:
+            continue
+        vid = values.get(value)
+        if vid is None:
+            vid = len(values) + 1
+            values[value] = vid
+        row[i] = vid
+    return row, len(values) + 1
+
+
 def saa_dom_rows(cp: CompiledPolicy, nodes, node_index: Dict[str, int]):
     """(saa_dom [E, N] int32, n_doms int): per-ServiceAntiAffinity-entry
     node label-value domains (0 = label absent; values interned per entry,
     one shared segment count)."""
-    n = len(node_index)
+    by_idx = _nodes_by_index(nodes, node_index)
     e_count = max(len(cp.saa_entries), 1)
-    dom = np.zeros((e_count, n), dtype=np.int32)
+    dom = np.zeros((e_count, len(by_idx)), dtype=np.int32)
     n_doms = 1
     for e, (label, _w) in enumerate(cp.saa_entries):
-        values: Dict[str, int] = {}
-        for node in nodes:
-            i = node_index.get(node.name)
-            if i is None:
-                continue
-            value = node.metadata.labels.get(label)
-            if value is None:
-                continue
-            vid = values.get(value)
-            if vid is None:
-                vid = len(values) + 1
-                values[value] = vid
-            dom[e, i] = vid
-        n_doms = max(n_doms, len(values) + 1)
+        dom[e], n_values = _label_value_row(by_idx, label)
+        n_doms = max(n_doms, n_values)
     return dom, n_doms
+
+
+def service_affinity_columns(cp: CompiledPolicy, pods, snapshot,
+                             node_index: Dict[str, int], saa_defs: list):
+    """Static ServiceAffinity state (predicates.py check_service_affinity):
+
+    Returns (sa_self_id[P], sa_self_ok[Cs, N], sa_unres[Cs, La],
+    sa_val[La, N], sa_lock_init[Fd]).
+
+    The plugin pod lister is the scheduler cache (factory.go:166) — ASSIGNED
+    pods, seeded in snapshot order then bound pods in bind order — so the
+    first matching pod is either a seeded assigned pod (static: its node
+    index locks sig f, or -2 when the node is unknowable so nothing ever
+    pins) or the first matching pod to BIND, which the kernel locks into the
+    carry when that bind happens (-1 until then)."""
+    labels = list(cp.sa_labels)
+    n = len(node_index)
+    la = max(len(labels), 1)
+    by_idx = _nodes_by_index(snapshot.nodes, node_index)
+
+    sa_val = np.zeros((la, n), dtype=np.int32)
+    for li, label in enumerate(labels):
+        sa_val[li], _ = _label_value_row(by_idx, label)
+
+    sig_ids: Dict[tuple, int] = {}
+    reps: List[tuple] = []
+    sa_self_id = np.zeros(len(pods), dtype=np.int32)
+    for j, pod in enumerate(pods):
+        selector = pod.spec.node_selector or {}
+        pins = tuple(sorted((label, selector[label]) for label in labels
+                            if label in selector))
+        cid = sig_ids.get(pins)
+        if cid is None:
+            cid = len(reps)
+            sig_ids[pins] = cid
+            reps.append(pins)
+        sa_self_id[j] = cid
+
+    cs = max(len(reps), 1)
+    sa_self_ok = np.ones((cs, n), dtype=bool)
+    sa_unres = np.zeros((cs, la), dtype=bool)
+    for c, pins in enumerate(reps):
+        pinned = dict(pins)
+        for li, label in enumerate(labels):
+            sa_unres[c, li] = label not in pinned
+        for i, node in enumerate(by_idx):
+            sa_self_ok[c, i] = all(node.metadata.labels.get(k) == v
+                                   for k, v in pinned.items())
+
+    fd = max(len(saa_defs), 1)
+    lock_init = np.full(fd, -1, dtype=np.int32)
+    for f in range(1, len(saa_defs)):
+        ns, sel = saa_defs[f]
+        first = next(
+            (p for p in snapshot.pods
+             if p.spec.node_name and p.namespace == ns
+             and all(p.metadata.labels.get(k) == v for k, v in sel.items())),
+            None)
+        if first is not None:
+            if first.spec.node_name in node_index:
+                lock_init[f] = node_index[first.spec.node_name]
+            else:
+                # assigned to an unknowable node: it stays service_pods[0]
+                # forever (assigned order), so nothing ever pins
+                lock_init[f] = -2
+    return sa_self_id, sa_self_ok, sa_unres, sa_val, lock_init
 
 
 def policy_static_rows(cp: CompiledPolicy, nodes,
@@ -330,11 +446,7 @@ def policy_static_rows(cp: CompiledPolicy, nodes,
     to spec.label_rows. `nodes` is the snapshot node list; node_index the
     compiled order."""
     n = len(node_index)
-    by_idx: list = [None] * n
-    for node in nodes:
-        i = node_index.get(node.name)
-        if i is not None:
-            by_idx[i] = node
+    by_idx = _nodes_by_index(nodes, node_index)
     if cp.label_rows:
         label_ok = np.stack([_label_pred_row(by_idx, entries)
                              for _, entries in cp.label_rows])

@@ -72,7 +72,8 @@ BIT_DISK_CONFLICT = 19          # NoDiskConflict (error.go ErrDiskConflict)
 BIT_MAX_VOLUME_COUNT = 20       # MaxPDVolumeCount
 BIT_VOLUME_ZONE_CONFLICT = 21   # NoVolumeZoneConflict
 BIT_NODE_LABEL_PRESENCE = 22    # CheckNodeLabelPresence (policy-configured)
-NUM_FIXED_BITS = 23
+BIT_SERVICE_AFFINITY = 23       # CheckServiceAffinity (policy-configured)
+NUM_FIXED_BITS = 24
 # bits >= NUM_FIXED_BITS: Insufficient <scalar resource s>, per interned name
 
 REASON_STRINGS = [
@@ -99,6 +100,7 @@ REASON_STRINGS = [
     "node(s) exceed max volume count",
     "node(s) had no available volume zone",
     "node(s) didn't have the requested labels",
+    "node(s) didn't match service affinity",
 ]
 
 # Pod-group budgets (env-overridable). Groups are merged by match profile and
@@ -256,6 +258,9 @@ class GroupTables:
     pref_w: np.ndarray           # [G, Tp] float64 — preferred terms, signed weight
     pref_term: np.ndarray        # [G, Tp] int32 (into Td)
     pref_key: np.ndarray         # [G, Tp] int32
+    # (namespace, selector) per first-sel sig, index 0 = None; the backend's
+    # ServiceAffinity first-POD analysis resolves locks against these
+    saa_defs: list = field(default_factory=list)
 
 
 @dataclass
@@ -280,6 +285,8 @@ class PodColumns:
     # pod-image-set signature id (ImageLocalityPriority table; zeros unless a
     # policy enables the priority — jaxe.policyc fills it then)
     img_id: np.ndarray           # [P] int32
+    # ServiceAffinity predicate column (policy-only; policyc fills it)
+    sa_self_id: np.ndarray       # [P] int32 — own-nodeSelector-pin signature
 
 
 @dataclass
@@ -962,7 +969,8 @@ def _compile_groups(snapshot: ClusterSnapshot, pods: List[Pod],
         vol_mask=vol_mask, vol_type=vol_type, zone_ok=zone_ok,
         used_vols_init=used_vols_init,
         ss_rows=ss_rows, ss_sig=ss_sig,
-        saa_rows=saa_rows, saa_sig=saa_sig, term_match=term_match,
+        saa_rows=saa_rows, saa_sig=saa_sig, saa_defs=list(saa_defs),
+        term_match=term_match,
         zone_dom=zone_dom, topo_dom=topo_dom,
         aff_valid=aff_valid, aff_err=aff_err, aff_empty=aff_empty,
         aff_term=aff_term, aff_key=aff_key, aff_hostname=aff_hostname,
@@ -1139,7 +1147,8 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod],
         sel_id=np.zeros(p, dtype=np.int32), tol_id=np.zeros(p, dtype=np.int32),
         aff_id=np.zeros(p, dtype=np.int32), avoid_id=np.zeros(p, dtype=np.int32),
         host_id=np.zeros(p, dtype=np.int32), group_id=np.zeros(p, dtype=np.int32),
-        img_id=np.zeros(p, dtype=np.int32))
+        img_id=np.zeros(p, dtype=np.int32),
+        sa_self_id=np.zeros(p, dtype=np.int32))
 
     sel_i, tol_i, aff_i, avoid_i, host_i = (Interner() for _ in range(5))
     unsupported: List[str] = []

@@ -166,7 +166,8 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     need_noexec = (cp is not None and cp.spec.pred_keys is not None
                    and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
                    in cp.spec.pred_keys)
-    need_saa = cp is not None and bool(cp.spec.saa_weights)
+    need_saa = cp is not None and (bool(cp.spec.saa_weights)
+                                   or cp.spec.sa_enabled)
     if not scenarios:
         return []
     ensure_x64()
@@ -228,7 +229,19 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
                                              compiled.node_index)
                 host_statics = host_statics._replace(saa_dom=saa_dom)
                 n_saa_doms = max(n_saa_doms, doms)
-        host_trees.append((host_statics, carry_init_host(compiled),
+        host_carry = carry_init_host(compiled)
+        if cp is not None and cp.spec.sa_enabled:
+            from tpusim.jaxe.policyc import service_affinity_columns
+
+            snapshot, pods = scenarios[batch_indices[b]]
+            (cols.sa_self_id, sa_self_ok, sa_unres, sa_val,
+             sa_lock_init) = service_affinity_columns(
+                cp, pods, snapshot, compiled.node_index,
+                compiled.groups.saa_defs)
+            host_statics = host_statics._replace(
+                sa_self_ok=sa_self_ok, sa_unres=sa_unres, sa_val=sa_val)
+            host_carry = host_carry._replace(sa_lock=sa_lock_init)
+        host_trees.append((host_statics, host_carry,
                            pod_columns_to_host(cols)))
 
     # common shapes

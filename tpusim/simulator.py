@@ -117,7 +117,12 @@ class ClusterCapacity:
         # simulator.go:345-428: fake empty RC/RS/StatefulSet listers, simulated
         # pod/node/service listers) ---
         args = PluginFactoryArgs(
-            pod_lister=lambda: self.resource_store.list(ResourceType.PODS),
+            # the plugin pod lister is the SCHEDULER CACHE, not the store
+            # (factory.go:166 podLister: schedulerCache): assigned pods only,
+            # in cache insertion order (seed order then bind order) — the
+            # deterministic stand-in for Go's random map iteration
+            pod_lister=lambda: [state.pod for state
+                                in self.cache.pod_states.values()],
             service_lister=lambda: self.resource_store.list(ResourceType.SERVICES),
             node_info_getter=lambda name: self.node_info_map.get(name),
             pvc_getter=self.volume_binder.get_pvc,
@@ -127,6 +132,15 @@ class ClusterCapacity:
             volume_scheduling_enabled=config.enable_volume_scheduling,
             hard_pod_affinity_symmetric_weight=config.hard_pod_affinity_symmetric_weight,
         )
+        # ServiceAffinity predicates (policy-registered, arbitrary names)
+        # judge OTHER nodes by where service pods sit, so any pod add/delete
+        # invalidates them on ALL nodes (factory.go's onPodAdd/Delete
+        # invalidation set includes CheckServiceAffinity)
+        self._service_affinity_pred_names = [
+            pp.name for pp in (config.policy.predicates or [])
+            if pp.argument is not None
+            and pp.argument.service_affinity is not None
+        ] if config.policy is not None else []
         self.scheduling_queue = new_scheduling_queue(config.enable_pod_priority)
         self.pod_backoff = PodBackoff()  # MakeDefaultErrorFunc's backoff state
         if config.policy is not None:
@@ -176,11 +190,18 @@ class ClusterCapacity:
     def _invalidate_ecache_for_node(self, node_name: str) -> None:
         """The factory event handlers invalidate cached predicate results when
         a node's pod set changes (factory.go:596-631 + ecache hooks); the
-        conservative whole-node invalidation keeps the cache correct."""
+        conservative whole-node invalidation keeps the cache correct. A
+        ServiceAffinity verdict on EVERY node can change when a service pod
+        binds or leaves anywhere, so those predicate keys invalidate
+        cluster-wide (factory.go's CheckServiceAffinity invalidation)."""
         # handlers also fire during __init__ seeding, before the engine exists
         scheduler = getattr(self, "scheduler", None)
         if scheduler is not None and scheduler.equivalence_cache is not None:
             scheduler.equivalence_cache.invalidate_all_on_node(node_name)
+            if self._service_affinity_pred_names:
+                scheduler.equivalence_cache \
+                    .invalidate_cached_predicate_item_of_all_nodes(
+                        self._service_affinity_pred_names)
 
     def _on_node_event(self, event: str, node: Node) -> None:
         if event == DELETED:
@@ -418,10 +439,11 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
     (jaxe/delta.py), so compiled state is patched, not rebuilt."""
     compiled_policy = None
     if policy is not None and backend == "jax":
-        # compile (and validate) the policy for the device engine; host-bound
-        # features (extenders, ServiceAffinity/ServiceAntiAffinity, always-
-        # check-all) route to the reference orchestrator, which has the full
-        # plugin registry and the in-process extender seam
+        # compile (and validate) the policy for the device engine; the few
+        # host-bound features (extenders, multiple ServiceAffinity entries,
+        # duplicate-reason alwaysCheckAllPredicates shapes) route to the
+        # reference orchestrator, which has the full plugin registry and the
+        # in-process extender seam
         import logging
 
         from tpusim.jaxe.policyc import compile_policy

@@ -17,8 +17,11 @@ Compile-time fallbacks route to the reference backend (fallback="reference")
 or raise (fallback="error"): pod-group budget overruns (merged groups >
 TPUSIM_MAX_GROUPS, raw signatures > TPUSIM_MAX_RAW_GROUPS, matcher precompute
 > TPUSIM_MAX_MATCH_WORK, presence bytes > TPUSIM_MAX_PRESENCE_BYTES — groups
-merge by match profile first, so only behaviorally distinct classes count) and
-volume-using workloads (state.volume_unsupported).
+merge by match profile first, so only behaviorally distinct classes count),
+volume workloads on the INCREMENTAL path only (state.volume_unsupported —
+fresh compiles evaluate the volume predicates natively), and the host-bound
+policy shapes listed in jaxe/policyc.py (extenders, multiple ServiceAffinity
+entries, duplicate-reason alwaysCheckAllPredicates).
 """
 
 from __future__ import annotations

@@ -317,83 +317,130 @@ def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
     print(json.dumps(result), flush=True)
 
 
+def _ladder_configs() -> set:
+    """Parse TPUSIM_BENCH_LADDER_CONFIGS (e.g. "3,5" to rerun a subset
+    without repeating the whole ladder). Called in the PARENT before any
+    child spawns: a typo'd knob must fail instantly, not burn the full
+    retry ladder (each child pays backend init) producing "no JSON line"."""
+    raw = os.environ.get("TPUSIM_BENCH_LADDER_CONFIGS", "1,2,3,4,5")
+    try:
+        wanted = {int(c) for c in raw.split(",") if c.strip()}
+    except ValueError:
+        wanted = set()
+    if not wanted or not wanted <= {1, 2, 3, 4, 5}:
+        raise SystemExit(
+            f"TPUSIM_BENCH_LADDER_CONFIGS={raw!r}: need values in 1-5")
+    return wanted
+
+
 def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> None:
     """BASELINE.md configs 1-5; one JSON line each."""
     from tpusim.api.podspec import expand_simulation_pods, parse_simulation_pods
     from tpusim.api.snapshot import synthetic_cluster
 
+    wanted = _ladder_configs()
     results = []
 
-    # 1. quickstart: etc/pod.yaml 20 pods vs synthetic nodes (falls back to
-    # the equivalent synthetic spec when the reference checkout is absent)
-    quickstart = os.environ.get("TPUSIM_BENCH_QUICKSTART",
-                                "/root/reference/etc/pod.yaml")
-    try:
-        with open(quickstart) as f:
-            sim_pods = parse_simulation_pods(f.read())
-        quick_pods = list(reversed(expand_simulation_pods(sim_pods)))
-    except OSError:
-        from tpusim.api.snapshot import make_pod
+    if 1 in wanted:
+        # 1. quickstart: etc/pod.yaml 20 pods vs synthetic nodes (falls back
+        # to the equivalent synthetic spec when the reference checkout is
+        # absent)
+        quickstart = os.environ.get("TPUSIM_BENCH_QUICKSTART",
+                                    "/root/reference/etc/pod.yaml")
+        try:
+            with open(quickstart) as f:
+                sim_pods = parse_simulation_pods(f.read())
+            quick_pods = list(reversed(expand_simulation_pods(sim_pods)))
+        except OSError:
+            from tpusim.api.snapshot import make_pod
 
-        log(f"  quickstart spec {quickstart!r} unavailable; using the "
-            "equivalent synthetic 10 small + 10 oversized pods")
-        quick_pods = ([make_pod(f"small-{i}", milli_cpu=100, memory=1024)
-                       for i in range(10)]
-                      + [make_pod(f"big-{i}", milli_cpu=100_000, memory=1024)
-                         for i in range(10)])
-    results.append(measure_config(
-        "config 1: quickstart 20 pods, 100 synthetic nodes",
-        synthetic_cluster(100, milli_cpu=4000, memory=16 * 1024**3),
-        quick_pods, platform, batch, baseline_pods, chunk))
-    print(json.dumps(results[-1]), flush=True)
+            log(f"  quickstart spec {quickstart!r} unavailable; using the "
+                "equivalent synthetic 10 small + 10 oversized pods")
+            quick_pods = ([make_pod(f"small-{i}", milli_cpu=100, memory=1024)
+                           for i in range(10)]
+                          + [make_pod(f"big-{i}", milli_cpu=100_000,
+                                      memory=1024)
+                             for i in range(10)])
+        results.append(measure_config(
+            "config 1: quickstart 20 pods, 100 synthetic nodes",
+            synthetic_cluster(100, milli_cpu=4000, memory=16 * 1024**3),
+            quick_pods, platform, batch, baseline_pods, chunk))
+        print(json.dumps(results[-1]), flush=True)
 
-    # 2. 1k uniform pods / 100 nodes
-    snapshot, pods = uniform_workload(1_000, 100)
-    results.append(measure_config("config 2: 1k uniform pods, 100 nodes",
-                                  snapshot, pods, platform, batch,
-                                  baseline_pods, chunk))
-    print(json.dumps(results[-1]), flush=True)
+    if 2 in wanted:
+        # 2. 1k uniform pods / 100 nodes
+        snapshot, pods = uniform_workload(1_000, 100)
+        results.append(measure_config("config 2: 1k uniform pods, 100 nodes",
+                                      snapshot, pods, platform, batch,
+                                      baseline_pods, chunk))
+        print(json.dumps(results[-1]), flush=True)
 
-    # 3. 100k Zipf / 5k heterogeneous
-    snapshot, pods = build_workload(100_000, 5_000)
-    results.append(measure_config(
-        "config 3: 100k Zipf pods, 5k heterogeneous nodes",
-        snapshot, pods, platform, batch, baseline_pods, chunk))
-    print(json.dumps(results[-1]), flush=True)
+    if 3 in wanted:
+        # 3. 100k Zipf / 5k heterogeneous
+        snapshot, pods = build_workload(100_000, 5_000)
+        results.append(measure_config(
+            "config 3: 100k Zipf pods, 5k heterogeneous nodes",
+            snapshot, pods, platform, batch, baseline_pods, chunk))
+        print(json.dumps(results[-1]), flush=True)
 
-    # 4. 1M pods / 10k nodes with taints+tolerations and node affinity
-    # (CPU fallback runs a scaled shape so the watchdog never fires)
-    p4, n4 = (1_000_000, 10_000) if platform != "cpu" else (100_000, 2_000)
-    snapshot, pods = build_workload(p4, n4, affinity=True)
-    results.append(measure_config(
-        f"config 4: {p4 // 1000}k Zipf pods, {n4} nodes, taints+node-affinity",
-        snapshot, pods, platform, batch, baseline_pods, chunk, timed_runs=1))
-    print(json.dumps(results[-1]), flush=True)
+    if 4 in wanted:
+        # 4. 1M pods / 10k nodes with taints+tolerations and node affinity
+        # (CPU fallback runs a scaled shape so the watchdog never fires)
+        p4, n4 = (1_000_000, 10_000) if platform != "cpu" else (100_000, 2_000)
+        snapshot, pods = build_workload(p4, n4, affinity=True)
+        results.append(measure_config(
+            f"config 4: {p4 // 1000}k Zipf pods, {n4} nodes, "
+            "taints+node-affinity",
+            snapshot, pods, platform, batch, baseline_pods, chunk,
+            timed_runs=1))
+        print(json.dumps(results[-1]), flush=True)
 
-    # 5. multi-tenant what-if: 50 snapshots x 20k pods, one batched program
-    from tpusim.jaxe.whatif import run_what_if
+    if 5 in wanted:
+        # 5. multi-tenant what-if: 50 snapshots x 20k pods, one batched
+        # program. The single jitted vmap-of-scan program can sit in XLA
+        # compile for minutes with no observable progress, so a heartbeat
+        # thread keeps the parent's stall watchdog fed.
+        import threading
 
-    n_scen, p_scen, n_nodes5 = (50, 20_000, 1_000) if platform != "cpu" \
-        else (8, 5_000, 500)
-    scenarios = []
-    for s in range(n_scen):
-        snap, pods = build_workload(p_scen, n_nodes5, seed=1000 + s)
-        scenarios.append((snap, pods))
-    # run_what_if compiles per invocation (the jitted program is built
-    # inside), so every call pays host interning + XLA compile: the honest
-    # metric is end-to-end including those costs
-    t0 = time.perf_counter()
-    run_what_if(scenarios)
-    e2e = time.perf_counter() - t0
-    total = n_scen * p_scen
-    log(f"[config 5] {n_scen}x{p_scen // 1000}k what-if: "
-        f"{e2e:.1f}s end-to-end (incl. compile + host interning)")
-    results.append({
-        "metric": f"scheduled pods/sec (config 5: {n_scen}x"
-                  f"{p_scen // 1000}k batched what-if, end-to-end incl. "
-                  f"compile, platform={platform})",
-        "value": round(total / e2e, 1), "unit": "pods/s", "vs_baseline": 0})
-    print(json.dumps(results[-1]), flush=True)
+        from tpusim.jaxe.whatif import run_what_if
+
+        n_scen, p_scen, n_nodes5 = (50, 20_000, 1_000) if platform != "cpu" \
+            else (8, 5_000, 500)
+        scenarios = []
+        t0 = time.perf_counter()
+        for s in range(n_scen):
+            snap, pods = build_workload(p_scen, n_nodes5, seed=1000 + s)
+            scenarios.append((snap, pods))
+        log(f"[config 5] built {n_scen}x{p_scen // 1000}k scenarios "
+            f"in {time.perf_counter() - t0:.1f}s")
+        # run_what_if compiles per invocation (the jitted program is built
+        # inside), so every call pays host interning + XLA compile: the honest
+        # metric is end-to-end including those costs
+        done = threading.Event()
+
+        def heartbeat():
+            while not done.wait(60.0):
+                log(f"[config 5] what-if still running "
+                    f"({time.perf_counter() - t0:.0f}s; XLA compile + "
+                    "execution give no incremental progress)")
+
+        threading.Thread(target=heartbeat, daemon=True).start()
+        t0 = time.perf_counter()
+        try:
+            run_what_if(scenarios)
+        finally:
+            done.set()
+        e2e = time.perf_counter() - t0
+        total = n_scen * p_scen
+        log(f"[config 5] {n_scen}x{p_scen // 1000}k what-if: "
+            f"{e2e:.1f}s end-to-end (incl. compile + host interning)")
+        results.append({
+            "metric": f"scheduled pods/sec (config 5: {n_scen}x"
+                      f"{p_scen // 1000}k batched what-if, end-to-end incl. "
+                      f"compile, platform={platform})",
+            "value": round(total / e2e, 1), "unit": "pods/s",
+            "vs_baseline": 0})
+        print(json.dumps(results[-1]), flush=True)
 
 
 def run_phases(platform: str, chunk: int) -> None:
@@ -421,23 +468,74 @@ def run_phases(platform: str, chunk: int) -> None:
         schedule_wavefront,
     )
 
-    num_pods = int(os.environ.get("TPUSIM_BENCH_PHASE_PODS", 20_000))
+    # 5k pods keeps the [P, N] phase-program intermediates ~200MB (int64):
+    # the 20k-pod shape wedged the axon tunnel mid-rep; the split is per-pod
+    # normalized so the smaller pod axis costs nothing but noise
+    num_pods = int(os.environ.get("TPUSIM_BENCH_PHASE_PODS", 5_000))
     num_nodes = int(os.environ.get("TPUSIM_BENCH_NODES", 5_000))
     if platform == "cpu":
         num_pods, num_nodes = 5_000, 1_000
     snapshot, pods = build_workload(num_pods, num_nodes)
     compiled, config, carry, statics, xs = _prepare(snapshot, pods)
 
-    def timeit(fn, *args, reps=3):
+    def timeit(fn, *args, reps=3, label=""):
+        # per-stage logs keep the parent's stall watchdog fed: phase-program
+        # XLA compiles at this shape run minutes each on the TPU tunnel
+        if label:
+            log(f"  [{label}] compiling...")
+        t0 = time.perf_counter()
         out = fn(*args)           # compile
         jax.tree_util.tree_map(np.asarray, out)
+        if label:
+            log(f"  [{label}] compile+first run {time.perf_counter() - t0:.1f}s")
         times = []
-        for _ in range(reps):
+        for r in range(reps):
             t0 = time.perf_counter()
             out = fn(*args)
             jax.tree_util.tree_map(np.asarray, out)  # force
             times.append(time.perf_counter() - t0)
+            if label:
+                log(f"  [{label}] rep {r + 1}/{reps}: {times[-1]:.3f}s")
         return float(np.median(times))
+
+    # stage order: production-path tuning sweeps first, phase-isolated split
+    # last — a mid-run tunnel wedge still leaves the tuning data (the parent
+    # keeps the LAST JSON line printed, even from a killed child)
+    summary = {
+        "metric": f"per-phase split + tuning ({num_pods // 1000}k pods, "
+                  f"{num_nodes} nodes, platform={platform})",
+        "value": 0.0,
+        "unit": "pods/s",
+        "vs_baseline": 0,
+    }
+
+    # --- exact-scan unroll sweep ---
+    unroll_results = {}
+    for unroll in (1, 2, 4, 8):
+        cfg_u = dataclasses.replace(config, scan_unroll=unroll)
+        t = timeit(lambda cu=cfg_u: schedule_scan(cu, carry_init(compiled),
+                                                  statics, xs)[1], reps=3,
+                   label=f"unroll {unroll}")
+        unroll_results[str(unroll)] = round(num_pods / t, 1)
+        log(f"[unroll {unroll}] exact scan: {num_pods / t:.0f} pods/s")
+    best_unroll = max(unroll_results, key=lambda k: unroll_results[k])
+    summary.update(value=unroll_results[best_unroll],
+                   exact_scan_unroll_pods_per_s=unroll_results,
+                   best_unroll=int(best_unroll))
+    print(json.dumps(summary), flush=True)
+
+    # --- wavefront K sweep ---
+    k_results = {}
+    for k in (64, 256, 1024, 4096):
+        t = timeit(lambda kk=k: schedule_wavefront(
+            config, carry_init(compiled), statics, xs, kk)[1], reps=3,
+                   label=f"wavefront K={k}")
+        k_results[str(k)] = round(num_pods / t, 1)
+        log(f"[wavefront K={k}] {num_pods / t:.0f} pods/s")
+    best_k = max(k_results, key=lambda k: k_results[k])
+    summary.update(wavefront_k_pods_per_s=k_results,
+                   best_wavefront_k=int(best_k))
+    print(json.dumps(summary), flush=True)
 
     # --- phase-isolated programs (vmapped over the pod axis, frozen carry) ---
     filter_fn = jax.jit(lambda c, s, x: jax.vmap(
@@ -456,10 +554,10 @@ def run_phases(platform: str, chunk: int) -> None:
         (c, s), (x, v)))
     valid = jnp.ones(num_pods, dtype=bool)
 
-    t_filter = timeit(filter_fn, carry, statics, xs)
-    t_eval = timeit(eval_fn, carry, statics, xs)
-    t_select = timeit(select_fn, carry, statics, xs)
-    t_full = timeit(wave_step, carry, statics, xs, valid)
+    t_filter = timeit(filter_fn, carry, statics, xs, label="filter")
+    t_eval = timeit(eval_fn, carry, statics, xs, label="filter+score")
+    t_select = timeit(select_fn, carry, statics, xs, label="+select")
+    t_full = timeit(wave_step, carry, statics, xs, valid, label="full step")
     phases = {
         "filter_us_per_pod": round(1e6 * t_filter / num_pods, 3),
         "score_us_per_pod": round(1e6 * max(t_eval - t_filter, 0.0) / num_pods, 3),
@@ -470,38 +568,8 @@ def run_phases(platform: str, chunk: int) -> None:
         f"filter {t_filter:.3f}s, +score {t_eval:.3f}s, "
         f"+select {t_select:.3f}s, full step {t_full:.3f}s")
     log(f"[phases] per-pod split: {phases}")
-
-    # --- exact-scan unroll sweep ---
-    unroll_results = {}
-    for unroll in (1, 2, 4, 8):
-        cfg_u = dataclasses.replace(config, scan_unroll=unroll)
-        t = timeit(lambda cu=cfg_u: schedule_scan(cu, carry_init(compiled),
-                                                  statics, xs)[1], reps=3)
-        unroll_results[str(unroll)] = round(num_pods / t, 1)
-        log(f"[unroll {unroll}] exact scan: {num_pods / t:.0f} pods/s")
-    best_unroll = max(unroll_results, key=lambda k: unroll_results[k])
-
-    # --- wavefront K sweep ---
-    k_results = {}
-    for k in (64, 256, 1024, 4096):
-        t = timeit(lambda kk=k: schedule_wavefront(
-            config, carry_init(compiled), statics, xs, kk)[1], reps=3)
-        k_results[str(k)] = round(num_pods / t, 1)
-        log(f"[wavefront K={k}] {num_pods / t:.0f} pods/s")
-    best_k = max(k_results, key=lambda k: k_results[k])
-
-    print(json.dumps({
-        "metric": f"per-phase split + tuning ({num_pods // 1000}k pods, "
-                  f"{num_nodes} nodes, platform={platform})",
-        "value": unroll_results[best_unroll],
-        "unit": "pods/s",
-        "vs_baseline": 0,
-        "phases": phases,
-        "exact_scan_unroll_pods_per_s": unroll_results,
-        "best_unroll": int(best_unroll),
-        "wavefront_k_pods_per_s": k_results,
-        "best_wavefront_k": int(best_k),
-    }), flush=True)
+    summary["phases"] = phases
+    print(json.dumps(summary), flush=True)
 
 
 # --------------------------------------------------------------------------
@@ -601,6 +669,8 @@ def main() -> None:
         return
     ladder = "--ladder" in sys.argv
     phases = "--phases" in sys.argv
+    if ladder:
+        _ladder_configs()  # validate the knob before spawning any child
 
     stall_timeout = float(os.environ.get("TPUSIM_BENCH_STALL_TIMEOUT", 240))
     run_timeout = float(os.environ.get("TPUSIM_BENCH_RUN_TIMEOUT", 2400))

@@ -165,15 +165,22 @@ def _run_once(config, carry, statics, xs, batch: int, chunk: int):
         num_chunks = (p + pad) // chunk
         choice_parts, count_parts = [], []
         t0 = time.perf_counter()
+        pending = None  # previous chunk's device choices, fetched one late:
+        # dispatch is async, so chunk i executes while chunk i-1's result
+        # crosses back to host (the fetch is the only host sync in the loop)
         for ci in range(num_chunks):
             sl = slice(ci * chunk, (ci + 1) * chunk)
             xs_c = PodX(*(jnp.asarray(a[sl]) for a in xs_host))
             carry, ch, cnt, _ = schedule_scan_donated(config, carry, statics, xs_c)
-            choice_parts.append(np.asarray(ch))   # forces this chunk
             count_parts.append(cnt)
-            done = min((ci + 1) * chunk, p)
-            log(f"  chunk {ci + 1}/{num_chunks}: {done}/{p} pods "
-                f"({time.perf_counter() - t0:.1f}s)")
+            if pending is not None:
+                choice_parts.append(np.asarray(pending))  # forces chunk ci-1
+                log(f"  chunk {ci}/{num_chunks}: {ci * chunk}/{p} pods done, "
+                    f"next dispatched ({time.perf_counter() - t0:.1f}s)")
+            pending = ch
+        choice_parts.append(np.asarray(pending))
+        log(f"  chunk {num_chunks}/{num_chunks}: {p}/{p} pods done "
+            f"({time.perf_counter() - t0:.1f}s)")
         choices = np.concatenate(choice_parts)[:p]
         counts = np.concatenate([np.asarray(c) for c in count_parts])[:p]
         return choices, int(np.sum(np.where(choices >= 0, choices, -1))), counts

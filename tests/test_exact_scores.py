@@ -1,0 +1,109 @@
+"""Exact integer score arithmetic (DEVIATIONS.md #16).
+
+Score normalizes must be platform-invariant: float64 divisions round
+differently under the TPU's emulated f64 than under host IEEE f64, which was
+observed as placement-hash divergence between CPU and TPU runs of the same
+workload. The balanced-allocation score runs on 128-bit limbs because
+req_cpu*alloc_mem overflows int64 for large-memory nodes
+(balanced_resource_allocation.go:39-63).
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from tpusim.jaxe import ensure_x64  # noqa: E402
+
+ensure_x64()
+
+from tpusim.engine.priorities import _balanced_scorer  # noqa: E402
+from tpusim.engine.resources import Resource  # noqa: E402
+from tpusim.jaxe.kernels import (  # noqa: E402
+    _balanced_score,
+    _ge_limbs,
+    _mul_limbs,
+    _scale_limbs,
+    _sub_limbs,
+)
+
+
+def limbs_to_int(limbs) -> list:
+    vals = [np.asarray(li).astype(object) for li in limbs]
+    out = []
+    for i in range(len(vals[0])):
+        out.append(sum(int(v[i]) << (32 * k) for k, v in enumerate(vals)))
+    return out
+
+
+def test_limb_helpers_against_bignum():
+    rng = np.random.RandomState(7)
+    a = rng.randint(0, 2**62, 500).astype(np.int64)
+    b = rng.randint(0, 2**62, 500).astype(np.int64)
+    prod = _mul_limbs(jnp.asarray(a), jnp.asarray(b))
+    assert limbs_to_int(prod) == [int(x) * int(y) for x, y in zip(a, b)]
+
+    scaled = _scale_limbs(prod, 10)
+    assert limbs_to_int(scaled) == [10 * int(x) * int(y) for x, y in zip(a, b)]
+
+    c = rng.randint(0, 2**62, 500).astype(np.int64)
+    d = rng.randint(0, 2**62, 500).astype(np.int64)
+    prod2 = _mul_limbs(jnp.asarray(c), jnp.asarray(d))
+    ge = np.asarray(_ge_limbs(prod, prod2))
+    want_ge = [int(x) * int(y) >= int(u) * int(v)
+               for x, y, u, v in zip(a, b, c, d)]
+    assert ge.tolist() == want_ge
+
+    hi = tuple(jnp.where(jnp.asarray(ge), p, q) for p, q in zip(prod, prod2))
+    lo = tuple(jnp.where(jnp.asarray(ge), q, p) for p, q in zip(prod, prod2))
+    diff = _sub_limbs(hi, lo)
+    want_diff = [abs(int(x) * int(y) - int(u) * int(v))
+                 for x, y, u, v in zip(a, b, c, d)]
+    assert limbs_to_int(diff) == want_diff
+
+
+def _oracle(rc, rm, ac, am):
+    if ac == 0 or rc >= ac or am == 0 or rm >= am:
+        return 0
+    num = abs(rc * am - rm * ac)
+    den = ac * am
+    return (10 * (den - num)) // den
+
+
+def test_balanced_score_exact_over_adversarial_magnitudes():
+    rng = np.random.RandomState(0)
+    n = 5000
+    ac = np.concatenate([
+        rng.randint(0, 2**22, n // 4), rng.randint(0, 2**62, n // 4),
+        np.array([0, 1, 2, 10]), rng.randint(1, 100, n // 2 - 4),
+    ]).astype(np.int64)
+    am = np.concatenate([rng.randint(0, 2**45, n // 2),
+                         rng.randint(0, 2**62, n // 2)]).astype(np.int64)
+    rc = (rng.rand(n) * (ac + 1)).astype(np.int64)
+    rm = (rng.rand(n) * (am + 1)).astype(np.int64)
+    rc[:50] = 0
+    rm[:50] = 0  # num == 0 boundary: score must be exactly 10 (or 0-gated)
+    got = np.asarray(_balanced_score(jnp.asarray(rc), jnp.asarray(rm),
+                                     jnp.asarray(ac), jnp.asarray(am)))
+    want = [_oracle(int(a), int(b), int(c), int(d))
+            for a, b, c, d in zip(rc, rm, ac, am)]
+    assert got.tolist() == want
+
+
+def test_balanced_host_matches_device_at_int64_overflow_magnitudes():
+    # 4TiB-memory, 10k-core nodes: req*alloc products overflow int64; the
+    # old float64 path also loses the low bits (2^65 > 2^53)
+    cases = [
+        (5_000_000, 2**41, 10_000_000, 2**42),
+        (9_999_999, 2**42 - 1, 10_000_000, 2**42),
+        (1, 1, 10_000_000, 2**42),
+        (0, 0, 10_000_000, 2**42),
+    ]
+    rc, rm, ac, am = (np.array(col, dtype=np.int64) for col in zip(*cases))
+    dev = np.asarray(_balanced_score(jnp.asarray(rc), jnp.asarray(rm),
+                                     jnp.asarray(ac), jnp.asarray(am)))
+    for i, (c_rc, c_rm, c_ac, c_am) in enumerate(cases):
+        host = _balanced_scorer(
+            Resource(milli_cpu=c_rc, memory=c_rm),
+            Resource(milli_cpu=c_ac, memory=c_am))
+        assert dev[i] == host == _oracle(c_rc, c_rm, c_ac, c_am)

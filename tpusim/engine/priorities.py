@@ -95,20 +95,22 @@ def most_requested_priority_map(pod: Pod, meta, node_info: NodeInfo) -> HostPrio
                             + _most_requested_score(req.memory, alloc.memory)) // 2)
 
 
-def _fraction_of_capacity(requested: int, capacity: int) -> float:
-    if capacity == 0:
-        return 1.0
-    return requested / capacity
-
-
 def _balanced_scorer(requested: Resource, allocatable: Resource) -> int:
-    """balanced_resource_allocation.go:39-63."""
-    cpu_fraction = _fraction_of_capacity(requested.milli_cpu, allocatable.milli_cpu)
-    mem_fraction = _fraction_of_capacity(requested.memory, allocatable.memory)
-    if cpu_fraction >= 1 or mem_fraction >= 1:
+    """balanced_resource_allocation.go:39-63, in exact rational arithmetic.
+
+    Go computes int64((1 - |cpuFrac - memFrac|) * 10) in float64; this is the
+    same quantity as floor(10 * (den - |rc*am - rm*ac|) / den) with
+    den = ac*am, evaluated exactly (DEVIATIONS.md #16: scores deviate from
+    Go only where float64 rounding crosses an integer boundary, and are
+    identical across host/CPU/TPU)."""
+    rc, ac = requested.milli_cpu, allocatable.milli_cpu
+    rm, am = requested.memory, allocatable.memory
+    # fractionOfCapacity: capacity 0 -> fraction 1; fraction >= 1 -> score 0
+    if ac == 0 or rc >= ac or am == 0 or rm >= am:
         return 0
-    diff = abs(cpu_fraction - mem_fraction)
-    return int((1 - diff) * MAX_PRIORITY)
+    num = abs(rc * am - rm * ac)
+    den = ac * am
+    return (MAX_PRIORITY * (den - num)) // den
 
 
 def balanced_resource_allocation_map(pod: Pod, meta, node_info: NodeInfo) -> HostPriority:
@@ -307,7 +309,9 @@ def equal_priority_map(pod: Pod, meta, node_info: NodeInfo) -> HostPriority:
 # selector spreading (selector_spreading.go:66-175)
 # ---------------------------------------------------------------------------
 
-ZONE_WEIGHTING = 2.0 / 3.0
+# Go's zoneWeighting = 2.0/3.0 (selector_spreading.go:41) appears below (and
+# in jaxe/kernels.py) as its exact rational form node/3 + 2*zone/3, evaluated
+# in integer arithmetic with one floor at the end — see DEVIATIONS.md #16.
 
 
 def get_zone_key(node: Optional[Node]) -> str:
@@ -389,22 +393,26 @@ class SelectorSpread:
             counts_by_zone[zone_id] = counts_by_zone.get(zone_id, 0) + hp.score
         max_count_by_zone = max(counts_by_zone.values(), default=0)
         have_zones = bool(counts_by_zone)
+        # Exact rational form of Go's float64 math (DEVIATIONS.md #16):
+        # nodeScore = 10*(mn-c)/mn (10 when mn==0), zoneScore likewise, and
+        # the zone blend is nodeScore/3 + 2*zoneScore/3 (selector_spreading.go
+        # hardcodes zoneWeighting = 2.0/3.0) — one floor at the end.
         for hp in result:
-            f_score = float(MAX_PRIORITY)
-            if max_count_by_node > 0:
-                f_score = MAX_PRIORITY * ((max_count_by_node - hp.score)
-                                          / max_count_by_node)
+            mn = max_count_by_node
+            node_num, node_den = (mn - hp.score, mn) if mn > 0 else (1, 1)
+            zone_id = None
             if have_zones:
                 info = node_info_map.get(hp.host)
                 zone_id = get_zone_key(info.node if info else None)
-                if zone_id:
-                    zone_score = float(MAX_PRIORITY)
-                    if max_count_by_zone > 0:
-                        zone_score = MAX_PRIORITY * (
-                            (max_count_by_zone - counts_by_zone[zone_id])
-                            / max_count_by_zone)
-                    f_score = f_score * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zone_score
-            hp.score = int(f_score)
+            if zone_id:
+                mz = max_count_by_zone
+                zone_num, zone_den = ((mz - counts_by_zone[zone_id], mz)
+                                      if mz > 0 else (1, 1))
+                hp.score = (MAX_PRIORITY
+                            * (node_num * zone_den + 2 * zone_num * node_den)
+                            ) // (3 * node_den * zone_den)
+            else:
+                hp.score = (MAX_PRIORITY * node_num) // node_den
 
 
 # ---------------------------------------------------------------------------
@@ -472,11 +480,13 @@ class ServiceAntiAffinity:
             if label is None:
                 hp.score = 0
                 continue
-            f_score = float(MAX_PRIORITY)
+            # exact rational form of Go's float64 math (DEVIATIONS.md #16)
             if num_service_pods > 0:
-                f_score = MAX_PRIORITY * (
-                    (num_service_pods - pod_counts[label]) / num_service_pods)
-            hp.score = int(f_score)
+                hp.score = (MAX_PRIORITY
+                            * (num_service_pods - pod_counts[label])
+                            ) // num_service_pods
+            else:
+                hp.score = MAX_PRIORITY
 
 
 def make_service_anti_affinity_priority(pod_lister, service_lister, label: str):
@@ -502,10 +512,12 @@ class InterPodAffinityPriority:
         has_affinity = affinity is not None and affinity.pod_affinity is not None
         has_anti_affinity = affinity is not None and affinity.pod_anti_affinity is not None
 
-        counts: Dict[str, float] = {n.name: 0.0 for n in nodes}
+        # integer weights summed in exact integer arithmetic (Go uses float64
+        # for the same integer-valued quantities; DEVIATIONS.md #16)
+        counts: Dict[str, int] = {n.name: 0 for n in nodes}
 
         def process_term(term, pod_defining, pod_to_check, fixed_node: Node,
-                         weight: float) -> None:
+                         weight: int) -> None:
             namespaces = get_namespaces_from_pod_affinity_term(pod_defining, term)
             if not pod_matches_term_namespace_and_selector(
                     pod_to_check, namespaces, term.label_selector):
@@ -518,7 +530,7 @@ class InterPodAffinityPriority:
                                    multiplier: int) -> None:
             for wt in terms:
                 process_term(wt.pod_affinity_term, pod_defining, pod_to_check,
-                             fixed_node, float(wt.weight * multiplier))
+                             fixed_node, wt.weight * multiplier)
 
         def process_pod(existing_pod: Pod) -> None:
             existing_info = self._node_info(existing_pod.spec.node_name)
@@ -538,7 +550,7 @@ class InterPodAffinityPriority:
                 if self.hard_pod_affinity_weight > 0:
                     for term in ex_affinity.pod_affinity.required:
                         process_term(term, existing_pod, pod, existing_node,
-                                     float(self.hard_pod_affinity_weight))
+                                     self.hard_pod_affinity_weight)
                 process_weighted_terms(ex_affinity.pod_affinity.preferred,
                                        existing_pod, pod, existing_node, 1)
             if ex_has_anti:
@@ -555,18 +567,19 @@ class InterPodAffinityPriority:
             for existing_pod in pods:
                 process_pod(existing_pod)
 
-        max_count = max((counts[n.name] for n in nodes), default=0.0)
-        max_count = max(max_count, 0.0)
-        min_count = min((counts[n.name] for n in nodes), default=0.0)
-        min_count = min(min_count, 0.0)
+        max_count = max(max((counts[n.name] for n in nodes), default=0), 0)
+        min_count = min(min((counts[n.name] for n in nodes), default=0), 0)
 
         result = []
         for node in nodes:
-            f_score = 0.0
+            score = 0
             if (max_count - min_count) > 0:
-                f_score = MAX_PRIORITY * ((counts[node.name] - min_count)
-                                          / (max_count - min_count))
-            result.append(HostPriority(node.name, int(f_score)))
+                # exact rational form of Go's float64 normalize
+                # (DEVIATIONS.md #16); numerator is nonnegative, so floor
+                # division equals Go's toward-zero int() conversion
+                score = (MAX_PRIORITY * (counts[node.name] - min_count)
+                         ) // (max_count - min_count)
+            result.append(HostPriority(node.name, score))
         return result
 
 

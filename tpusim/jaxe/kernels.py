@@ -50,7 +50,6 @@ from tpusim.engine.predicates import (
     POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
     POD_TOLERATES_NODE_TAINTS_PRED,
 )
-from tpusim.engine.priorities import ZONE_WEIGHTING
 from tpusim.jaxe.state import (
     BIT_AFFINITY_NOT_MATCH,
     BIT_AFFINITY_RULES,
@@ -450,15 +449,91 @@ def _ratio_score(requested, capacity, most: bool):
         valid, ((capacity - requested) * MAX_PRIORITY) // jnp.maximum(capacity, 1), 0)
 
 
+# --- exact 128-bit integer helpers (4x32-bit limbs held in uint64) ---------
+# Score arithmetic must be EXACT, not float64: TPUs have no native f64 (XLA
+# emulates it), and emulated divisions round differently from the host's IEEE
+# f64, flipping scores at integer boundaries — observed as placement-hash
+# divergence between the CPU and TPU runs of the same workload. Products like
+# req_cpu*alloc_mem overflow int64 for large-memory nodes, so the balanced-
+# allocation score runs on 128-bit limbs (DEVIATIONS.md #16).
+
+_M32 = np.uint64(0xFFFFFFFF)
+
+
+def _mul_limbs(a, b):
+    """Exact 128-bit product of two nonnegative int64 arrays as 4x32-bit
+    limbs (least-significant first), each limb stored in a uint64."""
+    a = a.astype(jnp.uint64)
+    b = b.astype(jnp.uint64)
+    ah, al = a >> 32, a & _M32
+    bh, bl = b >> 32, b & _M32
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    l0 = ll & _M32
+    c1 = (ll >> 32) + (lh & _M32) + (hl & _M32)
+    l1 = c1 & _M32
+    c2 = (c1 >> 32) + (lh >> 32) + (hl >> 32) + (hh & _M32)
+    l2 = c2 & _M32
+    l3 = (c2 >> 32) + (hh >> 32)  # < 2^32: the full product is < 2^126
+    return (l0, l1, l2, l3)
+
+
+def _scale_limbs(limbs, k: int):
+    """limbs * k for a small Python int k (k <= 10); returns len+1 limbs."""
+    k64 = np.uint64(k)
+    out = []
+    carry = jnp.zeros_like(limbs[0])
+    for li in limbs:
+        v = li * k64 + carry  # < 2^32 * 10 + carry: fits uint64 easily
+        out.append(v & _M32)
+        carry = v >> 32
+    out.append(carry)
+    return tuple(out)
+
+
+def _ge_limbs(x, y):
+    """x >= y, lexicographic over equal-length limb tuples (LSB first)."""
+    ge = jnp.ones_like(x[0], dtype=bool)
+    for xi, yi in zip(x, y):  # LSB -> MSB; the last differing limb decides
+        ge = (xi > yi) | ((xi == yi) & ge)
+    return ge
+
+
+def _sub_limbs(x, y):
+    """x - y over 4-limb values, requiring x >= y elementwise."""
+    base = np.uint64(1) << np.uint64(32)
+    out = []
+    borrow = jnp.zeros_like(x[0])
+    for xi, yi in zip(x, y):
+        need = yi + borrow  # <= 2^32: no overflow
+        under = xi < need
+        out.append(jnp.where(under, xi + base - need, xi - need))
+        borrow = under.astype(jnp.uint64)
+    return tuple(out)
+
+
 def _balanced_score(req_cpu, req_mem, alloc_cpu, alloc_mem):
-    """balanced_resource_allocation.go:39-63 — float64 like Go."""
-    cpu_frac = jnp.where(alloc_cpu == 0, 1.0,
-                         req_cpu.astype(jnp.float64) / jnp.maximum(alloc_cpu, 1))
-    mem_frac = jnp.where(alloc_mem == 0, 1.0,
-                         req_mem.astype(jnp.float64) / jnp.maximum(alloc_mem, 1))
-    diff = jnp.abs(cpu_frac - mem_frac)
-    score = ((1.0 - diff) * MAX_PRIORITY).astype(jnp.int64)
-    return jnp.where((cpu_frac >= 1) | (mem_frac >= 1), 0, score)
+    """balanced_resource_allocation.go:39-63 in exact rational arithmetic.
+
+    score = floor(10 * (den - |rc*am - rm*ac|) / den), den = ac*am — the same
+    quantity Go computes as int64((1-|cpuFrac-memFrac|)*10) in float64, here
+    evaluated exactly on 128-bit limbs: score = #{t in 0..9 : 10*num <= t*den}
+    (t*den >= 10*num  <=>  t/10 >= num/den  counts each score unit)."""
+    p1 = _mul_limbs(req_cpu, alloc_mem)
+    p2 = _mul_limbs(req_mem, alloc_cpu)
+    swap = _ge_limbs(p1, p2)
+    hi = tuple(jnp.where(swap, a, b) for a, b in zip(p1, p2))
+    lo = tuple(jnp.where(swap, b, a) for a, b in zip(p1, p2))
+    num10 = _scale_limbs(_sub_limbs(hi, lo), 10)
+    den = _mul_limbs(alloc_cpu, alloc_mem)
+    score = jnp.zeros(req_cpu.shape, dtype=jnp.int64)
+    for t in range(10):
+        score = score + _ge_limbs(_scale_limbs(den, t), num10).astype(jnp.int64)
+    zero = ((alloc_cpu == 0) | (req_cpu >= alloc_cpu)
+            | (alloc_mem == 0) | (req_mem >= alloc_mem))
+    return jnp.where(zero, 0, score)
 
 
 def _seg_rows(values, doms, num_segments: int):
@@ -811,47 +886,53 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         # identified by the policy label. cnt counts such pods per node;
         # the reduce is over feasible nodes (the host maps over filtered
         # nodes only); unlabeled nodes score 0.
-        saa_cnt = st.saa_rows[st.saa_sig[x.group_id]].astype(jnp.float64) @ \
-            carry.presence.astype(jnp.float64)                  # [N]
-        saa_fcnt = jnp.where(feasible, saa_cnt, 0.0)
+        # the f64 matmul is exact (counts are small integers, far below
+        # 2^24); the normalize below is exact integer (DEVIATIONS.md #16)
+        saa_cnt = (st.saa_rows[st.saa_sig[x.group_id]].astype(jnp.float64) @
+                   carry.presence.astype(jnp.float64)).astype(jnp.int64)  # [N]
+        saa_fcnt = jnp.where(feasible, saa_cnt, 0)
         saa_total = jnp.sum(saa_fcnt)
         for e, w_saa in enumerate(ps.saa_weights):
             dom = st.saa_dom[e]
             labeled = dom > 0
             grp = jax.ops.segment_sum(
-                jnp.where(labeled, saa_fcnt, 0.0), dom,
-                num_segments=config.n_saa_doms).at[0].set(0.0)
+                jnp.where(labeled, saa_fcnt, 0), dom,
+                num_segments=config.n_saa_doms).at[0].set(0)
             f_score = jnp.where(
                 saa_total > 0,
-                MAX_PRIORITY * ((saa_total - grp[dom]) / saa_total),
-                float(MAX_PRIORITY))
-            score = score + jnp.where(labeled, f_score.astype(jnp.int64),
-                                      0) * w_saa
+                (MAX_PRIORITY * (saa_total - grp[dom]))
+                // jnp.maximum(saa_total, 1),
+                MAX_PRIORITY)
+            score = score + jnp.where(labeled, f_score, 0) * w_saa
 
     if config.has_services and w_spread:
         # SelectorSpreadPriority (selector_spreading.go:66-175): per-node count
         # of same-namespace pods matched by my services' selectors, then the
         # node/zone-blended normalize over feasible nodes
-        cnt = st.ss_rows[st.ss_sig[x.group_id]].astype(jnp.float64) @ \
-            carry.presence.astype(jnp.float64)                       # [N]
-        fcnt = jnp.where(feasible, cnt, 0.0)
+        # f64 matmul exact for small integer counts; normalize + zone blend
+        # in exact integer arithmetic, one floor at the end — matching the
+        # host's rational form of Go's nodeScore/3 + 2*zoneScore/3
+        # (selector_spreading.go hardcodes zoneWeighting=2.0/3.0;
+        # DEVIATIONS.md #16)
+        cnt = (st.ss_rows[st.ss_sig[x.group_id]].astype(jnp.float64) @
+               carry.presence.astype(jnp.float64)).astype(jnp.int64)  # [N]
+        fcnt = jnp.where(feasible, cnt, 0)
         max_node = jnp.max(fcnt)
         zdom = st.zone_dom
         zvalid = zdom > 0
         zcnt = jax.ops.segment_sum(fcnt, zdom,
-                                   num_segments=config.n_zone_doms).at[0].set(0.0)
+                                   num_segments=config.n_zone_doms).at[0].set(0)
         have_zones = jnp.any(feasible & zvalid)
         max_zone = jnp.max(zcnt)
-        fscore = jnp.where(max_node > 0,
-                           MAX_PRIORITY * ((max_node - cnt) / max_node),
-                           float(MAX_PRIORITY))
-        zscore = jnp.where(max_zone > 0,
-                           MAX_PRIORITY * ((max_zone - zcnt[zdom]) / max_zone),
-                           float(MAX_PRIORITY))
-        blended = jnp.where(
-            have_zones & zvalid,
-            fscore * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zscore, fscore)
-        score = score + blended.astype(jnp.int64) * w_spread
+        node_num = jnp.where(max_node > 0, max_node - cnt, 1)
+        node_den = jnp.maximum(max_node, 1)
+        zone_num = jnp.where(max_zone > 0, max_zone - zcnt[zdom], 1)
+        zone_den = jnp.maximum(max_zone, 1)
+        plain = (MAX_PRIORITY * node_num) // node_den
+        blend = (MAX_PRIORITY
+                 * (node_num * zone_den + 2 * zone_num * node_den)
+                 ) // (3 * node_den * zone_den)
+        score = score + jnp.where(have_zones & zvalid, blend, plain) * w_spread
 
     if config.has_interpod and w_interpod:
         # InterPodAffinityPriority (interpod_affinity.go:118+): float64 counts
@@ -877,11 +958,19 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         counts = counts + jnp.sum(
             jnp.where(st.topo_dom > 0, wsum_at, 0.0), axis=0)
 
-        maxc = jnp.maximum(jnp.max(jnp.where(feasible, counts, -jnp.inf)), 0.0)
-        minc = jnp.minimum(jnp.min(jnp.where(feasible, counts, jnp.inf)), 0.0)
+        # counts are integer-valued f64 sums (weights and hard_weight are
+        # ints, well below 2^24: exact); the normalize is exact integer —
+        # the numerator is nonnegative, so floor division equals Go's
+        # toward-zero int() conversion (DEVIATIONS.md #16)
+        counts_i = counts.astype(jnp.int64)
+        big = jnp.int64(1) << 62
+        maxc = jnp.maximum(jnp.max(jnp.where(feasible, counts_i, -big)), 0)
+        minc = jnp.minimum(jnp.min(jnp.where(feasible, counts_i, big)), 0)
         rng = maxc - minc
-        ip = jnp.where(rng > 0, MAX_PRIORITY * ((counts - minc) / rng), 0.0)
-        score = score + ip.astype(jnp.int64) * w_interpod
+        ip = jnp.where(rng > 0,
+                       (MAX_PRIORITY * (counts_i - minc)) // jnp.maximum(rng, 1),
+                       0)
+        score = score + ip * w_interpod
 
     return feasible, reason_bits, score, n_feasible
 

@@ -23,6 +23,7 @@ Shape unification:
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -47,7 +48,7 @@ from tpusim.jaxe.kernels import (
     Statics,
     carry_init_host,
     config_for,
-    make_step,
+    _schedule_scan_impl,
     pod_columns_to_host,
     statics_to_host,
 )
@@ -55,6 +56,20 @@ from tpusim.jaxe.sharding import pad_node_axis, snap_shardings
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
 
 GHOST_CPU = np.int64(1) << 61  # larger than any allocatable: never feasible
+
+
+@partial(jax.jit, static_argnames=("config",))
+def _batched(config, carries, statics_b, xs_b):
+    """vmap of the exact scan over the scenario axis, jitted at module level
+    so jax's compile cache persists across run_what_if invocations: repeated
+    what-if studies with matching shapes+config skip the (minutes-long on
+    TPU) XLA compile that dominates a cold call (BASELINE.md config 5)."""
+
+    def one(carry, st, xs):
+        _, choices, counts, _adv = _schedule_scan_impl(config, carry, st, xs)
+        return choices, counts
+
+    return jax.vmap(one)(carries, statics_b, xs_b)
 
 
 @dataclass
@@ -285,22 +300,12 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         from dataclasses import replace as _dc_replace
 
         config = _dc_replace(config, policy=cp.spec, n_saa_doms=n_saa_doms)
-    step = make_step(config)
-
-    @jax.jit
-    def batched(carries, statics_b, xs_b):
-        def one(carry, st, xs):
-            (final_carry, _), (choices, counts, _adv) = jax.lax.scan(
-                step, (carry, st), xs)
-            return choices, counts
-        return jax.vmap(one)(carries, statics_b, xs_b)
-
     if mesh is not None:
         with mesh:
-            choices_b, counts_b = batched(carries, statics_b, xs_b)
+            choices_b, counts_b = _batched(config, carries, statics_b, xs_b)
             choices_b = np.asarray(choices_b)
     else:
-        choices_b, counts_b = batched(carries, statics_b, xs_b)
+        choices_b, counts_b = _batched(config, carries, statics_b, xs_b)
         choices_b = np.asarray(choices_b)
     counts_b = np.asarray(counts_b)
 

@@ -133,16 +133,21 @@ def _prepare(snapshot, pods, provider_most_requested=False, to_device=True):
     statics = statics_to_device(compiled)
     xs = (pod_columns_to_device(cols) if to_device
           else pod_columns_to_host(cols))
-    return compiled, config, carry, statics, xs
+    return compiled, config, carry, statics, xs, cols
+
+
+def _checksum(choices) -> int:
+    """Placement checksum; fetching it as a host scalar provably forces the
+    computation that produced `choices` (unlike block_until_ready on the
+    axon runtime, which has been observed returning early)."""
+    return int(np.sum(np.where(np.asarray(choices) >= 0,
+                               np.asarray(choices), -1)))
 
 
 def _run_once(config, carry, statics, xs, batch: int, chunk: int):
     """One full scheduling pass; returns (choices np, checksum int, counts).
 
-    The checksum is a device-side reduction fetched as a host scalar: fetching
-    it provably forces the whole computation (choices feeds the sum), unlike
-    block_until_ready on the axon runtime, which has been observed returning
-    early. Batches longer than `chunk` run through the donated-carry chunked
+    Batches longer than `chunk` run through the donated-carry chunked
     scan (bounded HBM churn, progress logging)."""
     import jax.numpy as jnp
 
@@ -183,11 +188,10 @@ def _run_once(config, carry, statics, xs, batch: int, chunk: int):
             f"({time.perf_counter() - t0:.1f}s)")
         choices = np.concatenate(choice_parts)[:p]
         counts = np.concatenate([np.asarray(c) for c in count_parts])[:p]
-        return choices, int(np.sum(np.where(choices >= 0, choices, -1))), counts
+        return choices, _checksum(choices), counts
     else:
         _, choices, counts, _ = schedule_scan(config, carry, statics, xs)
-    checksum = int(jnp.sum(jnp.where(choices >= 0, choices, -1)))
-    return np.asarray(choices), checksum, np.asarray(counts)
+    return np.asarray(choices), _checksum(choices), np.asarray(counts)
 
 
 def measure_config(name: str, snapshot, pods, platform: str, batch: int,
@@ -211,14 +215,48 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
             f"= {ref_rate:.1f} pods/s")
 
     use_chunks = batch == 0 and chunk and num_pods > chunk
-    compiled, config, carry, statics, xs = _prepare(snapshot, pods,
-                                                    to_device=not use_chunks)
+    compiled, config, carry, statics, xs, cols = _prepare(
+        snapshot, pods, to_device=not use_chunks)
     if compiled.unsupported:
         return {"metric": f"{name} (unsupported: {compiled.unsupported})",
                 "value": 0, "unit": "pods/s", "vs_baseline": 0}
 
+    fast_plan = None
+    if batch == 0 and os.environ.get("TPUSIM_FAST") == "1":
+        import jax
+
+        from tpusim.jaxe.fastscan import fast_scan, plan_fast
+
+        # off-TPU, fast_scan would auto-select the Pallas INTERPRETER —
+        # orders of magnitude slower than the XLA scan and meaningless as a
+        # benchmark; only TPUSIM_FAST_INTERPRET=1 (correctness runs) allows it
+        if (jax.default_backend() != "tpu"
+                and os.environ.get("TPUSIM_FAST_INTERPRET") != "1"):
+            log("  TPUSIM_FAST requested but backend is not TPU; "
+                "using the XLA scan (set TPUSIM_FAST_INTERPRET=1 to force "
+                "the interpreter for correctness checks)")
+        else:
+            fast_plan, why = plan_fast(config, compiled, cols)
+            if fast_plan is None:
+                log(f"  TPUSIM_FAST requested but ineligible ({why}); "
+                    "using the XLA scan")
+            else:
+                log("  pallas fast path eligible")
+
+    def one_pass(carry):
+        if fast_plan is not None:
+            t_start = time.perf_counter()
+
+            def prog(ci, total, done):
+                log(f"  fast chunk {ci}/{total}: {done}/{num_pods} pods "
+                    f"({time.perf_counter() - t_start:.1f}s)")
+
+            f_choices, f_counts, _adv = fast_scan(fast_plan, progress=prog)
+            return f_choices, _checksum(f_choices), f_counts
+        return _run_once(config, carry, statics, xs, batch, chunk)
+
     t0 = time.perf_counter()
-    choices, checksum, counts = _run_once(config, carry, statics, xs, batch, chunk)
+    choices, checksum, counts = one_pass(carry)
     cold = time.perf_counter() - t0
     log(f"  device cold (incl XLA compile): {cold:.1f}s (checksum={checksum})")
 
@@ -227,7 +265,7 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
     for _ in range(timed_runs):
         carry = carry_init(compiled)  # fresh carry (the donated one is gone)
         t0 = time.perf_counter()
-        choices, cs, counts = _run_once(config, carry, statics, xs, batch, chunk)
+        choices, cs, counts = one_pass(carry)
         warm_times.append(time.perf_counter() - t0)
         if cs != checksum:
             drift = True
@@ -249,7 +287,10 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
             != ref_placements[i].node_name)
         log(f"  parity check on first {sub} pods: {mismatches} mismatches")
 
-    mode = "exact scan" if batch == 0 else f"wavefront K={batch}"
+    if batch == 0:
+        mode = "exact scan (pallas)" if fast_plan is not None else "exact scan"
+    else:
+        mode = f"wavefront K={batch}"
     result = {
         "metric": f"scheduled pods/sec ({name}, {mode}, platform={platform}"
                   + (f", parity_mismatches={mismatches}" if mismatches is not None else "")
@@ -483,7 +524,7 @@ def run_phases(platform: str, chunk: int) -> None:
     if platform == "cpu":
         num_pods, num_nodes = 5_000, 1_000
     snapshot, pods = build_workload(num_pods, num_nodes)
-    compiled, config, carry, statics, xs = _prepare(snapshot, pods)
+    compiled, config, carry, statics, xs, _cols = _prepare(snapshot, pods)
 
     def timeit(fn, *args, reps=3, label=""):
         # per-stage logs keep the parent's stall watchdog fed: phase-program

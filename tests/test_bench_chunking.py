@@ -22,7 +22,7 @@ def workload():
 
 def _schedule(snapshot, pods, chunk: int):
     use_chunks = chunk and len(pods) > chunk
-    compiled, config, carry, statics, xs = bench._prepare(
+    compiled, config, carry, statics, xs, _cols = bench._prepare(
         snapshot, pods, to_device=not use_chunks)
     assert not compiled.unsupported
     return bench._run_once(config, carry, statics, xs, batch=0, chunk=chunk)

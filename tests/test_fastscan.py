@@ -1,0 +1,112 @@
+"""Pallas fast-path parity: fast_scan == schedule_scan, bit for bit.
+
+Runs the kernel in interpreter mode on CPU (auto-selected off-TPU), over
+failure-heavy workloads exercising every eligible stage: node conditions,
+unschedulable, resource exhaustion (cpu/mem/pods), hostname pins, selectors
+incl. never-matching zones, NoSchedule taints + tolerations, best-effort
+zero-request pods, preferred node affinity, PreferNoSchedule taint scoring,
+seeded running pods in the initial carry, and both providers.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from tpusim.jaxe import ensure_x64  # noqa: E402
+
+ensure_x64()
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod  # noqa: E402
+from tpusim.jaxe.fastscan import fast_scan, plan_fast  # noqa: E402
+from tpusim.jaxe.kernels import (  # noqa: E402
+    carry_init,
+    config_for,
+    pod_columns_to_device,
+    schedule_scan,
+    statics_to_device,
+)
+from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster  # noqa: E402
+
+
+def build(seed: int, num_nodes: int = 40, num_pods: int = 180):
+    rng = np.random.RandomState(seed)
+    nodes = []
+    for i in range(num_nodes):
+        taints = None
+        if i % 3 == 0:
+            taints = [{"key": "dedicated", "value": "batch",
+                       "effect": "NoSchedule"}]
+        if i % 5 == 1:
+            taints = (taints or []) + [{"key": "soft", "value": "x",
+                                        "effect": "PreferNoSchedule"}]
+        nodes.append(make_node(
+            f"n{i}", milli_cpu=int(rng.choice([500, 1000, 2000])),
+            memory=int(rng.choice([1, 2, 4])) * 1024**3,
+            pods=int(rng.choice([3, 8, 110])),
+            labels={"zone": f"z{i % 3}"}, taints=taints,
+            unschedulable=(i % 13 == 0), ready=(i % 17 != 3)))
+    running = [make_pod(f"r{i}", milli_cpu=300, memory=2**28,
+                        node_name=f"n{i % num_nodes}", phase="Running")
+               for i in range(25)]
+    pods = []
+    for i in range(num_pods):
+        kw = {}
+        if i % 5 == 0:
+            kw["tolerations"] = [{"key": "dedicated", "operator": "Equal",
+                                  "value": "batch", "effect": "NoSchedule"}]
+        if i % 4 == 0:
+            kw["node_selector"] = {"zone": f"z{i % 4}"}  # z3 never matches
+        if i % 9 == 0:
+            kw["node_name"] = f"n{i % 50}"  # hostname pins, some dangling
+        if i % 13 == 0:
+            pods.append(make_pod(f"p{i}"))  # zero-request best-effort
+            continue
+        if i % 11 == 0:
+            kw["affinity"] = {"nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": 10, "preference": {"matchExpressions": [
+                        {"key": "zone", "operator": "In",
+                         "values": ["z1"]}]}}]}}
+        pods.append(make_pod(
+            f"p{i}", milli_cpu=int(rng.randint(1, 25)) * 100,
+            memory=int(rng.randint(1, 24)) * 2**27, **kw))
+    return ClusterSnapshot(nodes=nodes, pods=running), pods
+
+
+@pytest.mark.parametrize("seed,most_requested", [(0, False), (1, True)])
+def test_fast_scan_matches_xla_scan(seed, most_requested):
+    snapshot, pods = build(seed)
+    compiled, cols = compile_cluster(snapshot, pods)
+    assert not compiled.unsupported
+    config = config_for(
+        [compiled], most_requested=most_requested,
+        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+    plan, reason = plan_fast(config, compiled, cols)
+    assert plan is not None, reason
+
+    _, choices, counts, advanced = schedule_scan(
+        config, carry_init(compiled), statics_to_device(compiled),
+        pod_columns_to_device(cols))
+    # chunk 64 exercises multiple kernel invocations + ghost-padded tails
+    f_choices, f_counts, f_adv = fast_scan(plan, chunk=64)
+    assert np.array_equal(f_choices, np.asarray(choices))
+    assert np.array_equal(f_counts, np.asarray(counts))
+    assert np.array_equal(f_adv, np.asarray(advanced))
+    scheduled = int(np.sum(f_choices >= 0))
+    assert 0 < scheduled < len(pods)  # both outcomes actually exercised
+
+
+def test_ineligible_workloads_report_reasons():
+    nodes = [make_node("n0")]
+    pods = [make_pod("p0", milli_cpu=100, memory=2**20, labels={"app": "a"},
+                     affinity={"podAffinity": {
+                         "requiredDuringSchedulingIgnoredDuringExecution": [
+                             {"labelSelector": {"matchLabels": {"app": "a"}},
+                              "topologyKey": "kubernetes.io/hostname"}]}})]
+    compiled, cols = compile_cluster(ClusterSnapshot(nodes=nodes), pods)
+    config = config_for([compiled], most_requested=False,
+                        num_reason_bits=NUM_FIXED_BITS)
+    plan, reason = plan_fast(config, compiled, cols)
+    assert plan is None
+    assert "has_interpod" in reason

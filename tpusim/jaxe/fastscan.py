@@ -1,0 +1,524 @@
+"""Pallas TPU fast path for the exact sequential scan.
+
+The production `schedule_scan` is a `lax.scan` whose per-pod step is one XLA
+while-loop iteration; on TPU each iteration pays loop/dispatch overhead that
+dwarfs the [N]-wide arithmetic (measured ~94us/pod at 10k nodes vs ~5us of
+compute). This module runs the same step as a single Pallas kernel with a
+grid over the pod axis: the carry ([N]-sized node state) lives in VMEM
+output blocks that persist across grid steps, pod scalars and pregathered
+signature-table rows stream in via the grid pipeline, and each step is pure
+VPU work — no per-pod dispatch.
+
+Semantics are IDENTICAL to the XLA path for eligible workloads (differential
+tests drive both); ineligible workloads fall back to `schedule_scan`.
+
+Eligibility (checked by `plan_fast`, reasons returned):
+  * no pod-group features — host ports, services/spreading, inter-pod
+    (anti)affinity, volume predicates (`EngineConfig.has_*` all False), no
+    scalar resources, no policy, no ServiceAffinity;
+  * every resource quantity reduces exactly to int32: values are divided by
+    the per-axis gcd (exact — fractions and fit comparisons are
+    unit-invariant) and the reduced values must stay under 2^29, with the
+    BalancedResourceAllocation product bound 10*max_cpu*max_mem < 2^31
+    (Mosaic has no 64-bit integers, so the kernel is int32 throughout;
+    DEVIATIONS.md #16's exactness contract is preserved because the reduced
+    arithmetic never overflows).
+
+Reference mapping (same as kernels._evaluate for this subset):
+  CheckNodeCondition/Unschedulable -> cond_fail_bits stage
+  GeneralPredicates (resources, hostname, selector+affinity) -> stage 2
+    (predicates.go:1059-1123, :659-776, :780-865)
+  PodToleratesNodeTaints (predicates.go:1465-1493) -> stage 3
+  CheckNodeMemory/DiskPressure (predicates.go:1502-1541) -> stages 4-5
+  Least/MostRequested, BalancedResourceAllocation, NodeAffinity,
+  TaintToleration normalizes, NodePreferAvoidPods -> int32 score sum
+  selectHost round-robin tie-break (generic_scheduler.go:183-198) -> masked
+    argmax + rank-k tie pick carried through the VMEM rr cell
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on some non-TPU builds; interpret mode needs none
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover - exercised only on exotic builds
+    pltpu = None
+    _VMEM = _SMEM = None
+
+from tpusim.jaxe.state import NUM_FIXED_BITS, CompiledCluster, PodColumns
+from tpusim.jaxe.kernels import (
+    AVOID_PODS_WEIGHT,
+    MAX_PRIORITY,
+    EngineConfig,
+)
+from tpusim.jaxe.state import (
+    BIT_DISK_PRESSURE,
+    BIT_HOSTNAME_MISMATCH,
+    BIT_INSUFFICIENT_CPU,
+    BIT_INSUFFICIENT_EPHEMERAL,
+    BIT_INSUFFICIENT_GPU,
+    BIT_INSUFFICIENT_MEMORY,
+    BIT_INSUFFICIENT_PODS,
+    BIT_MEMORY_PRESSURE,
+    BIT_NODE_SELECTOR_MISMATCH,
+    BIT_TAINTS_NOT_TOLERATED,
+)
+
+INT_LIMIT = 1 << 29          # per-value bound after gcd reduction
+GHOST_REQ = 1 << 30          # > any reduced allocatable: never feasible
+PAD_SENTINEL_BIT = 30        # cond bit for padded nodes; >= NUM_FIXED_BITS
+LANES = 128
+
+
+@dataclass
+class FastPlan:
+    """int32 device-ready arrays; node axis padded to a multiple of 128."""
+
+    num_nodes: int           # real nodes (pad rows follow)
+    num_pods: int
+    most_requested: bool
+    # statics [1, Npad]
+    alloc_cpu: np.ndarray
+    alloc_mem: np.ndarray
+    alloc_gpu: np.ndarray
+    alloc_eph: np.ndarray
+    allowed: np.ndarray
+    cond_bits: np.ndarray
+    mem_pressure: np.ndarray
+    disk_pressure: np.ndarray
+    # signature tables [S, Npad]
+    selector_ok: np.ndarray
+    taint_ok: np.ndarray
+    intolerable: np.ndarray
+    aff_count: np.ndarray
+    avoid_score: np.ndarray
+    host_ok: np.ndarray
+    # initial carry [1, Npad]
+    used_cpu: np.ndarray
+    used_mem: np.ndarray
+    used_gpu: np.ndarray
+    used_eph: np.ndarray
+    nonzero_cpu: np.ndarray
+    nonzero_mem: np.ndarray
+    pod_count: np.ndarray
+    # pod columns [P]
+    req_cpu: np.ndarray
+    req_mem: np.ndarray
+    req_gpu: np.ndarray
+    req_eph: np.ndarray
+    nz_cpu: np.ndarray
+    nz_mem: np.ndarray
+    zero_request: np.ndarray
+    best_effort: np.ndarray
+    sel_id: np.ndarray
+    tol_id: np.ndarray
+    aff_id: np.ndarray
+    avoid_id: np.ndarray
+    host_id: np.ndarray
+
+
+def _gcd_reduce(arrays) -> Tuple[int, list]:
+    """gcd over every value in `arrays`; returns (g, arrays // g)."""
+    g = 0
+    for a in arrays:
+        for v in np.unique(np.asarray(a, dtype=np.int64)):
+            g = math.gcd(g, int(v))
+    if g <= 1:
+        return max(g, 1), [np.asarray(a, dtype=np.int64) for a in arrays]
+    return g, [np.asarray(a, dtype=np.int64) // g for a in arrays]
+
+
+def plan_fast(config: EngineConfig, compiled: CompiledCluster,
+              cols: PodColumns) -> Tuple[Optional[FastPlan], str]:
+    """Build the int32 plan, or (None, reason) when ineligible."""
+    if config.policy is not None:
+        return None, "policy configured"
+    for flag in ("has_ports", "has_services", "has_interpod",
+                 "has_disk_conflict", "has_maxpd", "has_vol_zone"):
+        if getattr(config, flag):
+            return None, f"pod-group feature {flag}"
+    if compiled.scalar_names:
+        return None, "scalar resources"
+    s, t, d = compiled.statics, compiled.tables, compiled.dynamic
+
+    g_cpu, (ac, rc, nzc, uc, nzuc) = _gcd_reduce(
+        [s.alloc_cpu, cols.req_cpu, cols.nz_cpu, d.used_cpu, d.nonzero_cpu])
+    g_mem, (am, rm, nzm, um, nzum) = _gcd_reduce(
+        [s.alloc_mem, cols.req_mem, cols.nz_mem, d.used_mem, d.nonzero_mem])
+    g_gpu, (ag, rg, ug) = _gcd_reduce([s.alloc_gpu, cols.req_gpu, d.used_gpu])
+    g_eph, (ae, re_, ue) = _gcd_reduce([s.alloc_eph, cols.req_eph, d.used_eph])
+
+    for name, arrs in (("cpu", (ac, rc, nzc, uc, nzuc)),
+                       ("memory", (am, rm, nzm, um, nzum)),
+                       ("gpu", (ag, rg, ug)), ("ephemeral", (ae, re_, ue))):
+        for a in arrs:
+            if a.size and int(a.max(initial=0)) >= INT_LIMIT:
+                return None, f"{name} values exceed int32 after gcd reduction"
+    # BalancedResourceAllocation products must fit int32 including the
+    # nonzero totals (which can exceed allocatable; bounded by allowed_pods
+    # extra defaulted requests per node)
+    allowed_max = int(np.max(s.allowed_pods, initial=0))
+    bound_c = int(ac.max(initial=0)) + allowed_max * int(
+        max(nzc.max(initial=0), nzuc.max(initial=0), 0))
+    bound_m = int(am.max(initial=0)) + allowed_max * int(
+        max(nzm.max(initial=0), nzum.max(initial=0), 0))
+    if 10 * bound_c * bound_m >= (1 << 31):
+        return None, "balanced-allocation product exceeds int32"
+    for name, table in (("affinity", t.affinity_count),
+                        ("intolerable", t.intolerable),
+                        ("avoid", t.avoid_score)):
+        if table.size and MAX_PRIORITY * int(np.max(np.abs(table))) * max(
+                AVOID_PODS_WEIGHT if name == "avoid" else 1, 1) >= (1 << 31):
+            return None, f"{name} table exceeds int32"
+
+    n = len(np.asarray(s.alloc_cpu))
+    npad = -(-max(n, 1) // LANES) * LANES
+
+    def node_row(a, fill=0):
+        a = np.asarray(a, dtype=np.int64).astype(np.int32)
+        out = np.full((1, npad), fill, dtype=np.int32)
+        out[0, :n] = a
+        return out
+
+    def table_rows(a, fill=0):
+        a = np.asarray(a)
+        rows = max(a.shape[0], 1)
+        out = np.full((rows, npad), fill, dtype=np.int32)
+        if a.size:
+            out[:a.shape[0], :n] = a.astype(np.int32)
+        return out
+
+    cond = node_row(np.asarray(s.cond_fail_bits, dtype=np.int64)
+                    .astype(np.int32))
+    cond[0, n:] = np.int32(1 << PAD_SENTINEL_BIT)
+
+    def pods(a):
+        return np.asarray(a, dtype=np.int64).astype(np.int32)
+
+    plan = FastPlan(
+        num_nodes=n, num_pods=len(np.asarray(cols.req_cpu)),
+        most_requested=config.most_requested,
+        alloc_cpu=node_row(ac), alloc_mem=node_row(am),
+        alloc_gpu=node_row(ag), alloc_eph=node_row(ae),
+        allowed=node_row(s.allowed_pods), cond_bits=cond,
+        mem_pressure=node_row(np.asarray(s.mem_pressure, dtype=np.int64)),
+        disk_pressure=node_row(np.asarray(s.disk_pressure, dtype=np.int64)),
+        selector_ok=table_rows(t.selector_ok),
+        taint_ok=table_rows(t.taint_ok),
+        intolerable=table_rows(t.intolerable),
+        aff_count=table_rows(t.affinity_count),
+        avoid_score=table_rows(t.avoid_score),
+        host_ok=table_rows(t.host_ok),
+        used_cpu=node_row(uc), used_mem=node_row(um),
+        used_gpu=node_row(ug), used_eph=node_row(ue),
+        nonzero_cpu=node_row(nzuc), nonzero_mem=node_row(nzum),
+        pod_count=node_row(d.pod_count),
+        req_cpu=pods(rc), req_mem=pods(rm), req_gpu=pods(rg),
+        req_eph=pods(re_),
+        nz_cpu=pods(nzc), nz_mem=pods(nzm),
+        zero_request=pods(np.asarray(cols.zero_request, dtype=np.int64)),
+        best_effort=pods(np.asarray(cols.best_effort, dtype=np.int64)),
+        sel_id=pods(cols.sel_id), tol_id=pods(cols.tol_id),
+        aff_id=pods(cols.aff_id), avoid_id=pods(cols.avoid_id),
+        host_id=pods(cols.host_id),
+    )
+    return plan, ""
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _make_kernel(most_requested: bool, num_bits: int):
+    def kernel(rc_r, rm_r, rg_r, re_r, nzc_r, nzm_r, zr_r, be_r,
+               sel_r, tol_r, intol_r, aff_r, av_r, host_r,
+               acpu_r, amem_r, agpu_r, aeph_r, allowed_r, cond_r, mpr_r, dpr_r,
+               iuc_r, ium_r, iug_r, iue_r, inzc_r, inzm_r, ipc_r, imisc_r,
+               ouc_r, oum_r, oug_r, oue_r, onzc_r, onzm_r, opc_r, omisc_r,
+               choice_r, counts_r, adv_r):
+        p = pl.program_id(0)
+
+        @pl.when(p == 0)
+        def _init():
+            ouc_r[:] = iuc_r[:]
+            oum_r[:] = ium_r[:]
+            oug_r[:] = iug_r[:]
+            oue_r[:] = iue_r[:]
+            onzc_r[:] = inzc_r[:]
+            onzm_r[:] = inzm_r[:]
+            opc_r[:] = ipc_r[:]
+            omisc_r[:] = imisc_r[:]
+
+        rc = rc_r[0, 0]
+        rm = rm_r[0, 0]
+        rg = rg_r[0, 0]
+        re = re_r[0, 0]
+        nzc = nzc_r[0, 0]
+        nzm = nzm_r[0, 0]
+        check_res = zr_r[0, 0] == 0
+        best_effort = be_r[0, 0] != 0
+        rr = omisc_r[0, 0]
+
+        used_c = ouc_r[:]
+        used_m = oum_r[:]
+        used_g = oug_r[:]
+        used_e = oue_r[:]
+        nz_c = onzc_r[:]
+        nz_m = onzm_r[:]
+        pc = opc_r[:]
+        acpu = acpu_r[:]
+        amem = amem_r[:]
+
+        # ---- filter stages, predicatesOrdering (kernels._evaluate) ----
+        cond = cond_r[:]
+        fail_cond = cond != 0
+
+        insuff_pods = (pc + 1) > allowed_r[:]
+        insuff_cpu = check_res & (acpu < used_c + rc)
+        insuff_mem = check_res & (amem < used_m + rm)
+        insuff_gpu = check_res & (agpu_r[:] < used_g + rg)
+        insuff_eph = check_res & (aeph_r[:] < used_e + re)
+        fail_res = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
+                    | insuff_eph)
+        host_bad = host_r[:] == 0
+        sel_bad = sel_r[:] == 0
+        fail_general = fail_res | host_bad | sel_bad
+        bits_general = (
+            insuff_pods.astype(jnp.int32) << BIT_INSUFFICIENT_PODS
+            | insuff_cpu.astype(jnp.int32) << BIT_INSUFFICIENT_CPU
+            | insuff_mem.astype(jnp.int32) << BIT_INSUFFICIENT_MEMORY
+            | insuff_gpu.astype(jnp.int32) << BIT_INSUFFICIENT_GPU
+            | insuff_eph.astype(jnp.int32) << BIT_INSUFFICIENT_EPHEMERAL
+            | host_bad.astype(jnp.int32) << BIT_HOSTNAME_MISMATCH
+            | sel_bad.astype(jnp.int32) << BIT_NODE_SELECTOR_MISMATCH)
+        fail_taint = tol_r[:] == 0
+        fail_mem_pr = (mpr_r[:] != 0) & best_effort
+        fail_disk_pr = dpr_r[:] != 0
+
+        feasible = ~(fail_cond | fail_general | fail_taint | fail_mem_pr
+                     | fail_disk_pr)
+        # short-circuit reason selection: first failing stage wins
+        reason = jnp.zeros_like(cond)
+        stages = ((fail_cond, cond),
+                  (fail_general, bits_general),
+                  (fail_taint, jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED),
+                  (fail_mem_pr, jnp.int32(1) << BIT_MEMORY_PRESSURE),
+                  (fail_disk_pr, jnp.int32(1) << BIT_DISK_PRESSURE))
+        for fail, bits in reversed(stages):
+            reason = jnp.where(fail, bits, reason)
+        n_feasible = jnp.sum(feasible.astype(jnp.int32), dtype=jnp.int32)
+        found = n_feasible > 0
+
+        # ---- score (int32 throughout; products bounded by plan_fast) ----
+        total_c = nz_c + nzc
+        total_m = nz_m + nzm
+
+        def ratio(req, cap):
+            valid = (cap > 0) & (req <= cap)
+            if most_requested:
+                expr = (req * MAX_PRIORITY) // jnp.maximum(cap, 1)
+            else:
+                expr = ((cap - req) * MAX_PRIORITY) // jnp.maximum(cap, 1)
+            return jnp.where(valid, expr, 0)
+
+        score = (ratio(total_c, acpu) + ratio(total_m, amem)) // 2
+        # balanced (exact rational, DEVIATIONS.md #16): products fit int32
+        num = jnp.abs(total_c * amem - total_m * acpu)
+        den = acpu * amem
+        bal = (MAX_PRIORITY * (den - num)) // jnp.maximum(den, 1)
+        bal_zero = ((acpu == 0) | (total_c >= acpu)
+                    | (amem == 0) | (total_m >= amem))
+        score = score + jnp.where(bal_zero, 0, bal)
+        # NodeAffinityPriority normalize over feasible nodes
+        aff = aff_r[:]
+        aff_max = jnp.max(jnp.where(feasible, aff, 0))
+        score = score + jnp.where(
+            aff_max > 0, MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
+        # TaintTolerationPriority reversed normalize
+        intol = intol_r[:]
+        intol_max = jnp.max(jnp.where(feasible, intol, 0))
+        score = score + jnp.where(
+            intol_max > 0,
+            MAX_PRIORITY - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
+            MAX_PRIORITY)
+        score = score + av_r[:] * AVOID_PODS_WEIGHT
+
+        # ---- selectHost: stable-desc argmax + round-robin tie pick ----
+        masked = jnp.where(feasible, score, -1)
+        max_score = jnp.max(masked)
+        tie = feasible & (masked == max_score)
+        ties = jnp.maximum(jnp.sum(tie.astype(jnp.int32), dtype=jnp.int32), 1)
+        k = jnp.where(n_feasible > 1, rr % ties, 0)
+        rank = jnp.cumsum(tie.astype(jnp.int32), axis=1, dtype=jnp.int32) - 1
+        pick = tie & (rank == k)
+        idx_row = jax.lax.broadcasted_iota(jnp.int32, pick.shape, 1)
+        choice = jnp.min(jnp.where(pick, idx_row, jnp.int32(1 << 30)))
+        choice_r[0, 0] = jnp.where(found, choice, -1)
+        adv_r[0, 0] = (n_feasible > 1).astype(jnp.int32)
+
+        # ---- reason histogram (zeros when scheduled) ----
+        fr = jnp.where(found, jnp.zeros_like(reason), reason)
+        for b in range(num_bits):
+            counts_r[0, b] = jnp.sum((fr >> b) & 1, dtype=jnp.int32)
+        counts_r[0, num_bits:] = jnp.zeros(
+            (counts_r.shape[1] - num_bits,), dtype=jnp.int32)
+
+        # ---- bind: single-element scatter-add at the chosen node ----
+        i = jnp.maximum(choice, 0)
+
+        @pl.when(found)
+        def _bind():
+            ouc_r[0, i] = used_c[0, i] + rc
+            oum_r[0, i] = used_m[0, i] + rm
+            oug_r[0, i] = used_g[0, i] + rg
+            oue_r[0, i] = used_e[0, i] + re
+            onzc_r[0, i] = nz_c[0, i] + nzc
+            onzm_r[0, i] = nz_m[0, i] + nzm
+            opc_r[0, i] = pc[0, i] + 1
+
+        omisc_r[0, 0] = rr + (n_feasible > 1).astype(jnp.int32)
+
+    return kernel
+
+
+@lru_cache(maxsize=16)
+def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
+                counts_w: int, interpret: bool):
+    """jitted pallas_call for one (node-pad, chunk) shape."""
+    kernel = _make_kernel(most_requested, num_bits)
+
+    def smem_scalar():
+        return pl.BlockSpec((1, 1), lambda p: (p, 0), memory_space=_SMEM) \
+            if _SMEM is not None else pl.BlockSpec((1, 1), lambda p: (p, 0))
+
+    def row_per_pod():
+        kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+        return pl.BlockSpec((1, npad), lambda p: (p, 0), **kw)
+
+    def const_row(width=None):
+        kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+        return pl.BlockSpec((1, width or npad), lambda p: (0, 0), **kw)
+
+    grid_spec = pl.GridSpec(
+        grid=(k,),
+        in_specs=(
+            [smem_scalar() for _ in range(8)]           # pod scalars
+            + [row_per_pod() for _ in range(6)]         # pregathered rows
+            + [const_row() for _ in range(8)]           # statics
+            + [const_row() for _ in range(7)]           # init carry
+            + [const_row(LANES)]                        # init misc (rr)
+        ),
+        out_specs=(
+            [const_row() for _ in range(7)]             # carry out
+            + [const_row(LANES)]                        # misc out
+            + [pl.BlockSpec((1, 1), lambda p: (p, 0),
+                            **({"memory_space": _VMEM} if _VMEM else {}))]
+            + [pl.BlockSpec((1, counts_w), lambda p: (p, 0),
+                            **({"memory_space": _VMEM} if _VMEM else {}))]
+            + [pl.BlockSpec((1, 1), lambda p: (p, 0),
+                            **({"memory_space": _VMEM} if _VMEM else {}))]
+        ),
+    )
+    i32 = jnp.int32
+    out_shape = (
+        [jax.ShapeDtypeStruct((1, npad), i32) for _ in range(7)]
+        + [jax.ShapeDtypeStruct((1, LANES), i32)]
+        + [jax.ShapeDtypeStruct((k, 1), i32),
+           jax.ShapeDtypeStruct((k, counts_w), i32),
+           jax.ShapeDtypeStruct((k, 1), i32)]
+    )
+    call = pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=out_shape, interpret=interpret)
+    return jax.jit(lambda *args: call(*args))
+
+
+def fast_scan(plan: FastPlan, chunk: int = 0,
+              interpret: Optional[bool] = None, progress=None
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Run the full pod batch; returns (choices[P], counts[P,B], advanced[P]).
+
+    chunk: pods per kernel invocation (TPUSIM_FAST_CHUNK, default 512 — each
+    chunk pregathers its signature rows as [chunk, Npad] int32 arrays, so the
+    chunk size bounds that transient HBM footprint). interpret=None
+    auto-selects interpreter mode off-TPU (tests run on CPU).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    chunk = chunk or int(os.environ.get("TPUSIM_FAST_CHUNK", 512))
+    p = plan.num_pods
+    npad = plan.alloc_cpu.shape[1]
+    num_bits = NUM_FIXED_BITS
+    counts_w = LANES  # lane-aligned histogram row; decode slices [:num_bits]
+    k = min(chunk, max(p, 1))
+    call = _build_call(npad, k, plan.most_requested, num_bits, counts_w,
+                       interpret)
+
+    statics = [jnp.asarray(a) for a in (
+        plan.alloc_cpu, plan.alloc_mem, plan.alloc_gpu, plan.alloc_eph,
+        plan.allowed, plan.cond_bits, plan.mem_pressure, plan.disk_pressure)]
+    tables = [jnp.asarray(a) for a in (
+        plan.selector_ok, plan.taint_ok, plan.intolerable,
+        plan.aff_count, plan.avoid_score, plan.host_ok)]
+    carry = [jnp.asarray(a) for a in (
+        plan.used_cpu, plan.used_mem, plan.used_gpu, plan.used_eph,
+        plan.nonzero_cpu, plan.nonzero_mem, plan.pod_count)]
+    misc = jnp.zeros((1, LANES), dtype=jnp.int32)
+
+    def col(a, fill):
+        out = np.full(k, fill, dtype=np.int32)
+        out[:a.shape[0]] = a
+        return out.reshape(k, 1)
+
+    choices_parts, counts_parts, adv_parts = [], [], []
+    num_chunks = -(-p // k) if p else 0
+    for ci in range(num_chunks):
+        sl = slice(ci * k, min((ci + 1) * k, p))
+        # ghost padding: infeasible everywhere, no carry/rr effect
+        scalars = [
+            col(plan.req_cpu[sl], GHOST_REQ), col(plan.req_mem[sl], 0),
+            col(plan.req_gpu[sl], 0), col(plan.req_eph[sl], 0),
+            col(plan.nz_cpu[sl], 0), col(plan.nz_mem[sl], 0),
+            col(plan.zero_request[sl], 0), col(plan.best_effort[sl], 0)]
+        ids = [col(plan.sel_id[sl], 0), col(plan.tol_id[sl], 0),
+               col(plan.aff_id[sl], 0), col(plan.avoid_id[sl], 0),
+               col(plan.host_id[sl], 0)]
+        # pregather the signature rows for this chunk (XLA gather, [k, Npad])
+        sel_rows = tables[0][ids[0][:, 0]]
+        tol_rows = tables[1][ids[1][:, 0]]
+        intol_rows = tables[2][ids[1][:, 0]]
+        aff_rows = tables[3][ids[2][:, 0]]
+        av_rows = tables[4][ids[3][:, 0]]
+        host_rows = tables[5][ids[4][:, 0]]
+        args = ([jnp.asarray(a) for a in scalars]
+                + [sel_rows, tol_rows, intol_rows, aff_rows, av_rows,
+                   host_rows]
+                + statics + carry + [misc])
+        out = call(*args)
+        carry = list(out[:7])
+        misc = out[7]
+        n_real = sl.stop - sl.start
+        choices_parts.append(np.asarray(out[8])[:n_real, 0])
+        counts_parts.append(np.asarray(out[9])[:n_real, :num_bits])
+        adv_parts.append(np.asarray(out[10])[:n_real, 0] != 0)
+        if progress is not None:
+            progress(ci + 1, num_chunks, sl.stop)
+
+    if not choices_parts:
+        return (np.zeros(0, np.int32), np.zeros((0, num_bits), np.int32),
+                np.zeros(0, bool))
+    return (np.concatenate(choices_parts), np.concatenate(counts_parts),
+            np.concatenate(adv_parts))

@@ -223,15 +223,13 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
 
     fast_plan = None
     if batch == 0 and os.environ.get("TPUSIM_FAST") == "1":
-        import jax
-
+        # one shared gate (env flag + interpreter override + tpu backend):
+        # off-TPU the kernel would run in the Pallas interpreter, which is
+        # meaningless as a benchmark
+        from tpusim.jaxe.backend import _fast_path_enabled
         from tpusim.jaxe.fastscan import fast_scan, plan_fast
 
-        # off-TPU, fast_scan would auto-select the Pallas INTERPRETER —
-        # orders of magnitude slower than the XLA scan and meaningless as a
-        # benchmark; only TPUSIM_FAST_INTERPRET=1 (correctness runs) allows it
-        if (jax.default_backend() != "tpu"
-                and os.environ.get("TPUSIM_FAST_INTERPRET") != "1"):
+        if not _fast_path_enabled():
             log("  TPUSIM_FAST requested but backend is not TPU; "
                 "using the XLA scan (set TPUSIM_FAST_INTERPRET=1 to force "
                 "the interpreter for correctness checks)")

@@ -97,6 +97,29 @@ def test_fast_scan_matches_xla_scan(seed, most_requested):
     assert 0 < scheduled < len(pods)  # both outcomes actually exercised
 
 
+def test_backend_fast_path_matches_xla(monkeypatch):
+    from tpusim.jaxe import fastscan
+    from tpusim.jaxe.backend import JaxBackend
+
+    snapshot, pods = build(3, num_nodes=20, num_pods=60)
+    baseline = JaxBackend().schedule(pods, snapshot)
+    monkeypatch.setenv("TPUSIM_FAST", "1")
+    monkeypatch.setenv("TPUSIM_FAST_INTERPRET", "1")
+    # guard against the fast path silently not engaging (which would make
+    # this comparison vacuous): count actual kernel runs
+    runs = []
+    real_fast_scan = fastscan.fast_scan
+    monkeypatch.setattr(
+        fastscan, "fast_scan",
+        lambda plan, **kw: runs.append(1) or real_fast_scan(plan, **kw))
+    fast = JaxBackend().schedule(pods, snapshot)
+    assert runs, "pallas fast path did not engage"
+    assert [(p.pod.metadata.name, p.pod.spec.node_name, p.message)
+            for p in fast] == \
+           [(p.pod.metadata.name, p.pod.spec.node_name, p.message)
+            for p in baseline]
+
+
 def test_ineligible_workloads_report_reasons():
     nodes = [make_node("n0")]
     pods = [make_pod("p0", milli_cpu=100, memory=2**20, labels={"app": "a"},

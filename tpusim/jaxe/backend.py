@@ -53,6 +53,23 @@ from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
 
 log = logging.getLogger(__name__)
 
+
+def _fast_path_enabled() -> bool:
+    """TPUSIM_FAST=1 opts into the Pallas fused-scan fast path
+    (jaxe.fastscan) for eligible group-free workloads. Off-TPU the kernel
+    would run in the Pallas interpreter — far slower than the XLA scan — so
+    it additionally requires a TPU backend unless TPUSIM_FAST_INTERPRET=1
+    forces the interpreter (correctness runs)."""
+    import os
+
+    if os.environ.get("TPUSIM_FAST") != "1":
+        return False
+    if os.environ.get("TPUSIM_FAST_INTERPRET") == "1":
+        return True
+    import jax
+
+    return jax.default_backend() == "tpu"
+
 _MOST_REQUESTED_PROVIDERS = {CLUSTER_AUTOSCALER_PROVIDER, TD_PROVIDER}
 _KNOWN_PROVIDERS = {DEFAULT_PROVIDER} | _MOST_REQUESTED_PROVIDERS
 
@@ -188,8 +205,21 @@ class JaxBackend:
                 config = _dc_replace(config, n_saa_doms=n_saa_doms)
 
         ensure_x64()
+        # fast-path decision BEFORE any device upload: when the Pallas plan
+        # engages, the statics/carry/pod-column HBM transfers below would be
+        # pure wasted latency on exactly the hot path the feature accelerates
+        fplan = None
+        if self.batch_size == 0 and cp is None and _fast_path_enabled():
+            from tpusim.jaxe.fastscan import plan_fast
+
+            fplan, why = plan_fast(config, compiled, cols)
+            if fplan is None:
+                log.info("pallas fast path ineligible (%s); using the XLA "
+                         "scan", why)
         sa_lock_init = None
-        if cp is None:
+        if fplan is not None:
+            statics = None
+        elif cp is None:
             statics = statics_to_device(compiled)
         else:
             # overwrite the trivial custom-plugin rows with the policy's
@@ -222,10 +252,11 @@ class JaxBackend:
                 host_statics = host_statics._replace(
                     sa_self_ok=sa_self_ok, sa_unres=sa_unres, sa_val=sa_val)
             statics = _tree_to_device(host_statics)
-        carry = carry_init(compiled)
-        if sa_lock_init is not None:
-            carry = carry._replace(sa_lock=sa_lock_init)
-        xs = pod_columns_to_device(cols)
+        if fplan is None:
+            carry = carry_init(compiled)
+            if sa_lock_init is not None:
+                carry = carry._replace(sa_lock=sa_lock_init)
+            xs = pod_columns_to_device(cols)
         # On TPU the per-pod filter→score→select→bind pipeline is one fused
         # device program, so the whole batch dispatch lands in the algorithm
         # histogram (the per-phase split of metrics.go has no device analog);
@@ -235,7 +266,11 @@ class JaxBackend:
         from tpusim.framework.metrics import register, since_in_microseconds
         metrics = register()
         dispatch_start = perf_counter()
-        if self.batch_size > 0:
+        if fplan is not None:
+            from tpusim.jaxe.fastscan import fast_scan
+
+            choices, counts, _adv = fast_scan(fplan)
+        elif self.batch_size > 0:
             _, choices, counts, _ = schedule_wavefront(config, carry, statics,
                                                        xs, self.batch_size)
         else:

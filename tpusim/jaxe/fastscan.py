@@ -458,7 +458,12 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    chunk = chunk or int(os.environ.get("TPUSIM_FAST_CHUNK", 512))
+    if not chunk:
+        try:
+            chunk = int(os.environ.get("TPUSIM_FAST_CHUNK", 512))
+        except ValueError:
+            chunk = 512
+    chunk = max(chunk, 1)
     p = plan.num_pods
     npad = plan.alloc_cpu.shape[1]
     num_bits = NUM_FIXED_BITS

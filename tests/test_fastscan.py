@@ -120,6 +120,53 @@ def test_backend_fast_path_matches_xla(monkeypatch):
             for p in baseline]
 
 
+def _diff(snapshot, pods, most_requested=False):
+    compiled, cols = compile_cluster(snapshot, pods)
+    assert not compiled.unsupported
+    config = config_for(
+        [compiled], most_requested=most_requested,
+        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+    plan, reason = plan_fast(config, compiled, cols)
+    assert plan is not None, reason
+    _, choices, counts, advanced = schedule_scan(
+        config, carry_init(compiled), statics_to_device(compiled),
+        pod_columns_to_device(cols))
+    f_choices, f_counts, f_adv = fast_scan(plan, chunk=32)
+    assert np.array_equal(f_choices, np.asarray(choices))
+    assert np.array_equal(f_counts, np.asarray(counts))
+    assert np.array_equal(f_adv, np.asarray(advanced))
+    return f_choices
+
+
+def test_gpu_pods_and_single_node():
+    nodes = [make_node("n0", milli_cpu=2000, memory=2 * 1024**3, gpus=2)]
+    pods = [make_pod(f"g{i}", milli_cpu=100, memory=2**20, gpus=1)
+            for i in range(4)]
+    choices = _diff(ClusterSnapshot(nodes=nodes), pods)
+    # 2 GPUs: first two pods fit, the rest report Insufficient gpu
+    assert (choices >= 0).tolist() == [True, True, False, False]
+
+
+def test_all_infeasible_workload():
+    nodes = [make_node(f"n{i}", milli_cpu=500, memory=2**28)
+             for i in range(3)]
+    pods = [make_pod(f"p{i}", milli_cpu=4000, memory=2**30)
+            for i in range(5)]
+    choices = _diff(ClusterSnapshot(nodes=nodes), pods)
+    assert (choices == -1).all()
+
+
+def test_empty_pod_batch():
+    nodes = [make_node("n0")]
+    compiled, cols = compile_cluster(ClusterSnapshot(nodes=nodes), [])
+    config = config_for([compiled], most_requested=False,
+                        num_reason_bits=NUM_FIXED_BITS)
+    plan, reason = plan_fast(config, compiled, cols)
+    assert plan is not None, reason
+    choices, counts, adv = fast_scan(plan)
+    assert choices.shape == (0,) and counts.shape == (0, NUM_FIXED_BITS)
+
+
 def test_ineligible_workloads_report_reasons():
     nodes = [make_node("n0")]
     pods = [make_pod("p0", milli_cpu=100, memory=2**20, labels={"app": "a"},

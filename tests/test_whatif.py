@@ -247,3 +247,67 @@ def test_what_if_service_affinity_policy_matches_solo_runs():
         solo_placed = sorted((p.name, p.spec.node_name)
                              for p in solo.successful_pods)
         assert batch_placed == solo_placed
+
+
+@needs_8_devices
+def test_cli_what_if_mesh_flag(tmp_path, capsys):
+    """`--what-if manifest --mesh 2x4` runs the batch sharded over the
+    virtual 8-device mesh and matches the unsharded CLI run."""
+    import json
+
+    from tpusim.cli import main
+
+    manifest = []
+    for s in range(3):
+        snap, _ = scenario(100 + s, 6, 0)
+        snap_path = tmp_path / f"snap{s}.json"
+        snap.save(str(snap_path))
+        podspec = tmp_path / f"pods{s}.yaml"
+        podspec.write_text(
+            "- name: w\n  num: 5\n  pod:\n    metadata:\n      name: w\n"
+            "    spec:\n      containers:\n      - name: c\n"
+            "        resources:\n          requests:\n            cpu: 500m\n"
+            "            memory: 128Mi\n")
+        manifest.append({"snapshot": str(snap_path), "podspec": str(podspec)})
+    mpath = tmp_path / "manifest.json"
+    mpath.write_text(json.dumps(manifest))
+
+    assert main(["--what-if", str(mpath)]) == 0
+    plain = capsys.readouterr().out
+    assert main(["--what-if", str(mpath), "--mesh", "2x4"]) == 0
+    sharded = capsys.readouterr().out
+    # identical per-scenario placement counts, sharded or not
+    plain_lines = [line for line in plain.splitlines()
+                   if line.startswith("scenario")]
+    sharded_lines = [line for line in sharded.splitlines()
+                     if line.startswith("scenario")]
+    assert plain_lines == sharded_lines
+
+
+def test_cli_mesh_flag_validation(tmp_path, capsys):
+    import json
+
+    from tpusim.cli import main
+
+    snap, pods = scenario(7, 3, 0)
+    sp = tmp_path / "s.json"
+    snap.save(str(sp))
+    podspec = tmp_path / "p.yaml"
+    podspec.write_text(
+        "- name: w\n  num: 1\n  pod:\n    metadata:\n      name: w\n"
+        "    spec:\n      containers:\n      - name: c\n"
+        "        resources:\n          requests:\n            cpu: 100m\n")
+    mpath = tmp_path / "m.json"
+    mpath.write_text(json.dumps([{"snapshot": str(sp),
+                                  "podspec": str(podspec)}]))
+    assert main(["--what-if", str(mpath), "--mesh", "bogus"]) == 2
+    assert "SNAPxNODE" in capsys.readouterr().err
+    assert main(["--what-if", str(mpath), "--mesh", "999x9"]) == 2
+    assert "devices" in capsys.readouterr().err
+
+
+def test_cli_mesh_requires_what_if(capsys):
+    from tpusim.cli import main
+
+    assert main(["--podspec", "x.yaml", "--mesh", "2x4"]) == 2
+    assert "--what-if" in capsys.readouterr().err

@@ -82,6 +82,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "all scenarios as ONE batched device program "
                              "(jax backend; snapshot axis shardable over a "
                              "mesh). Ignores --podspec/--snapshot.")
+    parser.add_argument("--mesh", default="",
+                        help="What-if device mesh 'SNAPxNODE' (e.g. 2x4): "
+                             "scenarios data-parallel over SNAP devices, "
+                             "node columns sharded over NODE devices with "
+                             "GSPMD collectives (jaxe/sharding.py). Needs "
+                             "SNAP*NODE visible jax devices; default "
+                             "single-device.")
     parser.add_argument("--enable-pod-priority", action="store_true",
                         help="Enable the PodPriority feature gate (preemption). "
                              "On the jax backend this runs the host-device "
@@ -175,10 +182,32 @@ def run_what_if_cli(args) -> int:
         print(f"error: {policy_err}", file=sys.stderr)
         return 2
 
+    mesh = None
+    if args.mesh:
+        import jax
+
+        from tpusim.jaxe.sharding import make_mesh
+
+        try:
+            snap_s, _, node_s = args.mesh.lower().partition("x")
+            snap, node = int(snap_s), int(node_s)
+            if snap < 1 or node < 1:
+                raise ValueError
+        except ValueError:
+            print(f"error: --mesh {args.mesh!r}: want 'SNAPxNODE', e.g. 2x4",
+                  file=sys.stderr)
+            return 2
+        have = len(jax.devices())
+        if snap * node > have:
+            print(f"error: --mesh {args.mesh} needs {snap * node} devices, "
+                  f"{have} visible", file=sys.stderr)
+            return 2
+        mesh = make_mesh(snap * node, snap=snap)
+
     start = time.perf_counter()
     try:
         results = run_what_if(scenarios, provider=args.algorithmprovider,
-                              policy=policy)
+                              policy=policy, mesh=mesh)
     except (KeyError, ValueError, NotImplementedError) as exc:
         # KeyError: unknown provider/plugin name; ValueError incl. PolicyError
         # from compile_policy's validation — same contract as the single-run
@@ -211,6 +240,11 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         return run_what_if_cli(args)
+    if args.mesh:
+        print("error: --mesh applies only to --what-if (the single-run scan "
+              "is sequential; scale it via more nodes per snapshot)",
+              file=sys.stderr)
+        return 2
     if not args.podspec:
         print("error: --podspec is required (or use --what-if)", file=sys.stderr)
         return 2

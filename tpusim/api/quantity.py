@@ -12,6 +12,7 @@ Fraction arithmetic so "100m", "0.1", and "1e-1" all agree.
 from __future__ import annotations
 
 from fractions import Fraction
+from functools import lru_cache
 import re
 
 _BINARY_SUFFIXES = {
@@ -45,7 +46,7 @@ _QUANTITY_RE = re.compile(
 class Quantity:
     """Immutable exact quantity with k8s Value()/MilliValue() views."""
 
-    __slots__ = ("_frac", "_text")
+    __slots__ = ("_frac", "_text", "_value", "_milli")
 
     def __init__(self, value, text: str | None = None):
         if isinstance(value, Quantity):
@@ -60,16 +61,25 @@ class Quantity:
             self._text = text if text is not None else q._text
         else:
             raise TypeError(f"cannot build Quantity from {type(value)}")
+        # integer views are lazily computed once: the engine reads them per
+        # pod per scheduling pass, and Fraction math is the host-compile
+        # hot path at 100k+ pods
+        self._value = None
+        self._milli = None
 
     # --- integer views (reference: resource.Quantity.Value/MilliValue) ---
 
     def value(self) -> int:
         """Round up to the nearest integer (k8s Value())."""
-        return _ceil(self._frac)
+        if self._value is None:
+            self._value = _ceil(self._frac)
+        return self._value
 
     def milli_value(self) -> int:
         """Round up to the nearest 1/1000 (k8s MilliValue())."""
-        return _ceil(self._frac * 1000)
+        if self._milli is None:
+            self._milli = _ceil(self._frac * 1000)
+        return self._milli
 
     def is_zero(self) -> bool:
         return self._frac == 0
@@ -129,17 +139,24 @@ def _ceil(f: Fraction) -> int:
 
 
 def parse_quantity(s) -> Quantity:
-    """Parse a k8s quantity literal (str) or bare number (int/float)."""
+    """Parse a k8s quantity literal (str) or bare number (int/float).
+    String parses are memoized — workloads repeat a handful of literals
+    across 100k+ pods, and Quantity is immutable so sharing is safe."""
     if isinstance(s, Quantity):
         return s
     if isinstance(s, int):
         return Quantity(Fraction(s), text=str(s))
     if isinstance(s, float):
         return Quantity(Fraction(str(s)), text=None)
-    text = str(s).strip()
+    return _parse_str(str(s))
+
+
+@lru_cache(maxsize=65536)
+def _parse_str(text: str) -> Quantity:
+    text = text.strip()
     m = _QUANTITY_RE.match(text)
     if not m:
-        raise ValueError(f"invalid quantity: {s!r}")
+        raise ValueError(f"invalid quantity: {text!r}")
     num = Fraction(m.group("num"))
     if m.group("sign") == "-":
         num = -num
@@ -154,7 +171,7 @@ def parse_quantity(s) -> Quantity:
         elif suffix in _DECIMAL_SUFFIXES:
             num *= _DECIMAL_SUFFIXES[suffix]
         else:
-            raise ValueError(f"invalid quantity suffix: {s!r}")
+            raise ValueError(f"invalid quantity suffix: {text!r}")
     return Quantity(num, text=text)
 
 

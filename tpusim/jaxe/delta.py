@@ -36,7 +36,6 @@ schedule identically to a fresh compile of the equivalent snapshot.
 
 from __future__ import annotations
 
-import json
 from dataclasses import replace
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -60,6 +59,7 @@ from tpusim.jaxe.state import (
     _affinity_signature,
     _avoid_signature,
     _compile_groups,
+    _freeze,
     _group_signature,
     _host_signature,
     _selector_signature,
@@ -81,8 +81,10 @@ _SIG_KINDS = (
 )
 
 
-def _key(signature) -> str:
-    return json.dumps(signature, sort_keys=True, default=str)
+# Canonical signature key — MUST be the interner's own key function: the
+# incremental path looks ids up in tables keyed by compile_cluster's
+# interners (state.py builds sig_to_gid from Interner._ids keys).
+_key = _freeze
 
 
 # signature-row memo bound (the reference's equivalence cache is a 100-entry
@@ -115,8 +117,8 @@ class IncrementalCluster:
         self._scalar_idx: Dict[str, int] = {}
 
         # memoized [signature, node] rows: (table kind, sig key) -> np row [N]
-        self._sig_rows: Dict[Tuple[str, str], np.ndarray] = {}
-        self._sig_reps: Dict[str, Pod] = {}       # sig key -> representative
+        self._sig_rows: Dict[tuple, np.ndarray] = {}
+        self._sig_reps: Dict[tuple, Pod] = {}     # sig key -> representative
         self.sig_row_computations = 0             # cache-effectiveness counter
 
         # node statics + dynamic aggregates, maintained column-wise
@@ -126,7 +128,7 @@ class IncrementalCluster:
         # group tables cache
         self._groups: Optional[GroupTables] = None
         self._groups_meta = None                  # (flags..., doms, unsupported)
-        self._groups_sig_keys: Dict[str, int] = {}  # group sig key -> id
+        self._groups_sig_keys: Dict[object, int] = {}  # group sig key -> id
         self._groups_batch_keys: Optional[tuple] = None
         self._groups_dirty = True
         self._groups_active = False               # any feature flag set
@@ -489,10 +491,13 @@ class IncrementalCluster:
             fill_pod_request_row(cols, j, pod, get_resource_request(pod),
                                  self._scalar_idx)
             for name, sig_fn, _kinds in _SIG_KINDS:
-                # family-prefixed: _avoid_signature and _host_signature both
-                # serialize None to "null" — without the prefix one pod would
-                # become the representative for BOTH kinds (review finding)
-                sig_key = f"{name}:{_key(sig_fn(pod))}"
+                # family-prefixed tuple: _avoid_signature and _host_signature
+                # can freeze to the same key (e.g. both None) — without the
+                # prefix one pod would become the representative for BOTH
+                # kinds (review finding). Tuple, not f-string: repr-ing the
+                # frozen key would reintroduce the serialization cost the
+                # _freeze interning removed.
+                sig_key = (name, _key(sig_fn(pod)))
                 ids = batch_keys[name]
                 if sig_key not in ids:
                     ids[sig_key] = len(ids)

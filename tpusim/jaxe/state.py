@@ -142,15 +142,48 @@ def volume_unsupported(new_pods: List[Pod], cluster_pods) -> List[str]:
     return []
 
 
+def _freeze(x):
+    """Signature -> hashable canonical key. Same dedup power as the previous
+    sorted-key json.dumps at a fraction of the cost (interning is the
+    host-compile hot loop: 5 signatures per pod); at least as discriminating,
+    which only ever splits a group, never merges one. Leaf types first —
+    most signature nodes are strings."""
+    t = type(x)
+    if t is str or x is None:
+        return x
+    if t is int or t is bool or t is float:
+        # type-tagged: Python cross-type equality (True == 1 == 1.0) would
+        # otherwise merge keys json.dumps kept distinct ("true" vs "1")
+        return (t.__name__, x)
+    if t is dict:
+        try:
+            items = sorted(x.items())
+        except TypeError:  # mixed-type keys: order by a stable stringification
+            items = sorted(x.items(), key=lambda kv: (str(type(kv[0])),
+                                                      str(kv[0])))
+        return tuple((k, _freeze(v)) for k, v in items)
+    if t is list or t is tuple:
+        return tuple(_freeze(v) for v in x)
+    if isinstance(x, (bool, int, float)):  # numeric subclasses
+        return (type(x).__name__, x)
+    if isinstance(x, str):
+        return str(x)
+    if isinstance(x, dict):
+        return _freeze(dict(x))
+    if isinstance(x, (list, tuple)):
+        return tuple(_freeze(v) for v in x)
+    return str(x)  # json default=str analog for exotic leaves
+
+
 class Interner:
-    """Canonical-JSON signature -> dense id."""
+    """Canonical signature -> dense id."""
 
     def __init__(self):
-        self._ids: Dict[str, int] = {}
+        self._ids: Dict[object, int] = {}
         self.representatives: List[Pod] = []
 
     def intern(self, signature, representative) -> int:
-        key = json.dumps(signature, sort_keys=True, default=str)
+        key = _freeze(signature)
         if key not in self._ids:
             self._ids[key] = len(self.representatives)
             self.representatives.append(representative)

@@ -1,0 +1,65 @@
+"""Interner key contract (state._freeze).
+
+The interners grouped signatures by sorted-key canonical JSON; _freeze
+replaced that with hashable tuples for speed. The safety direction is:
+_freeze may SPLIT a json-equal group (harmless — grouping is dedup), but it
+must never MERGE two signatures whose canonical JSON differed, or two
+behaviorally-different pods would share one representative row.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpusim.jaxe.state import _freeze
+
+
+def canonical(x) -> str:
+    return json.dumps(x, sort_keys=True, default=str)
+
+
+def gen_value(rng, depth=0):
+    kind = rng.randint(0, 9 if depth < 3 else 6)
+    if kind == 0:
+        return rng.choice(["a", "b", "zone", "1", "true", ""])
+    if kind == 1:
+        return int(rng.randint(-2, 3))
+    if kind == 2:
+        return bool(rng.randint(0, 2))
+    if kind == 3:
+        return float(rng.choice([0.0, 1.0, 2.5]))
+    if kind == 4:
+        return None
+    if kind == 5:
+        # adversarial cross-type equals: True == 1 == 1.0, False == 0 == 0.0
+        landmines = [True, False, 0, 1, 0.0, 1.0]
+        return landmines[rng.randint(0, len(landmines))]
+    if kind == 6:
+        return [gen_value(rng, depth + 1)
+                for _ in range(rng.randint(0, 3))]
+    if kind == 7:
+        return {rng.choice(["k1", "k2", "k3"]): gen_value(rng, depth + 1)
+                for _ in range(rng.randint(0, 3))}
+    return {"nested": [gen_value(rng, depth + 1)]}
+
+
+def test_freeze_never_merges_json_distinct_signatures():
+    rng = np.random.RandomState(0)
+    values = [gen_value(rng) for _ in range(400)]
+    # seed the cross-type landmines explicitly
+    values += [True, 1, 1.0, False, 0, 0.0, "1", "true", [1], [True],
+               {"a": 1}, {"a": True}, {"a": 1.0}, (1,), [1.0]]
+    by_freeze: dict = {}
+    for v in values:
+        by_freeze.setdefault(_freeze(v), set()).add(canonical(v))
+    for fkey, canon_set in by_freeze.items():
+        assert len(canon_set) == 1, (
+            f"_freeze merged json-distinct signatures: {canon_set}")
+
+
+def test_freeze_deduplicates_identical_structures():
+    a = {"sel": {"zone": "z1"}, "tol": [{"key": "k", "op": "Equal"}]}
+    b = {"tol": [{"key": "k", "op": "Equal"}], "sel": {"zone": "z1"}}
+    assert _freeze(a) == _freeze(b)
+    assert hash(_freeze(a)) == hash(_freeze(b))

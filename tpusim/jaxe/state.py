@@ -142,6 +142,9 @@ def volume_unsupported(new_pods: List[Pod], cluster_pods) -> List[str]:
     return []
 
 
+_DICT_TAG = object()  # can never equal any JSON value
+
+
 def _freeze(x):
     """Signature -> hashable canonical key. Same dedup power as the previous
     sorted-key json.dumps at a fraction of the cost (interning is the
@@ -161,7 +164,9 @@ def _freeze(x):
         except TypeError:  # mixed-type keys: order by a stable stringification
             items = sorted(x.items(), key=lambda kv: (str(type(kv[0])),
                                                       str(kv[0])))
-        return tuple((k, _freeze(v)) for k, v in items)
+        # the sentinel keeps {} distinct from [] (and any dict distinct from
+        # a list that happens to freeze to the same item tuple)
+        return (_DICT_TAG,) + tuple((k, _freeze(v)) for k, v in items)
     if t is list or t is tuple:
         return tuple(_freeze(v) for v in x)
     if isinstance(x, (bool, int, float)):  # numeric subclasses

@@ -1,0 +1,69 @@
+"""Worker for the multi-process what-if test (spawned by test_multihost.py).
+
+Usage: python multihost_worker.py <coordinator_port> <process_id> <num_procs>
+
+Every process builds the IDENTICAL scenario list, runs the distributed
+what-if (snap shard per process, node columns over local devices, Gloo
+collectives between processes — the DCN analog on CPU), and compares the
+result against a process-local single-device run of the same batch. Prints
+MULTIHOST_OK on success.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                               num_processes=nproc, process_id=pid)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+    from tpusim.jaxe.whatif import run_what_if, run_what_if_multihost
+
+    import numpy as np
+
+    def scenario(seed: int):
+        rng = np.random.RandomState(seed)
+        nodes = [make_node(f"s{seed}-n{i}",
+                           milli_cpu=int(rng.choice([2000, 4000])),
+                           memory=int(rng.choice([4, 8])) * 1024**3,
+                           labels={"zone": f"z{i % 3}"})
+                 for i in range(10)]
+        pods = [make_pod(f"s{seed}-p{i}",
+                         milli_cpu=int(rng.randint(100, 1500)),
+                         memory=int(rng.randint(2**20, 2**30)),
+                         node_selector=({"zone": f"z{i % 3}"}
+                                        if i % 4 == 0 else None))
+                for i in range(20)]
+        return ClusterSnapshot(nodes=nodes), pods
+
+    # 3 scenarios over 2 snap shards: exercises the replica padding too
+    scenarios = [scenario(s) for s in (1, 2, 3)]
+    dist = run_what_if_multihost(scenarios)
+    solo = run_what_if(scenarios)
+
+    def key(results):
+        return [[(p.pod.metadata.name, p.pod.spec.node_name, p.message)
+                 for p in r.placements] for r in results]
+
+    if key(dist) != key(solo):
+        print(f"proc {pid}: MISMATCH", flush=True)
+        return 1
+    scheduled = sum(r.scheduled for r in dist)
+    total = sum(r.total for r in dist)
+    print(f"proc {pid}: MULTIHOST_OK {scheduled}/{total} scheduled over "
+          f"{jax.process_count()} processes x "
+          f"{jax.local_device_count()} devices", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

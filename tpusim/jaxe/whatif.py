@@ -142,23 +142,16 @@ def _unify(statics: Statics, carry: Carry, xs: PodX, targets: dict,
     return Statics(**st_fields), Carry(**ca_fields), PodX(**fields)
 
 
-def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
-                provider: str = "DefaultProvider",
-                mesh: Optional[object] = None,
-                hard_pod_affinity_symmetric_weight: int = 10,
-                policy=None) -> List[WhatIfResult]:
-    """Run independent (snapshot, pods) scenarios as one batched device
-    program. Pods are fed in podspec order (callers wanting reference LIFO
-    parity pass the reversed list, as run_simulation does).
+def _prepare_host_batch(scenarios, provider: str,
+                        hard_pod_affinity_symmetric_weight: int, policy,
+                        n_snap_shards: int, n_node_shards: int):
+    """Compile + shape-unify + pad the batch on host numpy.
 
-    mesh: an optional ("snap", "node") jax.sharding.Mesh (sharding.make_mesh);
-    None runs single-device. The scenario count need not divide the snap axis —
-    the batch is padded with a replica of the first scenario and the padding
-    dropped on decode.
-
-    policy: an engine.policy.Policy applied to EVERY scenario (one jitted
-    program serves the batch, so the policy is batch-wide); host-bound policy
-    features raise — what-if has no per-scenario host fallback.
+    Returns (prep, early): `early` is the finished result list when nothing
+    needs the device (no scenarios / all zero-node); otherwise `prep` is
+    (config, per_scenario host (carry, statics, xs) tuples padded to the
+    snap-shard multiple, real_count, batch_indices, compiled_list,
+    empty_results).
     """
     if provider not in _KNOWN_PROVIDERS:
         raise KeyError(f"plugin {provider!r} has not been registered")
@@ -184,7 +177,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     need_saa = cp is not None and (bool(cp.spec.saa_weights)
                                    or cp.spec.sa_enabled)
     if not scenarios:
-        return []
+        return None, []
     ensure_x64()
 
     # zero-node scenarios can't join the batch (no node axis to pad onto);
@@ -213,10 +206,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         batch_indices.append(i)
         compiled_list.append((compiled, cols))
     if not compiled_list:
-        return [empty_results[i] for i in range(len(scenarios))]
-
-    n_snap_shards = mesh.shape["snap"] if mesh is not None else 1
-    n_node_shards = mesh.shape["node"] if mesh is not None else 1
+        return None, [empty_results[i] for i in range(len(scenarios))]
 
     # host-side trees: unify + pad on numpy, upload once after stacking
     n_saa_doms = 1
@@ -278,19 +268,6 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     while len(per_scenario) % n_snap_shards != 0:
         per_scenario.append(per_scenario[0])
 
-    # np.stack keeps this on host; jnp.asarray below is the single upload
-    stack = lambda trees: jax.tree.map(  # noqa: E731
-        lambda *a: jnp.asarray(np.stack([np.asarray(x) for x in a])), *trees)
-    carries = stack([t[0] for t in per_scenario])
-    statics_b = stack([t[1] for t in per_scenario])
-    xs_b = stack([t[2] for t in per_scenario])
-
-    if mesh is not None:
-        st_spec, ca_spec, xs_spec = snap_shardings(mesh)
-        carries = jax.tree.map(jax.device_put, carries, ca_spec)
-        statics_b = jax.tree.map(jax.device_put, statics_b, st_spec)
-        xs_b = jax.tree.map(lambda a: jax.device_put(a, xs_spec), xs_b)
-
     config = config_for(
         [c for c, _ in compiled_list],
         most_requested=provider in _MOST_REQUESTED_PROVIDERS,
@@ -300,15 +277,21 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         from dataclasses import replace as _dc_replace
 
         config = _dc_replace(config, policy=cp.spec, n_saa_doms=n_saa_doms)
-    if mesh is not None:
-        with mesh:
-            choices_b, counts_b = _batched(config, carries, statics_b, xs_b)
-            choices_b = np.asarray(choices_b)
-    else:
-        choices_b, counts_b = _batched(config, carries, statics_b, xs_b)
-        choices_b = np.asarray(choices_b)
-    counts_b = np.asarray(counts_b)
+    return (config, per_scenario, real_count, batch_indices, compiled_list,
+            empty_results), None
 
+
+def _stack_host(per_scenario):
+    """Stacked host-numpy trees (carries, statics_b, xs_b)."""
+    stack = lambda trees: jax.tree.map(  # noqa: E731
+        lambda *a: np.stack([np.asarray(x) for x in a]), *trees)
+    return (stack([t[0] for t in per_scenario]),
+            stack([t[1] for t in per_scenario]),
+            stack([t[2] for t in per_scenario]))
+
+
+def _decode_batch(scenarios, batch_indices, compiled_list, empty_results,
+                  real_count, choices_b, counts_b) -> List[WhatIfResult]:
     batch_results: dict = {}
     for b in range(real_count):
         i = batch_indices[b]
@@ -322,3 +305,111 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
                                         unschedulable=len(pods) - scheduled)
     batch_results.update(empty_results)
     return [batch_results[i] for i in range(len(scenarios))]
+
+
+def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
+                provider: str = "DefaultProvider",
+                mesh: Optional[object] = None,
+                hard_pod_affinity_symmetric_weight: int = 10,
+                policy=None) -> List[WhatIfResult]:
+    """Run independent (snapshot, pods) scenarios as one batched device
+    program. Pods are fed in podspec order (callers wanting reference LIFO
+    parity pass the reversed list, as run_simulation does).
+
+    mesh: an optional ("snap", "node") jax.sharding.Mesh (sharding.make_mesh);
+    None runs single-device. The scenario count need not divide the snap axis —
+    the batch is padded with a replica of the first scenario and the padding
+    dropped on decode.
+
+    policy: an engine.policy.Policy applied to EVERY scenario (one jitted
+    program serves the batch, so the policy is batch-wide); host-bound policy
+    features raise — what-if has no per-scenario host fallback.
+    """
+    n_snap_shards = mesh.shape["snap"] if mesh is not None else 1
+    n_node_shards = mesh.shape["node"] if mesh is not None else 1
+    prep, early = _prepare_host_batch(
+        scenarios, provider, hard_pod_affinity_symmetric_weight, policy,
+        n_snap_shards, n_node_shards)
+    if prep is None:
+        return early
+    (config, per_scenario, real_count, batch_indices, compiled_list,
+     empty_results) = prep
+
+    host_carries, host_statics, host_xs = _stack_host(per_scenario)
+    if mesh is not None:
+        # sharded upload straight from host numpy — materializing on the
+        # default device first would double the transfer and peak memory
+        st_spec, ca_spec, xs_spec = snap_shardings(mesh)
+        carries = jax.tree.map(jax.device_put, host_carries, ca_spec)
+        statics_b = jax.tree.map(jax.device_put, host_statics, st_spec)
+        xs_b = jax.tree.map(lambda a: jax.device_put(a, xs_spec), host_xs)
+    else:
+        to_dev = lambda tree: jax.tree.map(jnp.asarray, tree)  # noqa: E731
+        carries, statics_b, xs_b = (to_dev(host_carries),
+                                    to_dev(host_statics), to_dev(host_xs))
+
+    if mesh is not None:
+        with mesh:
+            choices_b, counts_b = _batched(config, carries, statics_b, xs_b)
+            choices_b = np.asarray(choices_b)
+    else:
+        choices_b, counts_b = _batched(config, carries, statics_b, xs_b)
+        choices_b = np.asarray(choices_b)
+    counts_b = np.asarray(counts_b)
+    return _decode_batch(scenarios, batch_indices, compiled_list,
+                         empty_results, real_count, choices_b, counts_b)
+
+
+def run_what_if_multihost(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
+                          provider: str = "DefaultProvider",
+                          hard_pod_affinity_symmetric_weight: int = 10,
+                          policy=None) -> List[WhatIfResult]:
+    """Multi-process what-if: one global batched program over every
+    participating host's devices (the DCN analog — SURVEY.md §5
+    "distributed communication backend").
+
+    EVERY process (after `jax.distributed.initialize`) calls this with an
+    IDENTICAL, deterministically-built scenario list. The global
+    ("snap", "node") mesh puts one snap shard per process (scenarios are
+    data-parallel across hosts; node columns shard across each host's local
+    devices), array shards are placed via `jax.make_array_from_callback`
+    (host data is replicated, placement is distributed), and the results
+    are replicated back so every process decodes the full batch.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tpusim.jaxe.sharding import make_mesh
+
+    nproc = jax.process_count()
+    n_node = jax.local_device_count()
+    prep, early = _prepare_host_batch(
+        scenarios, provider, hard_pod_affinity_symmetric_weight, policy,
+        n_snap_shards=nproc, n_node_shards=n_node)
+    if prep is None:
+        return early
+    (config, per_scenario, real_count, batch_indices, compiled_list,
+     empty_results) = prep
+
+    # jax.devices() orders process 0's devices first, then process 1's, ...
+    # so reshaping to (nproc, n_node) gives each process its own snap row
+    mesh = make_mesh(nproc * n_node, snap=nproc)
+    st_spec, ca_spec, xs_spec = snap_shardings(mesh)
+    host_carries, host_statics, host_xs = _stack_host(per_scenario)
+
+    def _global(full, sharding):
+        return jax.make_array_from_callback(
+            full.shape, sharding, lambda idx: full[idx])
+
+    carries = jax.tree.map(_global, host_carries, ca_spec)
+    statics_b = jax.tree.map(_global, host_statics, st_spec)
+    xs_b = jax.tree.map(lambda a: _global(a, xs_spec), host_xs)
+
+    replicate = jax.jit(lambda x: x,
+                         out_shardings=NamedSharding(mesh, PartitionSpec()))
+    with mesh:
+        choices_b, counts_b = _batched(config, carries, statics_b, xs_b)
+        # fully replicated -> every shard addressable on every process
+        choices_b = np.asarray(replicate(choices_b))
+        counts_b = np.asarray(replicate(counts_b))
+    return _decode_batch(scenarios, batch_indices, compiled_list,
+                         empty_results, real_count, choices_b, counts_b)

@@ -28,7 +28,7 @@ Env knobs: TPUSIM_BENCH_PODS (default 100000), TPUSIM_BENCH_NODES (5000),
 TPUSIM_BENCH_BASELINE_PODS (200), TPUSIM_BENCH_BATCH (0 = exact scan),
 TPUSIM_BENCH_STALL_TIMEOUT (240s), TPUSIM_BENCH_RUN_TIMEOUT (2400s),
 TPUSIM_BENCH_RETRIES (2), TPUSIM_BENCH_CPU_PODS/_NODES (CPU-fallback shape),
-TPUSIM_BENCH_CHUNK (65536; chunked-scan chunk length), TPUSIM_SCAN_UNROLL,
+TPUSIM_BENCH_CHUNK (131072; chunked-scan chunk length — the 100k headline runs as ONE dispatch, 1M runs 8 chunks of ~12s each, inside the stall watchdog), TPUSIM_SCAN_UNROLL,
 TPUSIM_BENCH_LADDER_CONFIGS (ladder subset, e.g. "3,5"), TPUSIM_FAST=1
 (Pallas fused-scan fast path for eligible group-free workloads; TPU only
 unless TPUSIM_FAST_INTERPRET=1), TPUSIM_FAST_CHUNK (512).
@@ -320,7 +320,7 @@ def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
         num_pods, num_nodes = _cpu_sized_workload()
     baseline_pods = int(os.environ.get("TPUSIM_BENCH_BASELINE_PODS", 200))
     batch = int(os.environ.get("TPUSIM_BENCH_BATCH", 0))
-    chunk = int(os.environ.get("TPUSIM_BENCH_CHUNK", 65536))
+    chunk = int(os.environ.get("TPUSIM_BENCH_CHUNK", 131072))
 
     import jax
 

@@ -1,0 +1,100 @@
+"""TestPodFitsSelector golden table (predicates_test.go:894-1392), run
+through BOTH engines: every upstream case builds a one-node cluster and the
+pod must schedule (fits) or fail with the node-selector reason, identically
+on the reference backend and the device engine.
+"""
+
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.backends import ReferenceBackend
+from tpusim.jaxe.backend import JaxBackend
+
+
+def aff(*terms):
+    """affinity dict with requiredDuringScheduling terms (each a list of
+    matchExpressions)."""
+    return {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [{"matchExpressions": list(t)}
+                                  for t in terms]}}}
+
+
+def expr(key, op, *values):
+    e = {"key": key, "operator": op}
+    if values:
+        e["values"] = list(values)
+    return e
+
+
+# (name, node_selector, affinity, node_labels, fits) — table order follows
+# predicates_test.go:894-1392
+CASES = [
+    ("no selector", None, None, None, True),
+    ("missing labels", {"foo": "bar"}, None, None, False),
+    ("same labels", {"foo": "bar"}, None, {"foo": "bar"}, True),
+    ("node labels are superset", {"foo": "bar"}, None,
+     {"foo": "bar", "baz": "blah"}, True),
+    ("node labels are subset", {"foo": "bar", "baz": "blah"}, None,
+     {"foo": "bar"}, False),
+    ("In operator matches", None,
+     aff([expr("foo", "In", "bar", "value2")]), {"foo": "bar"}, True),
+    ("Gt operator matches", None,
+     aff([expr("kernel-version", "Gt", "0204")]),
+     {"kernel-version": "0206"}, True),
+    ("NotIn operator matches", None,
+     aff([expr("mem-type", "NotIn", "DDR", "DDR2")]),
+     {"mem-type": "DDR3"}, True),
+    ("Exists operator matches", None,
+     aff([expr("GPU", "Exists")]), {"GPU": "NVIDIA-GRID-K1"}, True),
+    ("affinity values don't match", None,
+     aff([expr("foo", "In", "value1", "value2")]), {"foo": "bar"}, False),
+    ("nil NodeSelectorTerms", None,
+     {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+         "nodeSelectorTerms": None}}}, {"foo": "bar"}, False),
+    ("empty NodeSelectorTerms", None,
+     {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+         "nodeSelectorTerms": []}}}, {"foo": "bar"}, False),
+    ("empty MatchExpressions term", None,
+     aff([]), {"foo": "bar"}, False),
+    ("no affinity schedules", None, None, {"foo": "bar"}, True),
+    ("affinity with nil NodeSelector schedules", None,
+     {"nodeAffinity": {}}, {"foo": "bar"}, True),
+    ("multiple matchExpressions ANDed match", None,
+     aff([expr("GPU", "Exists"), expr("GPU", "NotIn", "AMD", "INTER")]),
+     {"GPU": "NVIDIA-GRID-K1"}, True),
+    ("multiple matchExpressions ANDed don't match", None,
+     aff([expr("GPU", "Exists"), expr("GPU", "In", "AMD", "INTER")]),
+     {"GPU": "NVIDIA-GRID-K1"}, False),
+    ("multiple NodeSelectorTerms ORed", None,
+     aff([expr("foo", "In", "bar", "value2")],
+         [expr("diffkey", "In", "wrong", "value2")]),
+     {"foo": "bar"}, True),
+    ("affinity and nodeSelector both satisfied", {"foo": "bar"},
+     aff([expr("foo", "Exists")]), {"foo": "bar"}, True),
+    ("affinity matches but nodeSelector doesn't", {"foo": "bar"},
+     aff([expr("foo", "Exists")]), {"foo": "barrrrrr"}, False),
+    ("invalid value in affinity term", None,
+     aff([expr("foo", "NotIn", "invalid value: ___@#$%^")]),
+     {"foo": "bar"}, False),
+]
+
+
+@pytest.mark.parametrize("name,selector,affinity,labels,fits",
+                         CASES, ids=[c[0] for c in CASES])
+def test_pod_fits_selector_golden(name, selector, affinity, labels, fits):
+    node = make_node("node1", milli_cpu=4000, memory=4 * 1024**3,
+                     labels=labels)
+    pod = make_pod("p", milli_cpu=100, memory=1024,
+                   node_selector=selector, affinity=affinity)
+    snapshot = ClusterSnapshot(nodes=[node])
+
+    for backend in (ReferenceBackend(), JaxBackend()):
+        [placement] = backend.schedule([pod], snapshot)
+        scheduled = placement.pod.spec.node_name == "node1"
+        assert scheduled == fits, (
+            f"{name}: {type(backend).__name__} scheduled={scheduled}, "
+            f"upstream expects fits={fits} ({placement.message})")
+        if not fits:
+            assert "didn't match node selector" in placement.message, (
+                f"{name}: wrong reason: {placement.message}")

@@ -90,7 +90,17 @@ def test_node_selector_term_and_empty():
                              NodeSelectorRequirement("disk", "Exists")])
     assert term.matches({"zone": "a", "disk": "ssd"})
     assert not term.matches({"zone": "a"})
-    assert NodeSelectorTerm([]).matches({"anything": "x"})
+    # empty term builds labels.Nothing() — matches no objects
+    # (NodeSelectorRequirementsAsSelector, v1 helpers.go:215-217; golden:
+    # predicates_test.go "empty MatchExpressions" case)
+    assert not NodeSelectorTerm([]).matches({"anything": "x"})
+    assert NodeSelectorTerm([]).match_result({"anything": "x"}) is False
+    # a requirement failing labels.NewRequirement validation errors the
+    # whole selector (tri-state None)
+    bad = NodeSelectorTerm([NodeSelectorRequirement(
+        "zone", "NotIn", ["invalid value: ___@#$%^"])])
+    assert bad.match_result({"zone": "a"}) is None
+    assert not bad.matches({"zone": "a"})
 
 
 def test_label_selector():

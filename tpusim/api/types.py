@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 import enum
+import re
 from typing import Any, Optional
 
 from tpusim.api.quantity import Quantity, parse_quantity
@@ -162,6 +163,41 @@ class ObjectMeta:
 # selectors / affinity
 # ---------------------------------------------------------------------------
 
+# apimachinery validation (labels.NewRequirement -> util/validation):
+# label values are <= 63 chars, empty or alphanumeric with -_. inside;
+# label keys are [prefix/]name with a DNS-1123-subdomain prefix and a
+# 63-char qualified name part
+_LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+_LABEL_NAME_RE = re.compile(r"^([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9]$")
+_DNS1123_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?"
+                         r"(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
+
+
+def _valid_label_value(v: str) -> bool:
+    return len(v) <= 63 and bool(_LABEL_VALUE_RE.match(v))
+
+
+def _valid_label_key(k: str) -> bool:
+    prefix, sep, name = k.rpartition("/")
+    if sep and not prefix:
+        return False  # IsQualifiedName: "prefix part must be non-empty"
+    if prefix and (len(prefix) > 253 or not _DNS1123_RE.match(prefix)):
+        return False
+    return 0 < len(name) <= 63 and bool(_LABEL_NAME_RE.match(name))
+
+
+_INT64_RE = re.compile(r"^[+-]?[0-9]+$")
+_INT64_MIN, _INT64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def _parse_int64(s: str) -> Optional[int]:
+    """Go strconv.ParseInt(s, 10, 64): plain decimal digits only (no
+    underscores, no whitespace) within int64 range."""
+    if not _INT64_RE.match(s):
+        return None
+    v = int(s)
+    return v if _INT64_MIN <= v <= _INT64_MAX else None
+
 
 @dataclass
 class NodeSelectorRequirement:
@@ -180,8 +216,31 @@ class NodeSelectorRequirement:
             o["values"] = list(self.values)
         return o
 
+    def invalid(self) -> bool:
+        """labels.NewRequirement validation (apimachinery selector.go:134-169)
+        as invoked by NodeSelectorRequirementsAsSelector: a requirement that
+        would fail construction (bad operator, wrong value count, non-integer
+        Gt/Lt value, invalid label key/value) errors the WHOLE selector."""
+        if not _valid_label_key(self.key):
+            return True
+        if self.operator in ("In", "NotIn"):
+            if not self.values:
+                return True
+        elif self.operator in ("Exists", "DoesNotExist"):
+            if self.values:
+                return True
+        elif self.operator in ("Gt", "Lt"):
+            if len(self.values) != 1:
+                return True
+            if _parse_int64(self.values[0]) is None:
+                return True
+        else:
+            return True
+        return any(not _valid_label_value(v) for v in self.values)
+
     def matches(self, labels: dict) -> bool:
-        """apimachinery labels.Requirement.Matches semantics."""
+        """apimachinery labels.Requirement.Matches semantics (for a
+        requirement that passed `invalid()` validation)."""
         has = self.key in labels
         if self.operator == "In":
             return has and labels[self.key] in self.values
@@ -194,10 +253,9 @@ class NodeSelectorRequirement:
         if self.operator in ("Gt", "Lt"):
             if not has or len(self.values) != 1:
                 return False
-            try:
-                lhs = int(labels[self.key])
-                rhs = int(self.values[0])
-            except ValueError:
+            lhs = _parse_int64(labels[self.key])
+            rhs = _parse_int64(self.values[0])
+            if lhs is None or rhs is None:
                 return False
             return lhs > rhs if self.operator == "Gt" else lhs < rhs
         return False
@@ -215,10 +273,22 @@ class NodeSelectorTerm:
     def to_obj(self) -> dict:
         return {"matchExpressions": [e.to_obj() for e in self.match_expressions]}
 
-    def matches(self, labels: dict) -> bool:
-        """All requirements must match (ANDed). An empty term matches everything
-        (NodeSelectorRequirementsAsSelector of [] is labels.Everything())."""
+    def match_result(self, labels: dict) -> Optional[bool]:
+        """NodeSelectorRequirementsAsSelector semantics (v1 helpers.go:215):
+        None when any requirement fails validation (the selector errors),
+        False for an empty term ([] builds labels.Nothing()), else the ANDed
+        requirement match."""
+        if not self.match_expressions:
+            return False
+        if any(e.invalid() for e in self.match_expressions):
+            return None
         return all(e.matches(labels) for e in self.match_expressions)
+
+    def matches(self, labels: dict) -> bool:
+        """match_result collapsed: errors and the empty-term Nothing()
+        selector both count as no-match (the preferred-affinity scorer path;
+        the required path needs the tri-state — predicates.go:778-792)."""
+        return self.match_result(labels) is True
 
 
 @dataclass

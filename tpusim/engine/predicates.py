@@ -249,8 +249,12 @@ def pod_fits_resources(pod: Pod, meta, node_info: NodeInfo) -> PredicateResult:
 
 
 def pod_matches_node_labels(pod: Pod, node: Node) -> bool:
-    """Reference: predicates.go:798-846 (podMatchesNodeLabels): nodeSelector map
-    AND required node-affinity (terms ORed; empty term list matches nothing)."""
+    """Reference: predicates.go:778-846 (podMatchesNodeLabels +
+    nodeMatchesNodeSelectorTerms): nodeSelector map AND required
+    node-affinity. Terms are ORed in order; an empty term list matches
+    nothing; a term whose selector fails validation (match_result None —
+    NodeSelectorRequirementsAsSelector error) makes the whole affinity a
+    non-match immediately."""
     if pod.spec.node_selector:
         for k, v in pod.spec.node_selector.items():
             if node.metadata.labels.get(k) != v:
@@ -259,7 +263,13 @@ def pod_matches_node_labels(pod: Pod, node: Node) -> bool:
     if affinity is not None and affinity.node_affinity is not None:
         na = affinity.node_affinity
         if na.required_terms is not None:
-            if not any(t.matches(node.metadata.labels) for t in na.required_terms):
+            for t in na.required_terms:
+                r = t.match_result(node.metadata.labels)
+                if r is None:
+                    return False  # parse error: "regarding as not match"
+                if r:
+                    break
+            else:
                 return False
     return True
 

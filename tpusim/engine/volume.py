@@ -33,12 +33,23 @@ class VolumeBinderError(Exception):
 
 
 def check_node_affinity(pv: PersistentVolume, node_labels: dict) -> bool:
-    """volumeutil.CheckNodeAffinity: the PV's required node-affinity terms are
-    ORed; no affinity = unconstrained."""
+    """volumeutil.CheckNodeAffinity (volume/util/util.go:269-294): the PV's
+    required node-affinity terms are ORed; no affinity = unconstrained. A
+    term whose selector fails validation returns an ERROR upstream
+    ("Failed to parse MatchExpressions") — raised here as VolumeBinderError,
+    aborting the pod's scheduling rather than counting as a non-match."""
     terms = pv.node_affinity_terms()
     if terms is None:
         return True
-    return any(term.matches(node_labels) for term in terms)
+    for term in terms:
+        r = term.match_result(node_labels)
+        if r is None:
+            raise VolumeBinderError(
+                "Failed to parse MatchExpressions on PersistentVolume "
+                f"{pv.metadata.name}")
+        if r:
+            return True
+    return False
 
 
 def is_volume_bound_to_claim(pv: PersistentVolume,

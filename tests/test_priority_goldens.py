@@ -1,0 +1,186 @@
+"""Upstream priority golden tables, exact scores.
+
+BalancedResourceAllocation (balanced_resource_allocation_test.go:96-262) and
+the explicit-zero-request nuance it depends on: a request key present with
+value "0" stays 0 (GetNonzeroRequests overrides only UNSET keys,
+non_zero.go:36-54), and a pod with no containers contributes nothing.
+Exact integer scores must equal the upstream float-computed expectations
+(DEVIATIONS.md #16 promises divergence only at rounding boundaries no
+upstream golden crosses).
+"""
+
+import pytest
+
+from tpusim.api.types import Node, Pod
+from tpusim.engine import priorities as prios
+from tpusim.engine.resources import NodeInfo
+
+
+def mk_node(name, milli_cpu, mem):
+    return Node.from_obj({
+        "metadata": {"name": name},
+        "status": {
+            "capacity": {"cpu": f"{milli_cpu}m", "memory": str(mem),
+                         "pods": "110"},
+            "allocatable": {"cpu": f"{milli_cpu}m", "memory": str(mem),
+                            "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        }})
+
+
+def mk_pod(name, node_name="", containers=()):
+    return Pod.from_obj({
+        "metadata": {"name": name, "uid": name},
+        "spec": {"nodeName": node_name,
+                 "containers": [
+                     {"name": f"c{i}", "resources": {"requests": dict(reqs)}}
+                     for i, reqs in enumerate(containers)]},
+    })
+
+
+# upstream pod specs (balanced_resource_allocation_test.go:50-95): note the
+# EXPLICIT "0" memory requests — present keys keep their zero
+def no_resources(name, node=""):
+    return mk_pod(name, node)
+
+
+def cpu_only(name, node=""):
+    return mk_pod(name, node, [{"cpu": "1000m", "memory": "0"},
+                               {"cpu": "2000m", "memory": "0"}])
+
+
+def cpu_and_memory(name, node=""):
+    return mk_pod(name, node, [{"cpu": "1000m", "memory": "2000"},
+                               {"cpu": "2000m", "memory": "3000"}])
+
+
+CASES = [
+    ("nothing scheduled, nothing requested",
+     no_resources("p"), [],
+     [("machine1", 4000, 10000), ("machine2", 4000, 10000)], [10, 10]),
+    ("nothing scheduled, resources requested, differently sized machines",
+     cpu_and_memory("p"), [],
+     [("machine1", 4000, 10000), ("machine2", 6000, 10000)], [7, 10]),
+    ("no resources requested, pods scheduled",
+     no_resources("p"),
+     [no_resources("e1", "machine1"), no_resources("e2", "machine1"),
+      no_resources("e3", "machine2"), no_resources("e4", "machine2")],
+     [("machine1", 4000, 10000), ("machine2", 4000, 10000)], [10, 10]),
+    ("no resources requested, pods scheduled with resources",
+     no_resources("p"),
+     [cpu_only("e1", "machine1"), cpu_only("e2", "machine1"),
+      cpu_only("e3", "machine2"), cpu_and_memory("e4", "machine2")],
+     [("machine1", 10000, 20000), ("machine2", 10000, 20000)], [4, 6]),
+    ("resources requested, pods scheduled with resources",
+     cpu_and_memory("p"),
+     [cpu_only("e1", "machine1"), cpu_and_memory("e2", "machine2")],
+     [("machine1", 10000, 20000), ("machine2", 10000, 20000)], [6, 9]),
+    ("resources requested, differently sized machines",
+     cpu_and_memory("p"),
+     [cpu_only("e1", "machine1"), cpu_and_memory("e2", "machine2")],
+     [("machine1", 10000, 20000), ("machine2", 10000, 50000)], [6, 6]),
+    ("requested resources exceed node capacity",
+     cpu_only("p"),
+     [cpu_only("e1", "machine1"), cpu_and_memory("e2", "machine2")],
+     [("machine1", 4000, 10000), ("machine2", 4000, 10000)], [0, 0]),
+    ("zero node resources",
+     no_resources("p"),
+     [cpu_only("e1", "machine1"), cpu_and_memory("e2", "machine2")],
+     [("machine1", 0, 0), ("machine2", 0, 0)], [0, 0]),
+]
+
+
+@pytest.mark.parametrize("name,pod,existing,nodes,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_balanced_resource_allocation_golden(name, pod, existing, nodes,
+                                             expected):
+    scores = []
+    for node_name, cpu, mem in nodes:
+        ni = NodeInfo(*(p for p in existing
+                        if p.spec.node_name == node_name))
+        ni.set_node(mk_node(node_name, cpu, mem))
+        hp = prios.balanced_resource_allocation_map(pod, None, ni)
+        scores.append(hp.score)
+    assert scores == expected, f"{name}: {scores} != {expected}"
+
+
+def test_explicit_zero_memory_request_stays_zero():
+    # non_zero.go:36-54: "Override if un-set, but not if explicitly set to
+    # zero" — cpu_only pods must contribute 0 memory, not the 200MB default
+    from tpusim.engine.resources import get_nonzero_pod_request
+
+    nz = get_nonzero_pod_request(cpu_only("p"))
+    assert nz.milli_cpu == 3000
+    assert nz.memory == 0
+    # absent keys DO default
+    nz2 = get_nonzero_pod_request(mk_pod("q", containers=[{}]))
+    assert nz2.milli_cpu == 100
+    assert nz2.memory == 200 * 1024 * 1024
+
+
+# LeastRequested (least_requested_test.go:96-262): same fixtures, same case
+# order as the balanced table, upstream expected score lists
+LEAST_CASES = [
+    ("nothing scheduled, nothing requested", 0, [10, 10]),
+    ("nothing scheduled, resources requested, differently sized machines",
+     1, [3, 5]),
+    ("no resources requested, pods scheduled", 2, [10, 10]),
+    ("no resources requested, pods scheduled with resources", 3, [7, 5]),
+    ("resources requested, pods scheduled with resources", 4, [5, 4]),
+    ("resources requested, differently sized machines", 5, [5, 6]),
+    ("requested resources exceed node capacity", 6, [5, 2]),
+    ("zero node resources", 7, [0, 0]),
+]
+
+
+@pytest.mark.parametrize("name,case_idx,expected",
+                         LEAST_CASES, ids=[c[0] for c in LEAST_CASES])
+def test_least_requested_golden(name, case_idx, expected):
+    _, pod, existing, nodes, _ = CASES[case_idx]
+    scores = []
+    for node_name, cpu, mem in nodes:
+        ni = NodeInfo(*(p for p in existing
+                        if p.spec.node_name == node_name))
+        ni.set_node(mk_node(node_name, cpu, mem))
+        scores.append(prios.least_requested_priority_map(pod, None, ni).score)
+    assert scores == expected, f"{name}: {scores} != {expected}"
+
+
+def big_cpu_and_memory(name, node=""):
+    return mk_pod(name, node, [{"cpu": "2000m", "memory": "4000"},
+                               {"cpu": "3000m", "memory": "5000"}])
+
+
+# MostRequested (most_requested_test.go:111-217)
+MOST_CASES = [
+    ("nothing scheduled, nothing requested",
+     no_resources("p"), [],
+     [("machine1", 4000, 10000), ("machine2", 4000, 10000)], [0, 0]),
+    ("nothing scheduled, resources requested, differently sized machines",
+     cpu_and_memory("p"), [],
+     [("machine1", 4000, 10000), ("machine2", 6000, 10000)], [6, 5]),
+    ("no resources requested, pods scheduled with resources",
+     no_resources("p"),
+     [cpu_only("e1", "machine1"), cpu_only("e2", "machine1"),
+      cpu_only("e3", "machine2"), cpu_and_memory("e4", "machine2")],
+     [("machine1", 10000, 20000), ("machine2", 10000, 20000)], [3, 4]),
+    ("resources requested, pods scheduled with resources",
+     cpu_and_memory("p"),
+     [cpu_only("e1", "machine1"), cpu_and_memory("e2", "machine2")],
+     [("machine1", 10000, 20000), ("machine2", 10000, 20000)], [4, 5]),
+    ("resources requested with more than the node",
+     big_cpu_and_memory("p"), [],
+     [("machine1", 4000, 10000), ("machine2", 10000, 8000)], [4, 2]),
+]
+
+
+@pytest.mark.parametrize("name,pod,existing,nodes,expected",
+                         MOST_CASES, ids=[c[0] for c in MOST_CASES])
+def test_most_requested_golden(name, pod, existing, nodes, expected):
+    scores = []
+    for node_name, cpu, mem in nodes:
+        ni = NodeInfo(*(p for p in existing
+                        if p.spec.node_name == node_name))
+        ni.set_node(mk_node(node_name, cpu, mem))
+        scores.append(prios.most_requested_priority_map(pod, None, ni).score)
+    assert scores == expected, f"{name}: {scores} != {expected}"

@@ -1,0 +1,79 @@
+"""TestPodToleratesTaints golden table (predicates_test.go:3221-3420), run
+through BOTH engines: each upstream case builds a one-node cluster with the
+taints and the pod must schedule (fits) or fail with the taints reason,
+identically on the reference backend and the device engine.
+"""
+
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.backends import ReferenceBackend
+from tpusim.jaxe.backend import JaxBackend
+
+
+def taint(key, value, effect):
+    return {"key": key, "value": value, "effect": effect}
+
+
+def tol(key=None, operator=None, value=None, effect=None):
+    t = {}
+    if key is not None:
+        t["key"] = key
+    if operator is not None:
+        t["operator"] = operator
+    if value is not None:
+        t["value"] = value
+    if effect is not None:
+        t["effect"] = effect
+    return t
+
+
+# (name, tolerations, node_taints, fits) — table order follows
+# predicates_test.go:3221-3420
+CASES = [
+    ("no tolerations vs nonempty taints", None,
+     [taint("dedicated", "user1", "NoSchedule")], False),
+    ("matching toleration (default Equal operator)",
+     [tol("dedicated", value="user1", effect="NoSchedule")],
+     [taint("dedicated", "user1", "NoSchedule")], True),
+    ("value mismatch",
+     [tol("dedicated", "Equal", "user2", "NoSchedule")],
+     [taint("dedicated", "user1", "NoSchedule")], False),
+    ("Exists operator tolerates any value",
+     [tol("foo", "Exists", effect="NoSchedule")],
+     [taint("foo", "bar", "NoSchedule")], True),
+    ("multiple tolerations cover multiple taints",
+     [tol("dedicated", "Equal", "user2", "NoSchedule"),
+      tol("foo", "Exists", effect="NoSchedule")],
+     [taint("dedicated", "user2", "NoSchedule"),
+      taint("foo", "bar", "NoSchedule")], True),
+    ("effect mismatch (PreferNoSchedule toleration vs NoSchedule taint)",
+     [tol("foo", "Equal", "bar", "PreferNoSchedule")],
+     [taint("foo", "bar", "NoSchedule")], False),
+    ("empty toleration effect matches any effect",
+     [tol("foo", "Equal", "bar")],
+     [taint("foo", "bar", "NoSchedule")], True),
+    ("key/value mismatch but taint is only PreferNoSchedule",
+     [tol("dedicated", "Equal", "user2", "NoSchedule")],
+     [taint("dedicated", "user1", "PreferNoSchedule")], True),
+    ("no tolerations, PreferNoSchedule taint only", None,
+     [taint("dedicated", "user1", "PreferNoSchedule")], True),
+]
+
+
+@pytest.mark.parametrize("name,tolerations,taints,fits",
+                         CASES, ids=[c[0] for c in CASES])
+def test_pod_tolerates_taints_golden(name, tolerations, taints, fits):
+    node = make_node("node1", milli_cpu=4000, memory=4 * 1024**3,
+                     taints=taints)
+    pod = make_pod("p", milli_cpu=100, memory=1024, tolerations=tolerations)
+    snapshot = ClusterSnapshot(nodes=[node])
+
+    for backend in (ReferenceBackend(), JaxBackend()):
+        [placement] = backend.schedule([pod], snapshot)
+        scheduled = placement.pod.spec.node_name == "node1"
+        assert scheduled == fits, (
+            f"{name}: {type(backend).__name__} scheduled={scheduled}, "
+            f"upstream expects fits={fits} ({placement.message})")
+        if not fits:
+            assert "taints that the pod didn't tolerate" in placement.message

@@ -1,0 +1,85 @@
+"""TestPodFitsHostPorts golden table (predicates_test.go:555-668), run
+through BOTH engines: each case seeds one node with a running pod holding
+the existing ports, then the new pod must schedule (fits) or fail with the
+free-ports reason, identically on the reference backend and the device
+engine (which factors conflicts through interned port-set signatures).
+"""
+
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node
+from tpusim.api.types import Pod
+from tpusim.backends import ReferenceBackend
+from tpusim.jaxe.backend import JaxBackend
+
+
+def ports_pod(name, specs, node_name="", phase=""):
+    """specs: list of 'PROTO/ip/port' strings like the upstream newPod."""
+    ports = []
+    for s in specs:
+        proto, ip, port = s.split("/")
+        ports.append({"hostPort": int(port), "hostIP": ip, "protocol": proto})
+    obj = {
+        "metadata": {"name": name, "namespace": "default", "uid": name},
+        "spec": {"containers": [{
+            "name": "c", "ports": ports,
+            "resources": {"requests": {"cpu": "10m"}}}]},
+        "status": {},
+    }
+    if node_name:
+        obj["spec"]["nodeName"] = node_name
+    if phase:
+        obj["status"]["phase"] = phase
+    return Pod.from_obj(obj)
+
+
+# (name, new pod port specs, existing pod port specs, fits) — table order
+# follows predicates_test.go:555-668
+CASES = [
+    ("nothing running", [], None, True),
+    ("other port", ["UDP/127.0.0.1/8080"], ["UDP/127.0.0.1/9090"], True),
+    ("same udp port", ["UDP/127.0.0.1/8080"], ["UDP/127.0.0.1/8080"], False),
+    ("same tcp port", ["TCP/127.0.0.1/8080"], ["TCP/127.0.0.1/8080"], False),
+    ("different host ip", ["TCP/127.0.0.1/8080"], ["TCP/127.0.0.2/8080"],
+     True),
+    ("different protocol", ["UDP/127.0.0.1/8080"], ["TCP/127.0.0.1/8080"],
+     True),
+    ("second udp port conflict",
+     ["UDP/127.0.0.1/8000", "UDP/127.0.0.1/8080"],
+     ["UDP/127.0.0.1/8080"], False),
+    ("first tcp port conflict",
+     ["TCP/127.0.0.1/8001", "UDP/127.0.0.1/8080"],
+     ["TCP/127.0.0.1/8001", "UDP/127.0.0.1/8081"], False),
+    ("first tcp port conflict due to 0.0.0.0 hostIP",
+     ["TCP/0.0.0.0/8001"], ["TCP/127.0.0.1/8001"], False),
+    ("TCP hostPort conflict due to 0.0.0.0 hostIP",
+     ["TCP/10.0.10.10/8001", "TCP/0.0.0.0/8001"],
+     ["TCP/127.0.0.1/8001"], False),
+    ("second tcp port conflict to 0.0.0.0 hostIP",
+     ["TCP/127.0.0.1/8001"], ["TCP/0.0.0.0/8001"], False),
+    ("second different protocol", ["UDP/127.0.0.1/8001"],
+     ["TCP/0.0.0.0/8001"], True),
+    ("UDP hostPort conflict due to 0.0.0.0 hostIP",
+     ["UDP/127.0.0.1/8001"],
+     ["TCP/0.0.0.0/8001", "UDP/0.0.0.0/8001"], False),
+]
+
+
+@pytest.mark.parametrize("name,new_ports,existing_ports,fits",
+                         CASES, ids=[c[0] for c in CASES])
+def test_pod_fits_host_ports_golden(name, new_ports, existing_ports, fits):
+    node = make_node("node1", milli_cpu=4000, memory=4 * 1024**3)
+    existing = ([ports_pod("e", existing_ports, node_name="node1",
+                           phase="Running")]
+                if existing_ports is not None else [])
+    snapshot = ClusterSnapshot(nodes=[node], pods=existing)
+    pod = ports_pod("p", new_ports)
+
+    for backend in (ReferenceBackend(), JaxBackend()):
+        [placement] = backend.schedule([pod], snapshot)
+        scheduled = placement.pod.spec.node_name == "node1"
+        assert scheduled == fits, (
+            f"{name}: {type(backend).__name__} scheduled={scheduled}, "
+            f"upstream expects fits={fits} ({placement.message})")
+        if not fits:
+            assert "didn't have free ports" in placement.message

@@ -1,0 +1,200 @@
+"""TestPodFitsResources golden table (predicates_test.go:95-345).
+
+Host level: `pod_fits_resources` must return the exact upstream failure
+tuples (resource, requested, used, capacity) in order. Device level: the
+same workloads must schedule/fail identically through the jax backend
+(scalar resources ride interned columns), with the reason strings present
+in the FitError message.
+
+Node shape (predicates_test.go:340): cpu=10m, memory=20, pods=32,
+example.com/aaa=5, ephemeral-storage=20, hugepages-2Mi=5.
+"""
+
+import types as _types
+
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.api.types import Node, Pod
+from tpusim.backends import ReferenceBackend
+from tpusim.engine import errors as err
+from tpusim.engine.predicates import pod_fits_resources
+from tpusim.engine.resources import NodeInfo
+from tpusim.jaxe.backend import JaxBackend
+
+EXT_A = "example.com/aaa"
+EXT_B = "example.com/bbb"
+HUGE_A = "hugepages-2Mi"
+
+
+def res_pod(name, *containers, init=(), node_name="", phase=""):
+    """containers/init: dicts {cpu(milli), mem, scalar:{name:qty}}."""
+
+    def c_obj(i, spec, prefix):
+        requests = {}
+        if spec.get("cpu"):
+            requests["cpu"] = f"{spec['cpu']}m"
+        if spec.get("mem"):
+            requests["memory"] = str(spec["mem"])
+        for k, v in (spec.get("scalar") or {}).items():
+            requests[k] = str(v)
+        return {"name": f"{prefix}{i}", "resources": {"requests": requests}}
+
+    obj = {
+        "metadata": {"name": name, "namespace": "default", "uid": name},
+        "spec": {
+            "containers": [c_obj(i, s, "c") for i, s in enumerate(containers)],
+            "initContainers": [c_obj(i, s, "i") for i, s in enumerate(init)],
+        },
+        "status": {},
+    }
+    if node_name:
+        obj["spec"]["nodeName"] = node_name
+        obj["status"]["phase"] = phase or "Running"
+    return Pod.from_obj(obj)
+
+
+def golden_node(name="node1"):
+    alloc = {"cpu": "10m", "memory": "20", "pods": "32", EXT_A: "5",
+             "ephemeral-storage": "20", HUGE_A: "5"}
+    return Node.from_obj({
+        "metadata": {"name": name},
+        "status": {"capacity": dict(alloc), "allocatable": dict(alloc),
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def R(cpu=0, mem=0, **scalar):
+    d = {"cpu": cpu, "mem": mem}
+    if scalar:
+        d["scalar"] = {k.replace("__", "/"): v for k, v in scalar.items()}
+    return d
+
+
+def S(name, qty):
+    return {"scalar": {name: qty}}
+
+
+# (test name, pod, existing pod containers, expected fits,
+#  expected failure tuples (resource, requested, used, capacity))
+CASES = [
+    ("no resources requested always fits",
+     res_pod("p"), [R(10, 20)], True, []),
+    ("too many resources fails",
+     res_pod("p", R(1, 1)), [R(10, 20)], False,
+     [("cpu", 1, 10, 10), ("memory", 1, 20, 20)]),
+    ("too many resources fails due to init container cpu",
+     res_pod("p", R(1, 1), init=[R(3, 1)]), [R(8, 19)], False,
+     [("cpu", 3, 8, 10)]),
+    ("too many resources fails due to highest init container cpu",
+     res_pod("p", R(1, 1), init=[R(3, 1), R(2, 1)]), [R(8, 19)], False,
+     [("cpu", 3, 8, 10)]),
+    ("too many resources fails due to init container memory",
+     res_pod("p", R(1, 1), init=[R(1, 3)]), [R(9, 19)], False,
+     [("memory", 3, 19, 20)]),
+    ("too many resources fails due to highest init container memory",
+     res_pod("p", R(1, 1), init=[R(1, 3), R(1, 2)]), [R(9, 19)], False,
+     [("memory", 3, 19, 20)]),
+    ("init container fits because it's the max, not sum",
+     res_pod("p", R(1, 1), init=[R(1, 1)]), [R(9, 19)], True, []),
+    ("multiple init containers fit (max, not sum)",
+     res_pod("p", R(1, 1), init=[R(1, 1), R(1, 1)]), [R(9, 19)], True, []),
+    ("both resources fit",
+     res_pod("p", R(1, 1)), [R(5, 5)], True, []),
+    ("one resource memory fits",
+     res_pod("p", R(2, 1)), [R(9, 5)], False, [("cpu", 2, 9, 10)]),
+    ("one resource cpu fits",
+     res_pod("p", R(1, 2)), [R(5, 19)], False, [("memory", 2, 19, 20)]),
+    ("equal edge case",
+     res_pod("p", R(5, 1)), [R(5, 19)], True, []),
+    ("equal edge case for init container",
+     res_pod("p", R(4, 1), init=[R(5, 1)]), [R(5, 19)], True, []),
+    ("extended resource fits",
+     res_pod("p", S(EXT_A, 1)), [R()], True, []),
+    ("extended resource fits for init container",
+     res_pod("p", R(), init=[S(EXT_A, 1)]), [R()], True, []),
+    ("extended resource capacity enforced",
+     res_pod("p", {**R(1, 1), **S(EXT_A, 10)}), [R()], False,
+     [(EXT_A, 10, 0, 5)]),
+    ("extended resource capacity enforced for init container",
+     res_pod("p", R(), init=[{**R(1, 1), **S(EXT_A, 10)}]), [R()], False,
+     [(EXT_A, 10, 0, 5)]),
+    ("extended resource allocatable enforced",
+     res_pod("p", {**R(1, 1), **S(EXT_A, 1)}),
+     [{**R(), **S(EXT_A, 5)}], False, [(EXT_A, 1, 5, 5)]),
+    ("extended resource allocatable enforced for init container",
+     res_pod("p", R(), init=[{**R(1, 1), **S(EXT_A, 1)}]),
+     [{**R(), **S(EXT_A, 5)}], False, [(EXT_A, 1, 5, 5)]),
+    ("extended resource allocatable enforced for multiple containers",
+     res_pod("p", {**R(1, 1), **S(EXT_A, 3)}, {**R(1, 1), **S(EXT_A, 3)}),
+     [{**R(), **S(EXT_A, 2)}], False, [(EXT_A, 6, 2, 5)]),
+    ("extended resource allocatable admits multiple init containers",
+     res_pod("p", R(), init=[{**R(1, 1), **S(EXT_A, 3)},
+                             {**R(1, 1), **S(EXT_A, 3)}]),
+     [{**R(), **S(EXT_A, 2)}], True, []),
+    ("extended resource allocatable enforced for multiple init containers",
+     res_pod("p", R(), init=[{**R(1, 1), **S(EXT_A, 6)},
+                             {**R(1, 1), **S(EXT_A, 3)}]),
+     [{**R(), **S(EXT_A, 2)}], False, [(EXT_A, 6, 2, 5)]),
+    ("extended resource allocatable enforced for unknown resource",
+     res_pod("p", {**R(1, 1), **S(EXT_B, 1)}), [R()], False,
+     [(EXT_B, 1, 0, 0)]),
+    ("extended resource allocatable enforced for unknown resource for init",
+     res_pod("p", R(), init=[{**R(1, 1), **S(EXT_B, 1)}]), [R()], False,
+     [(EXT_B, 1, 0, 0)]),
+    ("hugepages resource capacity enforced",
+     res_pod("p", {**R(1, 1), **S(HUGE_A, 10)}),
+     [{**R(), **S(HUGE_A, 0)}], False, [(HUGE_A, 10, 0, 5)]),
+    ("hugepages resource capacity enforced for init container",
+     res_pod("p", R(), init=[{**R(1, 1), **S(HUGE_A, 10)}]),
+     [{**R(), **S(HUGE_A, 0)}], False, [(HUGE_A, 10, 0, 5)]),
+    ("hugepages resource allocatable enforced for multiple containers",
+     res_pod("p", {**R(1, 1), **S(HUGE_A, 3)}, {**R(1, 1), **S(HUGE_A, 3)}),
+     [{**R(), **S(HUGE_A, 2)}], False, [(HUGE_A, 6, 2, 5)]),
+]
+
+
+def existing_pods(specs):
+    return [res_pod(f"e{i}", spec, node_name="node1")
+            for i, spec in enumerate(specs)]
+
+
+@pytest.mark.parametrize("name,pod,existing,fits,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_pod_fits_resources_golden_host(name, pod, existing, fits, expected):
+    ni = NodeInfo(*existing_pods(existing))
+    ni.set_node(golden_node())
+    ok, fails = pod_fits_resources(pod, None, ni)
+    assert ok == fits, f"{name}: fits={ok}, want {fits} ({fails})"
+    got = [(f.resource_name, f.requested, f.used, f.capacity)
+           for f in fails if isinstance(f, err.InsufficientResourceError)]
+    assert got == expected, f"{name}: {got} != {expected}"
+
+
+@pytest.mark.parametrize("name,pod,existing,fits,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_pod_fits_resources_golden_backends(name, pod, existing, fits,
+                                            expected):
+    snapshot = ClusterSnapshot(nodes=[golden_node()],
+                               pods=existing_pods(existing))
+    for backend in (ReferenceBackend(), JaxBackend()):
+        [placement] = backend.schedule([pod], snapshot)
+        scheduled = placement.pod.spec.node_name == "node1"
+        assert scheduled == fits, (
+            f"{name}: {type(backend).__name__} scheduled={scheduled}, "
+            f"want {fits} ({placement.message})")
+        for resource, *_ in expected:
+            assert f"Insufficient {resource}" in placement.message
+
+
+def test_ignored_extended_resource_skipped():
+    # predicates.go:754-761 via IgnoredByScheduler extender options: the
+    # ignored extended resource is not capacity-checked
+    from tpusim.engine.resources import get_resource_request
+
+    pod = res_pod("p", {**R(1, 1), **S(EXT_B, 1)})
+    ni = NodeInfo()
+    ni.set_node(golden_node())
+    meta = _types.SimpleNamespace(pod_request=get_resource_request(pod),
+                                  ignored_extended_resources={EXT_B})
+    ok, fails = pod_fits_resources(pod, meta, ni)
+    assert ok, fails

@@ -1,0 +1,130 @@
+"""Upstream normalize-reduce priority golden tables, exact scores.
+
+TaintToleration (taint_toleration_test.go TestTaintAndToleration, 5 cases)
+and NodeAffinity (node_affinity_test.go TestNodeAffinityPriority, 4 cases):
+the host map+reduce pipeline must land on the upstream expected score lists
+exactly (integer NormalizeReduce, reduce.go:29-62).
+"""
+
+import pytest
+
+from tpusim.api.snapshot import make_node, make_pod
+from tpusim.engine import priorities as prios
+from tpusim.engine.resources import NodeInfo
+
+
+def run_map_reduce(map_fn, reduce_fn, pod, nodes):
+    infos = {}
+    result = []
+    for node in nodes:
+        ni = NodeInfo()
+        ni.set_node(node)
+        infos[node.metadata.name] = ni
+        result.append(map_fn(pod, None, ni))
+    if reduce_fn is not None:
+        reduce_fn(pod, None, infos, result)
+    return [hp.score for hp in result]
+
+
+def tol(key, value, effect):
+    return {"key": key, "operator": "Equal", "value": value, "effect": effect}
+
+
+def taint(key, value, effect):
+    return {"key": key, "value": value, "effect": effect}
+
+
+TAINT_CASES = [
+    ("tolerated taints score higher than intolerable",
+     [tol("foo", "bar", "PreferNoSchedule")],
+     [("nodeA", [taint("foo", "bar", "PreferNoSchedule")]),
+      ("nodeB", [taint("foo", "blah", "PreferNoSchedule")])],
+     [10, 0]),
+    ("all-tolerated nodes score the same regardless of taint count",
+     [tol("cpu-type", "arm64", "PreferNoSchedule"),
+      tol("disk-type", "ssd", "PreferNoSchedule")],
+     [("nodeA", []),
+      ("nodeB", [taint("cpu-type", "arm64", "PreferNoSchedule")]),
+      ("nodeC", [taint("cpu-type", "arm64", "PreferNoSchedule"),
+                 taint("disk-type", "ssd", "PreferNoSchedule")])],
+     [10, 10, 10]),
+    ("more intolerable taints, lower score",
+     [tol("foo", "bar", "PreferNoSchedule")],
+     [("nodeA", []),
+      ("nodeB", [taint("cpu-type", "arm64", "PreferNoSchedule")]),
+      ("nodeC", [taint("cpu-type", "arm64", "PreferNoSchedule"),
+                 taint("disk-type", "ssd", "PreferNoSchedule")])],
+     [10, 5, 0]),
+    ("only PreferNoSchedule effects are checked",
+     [tol("cpu-type", "arm64", "NoSchedule"),
+      tol("disk-type", "ssd", "NoSchedule")],
+     [("nodeA", []),
+      ("nodeB", [taint("cpu-type", "arm64", "NoSchedule")]),
+      ("nodeC", [taint("cpu-type", "arm64", "PreferNoSchedule"),
+                 taint("disk-type", "ssd", "PreferNoSchedule")])],
+     [10, 10, 0]),
+    ("no taints and tolerations",
+     [],
+     [("nodeA", []),
+      ("nodeB", [taint("cpu-type", "arm64", "PreferNoSchedule")])],
+     [10, 0]),
+]
+
+
+@pytest.mark.parametrize("name,tolerations,node_taints,expected",
+                         TAINT_CASES, ids=[c[0] for c in TAINT_CASES])
+def test_taint_toleration_priority_golden(name, tolerations, node_taints,
+                                          expected):
+    pod = make_pod("p", tolerations=tolerations or None)
+    nodes = [make_node(n, taints=t or None) for n, t in node_taints]
+    scores = run_map_reduce(prios.compute_taint_toleration_priority_map,
+                            prios.compute_taint_toleration_priority_reduce,
+                            pod, nodes)
+    assert scores == expected, f"{name}: {scores} != {expected}"
+
+
+def pref(weight, *exprs):
+    return {"weight": weight, "preference": {"matchExpressions": [
+        {"key": k, "operator": "In", "values": [v]} for k, v in exprs]}}
+
+
+AFFINITY1 = {"nodeAffinity": {
+    "preferredDuringSchedulingIgnoredDuringExecution": [
+        pref(2, ("foo", "bar"))]}}
+AFFINITY2 = {"nodeAffinity": {
+    "preferredDuringSchedulingIgnoredDuringExecution": [
+        pref(2, ("foo", "bar")),
+        pref(4, ("key", "value")),
+        pref(5, ("foo", "bar"), ("key", "value"), ("az", "az1"))]}}
+
+LABEL1 = {"foo": "bar"}
+LABEL2 = {"key": "value"}
+LABEL3 = {"az": "az1"}
+LABEL4 = {"abc": "az11", "def": "az22"}
+LABEL5 = {"foo": "bar", "key": "value", "az": "az1"}
+
+AFFINITY_CASES = [
+    ("nil NodeAffinity scores zero", None,
+     [("machine1", LABEL1), ("machine2", LABEL2), ("machine3", LABEL3)],
+     [0, 0, 0]),
+    ("no machine matches preferred terms", AFFINITY1,
+     [("machine1", LABEL4), ("machine2", LABEL2), ("machine3", LABEL3)],
+     [0, 0, 0]),
+    ("only machine1 matches", AFFINITY1,
+     [("machine1", LABEL1), ("machine2", LABEL2), ("machine3", LABEL3)],
+     [10, 0, 0]),
+    ("all match with different priorities", AFFINITY2,
+     [("machine1", LABEL1), ("machine5", LABEL5), ("machine2", LABEL2)],
+     [1, 10, 3]),
+]
+
+
+@pytest.mark.parametrize("name,affinity,node_labels,expected",
+                         AFFINITY_CASES, ids=[c[0] for c in AFFINITY_CASES])
+def test_node_affinity_priority_golden(name, affinity, node_labels, expected):
+    pod = make_pod("p", affinity=affinity)
+    nodes = [make_node(n, labels=dict(lb)) for n, lb in node_labels]
+    scores = run_map_reduce(prios.calculate_node_affinity_priority_map,
+                            prios.calculate_node_affinity_priority_reduce,
+                            pod, nodes)
+    assert scores == expected, f"{name}: {scores} != {expected}"

@@ -1,0 +1,152 @@
+"""TestInterPodAffinity golden table (predicates_test.go:2168-2780), run
+through BOTH engines on the upstream single-node cluster: machine1 with
+labels {region: r1, zone: z11}. Covers required pod affinity (operators,
+ANDed expressions, namespaces, the self-match special case), own
+anti-affinity, and existing pods' anti-affinity symmetry.
+"""
+
+import pytest
+
+from tpusim.api.types import Node, Pod
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.backends import ReferenceBackend
+from tpusim.jaxe.backend import JaxBackend
+
+POD_LABEL = {"service": "securityscan"}
+POD_LABEL2 = {"security": "S1"}
+
+
+def expr(key, op, *values):
+    e = {"key": key, "operator": op}
+    if values:
+        e["values"] = list(values)
+    return e
+
+
+def term(exprs, topology_key="", namespaces=None):
+    t = {"labelSelector": {"matchExpressions": list(exprs)}}
+    if topology_key:
+        t["topologyKey"] = topology_key
+    if namespaces:
+        t["namespaces"] = list(namespaces)
+    return t
+
+
+def ip_pod(name, labels=None, affinity=None, anti=None, node_name="",
+           namespace="default"):
+    aff = {}
+    if affinity:
+        aff["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": affinity}
+    if anti:
+        aff["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": anti}
+    obj = {
+        "metadata": {"name": name, "uid": name, "namespace": namespace,
+                     "labels": labels or {}},
+        "spec": {"containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "10m"}}}]},
+        "status": {},
+    }
+    if aff:
+        obj["spec"]["affinity"] = aff
+    if node_name:
+        obj["spec"]["nodeName"] = node_name
+        obj["status"]["phase"] = "Running"
+    return Pod.from_obj(obj)
+
+
+def machine1():
+    return Node.from_obj({
+        "metadata": {"name": "machine1",
+                     "labels": {"region": "r1", "zone": "z11"}},
+        "status": {
+            "capacity": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+            "allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+IN_SEC = [expr("service", "In", "securityscan", "value2")]
+CASES = [
+    ("no affinity rules, no existing pods",
+     ip_pod("p"), [], True),
+    ("required affinity In matches existing pod",
+     ip_pod("p", POD_LABEL2, affinity=[term(IN_SEC, "region")]),
+     [ip_pod("e", POD_LABEL, node_name="machine1")], True),
+    ("required affinity NotIn matches existing pod",
+     ip_pod("p", POD_LABEL2, affinity=[term(
+         [expr("service", "NotIn", "securityscan3", "value3")], "region")]),
+     [ip_pod("e", POD_LABEL, node_name="machine1")], True),
+    ("different namespace does not satisfy",
+     ip_pod("p", POD_LABEL2, affinity=[term(IN_SEC,
+                                            namespaces=["DiffNameSpace"])]),
+     [ip_pod("e", POD_LABEL, node_name="machine1", namespace="ns")], False),
+    ("unmatching labelSelector",
+     ip_pod("p", POD_LABEL, affinity=[term(
+         [expr("service", "In", "antivirusscan", "value2")], "region")]),
+     [ip_pod("e", POD_LABEL, node_name="machine1")], False),
+    ("multiple required terms with different operators all match",
+     ip_pod("p", POD_LABEL2, affinity=[
+         term([expr("service", "Exists"),
+               expr("wrongkey", "DoesNotExist")], "region"),
+         term([expr("service", "In", "securityscan"),
+               expr("service", "NotIn", "WrongValue")], "region")]),
+     [ip_pod("e", POD_LABEL, node_name="machine1")], True),
+    ("ANDed matchExpressions with one failing item",
+     ip_pod("p", POD_LABEL2, affinity=[
+         term([expr("service", "Exists"),
+               expr("wrongkey", "DoesNotExist")], "region"),
+         term([expr("service", "In", "securityscan2"),
+               expr("service", "NotIn", "WrongValue")], "region")]),
+     [ip_pod("e", POD_LABEL, node_name="machine1")], False),
+    ("affinity and non-matching anti-affinity",
+     ip_pod("p", POD_LABEL2, affinity=[term(IN_SEC, "region")],
+            anti=[term([expr("service", "In", "antivirusscan", "value2")],
+                       "node")]),
+     [ip_pod("e", POD_LABEL, node_name="machine1")], True),
+    ("anti-affinity symmetry that does not target the new pod",
+     ip_pod("p", POD_LABEL2, affinity=[term(IN_SEC, "region")],
+            anti=[term([expr("service", "In", "antivirusscan", "value2")],
+                       "node")]),
+     [ip_pod("e", POD_LABEL, node_name="machine1",
+             anti=[term([expr("service", "In", "antivirusscan", "value2")],
+                        "node")])], True),
+    ("own anti-affinity matches the existing pod",
+     ip_pod("p", POD_LABEL2, affinity=[term(IN_SEC, "region")],
+            anti=[term(IN_SEC, "zone")]),
+     [ip_pod("e", POD_LABEL, node_name="machine1")], False),
+    ("existing pod's anti-affinity targets the new pod (symmetry)",
+     ip_pod("p", POD_LABEL, affinity=[term(IN_SEC, "region")],
+            anti=[term([expr("service", "In", "antivirusscan", "value2")],
+                       "node")]),
+     [ip_pod("e", POD_LABEL, node_name="machine1",
+             anti=[term(IN_SEC, "zone")])], False),
+    ("NotIn affinity vs own labels (no self-match rescue)",
+     ip_pod("p", POD_LABEL, affinity=[term(
+         [expr("service", "NotIn", "securityscan", "value2")], "region")]),
+     [ip_pod("e", POD_LABEL, node_name="machine2")], False),
+    ("existing anti-affinity respected when new pod has no constraints",
+     ip_pod("p", POD_LABEL),
+     [ip_pod("e", POD_LABEL, node_name="machine1",
+             anti=[term(IN_SEC, "zone")])], False),
+    ("existing anti-affinity NotIn does not target the new pod",
+     ip_pod("p", POD_LABEL),
+     [ip_pod("e", POD_LABEL, node_name="machine1",
+             anti=[term([expr("service", "NotIn", "securityscan", "value2")],
+                        "zone")])], True),
+]
+
+
+@pytest.mark.parametrize("name,pod,existing,fits",
+                         CASES, ids=[c[0] for c in CASES])
+def test_inter_pod_affinity_golden(name, pod, existing, fits):
+    snapshot = ClusterSnapshot(nodes=[machine1()], pods=existing)
+    for backend in (ReferenceBackend(), JaxBackend()):
+        [placement] = backend.schedule([pod], snapshot)
+        scheduled = placement.pod.spec.node_name == "machine1"
+        assert scheduled == fits, (
+            f"{name}: {type(backend).__name__} scheduled={scheduled}, "
+            f"upstream expects fits={fits} ({placement.message})")
+        if not fits:
+            assert "pod affinity" in placement.message or \
+                "anti-affinity" in placement.message, placement.message

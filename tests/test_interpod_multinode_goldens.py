@@ -1,0 +1,171 @@
+"""TestInterPodAffinityWithMultipleNodes golden table
+(predicates_test.go:2783-3160): per-node fits via the host
+PodAffinityChecker with full-cluster context, plus a backend-level check
+that both engines place the pod on an allowed node (or mark it
+unschedulable when no node fits).
+"""
+
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.api.types import Node, Pod
+from tpusim.backends import ReferenceBackend
+from tpusim.engine import predicates as preds
+from tpusim.engine.resources import new_node_info_map
+from tpusim.jaxe.backend import JaxBackend
+
+RG_CHINA = {"region": "China"}
+RG_CHINA_AZ1 = {"region": "China", "az": "az1"}
+RG_INDIA = {"region": "India"}
+RG_US = {"region": "US"}
+
+
+def mk_node(name, labels):
+    return Node.from_obj({
+        "metadata": {"name": name, "labels": dict(labels)},
+        "status": {
+            "capacity": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+            "allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def expr(key, op, *values):
+    e = {"key": key, "operator": op}
+    if values:
+        e["values"] = list(values)
+    return e
+
+
+def pod_term(exprs, topo):
+    return {"labelSelector": {"matchExpressions": list(exprs)},
+            "topologyKey": topo}
+
+
+def mk_pod(name, labels=None, pod_affinity=None, pod_anti=None,
+           node_affinity=None, node_name="", namespace="default"):
+    aff = {}
+    if pod_affinity:
+        aff["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": pod_affinity}
+    if pod_anti:
+        aff["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": pod_anti}
+    if node_affinity:
+        aff["nodeAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": node_affinity}]}}
+    obj = {
+        "metadata": {"name": name, "uid": name, "namespace": namespace,
+                     "labels": labels or {}},
+        "spec": {"containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "10m"}}}]},
+        "status": {},
+    }
+    if aff:
+        obj["spec"]["affinity"] = aff
+    if node_name:
+        obj["spec"]["nodeName"] = node_name
+        obj["status"]["phase"] = "Running"
+    return Pod.from_obj(obj)
+
+
+CASES = [
+    ("same topology value nodes admit via existing match",
+     mk_pod("p", {"foo": "bar"},
+            pod_affinity=[pod_term([expr("foo", "In", "bar")], "region")]),
+     [mk_pod("e1", {"foo": "bar"}, node_name="machine1")],
+     [("machine1", RG_CHINA), ("machine2", RG_CHINA_AZ1),
+      ("machine3", RG_INDIA)],
+     {"machine1": True, "machine2": True, "machine3": False}),
+    ("node affinity rejects nodeA, pod affinity admits nodeB",
+     mk_pod("p", pod_affinity=[pod_term([expr("foo", "In", "abc")],
+                                        "region")],
+            node_affinity=[expr("hostname", "NotIn", "h1")]),
+     [mk_pod("e1", {"foo": "abc"}, node_name="nodeA"),
+      mk_pod("e2", {"foo": "def"}, node_name="nodeB")],
+     [("nodeA", {"region": "r1", "hostname": "h1"}),
+      ("nodeB", {"region": "r1", "hostname": "h2"})],
+     {"nodeA": False, "nodeB": True}),
+    ("first pod of a self-matching collection lands anywhere",
+     mk_pod("p", {"foo": "bar", "service": "securityscan"},
+            pod_affinity=[pod_term([expr("foo", "In", "bar")], "zone")]),
+     [],
+     [("nodeA", {"zone": "az1", "hostname": "h1"}),
+      ("nodeB", {"zone": "az2", "hostname": "h2"})],
+     {"nodeA": True, "nodeB": True}),
+    ("existing pod's anti-affinity blocks its whole topology domain",
+     mk_pod("p", {"foo": "abc"}),
+     [mk_pod("e1", {"foo": "bar"}, node_name="nodeA",
+             pod_anti=[pod_term([expr("foo", "In", "abc")], "region")])],
+     [("nodeA", {"region": "r1", "hostname": "nodeA"}),
+      ("nodeB", {"region": "r1", "hostname": "nodeB"})],
+     {"nodeA": False, "nodeB": False}),
+    ("anti-affinity domain blocks China, India stays open",
+     mk_pod("p", {"foo": "abc"}),
+     [mk_pod("e1", {"foo": "bar"}, node_name="nodeA",
+             pod_anti=[pod_term([expr("foo", "In", "abc")], "region")])],
+     [("nodeA", RG_CHINA), ("nodeB", RG_CHINA_AZ1), ("nodeC", RG_INDIA)],
+     {"nodeA": False, "nodeB": False, "nodeC": True}),
+    ("both own and existing anti-affinity block their domains",
+     mk_pod("p", {"foo": "123"},
+            pod_anti=[pod_term([expr("foo", "In", "bar")], "region")]),
+     [mk_pod("e1", {"foo": "bar"}, node_name="nodeA"),
+      mk_pod("e2", {"foo": "456"}, node_name="nodeC",
+             pod_anti=[pod_term([expr("foo", "In", "123")], "region")])],
+     [("nodeA", RG_CHINA), ("nodeB", RG_CHINA_AZ1), ("nodeC", RG_INDIA),
+      ("nodeD", RG_US)],
+     {"nodeA": False, "nodeB": False, "nodeC": False, "nodeD": True}),
+    ("anti-affinity in a different namespace does not block",
+     mk_pod("p", {"foo": "123"}, namespace="NS1",
+            pod_anti=[pod_term([expr("foo", "In", "bar")], "region")]),
+     [mk_pod("e1", {"foo": "bar"}, node_name="nodeA", namespace="NS1"),
+      mk_pod("e2", {"foo": "456"}, node_name="nodeC", namespace="NS2",
+             pod_anti=[pod_term([expr("foo", "In", "123")], "region")])],
+     [("nodeA", RG_CHINA), ("nodeB", RG_CHINA_AZ1), ("nodeC", RG_INDIA)],
+     {"nodeA": False, "nodeB": False, "nodeC": True}),
+]
+
+
+@pytest.mark.parametrize("name,pod,existing,node_specs,fits",
+                         CASES, ids=[c[0] for c in CASES])
+def test_interpod_multinode_golden_host(name, pod, existing, node_specs,
+                                        fits):
+    nodes = [mk_node(n, lb) for n, lb in node_specs]
+    infos = new_node_info_map(nodes, existing)
+    checker = preds.PodAffinityChecker(lambda n: infos.get(n),
+                                       lambda: list(existing))
+    meta = preds.get_predicate_metadata(pod, infos)
+    for node in nodes:
+        ni = infos[node.metadata.name]
+        ok, _ = checker.interpod_affinity_matches(pod, meta, ni)
+        # the upstream fits map is the combined verdict incl. the node
+        # (anti-)affinity predicate (case 2 rejects nodeA via NodeAffinity,
+        # its interpod failure reasons are nil)
+        sel_ok, _ = preds.pod_match_node_selector(pod, meta, ni)
+        ok = ok and sel_ok
+        assert ok == fits[node.metadata.name], (
+            f"{name}: host fit({node.metadata.name})={ok}, "
+            f"want {fits[node.metadata.name]}")
+
+
+@pytest.mark.parametrize("name,pod,existing,node_specs,fits",
+                         CASES, ids=[c[0] for c in CASES])
+def test_interpod_multinode_golden_backends(name, pod, existing, node_specs,
+                                            fits):
+    nodes = [mk_node(n, lb) for n, lb in node_specs]
+    snapshot = ClusterSnapshot(nodes=nodes, pods=existing)
+    allowed = {n for n, ok in fits.items() if ok}
+    results = {}
+    for backend in (ReferenceBackend(), JaxBackend()):
+        [placement] = backend.schedule([pod], snapshot)
+        chosen = placement.pod.spec.node_name
+        results[type(backend).__name__] = chosen
+        if allowed:
+            assert chosen in allowed, (
+                f"{name}: {type(backend).__name__} chose {chosen!r}, "
+                f"allowed {allowed} ({placement.message})")
+        else:
+            assert not chosen, (
+                f"{name}: {type(backend).__name__} scheduled {chosen!r}, "
+                "upstream expects unschedulable everywhere")
+    assert len(set(results.values())) == 1, f"{name}: engines disagree"

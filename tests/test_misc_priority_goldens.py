@@ -1,0 +1,151 @@
+"""Remaining upstream priority golden tables: ImageLocality
+(image_locality_test.go), NodeLabel (node_label_test.go), and
+NodePreferAvoidPods (node_prefer_avoid_pods_test.go), exact scores through
+the host map functions.
+"""
+
+import pytest
+
+from tpusim.api.types import Node, Pod
+from tpusim.engine import priorities as prios
+from tpusim.engine.resources import NodeInfo
+
+MB = 1024 * 1024
+
+
+def image_node(name, images):
+    return Node.from_obj({
+        "metadata": {"name": name},
+        "status": {
+            "capacity": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+            "allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "images": [{"names": names, "sizeBytes": size}
+                       for names, size in images]}})
+
+
+def image_pod(*images):
+    return Pod.from_obj({
+        "metadata": {"name": "p", "uid": "p"},
+        "spec": {"containers": [{"name": f"c{i}", "image": img}
+                                for i, img in enumerate(images)]}})
+
+
+NODE_40_140_2000 = [(["gcr.io/40", "gcr.io/40:v1", "gcr.io/40:v1"], 40 * MB),
+                    (["gcr.io/140", "gcr.io/140:v1"], 140 * MB),
+                    (["gcr.io/2000"], 2000 * MB)]
+NODE_250_10 = [(["gcr.io/250"], 250 * MB),
+               (["gcr.io/10", "gcr.io/10:v1"], 10 * MB)]
+
+IMAGE_CASES = [
+    ("two images spread on two nodes, prefer the larger image one",
+     image_pod("gcr.io/40", "gcr.io/250"), [1, 3]),
+    ("two images on one node, prefer this node",
+     image_pod("gcr.io/40", "gcr.io/140"), [2, 0]),
+    ("if exceed limit, use limit",
+     image_pod("gcr.io/10", "gcr.io/2000"), [10, 0]),
+]
+
+
+@pytest.mark.parametrize("name,pod,expected",
+                         IMAGE_CASES, ids=[c[0] for c in IMAGE_CASES])
+def test_image_locality_priority_golden(name, pod, expected):
+    scores = []
+    for node in (image_node("machine1", NODE_40_140_2000),
+                 image_node("machine2", NODE_250_10)):
+        ni = NodeInfo()
+        ni.set_node(node)
+        scores.append(prios.image_locality_priority_map(pod, None, ni).score)
+    assert scores == expected, f"{name}: {scores} != {expected}"
+
+
+LABEL_NODES = [("machine1", {"foo": "bar"}), ("machine2", {"bar": "foo"}),
+               ("machine3", {"bar": "baz"})]
+
+LABEL_CASES = [
+    ("no match found, presence true", "baz", True, [0, 0, 0]),
+    ("no match found, presence false", "baz", False, [10, 10, 10]),
+    ("one match found, presence true", "foo", True, [10, 0, 0]),
+    ("one match found, presence false", "foo", False, [0, 10, 10]),
+    ("two matches found, presence true", "bar", True, [0, 10, 10]),
+    ("two matches found, presence false", "bar", False, [10, 0, 0]),
+]
+
+
+@pytest.mark.parametrize("name,label,presence,expected",
+                         LABEL_CASES, ids=[c[0] for c in LABEL_CASES])
+def test_node_label_priority_golden(name, label, presence, expected):
+    from tpusim.api.snapshot import make_node, make_pod
+
+    fn = prios.make_node_label_priority_map(label, presence)
+    scores = []
+    for node_name, labels in LABEL_NODES:
+        ni = NodeInfo()
+        ni.set_node(make_node(node_name, labels=dict(labels)))
+        scores.append(fn(make_pod("p"), None, ni).score)
+    assert scores == expected, f"{name}: {scores} != {expected}"
+
+
+AVOID_RC = """
+{"preferAvoidPods": [{"podSignature": {"podController": {
+    "apiVersion": "v1", "kind": "ReplicationController", "name": "foo",
+    "uid": "abcdef123456", "controller": true}},
+  "reason": "some reason", "message": "some message"}]}
+"""
+AVOID_RS = """
+{"preferAvoidPods": [{"podSignature": {"podController": {
+    "apiVersion": "v1", "kind": "ReplicaSet", "name": "foo",
+    "uid": "qwert12345", "controller": true}},
+  "reason": "some reason", "message": "some message"}]}
+"""
+AVOID_ANNOTATION = "scheduler.alpha.kubernetes.io/preferAvoidPods"
+
+
+def avoid_node(name, annotation=None):
+    meta = {"name": name}
+    if annotation:
+        meta["annotations"] = {AVOID_ANNOTATION: annotation}
+    return Node.from_obj({
+        "metadata": meta,
+        "status": {
+            "capacity": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+            "allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def owned_pod(kind, uid, controller=True):
+    ref = {"kind": kind, "name": "foo", "uid": uid}
+    if controller:
+        ref["controller"] = True
+    return Pod.from_obj({
+        "metadata": {"name": "p", "uid": "p", "namespace": "default",
+                     "ownerReferences": [ref]},
+        "spec": {"containers": [{"name": "c"}]}})
+
+
+AVOID_CASES = [
+    ("pod managed by RC avoids annotated node",
+     owned_pod("ReplicationController", "abcdef123456"), [0, 10, 10]),
+    ("random controller kind is ignored",
+     owned_pod("RandomController", "abcdef123456"), [10, 10, 10]),
+    ("owner without Controller flag is ignored",
+     owned_pod("ReplicationController", "abcdef123456", controller=False),
+     [10, 10, 10]),
+    ("pod managed by ReplicaSet avoids its annotated node",
+     owned_pod("ReplicaSet", "qwert12345"), [10, 0, 10]),
+]
+
+
+@pytest.mark.parametrize("name,pod,expected",
+                         AVOID_CASES, ids=[c[0] for c in AVOID_CASES])
+def test_node_prefer_avoid_pods_golden(name, pod, expected):
+    nodes = [avoid_node("machine1", AVOID_RC),
+             avoid_node("machine2", AVOID_RS),
+             avoid_node("machine3")]
+    scores = []
+    for node in nodes:
+        ni = NodeInfo()
+        ni.set_node(node)
+        scores.append(prios.calculate_node_prefer_avoid_pods_priority_map(
+            pod, None, ni).score)
+    assert scores == expected, f"{name}: {scores} != {expected}"

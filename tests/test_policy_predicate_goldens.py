@@ -1,0 +1,113 @@
+"""NodeLabelPresence and ServiceAffinity policy-predicate golden tables
+(predicates_test.go:1393-1460 and :1460-1620), exact fit verdicts and
+failure reasons through the host predicate factories.
+"""
+
+import pytest
+
+from tpusim.api.snapshot import make_node, make_pod
+from tpusim.api.types import Service
+from tpusim.engine import errors as err
+from tpusim.engine import predicates as preds
+from tpusim.engine.resources import NodeInfo
+
+LABEL_PRESENCE_NODE_LABELS = {"foo": "bar", "bar": "foo"}
+
+LABEL_PRESENCE_CASES = [
+    ("label does not match, presence true", ["baz"], True, False),
+    ("label does not match, presence false", ["baz"], False, True),
+    ("one label matches, presence true", ["foo", "baz"], True, False),
+    ("one label matches, presence false", ["foo", "baz"], False, False),
+    ("all labels match, presence true", ["foo", "bar"], True, True),
+    ("all labels match, presence false", ["foo", "bar"], False, False),
+]
+
+
+@pytest.mark.parametrize("name,labels,presence,fits", LABEL_PRESENCE_CASES,
+                         ids=[c[0] for c in LABEL_PRESENCE_CASES])
+def test_node_label_presence_golden(name, labels, presence, fits):
+    ni = NodeInfo()
+    ni.set_node(make_node("n", labels=dict(LABEL_PRESENCE_NODE_LABELS)))
+    check = preds.make_node_label_presence_predicate(labels, presence)
+    ok, reasons = check(make_pod("p"), None, ni)
+    assert ok == fits, f"{name}: fits={ok}, want {fits}"
+    if not fits:
+        assert reasons == [err.ERR_NODE_LABEL_PRESENCE_VIOLATED]
+
+
+SELECTOR = {"foo": "bar"}
+NODES = {
+    "machine1": {"region": "r1", "zone": "z11"},
+    "machine2": {"region": "r1", "zone": "z12"},
+    "machine3": {"region": "r2", "zone": "z21"},
+    "machine4": {"region": "r2", "zone": "z22"},
+    "machine5": {"region": "r2", "zone": "z22"},
+}
+
+
+def sa_pod(name, labels=None, node_selector=None, node="", namespace="default"):
+    return make_pod(name, labels=labels, node_selector=node_selector,
+                    node_name=node, phase="Running" if node else "",
+                    namespace=namespace)
+
+
+def svc(selector=SELECTOR, namespace="default"):
+    return Service.from_obj({
+        "metadata": {"name": "s", "namespace": namespace},
+        "spec": {"selector": dict(selector)}})
+
+
+# (name, pod, existing pods, candidate node, services, affinity labels, fits)
+CASES = [
+    ("nothing scheduled",
+     sa_pod("p"), [], "machine1", [], ["region"], True),
+    ("pod with region label match",
+     sa_pod("p", node_selector={"region": "r1"}), [], "machine1",
+     [], ["region"], True),
+    ("pod with region label mismatch",
+     sa_pod("p", node_selector={"region": "r2"}), [], "machine1",
+     [], ["region"], False),
+    ("service pod on same node",
+     sa_pod("p", SELECTOR), [sa_pod("e", SELECTOR, node="machine1")],
+     "machine1", [svc()], ["region"], True),
+    ("service pod on different node, region match",
+     sa_pod("p", SELECTOR), [sa_pod("e", SELECTOR, node="machine2")],
+     "machine1", [svc()], ["region"], True),
+    ("service pod on different node, region mismatch",
+     sa_pod("p", SELECTOR), [sa_pod("e", SELECTOR, node="machine3")],
+     "machine1", [svc()], ["region"], False),
+    ("service in different namespace, region mismatch",
+     sa_pod("p", SELECTOR, namespace="ns1"),
+     [sa_pod("e", SELECTOR, node="machine3", namespace="ns1")],
+     "machine1", [svc(namespace="ns2")], ["region"], True),
+    ("pod in different namespace, region mismatch",
+     sa_pod("p", SELECTOR, namespace="ns1"),
+     [sa_pod("e", SELECTOR, node="machine3", namespace="ns2")],
+     "machine1", [svc(namespace="ns1")], ["region"], True),
+    ("service and pod in same namespace, region mismatch",
+     sa_pod("p", SELECTOR, namespace="ns1"),
+     [sa_pod("e", SELECTOR, node="machine3", namespace="ns1")],
+     "machine1", [svc(namespace="ns1")], ["region"], False),
+    ("multiple labels, not all match",
+     sa_pod("p", SELECTOR), [sa_pod("e", SELECTOR, node="machine2")],
+     "machine1", [svc()], ["region", "zone"], False),
+    ("multiple labels, all match",
+     sa_pod("p", SELECTOR), [sa_pod("e", SELECTOR, node="machine5")],
+     "machine4", [svc()], ["region", "zone"], True),
+]
+
+
+@pytest.mark.parametrize("name,pod,existing,node_name,services,labels,fits",
+                         CASES, ids=[c[0] for c in CASES])
+def test_service_affinity_golden(name, pod, existing, node_name, services,
+                                 labels, fits):
+    nodes = {n: make_node(n, labels=dict(lb)) for n, lb in NODES.items()}
+    ni = NodeInfo()
+    ni.set_node(nodes[node_name])
+    check = preds.make_service_affinity_predicate(
+        labels, lambda: list(existing), lambda: list(services),
+        lambda n: nodes.get(n))
+    ok, reasons = check(pod, None, ni)
+    assert ok == fits, f"{name}: fits={ok}, want {fits} ({reasons})"
+    if not fits:
+        assert reasons == [err.ERR_SERVICE_AFFINITY_VIOLATED]

@@ -1,0 +1,183 @@
+"""TestInterPodAffinityPriority golden table (interpod_affinity_test.go:
+42-528): exact upstream score lists through the host
+InterPodAffinityPriority with the default hard-affinity symmetric weight
+(1), covering preferred affinity/anti-affinity, both symmetry directions,
+and their combination.
+"""
+
+import pytest
+
+from tpusim.api.snapshot import make_node
+from tpusim.api.types import Pod
+from tpusim.engine.priorities import InterPodAffinityPriority
+from tpusim.engine.resources import new_node_info_map
+
+RG_CHINA = {"region": "China"}
+RG_INDIA = {"region": "India"}
+AZ_AZ1 = {"az": "az1"}
+AZ_AZ2 = {"az": "az2"}
+RG_CHINA_AZ1 = {"region": "China", "az": "az1"}
+S1 = {"security": "S1"}
+S2 = {"security": "S2"}
+
+
+def weighted(weight, exprs, topo):
+    return {"weight": weight, "podAffinityTerm": {
+        "labelSelector": {"matchExpressions": exprs}, "topologyKey": topo}}
+
+
+def expr(key, op, *values):
+    e = {"key": key, "operator": op}
+    if values:
+        e["values"] = list(values)
+    return e
+
+
+STAY_S1_REGION = {"podAffinity": {
+    "preferredDuringSchedulingIgnoredDuringExecution": [
+        weighted(5, [expr("security", "In", "S1")], "region")]}}
+STAY_S2_REGION = {"podAffinity": {
+    "preferredDuringSchedulingIgnoredDuringExecution": [
+        weighted(6, [expr("security", "In", "S2")], "region")]}}
+AFFINITY3 = {"podAffinity": {
+    "preferredDuringSchedulingIgnoredDuringExecution": [
+        weighted(8, [expr("security", "NotIn", "S1"),
+                     expr("security", "In", "S2")], "region"),
+        weighted(2, [expr("security", "Exists"),
+                     expr("wrongkey", "DoesNotExist")], "region")]}}
+HARD_AFFINITY = {"podAffinity": {
+    "requiredDuringSchedulingIgnoredDuringExecution": [
+        {"labelSelector": {"matchExpressions": [
+            expr("security", "In", "S1", "value2")]},
+         "topologyKey": "region"},
+        {"labelSelector": {"matchExpressions": [
+            expr("security", "Exists"), expr("wrongkey", "DoesNotExist")]},
+         "topologyKey": "region"}]}}
+AWAY_S1_AZ = {"podAntiAffinity": {
+    "preferredDuringSchedulingIgnoredDuringExecution": [
+        weighted(5, [expr("security", "In", "S1")], "az")]}}
+AWAY_S2_AZ = {"podAntiAffinity": {
+    "preferredDuringSchedulingIgnoredDuringExecution": [
+        weighted(5, [expr("security", "In", "S2")], "az")]}}
+STAY_S1_AWAY_S2 = {
+    "podAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+        weighted(8, [expr("security", "In", "S1")], "region")]},
+    "podAntiAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+        weighted(5, [expr("security", "In", "S2")], "az")]}}
+
+
+def mk_pod(name, labels=None, affinity=None, node=""):
+    obj = {"metadata": {"name": name, "uid": name, "namespace": "default",
+                        "labels": labels or {}},
+           "spec": {"containers": [{"name": "c"}]}, "status": {}}
+    if affinity:
+        obj["spec"]["affinity"] = affinity
+    if node:
+        obj["spec"]["nodeName"] = node
+        obj["status"]["phase"] = "Running"
+    return Pod.from_obj(obj)
+
+
+CASES = [
+    ("all machines same priority, nil affinity",
+     mk_pod("p", S1), [],
+     [("machine1", RG_CHINA), ("machine2", RG_INDIA), ("machine3", AZ_AZ1)],
+     [0, 0, 0]),
+    ("matching topology and pods score high",
+     mk_pod("p", S1, STAY_S1_REGION),
+     [mk_pod("e1", S1, node="machine1"), mk_pod("e2", S2, node="machine2"),
+      mk_pod("e3", S1, node="machine3")],
+     [("machine1", RG_CHINA), ("machine2", RG_INDIA), ("machine3", AZ_AZ1)],
+     [10, 0, 0]),
+    ("same topology value shares the score",
+     mk_pod("p", None, STAY_S1_REGION),
+     [mk_pod("e1", S1, node="machine1")],
+     [("machine1", RG_CHINA), ("machine2", RG_CHINA_AZ1),
+      ("machine3", RG_INDIA)],
+     [10, 10, 0]),
+    ("region with more matching pods scores higher",
+     mk_pod("p", S1, STAY_S2_REGION),
+     [mk_pod("e1", S2, node="machine1"), mk_pod("e2", S2, node="machine1"),
+      mk_pod("e3", S2, node="machine2"), mk_pod("e4", S2, node="machine3"),
+      mk_pod("e5", S2, node="machine4"), mk_pod("e6", S2, node="machine5")],
+     [("machine1", RG_CHINA), ("machine2", RG_INDIA),
+      ("machine3", RG_CHINA), ("machine4", RG_CHINA),
+      ("machine5", RG_INDIA)],
+     [10, 5, 10, 10, 5]),
+    ("mixed operators with some match failures",
+     mk_pod("p", S1, AFFINITY3),
+     [mk_pod("e1", S1, node="machine1"), mk_pod("e2", S2, node="machine2"),
+      mk_pod("e3", S1, node="machine3")],
+     [("machine1", RG_CHINA), ("machine2", RG_INDIA), ("machine3", AZ_AZ1)],
+     [2, 10, 0]),
+    ("preferred affinity symmetry",
+     mk_pod("p", S2),
+     [mk_pod("e1", S1, STAY_S1_REGION, node="machine1"),
+      mk_pod("e2", S2, STAY_S2_REGION, node="machine2")],
+     [("machine1", RG_CHINA), ("machine2", RG_INDIA), ("machine3", AZ_AZ1)],
+     [0, 10, 0]),
+    ("required affinity symmetry (hard weight)",
+     mk_pod("p", S1),
+     [mk_pod("e1", S1, HARD_AFFINITY, node="machine1"),
+      mk_pod("e2", S2, HARD_AFFINITY, node="machine2")],
+     [("machine1", RG_CHINA), ("machine2", RG_INDIA), ("machine3", AZ_AZ1)],
+     [10, 10, 0]),
+    ("anti-affinity: non-matching node scores high",
+     mk_pod("p", S1, AWAY_S1_AZ),
+     [mk_pod("e1", S1, node="machine1"), mk_pod("e2", S2, node="machine2")],
+     [("machine1", AZ_AZ1), ("machine2", RG_CHINA)],
+     [0, 10]),
+    ("anti-affinity: missing topology key means no repulsion",
+     mk_pod("p", S1, AWAY_S1_AZ),
+     [mk_pod("e1", S1, node="machine1"), mk_pod("e2", S1, node="machine2")],
+     [("machine1", AZ_AZ1), ("machine2", RG_CHINA)],
+     [0, 10]),
+    ("anti-affinity: more matches, lower score",
+     mk_pod("p", S1, AWAY_S1_AZ),
+     [mk_pod("e1", S1, node="machine1"), mk_pod("e2", S1, node="machine1"),
+      mk_pod("e3", S2, node="machine2")],
+     [("machine1", AZ_AZ1), ("machine2", RG_INDIA)],
+     [0, 10]),
+    ("anti-affinity symmetry",
+     mk_pod("p", S2),
+     [mk_pod("e1", S1, AWAY_S2_AZ, node="machine1"),
+      mk_pod("e2", S2, AWAY_S1_AZ, node="machine2")],
+     [("machine1", AZ_AZ1), ("machine2", AZ_AZ2)],
+     [0, 10]),
+    ("affinity and anti-affinity combined",
+     mk_pod("p", S1, STAY_S1_AWAY_S2),
+     [mk_pod("e1", S1, node="machine1"), mk_pod("e2", S1, node="machine2")],
+     [("machine1", RG_CHINA), ("machine2", AZ_AZ1)],
+     [10, 0]),
+    ("affinity dominates with same labels everywhere",
+     mk_pod("p", S1, STAY_S1_AWAY_S2),
+     [mk_pod("e1", S1, node="machine1"), mk_pod("e2", S1, node="machine1"),
+      mk_pod("e3", S1, node="machine2"), mk_pod("e4", S1, node="machine3"),
+      mk_pod("e5", S1, node="machine3"), mk_pod("e6", S1, node="machine4"),
+      mk_pod("e7", S1, node="machine5")],
+     [("machine1", RG_CHINA_AZ1), ("machine2", RG_INDIA),
+      ("machine3", RG_CHINA), ("machine4", RG_CHINA),
+      ("machine5", RG_INDIA)],
+     [10, 4, 10, 10, 4]),
+    ("affinity, anti-affinity, and both symmetry directions",
+     mk_pod("p", S1, STAY_S1_AWAY_S2),
+     [mk_pod("e1", S1, node="machine1"), mk_pod("e2", S2, node="machine2"),
+      mk_pod("e3", None, STAY_S1_AWAY_S2, node="machine3"),
+      mk_pod("e4", None, AWAY_S1_AZ, node="machine4")],
+     [("machine1", RG_CHINA), ("machine2", AZ_AZ1),
+      ("machine3", RG_INDIA), ("machine4", AZ_AZ2)],
+     [10, 0, 10, 0]),
+]
+
+
+@pytest.mark.parametrize("name,pod,existing,node_specs,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_inter_pod_affinity_priority_golden(name, pod, existing, node_specs,
+                                            expected):
+    nodes = [make_node(n, labels=dict(lb)) for n, lb in node_specs]
+    infos = new_node_info_map(nodes, existing)
+    prio = InterPodAffinityPriority(lambda n: infos.get(n),
+                                    hard_pod_affinity_weight=1)
+    result = prio.calculate(pod, infos, nodes)
+    scores = [hp.score for hp in result]
+    assert scores == expected, f"{name}: {scores} != {expected}"

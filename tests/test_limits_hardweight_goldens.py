@@ -1,0 +1,99 @@
+"""Final upstream golden tables: ResourceLimits
+(resource_limits_test.go:100-140) and HardPodAffinitySymmetricWeight
+(interpod_affinity_test.go:529-600).
+"""
+
+import pytest
+
+from tpusim.api.snapshot import make_node
+from tpusim.api.types import Node, Pod
+from tpusim.engine import priorities as prios
+from tpusim.engine.resources import NodeInfo, new_node_info_map
+
+
+def limits_pod(*containers):
+    return Pod.from_obj({
+        "metadata": {"name": "p", "uid": "p"},
+        "spec": {"containers": [
+            {"name": f"c{i}", "resources": {"limits": dict(lim)}}
+            for i, lim in enumerate(containers)]}})
+
+
+def plain_node(name, milli_cpu, mem):
+    alloc = {"pods": "110"}
+    if milli_cpu:
+        alloc["cpu"] = f"{milli_cpu}m"
+    if mem:
+        alloc["memory"] = str(mem)
+    return Node.from_obj({
+        "metadata": {"name": name},
+        "status": {"capacity": dict(alloc), "allocatable": dict(alloc),
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+CPU_ONLY = limits_pod({"cpu": "1000m", "memory": "0"},
+                      {"cpu": "2000m", "memory": "0"})
+MEM_ONLY = limits_pod({"cpu": "0", "memory": "2000"},
+                      {"cpu": "0", "memory": "3000"})
+CPU_AND_MEM = limits_pod({"cpu": "1000m", "memory": "2000"},
+                         {"cpu": "2000m", "memory": "3000"})
+
+LIMITS_CASES = [
+    ("pod does not specify its resource limits", limits_pod(),
+     [("machine1", 4000, 10000), ("machine2", 4000, 0),
+      ("machine3", 0, 10000), ("machine4", 0, 0)], [0, 0, 0, 0]),
+    ("pod only specifies cpu limits", CPU_ONLY,
+     [("machine1", 3000, 10000), ("machine2", 2000, 10000)], [1, 0]),
+    ("pod only specifies mem limits", MEM_ONLY,
+     [("machine1", 4000, 4000), ("machine2", 5000, 10000)], [0, 1]),
+    ("pod specifies both cpu and mem limits", CPU_AND_MEM,
+     [("machine1", 4000, 4000), ("machine2", 5000, 10000)], [1, 1]),
+    ("node does not advertise its allocatables", CPU_AND_MEM,
+     [("machine1", 0, 0)], [0]),
+]
+
+
+@pytest.mark.parametrize("name,pod,node_specs,expected",
+                         LIMITS_CASES, ids=[c[0] for c in LIMITS_CASES])
+def test_resource_limits_priority_golden(name, pod, node_specs, expected):
+    scores = []
+    for node_name, cpu, mem in node_specs:
+        ni = NodeInfo()
+        ni.set_node(plain_node(node_name, cpu, mem))
+        scores.append(prios.resource_limits_priority_map(pod, None, ni).score)
+    assert scores == expected, f"{name}: {scores} != {expected}"
+
+
+HARD_AFFINITY = {"podAffinity": {
+    "requiredDuringSchedulingIgnoredDuringExecution": [
+        {"labelSelector": {"matchExpressions": [
+            {"key": "service", "operator": "In", "values": ["S1"]}]},
+         "topologyKey": "region"}]}}
+
+
+def sym_pod(name, labels=None, affinity=None, node=""):
+    obj = {"metadata": {"name": name, "uid": name, "namespace": "default",
+                        "labels": labels or {}},
+           "spec": {"containers": [{"name": "c"}]}, "status": {}}
+    if affinity:
+        obj["spec"]["affinity"] = affinity
+    if node:
+        obj["spec"]["nodeName"] = node
+        obj["status"]["phase"] = "Running"
+    return Pod.from_obj(obj)
+
+
+@pytest.mark.parametrize("hard_weight,expected", [(1, [10, 10, 0]),
+                                                  (0, [0, 0, 0])])
+def test_hard_pod_affinity_symmetric_weight_golden(hard_weight, expected):
+    pod = sym_pod("p", {"service": "S1"})
+    existing = [sym_pod("e1", None, HARD_AFFINITY, node="machine1"),
+                sym_pod("e2", None, HARD_AFFINITY, node="machine2")]
+    nodes = [make_node("machine1", labels={"region": "China"}),
+             make_node("machine2", labels={"region": "India"}),
+             make_node("machine3", labels={"az": "az1"})]
+    infos = new_node_info_map(nodes, existing)
+    prio = prios.InterPodAffinityPriority(
+        lambda n: infos.get(n), hard_pod_affinity_weight=hard_weight)
+    scores = [hp.score for hp in prio.calculate(pod, infos, nodes)]
+    assert scores == expected

@@ -1,0 +1,73 @@
+"""Predicate-metadata incremental contract (metadata_test.go:134+): after
+AddPod/RemovePod, the metadata must equal what a fresh computation over the
+modified cluster produces — the property preemption's what-if victim
+simulations rest on.
+"""
+
+import random
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.engine import predicates as preds
+from tpusim.engine.resources import new_node_info_map
+
+
+def anti_pod(name, labels, node, topo="kubernetes.io/hostname",
+             sel=None):
+    pod = make_pod(name, labels=labels, node_name=node, phase="Running")
+    if sel is not None:
+        from tpusim.api.types import Affinity
+
+        pod.spec.affinity = Affinity.from_obj({
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": sel},
+                     "topologyKey": topo}]}})
+    return pod
+
+
+def meta_key_view(meta):
+    return {k: sorted((t.term.topology_key, t.node.metadata.name)
+                      for t in v)
+            for k, v in meta.matching_anti_affinity_terms.items() if v}
+
+
+def test_add_then_remove_restores_fresh_metadata():
+    rng = random.Random(0)
+    nodes = [make_node(f"n{i}", labels={"zone": f"z{i % 2}"})
+             for i in range(4)]
+    existing = []
+    for i in range(8):
+        sel = {"app": "web"} if i % 3 == 0 else None
+        existing.append(anti_pod(f"e{i}", {"app": rng.choice(["web", "db"])},
+                                 f"n{i % 4}", sel=sel))
+    target = make_pod("p", labels={"app": "web"})
+    infos = new_node_info_map(nodes, existing)
+
+    incoming = anti_pod("new", {"app": "db"}, "n1", sel={"app": "web"})
+
+    # fresh metadata over cluster+incoming == incremental add_pod
+    fresh_infos = new_node_info_map(nodes, existing + [incoming])
+    fresh = preds.get_predicate_metadata(target, fresh_infos)
+    incr = preds.get_predicate_metadata(target, infos)
+    incr.add_pod(incoming, nodes[1])
+    assert meta_key_view(incr) == meta_key_view(fresh)
+
+    # removing it again restores the original metadata
+    incr.remove_pod(incoming)
+    base = preds.get_predicate_metadata(target, infos)
+    assert meta_key_view(incr) == meta_key_view(base)
+
+
+def test_shallow_copy_isolates_add_remove():
+    nodes = [make_node("n0"), make_node("n1")]
+    existing = [anti_pod("e0", {"app": "db"}, "n0", sel={"app": "web"})]
+    target = make_pod("p", labels={"app": "web"})
+    infos = new_node_info_map(nodes, existing)
+    meta = preds.get_predicate_metadata(target, infos)
+    copy = meta.shallow_copy()
+    copy.add_pod(anti_pod("x", {"app": "db"}, "n1", sel={"app": "web"}),
+                 nodes[1])
+    assert meta_key_view(copy) != meta_key_view(meta)
+    copy.remove_pod(existing[0])
+    # the original still sees e0's matching term after the copy's removal
+    assert any("e0" in k for k in meta.matching_anti_affinity_terms)

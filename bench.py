@@ -24,9 +24,19 @@ sequential semantics. `python bench.py --ladder` measures all five configs
 taints+affinity via the chunked donated scan; 50×20k batched what-if) and
 prints one JSON line per config plus a summary line.
 
+Before any measurement attempt the parent runs a PRE-FLIGHT PROBE: one tiny
+device op in a subprocess under TPUSIM_BENCH_PROBE_TIMEOUT (40s). A wedged
+tunnel therefore costs under a minute before a cleanly-labeled CPU fallback
+("tpu_unavailable"), instead of burning the full retry ladder. Children are
+never SIGKILLed while possibly inside a device op: SIGINT first, then
+SIGTERM after a grace period, SIGKILL only as a last resort (a hard kill
+mid-op has permanently wedged the tunnel before; see BASELINE.md).
+
 Env knobs: TPUSIM_BENCH_PODS (default 100000), TPUSIM_BENCH_NODES (5000),
 TPUSIM_BENCH_BASELINE_PODS (200), TPUSIM_BENCH_BATCH (0 = exact scan),
-TPUSIM_BENCH_STALL_TIMEOUT (240s), TPUSIM_BENCH_RUN_TIMEOUT (2400s),
+TPUSIM_BENCH_STALL_TIMEOUT (240s), TPUSIM_BENCH_INIT_TIMEOUT (75s — stall
+limit until the child reports its device list), TPUSIM_BENCH_PROBE_TIMEOUT
+(40s), TPUSIM_BENCH_RUN_TIMEOUT (2400s),
 TPUSIM_BENCH_RETRIES (2), TPUSIM_BENCH_CPU_PODS/_NODES (CPU-fallback shape),
 TPUSIM_BENCH_CHUNK (131072; chunked-scan chunk length — the 100k headline runs as ONE dispatch, 1M runs 8 chunks of ~12s each, inside the stall watchdog), TPUSIM_SCAN_UNROLL,
 TPUSIM_BENCH_LADDER_CONFIGS (ladder subset, e.g. "3,5"), TPUSIM_FAST=1
@@ -39,6 +49,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -150,15 +161,11 @@ def _checksum(choices) -> int:
 def _run_once(config, carry, statics, xs, batch: int, chunk: int):
     """One full scheduling pass; returns (choices np, checksum int, counts).
 
-    Batches longer than `chunk` run through the donated-carry chunked
-    scan (bounded HBM churn, progress logging)."""
-    import jax.numpy as jnp
-
+    Batches longer than `chunk` run through the double-buffered donated-carry
+    chunked scan (bounded HBM churn, overlapped transfers, progress logging)."""
     from tpusim.jaxe.kernels import (
-        PodX,
-        pad_infeasible_rows,
         schedule_scan,
-        schedule_scan_donated,
+        schedule_scan_chunked,
         schedule_wavefront,
     )
 
@@ -166,31 +173,15 @@ def _run_once(config, carry, statics, xs, batch: int, chunk: int):
     if batch > 0:
         _, choices, counts, _ = schedule_wavefront(config, carry, statics, xs, batch)
     elif chunk and p > chunk:
-        xs_host = xs  # host columns (measure_config keeps big batches on host)
-        pad = (-p) % chunk
-        if pad:
-            xs_host = pad_infeasible_rows(xs_host, pad)
-        num_chunks = (p + pad) // chunk
-        choice_parts, count_parts = [], []
         t0 = time.perf_counter()
-        pending = None  # previous chunk's device choices, fetched one late:
-        # dispatch is async, so chunk i executes while chunk i-1's result
-        # crosses back to host (the fetch is the only host sync in the loop)
-        for ci in range(num_chunks):
-            sl = slice(ci * chunk, (ci + 1) * chunk)
-            xs_c = PodX(*(jnp.asarray(a[sl]) for a in xs_host))
-            carry, ch, cnt, _ = schedule_scan_donated(config, carry, statics, xs_c)
-            count_parts.append(cnt)
-            if pending is not None:
-                choice_parts.append(np.asarray(pending))  # forces chunk ci-1
-                log(f"  chunk {ci}/{num_chunks}: {ci * chunk}/{p} pods done, "
-                    f"next dispatched ({time.perf_counter() - t0:.1f}s)")
-            pending = ch
-        choice_parts.append(np.asarray(pending))
-        log(f"  chunk {num_chunks}/{num_chunks}: {p}/{p} pods done "
-            f"({time.perf_counter() - t0:.1f}s)")
-        choices = np.concatenate(choice_parts)[:p]
-        counts = np.concatenate([np.asarray(c) for c in count_parts])[:p]
+
+        def prog(ci, total, done):
+            log(f"  chunk {ci}/{total}: {done}/{p} pods done "
+                f"({time.perf_counter() - t0:.1f}s)")
+
+        # xs holds host columns (measure_config keeps big batches on host)
+        _, choices, counts, _ = schedule_scan_chunked(
+            config, carry, statics, xs, chunk, progress=prog)
         return choices, _checksum(choices), counts
     else:
         _, choices, counts, _ = schedule_scan(config, carry, statics, xs)
@@ -625,10 +616,68 @@ def run_phases(platform: str, chunk: int) -> None:
 # parent: watchdogged child with retries + CPU fallback
 # --------------------------------------------------------------------------
 
-def run_watchdogged(cmd, stall_timeout: float, total_timeout: float):
-    """Run `cmd`, streaming its stderr; kill it if no output arrives for
-    `stall_timeout` seconds or the total exceeds `total_timeout`. Returns
-    (json_lines_from_stdout, error | None) — partial results from a killed
+def _graceful_stop(proc, reason: str) -> None:
+    """Stop a child that may be inside a TPU device op. NEVER SIGKILL first:
+    a hard kill mid-op has permanently wedged the axon tunnel for every later
+    process (BASELINE.md). SIGINT lets the JAX runtime unwind; SIGTERM's
+    kernel-side default disposition works even with the GIL held in C++;
+    SIGKILL is the last resort for a truly unkillable child."""
+    log(f"  stopping child ({reason}): SIGINT")
+    try:
+        proc.send_signal(signal.SIGINT)
+    except OSError:
+        return  # already gone
+    try:
+        proc.wait(timeout=15)
+        return
+    except subprocess.TimeoutExpired:
+        pass
+    log("  child ignored SIGINT; SIGTERM")
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+        return
+    except subprocess.TimeoutExpired:
+        pass
+    log("  child ignored SIGTERM; SIGKILL (last resort)")
+    proc.kill()
+
+
+def preflight_probe(timeout: float):
+    """One tiny device op in a throwaway subprocess; returns the resolved
+    platform string, or None if the op didn't complete within `timeout`
+    (wedged tunnel / hung backend init). Keeps the main attempts from ever
+    touching a dead tunnel."""
+    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+            "print('PROBE', d[0].platform, int(jnp.ones((8, 8)).sum()), "
+            "flush=True)")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _graceful_stop(proc, f"probe exceeded {timeout:.0f}s")
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return None
+    for line in (out or "").splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "PROBE" and parts[2] == "64":
+            return parts[1]
+    return None
+
+
+def run_watchdogged(cmd, stall_timeout: float, total_timeout: float,
+                    init_timeout: float | None = None):
+    """Run `cmd`, streaming its stderr; stop it if no output arrives for
+    `stall_timeout` seconds or the total exceeds `total_timeout`. Until the
+    child reports its device list ("devices:" line) the tighter
+    `init_timeout` applies — backend-init wedges are the tunnel's known
+    failure mode and deserve fast detection. Returns
+    (json_lines_from_stdout, error | None) — partial results from a stopped
     child still count. Per-stream reader threads feed a queue so a child
     that wedges mid-line (or bursts multiple lines) can neither block the
     watchdog nor strand buffered output."""
@@ -654,15 +703,18 @@ def run_watchdogged(cmd, stall_timeout: float, total_timeout: float):
     json_lines = []
     error = None
     open_streams = 2
+    init_done = False
     while open_streams:
         now = time.monotonic()
-        if now - last_output > stall_timeout:
-            error = f"no output for {stall_timeout:.0f}s (stalled); killed"
-            proc.kill()
+        limit = stall_timeout if init_done else (init_timeout or stall_timeout)
+        if now - last_output > limit:
+            phase = "stalled" if init_done else "backend-init stall"
+            error = f"no output for {limit:.0f}s ({phase}); stopped"
+            _graceful_stop(proc, error)
             break
         if now - start > total_timeout:
-            error = f"exceeded total timeout {total_timeout:.0f}s; killed"
-            proc.kill()
+            error = f"exceeded total timeout {total_timeout:.0f}s; stopped"
+            _graceful_stop(proc, error)
             break
         try:
             tag, line = q.get(timeout=5.0)
@@ -672,6 +724,8 @@ def run_watchdogged(cmd, stall_timeout: float, total_timeout: float):
             open_streams -= 1
             continue
         last_output = time.monotonic()
+        if tag == "err" and line.startswith("devices:"):
+            init_done = True
         if tag == "out":
             if line.strip().startswith("{"):
                 try:
@@ -721,12 +775,37 @@ def main() -> None:
     if ladder:
         _ladder_configs()  # validate the knob before spawning any child
 
+    # persistent XLA compile cache for every child (config 5's per-process
+    # ~2min compile becomes a one-time cost); TPUSIM_COMPILE_CACHE="" disables
+    os.environ.setdefault(
+        "TPUSIM_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+
     stall_timeout = float(os.environ.get("TPUSIM_BENCH_STALL_TIMEOUT", 240))
+    init_timeout = float(os.environ.get("TPUSIM_BENCH_INIT_TIMEOUT", 75))
+    probe_timeout = float(os.environ.get("TPUSIM_BENCH_PROBE_TIMEOUT", 40))
     run_timeout = float(os.environ.get("TPUSIM_BENCH_RUN_TIMEOUT", 2400))
     retries = int(os.environ.get("TPUSIM_BENCH_RETRIES", 2))
 
     errors: list[str] = []
-    attempts = [("default", a) for a in range(1, retries + 1)] + [("cpu", 1)]
+    log(f"pre-flight probe (timeout {probe_timeout:.0f}s)...")
+    t0 = time.monotonic()
+    probed = preflight_probe(probe_timeout)
+    if probed is None:
+        errors.append(f"tpu_unavailable: pre-flight device op did not "
+                      f"complete within {probe_timeout:.0f}s; CPU fallback")
+        log(f"probe FAILED after {time.monotonic() - t0:.0f}s "
+            "(wedged tunnel / hung backend init); skipping straight to CPU")
+        attempts = [("cpu", 1)]
+    else:
+        log(f"probe OK: platform={probed} ({time.monotonic() - t0:.0f}s)")
+        if probed == "cpu":
+            # the default backend already resolves to CPU (no accelerator or
+            # its plugin failed init cleanly) — no point in default attempts
+            attempts = [("cpu", 1)]
+        else:
+            attempts = ([("default", a) for a in range(1, retries + 1)]
+                        + [("cpu", 1)])
     for target, attempt in attempts:
         log(f"benchmark on {target!r} (attempt {attempt}, "
             f"stall timeout {stall_timeout:.0f}s, total {run_timeout:.0f}s)")
@@ -735,7 +814,8 @@ def main() -> None:
             cmd.append("--ladder")
         if phases:
             cmd.append("--phases")
-        json_lines, err = run_watchdogged(cmd, stall_timeout, run_timeout)
+        json_lines, err = run_watchdogged(cmd, stall_timeout, run_timeout,
+                                          init_timeout=init_timeout)
         if json_lines:
             if ladder:
                 # one line per completed config, then the HEADLINE config

@@ -228,6 +228,9 @@ def run_what_if_cli(args) -> int:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    # (An env-level JAX_PLATFORMS=cpu pin is honored by the import-time guard
+    # in tpusim/jaxe/__init__.py — every jax-using path imports that module
+    # before backend init, so no duplicate check is needed here.)
     if args.platform:
         import jax
 

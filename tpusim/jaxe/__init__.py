@@ -23,7 +23,39 @@ Integer/float precision: scores use int64 (Go int); BalancedResourceAllocation
 uses float64 exactly like Go. Memory quantities are byte-exact int64.
 """
 
+import logging as _logging
+import os
+
 import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # Honor an env-level CPU-only pin before ANY backend init. Axon-style TPU
+    # plugins force-append themselves over JAX_PLATFORMS, so the env var alone
+    # does not stop jax.devices() from initializing (and blocking on) the TPU
+    # tunnel; the config knob set pre-init does. Exact-match only: a priority
+    # list like "tpu,cpu" means "prefer the accelerator" and must pass
+    # through untouched. No-op if backends are already up (a host app that
+    # imported jax first keeps its own platform choice).
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as exc:
+        _logging.getLogger(__name__).warning(
+            "could not honor JAX_PLATFORMS=cpu via jax config: %s", exc)
+
+_cache_dir = os.environ.get("TPUSIM_COMPILE_CACHE", "")
+if _cache_dir:
+    # Persistent XLA compilation cache (opt-in): the what-if path compiles a
+    # fresh vmap(snapshots)×scan(pods) program per shape (~2min at the
+    # BASELINE.json config-5 shape) — cache it on disk so every later process
+    # pays a cache hit instead. Keyed by HLO + compile options, so a shape
+    # change recompiles naturally.
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as exc:
+        _logging.getLogger(__name__).warning(
+            "TPUSIM_COMPILE_CACHE=%s requested but the persistent compile "
+            "cache could not be enabled: %s", _cache_dir, exc)
 
 _x64_enabled = False
 

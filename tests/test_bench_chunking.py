@@ -48,3 +48,17 @@ def test_chunk_equal_to_pod_count_is_unchunked(workload):
     b = _schedule(snapshot, pods, 0)
     assert a[1] == b[1]
     assert np.array_equal(a[0], b[0])
+
+
+def test_backend_routes_big_batches_through_chunked_scan(workload, monkeypatch):
+    """JaxBackend must hand >TPUSIM_SCAN_CHUNK batches to the chunked scan
+    with placements bit-identical to the single dispatch."""
+    from tpusim.jaxe.backend import JaxBackend
+
+    snapshot, pods = workload
+    monkeypatch.delenv("TPUSIM_SCAN_CHUNK", raising=False)
+    unchunked = JaxBackend().schedule(pods, snapshot)
+    monkeypatch.setenv("TPUSIM_SCAN_CHUNK", "1024")
+    chunked = JaxBackend().schedule(pods, snapshot)
+    assert [p.node_name for p in chunked] == [p.node_name for p in unchunked]
+    assert [p.message for p in chunked] == [p.message for p in unchunked]

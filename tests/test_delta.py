@@ -248,3 +248,117 @@ def test_ingest_from_watch_fabric():
                  make_pod("e0", milli_cpu=800, node_name="n1", phase="Running"))
     inc.ingest(pod_buf)
     assert_equiv(inc, [make_pod("q", milli_cpu=500)])
+
+
+# ---------------------------------------------------------------------------
+# Volumes on the incremental path (round-2 VERDICT item 10): PV/PVC events
+# drive jaxe/delta.py with NO reference-engine fallback (fallback="error").
+# ---------------------------------------------------------------------------
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def _volume_cluster() -> IncrementalCluster:
+    from tpusim.api.snapshot import make_pv, make_pvc
+
+    nodes = [make_node(f"n{i}", milli_cpu=4000, memory=8 * 1024**3,
+                       labels={ZONE: f"zone-{i % 2}"}) for i in range(4)]
+    pvs = [make_pv(f"pv-{z}", labels={ZONE: f"zone-{z}"},
+                   source={"gcePersistentDisk": {"pdName": f"disk-{z}"}})
+           for z in range(2)]
+    pvcs = [make_pvc(f"claim-{z}", volume_name=f"pv-{z}") for z in range(2)]
+    return IncrementalCluster(ClusterSnapshot(nodes=nodes, pvs=pvs, pvcs=pvcs))
+
+
+def _volume_probe():
+    from tpusim.api.snapshot import make_pod_volume
+
+    gce = make_pod_volume("d", source={"gcePersistentDisk": {"pdName": "shared"}})
+    return [
+        make_pod("vq0", milli_cpu=100,
+                 volumes=[make_pod_volume("v", pvc="claim-0")]),  # zone conflict
+        make_pod("vq1", milli_cpu=100,
+                 volumes=[make_pod_volume("v", pvc="claim-1")]),
+        make_pod("vq2", milli_cpu=100, volumes=[gce]),  # NoDiskConflict probe
+        make_pod("vq3", milli_cpu=100),
+    ]
+
+
+def test_volume_pods_schedule_incrementally_without_fallback():
+    inc = _volume_cluster()
+    probe = _volume_probe()
+    placements = assert_equiv(inc, probe)  # fallback="error": no host engine
+    # zone-labeled PVs must pin each claim's pod to its zone
+    assert placements[0].node_name in ("n0", "n2")
+    assert placements[1].node_name in ("n1", "n3")
+
+
+def test_pv_pvc_events_invalidate_volume_tables():
+    from tpusim.api.snapshot import make_pod_volume, make_pv, make_pvc
+
+    inc = _volume_cluster()
+    probe = _volume_probe()
+    assert_equiv(inc, probe)
+
+    # a placed pod occupying the shared GCE disk forces NoDiskConflict
+    occupant = make_pod(
+        "occupant", milli_cpu=100, node_name="n3", phase="Running",
+        volumes=[make_pod_volume("d",
+                                 source={"gcePersistentDisk":
+                                         {"pdName": "shared"}})])
+    inc.apply(ADDED, occupant)
+    placements = assert_equiv(inc, probe)
+    assert placements[2].node_name != "n3"
+
+    # rebind claim-0 to the other zone's PV via PVC + PV events
+    inc.apply(ADDED, make_pv("pv-moved", labels={ZONE: "zone-1"},
+                             source={"gcePersistentDisk":
+                                     {"pdName": "disk-moved"}}))
+    inc.apply(MODIFIED, make_pvc("claim-0", volume_name="pv-moved"))
+    placements = assert_equiv(inc, probe)
+    assert placements[0].node_name in ("n1", "n3")
+
+    # deleting the PV after rebinding the claim back: tables must re-derive
+    # from the surviving PV set (an unresolved claim against zone-constrained
+    # nodes is host-bound on the FRESH path too, so rebind first)
+    inc.apply(MODIFIED, make_pvc("claim-0", volume_name="pv-0"))
+    inc.apply(DELETED, make_pv("pv-moved"))
+    placements = assert_equiv(inc, probe)
+    assert placements[0].node_name in ("n0", "n2")
+
+    inc.apply(DELETED, occupant)
+    assert_equiv(inc, probe)
+
+
+def test_pv_pvc_events_through_event_log_loader(tmp_path):
+    import json as _json
+
+    from tpusim.framework.events import load_event_log
+
+    frames = [
+        {"type": "Added", "object": {
+            "kind": "PersistentVolume",
+            "metadata": {"name": "pv-x", "labels": {ZONE: "zone-0"}},
+            "spec": {"capacity": {"storage": "1Gi"},
+                     "gcePersistentDisk": {"pdName": "x"}}}},
+        {"type": "Added", "object": {
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "claim-x", "namespace": "default"},
+            "spec": {"volumeName": "pv-x",
+                     "resources": {"requests": {"storage": "1Gi"}}}}},
+    ]
+    log_path = tmp_path / "events.jsonl"
+    log_path.write_text("\n".join(_json.dumps(f) for f in frames) + "\n")
+    events = load_event_log(str(log_path))
+    assert len(events) == 2
+
+    inc = IncrementalCluster(ClusterSnapshot(
+        nodes=[make_node(f"n{i}", milli_cpu=2000,
+                         labels={ZONE: f"zone-{i}"}) for i in range(2)]))
+    inc.apply_events(events)
+    from tpusim.api.snapshot import make_pod_volume
+
+    probe = [make_pod("q", milli_cpu=100,
+                      volumes=[make_pod_volume("v", pvc="claim-x")])]
+    placements = assert_equiv(inc, probe)
+    assert placements[0].node_name == "n0"

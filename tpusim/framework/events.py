@@ -86,10 +86,18 @@ def load_event_log(path: str) -> list:
     jaxe.delta.IncrementalCluster.apply_events / run_simulation(events=...)."""
     import io
 
-    from tpusim.api.types import Node, Pod, Service
+    from tpusim.api.types import (
+        Node,
+        PersistentVolume,
+        PersistentVolumeClaim,
+        Pod,
+        Service,
+    )
     from tpusim.framework.store import DELETED, MODIFIED
 
-    kinds = {"Pod": Pod, "Node": Node, "Service": Service}
+    kinds = {"Pod": Pod, "Node": Node, "Service": Service,
+             "PersistentVolume": PersistentVolume,
+             "PersistentVolumeClaim": PersistentVolumeClaim}
     valid = {ADDED, MODIFIED, DELETED}
     events = []
     with io.open(path, "r", encoding="utf-8") as f:
@@ -113,7 +121,8 @@ def load_event_log(path: str) -> list:
             if cls is None:
                 raise ValueError(f"{path}:{lineno}: unsupported object kind "
                                  f"{obj.get('kind')!r} (expected Pod/Node/"
-                                 "Service)")
+                                 "Service/PersistentVolume/"
+                                 "PersistentVolumeClaim)")
             try:
                 events.append((event_type, cls.from_obj(obj)))
             except (TypeError, AttributeError, KeyError) as exc:

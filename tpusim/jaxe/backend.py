@@ -18,10 +18,11 @@ or raise (fallback="error"): pod-group budget overruns (merged groups >
 TPUSIM_MAX_GROUPS, raw signatures > TPUSIM_MAX_RAW_GROUPS, matcher precompute
 > TPUSIM_MAX_MATCH_WORK, presence bytes > TPUSIM_MAX_PRESENCE_BYTES — groups
 merge by match profile first, so only behaviorally distinct classes count),
-volume workloads on the INCREMENTAL path only (state.volume_unsupported —
-fresh compiles evaluate the volume predicates natively), and the host-bound
-policy shapes listed in jaxe/policyc.py (extenders, multiple ServiceAffinity
-entries, duplicate-reason alwaysCheckAllPredicates).
+unresolvable PVC references on zone-constrained clusters (the reference's
+NoVolumeZoneConflict *errors* host-side there), and the host-bound policy
+shapes listed in jaxe/policyc.py (extenders, multiple ServiceAffinity
+entries, duplicate-reason alwaysCheckAllPredicates). Volume workloads run
+natively on BOTH the fresh and incremental (event-log) paths.
 """
 
 from __future__ import annotations
@@ -45,7 +46,9 @@ from tpusim.jaxe.kernels import (
     carry_init,
     config_for,
     pod_columns_to_device,
+    pod_columns_to_host,
     schedule_scan,
+    schedule_scan_chunked,
     schedule_wavefront,
     statics_to_device,
 )
@@ -252,11 +255,21 @@ class JaxBackend:
                 host_statics = host_statics._replace(
                     sa_self_ok=sa_self_ok, sa_unres=sa_unres, sa_val=sa_val)
             statics = _tree_to_device(host_statics)
+        # Batches beyond TPUSIM_SCAN_CHUNK pods run through the
+        # double-buffered chunked scan: pod columns stay host-side and stream
+        # to HBM chunk by chunk, bit-identical to the single dispatch
+        # (SURVEY.md §7 hard part 6 — 1M-pod batches).
+        import os as _os
+
+        scan_chunk = int(_os.environ.get("TPUSIM_SCAN_CHUNK", 131072))
+        use_chunks = (fplan is None and self.batch_size == 0
+                      and scan_chunk > 0 and len(pods) > scan_chunk)
         if fplan is None:
             carry = carry_init(compiled)
             if sa_lock_init is not None:
                 carry = carry._replace(sa_lock=sa_lock_init)
-            xs = pod_columns_to_device(cols)
+            xs = (pod_columns_to_host(cols) if use_chunks
+                  else pod_columns_to_device(cols))
         # On TPU the per-pod filter→score→select→bind pipeline is one fused
         # device program, so the whole batch dispatch lands in the algorithm
         # histogram (the per-phase split of metrics.go has no device analog);
@@ -273,6 +286,9 @@ class JaxBackend:
         elif self.batch_size > 0:
             _, choices, counts, _ = schedule_wavefront(config, carry, statics,
                                                        xs, self.batch_size)
+        elif use_chunks:
+            _, choices, counts, _ = schedule_scan_chunked(
+                config, carry, statics, xs, scan_chunk)
         else:
             _, choices, counts, _ = schedule_scan(config, carry, statics, xs)
         choices = np.asarray(choices)

@@ -42,7 +42,13 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 from tpusim.api.snapshot import ClusterSnapshot
-from tpusim.api.types import Node, Pod, Service
+from tpusim.api.types import (
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    Service,
+)
 from tpusim.engine.resources import (
     NodeInfo,
     get_nonzero_pod_request,
@@ -67,7 +73,6 @@ from tpusim.jaxe.state import (
     fill_pod_request_row,
     node_static_row,
     signature_row_fns,
-    volume_unsupported,
 )
 
 _SIG_KINDS = (
@@ -103,6 +108,14 @@ class IncrementalCluster:
         snapshot = snapshot or ClusterSnapshot()
         self.nodes: List[Node] = list(snapshot.nodes)
         self.services: List[Service] = list(snapshot.services)
+        # PV/PVC state: volume tables (disk-conflict/MaxPD/zone) are part of
+        # the group tables and rebuild from to_snapshot() when dirty, so
+        # carrying the objects here is all the incremental path needs to
+        # evaluate the volume predicates natively (no reference fallback)
+        self.pvs: Dict[str, PersistentVolume] = {pv.name: pv
+                                                 for pv in snapshot.pvs}
+        self.pvcs: Dict[str, PersistentVolumeClaim] = {pvc.key(): pvc
+                                                       for pvc in snapshot.pvcs}
         self._pods: Dict[str, Pod] = {p.key(): p for p in snapshot.pods}
         # node name -> keys of pods claiming it (placed or parked); lets node
         # events touch only their own pods instead of scanning all P
@@ -145,7 +158,9 @@ class IncrementalCluster:
         """The equivalent point-in-time ClusterSnapshot (shared objects)."""
         return ClusterSnapshot(nodes=list(self.nodes),
                                pods=list(self._pods.values()),
-                               services=list(self.services))
+                               services=list(self.services),
+                               pvs=list(self.pvs.values()),
+                               pvcs=list(self.pvcs.values()))
 
     # -- node-side caches ---------------------------------------------------
 
@@ -276,6 +291,10 @@ class IncrementalCluster:
             self._apply_node(event_type, obj)
         elif isinstance(obj, Service):
             self._apply_service(event_type, obj)
+        elif isinstance(obj, PersistentVolume):
+            self._apply_pv(event_type, obj)
+        elif isinstance(obj, PersistentVolumeClaim):
+            self._apply_pvc(event_type, obj)
         else:
             raise TypeError(f"unsupported event object: {type(obj).__name__}")
 
@@ -316,6 +335,11 @@ class IncrementalCluster:
             if p is not None and p.spec.node_name \
                     and p.spec.node_name not in self._node_index:
                 self._groups_dirty = True
+            # a placed pod's volumes feed used_vols_init [N, V] (NoDiskConflict
+            # occupancy, MaxPD counts), which lives in the cached group tables
+            # — no scatter path exists for it, so rebuild
+            if p is not None and p.spec.volumes:
+                self._groups_dirty = True
 
     def _apply_node(self, event_type: str, node: Node) -> None:
         self._groups_dirty = True  # topology/zone domains follow the node set
@@ -338,6 +362,27 @@ class IncrementalCluster:
                          if (s.namespace, s.name) != (svc.namespace, svc.name)]
         if event_type in (ADDED, MODIFIED):
             self.services.append(svc)
+
+    def _apply_pv(self, event_type: str, pv: PersistentVolume) -> None:
+        # MaxPD volume-id resolution and zone tables read PV objects; any PV
+        # churn invalidates them (factory.go wires the same PV handlers to
+        # ecache invalidation, factory.go:139-299)
+        self._groups_dirty = True
+        if event_type == DELETED:
+            self.pvs.pop(pv.name, None)
+        elif event_type in (ADDED, MODIFIED):
+            self.pvs[pv.name] = pv
+        else:
+            raise ValueError(f"unknown event type {event_type!r}")
+
+    def _apply_pvc(self, event_type: str, pvc: PersistentVolumeClaim) -> None:
+        self._groups_dirty = True
+        if event_type == DELETED:
+            self.pvcs.pop(pvc.key(), None)
+        elif event_type in (ADDED, MODIFIED):
+            self.pvcs[pvc.key()] = pvc
+        else:
+            raise ValueError(f"unknown event type {event_type!r}")
 
     # -- node column patches ------------------------------------------------
 
@@ -526,16 +571,17 @@ class IncrementalCluster:
         if (self._groups_dirty or self._groups is None
                 or batch_group_keys != self._groups_batch_keys):
             snapshot = self.to_snapshot()
-            # vol_meta is unused: the incremental path carries no PV/PVC state
-            # and volume workloads route through volume_unsupported below
             (groups, has_ports, has_services, has_interpod, n_topo, n_zone,
-             unsupported, sig_to_gid, _vol_meta) = _compile_groups(
+             unsupported, sig_to_gid, vol_meta) = _compile_groups(
                  snapshot, pods, self.nodes, self._node_index)
             self._groups = groups
             self._groups_meta = (has_ports, has_services, has_interpod,
-                                 n_topo, n_zone, unsupported)
+                                 n_topo, n_zone, unsupported, vol_meta)
             self._groups_batch_keys = batch_group_keys
-            self._groups_active = has_ports or has_services or has_interpod
+            # volume flags count: disk_sig[G]/vol_mask[G, V] key off group
+            # ids, so volume-only workloads still need real group_id columns
+            self._groups_active = (has_ports or has_services or has_interpod
+                                   or any(vol_meta[:3]))
             self._presence = groups.presence
             # raw canonical signature -> MERGED group id, as produced by
             # _compile_groups' profile merge; an unseen signature later marks
@@ -543,8 +589,9 @@ class IncrementalCluster:
             self._groups_sig_keys = dict(sig_to_gid)
             self._groups_dirty = False
         groups = self._groups
-        has_ports, has_services, has_interpod, n_topo, n_zone, unsupported = \
-            self._groups_meta
+        (has_ports, has_services, has_interpod, n_topo, n_zone, unsupported,
+         vol_meta) = self._groups_meta
+        has_disk_conflict, has_maxpd, has_vol_zone, maxpd_limits = vol_meta
         if self._groups_active and not unsupported:
             group_id = np.fromiter(
                 (self._groups_sig_keys[_key(_group_signature(pod))]
@@ -572,16 +619,16 @@ class IncrementalCluster:
             nonzero_mem=dyn.nonzero_mem.copy(),
             pod_count=dyn.pod_count.copy())
 
-        unsupported = list(unsupported)
-        unsupported.extend(volume_unsupported(pods, self._pods.values()))
         compiled = CompiledCluster(
             statics=statics_out, tables=tables, groups=groups_out,
             dynamic=dyn_out, scalar_names=list(self._scalar_names),
             node_index=dict(self._node_index),
             has_ports=has_ports, has_services=has_services,
             has_interpod=has_interpod, has_noexec_table=need_noexec,
+            has_disk_conflict=has_disk_conflict, has_maxpd=has_maxpd,
+            has_vol_zone=has_vol_zone, maxpd_limits=maxpd_limits,
             n_topo_doms=n_topo, n_zone_doms=n_zone,
-            unsupported=unsupported)
+            unsupported=list(unsupported))
         return compiled, cols
 
     # -- scheduling ---------------------------------------------------------

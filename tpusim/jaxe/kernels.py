@@ -1082,6 +1082,57 @@ schedule_scan_donated = jax.jit(_schedule_scan_impl,
                                 donate_argnums=(1,))
 
 
+def schedule_scan_chunked(config: EngineConfig, carry: Carry, statics: Statics,
+                          xs_host: PodX, chunk: int, progress=None):
+    """Exact sequential scan over a pod batch too large for one dispatch,
+    with double-buffered transfers (SURVEY.md §7 hard part 6).
+
+    `xs_host` holds host-numpy pod columns; the full [P]-row PodX never lands
+    in HBM at once. Per iteration the host loop (a) dispatches chunk t on the
+    donated carry, (b) immediately enqueues the async upload of chunk t+1's
+    columns, and (c) only then fetches chunk t-1's choices — so the one host
+    sync per iteration overlaps with chunk t's device compute, and the upload
+    rides the same overlap window instead of serializing with dispatch.
+    Placements are bit-identical to the unchunked scan (the carry crosses
+    chunk boundaries untouched; padding rows are infeasible no-ops).
+
+    Returns (final_carry, choices[P], counts[P, bits], advanced[P]) with the
+    result arrays as host numpy."""
+    p = int(xs_host.req_cpu.shape[0])
+    pad = (-p) % chunk
+    if pad:
+        xs_host = pad_infeasible_rows(xs_host, pad)
+    num_chunks = (p + pad) // chunk
+
+    def upload(ci):
+        sl = slice(ci * chunk, (ci + 1) * chunk)
+        return jax.device_put(PodX(*(a[sl] for a in xs_host)))
+
+    choice_parts, count_parts, adv_parts = [], [], []
+    pending = None
+    nxt = upload(0)
+    for ci in range(num_chunks):
+        xs_c = nxt
+        carry, ch, cnt, adv = schedule_scan_donated(config, carry, statics,
+                                                    xs_c)
+        if ci + 1 < num_chunks:
+            nxt = upload(ci + 1)
+        count_parts.append(cnt)
+        adv_parts.append(adv)
+        if pending is not None:
+            choice_parts.append(np.asarray(pending))  # forces chunk ci-1
+            if progress is not None:
+                progress(ci, num_chunks, ci * chunk)
+        pending = ch
+    choice_parts.append(np.asarray(pending))
+    if progress is not None:
+        progress(num_chunks, num_chunks, p)
+    choices = np.concatenate(choice_parts)[:p]
+    counts = np.concatenate([np.asarray(c) for c in count_parts])[:p]
+    advanced = np.concatenate([np.asarray(a) for a in adv_parts])[:p]
+    return carry, choices, counts, advanced
+
+
 def pad_infeasible_rows(xs, pad: int):
     """Append `pad` PodX rows that fail PodFitsResources on every node
     (req_cpu = 2^61 exceeds any allocatable): no carry mutation, no rr

@@ -131,17 +131,6 @@ def _group_budgets():
             env_int("TPUSIM_MAX_PRESENCE_BYTES", MAX_PRESENCE_BYTES))
 
 
-def volume_unsupported(new_pods: List[Pod], cluster_pods) -> List[str]:
-    """Volume fallback for the INCREMENTAL path only: IncrementalCluster does
-    not ingest PV/PVC events, so it cannot resolve claims; fresh compiles
-    (compile_cluster) evaluate the volume predicates natively on device."""
-    if any(p.spec.volumes for p in new_pods) \
-            or any(p.spec.volumes for p in cluster_pods):
-        return ["pod volumes (the incremental event-log path carries no "
-                "PV/PVC state)"]
-    return []
-
-
 _DICT_TAG = object()  # can never equal any JSON value
 
 

@@ -180,3 +180,59 @@ def test_ineligible_workloads_report_reasons():
     plan, reason = plan_fast(config, compiled, cols)
     assert plan is None
     assert "has_interpod" in reason
+
+
+def test_scalar_resources_eligible_and_exact():
+    """Round-3 eligibility expansion: scalar (extended) resources run on the
+    fast path with bit-identical placements and reason histograms."""
+    nodes = [make_node(f"n{i}", milli_cpu=4000, memory=8 * 1024**3,
+                       scalars={"example.com/widget": 4 - i % 3,
+                                "example.com/gadget": 1000 * (1 + i % 2)})
+             for i in range(12)]
+    running = [make_pod(f"r{i}", milli_cpu=200, memory=2**26,
+                        node_name=f"n{i}", phase="Running",
+                        scalars={"example.com/widget": 1})
+               for i in range(4)]
+    pods = []
+    for i in range(60):
+        kw = {}
+        if i % 2 == 0:
+            kw["scalars"] = {"example.com/widget": 1 + i % 3}
+        elif i % 5 == 0:
+            kw["scalars"] = {"example.com/gadget": 700}
+        pods.append(make_pod(f"p{i}", milli_cpu=300, memory=2**27, **kw))
+    choices = _diff(ClusterSnapshot(nodes=nodes, pods=running), pods)
+    assert 0 < int(np.sum(choices >= 0)) < len(pods)  # widget exhaustion hits
+
+
+def test_scalar_reason_bits_match_reference_strings():
+    """The scalar failure bit decodes to the exact reference reason string."""
+    from tpusim.jaxe.backend import format_fit_error
+    from tpusim.jaxe.state import reason_strings
+
+    nodes = [make_node("n0", milli_cpu=4000, scalars={"example.com/widget": 1})]
+    pods = [make_pod(f"p{i}", milli_cpu=100,
+                     scalars={"example.com/widget": 1}) for i in range(3)]
+    compiled, cols = compile_cluster(ClusterSnapshot(nodes=nodes), pods)
+    config = config_for(
+        [compiled], most_requested=False,
+        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+    plan, reason = plan_fast(config, compiled, cols)
+    assert plan is not None, reason
+    f_choices, f_counts, _ = fast_scan(plan)
+    assert (f_choices >= 0).tolist() == [True, False, False]
+    msg = format_fit_error(1, f_counts[1], reason_strings(compiled.scalar_names))
+    assert "Insufficient example.com/widget" in msg
+
+
+def test_too_many_scalar_kinds_fall_back():
+    scal = {f"example.com/r{i}": 1 for i in range(8)}  # > 6-bit budget
+    nodes = [make_node("n0", scalars=scal)]
+    pods = [make_pod("p0", milli_cpu=100, scalars=scal)]
+    compiled, cols = compile_cluster(ClusterSnapshot(nodes=nodes), pods)
+    config = config_for(
+        [compiled], most_requested=False,
+        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+    plan, reason = plan_fast(config, compiled, cols)
+    assert plan is None
+    assert "reason-bit budget" in reason

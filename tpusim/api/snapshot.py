@@ -106,6 +106,7 @@ def make_node(
     taints: Optional[list] = None,
     unschedulable: bool = False,
     ready: bool = True,
+    scalars: Optional[dict] = None,
 ) -> Node:
     """Build a schedulable node fixture (reference: pkg/main.go:200-231 newSampleNode)."""
     cpu = f"{milli_cpu}m"
@@ -121,6 +122,9 @@ def make_node(
     if gpus:
         obj["status"]["capacity"]["alpha.kubernetes.io/nvidia-gpu"] = str(gpus)
         obj["status"]["allocatable"]["alpha.kubernetes.io/nvidia-gpu"] = str(gpus)
+    for res, qty in (scalars or {}).items():
+        obj["status"]["capacity"][res] = str(qty)
+        obj["status"]["allocatable"][res] = str(qty)
     if unschedulable:
         obj["spec"]["unschedulable"] = True
     if taints:
@@ -141,6 +145,7 @@ def make_pod(
     tolerations: Optional[list] = None,
     affinity: Optional[dict] = None,
     volumes: Optional[list] = None,
+    scalars: Optional[dict] = None,
 ) -> Pod:
     """Build a pod fixture (reference: pkg/main.go:189-198 newSamplePod)."""
     requests = {}
@@ -150,6 +155,8 @@ def make_pod(
         requests["memory"] = str(memory)
     if gpus:
         requests["alpha.kubernetes.io/nvidia-gpu"] = str(gpus)
+    for res, qty in (scalars or {}).items():
+        requests[res] = str(qty)
     obj = {
         "metadata": {"name": name, "namespace": namespace, "uid": name,
                      "labels": labels or {}},

@@ -15,14 +15,19 @@ tests drive both); ineligible workloads fall back to `schedule_scan`.
 Eligibility (checked by `plan_fast`, reasons returned):
   * no pod-group features — host ports, services/spreading, inter-pod
     (anti)affinity, volume predicates (`EngineConfig.has_*` all False), no
-    scalar resources, no policy, no ServiceAffinity;
+    policy, no ServiceAffinity;
   * every resource quantity reduces exactly to int32: values are divided by
     the per-axis gcd (exact — fractions and fit comparisons are
     unit-invariant) and the reduced values must stay under 2^29, with the
     BalancedResourceAllocation product bound 10*max_cpu*max_mem < 2^31
     (Mosaic has no 64-bit integers, so the kernel is int32 throughout;
     DEVIATIONS.md #16's exactness contract is preserved because the reduced
-    arithmetic never overflows).
+    arithmetic never overflows);
+  * scalar (extended) resources ARE eligible: each scalar axis gcd-reduces
+    independently like cpu/mem (PodFitsResources treats every scalar as one
+    more fit column, predicates.go:706-776), and its failure bit rides at
+    NUM_FIXED_BITS+s — at most PAD_SENTINEL_BIT-NUM_FIXED_BITS (=6) scalar
+    kinds fit the int32 reason word; more falls back to the XLA scan.
 
 Reference mapping (same as kernels._evaluate for this subset):
   CheckNodeCondition/Unschedulable -> cond_fail_bits stage
@@ -79,8 +84,9 @@ from tpusim.jaxe.state import (
 
 INT_LIMIT = 1 << 29          # per-value bound after gcd reduction
 GHOST_REQ = 1 << 30          # > any reduced allocatable: never feasible
-PAD_SENTINEL_BIT = 30        # cond bit for padded nodes; >= NUM_FIXED_BITS
+PAD_SENTINEL_BIT = 30        # cond bit for padded nodes; >= last scalar bit
 LANES = 128
+SUBLANES = 8                 # scalar-axis row padding (TPU sublane tile)
 
 
 @dataclass
@@ -90,6 +96,7 @@ class FastPlan:
     num_nodes: int           # real nodes (pad rows follow)
     num_pods: int
     most_requested: bool
+    num_scalars: int         # scalar-resource kinds (0 = no scalar args)
     # statics [1, Npad]
     alloc_cpu: np.ndarray
     alloc_mem: np.ndarray
@@ -128,6 +135,10 @@ class FastPlan:
     aff_id: np.ndarray
     avoid_id: np.ndarray
     host_id: np.ndarray
+    # scalar resources (present when num_scalars > 0)
+    alloc_scalar: Optional[np.ndarray] = None   # [Srows, Npad]
+    used_scalar: Optional[np.ndarray] = None    # [Srows, Npad] init carry
+    req_scalar: Optional[np.ndarray] = None     # [P, S]; chunks pad to LANES
 
 
 def _gcd_reduce(arrays) -> Tuple[int, list]:
@@ -150,8 +161,11 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
                  "has_disk_conflict", "has_maxpd", "has_vol_zone"):
         if getattr(config, flag):
             return None, f"pod-group feature {flag}"
-    if compiled.scalar_names:
-        return None, "scalar resources"
+    n_scal = len(compiled.scalar_names)
+    if NUM_FIXED_BITS + n_scal > PAD_SENTINEL_BIT:
+        return None, (f"{n_scal} scalar resource kinds exceed the int32 "
+                      f"reason-bit budget "
+                      f"({PAD_SENTINEL_BIT - NUM_FIXED_BITS})")
     s, t, d = compiled.statics, compiled.tables, compiled.dynamic
 
     g_cpu, (ac, rc, nzc, uc, nzuc) = _gcd_reduce(
@@ -160,10 +174,23 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
         [s.alloc_mem, cols.req_mem, cols.nz_mem, d.used_mem, d.nonzero_mem])
     g_gpu, (ag, rg, ug) = _gcd_reduce([s.alloc_gpu, cols.req_gpu, d.used_gpu])
     g_eph, (ae, re_, ue) = _gcd_reduce([s.alloc_eph, cols.req_eph, d.used_eph])
+    # each scalar axis reduces independently (fit comparisons never mix axes)
+    scal_cols = []
+    if n_scal:
+        ascal = np.asarray(s.alloc_scalar, dtype=np.int64).reshape(-1, n_scal)
+        rscal = np.asarray(cols.req_scalar, dtype=np.int64).reshape(-1, n_scal)
+        uscal = np.asarray(d.used_scalar, dtype=np.int64).reshape(-1, n_scal)
+        for si in range(n_scal):
+            _, (a_s, r_s, u_s) = _gcd_reduce(
+                [ascal[:, si], rscal[:, si], uscal[:, si]])
+            scal_cols.append((a_s, r_s, u_s))
 
-    for name, arrs in (("cpu", (ac, rc, nzc, uc, nzuc)),
-                       ("memory", (am, rm, nzm, um, nzum)),
-                       ("gpu", (ag, rg, ug)), ("ephemeral", (ae, re_, ue))):
+    checks = [("cpu", (ac, rc, nzc, uc, nzuc)),
+              ("memory", (am, rm, nzm, um, nzum)),
+              ("gpu", (ag, rg, ug)), ("ephemeral", (ae, re_, ue))]
+    checks += [(compiled.scalar_names[si], scal_cols[si])
+               for si in range(n_scal)]
+    for name, arrs in checks:
         for a in arrs:
             if a.size and int(a.max(initial=0)) >= INT_LIMIT:
                 return None, f"{name} values exceed int32 after gcd reduction"
@@ -208,9 +235,23 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     def pods(a):
         return np.asarray(a, dtype=np.int64).astype(np.int32)
 
+    alloc_scalar = used_scalar = req_scalar = None
+    if n_scal:
+        srows = -(-n_scal // SUBLANES) * SUBLANES
+        alloc_scalar = np.zeros((srows, npad), dtype=np.int32)
+        used_scalar = np.zeros((srows, npad), dtype=np.int32)
+        p_count = rscal.shape[0]
+        req_scalar = np.zeros((p_count, n_scal), dtype=np.int32)
+        for si, (a_s, r_s, u_s) in enumerate(scal_cols):
+            alloc_scalar[si, :n] = a_s.astype(np.int32)
+            used_scalar[si, :n] = u_s.astype(np.int32)
+            req_scalar[:, si] = r_s.astype(np.int32)
+
     plan = FastPlan(
         num_nodes=n, num_pods=len(np.asarray(cols.req_cpu)),
-        most_requested=config.most_requested,
+        most_requested=config.most_requested, num_scalars=n_scal,
+        alloc_scalar=alloc_scalar, used_scalar=used_scalar,
+        req_scalar=req_scalar,
         alloc_cpu=node_row(ac), alloc_mem=node_row(am),
         alloc_gpu=node_row(ag), alloc_eph=node_row(ae),
         allowed=node_row(s.allowed_pods), cond_bits=cond,
@@ -243,13 +284,21 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
 # ---------------------------------------------------------------------------
 
 
-def _make_kernel(most_requested: bool, num_bits: int):
-    def kernel(rc_r, rm_r, rg_r, re_r, nzc_r, nzm_r, zr_r, be_r,
-               sel_r, tol_r, intol_r, aff_r, av_r, host_r,
-               acpu_r, amem_r, agpu_r, aeph_r, allowed_r, cond_r, mpr_r, dpr_r,
-               iuc_r, ium_r, iug_r, iue_r, inzc_r, inzm_r, ipc_r, imisc_r,
-               ouc_r, oum_r, oug_r, oue_r, onzc_r, onzm_r, opc_r, omisc_r,
-               choice_r, counts_r, adv_r):
+def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int):
+    def kernel(*refs):
+        (rc_r, rm_r, rg_r, re_r, nzc_r, nzm_r, zr_r, be_r,
+         sel_r, tol_r, intol_r, aff_r, av_r, host_r,
+         acpu_r, amem_r, agpu_r, aeph_r, allowed_r, cond_r, mpr_r, dpr_r,
+         iuc_r, ium_r, iug_r, iue_r, inzc_r, inzm_r, ipc_r,
+         imisc_r) = refs[:30]
+        at = 30
+        if num_scalars:
+            rs_r, ascal_r, ius_r = refs[at:at + 3]
+            at += 3
+        (ouc_r, oum_r, oug_r, oue_r, onzc_r, onzm_r, opc_r, omisc_r,
+         choice_r, counts_r, adv_r) = refs[at:at + 11]
+        if num_scalars:
+            ous_r = refs[at + 11]
         p = pl.program_id(0)
 
         @pl.when(p == 0)
@@ -262,6 +311,8 @@ def _make_kernel(most_requested: bool, num_bits: int):
             onzm_r[:] = inzm_r[:]
             opc_r[:] = ipc_r[:]
             omisc_r[:] = imisc_r[:]
+            if num_scalars:
+                ous_r[:] = ius_r[:]
 
         rc = rc_r[0, 0]
         rm = rm_r[0, 0]
@@ -294,6 +345,16 @@ def _make_kernel(most_requested: bool, num_bits: int):
         insuff_eph = check_res & (aeph_r[:] < used_e + re)
         fail_res = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
                     | insuff_eph)
+        scalar_bits = None
+        if num_scalars:
+            asc = ascal_r[:]
+            us = ous_r[:]
+            for si in range(num_scalars):
+                ins = check_res & (asc[si:si + 1, :]
+                                   < us[si:si + 1, :] + rs_r[0, si])
+                fail_res = fail_res | ins
+                bit = ins.astype(jnp.int32) << (NUM_FIXED_BITS + si)
+                scalar_bits = bit if scalar_bits is None else scalar_bits | bit
         host_bad = host_r[:] == 0
         sel_bad = sel_r[:] == 0
         fail_general = fail_res | host_bad | sel_bad
@@ -305,6 +366,8 @@ def _make_kernel(most_requested: bool, num_bits: int):
             | insuff_eph.astype(jnp.int32) << BIT_INSUFFICIENT_EPHEMERAL
             | host_bad.astype(jnp.int32) << BIT_HOSTNAME_MISMATCH
             | sel_bad.astype(jnp.int32) << BIT_NODE_SELECTOR_MISMATCH)
+        if scalar_bits is not None:
+            bits_general = bits_general | scalar_bits
         fail_taint = tol_r[:] == 0
         fail_mem_pr = (mpr_r[:] != 0) & best_effort
         fail_disk_pr = dpr_r[:] != 0
@@ -389,6 +452,9 @@ def _make_kernel(most_requested: bool, num_bits: int):
             onzc_r[0, i] = nz_c[0, i] + nzc
             onzm_r[0, i] = nz_m[0, i] + nzm
             opc_r[0, i] = pc[0, i] + 1
+            if num_scalars:
+                for si in range(num_scalars):
+                    ous_r[si, i] = us[si, i] + rs_r[0, si]
 
         omisc_r[0, 0] = rr + (n_feasible > 1).astype(jnp.int32)
 
@@ -397,22 +463,27 @@ def _make_kernel(most_requested: bool, num_bits: int):
 
 @lru_cache(maxsize=16)
 def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
-                counts_w: int, interpret: bool):
-    """jitted pallas_call for one (node-pad, chunk) shape."""
-    kernel = _make_kernel(most_requested, num_bits)
+                counts_w: int, num_scalars: int, srows: int, interpret: bool):
+    """jitted pallas_call for one (node-pad, chunk, scalar) shape."""
+    kernel = _make_kernel(most_requested, num_bits, num_scalars)
 
     def smem_scalar():
         return pl.BlockSpec((1, 1), lambda p: (p, 0), memory_space=_SMEM) \
             if _SMEM is not None else pl.BlockSpec((1, 1), lambda p: (p, 0))
 
-    def row_per_pod():
+    def row_per_pod(width=None):
         kw = {"memory_space": _VMEM} if _VMEM is not None else {}
-        return pl.BlockSpec((1, npad), lambda p: (p, 0), **kw)
+        return pl.BlockSpec((1, width or npad), lambda p: (p, 0), **kw)
 
-    def const_row(width=None):
+    def const_row(width=None, rows=1):
         kw = {"memory_space": _VMEM} if _VMEM is not None else {}
-        return pl.BlockSpec((1, width or npad), lambda p: (0, 0), **kw)
+        return pl.BlockSpec((rows, width or npad), lambda p: (0, 0), **kw)
 
+    scalar_in = ([row_per_pod(LANES),            # req_scalar row per pod
+                  const_row(rows=srows),         # alloc_scalar
+                  const_row(rows=srows)]         # init used_scalar
+                 if num_scalars else [])
+    scalar_out = [const_row(rows=srows)] if num_scalars else []
     grid_spec = pl.GridSpec(
         grid=(k,),
         in_specs=(
@@ -421,6 +492,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
             + [const_row() for _ in range(8)]           # statics
             + [const_row() for _ in range(7)]           # init carry
             + [const_row(LANES)]                        # init misc (rr)
+            + scalar_in
         ),
         out_specs=(
             [const_row() for _ in range(7)]             # carry out
@@ -431,6 +503,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
                             **({"memory_space": _VMEM} if _VMEM else {}))]
             + [pl.BlockSpec((1, 1), lambda p: (p, 0),
                             **({"memory_space": _VMEM} if _VMEM else {}))]
+            + scalar_out
         ),
     )
     i32 = jnp.int32
@@ -440,6 +513,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
         + [jax.ShapeDtypeStruct((k, 1), i32),
            jax.ShapeDtypeStruct((k, counts_w), i32),
            jax.ShapeDtypeStruct((k, 1), i32)]
+        + ([jax.ShapeDtypeStruct((srows, npad), i32)] if num_scalars else [])
     )
     call = pl.pallas_call(kernel, grid_spec=grid_spec,
                           out_shape=out_shape, interpret=interpret)
@@ -466,11 +540,12 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     chunk = max(chunk, 1)
     p = plan.num_pods
     npad = plan.alloc_cpu.shape[1]
-    num_bits = NUM_FIXED_BITS
+    num_bits = NUM_FIXED_BITS + plan.num_scalars
     counts_w = LANES  # lane-aligned histogram row; decode slices [:num_bits]
+    srows = plan.alloc_scalar.shape[0] if plan.num_scalars else 0
     k = min(chunk, max(p, 1))
     call = _build_call(npad, k, plan.most_requested, num_bits, counts_w,
-                       interpret)
+                       plan.num_scalars, srows, interpret)
 
     statics = [jnp.asarray(a) for a in (
         plan.alloc_cpu, plan.alloc_mem, plan.alloc_gpu, plan.alloc_eph,
@@ -482,6 +557,9 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
         plan.used_cpu, plan.used_mem, plan.used_gpu, plan.used_eph,
         plan.nonzero_cpu, plan.nonzero_mem, plan.pod_count)]
     misc = jnp.zeros((1, LANES), dtype=jnp.int32)
+    if plan.num_scalars:
+        ascal = jnp.asarray(plan.alloc_scalar)
+        scal_carry = jnp.asarray(plan.used_scalar)
 
     def col(a, fill):
         out = np.full(k, fill, dtype=np.int32)
@@ -512,9 +590,15 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
                 + [sel_rows, tol_rows, intol_rows, aff_rows, av_rows,
                    host_rows]
                 + statics + carry + [misc])
+        if plan.num_scalars:
+            rs = np.zeros((k, LANES), dtype=np.int32)
+            rs[:sl.stop - sl.start, :plan.num_scalars] = plan.req_scalar[sl]
+            args += [jnp.asarray(rs), ascal, scal_carry]
         out = call(*args)
         carry = list(out[:7])
         misc = out[7]
+        if plan.num_scalars:
+            scal_carry = out[11]
         n_real = sl.stop - sl.start
         choices_parts.append(np.asarray(out[8])[:n_real, 0])
         counts_parts.append(np.asarray(out[9])[:n_real, :num_bits])

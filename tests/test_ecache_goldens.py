@@ -1,0 +1,143 @@
+"""Golden tables ported from the reference's equivalence-cache suite.
+
+Reference: vendor/k8s.io/kubernetes/pkg/scheduler/core/equivalence_cache_test.go
+(TestUpdateCachedPredicateItem:35, TestPredicateWithECache:110,
+TestGetHashEquivalencePod:243, TestInvalidateCachedPredicateItemOfAllNodes:516,
+TestInvalidateAllCachedPredicateItemOfNode:589). API mapping:
+UpdateCachedPredicateItem -> update, PredicateWithECache -> lookup (None =
+invalid), InvalidateCachedPredicateItem -> invalidate_predicates_on_node,
+...OfAllNodes -> invalidate_cached_predicate_item_of_all_nodes,
+InvalidateAllCachedPredicateItemOfNode -> invalidate_all_on_node.
+"""
+
+import pytest
+
+from tpusim.api.snapshot import make_pod, make_pod_volume, make_pvc
+from tpusim.api.types import OwnerReference
+from tpusim.engine import errors as err
+from tpusim.engine.equivalence import EquivalenceCache, get_equivalence_hash
+
+GENERAL = "GeneralPredicates"
+
+
+@pytest.mark.parametrize("node,fit,preseed", [
+    ("node1", True, False),   # test 1: fresh node entry
+    ("node2", False, True),   # test 2: overwrite an existing cached item
+])
+def test_update_cached_predicate_item(node, fit, preseed):
+    """TestUpdateCachedPredicateItem:35-108."""
+    cache = EquivalenceCache()
+    if preseed:
+        cache.update(node, GENERAL, 123, True, [])
+    cache.update(node, GENERAL, 123, fit, [])
+    assert cache.lookup(node, GENERAL, 123) == (fit, [])
+
+
+@pytest.mark.parametrize(
+    "node,cached_fit,cached_reasons,invalidate_key,lookup_hash,expect", [
+        # test 1: invalidated predicate key -> miss
+        ("node1", False, [err.ERR_POD_NOT_FITS_HOST_PORTS], True, 123, None),
+        # test 2: hit with fit=true
+        ("node2", True, [], False, 123, (True, [])),
+        # test 3: hit with fit=false + reasons
+        ("node3", False, [err.ERR_POD_NOT_FITS_HOST_PORTS], False, 123,
+         (False, [err.ERR_POD_NOT_FITS_HOST_PORTS])),
+        # test 4: different equivalence hash -> miss
+        ("node4", False, [err.ERR_POD_NOT_FITS_HOST_PORTS], False, 456, None),
+    ])
+def test_predicate_with_ecache(node, cached_fit, cached_reasons,
+                               invalidate_key, lookup_hash, expect):
+    """TestPredicateWithECache:110-241."""
+    cache = EquivalenceCache()
+    cache.update(node, GENERAL, 123, cached_fit, cached_reasons)
+    if invalidate_key:
+        cache.invalidate_predicates_on_node(node, [GENERAL])
+    assert cache.lookup(node, GENERAL, lookup_hash) == expect
+
+
+# ---------------------------------------------------------------------------
+# TestGetHashEquivalencePod:243-514 — controller-ref + resolved-PVC-set class
+# ---------------------------------------------------------------------------
+
+PVCS = {
+    "someEBSVol1": make_pvc("someEBSVol1", namespace="test",
+                            volume_name="someEBSVol1"),
+    "someEBSVol2": make_pvc("someEBSVol2", namespace="test",
+                            volume_name="someNonEBSVol"),
+    "someEBSVol3-0": make_pvc("someEBSVol3-0", namespace="test",
+                              volume_name="pvcWithDeletedPV"),
+    "someEBSVol3-1": make_pvc("someEBSVol3-1", namespace="test",
+                              volume_name="anotherPVCWithDeletedPV"),
+}
+for _name, _pvc in PVCS.items():
+    _pvc.metadata.uid = _name
+
+
+def pvc_getter(namespace, name):
+    if namespace != "test":
+        return None
+    return PVCS.get(name)
+
+
+def owned_pod(name, controller_uid, claims=()):
+    pod = make_pod(name, namespace="test",
+                   volumes=[make_pod_volume(f"v{i}", pvc=claim)
+                            for i, claim in enumerate(claims)])
+    pod.metadata.owner_references = [OwnerReference(
+        api_version="v1", kind="ReplicationController", name="rc",
+        uid=controller_uid, controller=True)]
+    return pod
+
+
+POD1 = owned_pod("pod1", "123", ["someEBSVol1", "someEBSVol2"])
+POD2 = owned_pod("pod2", "123", ["someEBSVol2", "someEBSVol1"])  # reordered
+POD3 = owned_pod("pod3", "567", ["someEBSVol3-1"])
+POD4 = owned_pod("pod4", "567", ["someEBSVol3-0"])
+POD5 = make_pod("pod5", namespace="test")                  # no controller ref
+POD6 = owned_pod("pod6", "567", ["no-exists-pvc"])         # unresolvable claim
+POD7 = owned_pod("pod7", "567")
+
+
+@pytest.mark.parametrize("pods,valid,equivalent", [
+    # same controllerRef and same pvc claims (order-independent)
+    ([POD1, POD2], [True, True], True),
+    # same controllerRef but different pvc claim
+    ([POD3, POD4], [True, True], False),
+    # pod without controllerRef
+    ([POD5], [False], False),
+    # same controllerRef but one has a non-existent pvc claim
+    ([POD6, POD7], [False, True], False),
+])
+def test_get_hash_equivalence_pod(pods, valid, equivalent):
+    hashes = [get_equivalence_hash(p, pvc_getter) for p in pods]
+    for h, expect_valid in zip(hashes, valid):
+        assert (h is not None) == expect_valid
+    computed = [h for h in hashes if h is not None]
+    if len(computed) == 2:
+        assert (computed[0] == computed[1]) == equivalent
+
+
+SEED = [("node1", 123, False, [err.ERR_POD_NOT_FITS_HOST_PORTS]),
+        ("node2", 456, False, [err.ERR_POD_NOT_FITS_HOST_PORTS]),
+        ("node3", 123, True, [])]
+
+
+def test_invalidate_cached_predicate_item_of_all_nodes():
+    """TestInvalidateCachedPredicateItemOfAllNodes:516-587."""
+    cache = EquivalenceCache()
+    for node, ehash, fit, reasons in SEED:
+        cache.update(node, GENERAL, ehash, fit, reasons)
+    cache.invalidate_cached_predicate_item_of_all_nodes([GENERAL])
+    for node, ehash, _, _ in SEED:
+        assert cache.lookup(node, GENERAL, ehash) is None
+
+
+def test_invalidate_all_cached_predicate_item_of_node():
+    """TestInvalidateAllCachedPredicateItemOfNode:589-651."""
+    cache = EquivalenceCache()
+    for node, ehash, fit, reasons in SEED:
+        cache.update(node, GENERAL, ehash, fit, reasons)
+    for node, ehash, _, _ in SEED:
+        cache.invalidate_all_on_node(node)
+        assert cache.lookup(node, GENERAL, ehash) is None
+        assert node not in cache._by_node

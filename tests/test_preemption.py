@@ -10,9 +10,17 @@ from tpusim.engine.util import PodBackoff, get_pod_priority, sort_by_priority_de
 from tpusim.simulator import ClusterCapacity, SchedulerServerConfig
 
 
-def prio_pod(name, priority, milli_cpu=500, node_name="", labels=None):
+def prio_pod(name, priority, milli_cpu=500, node_name="", labels=None,
+             unschedulable=False):
     p = make_pod(name, milli_cpu=milli_cpu, node_name=node_name, labels=labels)
     p.spec.priority = priority
+    if unschedulable:
+        # AddUnschedulableIfNotPresent parks only pods that actually carry
+        # the condition (scheduling_queue.go isPodUnschedulable)
+        from tpusim.api.types import PodCondition
+
+        p.status.conditions.append(PodCondition(
+            type="PodScheduled", status="False", reason="Unschedulable"))
     return p
 
 
@@ -155,22 +163,31 @@ def test_priority_queue_orders_by_priority_then_fifo():
 
 def test_priority_queue_unschedulable_parking_and_move():
     q = PriorityQueue()
-    p = prio_pod("parked", 1)
+    p = prio_pod("parked", 1, unschedulable=True)
     q.add_unschedulable_if_not_present(p)
     assert q.pop() is None
     q.move_all_to_active_queue()
     # while the move request is outstanding, unschedulable adds go straight to
     # active; Pop() resets the flag (scheduling_queue.go Pop)
-    q.add_unschedulable_if_not_present(prio_pod("direct", 1))
+    q.add_unschedulable_if_not_present(prio_pod("direct", 1, unschedulable=True))
     assert q.pop().name == "parked"  # moved first -> earlier FIFO slot
     assert q.pop().name == "direct"
-    q.add_unschedulable_if_not_present(prio_pod("parked-again", 1))
+    q.add_unschedulable_if_not_present(
+        prio_pod("parked-again", 1, unschedulable=True))
     assert q.pop() is None  # flag was reset; pod parked
+
+
+def test_priority_queue_unschedulable_add_without_condition_goes_active():
+    # a pod WITHOUT the Unschedulable condition never parks
+    # (scheduling_queue.go:273-293 isPodUnschedulable gate)
+    q = PriorityQueue()
+    q.add_unschedulable_if_not_present(prio_pod("no-cond", 1))
+    assert q.pop().name == "no-cond"
 
 
 def test_priority_queue_nominated_pods():
     q = PriorityQueue()
-    p = prio_pod("nom", 5)
+    p = prio_pod("nom", 5, unschedulable=True)
     p.status.nominated_node_name = "n1"
     q.add_unschedulable_if_not_present(p)
     assert [x.name for x in q.waiting_pods_for_node("n1")] == ["nom"]

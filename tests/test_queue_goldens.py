@@ -1,0 +1,323 @@
+"""Golden tables ported from the reference's scheduling-queue suite.
+
+Reference: vendor/k8s.io/kubernetes/pkg/scheduler/core/scheduling_queue_test.go
+(TestPriorityQueue_Add:93, _AddIfNotPresent:118,
+_AddUnschedulableIfNotPresent:144, _Pop:170 (sequential — our Pop is
+non-blocking by design, engine/queue.py docstring), _Update:187, _Delete:223,
+_MoveAllToActiveQueue:243, _AssignedPodAdded:257, _WaitingPodsForNode:310,
+TestUnschedulablePodsMap:327). Fixture pods mirror the file-scope vars at
+:29-91 (hpp/ns1, mpp/ns2 nominated node1, up/ns1 unschedulable nominated
+node1).
+"""
+
+from tpusim.api.snapshot import make_pod
+from tpusim.api.types import PodCondition
+from tpusim.engine.queue import PriorityQueue
+
+LOW, MEDIUM, HIGH = 0, 500, 1000
+
+
+def build(name, namespace, priority, nominated="", unschedulable=False,
+          affinity=None, labels=None, node_name=""):
+    p = make_pod(name, namespace=namespace, labels=labels,
+                 affinity=affinity, node_name=node_name)
+    p.spec.priority = priority
+    if nominated:
+        p.status.nominated_node_name = nominated
+    if unschedulable:
+        p.status.conditions.append(PodCondition(
+            type="PodScheduled", status="False", reason="Unschedulable"))
+    return p
+
+
+def high_priority_pod():
+    return build("hpp", "ns1", HIGH)
+
+
+def high_pri_nominated_pod():
+    return build("hpp", "ns1", HIGH, nominated="node1")
+
+
+def med_priority_pod():
+    return build("mpp", "ns2", MEDIUM, nominated="node1")
+
+
+def unschedulable_pod():
+    return build("up", "ns1", LOW, nominated="node1", unschedulable=True)
+
+
+def nominated_names(q, node):
+    return [p.metadata.name for p in q.waiting_pods_for_node(node)]
+
+
+def test_priority_queue_add():
+    """TestPriorityQueue_Add:93-116."""
+    q = PriorityQueue()
+    med, unsched, high = (med_priority_pod(), unschedulable_pod(),
+                          high_priority_pod())
+    q.add(med)
+    q.add(unsched)
+    q.add(high)
+    assert nominated_names(q, "node1") == ["mpp", "up"]
+    assert q.pop().metadata.name == "hpp"
+    assert q.pop().metadata.name == "mpp"
+    assert q.pop().metadata.name == "up"
+    assert not q._nominated  # Pop removes nominated entries
+
+
+def test_priority_queue_add_if_not_present():
+    """TestPriorityQueue_AddIfNotPresent:118-142 (reaches into
+    unschedulableQ.addOrUpdate exactly like the upstream test)."""
+    q = PriorityQueue()
+    hpn = high_pri_nominated_pod()
+    q._unschedulable[hpn.key()] = hpn
+    q.add_if_not_present(hpn)  # must not add anything
+    med, unsched = med_priority_pod(), unschedulable_pod()
+    q.add_if_not_present(med)
+    q.add_if_not_present(unsched)
+    assert nominated_names(q, "node1") == ["mpp", "up"]
+    assert q.pop().metadata.name == "mpp"
+    assert q.pop().metadata.name == "up"
+    assert not q._nominated
+    assert q._unschedulable[hpn.key()] is hpn
+
+
+def test_priority_queue_add_unschedulable_if_not_present():
+    """TestPriorityQueue_AddUnschedulableIfNotPresent:144-168: a pod without
+    the Unschedulable condition goes to activeQ, one with it parks."""
+    q = PriorityQueue()
+    hpn = high_pri_nominated_pod()
+    q.add(hpn)
+    q.add_unschedulable_if_not_present(hpn)  # must not add anything
+    med, unsched = med_priority_pod(), unschedulable_pod()
+    q.add_unschedulable_if_not_present(med)    # no condition -> activeQ
+    q.add_unschedulable_if_not_present(unsched)  # parks
+    assert nominated_names(q, "node1") == ["hpp", "mpp", "up"]
+    assert q.pop().metadata.name == "hpp"
+    assert q.pop().metadata.name == "mpp"
+    assert len(q._nominated) == 1
+    assert q._unschedulable[unsched.key()] is unsched
+
+
+def test_priority_queue_pop():
+    """TestPriorityQueue_Pop:170-185 (sequential: non-blocking Pop)."""
+    q = PriorityQueue()
+    q.add(med_priority_pod())
+    assert q.pop().metadata.name == "mpp"
+    assert not q._nominated
+
+
+def test_priority_queue_update():
+    """TestPriorityQueue_Update:187-221."""
+    q = PriorityQueue()
+    high = high_priority_pod()
+    q.update(None, high)
+    assert high.key() in q._active_items
+    assert not q._nominated
+    # update the active pod, adding a nominated node name
+    hpn = high_pri_nominated_pod()
+    q.update(high, hpn)
+    assert len(q._active_items) == 1
+    assert len(q._nominated) == 1
+    # updating an unschedulable pod in NO queue adds it to activeQ
+    unsched = unschedulable_pod()
+    q.update(unsched, unsched)
+    assert unsched.key() in q._active_items
+    # updating a pod already in activeQ keeps it there
+    q.update(unsched, unsched)
+    assert len(q._unschedulable) == 0
+    assert unsched.key() in q._active_items
+    assert q.pop().metadata.name == "hpp"
+
+
+def test_priority_queue_delete():
+    """TestPriorityQueue_Delete:223-241."""
+    q = PriorityQueue()
+    high, hpn = high_priority_pod(), high_pri_nominated_pod()
+    q.update(high, hpn)
+    unsched = unschedulable_pod()
+    q.add(unsched)
+    q.delete(hpn)
+    assert unsched.key() in q._active_items
+    assert hpn.key() not in q._active_items
+    assert len(q._nominated) == 1  # only unschedulablePod's entry remains
+    q.delete(unsched)
+    assert not q._nominated
+
+
+def test_priority_queue_move_all_to_active_queue():
+    """TestPriorityQueue_MoveAllToActiveQueue:243-252."""
+    q = PriorityQueue()
+    q.add(med_priority_pod())
+    unsched, high = unschedulable_pod(), high_priority_pod()
+    q._unschedulable[unsched.key()] = unsched
+    q._unschedulable[high.key()] = high
+    q.move_all_to_active_queue()
+    assert len(q._active_items) == 3
+
+
+def test_priority_queue_assigned_pod_added():
+    """TestPriorityQueue_AssignedPodAdded:257-308: a bound pod with labels
+    matching a parked pod's required pod-affinity term moves that pod (and
+    only that pod) to activeQ."""
+    affinity_pod = build(
+        "afp", "ns1", MEDIUM, nominated="node1", unschedulable=True,
+        affinity={"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchExpressions": [
+                    {"key": "service", "operator": "In",
+                     "values": ["securityscan", "value2"]}]},
+                 "topologyKey": "region"}]}})
+    label_pod = build("lbp", "ns1", LOW,
+                      labels={"service": "securityscan"},
+                      node_name="machine1")
+
+    q = PriorityQueue()
+    q.add(med_priority_pod())
+    unsched = unschedulable_pod()
+    q._unschedulable[unsched.key()] = unsched
+    q._unschedulable[affinity_pod.key()] = affinity_pod
+    q.assigned_pod_added(label_pod)
+    assert affinity_pod.key() not in q._unschedulable
+    assert affinity_pod.key() in q._active_items
+    assert unsched.key() in q._unschedulable
+
+
+def test_priority_queue_waiting_pods_for_node():
+    """TestPriorityQueue_WaitingPodsForNode:310-325."""
+    q = PriorityQueue()
+    q.add(med_priority_pod())
+    q.add(unschedulable_pod())
+    q.add(high_priority_pod())
+    assert q.pop().metadata.name == "hpp"
+    assert nominated_names(q, "node1") == ["mpp", "up"]
+    assert q.waiting_pods_for_node("node2") == []
+
+
+def test_unschedulable_pods_map():
+    """TestUnschedulablePodsMap:327-469: the parking map add/update/delete/
+    clear table, driven through the queue's parking dict (keyed by the pod's
+    full name — ours uses ns/name, identical uniqueness)."""
+    def pod(name, ns, annotations=None, nominated=""):
+        p = build(name, ns, LOW, nominated=nominated, unschedulable=True)
+        if annotations:
+            p.metadata.annotations = dict(annotations)
+        return p
+
+    pods = [pod("p0", "ns1", {"annot1": "val1"}, nominated="node1"),
+            pod("p1", "ns1", {"annot": "val"}),
+            pod("p2", "ns2", {"annot2": "val2", "annot3": "val3"},
+                nominated="node3"),
+            pod("p3", "ns4", nominated="node1")]
+    updated = {0: pod("p0", "ns1", {"annot1": "patched"}, nominated="node1"),
+               1: pod("p1", "ns1", {"annot": "patched"}),
+               3: pod("p3", "ns4", nominated="node1")}
+
+    cases = [
+        # (add indices, update indices, delete indices, expected remaining)
+        ([0, 1, 2, 3], [0], [0, 1], {"p2", "p3"}),
+        ([0, 3], [3], [0, 3], set()),
+        ([1, 2], [1], [2, 3], {"p1"}),
+    ]
+    for add_idx, upd_idx, del_idx, expect in cases:
+        q = PriorityQueue()
+        for i in add_idx:
+            q._unschedulable[pods[i].key()] = pods[i]
+        assert {p.metadata.name for p in q._unschedulable.values()} \
+            == {pods[i].metadata.name for i in add_idx}
+        for i in upd_idx:
+            q._unschedulable[updated[i].key()] = updated[i]
+            assert q._unschedulable[updated[i].key()] is updated[i]
+        for i in del_idx:
+            q.delete(pods[i])
+        assert {p.metadata.name for p in q._unschedulable.values()} == expect
+        q._unschedulable.clear()
+        assert not q._unschedulable
+
+
+# ---------------------------------------------------------------------------
+# PodBackoff golden table
+# Reference: vendor/.../pkg/scheduler/util/backoff_utils_test.go TestBackoff:34
+# ---------------------------------------------------------------------------
+
+
+def test_pod_backoff_golden():
+    from tpusim.engine.util import PodBackoff
+
+    class FakeClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    backoff = PodBackoff(default_duration=1.0, max_duration=60.0, clock=clock)
+    steps = [
+        ("default/foo", 1.0, 0.0),
+        ("default/foo", 2.0, 0.0),
+        ("default/foo", 4.0, 0.0),
+        ("default/bar", 1.0, 120.0),
+        # 'foo' has been gc'd here (idle > maxDuration)
+        ("default/foo", 1.0, 0.0),
+    ]
+    for pod_id, expected, advance in steps:
+        assert backoff.get_backoff_time(pod_id) == expected, pod_id
+        clock.t += advance
+        backoff.gc()
+    backoff.get_entry("default/foo").backoff = 60.0
+    assert backoff.get_backoff_time("default/foo") == 60.0
+    # namespace split: same name, different namespace
+    assert backoff.get_backoff_time("other/foo") == 1.0
+
+
+def test_pod_backoff_try_backoff_and_wait():
+    """TryBackoffAndWait analog (backoff_utils.go:63-70, non-sleeping): first
+    call passes (entry created), immediate retry is rejected until the backoff
+    window elapses."""
+    from tpusim.engine.util import PodBackoff
+
+    class FakeClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    backoff = PodBackoff(default_duration=1.0, max_duration=60.0, clock=clock)
+    assert backoff.try_backoff_and_wait("default/p")
+    backoff.get_backoff_time("default/p")  # record a failure: backoff 1 -> 2
+    assert not backoff.try_backoff_and_wait("default/p")  # still inside window
+    clock.t += 2.0
+    assert backoff.try_backoff_and_wait("default/p")
+
+
+def test_simulator_wires_assigned_pod_events_to_queue():
+    """factory.go:607/630 parity: a bound pod's store event must trigger the
+    queue's affinity-move (AssignedPodAdded/Updated), pulling a parked pod
+    with a matching required pod-affinity term back to activeQ and raising
+    receivedMoveRequest."""
+    from tpusim.api.snapshot import make_node
+    from tpusim.simulator import ClusterCapacity, SchedulerServerConfig
+
+    affinity_pod = make_pod(
+        "afp", milli_cpu=100,
+        affinity={"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"service": "securityscan"}},
+                 "topologyKey": "kubernetes.io/hostname"}]}})
+    affinity_pod.spec.priority = MEDIUM
+    label_pod = make_pod("lbp", milli_cpu=100,
+                         labels={"service": "securityscan"})
+    label_pod.spec.priority = LOW
+
+    cfg = SchedulerServerConfig(enable_pod_priority=True)
+    # LIFO feed: the LAST entry pops first — affinity pod schedules first
+    # (parks: no matching pod exists yet), then the label pod binds
+    cc = ClusterCapacity(cfg, [label_pod, affinity_pod], [],
+                         [make_node("n0", milli_cpu=2000)])
+    cc.run()
+    q = cc.scheduling_queue
+    assert affinity_pod.key() not in q._unschedulable, \
+        "bound-pod event did not move the parked affinity pod to activeQ"
+    assert affinity_pod.key() in q._active_items
+    assert q.received_move_request

@@ -1,0 +1,242 @@
+"""Golden tables ported from the reference's scheduler volume-binder suite.
+
+Reference: vendor/k8s.io/kubernetes/pkg/controller/volume/persistentvolume/
+scheduler_binder_test.go — TestFindPodVolumes:391 (all 17 scenarios) and
+TestAssumePodVolumes:581 (the cache-observable scenarios). Fixtures mirror
+the file-scope vars at :41-74 (waitClass/immediateClass, pv-node1a/1b/2,
+unbound/prebound/bound/immediate PVCs, nodeLabelKey="nodeKey").
+
+Not ported: TestBindPodVolumes:677 and the claimref-failed/tmpupdate-failed
+assume scenarios — they exercise the API-reactor write path (fake clientset
+update conflicts, GetReference failures on SelfLink-less objects); this
+offline binder has no API server, its "bind" IS the assume-time claimRef
+mutation, which the assume scenarios below pin.
+"""
+
+import pytest
+
+from tpusim.api.snapshot import (
+    make_node,
+    make_pod,
+    make_pod_volume,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+)
+from tpusim.engine.volume import VolumeBinder, VolumeBinderError
+
+WAIT_CLASS = "waitClass"
+IMMEDIATE_CLASS = "immediateClass"
+NODE_LABEL_KEY = "nodeKey"
+
+UNBOUND, PREBOUND, BOUND = range(3)
+
+
+def mk_pvc(name, size, state, pv_name="", class_name=WAIT_CLASS):
+    """makeTestPVC:260-287 (ns testns; bound/prebound set volumeName)."""
+    pvc = make_pvc(name, namespace="testns", storage=size,
+                   storage_class=class_name,
+                   volume_name=pv_name if state in (PREBOUND, BOUND) else "")
+    pvc.metadata.uid = "pvc-uid"
+    return pvc
+
+
+def mk_pv(name, node, capacity, bound_to=None, class_name=WAIT_CLASS):
+    """makeTestPV:309-336 (node != '' adds required node affinity on
+    nodeKey=node; bound_to sets claimRef)."""
+    terms = None
+    if node:
+        terms = [{"matchExpressions": [
+            {"key": NODE_LABEL_KEY, "operator": "In", "values": [node]}]}]
+    claim_ref = None
+    if bound_to is not None:
+        claim_ref = {"name": bound_to.name, "namespace": bound_to.namespace,
+                     "uid": bound_to.metadata.uid}
+    return make_pv(name, storage=capacity, storage_class=class_name,
+                   node_affinity_terms=terms, claim_ref=claim_ref)
+
+
+def pod_with_claims(pvcs):
+    """makePod:338-361 (testns, nodeName node1)."""
+    return make_pod("test-pod", namespace="testns", node_name="node1",
+                    volumes=[make_pod_volume(f"vol{i}", pvc=pvc.name)
+                             for i, pvc in enumerate(pvcs or [])])
+
+
+def pod_without_pvc():
+    """makePodWithoutPVC:363-380 (an emptyDir volume, no claims)."""
+    return make_pod("test-pod", namespace="testns",
+                    volumes=[make_pod_volume("v", source={"emptyDir": {}})])
+
+
+def fixtures():
+    pvcs = {
+        "unbound-pvc": mk_pvc("unbound-pvc", "1G", UNBOUND),
+        "unbound-pvc2": mk_pvc("unbound-pvc2", "5G", UNBOUND),
+        "prebound-pvc": mk_pvc("prebound-pvc", "1G", PREBOUND, "pv-node1a"),
+        "bound-pvc": mk_pvc("bound-pvc", "1G", BOUND, "pv-bound"),
+        "immediate-unbound-pvc": mk_pvc(
+            "immediate-unbound-pvc", "1G", UNBOUND,
+            class_name=IMMEDIATE_CLASS),
+        "immediate-bound-pvc": mk_pvc(
+            "immediate-bound-pvc", "1G", BOUND, "pv-bound-immediate",
+            class_name=IMMEDIATE_CLASS),
+    }
+    pvs = {
+        "pv-no-node": mk_pv("pv-no-node", "", "1G"),
+        "pv-node1a": mk_pv("pv-node1a", "node1", "5G"),
+        "pv-node1b": mk_pv("pv-node1b", "node1", "10G"),
+        "pv-node2": mk_pv("pv-node2", "node2", "1G"),
+        "pv-bound": mk_pv("pv-bound", "node1", "1G",
+                            bound_to=pvcs["bound-pvc"]),
+        "pv-node1a-bound": mk_pv("pv-node1a", "node1", "1G",
+                                   bound_to=pvcs["unbound-pvc"]),
+        "pv-bound-immediate": mk_pv(
+            "pv-bound-immediate", "node1", "1G",
+            bound_to=pvcs["immediate-bound-pvc"],
+            class_name=IMMEDIATE_CLASS),
+        "pv-bound-immediate-node2": mk_pv(
+            "pv-bound-immediate", "node2", "1G",
+            bound_to=pvcs["immediate-bound-pvc"],
+            class_name=IMMEDIATE_CLASS),
+    }
+    return pvcs, pvs
+
+
+CLASSES = [make_storage_class(WAIT_CLASS, binding_mode="WaitForFirstConsumer"),
+           make_storage_class(IMMEDIATE_CLASS, binding_mode="Immediate")]
+
+TEST_NODE = make_node("node1", labels={NODE_LABEL_KEY: "node1"})
+
+
+def build_binder(pv_names, pvc_names, pvcs, pvs):
+    return VolumeBinder(pvs=[pvs[n] for n in pv_names],
+                        pvcs=[pvcs[n] for n in pvc_names],
+                        classes=CLASSES, enabled=True)
+
+
+# TestFindPodVolumes:391-579 — scenario name -> (pod pvc names, pv names,
+# cache pvc names (None = pod's), expected bindings [(pvc, pv)] or None,
+# expected (unbound, bound), should_fail)
+FIND_SCENARIOS = {
+    "no-volumes": ([], [], None, None, (True, True), False),
+    "no-pvcs": (None, [], None, None, (True, True), False),
+    "pvc-not-found": (["bound-pvc"], [], [], None, None, True),
+    "bound-pvc": (["bound-pvc"], ["pv-bound"], None, None, (True, True),
+                  False),
+    "bound-pvc,pv-not-exists": (["bound-pvc"], [], None, None, None, True),
+    "prebound-pvc": (["prebound-pvc"], ["pv-node1a-bound"], None, None,
+                     (True, True), False),
+    "unbound-pvc,pv-same-node": (
+        ["unbound-pvc"], ["pv-node2", "pv-node1a", "pv-node1b"], None,
+        [("unbound-pvc", "pv-node1a")], (True, True), False),
+    "unbound-pvc,pv-different-node": (
+        ["unbound-pvc"], ["pv-node2"], None, None, (False, True), False),
+    "two-unbound-pvcs": (
+        ["unbound-pvc", "unbound-pvc2"], ["pv-node1a", "pv-node1b"], None,
+        [("unbound-pvc", "pv-node1a"), ("unbound-pvc2", "pv-node1b")],
+        (True, True), False),
+    "two-unbound-pvcs,order-by-size": (
+        ["unbound-pvc2", "unbound-pvc"], ["pv-node1a", "pv-node1b"], None,
+        [("unbound-pvc", "pv-node1a"), ("unbound-pvc2", "pv-node1b")],
+        (True, True), False),
+    "two-unbound-pvcs,partial-match": (
+        ["unbound-pvc", "unbound-pvc2"], ["pv-node1a"], None, None,
+        (False, True), False),
+    "one-bound,one-unbound": (
+        ["unbound-pvc", "bound-pvc"], ["pv-bound", "pv-node1a"], None,
+        [("unbound-pvc", "pv-node1a")], (True, True), False),
+    "one-bound,one-unbound,no-match": (
+        ["unbound-pvc", "bound-pvc"], ["pv-bound", "pv-node2"], None, None,
+        (False, True), False),
+    "one-prebound,one-unbound": (
+        ["unbound-pvc", "prebound-pvc"], ["pv-node1a", "pv-node1b"], None,
+        [("unbound-pvc", "pv-node1a")], (True, True), False),
+    "immediate-bound-pvc": (
+        ["immediate-bound-pvc"], ["pv-bound-immediate"], None, None,
+        (True, True), False),
+    "immediate-bound-pvc-wrong-node": (
+        ["immediate-bound-pvc"], ["pv-bound-immediate-node2"], None, None,
+        (True, False), False),
+    "immediate-unbound-pvc": (
+        ["immediate-unbound-pvc"], [], None, None, None, True),
+    "immediate-unbound-pvc,delayed-mode-bound": (
+        ["immediate-unbound-pvc", "bound-pvc"], ["pv-bound"], None, None,
+        None, True),
+    "immediate-unbound-pvc,delayed-mode-unbound": (
+        ["immediate-unbound-pvc", "unbound-pvc"], [], None, None, None, True),
+}
+
+
+@pytest.mark.parametrize("name", sorted(FIND_SCENARIOS))
+def test_find_pod_volumes(name):
+    (pod_pvcs, pv_names, cache_pvcs, expected_bindings, expected,
+     should_fail) = FIND_SCENARIOS[name]
+    pvcs, pvs = fixtures()
+    if pod_pvcs is None:  # the emptyDir pod
+        pod = pod_without_pvc()
+        pod_pvcs = []
+    else:
+        pod = pod_with_claims([pvcs[n] for n in pod_pvcs])
+    cache_names = pod_pvcs if cache_pvcs is None else cache_pvcs
+    binder = build_binder(pv_names, cache_names, pvcs, pvs)
+
+    if should_fail:
+        with pytest.raises(VolumeBinderError):
+            binder.find_pod_volumes(pod, TEST_NODE)
+        return
+    unbound_ok, bound_ok = binder.find_pod_volumes(pod, TEST_NODE)
+    assert (unbound_ok, bound_ok) == expected, name
+    cached = binder._binding_cache.get((pod.key(), TEST_NODE.name))
+    if expected_bindings is None:
+        assert not cached
+    else:
+        assert [(pvc.name, pv.name) for pvc, pv in cached] \
+            == expected_bindings, name
+
+
+# TestAssumePodVolumes:581-675, cache-observable scenarios.
+
+def test_assume_all_bound_is_noop():
+    pvcs, pvs = fixtures()
+    binder = build_binder(["pv-bound"], ["bound-pvc"], pvcs, pvs)
+    pod = pod_with_claims([pvcs["bound-pvc"]])
+    assert binder.find_pod_volumes(pod, TEST_NODE) == (True, True)
+    binder.assume_pod_volumes(pod, "node1")
+    # the already-bound PV keeps its original claimRef
+    assert binder.get_pv("pv-bound").claim_ref["name"] == "bound-pvc"
+
+
+@pytest.mark.parametrize("claims,expected_claim_refs", [
+    (["unbound-pvc"], {"pv-node1a": "unbound-pvc"}),           # one-binding
+    (["unbound-pvc", "unbound-pvc2"],                          # two-bindings
+     {"pv-node1a": "unbound-pvc", "pv-node1b": "unbound-pvc2"}),
+])
+def test_assume_sets_claim_refs(claims, expected_claim_refs):
+    pvcs, pvs = fixtures()
+    binder = build_binder(["pv-node1a", "pv-node1b"], claims, pvcs, pvs)
+    pod = pod_with_claims([pvcs[n] for n in claims])
+    assert binder.find_pod_volumes(pod, TEST_NODE) == (True, True)
+    binder.assume_pod_volumes(pod, "node1")
+    for pv_name, pvc_name in expected_claim_refs.items():
+        ref = binder.get_pv(pv_name).claim_ref
+        assert ref is not None and ref["name"] == pvc_name
+        assert ref["namespace"] == "testns"
+    # the binding decision is consumed (podBindingCache cleared for the pod)
+    assert not binder._binding_cache
+
+
+def test_assume_pv_already_bound_keeps_cache_state():
+    """pv-already-bound: assuming against a PV that already carries the
+    claimRef leaves it untouched (expectedBindings: {})."""
+    pvcs, pvs = fixtures()
+    binder = VolumeBinder(pvs=[pvs["pv-node1a-bound"]],
+                          pvcs=[pvcs["unbound-pvc"]],
+                          classes=CLASSES, enabled=True)
+    pod = pod_with_claims([pvcs["unbound-pvc"]])
+    before = binder.get_pv("pv-node1a").claim_ref
+    assert before is not None
+    binder._binding_cache[(pod.key(), "node1")] = [
+        (pvcs["unbound-pvc"], pvs["pv-node1a-bound"])]
+    binder.assume_pod_volumes(pod, "node1")
+    assert binder.get_pv("pv-node1a").claim_ref == before

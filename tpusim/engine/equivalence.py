@@ -15,20 +15,36 @@ capability parity.
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from tpusim.api.types import Pod
 
 ALGORITHM_CACHE_SIZE = 100  # equivalence_cache.go: maxCacheEntries
 
 
-def get_equivalence_hash(pod: Pod) -> Optional[int]:
-    """getHashEquivalencePod: pods sharing controller OwnerReferences form an
-    equivalence class; pods without one are not cacheable."""
-    refs = pod.metadata.owner_references
-    if not refs:
-        return None
-    return hash(tuple(sorted((r.uid or r.name) for r in refs)))
+def get_equivalence_hash(pod: Pod, pvc_getter: Callable = None) -> Optional[int]:
+    """predicates.EquivalencePodGenerator.getEquivalencePod (utils.go:87-124)
+    hashed like getHashEquivalencePod: the equivalence class is the pod's
+    CONTROLLER owner reference plus its (unordered) set of resolved PVC UIDs
+    — pods stamped from the same template claiming the same PVCs are
+    interchangeable for predicate evaluation. No controller reference, or a
+    PVC that does not resolve, means no valid class (not cacheable)."""
+    for ref in pod.metadata.owner_references:
+        if not ref.controller:
+            continue
+        pvc_set = set()
+        for volume in pod.spec.volumes:
+            claim = volume.pvc_name
+            if claim is None:
+                continue
+            pvc = pvc_getter(pod.namespace, claim) if pvc_getter else None
+            if pvc is None:
+                return None  # unresolvable claim: no equivalence class
+            pvc_set.add(pvc.metadata.uid or pvc.key())
+        # a pod can only belong to one controller
+        return hash((ref.api_version, ref.kind, ref.name, ref.uid,
+                     frozenset(pvc_set)))
+    return None
 
 
 class _LRU(OrderedDict):
@@ -51,11 +67,19 @@ class _LRU(OrderedDict):
 
 
 class EquivalenceCache:
-    def __init__(self):
+    def __init__(self, pvc_getter: Callable = None):
+        """pvc_getter: the PVC lister handed to the equivalence-class
+        generator (factory.go passes the PVC informer into
+        NewEquivalencePodGenerator)."""
         # node name -> LRU(predicate key -> {equiv hash -> (fit, reasons)})
         self._by_node: Dict[str, _LRU] = {}
+        self._pvc_getter = pvc_getter
         self.hits = 0
         self.misses = 0
+
+    def get_equivalence_class_hash(self, pod: Pod) -> Optional[int]:
+        """getEquivalenceClassInfo via the configured generator."""
+        return get_equivalence_hash(pod, self._pvc_getter)
 
     def lookup(self, node_name: str, predicate_key: str,
                equiv_hash: int) -> Optional[Tuple[bool, list]]:

@@ -20,7 +20,6 @@ from typing import Callable, Dict, List, Optional
 
 from tpusim.api.types import Node, Pod
 from tpusim.engine import errors as err
-from tpusim.engine.equivalence import get_equivalence_hash
 from tpusim.engine.errors import (
     FailureReason,
     PredicateError,
@@ -146,7 +145,8 @@ class GenericScheduler:
         fails: List[PredicateFailureReason] = []
         pods_added = False
         ecache = self.equivalence_cache
-        equiv_hash = get_equivalence_hash(pod) if ecache is not None else None
+        equiv_hash = (ecache.get_equivalence_class_hash(pod)
+                      if ecache is not None else None)
         for i in range(2):
             meta_to_use, info_to_use = meta, node_info
             if i == 0:

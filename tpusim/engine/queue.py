@@ -4,17 +4,62 @@ Reference: core/scheduling_queue.go — `NewSchedulingQueue` returns a plain FIF
 unless pod priority is enabled, else the PriorityQueue with an active heap,
 an unschedulable map, a nominated-pods index, and the receivedMoveRequest flag
 (:49-340). The simulator runs one pod in flight so the queues are small, but
-the semantics (ordering, unschedulable parking, move-to-active) are preserved.
+the semantics (ordering, unschedulable parking, nominated-index maintenance,
+affinity-triggered moves) are preserved — pinned by the golden tables ported
+from core/scheduling_queue_test.go (tests/test_queue_goldens.py).
+
+Deviation from upstream: Pop() returns None on an empty queue instead of
+blocking on a condition variable — the single-threaded simulator drives the
+feed itself (simulator.py nextPod), so there is never a consumer to park.
 """
 
 from __future__ import annotations
 
 import heapq
 import itertools
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from tpusim.api.types import Pod
 from tpusim.engine.util import get_pod_priority
+
+
+def nominated_node_name(pod: Pod) -> str:
+    """scheduling_queue.go:143-145."""
+    return pod.status.nominated_node_name
+
+
+def is_pod_unschedulable(pod: Pod) -> bool:
+    """scheduling_queue.go:268-271: carries PodScheduled=False with reason
+    Unschedulable."""
+    for cond in pod.status.conditions:
+        if cond.type == "PodScheduled":
+            return cond.status == "False" and cond.reason == "Unschedulable"
+    return False
+
+
+def _pod_uid(pod: Pod) -> str:
+    """Nominated-index identity: upstream compares pod UIDs
+    (scheduling_queue.go:190-216); fall back to the ns/name key for fixtures
+    without UIDs."""
+    return pod.metadata.uid or pod.key()
+
+
+def is_pod_updated(old_pod: Optional[Pod], new_pod: Pod) -> bool:
+    """scheduling_queue.go:321-331 isPodUpdated: strip status (and the
+    versioning fields our model does not carry) and compare — an update that
+    only touches status cannot have made the pod schedulable."""
+    if old_pod is None:
+        return True
+
+    def strip(pod: Pod) -> dict:
+        o = pod.to_obj()
+        o.pop("status", None)
+        meta = o.get("metadata") or {}
+        meta.pop("resourceVersion", None)
+        meta.pop("generation", None)
+        return o
+
+    return strip(old_pod) != strip(new_pod)
 
 
 class SchedulingQueue:
@@ -37,10 +82,16 @@ class SchedulingQueue:
     def pop(self) -> Optional[Pod]:
         raise NotImplementedError
 
-    def update(self, pod: Pod) -> None:
+    def update(self, old_pod: Optional[Pod], new_pod: Pod) -> None:
         raise NotImplementedError
 
     def delete(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def assigned_pod_added(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def assigned_pod_updated(self, pod: Pod) -> None:
         raise NotImplementedError
 
     def move_all_to_active_queue(self) -> None:
@@ -79,11 +130,18 @@ class FIFO(SchedulingQueue):
                 return pod
         return None
 
-    def update(self, pod: Pod) -> None:
-        self.add(pod)
+    def update(self, old_pod: Optional[Pod], new_pod: Pod) -> None:
+        self.add(new_pod)
 
     def delete(self, pod: Pod) -> None:
         self._items.pop(pod.key(), None)
+
+    # FIFO ignores assigned-pod and move events (scheduling_queue.go:104-116)
+    def assigned_pod_added(self, pod: Pod) -> None:
+        pass
+
+    def assigned_pod_updated(self, pod: Pod) -> None:
+        pass
 
     def move_all_to_active_queue(self) -> None:
         pass
@@ -96,102 +154,174 @@ class FIFO(SchedulingQueue):
 
 
 class PriorityQueue(SchedulingQueue):
-    """Reference: scheduling_queue.go:147-340 — activeQ heap ordered by pod
+    """Reference: scheduling_queue.go:147-460 — activeQ heap ordered by pod
     priority (ties FIFO by insertion), unschedulableQ parking lot, nominated
-    pods index, receivedMoveRequest."""
+    pods index maintained across add/update/delete/pop, receivedMoveRequest,
+    and affinity-triggered unschedulable->active moves."""
 
     def __init__(self):
         self._counter = itertools.count()
         self._active: List[tuple] = []  # (-priority, seq, key)
         self._active_items: Dict[str, Pod] = {}
+        self._active_seq: Dict[str, int] = {}  # key -> live heap entry seq
         self._unschedulable: Dict[str, Pod] = {}
         self._nominated: Dict[str, List[Pod]] = {}  # node name -> pods
         self.received_move_request = False
 
-    # --- nominated-pods index ---
-
-    def _nominated_node(self, pod: Pod) -> str:
-        return pod.status.nominated_node_name
+    # --- nominated-pods index (scheduling_queue.go:188-226) ---
 
     def _add_nominated(self, pod: Pod) -> None:
-        node = self._nominated_node(pod)
+        node = nominated_node_name(pod)
         if node:
+            if any(_pod_uid(np) == _pod_uid(pod)
+                   for np in self._nominated.get(node, ())):
+                return  # adding an existing pod does not update it
             self._nominated.setdefault(node, []).append(pod)
+
+    def _delete_nominated(self, pod: Pod) -> None:
+        node = nominated_node_name(pod)
+        if node and node in self._nominated:
+            self._nominated[node] = [p for p in self._nominated[node]
+                                     if _pod_uid(p) != _pod_uid(pod)]
+            if not self._nominated[node]:
+                del self._nominated[node]
+
+    def _update_nominated(self, old_pod: Optional[Pod], new_pod: Pod) -> None:
+        if old_pod is not None:
+            self._delete_nominated(old_pod)
+        self._add_nominated(new_pod)
 
     def has_nominated_pods(self) -> bool:
         return bool(self._nominated)
 
-    def _delete_nominated(self, pod: Pod) -> None:
-        node = self._nominated_node(pod)
-        if node and node in self._nominated:
-            self._nominated[node] = [p for p in self._nominated[node]
-                                     if p.key() != pod.key()]
-            if not self._nominated[node]:
-                del self._nominated[node]
+    # --- activeQ heap with lazy invalidation (cache.Heap Add/Update) ---
+
+    def _heap_add(self, pod: Pod) -> None:
+        key = pod.key()
+        seq = next(self._counter)
+        heapq.heappush(self._active, (-get_pod_priority(pod), seq, key))
+        self._active_items[key] = pod
+        self._active_seq[key] = seq
 
     # --- queue ops ---
 
     def add(self, pod: Pod) -> None:
+        """scheduling_queue.go:228-246."""
         key = pod.key()
+        self._heap_add(pod)
         if key in self._unschedulable:
-            del self._unschedulable[key]
             self._delete_nominated(pod)
-        if key not in self._active_items:
-            heapq.heappush(self._active,
-                           (-get_pod_priority(pod), next(self._counter), key))
-        self._active_items[key] = pod
+            del self._unschedulable[key]
         self._add_nominated(pod)
 
     def add_if_not_present(self, pod: Pod) -> None:
+        """scheduling_queue.go:248-266."""
         key = pod.key()
         if key in self._unschedulable or key in self._active_items:
             return
-        self.add(pod)
+        self._heap_add(pod)
+        self._add_nominated(pod)
 
     def add_unschedulable_if_not_present(self, pod: Pod) -> None:
-        """scheduling_queue.go:214-235: park unless a move request arrived
-        while this pod was being scheduled."""
+        """scheduling_queue.go:273-293: park only when no move request
+        arrived mid-flight AND the pod actually carries the Unschedulable
+        condition; anything else goes (back) to the active queue."""
         key = pod.key()
         if key in self._unschedulable or key in self._active_items:
             return
-        if self.received_move_request:
-            self.add(pod)
-        else:
+        if not self.received_move_request and is_pod_unschedulable(pod):
             self._unschedulable[key] = pod
             self._add_nominated(pod)
+            return
+        self._heap_add(pod)
+        self._add_nominated(pod)
 
     def pop(self) -> Optional[Pod]:
+        """scheduling_queue.go:295-312 (non-blocking; see module docstring):
+        removes the popped pod from the nominated index and clears
+        receivedMoveRequest to mark a new scheduling cycle."""
         while self._active:
-            _, _, key = heapq.heappop(self._active)
-            pod = self._active_items.pop(key, None)
-            if pod is not None:
-                self.received_move_request = False
-                return pod
+            _, seq, key = heapq.heappop(self._active)
+            if self._active_seq.get(key) != seq:
+                continue  # superseded by an update; skip the stale entry
+            del self._active_seq[key]
+            pod = self._active_items.pop(key)
+            self._delete_nominated(pod)
+            self.received_move_request = False
+            return pod
         return None
 
-    def update(self, pod: Pod) -> None:
-        key = pod.key()
+    def update(self, old_pod: Optional[Pod], new_pod: Pod) -> None:
+        """scheduling_queue.go:333-363."""
+        key = new_pod.key()
         if key in self._active_items:
-            self._active_items[key] = pod
+            self._update_nominated(old_pod, new_pod)
+            self._heap_add(new_pod)  # re-push; stale entry skipped at pop
             return
         if key in self._unschedulable:
-            # updates that may make the pod schedulable move it to active
-            del self._unschedulable[key]
-        self.add(pod)
+            self._update_nominated(old_pod, new_pod)
+            if is_pod_updated(old_pod, new_pod):
+                del self._unschedulable[key]
+                self._heap_add(new_pod)
+            else:
+                self._unschedulable[key] = new_pod
+            return
+        self._heap_add(new_pod)
+        self._add_nominated(new_pod)
 
     def delete(self, pod: Pod) -> None:
+        """scheduling_queue.go:365-376."""
         key = pod.key()
         self._delete_nominated(pod)
-        self._active_items.pop(key, None)
-        self._unschedulable.pop(key, None)
+        if key in self._active_items:
+            del self._active_items[key]
+            self._active_seq.pop(key, None)
+        else:
+            self._unschedulable.pop(key, None)
+
+    # --- assigned-pod events (scheduling_queue.go:378-446) ---
+
+    def assigned_pod_added(self, pod: Pod) -> None:
+        self._move_pods_to_active_queue(
+            self._unschedulable_pods_with_matching_affinity_term(pod))
+
+    def assigned_pod_updated(self, pod: Pod) -> None:
+        self._move_pods_to_active_queue(
+            self._unschedulable_pods_with_matching_affinity_term(pod))
+
+    def _move_pods_to_active_queue(self, pods: List[Pod]) -> None:
+        for pod in pods:
+            self._heap_add(pod)
+            self._unschedulable.pop(pod.key(), None)
+        self.received_move_request = True
+
+    def _unschedulable_pods_with_matching_affinity_term(
+            self, pod: Pod) -> List[Pod]:
+        """getUnschedulablePodsWithMatchingAffinityTerm: parked pods with any
+        REQUIRED pod-affinity term matching the newly assigned pod."""
+        from tpusim.engine.predicates import (
+            get_namespaces_from_pod_affinity_term,
+            get_pod_affinity_terms,
+            pod_matches_term_namespace_and_selector,
+        )
+
+        to_move = []
+        for up in self._unschedulable.values():
+            affinity = up.spec.affinity
+            if affinity is None or affinity.pod_affinity is None:
+                continue
+            for term in get_pod_affinity_terms(affinity.pod_affinity):
+                namespaces = get_namespaces_from_pod_affinity_term(up, term)
+                if pod_matches_term_namespace_and_selector(
+                        pod, namespaces, term.label_selector):
+                    to_move.append(up)
+                    break
+        return to_move
 
     def move_all_to_active_queue(self) -> None:
-        for pod in list(self._unschedulable.values()):
-            key = pod.key()
-            if key not in self._active_items:
-                heapq.heappush(self._active,
-                               (-get_pod_priority(pod), next(self._counter), key))
-                self._active_items[key] = pod
+        """scheduling_queue.go:391-410 (pods keep their nominated entries)."""
+        for pod in self._unschedulable.values():
+            self._heap_add(pod)
         self._unschedulable.clear()
         self.received_move_request = True
 

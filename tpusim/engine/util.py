@@ -45,11 +45,13 @@ class PodBackoff:
         self._entries: Dict[str, BackoffEntry] = {}
 
     def get_entry(self, pod_id: str) -> BackoffEntry:
+        """GetEntry also refreshes lastUpdate (backoff_utils.go:122-132)."""
         entry = self._entries.get(pod_id)
         if entry is None:
             entry = BackoffEntry()
             entry.backoff = self.default_duration
             self._entries[pod_id] = entry
+        entry.last_update = self._clock()
         return entry
 
     def get_backoff_time(self, pod_id: str) -> float:
@@ -62,15 +64,23 @@ class PodBackoff:
 
     def try_backoff_and_wait(self, pod_id: str) -> bool:
         """Non-sleeping variant used by the simulator: reports whether the pod
-        is allowed to retry now (no real wall-clock waits in an offline sim)."""
-        entry = self.get_entry(pod_id)
+        is allowed to retry now (no real wall-clock waits in an offline sim).
+        Reads the entry WITHOUT the GetEntry lastUpdate refresh — the elapsed
+        time since the last recorded backoff is the whole question."""
+        entry = self._entries.get(pod_id)
         now = self._clock()
+        if entry is None:
+            self.get_entry(pod_id)  # creates the entry (stamps lastUpdate)
+            return True
         if now - entry.last_update >= entry.backoff:
             entry.last_update = now
             return True
         return False
 
-    def gc(self, max_age: float = 60.0) -> None:
+    def gc(self, max_age: float = None) -> None:
+        """backoff_utils.go Gc: entries idle longer than maxDuration drop."""
+        if max_age is None:
+            max_age = self.max_duration
         now = self._clock()
         stale = [k for k, e in self._entries.items()
                  if now - e.last_update > max_age]

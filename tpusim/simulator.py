@@ -154,7 +154,8 @@ class ClusterCapacity:
                 config.algorithm_provider, args)
         self.scheduler.scheduling_queue = self.scheduling_queue
         if config.enable_equivalence_cache:
-            self.scheduler.equivalence_cache = EquivalenceCache()
+            self.scheduler.equivalence_cache = EquivalenceCache(
+                pvc_getter=self.volume_binder.get_pvc)
         # PDBs come from the fake informer in the reference (empty,
         # simulator.go:352-366) but can be injected for preemption studies
         self.pdbs: list = []
@@ -183,9 +184,23 @@ class ClusterCapacity:
                     or pod.key() not in self.cache.pod_states:
                 self.cache.add_pod(pod)
                 self._invalidate_ecache_for_node(pod.spec.node_name)
+            # factory.go:607-615 wires assigned-pod informer events to the
+            # queue's affinity-triggered moves: a bound pod may make parked
+            # pods with matching required pod-affinity terms schedulable
+            queue = getattr(self, "scheduling_queue", None)
+            if queue is not None:
+                if event == ADDED:
+                    queue.assigned_pod_added(pod)
+                else:
+                    queue.assigned_pod_updated(pod)
         elif event == DELETED and pod.key() in self.cache.pod_states:
             self.cache.remove_pod(pod)
             self._invalidate_ecache_for_node(pod.spec.node_name)
+            # factory.go:624-631: a deleted pod may free anti-affinity or
+            # resources anywhere — move everything back to active
+            queue = getattr(self, "scheduling_queue", None)
+            if queue is not None:
+                queue.move_all_to_active_queue()
 
     def _invalidate_ecache_for_node(self, node_name: str) -> None:
         """The factory event handlers invalidate cached predicate results when

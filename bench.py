@@ -66,9 +66,11 @@ def log(msg: str) -> None:
 # --------------------------------------------------------------------------
 
 def build_workload(num_pods: int, num_nodes: int, affinity: bool = False,
-                   seed: int = 12345):
+                   seed: int = 12345, priorities: bool = False):
     """Config-3 shape: heterogeneous nodes (taint slice, zone labels) + Zipf
-    pods; affinity=True adds the config-4 node-affinity slice."""
+    pods; affinity=True adds the config-4 node-affinity slice; priorities=True
+    adds the config-6 priority bands (60% band 0, 30% band 500, 10% band
+    1000 — saturation makes late high-priority pods preempt earlier ones)."""
     from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
 
     rng = np.random.RandomState(seed)
@@ -105,8 +107,12 @@ def build_workload(num_pods: int, num_nodes: int, affinity: bool = False,
                     "nodeSelectorTerms": [{"matchExpressions": [
                         {"key": "zone", "operator": "In",
                          "values": [f"z{want_zone[i]}"]}]}]}}}
-        pods.append(make_pod(f"p-{i}", milli_cpu=int(cpu_buckets[cpu_idx[i]]),
-                             memory=int(mem_buckets[mem_idx[i]]), **kwargs))
+        pod = make_pod(f"p-{i}", milli_cpu=int(cpu_buckets[cpu_idx[i]]),
+                       memory=int(mem_buckets[mem_idx[i]]), **kwargs)
+        if priorities:
+            pod.spec.priority = int(rng.choice([0, 500, 1000],
+                                               p=[0.6, 0.3, 0.1]))
+        pods.append(pod)
     return ClusterSnapshot(nodes=nodes), pods
 
 
@@ -362,14 +368,14 @@ def _ladder_configs() -> set:
     without repeating the whole ladder). Called in the PARENT before any
     child spawns: a typo'd knob must fail instantly, not burn the full
     retry ladder (each child pays backend init) producing "no JSON line"."""
-    raw = os.environ.get("TPUSIM_BENCH_LADDER_CONFIGS", "1,2,3,4,5")
+    raw = os.environ.get("TPUSIM_BENCH_LADDER_CONFIGS", "1,2,3,4,5,6")
     try:
         wanted = {int(c) for c in raw.split(",") if c.strip()}
     except ValueError:
         wanted = set()
-    if not wanted or not wanted <= {1, 2, 3, 4, 5}:
+    if not wanted or not wanted <= {1, 2, 3, 4, 5, 6}:
         raise SystemExit(
-            f"TPUSIM_BENCH_LADDER_CONFIGS={raw!r}: need values in 1-5")
+            f"TPUSIM_BENCH_LADDER_CONFIGS={raw!r}: need values in 1-6")
     return wanted
 
 
@@ -481,6 +487,78 @@ def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> Non
             "value": round(total / e2e, 1), "unit": "pods/s",
             "vs_baseline": 0})
         print(json.dumps(results[-1]), flush=True)
+
+    if 6 in wanted:
+        results.append(measure_preemption(platform, baseline_pods))
+        print(json.dumps(results[-1]), flush=True)
+
+
+def measure_preemption(platform: str, baseline_pods: int) -> dict:
+    """Config 6: the host-device hybrid preemption path (jaxe/preempt.py) on
+    a priority-banded, saturated config-4-style shape. Measures end-to-end
+    pods/s (device scans + host Preempt re-dispatches) and placement parity
+    vs the reference orchestrator on a subsample. Reference pipeline:
+    core/generic_scheduler.go:205-262 driven from scheduler.go:449-455."""
+    from tpusim.simulator import run_simulation
+
+    # ~1.5x CPU oversubscription: late high-priority pods must preempt
+    p6 = int(os.environ.get("TPUSIM_BENCH_PREEMPT_PODS",
+                            20_000 if platform != "cpu" else 6_000))
+    n6 = int(os.environ.get("TPUSIM_BENCH_PREEMPT_NODES",
+                            1_000 if platform != "cpu" else 300))
+    snapshot, pods = build_workload(p6, n6, affinity=True, priorities=True,
+                                    seed=777)
+    log(f"[config 6] {p6} priority-banded pods x {n6} nodes "
+        "(--enable-pod-priority)")
+
+    def outcome_map(status):
+        placed = {p.name: p.spec.node_name for p in status.successful_pods}
+        failed = {p.name for p in status.failed_pods}
+        return placed, failed
+
+    sub = min(baseline_pods, p6)
+    mismatches = None
+    if sub:
+        # fresh copies per run: the orchestrator seams mutate fed pods in
+        # place (Unschedulable conditions, nominated node names) and stale
+        # status would contaminate the later runs' nominated-pods index
+        t0 = time.perf_counter()
+        ref_status = run_simulation([p.copy() for p in pods[:sub]], snapshot,
+                                    backend="reference",
+                                    enable_pod_priority=True)
+        ref_elapsed = max(time.perf_counter() - t0, 1e-9)
+        log(f"  reference orchestrator: {sub} pods in {ref_elapsed:.1f}s "
+            f"= {sub / ref_elapsed:.1f} pods/s "
+            f"({len(ref_status.preempted_pods)} preempted)")
+        jax_sub = run_simulation([p.copy() for p in pods[:sub]], snapshot,
+                                 backend="jax", enable_pod_priority=True)
+        ref_placed, ref_failed = outcome_map(ref_status)
+        jax_placed, jax_failed = outcome_map(jax_sub)
+        mismatches = sum(
+            1 for p in pods[:sub]
+            if jax_placed.get(p.name) != ref_placed.get(p.name)
+            or (p.name in jax_failed) != (p.name in ref_failed))
+        log(f"  parity check on first {sub} pods: {mismatches} mismatches")
+
+    t0 = time.perf_counter()
+    status = run_simulation([p.copy() for p in pods], snapshot, backend="jax",
+                            enable_pod_priority=True)
+    e2e = max(time.perf_counter() - t0, 1e-9)
+    rate = p6 / e2e
+    preempted = len(status.preempted_pods)
+    log(f"  hybrid end-to-end: {p6} pods in {e2e:.1f}s = {rate:.0f} pods/s "
+        f"({len(status.successful_pods)} scheduled, "
+        f"{len(status.failed_pods)} unschedulable, {preempted} preempted)")
+    return {
+        "metric": f"scheduled pods/sec (config 6: {p6 // 1000}k "
+                  f"priority-banded pods, {n6} nodes, preemption hybrid, "
+                  f"platform={platform}, preempted={preempted}"
+                  + (f", parity_mismatches={mismatches}"
+                     if mismatches is not None else "") + ")",
+        "value": round(rate, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(rate * ref_elapsed / sub, 2) if sub else 0,
+    }
 
 
 def run_phases(platform: str, chunk: int) -> None:

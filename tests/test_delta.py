@@ -362,3 +362,25 @@ def test_pv_pvc_events_through_event_log_loader(tmp_path):
                       volumes=[make_pod_volume("v", pvc="claim-x")])]
     placements = assert_equiv(inc, probe)
     assert placements[0].node_name == "n0"
+
+
+def test_run_simulation_folds_pv_pvc_events_for_both_backends():
+    """run_simulation's event fold must carry PV/PVC events into the snapshot
+    the backends see (not just the jax precompiled path)."""
+    from tpusim.api.snapshot import make_pod_volume, make_pv, make_pvc
+    from tpusim.simulator import run_simulation
+
+    nodes = [make_node(f"n{i}", milli_cpu=2000,
+                       labels={ZONE: f"zone-{i}"}) for i in range(2)]
+    events = [
+        (ADDED, make_pv("pv-late", labels={ZONE: "zone-1"},
+                        source={"gcePersistentDisk": {"pdName": "late"}})),
+        (ADDED, make_pvc("claim-late", volume_name="pv-late")),
+    ]
+    probe = [make_pod("q", milli_cpu=100,
+                      volumes=[make_pod_volume("v", pvc="claim-late")])]
+    for backend in ("reference", "jax"):
+        status = run_simulation(list(probe), ClusterSnapshot(nodes=nodes),
+                                backend=backend, events=list(events))
+        assert len(status.successful_pods) == 1, backend
+        assert status.successful_pods[0].spec.node_name == "n1", backend

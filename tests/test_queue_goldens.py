@@ -321,3 +321,22 @@ def test_simulator_wires_assigned_pod_events_to_queue():
         "bound-pod event did not move the parked affinity pod to activeQ"
     assert affinity_pod.key() in q._active_items
     assert q.received_move_request
+
+
+def test_parking_survives_earlier_binds():
+    """Regression (review finding): assigned-pod events raise
+    receivedMoveRequest on every bind, and the simulator must mirror Pop()'s
+    per-cycle reset — otherwise after the first bind no pod ever parks."""
+    from tpusim.api.snapshot import make_node
+    from tpusim.simulator import ClusterCapacity, SchedulerServerConfig
+
+    small = make_pod("small", milli_cpu=100)
+    big = make_pod("big", milli_cpu=100_000)  # can never fit
+    cfg = SchedulerServerConfig(enable_pod_priority=True)
+    # LIFO: small (last) pops first and binds; big then fails — and must PARK
+    cc = ClusterCapacity(cfg, [big, small], [], [make_node("n0", milli_cpu=2000)])
+    cc.run()
+    q = cc.scheduling_queue
+    assert big.key() in q._unschedulable, \
+        "a stale move-request flag kept the failed pod out of the parking lot"
+    assert big.key() not in q._active_items

@@ -266,6 +266,14 @@ class ClusterCapacity:
         pod = self.pod_queue.pop()
         if pod is None:
             return None
+        # scheduling_queue.Pop's receivedMoveRequest reset marks the start of
+        # a scheduling cycle (scheduling_queue.go:295-312); the simulator
+        # feeds from the LIFO pod queue instead of popping the scheduling
+        # queue, so the reset is mirrored here — a move request then flips
+        # parking to re-activation only when it arrived while THIS pod was
+        # in flight (e.g. a preemption's victim deletions), like upstream
+        if hasattr(self.scheduling_queue, "received_move_request"):
+            self.scheduling_queue.received_move_request = False
         self.resource_store.add(ResourceType.PODS, pod)
         return pod
 
@@ -480,11 +488,12 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
         incremental = IncrementalCluster(snapshot)
         incremental.apply_events(events)
         folded = incremental.to_snapshot()
-        # PV/PVC/StorageClass events are not part of the watch-fabric analog;
-        # the seeded volume objects pass through unchanged
+        # folded PV/PVC state includes applied PersistentVolume(Claim) events
+        # (jaxe/delta.py); StorageClass objects are not watch-fabric events
+        # and pass through from the seed snapshot
         snapshot = ClusterSnapshot(
             nodes=folded.nodes, pods=folded.pods, services=folded.services,
-            pvs=snapshot.pvs, pvcs=snapshot.pvcs,
+            pvs=folded.pvs, pvcs=folded.pvcs,
             storage_classes=snapshot.storage_classes)
     if backend == "reference":
         cc = ClusterCapacity(

@@ -234,3 +234,35 @@ def test_cli_snapshot_file(tmp_path, capsys):
                "--backend", "jax", "--quiet"])
     assert rc == 0
     assert "scheduled" in capsys.readouterr().out
+
+
+def test_auto_backend_routes_by_workload_size(monkeypatch):
+    """--backend auto: tiny workloads run the host orchestrator (no device
+    dispatch), larger ones construct the jax backend (threshold via
+    TPUSIM_AUTO_THRESHOLD, counted as pods x nodes)."""
+    import tpusim.backends as backends_mod
+    from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+    from tpusim.simulator import run_simulation
+
+    calls = []
+    real = backends_mod.get_backend
+
+    def spy(name, **kw):
+        calls.append(name)
+        return real(name, **kw)
+
+    monkeypatch.setattr(backends_mod, "get_backend", spy)
+    monkeypatch.delenv("TPUSIM_AUTO_THRESHOLD", raising=False)
+    nodes = [make_node(f"n{i}", milli_cpu=2000) for i in range(3)]
+    pods = [make_pod(f"p{i}", milli_cpu=100) for i in range(4)]
+
+    status = run_simulation(list(pods), ClusterSnapshot(nodes=nodes),
+                            backend="auto")
+    assert len(status.successful_pods) == 4
+    assert calls == []  # 4 x 3 < threshold: host engine, no jax construction
+
+    monkeypatch.setenv("TPUSIM_AUTO_THRESHOLD", "1")
+    status = run_simulation(list(pods), ClusterSnapshot(nodes=nodes),
+                            backend="auto")
+    assert len(status.successful_pods) == 4
+    assert calls == ["jax"]

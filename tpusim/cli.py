@@ -55,9 +55,14 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--namespace", default="default",
                         help="Namespace stamped onto simulated pods")
     # new flags (BASELINE.json)
-    parser.add_argument("--backend", default="jax", choices=["reference", "jax"],
-                        help="Scheduling engine: jax (TPU batched) or reference "
-                             "(pure-Python parity loop)")
+    parser.add_argument("--backend", default="auto",
+                        choices=["auto", "reference", "jax"],
+                        help="Scheduling engine: jax (TPU batched), reference "
+                             "(pure-Python parity loop), or auto (default — "
+                             "workloads under TPUSIM_AUTO_THRESHOLD pods x "
+                             "nodes [100k] run on the host engine, avoiding "
+                             "device-dispatch latency on tiny runs; larger "
+                             "ones use the jax engine)")
     parser.add_argument("--batch-size", type=int, default=0,
                         help="Wavefront batch size for the jax backend "
                              "(0 = exact sequential mode)")
@@ -274,8 +279,8 @@ def main(argv=None) -> int:
         print(f"error: {policy_err}", file=sys.stderr)
         return 2
 
-    if args.batch_size and args.backend != "jax":
-        print("error: --batch-size requires --backend jax", file=sys.stderr)
+    if args.batch_size and args.backend == "reference":
+        print("error: --batch-size requires the jax backend", file=sys.stderr)
         return 2
     events = None
     if args.event_log:

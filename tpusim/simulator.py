@@ -460,6 +460,46 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
     (restclient.go:218-236 → informer cache mutations) as data. On the jax
     backend the replay drives the IncrementalCluster column caches
     (jaxe/delta.py), so compiled state is patched, not rebuilt."""
+    incremental = None
+    if events:
+        from tpusim.jaxe.delta import IncrementalCluster
+
+        incremental = IncrementalCluster(snapshot)
+        incremental.apply_events(events)
+        folded = incremental.to_snapshot()
+        # folded PV/PVC state includes applied PersistentVolume(Claim) events
+        # (jaxe/delta.py); StorageClass objects are not watch-fabric events
+        # and pass through from the seed snapshot
+        snapshot = ClusterSnapshot(
+            nodes=folded.nodes, pods=folded.pods, services=folded.services,
+            pvs=folded.pvs, pvcs=folded.pvcs,
+            storage_classes=snapshot.storage_classes)
+    if backend == "auto":
+        # Tiny workloads lose to device-dispatch latency (BASELINE.md: the
+        # 20-pod quickstart runs ~400x slower through an accelerator tunnel
+        # than the host engine; the crossover sits around config 2's 1k x 100
+        # shape). Sized AFTER the event-log fold so node-adding logs count.
+        # The rule intentionally avoids initializing jax — merely listing
+        # devices can block on a wedged tunnel. Volume scheduling is
+        # host-bound and wins over everything, including a wavefront request
+        # (batch_size is then ignored, like the host-bound-policy path).
+        import os as _os
+
+        threshold = int(_os.environ.get("TPUSIM_AUTO_THRESHOLD", 100_000))
+        tiny = len(pods) * max(len(snapshot.nodes), 1) < threshold
+        if enable_volume_scheduling:
+            if batch_size:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "volume scheduling is host-bound: running the reference "
+                    "engine; --batch-size is ignored")
+                batch_size = 0
+            backend = "reference"
+        elif batch_size:
+            backend = "jax"  # an explicit wavefront request wins
+        else:
+            backend = "reference" if tiny else "jax"
     compiled_policy = None
     if policy is not None and backend == "jax":
         # compile (and validate) the policy for the device engine; the few
@@ -481,20 +521,6 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                 "orchestrator instead of the jax backend%s", reason,
                 "; --batch-size is ignored" if batch_size else "")
             backend = "reference"
-    incremental = None
-    if events:
-        from tpusim.jaxe.delta import IncrementalCluster
-
-        incremental = IncrementalCluster(snapshot)
-        incremental.apply_events(events)
-        folded = incremental.to_snapshot()
-        # folded PV/PVC state includes applied PersistentVolume(Claim) events
-        # (jaxe/delta.py); StorageClass objects are not watch-fabric events
-        # and pass through from the seed snapshot
-        snapshot = ClusterSnapshot(
-            nodes=folded.nodes, pods=folded.pods, services=folded.services,
-            pvs=folded.pvs, pvcs=folded.pvcs,
-            storage_classes=snapshot.storage_classes)
     if backend == "reference":
         cc = ClusterCapacity(
             SchedulerServerConfig(scheduler_name=scheduler_name,

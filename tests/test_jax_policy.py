@@ -618,3 +618,65 @@ def test_policy_unsupported_routes_end_to_end():
     jx = run_simulation(list(pods), snap, backend="jax", policy=policy)
     assert sig(jx) == sig(ref)
     assert jx.successful_pods
+
+
+def test_policy_legacy_aliases_compile_and_match():
+    """1.0 backward-compat names (compatibility_test.go '1.0' stanza):
+    ServiceSpreadingPriority shares SelectorSpread's device path
+    (service-derived signatures only) — naming BOTH spread priorities sums
+    their weights like two host instances. The PodFitsPorts predicate alias
+    is HOST-BOUND (it evaluates at the host's custom tail slot, which the
+    device's fixed-order pipeline cannot express) and must fall back."""
+    from tpusim.api.types import Service
+
+    snapshot = mixed_cluster()
+    snapshot.services = [Service.from_obj(
+        {"metadata": {"name": "web", "namespace": "default"},
+         "spec": {"selector": {"app": "web"}}})]
+    pods = workload()
+    for i, p in enumerate(pods):
+        if i % 2 == 0:
+            p.metadata.labels["app"] = "web"
+    policy = Policy(
+        predicates=[PredicatePolicy(name="PodFitsHostPorts"),
+                    PredicatePolicy(name="PodFitsResources"),
+                    PredicatePolicy(name="MatchNodeSelector")],
+        priorities=[PriorityPolicy(name="ServiceSpreadingPriority", weight=2),
+                    PriorityPolicy(name="SelectorSpreadPriority", weight=3)])
+    cp = compile_policy(policy)
+    assert not cp.unsupported
+    assert cp.spec.w_spread == 5  # summed, like two host instances
+    assert_policy_parity(pods, snapshot, policy)
+
+    # the 1.0 predicate alias routes host-side (documented fallback) but
+    # still schedules with identical results end to end
+    legacy = Policy(
+        predicates=[PredicatePolicy(name="PodFitsPorts"),
+                    PredicatePolicy(name="PodFitsResources"),
+                    PredicatePolicy(name="MatchNodeSelector")],
+        priorities=[PriorityPolicy(name="ServiceSpreadingPriority", weight=2)])
+    cp = compile_policy(legacy)
+    assert any("PodFitsPorts" in u for u in cp.unsupported)
+    assert_policy_parity(pods, snapshot, legacy)
+
+
+def test_policy_custom_arg_under_alias_name_keeps_its_own_key():
+    """Review regression: a labelsPresence custom named 'PodFitsPorts' must
+    register under ITS OWN name (plugins.go registers customs by the policy
+    name; alias resolution only applies to the no-argument lookup), not be
+    silently collapsed into PodFitsHostPorts."""
+    policy = Policy(
+        predicates=[
+            PredicatePolicy(name="PodFitsPorts", argument=PredicateArgument(
+                labels_presence=LabelsPresenceArg(labels=["disktype"],
+                                                  presence=True))),
+            PredicatePolicy(name="PodFitsHostPorts"),
+        ],
+        priorities=[PriorityPolicy(name="LeastRequestedPriority", weight=1)])
+    cp = compile_policy(policy)
+    # the custom keeps its own (tail-slot) entry and the builtin survives
+    assert cp.label_rows, "custom label predicate was dropped"
+    status = assert_policy_parity(workload(), mixed_cluster(), policy)
+    # presence=True on 'disktype': only the ssd-labeled nodes qualify
+    assert all(p.spec.node_name in ("n0", "n2", "n4")
+               for p in status.successful_pods)

@@ -187,6 +187,8 @@ def default_registry() -> AlgorithmRegistry:
     r.register_fit_predicate(preds.POD_FITS_RESOURCES_PRED, preds.pod_fits_resources)
     r.register_fit_predicate(preds.HOSTNAME_PRED, preds.pod_fits_host)
     r.register_fit_predicate(preds.POD_FITS_HOST_PORTS_PRED, preds.pod_fits_host_ports)
+    # 1.0 backward-compat alias for PodFitsHostPorts (defaults.go:63-65)
+    r.register_fit_predicate("PodFitsPorts", preds.pod_fits_host_ports)
     r.register_fit_predicate(preds.MATCH_NODE_SELECTOR_PRED, preds.pod_match_node_selector)
     r.register_fit_predicate(preds.CHECK_NODE_UNSCHEDULABLE_PRED,
                              preds.check_node_unschedulable)
@@ -235,6 +237,14 @@ def default_registry() -> AlgorithmRegistry:
                                   prios.compute_taint_toleration_priority_map,
                                   prios.compute_taint_toleration_priority_reduce, 1)
     # registered-but-not-default (defaults.go:100-111)
+    # 1.0 backward-compat alias: service-only spreading (defaults.go:89-101 —
+    # SelectorSpread over the service lister with EMPTY controller/RS/SS
+    # listers, unlike SelectorSpreadPriority's fully-wired instance)
+    r.register_priority_config_factory(
+        "ServiceSpreadingPriority",
+        PriorityConfigFactory(
+            map_reduce_function=lambda args: _service_spreading_map_reduce(args),
+            weight=1))
     r.register_priority_function2("EqualPriority", prios.equal_priority_map, None, 1)
     r.register_priority_function2("ImageLocalityPriority",
                                   prios.image_locality_priority_map, None, 1)
@@ -273,6 +283,14 @@ def default_registry() -> AlgorithmRegistry:
 def _selector_spread_map_reduce(args: PluginFactoryArgs):
     spread = args.selector_spread()
     return spread.calculate_spread_priority_map, spread.calculate_spread_priority_reduce
+
+
+def _service_spreading_map_reduce(args: PluginFactoryArgs):
+    """ServiceSpreadingPriority (1.0 alias): services only, empty controller/
+    ReplicaSet/StatefulSet listers (defaults.go:92-100)."""
+    spread = prios.SelectorSpread(args.service_lister)
+    return (spread.calculate_spread_priority_map,
+            spread.calculate_spread_priority_reduce)
 
 
 def create_from_provider(provider: str, args: PluginFactoryArgs,

@@ -56,8 +56,22 @@ COMPILABLE_PREDS = frozenset({
     preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
 })
 
+# 1.0 backward-compat alias (defaults.go:63-65). HOST-BOUND, not aliased to
+# the hostports slot: the host engine evaluates registry keys outside
+# predicates.Ordering() at the alphabetical TAIL slot (the documented
+# deliberate deviation in generic_scheduler.py), so "PodFitsPorts" short-
+# circuits in a different position than "PodFitsHostPorts" — first-failure
+# reason strings can differ. The device's fixed-slot pipeline cannot express
+# a standard predicate at a tail slot; policies naming the alias fall back.
+_HOST_BOUND_PRED_ALIASES = frozenset({"PodFitsPorts"})
+
 # priority name -> PolicySpec weight field (EqualPriority adds the same
-# constant to every node, so it cannot change the argmax or the tie set)
+# constant to every node, so it cannot change the argmax or the tie set).
+# ServiceSpreadingPriority (the 1.0 alias) shares w_spread: the device's
+# spread signatures are service-derived only (state.py — RC/RS/StatefulSet
+# informers are empty fakes in the simulator, simulator.go:352-366), so the
+# alias scores identically to SelectorSpreadPriority and a policy naming
+# BOTH sums their weights, matching two host instances' summed scores.
 _WEIGHT_FIELDS: Dict[str, str] = {
     "LeastRequestedPriority": "w_least",
     "MostRequestedPriority": "w_most",
@@ -66,6 +80,7 @@ _WEIGHT_FIELDS: Dict[str, str] = {
     "TaintTolerationPriority": "w_taint",
     "NodePreferAvoidPodsPriority": "w_avoid",
     "SelectorSpreadPriority": "w_spread",
+    "ServiceSpreadingPriority": "w_spread",
     "InterPodAffinityPriority": "w_interpod",
 }
 # every priority the 1.10 registry knows now compiles (ImageLocality rides a
@@ -133,6 +148,12 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                               bool(arg.labels_presence.presence)))
             elif pp.name in COMPILABLE_PREDS:
                 pred_by_name[pp.name] = ("standard",)
+            elif pp.name in _HOST_BOUND_PRED_ALIASES:
+                unsupported.append(
+                    f"predicate {pp.name} (1.0 alias; evaluates at the "
+                    "host's custom tail slot, not the device's fixed "
+                    "ordering)")
+                continue
             else:
                 # plugins.go RegisterCustomFitPredicate's failure, byte-matched
                 raise KeyError("Invalid configuration: Predicate type not "
@@ -226,7 +247,10 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                                f"found for {pr.name}")
         for entry in prio_by_name.values():
             if entry[0] == "weight":
-                weights[entry[1]] = entry[2]
+                # += not =: two NAMES sharing a field (SelectorSpread +
+                # ServiceSpreading aliases) sum like two host instances;
+                # same-name duplicates already collapsed last-wins above
+                weights[entry[1]] += entry[2]
             elif entry[0] == "label":
                 label_prios.append(entry[1])
             elif entry[0] == "image":

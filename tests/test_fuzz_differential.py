@@ -201,3 +201,93 @@ def test_fuzz_preemption_parity():
         jx = run_simulation(list(pods), snapshot, backend="jax",
                             enable_pod_priority=True)
         assert sig(jx) == sig(ref), f"seed {seed}"
+
+
+def _fuzz_seeds(default: int) -> int:
+    """TPUSIM_FUZZ_SEEDS scales the committed quick sweeps into extended
+    campaigns (COVERAGE.md 'verification campaign')."""
+    import os
+
+    try:
+        return max(int(os.environ.get("TPUSIM_FUZZ_SEEDS", default)), 1)
+    except ValueError:
+        return default
+
+
+def random_volume_cluster(rng: random.Random):
+    """random_cluster + zone-labeled PVs, bound/unbound PVCs, and scalar
+    (extended) node resources — the round-3 feature axes."""
+    from tpusim.api.snapshot import make_pv, make_pvc
+
+    snapshot = random_cluster(rng)
+    ZONE = "failure-domain.beta.kubernetes.io/zone"
+    for i, node in enumerate(snapshot.nodes):
+        node.metadata.labels[ZONE] = f"vz{i % 2}"
+    pvs, pvcs = [], []
+    for v in range(rng.randint(1, 5)):
+        src = rng.choice([
+            {"gcePersistentDisk": {"pdName": f"disk-{v % 3}"}},
+            {"awsElasticBlockStore": {"volumeID": f"ebs-{v % 3}"}},
+        ])
+        pvs.append(make_pv(f"pv-{v}", labels={ZONE: f"vz{v % 2}"}, source=src))
+        pvcs.append(make_pvc(f"claim-{v}", volume_name=f"pv-{v}"))
+    snapshot.pvs, snapshot.pvcs = pvs, pvcs
+    # scalar resources on a node slice
+    for node in snapshot.nodes:
+        if rng.random() < 0.5:
+            node.status.allocatable["example.com/widget"] = \
+                __import__("tpusim.api.quantity", fromlist=["parse_quantity"]
+                           ).parse_quantity(str(rng.randint(1, 4)))
+    return snapshot
+
+
+def random_volume_pods(rng: random.Random, count: int, n_claims: int):
+    from tpusim.api.quantity import parse_quantity
+    from tpusim.api.snapshot import make_pod_volume
+    from tpusim.api.types import Volume
+
+    pods = random_pods(rng, count)
+    for p in pods:
+        roll = rng.random()
+        if roll < 0.3 and n_claims:
+            p.spec.volumes = [Volume.from_obj(make_pod_volume(
+                "v", pvc=f"claim-{rng.randrange(n_claims)}"))]
+        elif roll < 0.45:
+            p.spec.volumes = [Volume.from_obj(make_pod_volume(
+                "d", source={"gcePersistentDisk":
+                             {"pdName": f"disk-{rng.randrange(3)}"}}))]
+        if rng.random() < 0.3:
+            p.spec.containers[0].requests["example.com/widget"] = \
+                parse_quantity(str(rng.randint(1, 2)))
+    return pods
+
+
+def test_fuzz_volume_scalar_parity():
+    """Round-3 axes: PVC/zone/disk-conflict volumes + scalar resources,
+    reference vs device engine, fresh AND incremental compiles."""
+    from tpusim.jaxe.delta import IncrementalCluster
+
+    for seed in range(_fuzz_seeds(4)):
+        rng = random.Random(4000 + seed)
+        snapshot = random_volume_cluster(rng)
+        pods = random_volume_pods(rng, rng.randint(12, 20),
+                                  len(snapshot.pvcs))
+        ref = run_simulation(list(pods), snapshot, backend="reference")
+        jx = run_simulation(list(pods), snapshot, backend="jax")
+        assert sig(jx) == sig(ref), f"seed {seed}"
+        # incremental path: seed an empty cluster, stream everything as events
+        inc = IncrementalCluster(ClusterSnapshot(
+            nodes=snapshot.nodes, pvs=snapshot.pvs, pvcs=snapshot.pvcs))
+        from tpusim.framework.store import ADDED
+
+        for placed in snapshot.pods:
+            inc.apply(ADDED, placed)
+        for svc in snapshot.services:
+            inc.apply(ADDED, svc)
+        from tpusim.backends import ReferenceBackend, placement_hash
+        from tpusim.jaxe.backend import JaxBackend
+
+        feed = list(reversed(pods))
+        incr = inc.schedule(list(feed))
+        fresh = JaxBackend().schedule(list(feed), inc.to_snapshot())
+        assert placement_hash(incr) == placement_hash(fresh), f"seed {seed}"

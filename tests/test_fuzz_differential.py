@@ -217,6 +217,7 @@ def _fuzz_seeds(default: int) -> int:
 def random_volume_cluster(rng: random.Random):
     """random_cluster + zone-labeled PVs, bound/unbound PVCs, and scalar
     (extended) node resources — the round-3 feature axes."""
+    from tpusim.api.quantity import parse_quantity
     from tpusim.api.snapshot import make_pv, make_pvc
 
     snapshot = random_cluster(rng)
@@ -230,14 +231,18 @@ def random_volume_cluster(rng: random.Random):
             {"awsElasticBlockStore": {"volumeID": f"ebs-{v % 3}"}},
         ])
         pvs.append(make_pv(f"pv-{v}", labels={ZONE: f"vz{v % 2}"}, source=src))
-        pvcs.append(make_pvc(f"claim-{v}", volume_name=f"pv-{v}"))
+        # ~1 in 4 claims stays UNBOUND: a pod referencing it fails host-side
+        # ("unbound PersistentVolumeClaims") and forces the device's
+        # documented unresolvable-claim fallback — both paths must agree
+        bound = rng.random() >= 0.25
+        pvcs.append(make_pvc(f"claim-{v}",
+                             volume_name=f"pv-{v}" if bound else ""))
     snapshot.pvs, snapshot.pvcs = pvs, pvcs
     # scalar resources on a node slice
     for node in snapshot.nodes:
         if rng.random() < 0.5:
             node.status.allocatable["example.com/widget"] = \
-                __import__("tpusim.api.quantity", fromlist=["parse_quantity"]
-                           ).parse_quantity(str(rng.randint(1, 4)))
+                parse_quantity(str(rng.randint(1, 4)))
     return snapshot
 
 
@@ -290,4 +295,6 @@ def test_fuzz_volume_scalar_parity():
         feed = list(reversed(pods))
         incr = inc.schedule(list(feed))
         fresh = JaxBackend().schedule(list(feed), inc.to_snapshot())
+        host = ReferenceBackend().schedule(list(feed), inc.to_snapshot())
         assert placement_hash(incr) == placement_hash(fresh), f"seed {seed}"
+        assert placement_hash(incr) == placement_hash(host), f"seed {seed}"

@@ -1,0 +1,108 @@
+#!/usr/bin/env bash
+# Round-3 TPU capture runbook: run the moment the axon tunnel heals.
+# Sequential by design — ONE TPU client at a time; never kill -9 a child
+# (bench.py's own watchdog stops children SIGINT-first).
+#
+# Produces, under bench_results/:
+#   r3_tpu_ladder.jsonl   — configs 1-6 (incl. the preemption hybrid)
+#   r3_tpu_fast.jsonl     — Pallas fastscan on configs 3-4 (TPUSIM_FAST=1);
+#                           hash parity vs the XLA scan is checked by
+#                           comparing placement_hash fields across the files
+#   r3_tpu_phases.jsonl   — unroll + wavefront K sweeps and the phase split
+#
+# Each stage prints partial JSON lines as it goes, so a mid-run wedge still
+# leaves the completed stages on disk.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+
+run_stage() {
+    # run_stage <name> <jsonl-out> <log-out> <command...>
+    # The pipe lives INSIDE this function so its status (pipefail: the
+    # command's own exit) is checked at function scope — an `exit` here
+    # terminates the script, not a pipeline subshell.
+    local name="$1" out="$2" log="$3"
+    shift 3
+    "$@" 2> >(tee "$log" >&2) | tee "$out"
+    local st=$?
+    if [ "$st" -ne 0 ]; then
+        echo "== stage '$name' FAILED (exit $st); aborting — partial JSONL" \
+             "is on disk; do not start another TPU client against a" \
+             "possibly wedged tunnel ==" >&2
+        exit 1
+    fi
+}
+
+probe() {
+    timeout 60 python -c "
+import jax; d = jax.devices()
+import jax.numpy as jnp
+assert int(jnp.ones((8, 8)).sum()) == 64
+print('PROBE OK:', d)" 2>&1 | tail -1
+}
+
+echo "== pre-flight probe =="
+if ! probe | grep -q "PROBE OK"; then
+    echo "tunnel not healthy; aborting (re-run when the probe passes)" >&2
+    exit 1
+fi
+
+echo "== stage 1: full ladder (configs 1-6) =="
+run_stage ladder bench_results/r3_tpu_ladder.jsonl \
+    bench_results/r3_tpu_ladder.log python bench.py --ladder
+
+echo "== stage 2: Pallas fastscan, configs 3-4 =="
+run_stage fastscan bench_results/r3_tpu_fast.jsonl \
+    bench_results/r3_tpu_fast.log \
+    env TPUSIM_FAST=1 TPUSIM_BENCH_LADDER_CONFIGS=3,4 python bench.py --ladder
+
+echo "== stage 3: phase split + unroll/wavefront sweeps =="
+run_stage phases bench_results/r3_tpu_phases.jsonl \
+    bench_results/r3_tpu_phases.log python bench.py --phases
+
+echo "== hash parity check (fastscan vs XLA scan) =="
+if ! python - <<'EOF'
+import json, re, sys
+
+def hashes(path):
+    out = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # truncated trailing line from a mid-run wedge: keep the
+                    # completed records
+                    continue
+                m = re.search(r"(config \d).*placement_hash=([0-9a-f]+)",
+                              rec.get("metric", ""))
+                if m:
+                    out[m.group(1)] = m.group(2)
+    except OSError:
+        pass
+    return out
+
+ladder = hashes("bench_results/r3_tpu_ladder.jsonl")
+fast = hashes("bench_results/r3_tpu_fast.jsonl")
+ok = True
+for cfg, h in fast.items():
+    want = ladder.get(cfg)
+    status = "MATCH" if h == want else f"MISMATCH (xla={want})"
+    if h != want:
+        ok = False
+    print(f"{cfg}: fastscan={h} {status}")
+if not fast:
+    print("no fastscan hashes captured", file=sys.stderr)
+    ok = False
+sys.exit(0 if ok else 1)
+EOF
+then
+    echo "== PARITY CHECK FAILED — do not record the fastscan rate ==" >&2
+    exit 1
+fi
+echo "== capture complete; update BASELINE.md with the numbers above =="

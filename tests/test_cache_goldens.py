@@ -1,0 +1,260 @@
+"""Golden tables ported from the reference's scheduler-cache suite.
+
+Reference: vendor/k8s.io/kubernetes/pkg/scheduler/schedulercache/cache_test.go
+(TestAssumePodScheduled:75, TestExpirePod:221, TestAddPodWillConfirm:278,
+TestAddPodWillReplaceAssumed:330, TestAddPodAfterExpiration:392,
+TestUpdatePod:439, TestExpireAddUpdatePod:505,
+TestEphemeralStorageResource:600, TestRemovePod:643, TestForgetPod:685).
+Not ported: TestNodeOperators:774 (generation/snapshot behavior is pinned by
+tests/test_cache.py's injected-clock suite) and TestPDBOperations:1073 (the
+reference caches PDBs beside nodes; this build keeps PDBs as an orchestrator
+list — simulator.py `self.pdbs` — because the fake PDB informer is empty,
+simulator.go:352-366).
+"""
+
+import pytest
+
+from tpusim.api.snapshot import make_pod
+from tpusim.api.types import ContainerPort
+from tpusim.engine.cache import SchedulerCache
+from tpusim.engine.resources import (
+    DEFAULT_MILLI_CPU_REQUEST,
+    DEFAULT_MEMORY_REQUEST,
+)
+
+NODE = "node"
+TTL = 10.0
+
+
+class Clock:
+    t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def base_pod(name, milli_cpu=0, memory=0, scalars=None, ports=(),
+             node_name=NODE):
+    """makeBasePod:  cpu/mem/extended requests + host ports."""
+    pod = make_pod(name, milli_cpu=milli_cpu, memory=memory,
+                   scalars=scalars, node_name=node_name)
+    pod.spec.containers[0].ports = [
+        ContainerPort.from_obj({"hostIP": ip, "hostPort": hp,
+                                "protocol": proto})
+        for ip, hp, proto in ports]
+    return pod
+
+
+def port(ip="127.0.0.1", hp=80, proto="TCP"):
+    return (ip, hp, proto)
+
+
+def assume_and_finish(cache, clock, pod, at):
+    clock.t = at
+    cache.assume_pod(pod)
+    cache.finish_binding(pod)
+
+
+def check_info(info, milli_cpu, memory, pods, ports, nz_cpu=None, nz_mem=None,
+               eph=0, scalars=None):
+    """deepEqualWithoutGeneration over the aggregate fields the tables pin."""
+    assert info is not None
+    assert info.requested_resource.milli_cpu == milli_cpu
+    assert info.requested_resource.memory == memory
+    assert info.requested_resource.ephemeral_storage == eph
+    assert dict(info.requested_resource.scalar) == (scalars or {})
+    assert info.nonzero_request.milli_cpu == \
+        (nz_cpu if nz_cpu is not None else milli_cpu)
+    assert info.nonzero_request.memory == \
+        (nz_mem if nz_mem is not None else memory)
+    assert [p.name for p in info.pods] == pods
+    want_ports = set(ports)
+    # exact cardinality + per-port conflict probes: stale entries can neither
+    # hide (len) nor replace an expected one (check_conflict)
+    assert len(info.used_ports) == len(want_ports)
+    for ip, hp, proto in want_ports:
+        assert info.used_ports.check_conflict(ip, proto, hp), (ip, hp)
+
+
+# TestAssumePodScheduled:75-205 — all 6 table rows
+ASSUME_CASES = [
+    # (pods spec, expected (cpu, mem, pods, ports, extras))
+    ([("test", 100, 500, None, [port()])],
+     dict(milli_cpu=100, memory=500, pods=["test"], ports=[port()])),
+    ([("test-1", 100, 500, None, [port()]),
+      ("test-2", 200, 1024, None, [port(hp=8080)])],
+     dict(milli_cpu=300, memory=1524, pods=["test-1", "test-2"],
+          ports=[port(), port(hp=8080)])),
+    # non-zero request defaults
+    ([("test-nonzero", 0, 0, None, [port()])],
+     dict(milli_cpu=0, memory=0, pods=["test-nonzero"], ports=[port()],
+          nz_cpu=DEFAULT_MILLI_CPU_REQUEST, nz_mem=DEFAULT_MEMORY_REQUEST)),
+    ([("test", 100, 500, {"example.com/foo": 3}, [port()])],
+     dict(milli_cpu=100, memory=500, pods=["test"], ports=[port()],
+          scalars={"example.com/foo": 3})),
+    ([("test", 100, 500, {"example.com/foo": 3}, [port()]),
+      ("test-2", 200, 1024, {"example.com/foo": 5}, [port(hp=8080)])],
+     dict(milli_cpu=300, memory=1524, pods=["test", "test-2"],
+          ports=[port(), port(hp=8080)],
+          scalars={"example.com/foo": 8})),
+    # row 6: an invalid (slash-less) extended-resource key is filtered out of
+    # the scalar accounting, and an empty ContainerPort (HostPort=0)
+    # registers nothing
+    ([("test", 100, 500, {"random-invalid-extended-key": 100},
+       [("", 0, "")])],
+     dict(milli_cpu=100, memory=500, pods=["test"], ports=[])),
+]
+
+
+@pytest.mark.parametrize("case", range(len(ASSUME_CASES)))
+def test_assume_pod_scheduled(case):
+    specs, want = ASSUME_CASES[case]
+    cache = SchedulerCache(ttl=1.0, now=Clock())
+    pods = [base_pod(n, c, m, scalars=s, ports=ps)
+            for n, c, m, s, ps in specs]
+    for pod in pods:
+        cache.assume_pod(pod)
+    check_info(cache.nodes[NODE], **want)
+    # ForgetPod returns every resource and clears the node entry
+    for pod in pods:
+        cache.forget_pod(pod)
+    assert NODE not in cache.nodes
+
+
+def test_expire_pod():
+    """TestExpirePod:221-274: assumed+finished pods expire at deadline; a pod
+    assumed later survives the same cleanup."""
+    clock = Clock()
+    cache = SchedulerCache(ttl=TTL, now=clock)
+    p1 = base_pod("test-1", 100, 500, ports=[port()])
+    p2 = base_pod("test-2", 200, 1024, ports=[port(hp=8080)])
+    now = clock.t
+    assume_and_finish(cache, clock, p1, now)
+    assume_and_finish(cache, clock, p2, now + 3 * TTL / 2)
+    cache.cleanup_assumed_pods(now + 2 * TTL)
+    check_info(cache.nodes[NODE], milli_cpu=200, memory=1024,
+               pods=["test-2"], ports=[port(hp=8080)])
+
+    # row 1 of the table: a single assumed pod fully expires the node entry
+    cache2 = SchedulerCache(ttl=TTL, now=clock)
+    assume_and_finish(cache2, clock, base_pod("test-1", 100, 500,
+                                              ports=[port()]), now)
+    cache2.cleanup_assumed_pods(now + 2 * TTL)
+    assert NODE not in cache2.nodes
+
+
+def test_add_pod_will_confirm():
+    """TestAddPodWillConfirm:278-327: Add() confirms an assumed pod, which
+    then survives expiry; the unconfirmed one expires."""
+    clock = Clock()
+    cache = SchedulerCache(ttl=TTL, now=clock)
+    p1 = base_pod("test-1", 100, 500, ports=[port()])
+    p2 = base_pod("test-2", 200, 1024, ports=[port(hp=8080)])
+    now = clock.t
+    for pod in (p1, p2):
+        assume_and_finish(cache, clock, pod, now)
+    cache.add_pod(p1)
+    cache.cleanup_assumed_pods(now + 2 * TTL)
+    check_info(cache.nodes[NODE], milli_cpu=100, memory=500,
+               pods=["test-1"], ports=[port()])
+
+
+def test_add_pod_will_replace_assumed():
+    """TestAddPodWillReplaceAssumed:330-389: Add() on a different node moves
+    the accounting; a later Update keeps it on the actual node."""
+    clock = Clock()
+    cache = SchedulerCache(ttl=TTL, now=clock)
+    assumed = base_pod("test-1", 100, 500, ports=[("0.0.0.0", 80, "TCP")],
+                       node_name="assumed-node-1")
+    added = base_pod("test-1", 100, 500, ports=[("0.0.0.0", 80, "TCP")],
+                     node_name="actual-node")
+    updated = base_pod("test-1", 200, 500, ports=[("0.0.0.0", 90, "TCP")],
+                       node_name="actual-node")
+    assume_and_finish(cache, clock, assumed, clock.t)
+    cache.add_pod(added)
+    cache.update_pod(added, updated)
+    assert "assumed-node-1" not in cache.nodes
+    check_info(cache.nodes["actual-node"], milli_cpu=200, memory=500,
+               pods=["test-1"], ports=[("0.0.0.0", 90, "TCP")])
+
+
+def test_add_pod_after_expiration():
+    """TestAddPodAfterExpiration:392-436: an expired assumed pod is fully
+    removed, then a plain Add() brings it back."""
+    clock = Clock()
+    cache = SchedulerCache(ttl=TTL, now=clock)
+    pod = base_pod("test", 100, 500, ports=[port()])
+    now = clock.t
+    assume_and_finish(cache, clock, pod, now)
+    cache.cleanup_assumed_pods(now + 2 * TTL)
+    assert NODE not in cache.nodes
+    cache.add_pod(pod)
+    check_info(cache.nodes[NODE], milli_cpu=100, memory=500,
+               pods=["test"], ports=[port()])
+
+
+@pytest.mark.parametrize("pre_expire", [False, True])
+def test_update_pod_and_expire_add_update(pre_expire):
+    """TestUpdatePod:439-502 and TestExpireAddUpdatePod:505-577 share the
+    update table; the latter runs it after an assume+expire+add cycle."""
+    clock = Clock()
+    cache = SchedulerCache(ttl=TTL, now=clock)
+    v0 = base_pod("test", 100, 500, ports=[port()])
+    v1 = base_pod("test", 200, 1024, ports=[port(hp=8080)])
+    if pre_expire:
+        now = clock.t
+        assume_and_finish(cache, clock, v0, now)
+        cache.cleanup_assumed_pods(now + 2 * TTL)
+        assert NODE not in cache.nodes
+    cache.add_pod(v0)
+    cache.update_pod(v0, v1)
+    check_info(cache.nodes[NODE], milli_cpu=200, memory=1024,
+               pods=["test"], ports=[port(hp=8080)])
+    cache.update_pod(v1, v0)
+    check_info(cache.nodes[NODE], milli_cpu=100, memory=500,
+               pods=["test"], ports=[port()])
+
+
+def test_ephemeral_storage_resource():
+    """TestEphemeralStorageResource:600-640."""
+    cache = SchedulerCache(ttl=1.0, now=Clock())
+    pod = make_pod("pod-with-ephemeral-storage", node_name=NODE)
+    from tpusim.api.quantity import parse_quantity
+
+    pod.spec.containers[0].requests["ephemeral-storage"] = parse_quantity("500")
+    cache.add_pod(pod)
+    check_info(cache.nodes[NODE], milli_cpu=0, memory=0, eph=500,
+               pods=["pod-with-ephemeral-storage"], ports=[],
+               nz_cpu=DEFAULT_MILLI_CPU_REQUEST,
+               nz_mem=DEFAULT_MEMORY_REQUEST)
+    cache.remove_pod(pod)
+    assert NODE not in cache.nodes
+
+
+def test_remove_pod():
+    """TestRemovePod:643-683."""
+    cache = SchedulerCache(ttl=1.0, now=Clock())
+    pod = base_pod("test", 100, 500, ports=[port()])
+    cache.add_pod(pod)
+    check_info(cache.nodes[NODE], milli_cpu=100, memory=500,
+               pods=["test"], ports=[port()])
+    cache.remove_pod(pod)
+    assert NODE not in cache.nodes
+
+
+def test_forget_pod():
+    """TestForgetPod:685-737: only assumed pods may be forgotten; forgetting
+    clears the assumed set and the node entry."""
+    clock = Clock()
+    cache = SchedulerCache(ttl=TTL, now=clock)
+    pod = base_pod("test", 100, 500, ports=[port()])
+    now = clock.t
+    assume_and_finish(cache, clock, pod, now)
+    assert cache.is_assumed_pod(pod)
+    assert cache.pod_states[pod.key()].pod.name == pod.name
+    cache.forget_pod(pod)
+    assert not cache.is_assumed_pod(pod)
+    cache.cleanup_assumed_pods(now + 2 * TTL)
+    assert NODE not in cache.nodes
+
+

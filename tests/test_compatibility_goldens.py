@@ -119,3 +119,61 @@ def test_every_registered_plugin_appears_in_a_stanza():
         f"registered predicates missing a stanza: {registered_preds - seen_preds}"
     assert registered_prios <= seen_prios, \
         f"registered priorities missing a stanza: {registered_prios - seen_prios}"
+
+
+# ---------------------------------------------------------------------------
+# factory/plugins_test.go
+# ---------------------------------------------------------------------------
+
+
+def test_algorithm_name_validation():
+    """TestAlgorithmNameValidation:26-45 (plugins.go validName regex)."""
+    from tpusim.engine.providers import VALID_NAME_RE
+
+    for name in ["1SomeAlgo1rithm", "someAlgor-ithm1"]:
+        assert VALID_NAME_RE.match(name), name
+    for name in ["-SomeAlgorithm", "SomeAlgorithm-", "Some,Alg:orithm"]:
+        assert not VALID_NAME_RE.match(name), name
+
+
+def test_validate_priority_config_overflow():
+    """TestValidatePriorityConfigOverFlow:48-81 (plugins.go
+    validateSelectedConfigs)."""
+    from tpusim.engine.priorities import MAX_PRIORITY, PriorityConfig
+    from tpusim.engine.providers import (
+        MAX_TOTAL_PRIORITY,
+        validate_selected_configs,
+    )
+
+    max_int = MAX_TOTAL_PRIORITY
+
+    def configs(*weights):
+        return [PriorityConfig(name=f"p{i}", weight=w, map_fn=lambda *_: None)
+                for i, w in enumerate(weights)]
+
+    cases = [
+        ("one of the weights is MaxInt", configs(max_int, 5), True),
+        ("after multiplication with MaxPriority the weight is larger than "
+         "MaxWeight",
+         configs(max_int // MAX_PRIORITY + MAX_PRIORITY, 5), True),
+        ("normal weights", configs(10000, 5), False),
+    ]
+    for description, cfgs, expect_overflow in cases:
+        if expect_overflow:
+            with pytest.raises(ValueError):
+                validate_selected_configs(cfgs)
+        else:
+            validate_selected_configs(cfgs)
+
+
+def test_registration_rejects_invalid_names():
+    """plugins.go validateAlgorithmNameOrDie at every registration seam."""
+    from tpusim.engine.providers import AlgorithmRegistry
+
+    r = AlgorithmRegistry()
+    with pytest.raises(ValueError):
+        r.register_fit_predicate("-BadName", lambda *a: (True, []))
+    with pytest.raises(ValueError):
+        r.register_priority_function2("Bad,Name", lambda *a: None, None, 1)
+    with pytest.raises(ValueError):
+        r.register_algorithm_provider("BadName-", set(), set())

@@ -553,9 +553,9 @@ def test_policy_service_affinity_multiple_entries_fall_back():
     from tpusim.engine.policy import ServiceAffinityArg
 
     policy = Policy(predicates=[
-        PredicatePolicy(name="A", argument=PredicateArgument(
+        PredicatePolicy(name="SA-One", argument=PredicateArgument(
             service_affinity=ServiceAffinityArg(labels=["zone"]))),
-        PredicatePolicy(name="B", argument=PredicateArgument(
+        PredicatePolicy(name="SA-Two", argument=PredicateArgument(
             service_affinity=ServiceAffinityArg(labels=["rack"]))),
     ], priorities=[])
     assert compile_policy(policy).unsupported
@@ -604,9 +604,9 @@ def test_policy_unsupported_routes_end_to_end():
     from tpusim.engine.policy import ServiceAffinityArg
 
     policy = Policy(predicates=[
-        PredicatePolicy(name="A", argument=PredicateArgument(
+        PredicatePolicy(name="SA-One", argument=PredicateArgument(
             service_affinity=ServiceAffinityArg(labels=["zone"]))),
-        PredicatePolicy(name="B", argument=PredicateArgument(
+        PredicatePolicy(name="SA-Two", argument=PredicateArgument(
             service_affinity=ServiceAffinityArg(labels=["disktype"]))),
         PredicatePolicy(name="PodFitsResources"),
     ], priorities=[PriorityPolicy(name="LeastRequestedPriority", weight=1)])

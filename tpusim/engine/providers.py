@@ -9,6 +9,7 @@ defaults with LeastRequested→MostRequested; defaults.go:33-37,207-217).
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set
 
@@ -64,6 +65,33 @@ class PriorityConfigFactory:
     weight: int = 1
 
 
+# plugins.go:476 validName — note the upstream regex requires >= 2 chars
+VALID_NAME_RE = re.compile(r"^[a-zA-Z0-9]([-a-zA-Z0-9]*[a-zA-Z0-9])$")
+# api/types.go:31-38 — MaxInt is Go's 64-bit int; MaxWeight = MaxInt/MaxPriority
+MAX_TOTAL_PRIORITY = 2**63 - 1
+
+
+def validate_algorithm_name(name: str) -> None:
+    """plugins.go:478-482 validateAlgorithmNameOrDie (raises, never dies).
+    fullmatch, not match: Python's $ would accept a trailing newline that
+    Go's end-of-text anchor rejects."""
+    if not VALID_NAME_RE.fullmatch(name):
+        raise ValueError(f"algorithm name {name!r} does not match the name "
+                         f"validation regex \"{VALID_NAME_RE.pattern}\"")
+
+
+def validate_selected_configs(configs: List["PriorityConfig"]) -> None:
+    """plugins.go:463-474: the summed weight*MaxPriority must not overflow."""
+    from tpusim.engine.priorities import MAX_PRIORITY
+
+    total = 0
+    for config in configs:
+        if config.weight * MAX_PRIORITY > MAX_TOTAL_PRIORITY - total:
+            raise ValueError(
+                "Total priority of priority functions has overflown")
+        total += config.weight * MAX_PRIORITY
+
+
 class AlgorithmRegistry:
     """One registry instance == the Go package-level registries."""
 
@@ -77,14 +105,17 @@ class AlgorithmRegistry:
     # --- registration (plugins.go:111-376) ---
 
     def register_fit_predicate(self, name: str, fn: Callable) -> str:
+        validate_algorithm_name(name)
         self.fit_predicates[name] = fn
         return name
 
     def register_fit_predicate_factory(self, name: str, factory: Callable) -> str:
+        validate_algorithm_name(name)
         self.fit_predicate_factories[name] = factory
         return name
 
     def register_mandatory_fit_predicate(self, name: str, fn: Callable) -> str:
+        validate_algorithm_name(name)
         self.fit_predicates[name] = fn
         self.mandatory_fit_predicates.add(name)
         return name
@@ -95,17 +126,20 @@ class AlgorithmRegistry:
         self.mandatory_fit_predicates.discard(name)
 
     def register_priority_function2(self, name: str, map_fn, reduce_fn, weight: int) -> str:
+        validate_algorithm_name(name)
         self.priority_factories[name] = PriorityConfigFactory(
             map_reduce_function=lambda args: (map_fn, reduce_fn), weight=weight)
         return name
 
     def register_priority_config_factory(self, name: str,
                                          factory: PriorityConfigFactory) -> str:
+        validate_algorithm_name(name)
         self.priority_factories[name] = factory
         return name
 
     def register_algorithm_provider(self, name: str, predicate_keys: Set[str],
                                     priority_keys: Set[str]) -> str:
+        validate_algorithm_name(name)
         self.providers[name] = (set(predicate_keys), set(priority_keys))
         return name
 
@@ -141,6 +175,7 @@ class AlgorithmRegistry:
                 map_fn, reduce_fn = factory.map_reduce_function(args)
                 configs.append(PriorityConfig(name=key, weight=factory.weight,
                                               map_fn=map_fn, reduce_fn=reduce_fn))
+        validate_selected_configs(configs)
         return configs
 
 

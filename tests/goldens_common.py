@@ -1,0 +1,30 @@
+"""Shared fixtures for the schedulercache golden suites.
+
+make_base_pod is the single port of cache_test.go's makeBasePod (used by both
+tests/test_cache_goldens.py and tests/test_node_info_goldens.py): quantity
+STRINGS, with empty cpu/memory meaning the key is ABSENT from requests — the
+non-zero defaulting applies only to unset keys, never explicit zeros
+(non_zero.go:36-54).
+"""
+
+from tpusim.api.quantity import parse_quantity
+from tpusim.api.snapshot import make_pod
+from tpusim.api.types import ContainerPort
+
+
+def make_base_pod(name, cpu="", memory="", scalars=None, ports=(),
+                  node_name="node"):
+    pod = make_pod(name, node_name=node_name)
+    requests = {}
+    if cpu:
+        requests["cpu"] = parse_quantity(cpu)
+    if memory:
+        requests["memory"] = parse_quantity(memory)
+    for scalar_name, qty in (scalars or {}).items():
+        requests[scalar_name] = parse_quantity(str(qty))
+    pod.spec.containers[0].requests = requests
+    pod.spec.containers[0].ports = [
+        ContainerPort.from_obj({"hostIP": ip, "hostPort": hp,
+                                "protocol": proto})
+        for ip, hp, proto in ports]
+    return pod

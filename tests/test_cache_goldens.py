@@ -13,9 +13,9 @@ simulator.go:352-366).
 """
 
 import pytest
+from goldens_common import make_base_pod
 
 from tpusim.api.snapshot import make_pod
-from tpusim.api.types import ContainerPort
 from tpusim.engine.cache import SchedulerCache
 from tpusim.engine.resources import (
     DEFAULT_MILLI_CPU_REQUEST,
@@ -35,14 +35,12 @@ class Clock:
 
 def base_pod(name, milli_cpu=0, memory=0, scalars=None, ports=(),
              node_name=NODE):
-    """makeBasePod:  cpu/mem/extended requests + host ports."""
-    pod = make_pod(name, milli_cpu=milli_cpu, memory=memory,
-                   scalars=scalars, node_name=node_name)
-    pod.spec.containers[0].ports = [
-        ContainerPort.from_obj({"hostIP": ip, "hostPort": hp,
-                                "protocol": proto})
-        for ip, hp, proto in ports]
-    return pod
+    """makeBasePod via the shared port: int milli-cpu/bytes become the
+    upstream tables' quantity strings."""
+    return make_base_pod(
+        name, cpu=f"{milli_cpu}m" if milli_cpu else "",
+        memory=str(memory) if memory else "", scalars=scalars, ports=ports,
+        node_name=node_name)
 
 
 def port(ip="127.0.0.1", hp=80, proto="TCP"):

@@ -1,0 +1,192 @@
+"""Golden tables ported from the reference's NodeInfo/Resource suite.
+
+Reference: vendor/k8s.io/kubernetes/pkg/scheduler/schedulercache/
+node_info_test.go (TestNewResource:31, TestResourceClone:113,
+TestResourceAddScalar:152, TestNewNodeInfo:197, TestNodeInfoClone:293,
+TestNodeInfoAddPod:449, TestNodeInfoRemovePod:605). Not ported:
+TestResourceList:69 — the reverse Resource->ResourceList conversion exists
+upstream for the PV controller's reactor; nothing in the scheduler path (or
+this build) consumes it.
+
+Generation deviation, documented: upstream increments a per-NodeInfo counter
+(expected generation: 2 after two adds); this build draws from a globally
+monotonic counter so generations are unique across instances
+(resources.py:_next_generation) — the tables therefore assert generation
+MOVEMENT, not absolute values.
+"""
+
+import pytest
+from goldens_common import make_base_pod
+
+from tpusim.api.quantity import parse_quantity
+from tpusim.engine.resources import (
+    DEFAULT_MILLI_CPU_REQUEST,
+    DEFAULT_MEMORY_REQUEST,
+    NodeInfo,
+    Resource,
+)
+
+NODE = "test-node"
+
+
+def rl(**kwargs):
+    """A v1.ResourceList analog: name -> parsed Quantity."""
+    out = {}
+    for name, qty in kwargs.pop("scalars", {}).items():
+        out[name] = parse_quantity(str(qty))
+    for name, qty in kwargs.items():
+        out[name.replace("_", "-") if name.startswith("hugepages") else name] \
+            = parse_quantity(str(qty))
+    return out
+
+
+def base_pod(name, cpu="", memory="", ports=()):
+    return make_base_pod(name, cpu=cpu, memory=memory, ports=ports,
+                         node_name=NODE)
+
+
+def test_new_resource():
+    """TestNewResource:31-67: empty list -> zero Resource; the full list maps
+    cpu (milli), memory, first-class nvidia GPU, pods, ephemeral storage, an
+    extended scalar, and a hugepages scalar."""
+    empty = Resource()
+    empty.add_resource_list({})
+    assert (empty.milli_cpu, empty.memory, empty.nvidia_gpu,
+            empty.ephemeral_storage, empty.allowed_pod_number,
+            empty.scalar) == (0, 0, 0, 0, 0, {})
+
+    r = Resource()
+    r.add_resource_list(rl(
+        cpu="4m", memory="2000", pods="80",
+        scalars={"alpha.kubernetes.io/nvidia-gpu": 1000,
+                 "ephemeral-storage": 5000,
+                 "scalar.test/scalar1": 1,
+                 "hugepages-test": 2}))
+    assert r.milli_cpu == 4
+    assert r.memory == 2000
+    assert r.nvidia_gpu == 1000
+    assert r.ephemeral_storage == 5000
+    assert r.allowed_pod_number == 80
+    assert r.scalar == {"scalar.test/scalar1": 1, "hugepages-test": 2}
+
+
+def test_resource_clone():
+    """TestResourceClone:113-150: mutating the original never touches the
+    clone (including the scalar map)."""
+    r = Resource(milli_cpu=4, memory=2000, nvidia_gpu=1000,
+                 ephemeral_storage=5000, allowed_pod_number=80,
+                 scalar={"scalar.test/scalar1": 1, "hugepages-test": 2})
+    c = r.clone()
+    r.milli_cpu += 1000
+    r.scalar["scalar.test/scalar1"] = 99
+    assert c.milli_cpu == 4
+    assert c.scalar == {"scalar.test/scalar1": 1, "hugepages-test": 2}
+
+    empty_clone = Resource().clone()
+    assert empty_clone.scalar == {} and empty_clone.milli_cpu == 0
+
+
+def test_resource_add_scalar():
+    """TestResourceAddScalar:152-195: scalar accumulation preserves existing
+    fields and existing scalar entries."""
+    r = Resource()
+    r.add_resource_list(rl(scalars={"scalar.test/scalar1": 100}))
+    assert r.scalar == {"scalar.test/scalar1": 100}
+
+    r2 = Resource(milli_cpu=4, memory=2000, nvidia_gpu=1000,
+                  ephemeral_storage=5000, allowed_pod_number=80,
+                  scalar={"hugepages-test": 2})
+    r2.add_resource_list(rl(scalars={"scalar.test/scalar2": 200}))
+    assert r2.scalar == {"hugepages-test": 2, "scalar.test/scalar2": 200}
+    assert (r2.milli_cpu, r2.memory, r2.nvidia_gpu, r2.ephemeral_storage,
+            r2.allowed_pod_number) == (4, 2000, 1000, 5000, 80)
+
+
+def two_pods():
+    return [base_pod("test-1", "100m", "500",
+                     ports=[("127.0.0.1", 80, "TCP")]),
+            base_pod("test-2", "200m", "1Ki",
+                     ports=[("127.0.0.1", 8080, "TCP")])]
+
+
+def check_aggregates(ni):
+    assert ni.requested_resource.milli_cpu == 300
+    assert ni.requested_resource.memory == 1524
+    assert ni.nonzero_request.milli_cpu == 300
+    assert ni.nonzero_request.memory == 1524
+    assert [p.name for p in ni.pods] == ["test-1", "test-2"]
+    assert len(ni.used_ports) == 2
+    assert ni.used_ports.check_conflict("127.0.0.1", "TCP", 80)
+    assert ni.used_ports.check_conflict("127.0.0.1", "TCP", 8080)
+
+
+def test_new_node_info():
+    """TestNewNodeInfo:197-291 (generation asserted as movement, see module
+    docstring)."""
+    ni = NodeInfo()
+    g0 = ni.generation
+    for pod in two_pods():
+        ni.add_pod(pod)
+    check_aggregates(ni)
+    assert ni.generation > g0
+
+
+def test_node_info_clone():
+    """TestNodeInfoClone:293-447: the clone shares nothing mutable with the
+    original."""
+    ni = NodeInfo()
+    for pod in two_pods():
+        ni.add_pod(pod)
+    c = ni.clone()
+    ni.remove_pod(ni.pods[0])
+    ni.used_ports.remove("127.0.0.1", "TCP", 8080)
+    check_aggregates(c)
+
+
+def test_node_info_add_pod():
+    """TestNodeInfoAddPod:449-603: aggregates, non-zero defaults for a
+    request-less pod, and port registration."""
+    ni = NodeInfo()
+    ni.add_pod(base_pod("test-1", "100m", "500",
+                        ports=[("127.0.0.1", 80, "TCP")]))
+    ni.add_pod(base_pod("test-zero"))  # no requests: non-zero defaults apply
+    assert ni.requested_resource.milli_cpu == 100
+    assert ni.requested_resource.memory == 500
+    assert ni.nonzero_request.milli_cpu == 100 + DEFAULT_MILLI_CPU_REQUEST
+    assert ni.nonzero_request.memory == 500 + DEFAULT_MEMORY_REQUEST
+    assert [p.name for p in ni.pods] == ["test-1", "test-zero"]
+
+
+def test_node_info_remove_pod():
+    """TestNodeInfoRemovePod:605-828: removing an unknown pod errors and
+    leaves the info untouched; removing a real pod subtracts everything."""
+    ni = NodeInfo()
+    for pod in two_pods():
+        ni.add_pod(pod)
+    with pytest.raises(KeyError):
+        ni.remove_pod(base_pod("non-exist"))
+    check_aggregates(ni)
+
+    ni.remove_pod(ni.pods[0])
+    assert ni.requested_resource.milli_cpu == 200
+    assert ni.requested_resource.memory == 1024
+    assert ni.nonzero_request.milli_cpu == 200
+    assert ni.nonzero_request.memory == 1024
+    assert [p.name for p in ni.pods] == ["test-2"]
+    assert len(ni.used_ports) == 1
+    assert ni.used_ports.check_conflict("127.0.0.1", "TCP", 8080)
+
+
+def test_nonzero_defaults_apply_to_unset_not_explicit_zero():
+    """non_zero.go:36-54: an EXPLICIT zero request stays zero; only an absent
+    key gets the 100m/200Mi defaults."""
+    explicit_zero = base_pod("zero")
+    explicit_zero.spec.containers[0].requests = rl(cpu="0", memory="0")
+    unset = base_pod("unset")
+    ni = NodeInfo()
+    ni.add_pod(explicit_zero)
+    assert ni.nonzero_request.milli_cpu == 0
+    assert ni.nonzero_request.memory == 0
+    ni.add_pod(unset)
+    assert ni.nonzero_request.milli_cpu == DEFAULT_MILLI_CPU_REQUEST
+    assert ni.nonzero_request.memory == DEFAULT_MEMORY_REQUEST

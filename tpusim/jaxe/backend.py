@@ -127,6 +127,12 @@ class JaxBackend:
             raise ValueError("fallback must be 'reference' or 'error'")
         if batch_size < 0:
             raise ValueError("batch_size must be >= 0")
+        if not 1 <= hard_pod_affinity_symmetric_weight <= 100:
+            # factory.go:1024-1026 — the host backend rejects this range in
+            # _create_from_keys; the device backend must match
+            raise ValueError("invalid hardPodAffinitySymmetricWeight: "
+                             f"{hard_pod_affinity_symmetric_weight}, must be "
+                             "in the range 1-100")
         self.provider = provider
         self.fallback = fallback
         self.hard_pod_affinity_symmetric_weight = hard_pod_affinity_symmetric_weight

@@ -300,6 +300,11 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
         **weights)
     hard = (policy.hard_pod_affinity_symmetric_weight
             if policy.hard_pod_affinity_symmetric_weight != 0 else None)
+    if hard is not None and (hard < 1 or hard > 100):
+        # the same [1, 100] range _create_from_keys enforces host-side
+        # (factory.go:1024-1026) — both backends must reject identically
+        raise ValueError(f"invalid hardPodAffinitySymmetricWeight: {hard}, "
+                         "must be in the range 1-100")
     return CompiledPolicy(spec=spec, hard_weight=hard,
                           label_rows=label_rows,
                           label_prios=label_prios, saa_entries=saa_entries,

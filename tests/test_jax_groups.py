@@ -311,7 +311,13 @@ def test_hard_weight_zero_disables_symmetric_attraction():
         "status": {"phase": "Running"}})
     snap = ClusterSnapshot(nodes=nodes, pods=[peer])
     pod = make_pod("p", milli_cpu=100, labels={"app": "web"})
-    assert_parity([pod], snap, hard_weight=0)
+    # weight 0 is rejected at construction by BOTH backends (factory.go:1024's
+    # [1,100] range; the zero-weight priority semantics stay pinned at the
+    # priority level in test_limits_hardweight_goldens.py)
+    import pytest
+
+    with pytest.raises(ValueError):
+        assert_parity([pod], snap, hard_weight=0)
     assert_parity([pod], snap, hard_weight=50)
 
 

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Probe the axon tunnel every 10 min; on the first healthy probe run the
+# round-3 capture once and exit. Single TPU client by construction: the
+# probe and the capture never overlap, and nothing else should touch the
+# TPU while this runs (see bench_results/tpu_watch.log).
+cd "$(dirname "$0")/.."
+log=bench_results/tpu_watch.log
+mkdir -p bench_results
+echo "$(date -u +%H:%M:%S) watcher started" >> "$log"
+while true; do
+    if timeout 60 python -c "
+import jax; jax.devices()
+import jax.numpy as jnp
+assert int(jnp.ones((8, 8)).sum()) == 64" >/dev/null 2>&1; then
+        echo "$(date -u +%H:%M:%S) TUNNEL HEALED - starting capture" >> "$log"
+        bash tools/tpu_capture.sh >> "$log" 2>&1
+        echo "$(date -u +%H:%M:%S) capture finished rc=$?" >> "$log"
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) probe failed; sleeping 600s" >> "$log"
+    sleep 600
+done

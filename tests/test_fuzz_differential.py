@@ -126,7 +126,7 @@ def sig(status):
 
 
 def test_fuzz_provider_parity():
-    for seed in range(6):
+    for seed in range(_fuzz_seeds(6)):
         rng = random.Random(1000 + seed)
         snapshot = random_cluster(rng)
         pods = random_pods(rng, rng.randint(20, 30))
@@ -147,7 +147,7 @@ def test_fuzz_policy_parity():
                  "BalancedResourceAllocation", "NodeAffinityPriority",
                  "TaintTolerationPriority", "SelectorSpreadPriority",
                  "InterPodAffinityPriority", "ImageLocalityPriority"]
-    for seed in range(4):
+    for seed in range(_fuzz_seeds(4)):
         rng = random.Random(2000 + seed)
         snapshot = random_cluster(rng)
         pods = random_pods(rng, rng.randint(15, 25))
@@ -188,7 +188,7 @@ def test_fuzz_policy_parity():
 
 
 def test_fuzz_preemption_parity():
-    for seed in range(3):
+    for seed in range(_fuzz_seeds(3)):
         rng = random.Random(3000 + seed)
         snapshot = random_cluster(rng)
         for p in snapshot.pods:

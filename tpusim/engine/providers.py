@@ -343,6 +343,11 @@ def _create_from_keys(registry: AlgorithmRegistry, pred_keys: Set[str],
                       extenders: Optional[list] = None,
                       always_check_all_predicates: bool = False) -> GenericScheduler:
     """factory.go CreateFromKeys:1021-1082."""
+    weight = args.hard_pod_affinity_symmetric_weight
+    if weight < 1 or weight > 100:
+        # factory.go:1024-1026: the range is [1, 100]
+        raise ValueError(f"invalid hardPodAffinitySymmetricWeight: {weight}, "
+                         "must be in the range 1-100")
     predicates = registry.build_predicates(pred_keys, args)
     prioritizers = registry.build_prioritizers(pri_keys, args)
 

@@ -620,3 +620,87 @@ def test_jax_native_mixed_volumes_random():
                 "awsElasticBlockStore": {"volumeID": f"ebs{rng.randrange(5)}"}}))
         pods.append(make_pod(f"p{i}", milli_cpu=50, volumes=vols))
     _parity(pods, snapshot)
+
+
+# ---------------------------------------------------------------------------
+# scheduler_test.go TestSchedulerWithVolumeBinding:661-828 — the
+# placement-observable rows, driven with REAL PV/PVC fixtures instead of the
+# upstream fake binder (the assume/bind two-phase rows exercise async-bind
+# machinery the synchronous simulator does not have; its assume-time claimRef
+# flow is pinned by test_volume_binder_goldens.py).
+# ---------------------------------------------------------------------------
+
+
+def _sched_binding_world():
+    classes = [make_storage_class("wait", binding_mode="WaitForFirstConsumer")]
+    node = make_node("machine1", labels={"zone": "a"})
+    return classes, node
+
+
+def _run_one(pod, pvs, pvcs):
+    classes, node = _sched_binding_world()
+    snapshot = ClusterSnapshot(nodes=[node], pvs=pvs, pvcs=pvcs,
+                               storage_classes=classes)
+    return run_simulation([pod], snapshot, backend="reference",
+                          enable_volume_scheduling=True)
+
+
+def test_volume_binding_all_bound():
+    """'all-bound': a bound claim whose PV likes the node -> Scheduled."""
+    pv = make_pv("pv-ok", storage="5Gi", storage_class="wait",
+                 node_affinity_terms=[{"matchExpressions": [
+                     {"key": "zone", "operator": "In", "values": ["a"]}]}])
+    pvc = make_pvc("claim", storage="1Gi", storage_class="wait",
+                   volume_name="pv-ok")
+    status = _run_one(make_pod("foo", milli_cpu=10,
+                               volumes=[make_pod_volume("v", pvc="claim")]),
+                      [pv], [pvc])
+    assert len(status.successful_pods) == 1
+    assert status.successful_pods[0].spec.node_name == "machine1"
+
+
+def test_volume_binding_invalid_pv_affinity():
+    """'bound,invalid-pv-affinity' -> '1 node(s) had volume node affinity
+    conflict'."""
+    pv = make_pv("pv-wrong", storage="5Gi", storage_class="wait",
+                 node_affinity_terms=[{"matchExpressions": [
+                     {"key": "zone", "operator": "In", "values": ["other"]}]}])
+    pvc = make_pvc("claim", storage="1Gi", storage_class="wait",
+                   volume_name="pv-wrong")
+    status = _run_one(make_pod("foo", milli_cpu=10,
+                               volumes=[make_pod_volume("v", pvc="claim")]),
+                      [pv], [pvc])
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert msg == ("0/1 nodes are available: 1 node(s) had volume node "
+                   "affinity conflict.")
+
+
+def test_volume_binding_unbound_no_matches():
+    """'unbound,no-matches' -> '1 node(s) didn't find available persistent
+    volumes to bind'."""
+    pvc = make_pvc("claim", storage="1Gi", storage_class="wait")
+    status = _run_one(make_pod("foo", milli_cpu=10,
+                               volumes=[make_pod_volume("v", pvc="claim")]),
+                      [], [pvc])
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert msg == ("0/1 nodes are available: 1 node(s) didn't find available "
+                   "persistent volumes to bind.")
+
+
+def test_volume_binding_bound_and_unbound_unsatisfied():
+    """'bound-and-unbound-unsatisfied': one node emits BOTH reasons, joined
+    in the sorted FitError histogram."""
+    pv = make_pv("pv-wrong", storage="5Gi", storage_class="wait",
+                 node_affinity_terms=[{"matchExpressions": [
+                     {"key": "zone", "operator": "In", "values": ["other"]}]}])
+    pvcs = [make_pvc("bound-claim", storage="1Gi", storage_class="wait",
+                     volume_name="pv-wrong"),
+            make_pvc("unbound-claim", storage="1Gi", storage_class="wait")]
+    pod = make_pod("foo", milli_cpu=10,
+                   volumes=[make_pod_volume("v1", pvc="bound-claim"),
+                            make_pod_volume("v2", pvc="unbound-claim")])
+    status = _run_one(pod, [pv], pvcs)
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert msg == ("0/1 nodes are available: 1 node(s) didn't find available "
+                   "persistent volumes to bind, 1 node(s) had volume node "
+                   "affinity conflict.")

@@ -25,8 +25,9 @@ from tpusim.api.snapshot import make_node, make_pod
 class FakeApiServer:
     """Minimal /api/v1 list endpoints with request capture."""
 
-    def __init__(self, pods, nodes):
+    def __init__(self, pods, nodes, configmaps=None):
         self.requests = []
+        self.configmaps = configmaps or {}  # (ns, name) -> object dict
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -35,6 +36,22 @@ class FakeApiServer:
                 query = dict(urllib.parse.parse_qsl(parsed.query))
                 outer.requests.append(
                     (parsed.path, query, self.headers.get("Authorization")))
+                parts = parsed.path.split("/")
+                # /api/v1/namespaces/<ns>/configmaps/<name>
+                if len(parts) == 7 and parts[1:4] == ["api", "v1",
+                                                      "namespaces"] \
+                        and parts[5] == "configmaps":
+                    obj = outer.configmaps.get((parts[4], parts[6]))
+                    if obj is None:
+                        self.send_error(404)
+                        return
+                    body = json.dumps(obj).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if parsed.path == "/api/v1/nodes":
                     items = [n.to_obj() for n in nodes]
                 elif parsed.path == "/api/v1/pods":
@@ -226,3 +243,95 @@ def test_in_cluster_config(tmp_path, fake_cluster):
     assert cfg.server == f"https://{host}:{port}"
     with pytest.raises(KubeConfigError):
         in_cluster_config(root=str(root), environ={})
+
+
+# --- live ConfigMap policy source (simulator.go:397-424) ------------------
+
+
+POLICY_JSON = json.dumps({
+    "kind": "Policy", "apiVersion": "v1",
+    "predicates": [{"name": "PodFitsResources"}],
+    "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+})
+
+
+@pytest.fixture
+def fake_cluster_with_policy():
+    pods = [make_pod("run-1", milli_cpu=500, node_name="n0", phase="Running")]
+    nodes = [make_node("n0"), make_node("n1")]
+    cms = {
+        ("kube-system", "sched-policy"): {
+            "kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": "sched-policy",
+                         "namespace": "kube-system"},
+            "data": {"policy.cfg": POLICY_JSON},
+        },
+        ("kube-system", "no-key"): {
+            "kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": "no-key", "namespace": "kube-system"},
+            "data": {"other": "x"},
+        },
+    }
+    server = FakeApiServer(pods, nodes, configmaps=cms)
+    yield server
+    server.stop()
+
+
+def test_get_configmap(fake_cluster_with_policy, tmp_path):
+    cfg = load_kubeconfig(
+        write_kubeconfig(tmp_path, fake_cluster_with_policy.url))
+    obj = KubeClient(cfg).get_configmap("kube-system", "sched-policy")
+    assert obj["data"]["policy.cfg"] == POLICY_JSON
+
+
+def test_cli_live_policy_configmap(fake_cluster_with_policy, tmp_path, capsys):
+    """--scheduler-policy-configmap fetches the policy off the apiserver and
+    drives the run with it (simulator.go:402-415)."""
+    from tpusim.cli import main
+
+    path = write_kubeconfig(tmp_path, fake_cluster_with_policy.url)
+    podspec = tmp_path / "podspec.yaml"
+    podspec.write_text(
+        "- name: A\n  num: 2\n  pod:\n    spec:\n      containers:\n"
+        "      - resources:\n          requests:\n            cpu: 1\n")
+    rc = main(["--kubeconfig", path, "--podspec", str(podspec),
+               "--scheduler-policy-configmap", "sched-policy",
+               "--backend", "reference", "--quiet"])
+    assert rc == 0
+    assert "2 pod(s) scheduled" in capsys.readouterr().out
+    cm_reqs = [r for r in fake_cluster_with_policy.requests
+               if "configmaps" in r[0]]
+    assert cm_reqs and cm_reqs[0][0] == \
+        "/api/v1/namespaces/kube-system/configmaps/sched-policy"
+
+
+def test_cli_live_policy_configmap_missing_key(fake_cluster_with_policy,
+                                               tmp_path, capsys):
+    from tpusim.cli import main
+
+    path = write_kubeconfig(tmp_path, fake_cluster_with_policy.url)
+    podspec = tmp_path / "podspec.yaml"
+    podspec.write_text(
+        "- name: A\n  num: 1\n  pod:\n    spec:\n      containers:\n"
+        "      - resources:\n          requests:\n            cpu: 1\n")
+    rc = main(["--kubeconfig", path, "--podspec", str(podspec),
+               "--scheduler-policy-configmap", "no-key",
+               "--backend", "reference", "--quiet"])
+    assert rc == 2
+    # byte-matching the reference error (simulator.go:409-411)
+    assert 'missing policy config map value at key "policy.cfg"' \
+        in capsys.readouterr().err
+
+
+def test_cli_live_policy_configmap_needs_cluster(tmp_path, capsys):
+    from tpusim.cli import main
+
+    podspec = tmp_path / "podspec.yaml"
+    podspec.write_text(
+        "- name: A\n  num: 1\n  pod:\n    spec:\n      containers:\n"
+        "      - resources:\n          requests:\n            cpu: 1\n")
+    rc = main(["--podspec", str(podspec), "--synthetic-nodes", "2",
+               "--scheduler-policy-configmap", "sched-policy",
+               "--backend", "reference", "--quiet"])
+    assert rc == 2
+    assert "needs a cluster connection" in capsys.readouterr().err

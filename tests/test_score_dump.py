@@ -1,0 +1,38 @@
+"""Per-node score dump at high verbosity — the V(10) lines of
+generic_scheduler.go:618-622 (per-priority "%v -> %v: %v, Score: (%d)") and
+:670-674 (post-extender "Host %s => Score %d")."""
+
+import logging
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.simulator import run_simulation
+
+
+def _run(caplog, level):
+    snapshot = ClusterSnapshot(nodes=[
+        make_node("n0", milli_cpu=4000, memory=16 * 1024**3),
+        make_node("n1", milli_cpu=8000, memory=32 * 1024**3)])
+    pods = [make_pod("p", milli_cpu=500)]
+    with caplog.at_level(level, logger="tpusim.engine.generic_scheduler"):
+        status = run_simulation(pods, snapshot, backend="reference")
+    assert len(status.successful_pods) == 1
+    host = status.successful_pods[0].spec.node_name
+    return host, [r.getMessage() for r in caplog.records]
+
+
+def test_score_dump_at_debug(caplog):
+    host, msgs = _run(caplog, logging.DEBUG)
+    per_priority = [m for m in msgs if ", Score: (" in m]
+    aggregate = [m for m in msgs if m.startswith("Host ")]
+    # every node appears in the aggregate dump, and the winner's line exists
+    assert {"Host n0", "Host n1"} == {m.rsplit(" => ", 1)[0]
+                                      for m in aggregate}
+    assert any(m.startswith(f"Host {host} => Score ") for m in aggregate)
+    # each registered priority contributes a line per node
+    assert any("LeastRequestedPriority" in m for m in per_priority)
+    assert any("-> n1:" in m for m in per_priority)
+
+
+def test_score_dump_silent_by_default(caplog):
+    _, msgs = _run(caplog, logging.INFO)
+    assert not [m for m in msgs if ", Score: (" in m or m.startswith("Host ")]

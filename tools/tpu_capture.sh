@@ -1,14 +1,14 @@
 #!/usr/bin/env bash
-# Round-3 TPU capture runbook: run the moment the axon tunnel heals.
+# Round-4 TPU capture runbook: run the moment the axon tunnel heals.
 # Sequential by design — ONE TPU client at a time; never kill -9 a child
 # (bench.py's own watchdog stops children SIGINT-first).
 #
 # Produces, under bench_results/:
-#   r3_tpu_ladder.jsonl   — configs 1-6 (incl. the preemption hybrid)
-#   r3_tpu_fast.jsonl     — Pallas fastscan on configs 3-4 (TPUSIM_FAST=1);
+#   r4_tpu_ladder.jsonl   — configs 1-6 (incl. the preemption hybrid)
+#   r4_tpu_fast.jsonl     — Pallas fastscan on configs 3-4 (TPUSIM_FAST=1);
 #                           hash parity vs the XLA scan is checked by
 #                           comparing placement_hash fields across the files
-#   r3_tpu_phases.jsonl   — unroll + wavefront K sweeps and the phase split
+#   r4_tpu_phases.jsonl   — unroll + wavefront K sweeps and the phase split
 #
 # Each stage prints partial JSON lines as it goes, so a mid-run wedge still
 # leaves the completed stages on disk.
@@ -49,17 +49,30 @@ if ! probe | grep -q "PROBE OK"; then
 fi
 
 echo "== stage 1: full ladder (configs 1-6) =="
-run_stage ladder bench_results/r3_tpu_ladder.jsonl \
-    bench_results/r3_tpu_ladder.log python bench.py --ladder
+run_stage ladder bench_results/r4_tpu_ladder.jsonl \
+    bench_results/r4_tpu_ladder.log python bench.py --ladder
 
 echo "== stage 2: Pallas fastscan, configs 3-4 =="
-run_stage fastscan bench_results/r3_tpu_fast.jsonl \
-    bench_results/r3_tpu_fast.log \
+run_stage fastscan bench_results/r4_tpu_fast.jsonl \
+    bench_results/r4_tpu_fast.log \
     env TPUSIM_FAST=1 TPUSIM_BENCH_LADDER_CONFIGS=3,4 python bench.py --ladder
 
-echo "== stage 3: phase split + unroll/wavefront sweeps =="
-run_stage phases bench_results/r3_tpu_phases.jsonl \
-    bench_results/r3_tpu_phases.log python bench.py --phases
+echo "== stage 3: config-5 warm-cache pair (criterion: 2nd fresh-process run <60s) =="
+run_stage whatif1 bench_results/r4_tpu_whatif1.jsonl \
+    bench_results/r4_tpu_whatif1.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=5 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+t_start=$(date +%s)
+run_stage whatif2 bench_results/r4_tpu_whatif2.jsonl \
+    bench_results/r4_tpu_whatif2.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=5 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+t_end=$(date +%s)
+echo "== config-5 second-run wall: $((t_end - t_start))s (criterion <60s for the child's end-to-end; see [config 5] line in r4_tpu_whatif2.log) =="
+
+echo "== stage 4: phase split + unroll/wavefront sweeps ==" 
+run_stage phases bench_results/r4_tpu_phases.jsonl \
+    bench_results/r4_tpu_phases.log python bench.py --phases
 
 echo "== hash parity check (fastscan vs XLA scan) =="
 if ! python - <<'EOF'
@@ -87,8 +100,8 @@ def hashes(path):
         pass
     return out
 
-ladder = hashes("bench_results/r3_tpu_ladder.jsonl")
-fast = hashes("bench_results/r3_tpu_fast.jsonl")
+ladder = hashes("bench_results/r4_tpu_ladder.jsonl")
+fast = hashes("bench_results/r4_tpu_fast.jsonl")
 ok = True
 for cfg, h in fast.items():
     want = ladder.get(cfg)

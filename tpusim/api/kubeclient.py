@@ -202,6 +202,13 @@ class KubeClient:
         body = self._get("/api/v1/nodes")
         return [Node.from_obj(item) for item in body.get("items") or []]
 
+    def get_configmap(self, namespace: str, name: str) -> dict:
+        """ConfigMaps(namespace).Get(name) — the live scheduler-policy source
+        (simulator.go:402-406). Returns the raw ConfigMap object."""
+        path = (f"/api/v1/namespaces/{urllib.parse.quote(namespace)}"
+                f"/configmaps/{urllib.parse.quote(name)}")
+        return self._get(path)
+
 
 def get_checkpoints(client: KubeClient,
                     namespace: str = "") -> Tuple[List[Pod], List[Node]]:

@@ -10,9 +10,10 @@ k8s-style camelCase dicts so `pods.json` / `nodes.json` checkpoints
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import copy as _copy_mod
 import enum
 import re
+from dataclasses import dataclass, field, is_dataclass
 from typing import Any, Optional
 
 from tpusim.api.quantity import Quantity, parse_quantity
@@ -563,6 +564,27 @@ class ContainerPort:
         return o
 
 
+_COPY_ATOMIC = (str, int, float, bool, bytes, type(None), Quantity)
+
+
+def _structural_copy(o):
+    """Deep-copy a dataclass/list/dict graph, sharing atomic leaves.
+    Quantity counts as atomic: its only writes are idempotent lazy memos."""
+    if isinstance(o, _COPY_ATOMIC):
+        return o
+    if isinstance(o, list):
+        return [_structural_copy(x) for x in o]
+    if isinstance(o, dict):
+        return {k: _structural_copy(v) for k, v in o.items()}
+    if is_dataclass(o):
+        new = object.__new__(type(o))
+        d = new.__dict__
+        for k, v in o.__dict__.items():
+            d[k] = _structural_copy(v)
+        return new
+    return _copy_mod.deepcopy(o)
+
+
 def _parse_resource_list(o: Optional[dict]) -> dict:
     return {k: parse_quantity(v) for k, v in (o or {}).items()}
 
@@ -789,7 +811,13 @@ class Pod:
         return f"{self.namespace}/{self.metadata.name}"
 
     def copy(self) -> "Pod":
-        return Pod.from_obj(self.to_obj())
+        """Independent deep copy. Structural (field-graph) rather than a
+        to_obj/from_obj round-trip: the simulator's Bind seam copies every
+        bound pod, and re-serializing + re-parsing quantities dominated the
+        mirror cost of the preemption hybrid. Quantity leaves are immutable
+        (lazy memo only) and shared; equality and scheduling behavior match
+        the round-trip for any pod built through from_obj."""
+        return _structural_copy(self)
 
 
 # ---------------------------------------------------------------------------

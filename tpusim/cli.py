@@ -52,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--scheduler-policy-configmap-file", default="",
                         help="ConfigMap object (JSON/YAML) carrying the policy "
                              "under data['policy.cfg']")
+    parser.add_argument("--scheduler-policy-configmap", default="",
+                        help="Name of a ConfigMap to fetch the policy from the "
+                             "live cluster API (simulator.go:402-415); needs "
+                             "--kubeconfig or CC_INCLUSTER")
+    parser.add_argument("--scheduler-policy-configmap-namespace",
+                        default="kube-system",
+                        help="Namespace of --scheduler-policy-configmap")
     parser.add_argument("--namespace", default="default",
                         help="Namespace stamped onto simulated pods")
     # new flags (BASELINE.json)
@@ -110,6 +117,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Also print per-pod requirement spec")
     parser.add_argument("--quiet", action="store_true",
                         help="Only print the summary counts and timing")
+    parser.add_argument("--v", type=int, default=0, dest="verbosity",
+                        help="Log verbosity (glog analog). >=5 enables the "
+                             "per-node score dump: every priority's score per "
+                             "node and the post-extender aggregate "
+                             "(generic_scheduler.go:618-622,670-674)")
     return parser
 
 
@@ -142,19 +154,49 @@ def load_snapshot(args) -> ClusterSnapshot:
 
 
 def load_policy_from_args(args):
-    """(policy | None, error string | None) from the two policy flags."""
-    if not (args.scheduler_policy_file or args.scheduler_policy_configmap_file):
+    """(policy | None, error string | None) from the three policy sources:
+    serialized Policy file, ConfigMap-object file, or a live ConfigMap fetched
+    from the cluster API (simulator.go:383-424)."""
+    live_name = getattr(args, "scheduler_policy_configmap", "")
+    if not (args.scheduler_policy_file or args.scheduler_policy_configmap_file
+            or live_name):
         return None, None
     from tpusim.engine.policy import (
         PolicyError,
         load_policy_configmap_file,
         load_policy_file,
+        policy_from_configmap,
     )
     try:
-        policy = (load_policy_file(args.scheduler_policy_file)
-                  if args.scheduler_policy_file else
-                  load_policy_configmap_file(
-                      args.scheduler_policy_configmap_file))
+        if args.scheduler_policy_file:
+            policy = load_policy_file(args.scheduler_policy_file)
+        elif args.scheduler_policy_configmap_file:
+            policy = load_policy_configmap_file(
+                args.scheduler_policy_configmap_file)
+        else:
+            # live source: ConfigMaps(ns).Get(name) through the kube client
+            # (simulator.go:402-406)
+            if not (args.kubeconfig or os.environ.get("CC_INCLUSTER")):
+                return None, ("--scheduler-policy-configmap needs a cluster "
+                              "connection (--kubeconfig or CC_INCLUSTER)")
+            from tpusim.api.kubeclient import (
+                KubeClient,
+                in_cluster_config,
+                load_kubeconfig,
+            )
+            config = (load_kubeconfig(args.kubeconfig) if args.kubeconfig
+                      else in_cluster_config())
+            try:
+                client = KubeClient(config)
+            finally:
+                config.cleanup()
+            ns = args.scheduler_policy_configmap_namespace
+            try:
+                obj = client.get_configmap(ns, live_name)
+            except OSError as exc:
+                return None, (f"couldn't get policy config map "
+                              f"{ns}/{live_name}: {exc}")
+            policy = policy_from_configmap(obj)
     except (OSError, PolicyError) as exc:
         return None, f"invalid scheduler policy: {exc}"
     return policy, None
@@ -232,6 +274,13 @@ def run_what_if_cli(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.verbosity >= 5:
+        # glog -v analog: V(5)+ turns on the engine's per-node score dump
+        import logging
+
+        logging.basicConfig(stream=sys.stderr, format="%(message)s")
+        logging.getLogger("tpusim.engine").setLevel(logging.DEBUG)
 
     # (An env-level JAX_PLATFORMS=cpu pin is honored by the import-time guard
     # in tpusim/jaxe/__init__.py — every jax-using path imports that module

@@ -14,6 +14,7 @@ keep node-list order), which both backends implement identically.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from time import perf_counter as _now
 from typing import Callable, Dict, List, Optional
@@ -26,6 +27,17 @@ from tpusim.engine.errors import (
     PredicateFailureReason,
 )
 from tpusim.engine.predicates import (
+    CHECK_NODE_CONDITION_PRED,
+    CHECK_NODE_DISK_PRESSURE_PRED,
+    CHECK_NODE_LABEL_PRESENCE_PRED,
+    CHECK_NODE_MEMORY_PRESSURE_PRED,
+    CHECK_NODE_UNSCHEDULABLE_PRED,
+    CHECK_VOLUME_BINDING_PRED,
+    HOSTNAME_PRED,
+    MATCH_NODE_SELECTOR_PRED,
+    NO_VOLUME_ZONE_CONFLICT_PRED,
+    POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+    POD_TOLERATES_NODE_TAINTS_PRED,
     PREDICATES_ORDERING,
     PredicateMetadata,
     get_predicate_metadata,
@@ -41,6 +53,23 @@ from tpusim.engine.util import (
 )
 
 NO_NODE_AVAILABLE_MSG = "0/{} nodes are available"
+
+log = logging.getLogger(__name__)
+
+# Predicates whose outcome is a function of (pod, node statics) only — they
+# never read node_info.pods / used_ports / meta's matching terms, so once they
+# pass on the fully-stripped node (selectVictimsOnNode's first fit) they pass
+# for every victim subset and the reprieve loop may skip them. Unknown or
+# policy-registered predicate names are conservatively treated as dependent.
+_POD_SET_INDEPENDENT_PREDS = frozenset({
+    CHECK_NODE_CONDITION_PRED, CHECK_NODE_UNSCHEDULABLE_PRED, HOSTNAME_PRED,
+    MATCH_NODE_SELECTOR_PRED, POD_TOLERATES_NODE_TAINTS_PRED,
+    POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED, CHECK_NODE_LABEL_PRESENCE_PRED,
+    CHECK_VOLUME_BINDING_PRED, NO_VOLUME_ZONE_CONFLICT_PRED,
+    CHECK_NODE_MEMORY_PRESSURE_PRED, CHECK_NODE_DISK_PRESSURE_PRED,
+})
+_REPRIEVE_ORDERING = [k for k in PREDICATES_ORDERING
+                      if k not in _POD_SET_INDEPENDENT_PREDS]
 
 
 class SchedulingError(Exception):
@@ -249,6 +278,16 @@ class GenericScheduler:
             if config.reduce_fn is not None:
                 config.reduce_fn(pod, meta, node_info_map, results[i])
 
+        # per-priority score dump at high verbosity (the reference's V(10)
+        # "%v -> %v: %v, Score: (%d)" lines, generic_scheduler.go:618-622);
+        # answers "why did node X win" when a placement surprises
+        dump = log.isEnabledFor(logging.DEBUG)
+        if dump:
+            for j, config in enumerate(self.prioritizers):
+                for hp in results[j]:
+                    log.debug("%s/%s -> %s: %s, Score: (%d)", pod.namespace,
+                              pod.name, hp.host, config.name, hp.score)
+
         # weighted sum (:631-639)
         result = []
         for i, node in enumerate(nodes):
@@ -275,6 +314,11 @@ class GenericScheduler:
                     if hp.host in combined:
                         combined[hp.host] += hp.score * weight
             result = [HostPriority(n.name, combined[n.name]) for n in nodes]
+        if dump:
+            # aggregate dump, post-extender like the reference
+            # (generic_scheduler.go:670-674)
+            for hp in result:
+                log.debug("Host %s => Score %d", hp.host, hp.score)
         return result
 
     # --- select phase ---
@@ -342,8 +386,15 @@ class GenericScheduler:
     }
 
     def preempt(self, pod: Pod, nodes: List[Node],
-                node_info_map: Dict[str, NodeInfo], schedule_err: Exception):
-        """Returns (node, victims, nominated_pods_to_clear)."""
+                node_info_map: Dict[str, NodeInfo], schedule_err: Exception,
+                candidate_filter=None):
+        """Returns (node, victims, nominated_pods_to_clear).
+
+        candidate_filter: optional `name -> bool` prefilter over potential
+        nodes; callers may pass one ONLY when it provably excludes just nodes
+        where _select_victims_on_node would return fits=False (e.g. the
+        vectorized lower-priority resource bound in jaxe/preempt.py), so the
+        outcome is identical to the unfiltered pipeline."""
         if not isinstance(schedule_err, FitError):
             return None, [], []
         if not self._pod_eligible_to_preempt_others(pod, node_info_map):
@@ -355,6 +406,13 @@ class GenericScheduler:
         if not potential:
             # clean up any existing nominated node name of the pod (:231-234)
             return None, [], [pod]
+        if candidate_filter is not None:
+            # an emptied list matches the all-candidates-unfit path below
+            # (empty node_to_victims -> None without clearing nominations),
+            # NOT the no-potential-nodes arm above
+            potential = [n for n in potential if candidate_filter(n.name)]
+            if not potential:
+                return None, [], []
         pdbs = self.pdb_lister()
         node_to_victims = self._select_nodes_for_preemption(
             pod, node_info_map, potential, pdbs)
@@ -416,7 +474,11 @@ class GenericScheduler:
         """selectVictimsOnNode: remove all lower-priority pods, check fit, then
         reprieve as many as possible (PDB-violating victims first, each group
         highest-priority first)."""
-        info_copy = node_info.clone()
+        pod_priority = util_get_pod_priority(pod)
+        potential_victims = [p for p in node_info.pods
+                             if util_get_pod_priority(p) < pod_priority]
+        # one rebuilt-from-survivors clone instead of clone + per-pod strip
+        info_copy = node_info.clone_without(potential_victims)
 
         def remove_pod(p):
             info_copy.remove_pod(p)
@@ -428,12 +490,9 @@ class GenericScheduler:
             if meta is not None:
                 meta.add_pod(p, info_copy.node)
 
-        pod_priority = util_get_pod_priority(pod)
-        potential_victims = []
-        for p in list(info_copy.pods):
-            if util_get_pod_priority(p) < pod_priority:
-                potential_victims.append(p)
-                remove_pod(p)
+        if meta is not None:
+            for p in potential_victims:
+                meta.remove_pod(p)
         potential_victims = sort_by_priority_desc(potential_victims)
 
         fits, _ = self._fits_sans_nominated(pod, meta, info_copy)
@@ -445,9 +504,20 @@ class GenericScheduler:
         violating, non_violating = self._filter_pods_with_pdb_violation(
             potential_victims, pdbs)
 
+        chain = self._reprieve_chain()
+
         def reprieve(p) -> bool:
             add_pod(p)
-            fits, _ = self._fits_sans_nominated(pod, meta, info_copy)
+            # the full-ordering fit above already passed on the stripped
+            # node; fit is an order-independent AND over the predicate set,
+            # so the boolean-only chain (pod-set-dependent predicates,
+            # cheapest first) gives the identical outcome
+            fits = True
+            for predicate in chain:
+                ok, _ = predicate(pod, meta, info_copy)
+                if not ok:
+                    fits = False
+                    break
             if not fits:
                 remove_pod(p)
                 victims.append(p)
@@ -472,6 +542,41 @@ class GenericScheduler:
                 fails.extend(reasons)
                 break
         return (not fails), fails
+
+    def _reprieve_chain(self) -> list:
+        """The boolean-only predicate chain for reprieve re-checks in
+        _select_victims_on_node: pod-set-dependent predicates only (node-
+        static ones passed on the stripped node and cannot change when only
+        the pod set changes), with GeneralPredicates decomposed into its
+        dependent halves — PodFitsResources + PodFitsHostPorts; PodFitsHost
+        and PodMatchNodeSelector are node-static (predicates.go:1059-1123) —
+        and resources hoisted first as the dominant reprieve failure."""
+        chain = getattr(self, "_reprieve_chain_cache", None)
+        if chain is None:
+            from tpusim.engine.predicates import (
+                GENERAL_PRED,
+                POD_FITS_HOST_PORTS_PRED,
+                POD_FITS_RESOURCES_PRED,
+                pod_fits_host_ports,
+                pod_fits_resources,
+            )
+            decomposed = (GENERAL_PRED, POD_FITS_RESOURCES_PRED,
+                          POD_FITS_HOST_PORTS_PRED)
+            chain = []
+            if (GENERAL_PRED in self.predicates
+                    or POD_FITS_RESOURCES_PRED in self.predicates):
+                chain.append(pod_fits_resources)
+            if (GENERAL_PRED in self.predicates
+                    or POD_FITS_HOST_PORTS_PRED in self.predicates):
+                chain.append(pod_fits_host_ports)
+            for key in _REPRIEVE_ORDERING:
+                if key in decomposed:
+                    continue
+                fn = self.predicates.get(key)
+                if fn is not None:
+                    chain.append(fn)
+            self._reprieve_chain_cache = chain
+        return chain
 
     @staticmethod
     def _filter_pods_with_pdb_violation(pods, pdbs):

@@ -60,7 +60,9 @@ def _resource_allocation_map(pod: Pod, meta, node_info: NodeInfo, scorer) -> Hos
     if meta is not None and meta.nonzero_request is not None:
         requested = meta.nonzero_request.clone()
     else:
-        requested = get_nonzero_pod_request(pod)
+        # clone: the memoized request (engine/resources.request_memo) is a
+        # shared object and the += below must not corrupt it
+        requested = get_nonzero_pod_request(pod).clone()
     requested.milli_cpu += node_info.nonzero_request.milli_cpu
     requested.memory += node_info.nonzero_request.memory
     return HostPriority(node_info.node.name,

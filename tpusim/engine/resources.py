@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import itertools
 
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -78,9 +79,35 @@ class Resource:
                         dict(self.scalar))
 
 
+# Scoped request memo: preemption's victim selection recomputes the same
+# pods' requests hundreds of times (clone/strip/reprieve per candidate node).
+# When a scope is active, results are cached by object identity — the pod
+# reference is held alongside so a recycled id() can never alias — and MUST be
+# treated as immutable by callers (the one historical mutator,
+# priorities._resource_allocation_map, clones its copy).
+_REQ_MEMO: Optional[dict] = None
+_NZ_MEMO: Optional[dict] = None
+
+
+@contextmanager
+def request_memo():
+    global _REQ_MEMO, _NZ_MEMO
+    prev = (_REQ_MEMO, _NZ_MEMO)
+    _REQ_MEMO, _NZ_MEMO = {}, {}
+    try:
+        yield
+    finally:
+        _REQ_MEMO, _NZ_MEMO = prev
+
+
 def get_resource_request(pod: Pod) -> Resource:
     """Reference: predicates.go:659-697 — sum containers, then per-resource max
     with each init container."""
+    memo = _REQ_MEMO
+    if memo is not None:
+        hit = memo.get(id(pod))
+        if hit is not None:
+            return hit[1]
     result = Resource()
     for c in pod.spec.containers:
         result.add_resource_list(c.requests)
@@ -96,6 +123,8 @@ def get_resource_request(pod: Pod) -> Resource:
                 result.nvidia_gpu = max(result.nvidia_gpu, q.value())
             elif is_scalar_resource_name(name):
                 result.scalar[name] = max(result.scalar.get(name, 0), q.value())
+    if memo is not None:
+        memo[id(pod)] = (pod, result)
     return result
 
 
@@ -115,11 +144,18 @@ def get_nonzero_requests(requests: dict) -> tuple[int, int]:
 def get_nonzero_pod_request(pod: Pod) -> Resource:
     """Reference: resource_allocation.go:75-84 (getNonZeroRequests): containers
     only, no init-container max."""
+    memo = _NZ_MEMO
+    if memo is not None:
+        hit = memo.get(id(pod))
+        if hit is not None:
+            return hit[1]
     result = Resource()
     for c in pod.spec.containers:
         cpu, mem = get_nonzero_requests(c.requests)
         result.milli_cpu += cpu
         result.memory += mem
+    if memo is not None:
+        memo[id(pod)] = (pod, result)
     return result
 
 
@@ -259,13 +295,22 @@ class NodeInfo:
         self.generation = _next_generation()
 
     def remove_pod(self, pod: Pod) -> None:
-        key = pod.key()
+        # identity-first scan: callers (victim selection, cache accounting)
+        # overwhelmingly pass the exact object held in self.pods, and the
+        # key() fallback builds two strings per compared entry — measurably
+        # hot at preemption's ~15 removals per candidate node
         for i, p in enumerate(self.pods):
-            if p.key() == key:
+            if p is pod:
                 del self.pods[i]
                 break
         else:
-            raise KeyError(f"no corresponding pod {key} in pods of node")
+            key = pod.key()
+            for i, p in enumerate(self.pods):
+                if p.key() == key:
+                    del self.pods[i]
+                    break
+            else:
+                raise KeyError(f"no corresponding pod {key} in pods of node")
         res = get_resource_request(pod)
         self.requested_resource.subtract(res)
         non0 = get_nonzero_pod_request(pod)
@@ -298,6 +343,31 @@ class NodeInfo:
         c.memory_pressure = self.memory_pressure
         c.disk_pressure = self.disk_pressure
         c.generation = self.generation
+        return c
+
+    def clone_without(self, excluded: List[Pod]) -> "NodeInfo":
+        """Equivalent to clone() followed by remove_pod() for each of
+        `excluded` (identity-matched members of self.pods), but built by
+        re-accumulating the SURVIVORS: victim selection strips most of a
+        node's pods, so rebuilding from the few kept ones is cheaper than
+        paying per-removal accounting. Integer adds make the rebuilt
+        aggregates bit-identical to subtract-per-removal."""
+        c = NodeInfo()
+        c.node = self.node
+        excluded_ids = {id(p) for p in excluded}
+        c.pods = [p for p in self.pods if id(p) not in excluded_ids]
+        c.allocatable_resource = self.allocatable_resource.clone()
+        c.taints = list(self.taints)
+        c.memory_pressure = self.memory_pressure
+        c.disk_pressure = self.disk_pressure
+        for p in c.pods:
+            c.requested_resource.add(get_resource_request(p))
+            non0 = get_nonzero_pod_request(p)
+            c.nonzero_request.milli_cpu += non0.milli_cpu
+            c.nonzero_request.memory += non0.memory
+            for port in get_container_ports(p):
+                c.used_ports.add(port.host_ip, port.protocol, port.host_port)
+        c.generation = _next_generation()
         return c
 
 

@@ -631,6 +631,35 @@ class IncrementalCluster:
             unsupported=list(unsupported))
         return compiled, cols
 
+    def refresh_dynamic(self, compiled: CompiledCluster
+                        ) -> Optional[CompiledCluster]:
+        """Re-snapshot ONLY the dynamic aggregates + group presence of a
+        previously compiled batch after placed-pod churn (bind/victim events
+        fed through apply()) — the preemption hybrid's fast re-arm path
+        (jaxe/preempt.py): a victim deletion invalidates the device carry but
+        not the static tables, so rebuilding the carry is a handful of array
+        copies instead of an O(remaining-pods) compile().
+
+        Valid only when no structural rebuild is pending: group tables clean,
+        node set and scalar universe unchanged since `compiled` was produced.
+        Returns None when a full compile() is required."""
+        if (self._groups_dirty or self._statics is None or self._dyn is None
+                or self._groups is None or self._presence is None
+                or len(self.nodes) != len(compiled.statics.names)
+                or len(self._scalar_names) != len(compiled.scalar_names)):
+            return None
+        dyn = self._dyn
+        dyn_out = DynamicInit(
+            used_cpu=dyn.used_cpu.copy(), used_mem=dyn.used_mem.copy(),
+            used_gpu=dyn.used_gpu.copy(), used_eph=dyn.used_eph.copy(),
+            used_scalar=dyn.used_scalar.copy(),
+            nonzero_cpu=dyn.nonzero_cpu.copy(),
+            nonzero_mem=dyn.nonzero_mem.copy(),
+            pod_count=dyn.pod_count.copy())
+        return replace(compiled, dynamic=dyn_out,
+                       groups=replace(compiled.groups,
+                                      presence=self._presence.copy()))
+
     # -- scheduling ---------------------------------------------------------
 
     def schedule(self, pods: List[Pod], provider: str = "DefaultProvider",

@@ -17,14 +17,31 @@ TPU-native split is therefore:
            of the cluster.
 
 A successful preemption mutates state (victims deleted), which invalidates
-the device's decisions for every later pod — so the scan re-dispatches from
-the failed pod. The IncrementalCluster event path (tpusim/jaxe/delta.py)
-keeps compiled columns in sync: binds stream in as ADDED events, victims as
-DELETED events, so a re-dispatch recompiles only what changed (the
-watch-fabric analog powering preemption). Re-dispatch batches are padded to
-power-of-two buckets with provably-infeasible rows (req_cpu = 2^61 exceeds
-any allocatable), bounding XLA recompiles to O(log P) per run; an infeasible
-row can never bind or advance the rr counter, so padding is semantics-free.
+the device's decisions for every later pod — so the scan must restart from
+the failed pod. Restarts are made cheap two ways:
+
+  1. **Chunked speculation.** The batch is compiled ONCE; the device scans
+     adaptively growing power-of-two chunks (TPUSIM_PREEMPT_CHUNK0, doubling
+     to TPUSIM_PREEMPT_CHUNK_MAX) instead of all remaining pods at once.
+     Decisions after a preemption point are discarded, so a bounded chunk
+     caps the wasted speculation at one chunk per preemption — previously a
+     full O(remaining) re-scan (and an O(remaining) host recompile) per
+     preemption made config-6-style saturated workloads quadratic. The
+     chunk size resets after every preemption (preemptions cluster once the
+     cluster saturates) and doubles while the stream stays clean, so
+     preemption-free stretches approach single-dispatch throughput.
+  2. **Dynamic-only re-arm.** The IncrementalCluster event path
+     (tpusim/jaxe/delta.py) keeps columns in sync: binds stream in as ADDED
+     events, victims as DELETED events. After a preemption the carry is
+     rebuilt from `IncrementalCluster.refresh_dynamic` — a handful of array
+     copies — and the compiled statics/tables/pod columns are reused as-is.
+     Only structural churn (a victim or bound pod carrying volumes dirties
+     the group tables) falls back to a full compile of the remaining feed.
+
+Chunks are padded to power-of-two buckets with provably-infeasible rows
+(req_cpu = 2^61 exceeds any allocatable), bounding XLA recompiles to
+O(log chunk_max) per run; an infeasible row can never bind or advance the
+rr counter, so padding is semantics-free.
 
 A cheap host gate skips the preemption attempt entirely when no placed pod
 has lower priority than the failed pod (selectVictimsOnNode can then never
@@ -35,6 +52,7 @@ the mirror bookkeeping.
 from __future__ import annotations
 
 import logging
+import os
 from collections import Counter
 from typing import List
 
@@ -48,6 +66,7 @@ from tpusim.engine.generic_scheduler import (
     SchedulingError,
 )
 from tpusim.engine.providers import DEFAULT_PROVIDER
+from tpusim.engine.resources import get_resource_request, request_memo
 from tpusim.engine.util import get_pod_priority
 from tpusim.framework.report import Status
 from tpusim.framework.store import ADDED
@@ -74,6 +93,79 @@ log = logging.getLogger(__name__)
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
+
+
+class _PreemptBound:
+    """Vectorized necessary-fit bound over [priority, node] request aggregates
+    — the device-engine analog of victim selection's masked aggregate search.
+
+    selectVictimsOnNode (core/generic_scheduler.go:583-665) strips every
+    lower-priority pod from a candidate node and runs podFitsOnNode;
+    PodFitsResources (predicates.go:706-776) is in that predicate set, so a
+    node where the pod's request still exceeds allocatable after removing ALL
+    lower-priority usage can never yield a fitting victim set. This tracker
+    keeps per-priority-band per-node aggregates of the same
+    get_resource_request accounting NodeInfo uses, and evaluates that bound
+    for every node in one numpy pass, so the host pipeline only clones and
+    reprieves on the handful of nodes that can actually fit the pod.
+
+    The bound checks pod count + cpu/mem/gpu/ephemeral exactly as
+    pod_fits_resources does (including the all-zero-request early-out) and
+    deliberately ignores scalar resources and meta.ignored_extended_resources
+    — omitted checks only make the bound more permissive, so a pruned node is
+    PROVABLY unfit and the filtered pipeline's outcome is identical."""
+
+    def __init__(self, compiled, placed_pods: List[Pod]):
+        st = compiled.statics
+        self._node_index = dict(compiled.node_index)
+        n = len(st.names)
+        self._alloc = (st.alloc_cpu.copy(), st.alloc_mem.copy(),
+                       st.alloc_gpu.copy(), st.alloc_eph.copy())
+        self._allowed = st.allowed_pods.copy()
+        # priority -> [cpu, mem, gpu, eph, count] per-node arrays
+        self._bands: dict = {}
+        self._n = n
+        for pod in placed_pods:
+            if pod.spec.node_name:
+                self.update(pod, +1)
+
+    def update(self, pod: Pod, sign: int) -> None:
+        i = self._node_index.get(pod.spec.node_name)
+        if i is None:
+            return
+        prio = get_pod_priority(pod)
+        band = self._bands.get(prio)
+        if band is None:
+            band = [np.zeros(self._n, np.int64) for _ in range(5)]
+            self._bands[prio] = band
+        req = get_resource_request(pod)
+        band[0][i] += sign * req.milli_cpu
+        band[1][i] += sign * req.memory
+        band[2][i] += sign * req.nvidia_gpu
+        band[3][i] += sign * req.ephemeral_storage
+        band[4][i] += sign
+
+    def candidates(self, pod: Pod):
+        """Set of node names where the stripped-node resource bound passes,
+        or None when every node passes (skip filtering)."""
+        pp = get_pod_priority(pod)
+        remain = [np.zeros(self._n, np.int64) for _ in range(5)]
+        for prio, band in self._bands.items():
+            if prio >= pp:   # only lower-priority pods are strippable
+                for acc, col in zip(remain, band):
+                    acc += col
+        req = get_resource_request(pod)
+        ok = remain[4] + 1 <= self._allowed
+        if (req.milli_cpu or req.memory or req.nvidia_gpu
+                or req.ephemeral_storage or req.scalar):
+            for k, want in enumerate((req.milli_cpu, req.memory,
+                                      req.nvidia_gpu, req.ephemeral_storage)):
+                ok &= want + remain[k] <= self._alloc[k]
+        if ok.all():
+            return None
+        names = self._node_index
+        mask = ok
+        return {name for name, i in names.items() if mask[i]}
 
 
 def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
@@ -127,152 +219,213 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
         get_pod_priority(p) for p in snapshot.pods if p.spec.node_name)
     attempts: dict = {}   # pod key -> preemption attempts (budget 1, like
     #                       _schedule_one's preempt_budget)
-    remaining = feed
-    full_size = len(feed)
     last_outcome = "run"
     metrics = cc.metrics
-    first_dispatch = True
-    rr_start = 0
+    first_compile = True
+    rr_start = 0          # lastNodeIndex persists across the whole run
+    #                       (generic_scheduler.go:97); restarts resume it
+    pos = 0               # next unprocessed pod in `feed`
+
+    chunk0 = max(1, int(os.environ.get("TPUSIM_PREEMPT_CHUNK0", "128")))
+    chunk_max = max(chunk0,
+                    int(os.environ.get("TPUSIM_PREEMPT_CHUNK_MAX", "8192")))
+    if batch_size > 0:
+        # wavefront waves must tile chunks exactly so wave boundaries (and
+        # the frozen-carry approximation) match the unchunked dispatch
+        chunk0 = -(-chunk0 // batch_size) * batch_size
+        chunk_max = max(chunk0, chunk_max // batch_size * batch_size)
 
     from time import perf_counter
 
     from tpusim.framework.metrics import since_in_microseconds
 
-    while True:
-        compiled, cols = inc.compile(remaining)
-        if compiled.unsupported:
-            if not first_dispatch:
-                raise RuntimeError(
-                    "jax preemption: compile fallback after binds were made "
-                    f"({sorted(set(compiled.unsupported))[:3]})")
-            log.warning("jax backend (preemption) falling back to reference "
-                        "for: %s", "; ".join(sorted(set(compiled.unsupported))[:5]))
-            ref = ClusterCapacity(host_config(), new_pods=pods,
-                                  scheduled_pods=snapshot.pods,
-                                  nodes=snapshot.nodes,
-                                  services=snapshot.services, pvs=snapshot.pvs,
-                                  pvcs=snapshot.pvcs,
-                                  storage_classes=snapshot.storage_classes)
-            ref.run()
-            return ref.status
+    import jax.numpy as jnp
 
-        num_bits = NUM_FIXED_BITS + len(compiled.scalar_names)
-        config = config_for(
-            [compiled],
-            most_requested=provider in _MOST_REQUESTED_PROVIDERS,
-            num_reason_bits=num_bits,
-            hard_weight=hard_pod_affinity_symmetric_weight)
-        ensure_x64()
-        # lastNodeIndex persists across the whole run (generic_scheduler.go:97)
-        # — re-dispatches resume the rr counter at the preemption point
-        carry = carry_init(compiled)._replace(rr=np.int64(rr_start))
-        statics = statics_to_device(compiled)
-        xs_host = pod_columns_to_host(cols)
-        if not first_dispatch:
-            # bucket re-dispatch shapes so XLA recompiles O(log P) times
-            bucket = min(_next_pow2(len(remaining)), full_size)
-            xs_host = pad_infeasible_rows(xs_host, bucket - len(remaining))
-        first_dispatch = False
-        import jax.numpy as jnp
+    # pod specs are immutable for the duration of the run (only status and
+    # node_name change), so request recomputation — hot in victim selection's
+    # clone/strip/reprieve churn — is memoized for the whole hybrid loop
+    with request_memo():
+        while pos < len(feed):
+            # (re)compile feed[pos:] against the current picture; reached once up
+            # front and again only after structural churn (volume-carrying binds
+            # or victims dirty the group tables — refresh_dynamic covers the rest)
+            compiled, cols = inc.compile(feed[pos:])
+            if compiled.unsupported:
+                if not first_compile:
+                    raise RuntimeError(
+                        "jax preemption: compile fallback after binds were made "
+                        f"({sorted(set(compiled.unsupported))[:3]})")
+                log.warning("jax backend (preemption) falling back to reference "
+                            "for: %s", "; ".join(sorted(set(compiled.unsupported))[:5]))
+                ref = ClusterCapacity(host_config(), new_pods=pods,
+                                      scheduled_pods=snapshot.pods,
+                                      nodes=snapshot.nodes,
+                                      services=snapshot.services, pvs=snapshot.pvs,
+                                      pvcs=snapshot.pvcs,
+                                      storage_classes=snapshot.storage_classes)
+                ref.run()
+                return ref.status
+            if first_compile:
+                # the bound only prunes nodes the resource-fit check would
+                # reject; shipped providers carry it via GeneralPredicates
+                # (which subsumes PodFitsResources, predicates.go:1059-1123),
+                # policies may register PodFitsResources directly — a set
+                # with neither skips pruning to stay outcome-identical
+                preds = cc.scheduler.predicates
+                bound = (_PreemptBound(compiled, snapshot.pods)
+                         if "GeneralPredicates" in preds
+                         or "PodFitsResources" in preds else None)
+            first_compile = False
 
-        xs = PodX(*(jnp.asarray(a) for a in xs_host))
+            num_bits = NUM_FIXED_BITS + len(compiled.scalar_names)
+            config = config_for(
+                [compiled],
+                most_requested=provider in _MOST_REQUESTED_PROVIDERS,
+                num_reason_bits=num_bits,
+                hard_weight=hard_pod_affinity_symmetric_weight)
+            ensure_x64()
+            statics = statics_to_device(compiled)
+            xs_all = pod_columns_to_host(cols)
+            strings = reason_strings(compiled.scalar_names)
+            names = compiled.statics.names
+            base = pos            # xs_all row i holds feed[base + i]
+            carry = carry_init(compiled)._replace(rr=np.int64(rr_start))
+            chunk = chunk0
 
-        dispatch_start = perf_counter()
-        if batch_size > 0:
-            _, choices, counts, advanced = schedule_wavefront(
-                config, carry, statics, xs, batch_size)
-        else:
-            _, choices, counts, advanced = schedule_scan(config, carry,
-                                                         statics, xs)
-        choices = np.asarray(choices)[:len(remaining)]
-        counts = np.asarray(counts)[:len(remaining)]
-        advanced = np.asarray(advanced)[:len(remaining)]
-        metrics.scheduling_algorithm_latency.observe(
-            since_in_microseconds(dispatch_start))
+            while pos < len(feed):
+                take = min(chunk, len(feed) - pos)
+                off = pos - base
+                sl = PodX(*(a[off:off + take] for a in xs_all))
+                dispatch_start = perf_counter()
+                if batch_size > 0:
+                    xs = PodX(*(jnp.asarray(a) for a in sl))
+                    carry_out, choices, counts, advanced = schedule_wavefront(
+                        config, carry, statics, xs, batch_size)
+                else:
+                    # pow2 buckets bound XLA recompiles to O(log chunk_max)
+                    bucket = _next_pow2(take)
+                    sl = pad_infeasible_rows(sl, bucket - take)
+                    xs = PodX(*(jnp.asarray(a) for a in sl))
+                    carry_out, choices, counts, advanced = schedule_scan(
+                        config, carry, statics, xs)
+                choices = np.asarray(choices)[:take]
+                counts = np.asarray(counts)[:take]
+                advanced = np.asarray(advanced)[:take]
+                metrics.scheduling_algorithm_latency.observe(
+                    since_in_microseconds(dispatch_start))
 
-        strings = reason_strings(compiled.scalar_names)
-        names = compiled.statics.names
+                mutated = False
+                for j in range(take):
+                    pod = feed[pos + j]
+                    cc.resource_store.add(ResourceType.PODS, pod)  # nextPod's add
+                    c = int(choices[j])
+                    if c >= 0:
+                        cc.bind(pod, names[c])
+                        placed, _ = cc.resource_store.get(ResourceType.PODS,
+                                                          pod.key())
+                        inc.apply(ADDED, placed)
+                        placed_priorities[get_pod_priority(placed)] += 1
+                        if bound is not None:
+                            bound.update(placed, +1)
+                        last_outcome = "bound"
+                        continue
 
-        redispatch = False
-        for j, pod in enumerate(remaining):
-            cc.resource_store.add(ResourceType.PODS, pod)  # nextPod's store add
-            c = int(choices[j])
-            if c >= 0:
-                cc.bind(pod, names[c])
-                bound, _ = cc.resource_store.get(ResourceType.PODS, pod.key())
-                inc.apply(ADDED, bound)
-                placed_priorities[get_pod_priority(bound)] += 1
-                last_outcome = "bound"
-                continue
+                    # failure: the scan left the carry untouched, so later
+                    # decisions stay valid unless a preemption below mutates state
+                    pod_priority = get_pod_priority(pod)
+                    can_preempt = (
+                        cc.config.enable_pod_priority
+                        and attempts.get(pod.key(), 0) < 1
+                        and any(count > 0 and pri < pod_priority
+                                for pri, count in placed_priorities.items()))
+                    if not can_preempt:
+                        cc.update(pod, PodCondition(
+                            type="PodScheduled", status="False",
+                            reason="Unschedulable",
+                            message=format_fit_error(len(names), counts[j],
+                                                     strings)))
+                        last_outcome = "failed"
+                        continue
 
-            # failure: the scan left the carry untouched, so later decisions
-            # stay valid unless a preemption below mutates state
-            pod_priority = get_pod_priority(pod)
-            can_preempt = (
-                cc.config.enable_pod_priority
-                and attempts.get(pod.key(), 0) < 1
-                and any(count > 0 and pri < pod_priority
-                        for pri, count in placed_priorities.items()))
-            if not can_preempt:
-                cc.update(pod, PodCondition(
-                    type="PodScheduled", status="False",
-                    reason="Unschedulable",
-                    message=format_fit_error(len(names), counts[j], strings)))
-                last_outcome = "failed"
-                continue
+                    # host arm: per-node failure reasons (the device ships only
+                    # the aggregate histogram), then the exact Preempt pipeline —
+                    # both against the cache's generation-checked snapshot, like
+                    # the host engine's g.cachedNodeInfoMap
+                    node_infos = cc.refresh_node_info_snapshot()
+                    try:
+                        filtered, failed = cc.scheduler.find_nodes_that_fit(
+                            pod, cc.nodes, node_infos)
+                    except SchedulingError as exc:
+                        cc.update(pod, PodCondition(
+                            type="PodScheduled", status="False",
+                            reason="Unschedulable", message=str(exc)))
+                        last_outcome = "failed"
+                        continue
+                    rr_here = rr_start + int(np.sum(advanced[:j]))
+                    if filtered:
+                        # device said infeasible, host disagrees — a parity bug;
+                        # keep the run coherent by trusting the host engine
+                        log.error("device/host disagreement for pod %s: host "
+                                  "found %d feasible nodes; using host placement",
+                                  pod.key(), len(filtered))
+                        cc.scheduler.last_node_index = rr_here
+                        host = cc.scheduler.schedule(pod, cc.nodes, node_infos)
+                        rr_start = cc.scheduler.last_node_index
+                        cc.bind(pod, host)
+                        placed, _ = cc.resource_store.get(ResourceType.PODS,
+                                                          pod.key())
+                        inc.apply(ADDED, placed)
+                        placed_priorities[get_pod_priority(placed)] += 1
+                        if bound is not None:
+                            bound.update(placed, +1)
+                        last_outcome = "bound"
+                        pos += j + 1
+                        mutated = True
+                        break
+                    fit_err = FitError(pod, len(cc.nodes), failed)
+                    cand = bound.candidates(pod) if bound is not None else None
+                    node, victims = cc.attempt_preemption(
+                        pod, fit_err,
+                        candidate_filter=(cand.__contains__
+                                          if cand is not None else None))
+                    if node is None:
+                        cc.update(pod, PodCondition(
+                            type="PodScheduled", status="False",
+                            reason="Unschedulable", message=fit_err.error()))
+                        last_outcome = "failed"
+                        continue
+                    for victim in victims:
+                        inc.apply(EV_DELETED, victim)
+                        placed_priorities[get_pod_priority(victim)] -= 1
+                        if bound is not None:
+                            bound.update(victim, -1)
+                    attempts[pod.key()] = attempts.get(pod.key(), 0) + 1
+                    # scheduleOne retries the nominated pod immediately
+                    # (simulator _schedule_one preempt_budget arm); every later
+                    # decision was computed against pre-preemption state
+                    pos += j
+                    rr_start = rr_here
+                    mutated = True
+                    break
 
-            # host arm: per-node failure reasons (the device ships only the
-            # aggregate histogram), then the exact Preempt pipeline — both
-            # against the cache's generation-checked snapshot, like the host
-            # engine's g.cachedNodeInfoMap
-            node_infos = cc.refresh_node_info_snapshot()
-            try:
-                filtered, failed = cc.scheduler.find_nodes_that_fit(
-                    pod, cc.nodes, node_infos)
-            except SchedulingError as exc:
-                cc.update(pod, PodCondition(
-                    type="PodScheduled", status="False",
-                    reason="Unschedulable", message=str(exc)))
-                last_outcome = "failed"
-                continue
-            if filtered:
-                # device said infeasible, host disagrees — a parity bug; keep
-                # the run coherent by trusting the host engine
-                log.error("device/host disagreement for pod %s: host found %d "
-                          "feasible nodes; using host placement", pod.key(),
-                          len(filtered))
-                cc.scheduler.last_node_index = rr_start + int(np.sum(advanced[:j]))
-                host = cc.scheduler.schedule(pod, cc.nodes, node_infos)
-                rr_start = cc.scheduler.last_node_index
-                cc.bind(pod, host)
-                bound, _ = cc.resource_store.get(ResourceType.PODS, pod.key())
-                inc.apply(ADDED, bound)
-                placed_priorities[get_pod_priority(bound)] += 1
-                last_outcome = "bound"
-                remaining = remaining[j + 1:]
-                redispatch = bool(remaining)
-                break
-            fit_err = FitError(pod, len(cc.nodes), failed)
-            node, victims = cc.attempt_preemption(pod, fit_err)
-            if node is None:
-                cc.update(pod, PodCondition(
-                    type="PodScheduled", status="False",
-                    reason="Unschedulable", message=fit_err.error()))
-                last_outcome = "failed"
-                continue
-            for victim in victims:
-                inc.apply(EV_DELETED, victim)
-                placed_priorities[get_pod_priority(victim)] -= 1
-            attempts[pod.key()] = attempts.get(pod.key(), 0) + 1
-            rr_start += int(np.sum(advanced[:j]))
-            # scheduleOne retries the nominated pod immediately
-            # (simulator _schedule_one preempt_budget arm); every later
-            # decision was computed against pre-preemption state
-            remaining = remaining[j:]
-            redispatch = True
-            break
-        if not redispatch:
-            break
+                if not mutated:
+                    pos += take
+                    carry = carry_out
+                    rr_start += int(np.sum(advanced))
+                    chunk = min(chunk * 2, chunk_max)
+                    continue
+                if pos >= len(feed):
+                    break
+                # state changed: re-arm the carry from the incremental picture;
+                # statics/tables/pod columns are reused when the group structure
+                # is clean, else fall out to a full recompile of feed[pos:]
+                refreshed = inc.refresh_dynamic(compiled)
+                if refreshed is None:
+                    break
+                compiled = refreshed
+                carry = carry_init(compiled)._replace(rr=np.int64(rr_start))
+                chunk = chunk0
+
 
     cc.status.stop_reason = cc.STOP_REASONS[last_outcome]
     cc.close()

@@ -358,7 +358,8 @@ class ClusterCapacity:
         metrics.e2e_scheduling_latency.observe(since_in_microseconds(e2e_start))
         return "bound"
 
-    def attempt_preemption(self, pod: Pod, fit_err: FitError):
+    def attempt_preemption(self, pod: Pod, fit_err: FitError,
+                           candidate_filter=None):
         """The preemption arm of scheduleOne (scheduler.go:449-455 → the full
         Preempt pipeline, core/generic_scheduler.go:205-262): pick a node +
         victims, delete the victims from the store (mutating the cache through
@@ -373,7 +374,8 @@ class ClusterCapacity:
             # Preempt runs against the same cached snapshot the failed
             # Schedule used (g.cachedNodeInfoMap, generic_scheduler.go:205)
             node, victims, to_clear = self.scheduler.preempt(
-                pod, self.nodes, self._cached_node_infos, fit_err)
+                pod, self.nodes, self._cached_node_infos, fit_err,
+                candidate_filter=candidate_filter)
         except SchedulingError:
             # a failed preemption attempt (e.g. extender error) is
             # logged-and-dropped in the reference (scheduler.go:

@@ -142,7 +142,8 @@ def test_fuzz_policy_parity():
     pred_pool = ["GeneralPredicates", "PodFitsResources",
                  "PodToleratesNodeTaints", "MatchNodeSelector",
                  "CheckNodeMemoryPressure", "CheckNodeDiskPressure",
-                 "MatchInterPodAffinity", "PodFitsHostPorts", "HostName"]
+                 "MatchInterPodAffinity", "PodFitsHostPorts", "HostName",
+                 "CheckNodeUnschedulable", "PodToleratesNodeNoExecuteTaints"]
     prio_pool = ["LeastRequestedPriority", "MostRequestedPriority",
                  "BalancedResourceAllocation", "NodeAffinityPriority",
                  "TaintTolerationPriority", "SelectorSpreadPriority",
@@ -159,6 +160,15 @@ def test_fuzz_policy_parity():
                     labels_presence=LabelsPresenceArg(
                         labels=["disktype"],
                         presence=rng.random() < 0.7))))
+        if rng.random() < 0.3:
+            # a second label predicate: with alwaysCheckAllPredicates below,
+            # several failing label predicates duplicate one reason string —
+            # the kernel's count-mode histogram must match the host's
+            # multiplicities (VERDICT r3 item 8)
+            preds.append(PredicatePolicy(
+                name="WantsZone", argument=PredicateArgument(
+                    labels_presence=LabelsPresenceArg(
+                        labels=["zone"], presence=rng.random() < 0.7))))
         if rng.random() < 0.5:
             from tpusim.engine.policy import ServiceAffinityArg
 
@@ -166,6 +176,13 @@ def test_fuzz_policy_parity():
                 name="StickToZone", argument=PredicateArgument(
                     service_affinity=ServiceAffinityArg(
                         labels=[rng.choice(["zone", "disktype"])]))))
+            if rng.random() < 0.4:
+                # a SECOND ServiceAffinity entry: each evaluates its own
+                # label segment against the shared first-pod lock
+                preds.append(PredicatePolicy(
+                    name="StickToDisk", argument=PredicateArgument(
+                        service_affinity=ServiceAffinityArg(
+                            labels=["disktype"]))))
         prios = [PriorityPolicy(name=n, weight=rng.randint(1, 5)) for n in
                  rng.sample(prio_pool, rng.randint(1, 4))]
         if rng.random() < 0.5:
@@ -179,7 +196,8 @@ def test_fuzz_policy_parity():
                 argument=PriorityArgument(
                     service_anti_affinity=ServiceAntiAffinityArg(
                         label="zone"))))
-        policy = Policy(predicates=preds, priorities=prios)
+        policy = Policy(predicates=preds, priorities=prios,
+                        always_check_all_predicates=rng.random() < 0.4)
         ref = run_simulation(list(pods), snapshot, backend="reference",
                              policy=policy)
         jx = run_simulation(list(pods), snapshot, backend="jax",

@@ -76,7 +76,7 @@ def test_policy_node_label_predicate_on_device():
             PriorityPolicy(name="BalancedResourceAllocation", weight=1),
         ])
     cp = compile_policy(policy)
-    assert not cp.unsupported and cp.spec.label_rows == ("",)
+    assert not cp.unsupported and cp.spec.label_rows == ("tail:0",)
     status = assert_policy_parity(workload(), mixed_cluster(), policy)
     # only ssd-labelled nodes (n0/n2/n4) may host pods
     assert status.successful_pods
@@ -279,29 +279,50 @@ def test_policy_always_check_all_on_device():
     assert "Insufficient cpu" in msg and "taints" in msg
 
 
-def test_policy_always_check_all_fallback_shapes():
-    """Host reason multiplicity the device bit-histogram can't represent
-    routes to the reference engine."""
+def test_policy_always_check_all_duplicate_reasons_on_device():
+    """Shapes where the host emits one reason string SEVERAL times per node
+    (VERDICT r3 item 8): the kernel's count-mode histogram reproduces the
+    multiplicities natively — no fallback, byte-identical messages."""
     aca = dict(always_check_all_predicates=True)
+
+    # (a) several label-presence predicates sharing one reason string
     two_labels = Policy(predicates=[
         PredicatePolicy(name="LblA", argument=PredicateArgument(
             labels_presence=LabelsPresenceArg(labels=["x"], presence=True))),
         PredicatePolicy(name="LblB", argument=PredicateArgument(
             labels_presence=LabelsPresenceArg(labels=["y"], presence=True))),
     ], priorities=[], **aca)
-    assert compile_policy(two_labels).unsupported
+    assert not compile_policy(two_labels).unsupported
+    # n0 misses both labels (2 occurrences), n1 misses one (1 occurrence)
+    nodes = [make_node("n0"), make_node("n1", labels={"x": "1"})]
+    status = assert_policy_parity([make_pod("p", milli_cpu=100)],
+                                  ClusterSnapshot(nodes=nodes), two_labels)
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert "3 node(s) didn't have the requested labels" in msg
+
+    # (b) GeneralPredicates plus an individually-named part
     umbrella_plus_part = Policy(predicates=[
         PredicatePolicy(name="GeneralPredicates"),
         PredicatePolicy(name="PodFitsResources")], priorities=[], **aca)
-    assert compile_policy(umbrella_plus_part).unsupported
+    assert not compile_policy(umbrella_plus_part).unsupported
+    status = assert_policy_parity(
+        [make_pod("p", milli_cpu=500)],
+        ClusterSnapshot(nodes=[make_node("tiny", milli_cpu=100)]),
+        umbrella_plus_part)
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert "2 Insufficient cpu" in msg
+
+    # (c) CheckNodeUnschedulable beside the mandatory condition check
     unsched = Policy(predicates=[
-        PredicatePolicy(name="CheckNodeUnschedulable")], priorities=[], **aca)
-    assert compile_policy(unsched).unsupported
-    # the same shapes WITHOUT the flag stay on device
-    assert not compile_policy(Policy(predicates=[
-        PredicatePolicy(name="GeneralPredicates"),
-        PredicatePolicy(name="PodFitsResources")],
-        priorities=[])).unsupported
+        PredicatePolicy(name="CheckNodeUnschedulable"),
+        PredicatePolicy(name="PodFitsResources")], priorities=[], **aca)
+    assert not compile_policy(unsched).unsupported
+    status = assert_policy_parity(
+        [make_pod("p", milli_cpu=100)],
+        ClusterSnapshot(nodes=[make_node("cordoned", unschedulable=True)]),
+        unsched)
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert "2 node(s) were unschedulable" in msg
 
 
 def test_policy_no_execute_taints_on_device():
@@ -329,12 +350,20 @@ def test_policy_no_execute_taints_on_device():
     # NoSchedule node (the narrow variant ignores NoSchedule)
     assert by_name["p0"] == "soft" and by_name["p1"] == "soft"
     assert by_name["tolerant"] == "evict"
-    # with always-check-all plus BOTH taint predicates: host-bound
+    # always-check-all plus BOTH taint predicates: a NoExecute taint fails
+    # both (2 occurrences of the shared string), NoSchedule only the broad
+    # one (1 occurrence) — count mode keeps this on device
     both = Policy(predicates=[
         PredicatePolicy(name="PodToleratesNodeTaints"),
-        PredicatePolicy(name="PodToleratesNodeNoExecuteTaints")],
+        PredicatePolicy(name="PodToleratesNodeNoExecuteTaints"),
+        PredicatePolicy(name="PodFitsResources")],
         priorities=[], always_check_all_predicates=True)
-    assert compile_policy(both).unsupported
+    assert not compile_policy(both).unsupported
+    status = assert_policy_parity(
+        [make_pod("p", milli_cpu=100)],
+        ClusterSnapshot(nodes=nodes), both)
+    msg = status.failed_pods[0].status.conditions[-1].message
+    assert "3 node(s) had taints that the pod didn't tolerate" in msg
 
 
 def _saa_world(rng_seed=0):
@@ -549,7 +578,11 @@ def test_policy_service_affinity_locked_node_lacks_label():
         {"n1", "n2", "n3"}
 
 
-def test_policy_service_affinity_multiple_entries_fall_back():
+def test_policy_service_affinity_multiple_entries_on_device():
+    """Two ServiceAffinity predicates in one policy: each entry evaluates
+    its own label segment as a separate stage against the shared
+    first-matching-pod lock (VERDICT r3 item 8 — previously a fallback)."""
+    from tpusim.api.types import Service
     from tpusim.engine.policy import ServiceAffinityArg
 
     policy = Policy(predicates=[
@@ -557,8 +590,41 @@ def test_policy_service_affinity_multiple_entries_fall_back():
             service_affinity=ServiceAffinityArg(labels=["zone"]))),
         PredicatePolicy(name="SA-Two", argument=PredicateArgument(
             service_affinity=ServiceAffinityArg(labels=["rack"]))),
-    ], priorities=[])
-    assert compile_policy(policy).unsupported
+        PredicatePolicy(name="PodFitsResources"),
+    ], priorities=[PriorityPolicy(name="LeastRequestedPriority", weight=1)])
+    cp = compile_policy(policy)
+    assert not cp.unsupported
+    assert cp.spec.sa_segs == (1, 1) and len(cp.spec.sa_slots) == 2
+    assert cp.sa_entries == (("zone",), ("rack",))
+
+    # zone AND rack must both follow the first db pod's node (n1: z1/r1);
+    # n2 shares the zone but not the rack, n3 shares neither
+    nodes = [
+        make_node("n1", milli_cpu=9000, labels={"zone": "z1", "rack": "r1"}),
+        make_node("n2", milli_cpu=9000, labels={"zone": "z1", "rack": "r2"}),
+        make_node("n3", milli_cpu=9000, labels={"zone": "z2", "rack": "r3"}),
+    ]
+    svc = Service.from_obj({"metadata": {"name": "db",
+                                         "namespace": "default"},
+                            "spec": {"selector": {"app": "db"}}})
+    seed = make_pod("seed", milli_cpu=100, node_name="n1", phase="Running",
+                    labels={"app": "db"})
+    snap = ClusterSnapshot(nodes=nodes, pods=[seed], services=[svc])
+    pods = [make_pod(f"db{i}", milli_cpu=200, labels={"app": "db"})
+            for i in range(3)]
+    status = assert_policy_parity(pods, snap, policy)
+    # both entries constrain: every db pod lands on the seed's node
+    assert all(p.spec.node_name == "n1" for p in status.successful_pods)
+
+    # differential: zone-only would have allowed n2 — prove rack bites
+    zone_only = Policy(predicates=[
+        PredicatePolicy(name="SA-One", argument=PredicateArgument(
+            service_affinity=ServiceAffinityArg(labels=["zone"]))),
+        PredicatePolicy(name="PodFitsResources"),
+    ], priorities=[PriorityPolicy(name="LeastRequestedPriority", weight=1)])
+    status2 = assert_policy_parity(pods, snap, zone_only)
+    assert {p.spec.node_name
+            for p in status2.successful_pods} == {"n1", "n2"}
 
 
 def test_policy_service_affinity_with_equivalence_cache():
@@ -598,16 +664,12 @@ def test_policy_service_affinity_with_equivalence_cache():
 
 
 def test_policy_unsupported_routes_end_to_end():
-    """run_simulation's host-bound-policy reroute arm, end to end: a
-    multiple-ServiceAffinity policy (no HTTP involved) runs the reference
+    """run_simulation's host-bound-policy reroute arm, end to end: a policy
+    naming the 1.0 PodFitsPorts alias (host-bound: it evaluates at the
+    host's custom tail slot; no HTTP involved) runs the reference
     orchestrator under backend='jax' and matches backend='reference'."""
-    from tpusim.engine.policy import ServiceAffinityArg
-
     policy = Policy(predicates=[
-        PredicatePolicy(name="SA-One", argument=PredicateArgument(
-            service_affinity=ServiceAffinityArg(labels=["zone"]))),
-        PredicatePolicy(name="SA-Two", argument=PredicateArgument(
-            service_affinity=ServiceAffinityArg(labels=["disktype"]))),
+        PredicatePolicy(name="PodFitsPorts"),
         PredicatePolicy(name="PodFitsResources"),
     ], priorities=[PriorityPolicy(name="LeastRequestedPriority", weight=1)])
     assert compile_policy(policy).unsupported

@@ -20,9 +20,9 @@ TPUSIM_MAX_GROUPS, raw signatures > TPUSIM_MAX_RAW_GROUPS, matcher precompute
 merge by match profile first, so only behaviorally distinct classes count),
 unresolvable PVC references on zone-constrained clusters (the reference's
 NoVolumeZoneConflict *errors* host-side there), and the host-bound policy
-shapes listed in jaxe/policyc.py (extenders, multiple ServiceAffinity
-entries, duplicate-reason alwaysCheckAllPredicates). Volume workloads run
-natively on BOTH the fresh and incremental (event-log) paths.
+shapes listed in jaxe/policyc.py (extenders, the PodFitsPorts tail-slot
+alias). Volume workloads run natively on BOTH the fresh and incremental
+(event-log) paths.
 """
 
 from __future__ import annotations
@@ -254,12 +254,12 @@ class JaxBackend:
             if cp.spec.sa_enabled:
                 from tpusim.jaxe.policyc import service_affinity_columns
 
-                (cols.sa_self_id, sa_self_ok, sa_unres, sa_val,
+                (cols.sa_self_id, sa_pin, sa_val,
                  sa_lock_init) = service_affinity_columns(
                     cp, pods, snapshot, compiled.node_index,
                     compiled.groups.saa_defs)
                 host_statics = host_statics._replace(
-                    sa_self_ok=sa_self_ok, sa_unres=sa_unres, sa_val=sa_val)
+                    sa_pin=sa_pin, sa_val=sa_val)
             statics = _tree_to_device(host_statics)
         # Batches beyond TPUSIM_SCAN_CHUNK pods run through the
         # double-buffered chunked scan: pod columns stay host-side and stream

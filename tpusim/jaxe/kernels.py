@@ -69,6 +69,7 @@ from tpusim.jaxe.state import (
     BIT_MEMORY_PRESSURE,
     BIT_NODE_LABEL_PRESENCE,
     BIT_NODE_SELECTOR_MISMATCH,
+    BIT_NODE_UNSCHEDULABLE,
     BIT_SERVICE_AFFINITY,
     BIT_TAINTS_NOT_TOLERATED,
     NUM_FIXED_BITS,
@@ -164,14 +165,15 @@ class Statics(NamedTuple):
     image_score: jnp.ndarray
     #   saa_dom — [E, N] per-ServiceAntiAffinity-entry node label-value domain
     #             ids (0 = label absent), from jaxe.policyc
-    #   ServiceAffinity predicate (policy): sa_val [La, N] interned node
-    #   values per policy affinity label (0 = absent); sa_self_ok [Cs, N] the
-    #   pod's own nodeSelector pins over those labels; sa_unres [Cs, La]
-    #   which labels the pod left unpinned
+    #   ServiceAffinity predicates (policy): sa_val [La, N] interned node
+    #   values per policy affinity label (0 = absent; label rows concatenate
+    #   the entries' label lists, segmented by PolicySpec.sa_segs); sa_pin
+    #   [Cs, La] the pod's own nodeSelector pins in the same value space
+    #   (0 = label unpinned; a pinned value no node carries interns to a
+    #   fresh id that matches nothing)
     saa_dom: jnp.ndarray
     sa_val: jnp.ndarray
-    sa_self_ok: jnp.ndarray
-    sa_unres: jnp.ndarray
+    sa_pin: jnp.ndarray
 
 
 class PodX(NamedTuple):
@@ -222,16 +224,21 @@ class PolicySpec:
     # ServiceAntiAffinity custom priorities: one weight per entry, parallel
     # to the Statics.saa_dom rows (selector_spreading.go:176-280)
     saa_weights: tuple = ()
-    # ServiceAffinity predicate (policy): enabled + its ordering slot (the
-    # canonical name "CheckServiceAffinity" evaluates at its ordering
-    # position; any other policy name runs after the fixed ordering)
+    # ServiceAffinity predicates (policy): one slot per entry — a canonical
+    # PREDICATES_ORDERING name evaluates at that position; any other policy
+    # name runs after the fixed ordering at its alphabetical tail position
+    # ("tail:<k>"). sa_segs holds each entry's label count, segmenting the
+    # concatenated Statics.sa_val label rows. sa_enabled gates the
+    # first-matching-pod lock updates in the bind scatter.
     sa_enabled: bool = False
-    sa_slot: str = ""
+    sa_slots: tuple = ()
+    sa_segs: tuple = ()
     # first-failure reason selection becomes collect-all-failures
     # (generic_scheduler.go alwaysCheckAllPredicates)
     always_check_all: bool = False
     # one entry per Statics.label_ok row: the PREDICATES_ORDERING name whose
-    # slot the row evaluates at, or "" for the after-the-ordering tail row
+    # slot the row evaluates at, or "tail:<k>" for its alphabetical position
+    # after the fixed ordering
     label_rows: tuple = ()
     has_label_prio: bool = False
 
@@ -298,7 +305,7 @@ STATICS_AXES = dict(
     label_ok=("label_pred", "node"), label_prio=("node",),
     image_score=("sig_img", "node"), saa_dom=("saa_entry", "node"),
     sa_val=("sa_label", "node"),
-    sa_self_ok=("sig_sa_self", "node"), sa_unres=("sig_sa_self", "sa_label"),
+    sa_pin=("sig_sa_self", "sa_label"),
 )
 CARRY_AXES = dict(
     used_cpu=("node",), used_mem=("node",), used_gpu=("node",), used_eph=("node",),
@@ -381,8 +388,7 @@ def statics_to_host(compiled: CompiledCluster) -> Statics:
         image_score=np.zeros((1, len(s.alloc_cpu)), dtype=np.int64),
         saa_dom=np.zeros((1, len(s.alloc_cpu)), dtype=np.int32),
         sa_val=np.zeros((1, len(s.alloc_cpu)), dtype=np.int32),
-        sa_self_ok=np.ones((1, len(s.alloc_cpu)), dtype=bool),
-        sa_unres=np.zeros((1, 1), dtype=bool))
+        sa_pin=np.zeros((1, 1), dtype=np.int32))
 
 
 def _presence_dom_init(presence: np.ndarray, topo_dom: np.ndarray,
@@ -565,6 +571,16 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     # already carry spec.unschedulable and fail first with the same reason
     fail_cond = st.cond_fail_bits != 0
     stages = [(fail_cond, st.cond_fail_bits)]
+    if (ps is not None and ps.always_check_all and en is not None
+            and CHECK_NODE_UNSCHEDULABLE_PRED in en):
+        # with always-check-all, a registered CheckNodeUnschedulable emits
+        # the unschedulable reason a SECOND time beyond the mandatory
+        # condition check (both run; same string) — the count-mode histogram
+        # below sums stage firings, so a duplicate stage reproduces the
+        # host's doubled occurrence exactly
+        unsched = (st.cond_fail_bits
+                   & (jnp.int64(1) << BIT_NODE_UNSCHEDULABLE)) != 0
+        stages.append((unsched, jnp.int64(1) << BIT_NODE_UNSCHEDULABLE))
 
     # policy label-presence predicates evaluate at the ordering slot of the
     # name they were registered under (the host's _predicate_key_order slots
@@ -574,30 +590,44 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         for i, slot in enumerate(ps.label_rows):
             label_at.setdefault(slot, []).append(i)
 
-    def sa_fail():
-        # ServiceAffinity predicate (predicates.py check_service_affinity):
-        # the candidate node must match (a) the labels the pod pins via its
-        # own nodeSelector and (b), for the remaining policy labels, the
-        # values on the locked first-service-pod's node — when a lock exists
-        # and the locked node carries the label
-        f = st.saa_sig[x.group_id]
-        lock = carry.sa_lock[f]
-        own_ok = st.sa_self_ok[x.sa_self_id]            # [N]
-        unres = st.sa_unres[x.sa_self_id]               # [La]
-        li = jnp.maximum(lock, 0)
-        locked_vals = st.sa_val[:, li]                  # [La]
-        pin = unres & (locked_vals > 0)                 # label pinned by lock
-        match = st.sa_val == locked_vals[:, None]       # [La, N]
-        lock_ok = jnp.all(~pin[:, None] | match, axis=0)
-        ok = own_ok & (lock_ok | (lock < 0))
+    if ps is not None and ps.sa_slots:
+        # ServiceAffinity predicates (predicates.py check_service_affinity),
+        # shared prelude: the candidate node must match (a) the labels the
+        # pod pins via its own nodeSelector and (b), for the remaining
+        # entry labels, the values on the locked first-service-pod's node —
+        # when a lock exists and the locked node carries the label. The
+        # lock (a node index) is entry-independent (same first matching
+        # pod); only the label segments differ per entry.
+        _sa_f = st.saa_sig[x.group_id]
+        _sa_lock = carry.sa_lock[_sa_f]
+        _sa_li = jnp.maximum(_sa_lock, 0)
+        _sa_pin = st.sa_pin[x.sa_self_id]                    # [La]
+        _sa_unres = _sa_pin == 0
+        _sa_own_l = _sa_unres[:, None] | (st.sa_val == _sa_pin[:, None])
+        _sa_locked = st.sa_val[:, _sa_li]                    # [La]
+        _sa_pinned = _sa_unres & (_sa_locked > 0)
+        _sa_lock_l = (~_sa_pinned[:, None]
+                      | (st.sa_val == _sa_locked[:, None]))  # [La, N]
+        _sa_off = [0]
+        for seg in ps.sa_segs:
+            _sa_off.append(_sa_off[-1] + seg)
+
+    def sa_fail(e):
+        l0, l1 = _sa_off[e], _sa_off[e + 1]
+        own_ok = jnp.all(_sa_own_l[l0:l1], axis=0)
+        lock_ok = jnp.all(_sa_lock_l[l0:l1], axis=0)
+        ok = own_ok & (lock_ok | (_sa_lock < 0))
         return ~ok
 
     def emit_label(slot_name):
         for i in label_at.get(slot_name, ()):
             stages.append((~st.label_ok[i],
                            jnp.int64(1) << BIT_NODE_LABEL_PRESENCE))
-        if ps is not None and ps.sa_enabled and ps.sa_slot == slot_name:
-            stages.append((sa_fail(), jnp.int64(1) << BIT_SERVICE_AFFINITY))
+        if ps is not None:
+            for e, slot in enumerate(ps.sa_slots):
+                if slot == slot_name:
+                    stages.append((sa_fail(e),
+                                   jnp.int64(1) << BIT_SERVICE_AFFINITY))
 
     emit_label(CHECK_NODE_UNSCHEDULABLE_PRED)
 
@@ -791,26 +821,45 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         stages.append((fail_interpod, interpod_bits))
     emit_label(MATCH_INTERPOD_AFFINITY_PRED)
     # customs under non-ordering names run after the fixed ordering in the
-    # host's ALPHABETICAL name order: label rows sorting before a tail
-    # ServiceAffinity ride slot "", the SA stage follows (emit_label checks
-    # sa_slot == ""), and later-sorting label rows ride slot "post"
-    emit_label("")
-    emit_label("post")
+    # host's ALPHABETICAL name order; policyc assigns each tail custom
+    # (label-presence row or ServiceAffinity entry) its sorted position as
+    # slot "tail:<k>"
+    if ps is not None:
+        tail_ks = sorted(
+            int(s.split(":", 1)[1])
+            for s in set(ps.label_rows) | set(ps.sa_slots)
+            if s.startswith("tail:"))
+        for k in tail_ks:
+            emit_label(f"tail:{k}")
 
     fail_any = stages[0][0]
     for fail, _ in stages[1:]:
         fail_any = fail_any | fail
     feasible = ~fail_any
     reason_bits = jnp.int64(0)
+    aca_counts = None
     if ps is not None and ps.always_check_all:
         # alwaysCheckAllPredicates: every failing stage contributes its
         # reasons (podFitsOnNode keeps evaluating past the first failure).
         # Sentinel-padded nodes (sharding/what-if node-axis padding: condition
         # bit 62, never decoded) must contribute NOTHING else, or phantom
         # nodes would inflate the reason histogram.
-        for fail, bits in stages:
-            reason_bits = reason_bits | jnp.where(fail, bits, jnp.int64(0))
         is_pad = (st.cond_fail_bits & (jnp.int64(1) << 62)) != 0
+        # count mode: the host can emit one reason STRING several times per
+        # node (a duplicated stage pair, or several label predicates sharing
+        # ERR_NODE_LABEL_PRESENCE_VIOLATED) — summing decoded stage firings
+        # reproduces those multiplicities, which a bitmask OR cannot. Only
+        # the cheap [S, N] stacks materialize here; the [S, N, bits] decode
+        # is deferred to the caller's not-found cond branch (hoisting it
+        # would run it on every step, bound or not — see the histogram
+        # comment in make_step).
+        fail_stack = jnp.stack([fail & ~is_pad for fail, _ in stages])
+        bits_stack = jnp.stack([
+            jnp.broadcast_to(bits, fail.shape) for fail, bits in stages])
+        aca_counts = (fail_stack, bits_stack)
+        for fail, bits in stages:
+            eff = fail & ~is_pad
+            reason_bits = reason_bits | jnp.where(eff, bits, jnp.int64(0))
         reason_bits = jnp.where(is_pad, st.cond_fail_bits, reason_bits)
     else:
         # short-circuit reason selection: first failing stage wins (padded
@@ -972,7 +1021,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
                        0)
         score = score + ip * w_interpod
 
-    return feasible, reason_bits, score, n_feasible
+    return feasible, reason_bits, score, n_feasible, aca_counts
 
 
 def _select(feasible, score, n_feasible, rr):
@@ -997,12 +1046,25 @@ def _reason_histogram(reason_bits, num_bits: int):
     return jnp.sum(present, axis=0).astype(jnp.int32)
 
 
+def _aca_histogram(aca_counts, num_bits: int):
+    """Count-mode histogram from _evaluate's (fail_stack, bits_stack):
+    per-reason-string occurrence sums over ALL failing stages (pad-masked
+    already), reproducing the host's duplicate-string multiplicities under
+    alwaysCheckAllPredicates."""
+    fail_stack, bits_stack = aca_counts
+    bit_ids = jnp.arange(num_bits, dtype=jnp.int64)
+    decoded = ((bits_stack[..., None] >> bit_ids) & 1) != 0   # [S, N, B]
+    return jnp.sum(fail_stack[..., None] & decoded,
+                   axis=(0, 1)).astype(jnp.int32)
+
+
 def make_step(config: EngineConfig):
     """The exact sequential scan step: (carry, PodX) -> (carry', (choice, counts))."""
 
     def step(state: tuple, x: PodX):
         carry, st = state
-        feasible, reason_bits, score, n_feasible = _evaluate(config, carry, st, x)
+        feasible, reason_bits, score, n_feasible, aca_counts = _evaluate(
+            config, carry, st, x)
         choice, found = _select(feasible, score, n_feasible, carry.rr)
         rr_next = carry.rr + jnp.where(n_feasible > 1, 1, 0)
 
@@ -1051,10 +1113,16 @@ def make_step(config: EngineConfig):
             used_vols=used_vols, sa_lock=sa_lock,
             rr=rr_next)
 
+        # the histogram lambdas must stay INSIDE the cond branch: hoisting
+        # them out captures the decode as a cond operand and XLA then
+        # computes the [N x bits] (or [S x N x bits]) sum every step, bound
+        # or not (measured ~25% on the 20k x 2000 CPU scan)
         counts = jax.lax.cond(
             found,
             lambda: jnp.zeros(config.num_reason_bits, dtype=jnp.int32),
-            lambda: _reason_histogram(reason_bits, config.num_reason_bits))
+            (lambda: _aca_histogram(aca_counts, config.num_reason_bits))
+            if aca_counts is not None else
+            (lambda: _reason_histogram(reason_bits, config.num_reason_bits)))
         # advanced: selectHost consumed the rr counter for this pod — lets the
         # preemption hybrid (jaxe/preempt.py) resume rr mid-batch on re-dispatch
         return (new_carry, st), (choice, counts, n_feasible > 1)
@@ -1157,7 +1225,7 @@ def make_wavefront_step(config: EngineConfig):
         carry, st = state
         xs, valid = wave  # PodX with leading K axis, valid[K] (padding mask)
 
-        feasible, reason_bits, score, n_feasible = jax.vmap(
+        feasible, reason_bits, score, n_feasible, aca_counts = jax.vmap(
             lambda x: _evaluate(config, carry, st, x))(xs)
 
         # rr bookkeeping: pod k sees rr advanced by every prior in-wave pod
@@ -1222,10 +1290,16 @@ def make_wavefront_step(config: EngineConfig):
             used_vols=used_vols, sa_lock=sa_lock,
             rr=carry.rr + jnp.sum(advances))
 
+        # wavefront computes histograms for the whole wave regardless (the
+        # jnp.where evaluates both sides), matching the pre-existing cost
+        hist = (jax.vmap(
+            lambda a: _aca_histogram(a, config.num_reason_bits))(aca_counts)
+            if aca_counts is not None else jax.vmap(
+            lambda b: _reason_histogram(b, config.num_reason_bits))(reason_bits))
         counts = jnp.where(
             (founds | ~valid)[:, None],
             jnp.zeros((1, config.num_reason_bits), dtype=jnp.int32),
-            jax.vmap(lambda b: _reason_histogram(b, config.num_reason_bits))(reason_bits))
+            hist)
         choices = jnp.where(valid, choices, -1)  # _select already yields -1 on not-found
         return (new_carry, st), (choices, counts, advances > 0)
 

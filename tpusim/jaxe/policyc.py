@@ -12,19 +12,25 @@ but instead of assembling host predicate/priority closures it produces:
 
 Host-bound policy features have no device encoding and fall back to the
 reference engine (the same containment as volume workloads): extenders (HTTP
-round-trips mid-filter), multiple ServiceAffinity predicates in one policy
-(the device carries one first-pod lock per first-service signature), and the
-few alwaysCheckAllPredicates shapes where the host can emit one reason
-string twice per node (the device histogram is bit-per-string). Everything
-else in the 1.10 registry compiles: ImageLocality and the NoExecute taint
-variant ride static signature tables; Service(Anti)Affinity compile because
-services are static during a run (the first-matching-SERVICE selector
-interns at group-compile time) and the ServiceAffinity first matching POD is
-a static property of snapshot+feed order (service_affinity_columns — a
-seeded pod is a static lock, a fed pod locks the carry when it binds); and
-alwaysCheckAllPredicates otherwise runs on device (reason bits OR over all
-failing stages). Unknown names raise the host registry's KeyError
-byte-for-byte."""
+round-trips mid-filter) and the 1.0 tail-slot alias PodFitsPorts.
+Everything else in the 1.10 registry compiles — including MULTIPLE
+ServiceAffinity predicates in one policy: each entry evaluates its own label
+segment (PolicySpec.sa_segs over the concatenated sa_val rows) as a separate
+stage at its own ordering/tail slot against the shared first-matching-pod
+lock (the lock is a node index identifying the same first pod for every
+entry). ImageLocality and the
+NoExecute taint variant ride static signature tables; Service(Anti)Affinity
+compile because services are static during a run (the first-matching-SERVICE
+selector interns at group-compile time) and the ServiceAffinity first
+matching POD is a static property of snapshot+feed order
+(service_affinity_columns — a seeded pod is a static lock, a fed pod locks
+the carry when it binds); and alwaysCheckAllPredicates runs on device in
+count mode — the histogram sums per-string occurrences over ALL failing
+stages, so shapes where the host emits one reason string several times per
+node (GeneralPredicates plus an individually-named part, both taint
+predicates, CheckNodeUnschedulable beside the mandatory condition check,
+several label-presence predicates) reproduce the host's multiplicities
+exactly. Unknown names raise the host registry's KeyError byte-for-byte."""
 
 from __future__ import annotations
 
@@ -110,8 +116,9 @@ class CompiledPolicy:
     # ServiceAntiAffinity entries: (node label, weight), parallel to
     # spec.saa_weights
     saa_entries: List[Tuple[str, int]] = field(default_factory=list)
-    # ServiceAffinity predicate: the policy's affinity label list
-    sa_labels: tuple = ()
+    # ServiceAffinity predicates: one label tuple per entry, in the entry
+    # order of PolicySpec.sa_slots / sa_segs
+    sa_entries: tuple = ()
     # host-bound features forcing the reference fallback (empty = compilable)
     unsupported: List[str] = field(default_factory=list)
 
@@ -130,9 +137,8 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
     # the {register_...} set comprehension in providers.create_from_config) —
     # so duplicates resolve last-wins here too.
     label_rows: List[Tuple[str, list]] = []
-    sa_enabled = False
-    sa_slot = ""
-    sa_labels: tuple = ()
+    sa_entries: List[tuple] = []
+    sa_slots: List[str] = []
     if policy.predicates is None:
         pred_keys = None
     else:
@@ -188,34 +194,32 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                     tail_entries.append((name, entry[1]))
             else:
                 unsupported.append(entry[1])
-        if len(sa_found) > 1:
-            unsupported.append(
-                "multiple ServiceAffinity predicates (the device carries one "
-                "first-pod lock per first-service signature)")
-            sa_found = []
-        sa_name = None
-        if sa_found:
-            sa_name, sa_labels = sa_found[0]
-            sa_slot = sa_name if sa_name in preds.PREDICATES_ORDERING else ""
-            sa_enabled = True
         for name in preds.PREDICATES_ORDERING:
             if name in slotted:
                 label_rows.append((name, slotted[name]))
-        if tail_entries:
-            # the host runs tail customs in ALPHABETICAL name order
-            # (generic_scheduler.py _predicate_key_order); label-vs-label
-            # order is invisible (one shared reason string), but a tail
-            # ServiceAffinity splits them into before/after rows
-            tail_entries.sort(key=lambda pair: pair[0])
-            if sa_enabled and sa_slot == "" and sa_name is not None:
-                pre = [e for n, e in tail_entries if n < sa_name]
-                post = [e for n, e in tail_entries if n > sa_name]
-                if pre:
-                    label_rows.append(("", pre))
-                if post:
-                    label_rows.append(("post", post))
+        # ServiceAffinity entries under a PREDICATES_ORDERING name evaluate
+        # at that slot; every other custom (label-presence row or SA entry)
+        # runs after the fixed ordering in the host's ALPHABETICAL name
+        # order — each gets its sorted position as slot "tail:<k>". One ROW
+        # PER LABEL PREDICATE (not folded): with alwaysCheckAllPredicates
+        # each failing predicate contributes its own occurrence of the
+        # shared reason string, and the kernel's count-mode histogram sums
+        # per-stage firings — folding would collapse them to one.
+        sa_found.sort(key=lambda pair: pair[0])
+        for name, labels in sa_found:
+            if name in preds.PREDICATES_ORDERING:
+                sa_entries.append(tuple(labels))
+                sa_slots.append(name)
+        tail_customs = sorted(
+            [(n, "label", e) for n, e in tail_entries]
+            + [(n, "sa", tuple(labels)) for n, labels in sa_found
+               if n not in preds.PREDICATES_ORDERING])
+        for k, (_n, kind, payload) in enumerate(tail_customs):
+            if kind == "label":
+                label_rows.append((f"tail:{k}", [payload]))
             else:
-                label_rows.append(("", [e for _, e in tail_entries]))
+                sa_entries.append(payload)
+                sa_slots.append(f"tail:{k}")
 
     weights = dict(_DEFAULT_WEIGHTS)
     label_prios: List[Tuple[str, bool, int]] = []
@@ -261,41 +265,20 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                 unsupported.append(entry[1])
             # "equal": constant shift; no effect on selection or ties
 
+    # alwaysCheckAllPredicates shapes where one node emits the same reason
+    # string more than once (duplicated stage pairs, several label
+    # predicates) compile natively: the kernel switches its histogram to
+    # count mode — per-string occurrence sums over all failing stages —
+    # instead of the bit-per-string OR (VERDICT r3 item 8)
     aca = bool(policy.always_check_all_predicates)
-    if aca:
-        # the device reason histogram counts each reason STRING at most once
-        # per node; with always-check-all the host can emit the same string
-        # twice for one node in exactly these shapes — fall back there
-        n_label_entries = sum(len(entries) for _, entries in label_rows)
-        if n_label_entries > 1:
-            unsupported.append("alwaysCheckAllPredicates with multiple "
-                               "label-presence predicates (duplicate reason "
-                               "strings per node)")
-        if pred_keys:
-            parts = {preds.HOSTNAME_PRED, preds.POD_FITS_HOST_PORTS_PRED,
-                     preds.MATCH_NODE_SELECTOR_PRED,
-                     preds.POD_FITS_RESOURCES_PRED}
-            if preds.GENERAL_PRED in pred_keys and pred_keys & parts:
-                unsupported.append(
-                    "alwaysCheckAllPredicates with GeneralPredicates plus an "
-                    "individually-named part (duplicate reason strings)")
-            if preds.CHECK_NODE_UNSCHEDULABLE_PRED in pred_keys:
-                unsupported.append(
-                    "alwaysCheckAllPredicates with CheckNodeUnschedulable "
-                    "(duplicates the mandatory condition check's reason)")
-            if {preds.POD_TOLERATES_NODE_TAINTS_PRED,
-                    preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED} \
-                    <= pred_keys:
-                unsupported.append(
-                    "alwaysCheckAllPredicates with both taint predicates "
-                    "(duplicate reason strings per node)")
     spec = PolicySpec(
         pred_keys=frozenset(pred_keys) if pred_keys is not None else None,
         label_rows=tuple(slot for slot, _ in label_rows),
         has_label_prio=bool(label_prios),
         w_image=image_weight,
         saa_weights=tuple(w for _, w in saa_entries),
-        sa_enabled=sa_enabled, sa_slot=sa_slot,
+        sa_enabled=bool(sa_entries), sa_slots=tuple(sa_slots),
+        sa_segs=tuple(len(e) for e in sa_entries),
         always_check_all=aca,
         **weights)
     hard = (policy.hard_pod_affinity_symmetric_weight
@@ -308,7 +291,7 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
     return CompiledPolicy(spec=spec, hard_weight=hard,
                           label_rows=label_rows,
                           label_prios=label_prios, saa_entries=saa_entries,
-                          sa_labels=sa_labels,
+                          sa_entries=tuple(sa_entries),
                           unsupported=unsupported)
 
 
@@ -372,9 +355,12 @@ def _nodes_by_index(nodes, node_index: Dict[str, int]) -> list:
     return by_idx
 
 
-def _label_value_row(by_idx: list, label: str):
+def _label_value_row(by_idx: list, label: str, extra_values=()):
     """Intern one node label's values into an int32 row (0 = absent);
-    returns (row[N], number of distinct values + 1)."""
+    returns (row[N], number of distinct values + 1, value->id map).
+    extra_values are interned too (after the node values) so callers can
+    express pod-side pins in the same id space — a pinned value no node
+    carries gets a fresh id that matches nothing."""
     row = np.zeros(len(by_idx), dtype=np.int32)
     values: Dict[str, int] = {}
     for i, node in enumerate(by_idx):
@@ -386,7 +372,10 @@ def _label_value_row(by_idx: list, label: str):
             vid = len(values) + 1
             values[value] = vid
         row[i] = vid
-    return row, len(values) + 1
+    for value in extra_values:
+        if value not in values:
+            values[value] = len(values) + 1
+    return row, len(values) + 1, values
 
 
 def saa_dom_rows(cp: CompiledPolicy, nodes, node_index: Dict[str, int]):
@@ -398,7 +387,7 @@ def saa_dom_rows(cp: CompiledPolicy, nodes, node_index: Dict[str, int]):
     dom = np.zeros((e_count, len(by_idx)), dtype=np.int32)
     n_doms = 1
     for e, (label, _w) in enumerate(cp.saa_entries):
-        dom[e], n_values = _label_value_row(by_idx, label)
+        dom[e], n_values, _ = _label_value_row(by_idx, label)
         n_doms = max(n_doms, n_values)
     return dom, n_doms
 
@@ -407,30 +396,44 @@ def service_affinity_columns(cp: CompiledPolicy, pods, snapshot,
                              node_index: Dict[str, int], saa_defs: list):
     """Static ServiceAffinity state (predicates.py check_service_affinity):
 
-    Returns (sa_self_id[P], sa_self_ok[Cs, N], sa_unres[Cs, La],
-    sa_val[La, N], sa_lock_init[Fd]).
+    Returns (sa_self_id[P], sa_pin[Cs, La], sa_val[La, N], sa_lock_init[Fd]).
+
+    The label axis concatenates every entry's label list in PolicySpec
+    sa_segs order (one policy may carry several ServiceAffinity predicates;
+    each evaluates its own segment as a separate stage). Pod-side pins are
+    interned into sa_val's per-label value space (0 = unpinned).
 
     The plugin pod lister is the scheduler cache (factory.go:166) — ASSIGNED
     pods, seeded in snapshot order then bound pods in bind order — so the
     first matching pod is either a seeded assigned pod (static: its node
     index locks sig f, or -2 when the node is unknowable so nothing ever
     pins) or the first matching pod to BIND, which the kernel locks into the
-    carry when that bind happens (-1 until then)."""
-    labels = list(cp.sa_labels)
+    carry when that bind happens (-1 until then). The lock — a node index —
+    is shared by every entry: it identifies the same first matching pod."""
+    labels = [label for entry in cp.sa_entries for label in entry]
     n = len(node_index)
     la = max(len(labels), 1)
     by_idx = _nodes_by_index(snapshot.nodes, node_index)
 
+    # intern pods' pinned values alongside node values, per label
+    pinned_values: List[set] = [set() for _ in labels]
+    for pod in pods:
+        selector = pod.spec.node_selector or {}
+        for li, label in enumerate(labels):
+            if label in selector:
+                pinned_values[li].add(selector[label])
     sa_val = np.zeros((la, n), dtype=np.int32)
+    value_maps: List[Dict[str, int]] = [{} for _ in range(la)]
     for li, label in enumerate(labels):
-        sa_val[li], _ = _label_value_row(by_idx, label)
+        sa_val[li], _, value_maps[li] = _label_value_row(
+            by_idx, label, extra_values=sorted(pinned_values[li]))
 
     sig_ids: Dict[tuple, int] = {}
     reps: List[tuple] = []
     sa_self_id = np.zeros(len(pods), dtype=np.int32)
     for j, pod in enumerate(pods):
         selector = pod.spec.node_selector or {}
-        pins = tuple(sorted((label, selector[label]) for label in labels
+        pins = tuple(sorted((label, selector[label]) for label in set(labels)
                             if label in selector))
         cid = sig_ids.get(pins)
         if cid is None:
@@ -440,15 +443,12 @@ def service_affinity_columns(cp: CompiledPolicy, pods, snapshot,
         sa_self_id[j] = cid
 
     cs = max(len(reps), 1)
-    sa_self_ok = np.ones((cs, n), dtype=bool)
-    sa_unres = np.zeros((cs, la), dtype=bool)
+    sa_pin = np.zeros((cs, la), dtype=np.int32)
     for c, pins in enumerate(reps):
         pinned = dict(pins)
         for li, label in enumerate(labels):
-            sa_unres[c, li] = label not in pinned
-        for i, node in enumerate(by_idx):
-            sa_self_ok[c, i] = all(node.metadata.labels.get(k) == v
-                                   for k, v in pinned.items())
+            if label in pinned:
+                sa_pin[c, li] = value_maps[li][pinned[label]]
 
     fd = max(len(saa_defs), 1)
     lock_init = np.full(fd, -1, dtype=np.int32)
@@ -466,7 +466,7 @@ def service_affinity_columns(cp: CompiledPolicy, pods, snapshot,
                 # assigned to an unknowable node: it stays service_pods[0]
                 # forever (assigned order), so nothing ever pins
                 lock_init[f] = -2
-    return sa_self_id, sa_self_ok, sa_unres, sa_val, lock_init
+    return sa_self_id, sa_pin, sa_val, lock_init
 
 
 def policy_static_rows(cp: CompiledPolicy, nodes,

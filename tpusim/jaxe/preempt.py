@@ -298,6 +298,14 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                 sl = PodX(*(a[off:off + take] for a in xs_all))
                 dispatch_start = perf_counter()
                 if batch_size > 0:
+                    # pow2 WAVE buckets bound wavefront recompiles the same
+                    # way the scan branch's row buckets do: arbitrary tail
+                    # lengths after a preemption would otherwise each trace
+                    # a fresh program (infeasible pad rows never bind or
+                    # advance rr)
+                    waves = -(-take // batch_size)
+                    bucket = _next_pow2(waves) * batch_size
+                    sl = pad_infeasible_rows(sl, bucket - take)
                     xs = PodX(*(jnp.asarray(a) for a in sl))
                     carry_out, choices, counts, advanced = schedule_wavefront(
                         config, carry, statics, xs, batch_size)

@@ -239,12 +239,12 @@ def _prepare_host_batch(scenarios, provider: str,
             from tpusim.jaxe.policyc import service_affinity_columns
 
             snapshot, pods = scenarios[batch_indices[b]]
-            (cols.sa_self_id, sa_self_ok, sa_unres, sa_val,
+            (cols.sa_self_id, sa_pin, sa_val,
              sa_lock_init) = service_affinity_columns(
                 cp, pods, snapshot, compiled.node_index,
                 compiled.groups.saa_defs)
             host_statics = host_statics._replace(
-                sa_self_ok=sa_self_ok, sa_unres=sa_unres, sa_val=sa_val)
+                sa_pin=sa_pin, sa_val=sa_val)
             host_carry = host_carry._replace(sa_lock=sa_lock_init)
         host_trees.append((host_statics, host_carry,
                            pod_columns_to_host(cols)))

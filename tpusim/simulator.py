@@ -505,10 +505,9 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
     compiled_policy = None
     if policy is not None and backend == "jax":
         # compile (and validate) the policy for the device engine; the few
-        # host-bound features (extenders, multiple ServiceAffinity entries,
-        # duplicate-reason alwaysCheckAllPredicates shapes) route to the
-        # reference orchestrator, which has the full plugin registry and the
-        # in-process extender seam
+        # host-bound features (extenders, the PodFitsPorts tail-slot alias)
+        # route to the reference orchestrator, which has the full plugin
+        # registry and the in-process extender seam
         import logging
 
         from tpusim.jaxe.policyc import compile_policy

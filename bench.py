@@ -562,15 +562,37 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
     log(f"  hybrid end-to-end: {p6} pods in {e2e:.1f}s = {rate:.0f} pods/s "
         f"({len(status.successful_pods)} scheduled, "
         f"{len(status.failed_pods)} unschedulable, {preempted} preempted)")
+
+    # the honest 10x criterion needs the reference on the FULL feed at EQUAL
+    # preemption counts (the parity subsample saturates nothing and preempts
+    # 0 times, overstating the reference's rate); affordable on CPU shapes,
+    # env-gated for the larger TPU shapes
+    ref_full_limit = int(os.environ.get("TPUSIM_BENCH_PREEMPT_FULL_REF_MAX",
+                                        8_000))
+    vs_baseline = round(rate * ref_elapsed / sub, 2) if sub else 0
+    ref_note = ""
+    if p6 <= ref_full_limit:
+        t0 = time.perf_counter()
+        ref_full = run_simulation([p.copy() for p in pods], snapshot,
+                                  backend="reference",
+                                  enable_pod_priority=True)
+        ref_full_elapsed = max(time.perf_counter() - t0, 1e-9)
+        ref_rate = p6 / ref_full_elapsed
+        log(f"  reference full feed: {p6} pods in {ref_full_elapsed:.1f}s "
+            f"= {ref_rate:.0f} pods/s "
+            f"({len(ref_full.preempted_pods)} preempted)")
+        vs_baseline = round(rate / ref_rate, 2)
+        ref_note = (f", ref_full={ref_rate:.0f}pods/s"
+                    f"/{len(ref_full.preempted_pods)}preempted")
     return {
         "metric": f"scheduled pods/sec (config 6: {p6 // 1000}k "
                   f"priority-banded pods, {n6} nodes, preemption hybrid, "
                   f"platform={platform}, preempted={preempted}"
                   + (f", parity_mismatches={mismatches}"
-                     if mismatches is not None else "") + ")",
+                     if mismatches is not None else "") + ref_note + ")",
         "value": round(rate, 1),
         "unit": "pods/s",
-        "vs_baseline": round(rate * ref_elapsed / sub, 2) if sub else 0,
+        "vs_baseline": vs_baseline,
     }
 
 

@@ -87,17 +87,18 @@ class Resource:
 # priorities._resource_allocation_map, clones its copy).
 _REQ_MEMO: Optional[dict] = None
 _NZ_MEMO: Optional[dict] = None
+_PORTS_MEMO: Optional[dict] = None
 
 
 @contextmanager
 def request_memo():
-    global _REQ_MEMO, _NZ_MEMO
-    prev = (_REQ_MEMO, _NZ_MEMO)
-    _REQ_MEMO, _NZ_MEMO = {}, {}
+    global _REQ_MEMO, _NZ_MEMO, _PORTS_MEMO
+    prev = (_REQ_MEMO, _NZ_MEMO, _PORTS_MEMO)
+    _REQ_MEMO, _NZ_MEMO, _PORTS_MEMO = {}, {}, {}
     try:
         yield
     finally:
-        _REQ_MEMO, _NZ_MEMO = prev
+        _REQ_MEMO, _NZ_MEMO, _PORTS_MEMO = prev
 
 
 def get_resource_request(pod: Pod) -> Resource:
@@ -173,9 +174,16 @@ def is_pod_best_effort(pod: Pod) -> bool:
 def get_container_ports(pod: Pod) -> list:
     """Reference: util/utils.go GetContainerPorts — every containerPort entry of
     the pod's (non-init) containers."""
+    memo = _PORTS_MEMO
+    if memo is not None:
+        hit = memo.get(id(pod))
+        if hit is not None:
+            return hit[1]
     ports = []
     for c in pod.spec.containers:
         ports.extend(c.ports)
+    if memo is not None:
+        memo[id(pod)] = (pod, ports)
     return ports
 
 

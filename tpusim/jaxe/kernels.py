@@ -857,10 +857,8 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         bits_stack = jnp.stack([
             jnp.broadcast_to(bits, fail.shape) for fail, bits in stages])
         aca_counts = (fail_stack, bits_stack)
-        for fail, bits in stages:
-            eff = fail & ~is_pad
-            reason_bits = reason_bits | jnp.where(eff, bits, jnp.int64(0))
-        reason_bits = jnp.where(is_pad, st.cond_fail_bits, reason_bits)
+        # reason_bits stays zero in count mode: both consumers (the scan
+        # step's cond and the wavefront hist) read aca_counts instead
     else:
         # short-circuit reason selection: first failing stage wins (padded
         # nodes fail at the cond stage, whose sentinel bit is never decoded)
@@ -1140,6 +1138,7 @@ def _schedule_scan_impl(config: EngineConfig, carry: Carry, statics: Statics,
 
 # Exact sequential mode: scan the fused step over the pod axis.
 schedule_scan = partial(jax.jit, static_argnames=("config",))(_schedule_scan_impl)
+
 
 # Chunked-driver variant: the carry buffers are donated, so a host loop
 # feeding pod chunks (carry, ch = scan(carry, chunk)) updates the [N]-sized

@@ -142,3 +142,35 @@ def test_jax_preemption_empty_feed():
     snap = ClusterSnapshot(nodes=[make_node("n1", milli_cpu=1000)], pods=[])
     status = assert_preempt_parity([], snap)
     assert status.stop_reason
+
+
+def test_jax_preemption_wavefront_chunk_invariant(monkeypatch):
+    """Wavefront mode (batch_size > 0) under the chunked dispatch loop:
+    chunk boundaries are wave-aligned and the carry flows across chunks, so
+    ANY chunk sizing must produce the outcome of a single full dispatch
+    (including the pow2 wave-bucket padding after preemptions)."""
+    import numpy as np
+
+    rng = np.random.RandomState(11)
+    nodes = [make_node(f"n{i}", milli_cpu=2000, memory=16 * 1024**3)
+             for i in range(12)]
+    placed = [prio_pod(f"placed-{i}", i % 3, milli_cpu=700,
+                       node_name=f"n{i % 12}") for i in range(18)]
+    pods = [prio_pod(f"new-{i}", int(rng.randint(0, 10)),
+                     milli_cpu=int(rng.choice([400, 900, 1600])))
+            for i in range(40)]
+    snap = ClusterSnapshot(nodes=nodes, pods=placed)
+
+    def run(chunk0, chunk_max):
+        monkeypatch.setenv("TPUSIM_PREEMPT_CHUNK0", str(chunk0))
+        monkeypatch.setenv("TPUSIM_PREEMPT_CHUNK_MAX", str(chunk_max))
+        return run_simulation(list(pods), snap, backend="jax",
+                              enable_pod_priority=True, batch_size=4)
+
+    small = run(8, 16)
+    single = run(1 << 20, 1 << 20)
+    assert status_sig(small) == status_sig(single)
+    assert sorted(p.name for p in small.preempted_pods) == \
+        sorted(p.name for p in single.preempted_pods)
+    # the workload must actually exercise the preemption arm
+    assert small.preempted_pods

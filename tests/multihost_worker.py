@@ -25,25 +25,66 @@ def main() -> int:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+    from tpusim.api.snapshot import (
+        ClusterSnapshot,
+        make_node,
+        make_pod,
+        make_pod_volume,
+    )
+    from tpusim.api.types import Service
     from tpusim.jaxe.whatif import run_what_if, run_what_if_multihost
 
     import numpy as np
 
     def scenario(seed: int):
+        """Group-BOUND scenario: services + spreading, inter-pod
+        (anti)affinity, host ports, and volumes, so the cross-process
+        collectives cover the presence scatters and topo-domain reductions
+        too (not just the per-node aggregate columns)."""
         rng = np.random.RandomState(seed)
         nodes = [make_node(f"s{seed}-n{i}",
                            milli_cpu=int(rng.choice([2000, 4000])),
                            memory=int(rng.choice([4, 8])) * 1024**3,
-                           labels={"zone": f"z{i % 3}"})
+                           labels={"zone": f"z{i % 3}",
+                                   "kubernetes.io/hostname": f"s{seed}-n{i}"})
                  for i in range(10)]
-        pods = [make_pod(f"s{seed}-p{i}",
-                         milli_cpu=int(rng.randint(100, 1500)),
-                         memory=int(rng.randint(2**20, 2**30)),
-                         node_selector=({"zone": f"z{i % 3}"}
-                                        if i % 4 == 0 else None))
-                for i in range(20)]
-        return ClusterSnapshot(nodes=nodes), pods
+        services = [Service.from_obj(
+            {"metadata": {"name": f"s{seed}-svc", "namespace": "default"},
+             "spec": {"selector": {"app": "a0"}}})]
+        placed = [make_pod(f"s{seed}-seed", milli_cpu=100,
+                           node_name=f"s{seed}-n0", phase="Running",
+                           labels={"app": "a0"})]
+        pods = []
+        for i in range(20):
+            kwargs = {"labels": {"app": f"a{i % 2}"}}
+            if i % 4 == 0:
+                kwargs["node_selector"] = {"zone": f"z{i % 3}"}
+            if i % 5 == 1:
+                kwargs["affinity"] = {"podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {"matchLabels": {"app": "a0"}},
+                        "topologyKey": "zone"}]}}
+            elif i % 5 == 3:
+                kwargs["affinity"] = {"podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {"matchLabels": {"app": f"a{i % 2}"}},
+                        "topologyKey": "kubernetes.io/hostname"}]}}
+            if i % 6 == 2:
+                # i // 6 varies (i = 2, 8, 14 -> pd0, pd1, pd2): distinct
+                # disks stay schedulable, so the volume path is exercised
+                # beyond a single NoDiskConflict collision
+                kwargs["volumes"] = [make_pod_volume(
+                    "d",
+                    source={"gcePersistentDisk": {"pdName": f"pd{i // 6}"}})]
+            pods.append(make_pod(f"s{seed}-p{i}",
+                                 milli_cpu=int(rng.randint(100, 1500)),
+                                 memory=int(rng.randint(2**20, 2**30)),
+                                 **kwargs))
+        from test_jax_groups import port_pod
+        pods.append(port_pod(f"s{seed}-pp0", 9090))
+        pods.append(port_pod(f"s{seed}-pp1", 9090))
+        return ClusterSnapshot(nodes=nodes, pods=placed,
+                               services=services), pods
 
     # 3 scenarios over 2 snap shards: exercises the replica padding too
     scenarios = [scenario(s) for s in (1, 2, 3)]

@@ -7,6 +7,22 @@ test_jax_policy.py / test_jax_preempt.py."""
 
 import random
 
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _bounded_compile_state():
+    """Extended campaigns (TPUSIM_FUZZ_SEEDS=100+) compile hundreds of
+    distinct programs per axis; letting them accumulate across axes in one
+    process eventually segfaults XLA:CPU's native compiler (observed at
+    ~200+ cached executables). Clearing jax's compilation caches between
+    axes bounds the in-process state — each axis then behaves like its own
+    fresh process, which runs clean at 100 seeds."""
+    import jax
+
+    jax.clear_caches()
+    yield
+
 from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
 from tpusim.api.types import ContainerImage, Service
 from tpusim.engine.policy import (

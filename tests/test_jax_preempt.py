@@ -164,7 +164,9 @@ def test_jax_preemption_wavefront_chunk_invariant(monkeypatch):
     def run(chunk0, chunk_max):
         monkeypatch.setenv("TPUSIM_PREEMPT_CHUNK0", str(chunk0))
         monkeypatch.setenv("TPUSIM_PREEMPT_CHUNK_MAX", str(chunk_max))
-        return run_simulation(list(pods), snap, backend="jax",
+        # fresh copies per run: the orchestrator seams mutate fed pods in
+        # place (conditions, nominated node names)
+        return run_simulation([p.copy() for p in pods], snap, backend="jax",
                               enable_pod_priority=True, batch_size=4)
 
     small = run(8, 16)

@@ -132,3 +132,39 @@ def test_cli_event_log_invalid(tmp_path, capsys):
                "--event-log", str(bad)])
     assert rc == 2
     assert "invalid event log" in capsys.readouterr().err
+
+
+def test_event_replay_feeds_preemption_hybrid():
+    """The incremental columns built from a watch-event replay are reused by
+    the preemption hybrid (run_simulation passes the IncrementalCluster into
+    run_with_preemption), and the run matches both the reference on the
+    equivalent snapshot and a fresh-snapshot hybrid run."""
+    base, events, equivalent = make_events_and_equivalent()
+
+    def prio(pod, value):
+        pod.spec.priority = value
+        return pod
+
+    # saturate the surviving capacity with low-prio placed pods via events,
+    # then feed high-prio pods that must preempt
+    extra_placed = [prio(make_pod(f"low-{i}",
+                                  milli_cpu=(6000 if i == 0 else 3000),
+                                  node_name=("n2" if i == 0 else "n3"),
+                                  phase="Running"), 0)
+                    for i in range(2)]
+    events = events + [(ADDED, p) for p in extra_placed]
+    equivalent.pods = equivalent.pods + extra_placed
+    pods = [prio(make_pod(f"hi-{i}", milli_cpu=2500), 100) for i in range(2)]
+
+    replayed = run_simulation([p.copy() for p in pods], base, backend="jax",
+                              events=events, enable_pod_priority=True)
+    ref = run_simulation([p.copy() for p in pods], equivalent,
+                         backend="reference", enable_pod_priority=True)
+    fresh = run_simulation([p.copy() for p in pods], equivalent,
+                           backend="jax", enable_pod_priority=True)
+    assert placements_sig(replayed) == placements_sig(ref) \
+        == placements_sig(fresh)
+    assert sorted(p.name for p in replayed.preempted_pods) \
+        == sorted(p.name for p in ref.preempted_pods)
+    # the saturation must actually force evictions
+    assert replayed.preempted_pods

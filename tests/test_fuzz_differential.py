@@ -17,10 +17,19 @@ def _bounded_compile_state():
     process eventually segfaults XLA:CPU's native compiler (observed at
     ~200+ cached executables). Clearing jax's compilation caches between
     axes bounds the in-process state — each axis then behaves like its own
-    fresh process, which runs clean at 100 seeds."""
-    import jax
+    fresh process, which runs clean at 100 seeds. Default quick runs keep
+    their warm caches (the clear would force later test modules to
+    recompile shared engine programs for no safety benefit)."""
+    import os
 
-    jax.clear_caches()
+    try:
+        extended = int(os.environ.get("TPUSIM_FUZZ_SEEDS", "0")) > 25
+    except ValueError:
+        extended = False
+    if extended:
+        import jax
+
+        jax.clear_caches()
     yield
 
 from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod

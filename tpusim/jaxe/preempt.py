@@ -297,23 +297,18 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                 off = pos - base
                 sl = PodX(*(a[off:off + take] for a in xs_all))
                 dispatch_start = perf_counter()
+                # pow2 buckets (whole waves in wavefront mode) bound XLA
+                # recompiles to O(log chunk_max): arbitrary tail lengths
+                # after a preemption would otherwise each trace a fresh
+                # program (infeasible pad rows never bind or advance rr)
+                bucket = (_next_pow2(-(-take // batch_size)) * batch_size
+                          if batch_size > 0 else _next_pow2(take))
+                sl = pad_infeasible_rows(sl, bucket - take)
+                xs = PodX(*(jnp.asarray(a) for a in sl))
                 if batch_size > 0:
-                    # pow2 WAVE buckets bound wavefront recompiles the same
-                    # way the scan branch's row buckets do: arbitrary tail
-                    # lengths after a preemption would otherwise each trace
-                    # a fresh program (infeasible pad rows never bind or
-                    # advance rr)
-                    waves = -(-take // batch_size)
-                    bucket = _next_pow2(waves) * batch_size
-                    sl = pad_infeasible_rows(sl, bucket - take)
-                    xs = PodX(*(jnp.asarray(a) for a in sl))
                     carry_out, choices, counts, advanced = schedule_wavefront(
                         config, carry, statics, xs, batch_size)
                 else:
-                    # pow2 buckets bound XLA recompiles to O(log chunk_max)
-                    bucket = _next_pow2(take)
-                    sl = pad_infeasible_rows(sl, bucket - take)
-                    xs = PodX(*(jnp.asarray(a) for a in sl))
                     carry_out, choices, counts, advanced = schedule_scan(
                         config, carry, statics, xs)
                 choices = np.asarray(choices)[:take]

@@ -881,6 +881,31 @@ def run_watchdogged(cmd, stall_timeout: float, total_timeout: float,
     return [], error or "child produced no JSON line"
 
 
+def plan_attempts(probed, ladder: bool, phases: bool, retries: int):
+    """(attempts, auto_ladder) for the watchdogged child runs.
+
+    probed None (wedged tunnel) or "cpu" -> one CPU attempt. A healthy
+    accelerator gets `retries` default-backend attempts plus a CPU fallback,
+    and — unless the caller already asked for --ladder/--phases or set
+    TPUSIM_BENCH_TPU_AUTOLADDER=0 — promotes the default invocation to the
+    ladder HEADLINE configs (VERDICT r3 item 1): the driver-verified
+    artifact then measures the north-star shapes (config 3: 100k x 5k;
+    4: 1M x 10k; 5: what-if) instead of the small default. Only the
+    "default" attempts run the promoted ladder; the CPU fallback keeps the
+    plain default workload. Pure: the caller owns the
+    TPUSIM_BENCH_LADDER_CONFIGS default + validation."""
+    if probed is None or probed == "cpu":
+        # no accelerator (or its plugin failed init cleanly): no point in
+        # default-backend attempts
+        return [("cpu", 1)], False
+    attempts = ([("default", a) for a in range(1, retries + 1)]
+                + [("cpu", 1)])
+    auto_ladder = (not ladder and not phases
+                   and os.environ.get("TPUSIM_BENCH_TPU_AUTOLADDER", "1")
+                   != "0")
+    return attempts, auto_ladder
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         run_child(sys.argv[2] if len(sys.argv) > 2 else "default",
@@ -905,7 +930,6 @@ def main() -> None:
     retries = int(os.environ.get("TPUSIM_BENCH_RETRIES", 2))
 
     errors: list[str] = []
-    auto_ladder = False
     log(f"pre-flight probe (timeout {probe_timeout:.0f}s)...")
     t0 = time.monotonic()
     probed = preflight_probe(probe_timeout)
@@ -914,28 +938,14 @@ def main() -> None:
                       f"complete within {probe_timeout:.0f}s; CPU fallback")
         log(f"probe FAILED after {time.monotonic() - t0:.0f}s "
             "(wedged tunnel / hung backend init); skipping straight to CPU")
-        attempts = [("cpu", 1)]
     else:
         log(f"probe OK: platform={probed} ({time.monotonic() - t0:.0f}s)")
-        if probed == "cpu":
-            # the default backend already resolves to CPU (no accelerator or
-            # its plugin failed init cleanly) — no point in default attempts
-            attempts = [("cpu", 1)]
-        else:
-            attempts = ([("default", a) for a in range(1, retries + 1)]
-                        + [("cpu", 1)])
-            if not ladder and not phases and os.environ.get(
-                    "TPUSIM_BENCH_TPU_AUTOLADDER", "1") != "0":
-                # a healthy accelerator promotes the default invocation to
-                # the ladder HEADLINE configs (VERDICT r3 item 1): the
-                # driver-verified artifact then measures the north-star
-                # shapes (config 3: 100k x 5k; 4: 1M x 10k; 5: what-if)
-                # instead of the small default. The CPU-fallback attempt
-                # keeps the plain default workload.
-                auto_ladder = True
-                os.environ.setdefault("TPUSIM_BENCH_LADDER_CONFIGS", "3,4,5")
-                log("TPU present: promoting default run to ladder configs "
-                    + os.environ["TPUSIM_BENCH_LADDER_CONFIGS"])
+    attempts, auto_ladder = plan_attempts(probed, ladder, phases, retries)
+    if auto_ladder:
+        os.environ.setdefault("TPUSIM_BENCH_LADDER_CONFIGS", "3,4,5")
+        _ladder_configs()  # validate (incl. any user override) before spawning
+        log("TPU present: promoting default run to ladder configs "
+            + os.environ["TPUSIM_BENCH_LADDER_CONFIGS"])
     for target, attempt in attempts:
         use_ladder = ladder or (auto_ladder and target == "default")
         log(f"benchmark on {target!r} (attempt {attempt}, "

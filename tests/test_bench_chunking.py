@@ -62,3 +62,41 @@ def test_backend_routes_big_batches_through_chunked_scan(workload, monkeypatch):
     chunked = JaxBackend().schedule(pods, snapshot)
     assert [p.node_name for p in chunked] == [p.node_name for p in unchunked]
     assert [p.message for p in chunked] == [p.message for p in unchunked]
+
+
+def test_plan_attempts_promotion(monkeypatch):
+    """The TPU auto-ladder promotion (VERDICT r3 item 1) has no live-TPU
+    test bed here — pin its decision table so the first healthy tunnel
+    window can't be wasted on a broken branch."""
+    import os
+
+    import bench
+
+    monkeypatch.delenv("TPUSIM_BENCH_LADDER_CONFIGS", raising=False)
+    monkeypatch.delenv("TPUSIM_BENCH_TPU_AUTOLADDER", raising=False)
+
+    # wedged tunnel / clean CPU resolve: one CPU attempt, no promotion
+    assert bench.plan_attempts(None, False, False, 2) == ([("cpu", 1)], False)
+    assert bench.plan_attempts("cpu", False, False, 2) == ([("cpu", 1)], False)
+
+    # healthy accelerator: default attempts + CPU fallback, promoted ladder
+    attempts, auto = bench.plan_attempts("tpu", False, False, 2)
+    assert attempts == [("default", 1), ("default", 2), ("cpu", 1)]
+    assert auto
+    # the promoted default (written by main next to its log line) must
+    # parse as a valid config subset
+    monkeypatch.setenv("TPUSIM_BENCH_LADDER_CONFIGS", "3,4,5")
+    assert bench._ladder_configs() == {3, 4, 5}
+
+    # explicit --ladder/--phases: no promotion (caller controls the configs)
+    assert bench.plan_attempts("tpu", True, False, 1)[1] is False
+    assert bench.plan_attempts("tpu", False, True, 1)[1] is False
+
+    # kill switch
+    monkeypatch.setenv("TPUSIM_BENCH_TPU_AUTOLADDER", "0")
+    attempts, auto = bench.plan_attempts("tpu", False, False, 1)
+    assert attempts == [("default", 1), ("cpu", 1)] and auto is False
+
+    # a user override of the configs passes validation too
+    monkeypatch.setenv("TPUSIM_BENCH_LADDER_CONFIGS", "3,6")
+    assert bench._ladder_configs() == {3, 6}

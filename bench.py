@@ -881,6 +881,11 @@ def run_watchdogged(cmd, stall_timeout: float, total_timeout: float,
     return [], error or "child produced no JSON line"
 
 
+# the ladder subset a healthy accelerator promotes the default run to
+# (VERDICT r3 item 1: the north-star shapes)
+AUTOLADDER_DEFAULT_CONFIGS = "3,4,5"
+
+
 def plan_attempts(probed, ladder: bool, phases: bool, retries: int):
     """(attempts, auto_ladder) for the watchdogged child runs.
 
@@ -892,8 +897,10 @@ def plan_attempts(probed, ladder: bool, phases: bool, retries: int):
     artifact then measures the north-star shapes (config 3: 100k x 5k;
     4: 1M x 10k; 5: what-if) instead of the small default. Only the
     "default" attempts run the promoted ladder; the CPU fallback keeps the
-    plain default workload. Pure: the caller owns the
-    TPUSIM_BENCH_LADDER_CONFIGS default + validation."""
+    plain default workload. No env writes (only the
+    TPUSIM_BENCH_TPU_AUTOLADDER kill switch is read); the caller owns the
+    TPUSIM_BENCH_LADDER_CONFIGS default (AUTOLADDER_DEFAULT_CONFIGS) and
+    its validation."""
     if probed is None or probed == "cpu":
         # no accelerator (or its plugin failed init cleanly): no point in
         # default-backend attempts
@@ -942,7 +949,8 @@ def main() -> None:
         log(f"probe OK: platform={probed} ({time.monotonic() - t0:.0f}s)")
     attempts, auto_ladder = plan_attempts(probed, ladder, phases, retries)
     if auto_ladder:
-        os.environ.setdefault("TPUSIM_BENCH_LADDER_CONFIGS", "3,4,5")
+        os.environ.setdefault("TPUSIM_BENCH_LADDER_CONFIGS",
+                              AUTOLADDER_DEFAULT_CONFIGS)
         _ladder_configs()  # validate (incl. any user override) before spawning
         log("TPU present: promoting default run to ladder configs "
             + os.environ["TPUSIM_BENCH_LADDER_CONFIGS"])

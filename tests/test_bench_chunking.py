@@ -68,8 +68,6 @@ def test_plan_attempts_promotion(monkeypatch):
     """The TPU auto-ladder promotion (VERDICT r3 item 1) has no live-TPU
     test bed here — pin its decision table so the first healthy tunnel
     window can't be wasted on a broken branch."""
-    import os
-
     import bench
 
     monkeypatch.delenv("TPUSIM_BENCH_LADDER_CONFIGS", raising=False)
@@ -85,7 +83,8 @@ def test_plan_attempts_promotion(monkeypatch):
     assert auto
     # the promoted default (written by main next to its log line) must
     # parse as a valid config subset
-    monkeypatch.setenv("TPUSIM_BENCH_LADDER_CONFIGS", "3,4,5")
+    monkeypatch.setenv("TPUSIM_BENCH_LADDER_CONFIGS",
+                       bench.AUTOLADDER_DEFAULT_CONFIGS)
     assert bench._ladder_configs() == {3, 4, 5}
 
     # explicit --ladder/--phases: no promotion (caller controls the configs)

@@ -168,7 +168,8 @@ def test_fuzz_policy_parity():
                  "PodToleratesNodeTaints", "MatchNodeSelector",
                  "CheckNodeMemoryPressure", "CheckNodeDiskPressure",
                  "MatchInterPodAffinity", "PodFitsHostPorts", "HostName",
-                 "CheckNodeUnschedulable", "PodToleratesNodeNoExecuteTaints"]
+                 "CheckNodeUnschedulable", "PodToleratesNodeNoExecuteTaints",
+                 "PodFitsPorts"]
     prio_pool = ["LeastRequestedPriority", "MostRequestedPriority",
                  "BalancedResourceAllocation", "NodeAffinityPriority",
                  "TaintTolerationPriority", "SelectorSpreadPriority",

@@ -664,14 +664,20 @@ def test_policy_service_affinity_with_equivalence_cache():
 
 
 def test_policy_unsupported_routes_end_to_end():
-    """run_simulation's host-bound-policy reroute arm, end to end: a policy
-    naming the 1.0 PodFitsPorts alias (host-bound: it evaluates at the
-    host's custom tail slot; no HTTP involved) runs the reference
-    orchestrator under backend='jax' and matches backend='reference'."""
+    """run_simulation's host-bound-policy reroute arm, end to end: an
+    extender policy (the last host-bound feature) runs the reference
+    orchestrator under backend='jax' and matches backend='reference'.
+    A prioritize-only extender keeps the run schedulable — prioritize
+    transport errors are ignored (generic_scheduler.go:649-653) — so no
+    live extender server is needed."""
+    from tpusim.engine.policy import ExtenderConfig
+
     policy = Policy(predicates=[
-        PredicatePolicy(name="PodFitsPorts"),
         PredicatePolicy(name="PodFitsResources"),
-    ], priorities=[PriorityPolicy(name="LeastRequestedPriority", weight=1)])
+    ], priorities=[PriorityPolicy(name="LeastRequestedPriority", weight=1)],
+        extender_configs=[ExtenderConfig(url_prefix="http://no-such-host",
+                                         prioritize_verb="prioritize",
+                                         weight=2)])
     assert compile_policy(policy).unsupported
     pods = [make_pod(f"p{i}", milli_cpu=400, labels={"app": "db"})
             for i in range(5)]
@@ -687,8 +693,8 @@ def test_policy_legacy_aliases_compile_and_match():
     ServiceSpreadingPriority shares SelectorSpread's device path
     (service-derived signatures only) — naming BOTH spread priorities sums
     their weights like two host instances. The PodFitsPorts predicate alias
-    is HOST-BOUND (it evaluates at the host's custom tail slot, which the
-    device's fixed-order pipeline cannot express) and must fall back."""
+    evaluates at the host's custom tail slot — the device re-emits the
+    port-conflict stage at that tail position (PolicySpec.ports_slots)."""
     from tpusim.api.types import Service
 
     snapshot = mixed_cluster()
@@ -710,16 +716,68 @@ def test_policy_legacy_aliases_compile_and_match():
     assert cp.spec.w_spread == 5  # summed, like two host instances
     assert_policy_parity(pods, snapshot, policy)
 
-    # the 1.0 predicate alias routes host-side (documented fallback) but
-    # still schedules with identical results end to end
+    # the 1.0 predicate alias compiles: the port stage re-runs at its
+    # alphabetical tail slot, after every fixed-ordering predicate
     legacy = Policy(
         predicates=[PredicatePolicy(name="PodFitsPorts"),
                     PredicatePolicy(name="PodFitsResources"),
                     PredicatePolicy(name="MatchNodeSelector")],
         priorities=[PriorityPolicy(name="ServiceSpreadingPriority", weight=2)])
     cp = compile_policy(legacy)
-    assert any("PodFitsPorts" in u for u in cp.unsupported)
+    assert not cp.unsupported and cp.spec.ports_slots == ("tail:0",)
     assert_policy_parity(pods, snapshot, legacy)
+
+
+def test_policy_ports_alias_tail_slot_reason_ordering():
+    """The alias's OBSERVABLE difference from PodFitsHostPorts: it
+    short-circuits AFTER the fixed ordering. A node failing both resources
+    and a port conflict reports the port reason under the fixed-slot name
+    (PodFitsHostPorts runs before PodFitsResources in the ordering,
+    predicates.go:130-136) but the RESOURCE reason under the tail alias —
+    byte-matched against the reference on both shapes."""
+    from test_jax_groups import port_pod
+
+    nodes = [make_node("tiny", milli_cpu=300)]
+    # occupy the port AND most of the cpu
+    seed = port_pod("seed", 7070, milli_cpu=200, node_name="tiny",
+                    phase="Running")
+    snap = ClusterSnapshot(nodes=nodes, pods=[seed])
+    contender = port_pod("p", 7070, milli_cpu=200)
+
+    def msg_for(pred_name):
+        policy = Policy(
+            predicates=[PredicatePolicy(name=pred_name),
+                        PredicatePolicy(name="PodFitsResources")],
+            priorities=[PriorityPolicy(name="LeastRequestedPriority",
+                                       weight=1)])
+        status = assert_policy_parity([contender.copy()], snap, policy)
+        return status.failed_pods[0].status.conditions[-1].message
+
+    assert "free ports" in msg_for("PodFitsHostPorts")   # fixed slot first
+    assert "Insufficient cpu" in msg_for("PodFitsPorts")  # alias at tail
+
+
+def test_policy_ports_alias_actually_filters():
+    """Regression guard for the tail emission itself: when the port
+    conflict is the pod ONLY obstacle, the alias must still veto the
+    node - a silently-skipped tail stage would schedule the pod and be
+    invisible to the ordering test above (both backends would report the
+    earlier resource failure either way)."""
+    from test_jax_groups import port_pod
+
+    nodes = [make_node("roomy", milli_cpu=8000)]
+    seed = port_pod("seed", 7070, milli_cpu=100, node_name="roomy",
+                    phase="Running")
+    snap = ClusterSnapshot(nodes=nodes, pods=[seed])
+    policy = Policy(
+        predicates=[PredicatePolicy(name="PodFitsPorts"),
+                    PredicatePolicy(name="PodFitsResources")],
+        priorities=[PriorityPolicy(name="LeastRequestedPriority", weight=1)])
+    assert not compile_policy(policy).unsupported
+    status = assert_policy_parity([port_pod("p", 7070, milli_cpu=100)],
+                                  snap, policy)
+    [failed] = status.failed_pods
+    assert "free ports" in failed.status.conditions[-1].message
 
 
 def test_policy_custom_arg_under_alias_name_keeps_its_own_key():

@@ -36,3 +36,43 @@ def test_score_dump_at_debug(caplog):
 def test_score_dump_silent_by_default(caplog):
     _, msgs = _run(caplog, logging.INFO)
     assert not [m for m in msgs if ", Score: (" in m or m.startswith("Host ")]
+
+
+def test_cli_v5_flag_enables_dump(tmp_path):
+    """--v 5 wires the glog-style verbosity to the engine logger — the flag
+    (not a test fixture) must flip the logger's effective level, so the
+    probe is a DEBUG-level handler that only sees records once the level
+    gate opens."""
+    import logging
+
+    from tpusim.cli import main
+
+    podspec = tmp_path / "p.yaml"
+    podspec.write_text(
+        "- name: A\n  num: 1\n  pod:\n    spec:\n      containers:\n"
+        "      - resources:\n          requests:\n            cpu: 1\n")
+
+    class Probe(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.DEBUG)
+            self.messages = []
+
+        def emit(self, record):
+            self.messages.append(record.getMessage())
+
+    target = logging.getLogger("tpusim.engine.generic_scheduler")
+    args = ["--podspec", str(podspec), "--synthetic-nodes", "2",
+            "--backend", "reference", "--quiet"]
+    probe = Probe()
+    target.addHandler(probe)
+    try:
+        assert main(list(args)) == 0
+        assert not any("=> Score" in m for m in probe.messages)
+
+        assert main(args + ["--v", "5"]) == 0
+        assert any("=> Score" in m for m in probe.messages)
+        assert any(", Score: (" in m for m in probe.messages)
+    finally:
+        target.removeHandler(probe)
+        # undo the process-wide level the flag set
+        logging.getLogger("tpusim.engine").setLevel(logging.NOTSET)

@@ -20,9 +20,8 @@ TPUSIM_MAX_GROUPS, raw signatures > TPUSIM_MAX_RAW_GROUPS, matcher precompute
 merge by match profile first, so only behaviorally distinct classes count),
 unresolvable PVC references on zone-constrained clusters (the reference's
 NoVolumeZoneConflict *errors* host-side there), and the host-bound policy
-shapes listed in jaxe/policyc.py (extenders, the PodFitsPorts tail-slot
-alias). Volume workloads run natively on BOTH the fresh and incremental
-(event-log) paths.
+shapes listed in jaxe/policyc.py (extenders only). Volume workloads run
+natively on BOTH the fresh and incremental (event-log) paths.
 """
 
 from __future__ import annotations

@@ -233,6 +233,10 @@ class PolicySpec:
     sa_enabled: bool = False
     sa_slots: tuple = ()
     sa_segs: tuple = ()
+    # 1.0 PodFitsPorts alias: tail slots ("tail:<k>") where the
+    # port-conflict stage runs again (the host evaluates registry keys
+    # outside predicates.Ordering() at the alphabetical tail)
+    ports_slots: tuple = ()
     # first-failure reason selection becomes collect-all-failures
     # (generic_scheduler.go alwaysCheckAllPredicates)
     always_check_all: bool = False
@@ -628,6 +632,11 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
                 if slot == slot_name:
                     stages.append((sa_fail(e),
                                    jnp.int64(1) << BIT_SERVICE_AFFINITY))
+            if slot_name in ps.ports_slots and config.has_ports:
+                # the PodFitsPorts tail alias re-emits the port stage here
+                # (port_bad is defined by the time tail slots run; a
+                # port-free workload has nothing to re-check)
+                stages.append((port_bad, jnp.int64(1) << BIT_HOST_PORTS))
 
     emit_label(CHECK_NODE_UNSCHEDULABLE_PRED)
 
@@ -662,7 +671,9 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         host_bad = ~st.host_ok[x.host_id]
     if general_on or part_on[MATCH_NODE_SELECTOR_PRED]:
         sel_bad = ~st.selector_ok[x.sel_id]
-    if config.has_ports and (general_on or part_on[POD_FITS_HOST_PORTS_PRED]):
+    ports_alias_on = ps is not None and bool(ps.ports_slots)
+    if config.has_ports and (general_on or part_on[POD_FITS_HOST_PORTS_PRED]
+                             or ports_alias_on):
         # PodFitsHostPorts (predicates.go:1019-1039), part of GeneralPredicates:
         # a wanted port of my group conflicts with occupancy of any group
         # present; conflict is factored through interned port-set ids
@@ -828,6 +839,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         tail_ks = sorted(
             int(s.split(":", 1)[1])
             for s in set(ps.label_rows) | set(ps.sa_slots)
+            | set(ps.ports_slots)
             if s.startswith("tail:"))
         for k in tail_ks:
             emit_label(f"tail:{k}")

@@ -10,15 +10,15 @@ but instead of assembling host predicate/priority closures it produces:
     (CheckNodeLabelPresence masks, NodeLabel priority scores) that overwrite
     the trivial rows in Statics.
 
-Host-bound policy features have no device encoding and fall back to the
-reference engine (the same containment as volume workloads): extenders (HTTP
-round-trips mid-filter) and the 1.0 tail-slot alias PodFitsPorts.
-Everything else in the 1.10 registry compiles — including MULTIPLE
+The ONLY host-bound policy feature left is extenders (HTTP round-trips
+mid-filter); they fall back to the reference engine (the same containment
+as volume-binder workloads). Everything else in the 1.10 registry compiles — including MULTIPLE
 ServiceAffinity predicates in one policy: each entry evaluates its own label
 segment (PolicySpec.sa_segs over the concatenated sa_val rows) as a separate
 stage at its own ordering/tail slot against the shared first-matching-pod
 lock (the lock is a node index identifying the same first pod for every
-entry). ImageLocality and the
+entry); the 1.0 PodFitsPorts alias re-emits the port-conflict stage at
+its alphabetical tail slot (ports_slots). ImageLocality and the
 NoExecute taint variant ride static signature tables; Service(Anti)Affinity
 compile because services are static during a run (the first-matching-SERVICE
 selector interns at group-compile time) and the ServiceAffinity first
@@ -62,14 +62,16 @@ COMPILABLE_PREDS = frozenset({
     preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
 })
 
-# 1.0 backward-compat alias (defaults.go:63-65). HOST-BOUND, not aliased to
-# the hostports slot: the host engine evaluates registry keys outside
+# 1.0 backward-compat alias (defaults.go:63-65). NOT aliased to the
+# hostports slot: the host engine evaluates registry keys outside
 # predicates.Ordering() at the alphabetical TAIL slot (the documented
-# deliberate deviation in generic_scheduler.py), so "PodFitsPorts" short-
-# circuits in a different position than "PodFitsHostPorts" — first-failure
-# reason strings can differ. The device's fixed-slot pipeline cannot express
-# a standard predicate at a tail slot; policies naming the alias fall back.
-_HOST_BOUND_PRED_ALIASES = frozenset({"PodFitsPorts"})
+# deliberate deviation in generic_scheduler.py), so "PodFitsPorts"
+# short-circuits in a different position than "PodFitsHostPorts" —
+# first-failure reason strings can differ. The device expresses that via
+# the generic tail-slot mechanism ("tail:<k>", shared with label-presence
+# rows and ServiceAffinity entries): the port-conflict stage is emitted
+# again at the alias's sorted tail position (PolicySpec.ports_slots).
+_TAIL_PORTS_ALIAS = "PodFitsPorts"
 
 # priority name -> PolicySpec weight field (EqualPriority adds the same
 # constant to every node, so it cannot change the argmax or the tie set).
@@ -139,6 +141,7 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
     label_rows: List[Tuple[str, list]] = []
     sa_entries: List[tuple] = []
     sa_slots: List[str] = []
+    ports_slots: List[str] = []
     if policy.predicates is None:
         pred_keys = None
     else:
@@ -154,12 +157,8 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
                               bool(arg.labels_presence.presence)))
             elif pp.name in COMPILABLE_PREDS:
                 pred_by_name[pp.name] = ("standard",)
-            elif pp.name in _HOST_BOUND_PRED_ALIASES:
-                unsupported.append(
-                    f"predicate {pp.name} (1.0 alias; evaluates at the "
-                    "host's custom tail slot, not the device's fixed "
-                    "ordering)")
-                continue
+            elif pp.name == _TAIL_PORTS_ALIAS:
+                pred_by_name[pp.name] = ("ports",)
             else:
                 # plugins.go RegisterCustomFitPredicate's failure, byte-matched
                 raise KeyError("Invalid configuration: Predicate type not "
@@ -168,9 +167,12 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
         slotted: Dict[str, list] = {}
         tail_entries: list = []
         sa_found: List[Tuple[str, tuple]] = []
+        tail_ports: List[str] = []
         for name, entry in pred_by_name.items():
             if entry[0] == "standard":
                 pred_keys.add(name)
+            elif entry[0] == "ports":
+                tail_ports.append(name)
             elif entry[0] == "sa":
                 if name == preds.CHECK_NODE_CONDITION_PRED:
                     unsupported.append("ServiceAffinity predicate replacing "
@@ -213,10 +215,16 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
         tail_customs = sorted(
             [(n, "label", e) for n, e in tail_entries]
             + [(n, "sa", tuple(labels)) for n, labels in sa_found
-               if n not in preds.PREDICATES_ORDERING])
+               if n not in preds.PREDICATES_ORDERING]
+            + [(n, "ports", None) for n in tail_ports])
         for k, (_n, kind, payload) in enumerate(tail_customs):
             if kind == "label":
                 label_rows.append((f"tail:{k}", [payload]))
+            elif kind == "ports":
+                # the 1.0 PodFitsPorts alias: the port-conflict stage runs
+                # AGAIN at its alphabetical tail position (the host evaluates
+                # registry keys outside predicates.Ordering() there)
+                ports_slots.append(f"tail:{k}")
             else:
                 sa_entries.append(payload)
                 sa_slots.append(f"tail:{k}")
@@ -279,6 +287,7 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
         saa_weights=tuple(w for _, w in saa_entries),
         sa_enabled=bool(sa_entries), sa_slots=tuple(sa_slots),
         sa_segs=tuple(len(e) for e in sa_entries),
+        ports_slots=tuple(ports_slots),
         always_check_all=aca,
         **weights)
     hard = (policy.hard_pod_affinity_symmetric_weight

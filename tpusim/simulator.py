@@ -504,10 +504,10 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
             backend = "reference" if tiny else "jax"
     compiled_policy = None
     if policy is not None and backend == "jax":
-        # compile (and validate) the policy for the device engine; the few
-        # host-bound features (extenders, the PodFitsPorts tail-slot alias)
-        # route to the reference orchestrator, which has the full plugin
-        # registry and the in-process extender seam
+        # compile (and validate) the policy for the device engine; the one
+        # host-bound feature (extenders) routes to the reference
+        # orchestrator, which has the full plugin registry and the
+        # in-process extender seam
         import logging
 
         from tpusim.jaxe.policyc import compile_policy

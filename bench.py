@@ -229,7 +229,7 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
         from tpusim.jaxe.backend import _fast_path_enabled
         from tpusim.jaxe.fastscan import fast_scan, plan_fast
 
-        if not _fast_path_enabled():
+        if not _fast_path_enabled()[0]:
             log("  TPUSIM_FAST requested but backend is not TPU; "
                 "using the XLA scan (set TPUSIM_FAST_INTERPRET=1 to force "
                 "the interpreter for correctness checks)")
@@ -242,6 +242,7 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
                 log("  pallas fast path eligible")
 
     def one_pass(carry):
+        nonlocal fast_plan
         if fast_plan is not None:
             t_start = time.perf_counter()
 
@@ -249,8 +250,18 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
                 log(f"  fast chunk {ci}/{total}: {done}/{num_pods} pods "
                     f"({time.perf_counter() - t_start:.1f}s)")
 
-            f_choices, f_counts, _adv = fast_scan(fast_plan, progress=prog)
-            return f_choices, _checksum(f_choices), f_counts
+            try:
+                f_choices, f_counts, _adv = fast_scan(fast_plan,
+                                                      progress=prog)
+            except Exception as exc:
+                # never crash the child mid-device-context (an abrupt exit
+                # has wedged the axon tunnel before — BASELINE.md round-4
+                # postmortem); degrade to the XLA scan and relabel the run
+                log(f"  pallas fast path FAILED ({type(exc).__name__}: "
+                    f"{exc}); falling back to the XLA scan")
+                fast_plan = None
+            else:
+                return f_choices, _checksum(f_choices), f_counts
         return _run_once(config, carry, statics, xs, batch, chunk)
 
     t0 = time.perf_counter()
@@ -475,20 +486,10 @@ def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> Non
         # run_what_if compiles per invocation (the jitted program is built
         # inside), so every call pays host interning + XLA compile: the honest
         # metric is end-to-end including those costs
-        done = threading.Event()
-
-        def heartbeat():
-            while not done.wait(60.0):
-                log(f"[config 5] what-if still running "
-                    f"({time.perf_counter() - t0:.0f}s; XLA compile + "
-                    "execution give no incremental progress)")
-
-        threading.Thread(target=heartbeat, daemon=True).start()
         t0 = time.perf_counter()
-        try:
+        with stage_heartbeat("[config 5] what-if still running (XLA compile "
+                             "+ execution give no incremental progress)"):
             run_what_if(scenarios)
-        finally:
-            done.set()
         e2e = time.perf_counter() - t0
         total = n_scen * p_scen
         log(f"[config 5] {n_scen}x{p_scen // 1000}k what-if: "
@@ -504,6 +505,35 @@ def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> Non
     if 6 in wanted:
         results.append(measure_preemption(platform, baseline_pods))
         print(json.dumps(results[-1]), flush=True)
+
+
+class stage_heartbeat:
+    """Logs '<label> (Ns elapsed)' every 60s until the block exits: any
+    silent stage longer than TPUSIM_BENCH_STALL_TIMEOUT (240s) would
+    otherwise be killed by the parent's stall watchdog — the round-4 TPU
+    capture lost config 6 exactly this way (the 20k-pod hybrid run prints
+    nothing while device dispatches and host preemptions alternate)."""
+
+    def __init__(self, label: str):
+        self.label = label
+
+    def __enter__(self):
+        import threading
+
+        self._done = threading.Event()
+        self._t0 = time.perf_counter()
+
+        def beat():
+            while not self._done.wait(60.0):
+                log(f"{self.label} "
+                    f"({time.perf_counter() - self._t0:.0f}s elapsed)")
+
+        threading.Thread(target=beat, daemon=True).start()
+        return self
+
+    def __exit__(self, *exc):
+        self._done.set()
+        return False
 
 
 def measure_preemption(platform: str, baseline_pods: int) -> dict:
@@ -543,8 +573,10 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
         log(f"  reference orchestrator: {sub} pods in {ref_elapsed:.1f}s "
             f"= {sub / ref_elapsed:.1f} pods/s "
             f"({len(ref_status.preempted_pods)} preempted)")
-        jax_sub = run_simulation([p.copy() for p in pods[:sub]], snapshot,
-                                 backend="jax", enable_pod_priority=True)
+        with stage_heartbeat("[config 6] parity run still going (first "
+                             "preemption-path XLA compile)"):
+            jax_sub = run_simulation([p.copy() for p in pods[:sub]], snapshot,
+                                     backend="jax", enable_pod_priority=True)
         ref_placed, ref_failed = outcome_map(ref_status)
         jax_placed, jax_failed = outcome_map(jax_sub)
         mismatches = sum(
@@ -554,8 +586,9 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
         log(f"  parity check on first {sub} pods: {mismatches} mismatches")
 
     t0 = time.perf_counter()
-    status = run_simulation([p.copy() for p in pods], snapshot, backend="jax",
-                            enable_pod_priority=True)
+    with stage_heartbeat("[config 6] hybrid still running"):
+        status = run_simulation([p.copy() for p in pods], snapshot,
+                                backend="jax", enable_pod_priority=True)
     e2e = max(time.perf_counter() - t0, 1e-9)
     rate = p6 / e2e
     preempted = len(status.preempted_pods)
@@ -577,9 +610,11 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
             ref_full, ref_full_elapsed = ref_status, ref_elapsed
         else:
             t0 = time.perf_counter()
-            ref_full = run_simulation([p.copy() for p in pods], snapshot,
-                                      backend="reference",
-                                      enable_pod_priority=True)
+            with stage_heartbeat("[config 6] full-feed reference still "
+                                 "running"):
+                ref_full = run_simulation([p.copy() for p in pods], snapshot,
+                                          backend="reference",
+                                          enable_pod_priority=True)
             ref_full_elapsed = max(time.perf_counter() - t0, 1e-9)
         ref_rate = p6 / ref_full_elapsed
         log(f"  reference full feed: {p6} pods in {ref_full_elapsed:.1f}s "
@@ -701,7 +736,7 @@ def run_phases(platform: str, chunk: int) -> None:
         lambda xi: _evaluate(config, c, s, xi))(x))
 
     def select_stage(c, s, x):
-        feasible, _, score, n_feasible = jax.vmap(
+        feasible, _, score, n_feasible, _aca = jax.vmap(
             lambda xi: _evaluate(config, c, s, xi))(x)
         rr = jnp.arange(feasible.shape[0], dtype=jnp.int64)
         return jax.vmap(_select)(feasible, score, n_feasible, rr)

@@ -225,6 +225,87 @@ def test_scalar_reason_bits_match_reference_strings():
     assert "Insufficient example.com/widget" in msg
 
 
+def _outcomes(placements):
+    return [(p.pod.metadata.name, p.pod.spec.node_name, p.message)
+            for p in placements]
+
+
+def test_auto_mode_env_gates(monkeypatch):
+    """AUTO (env unset): default-on only on TPU, with verification requested
+    until the first self-check passes; explicit 0/1 still win."""
+    from tpusim.jaxe import backend
+
+    monkeypatch.delenv("TPUSIM_FAST", raising=False)
+    monkeypatch.delenv("TPUSIM_FAST_INTERPRET", raising=False)
+    monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
+    monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+    # this suite runs on the CPU backend: AUTO must stay off (the
+    # interpreter is not a fast path)
+    assert backend._fast_path_enabled() == (False, True)
+    monkeypatch.setenv("TPUSIM_FAST", "0")
+    assert backend._fast_path_enabled() == (False, False)
+    monkeypatch.setenv("TPUSIM_FAST", "1")
+    monkeypatch.setenv("TPUSIM_FAST_INTERPRET", "1")
+    assert backend._fast_path_enabled() == (True, False)
+    # a failed self-check pins the process off even in AUTO
+    monkeypatch.delenv("TPUSIM_FAST", raising=False)
+    monkeypatch.setitem(backend._FAST_AUTO, "disabled", True)
+    assert backend._fast_path_enabled() == (False, False)
+
+
+def _run_auto(monkeypatch, corrupt=None, boom=False):
+    """Drive JaxBackend through the AUTO fast path on CPU (interpreter) by
+    forcing the gate open with verification on; returns (baseline, auto)."""
+    from tpusim.jaxe import backend, fastscan
+
+    snapshot, pods = build(3, num_nodes=20, num_pods=60)
+    monkeypatch.delenv("TPUSIM_FAST", raising=False)
+    baseline = backend.JaxBackend().schedule(pods, snapshot)
+
+    monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
+    monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+    monkeypatch.setattr(backend, "_fast_path_enabled", lambda: (True, True))
+    real = fastscan.fast_scan
+
+    def wrapped(plan, **kw):
+        if boom:
+            raise RuntimeError("mosaic said no")
+        choices, counts, adv = real(plan, **kw)
+        if corrupt is not None:
+            choices = np.array(choices, copy=True)
+            choices[0] = corrupt(choices[0])
+        return choices, counts, adv
+
+    monkeypatch.setattr(fastscan, "fast_scan", wrapped)
+    auto = backend.JaxBackend().schedule(pods, snapshot)
+    return backend, baseline, auto
+
+
+def test_auto_verification_passes_and_trusts(monkeypatch):
+    backend, baseline, auto = _run_auto(monkeypatch)
+    assert _outcomes(auto) == _outcomes(baseline)
+    assert backend._FAST_AUTO["verified"] is True
+    assert backend._FAST_AUTO["disabled"] is False
+
+
+def test_auto_verification_mismatch_falls_back(monkeypatch):
+    """A kernel that lowers but miscomputes must lose to the XLA scan: the
+    guardrail discards the fast results and pins the process off."""
+    backend, baseline, auto = _run_auto(
+        monkeypatch, corrupt=lambda c: -1 if c >= 0 else 0)
+    assert _outcomes(auto) == _outcomes(baseline)
+    assert backend._FAST_AUTO["disabled"] is True
+
+
+def test_auto_fast_path_exception_falls_back(monkeypatch):
+    """A Mosaic rejection raises inside fast_scan: results still come from
+    the XLA scan and the process never retries the fast path (an abrupt
+    child exit mid-device-context has wedged the axon tunnel before)."""
+    backend, baseline, auto = _run_auto(monkeypatch, boom=True)
+    assert _outcomes(auto) == _outcomes(baseline)
+    assert backend._FAST_AUTO["disabled"] is True
+
+
 def test_too_many_scalar_kinds_fall_back():
     scal = {f"example.com/r{i}": 1 for i in range(8)}  # > 6-bit budget
     nodes = [make_node("n0", scalars=scal)]

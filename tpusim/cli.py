@@ -206,7 +206,11 @@ def run_what_if_cli(args) -> int:
     """Batched multi-snapshot mode (BASELINE.json config 5)."""
     import json
 
+    from tpusim.jaxe import ensure_responsive_platform
     from tpusim.jaxe.whatif import run_what_if
+
+    # a wedged accelerator tunnel must degrade to CPU, not hang the dispatch
+    ensure_responsive_platform()
 
     try:
         with open(args.what_if) as f:
@@ -289,6 +293,11 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+        # an explicit pin is a deliberate choice: the wedged-tunnel probe
+        # guard must neither delay it nor silently override it with CPU
+        import os
+
+        os.environ["TPUSIM_PROBE"] = "0"
 
     if args.what_if:
         if args.event_log:

@@ -57,6 +57,107 @@ if _cache_dir:
             "TPUSIM_COMPILE_CACHE=%s requested but the persistent compile "
             "cache could not be enabled: %s", _cache_dir, exc)
 
+_probe_checked = False
+
+
+def ensure_responsive_platform(timeout: float = 0.0) -> None:
+    """Probe the accelerator in a SUBPROCESS before the first in-process
+    device op; pin jax to CPU when it does not answer.
+
+    The axon TPU tunnel can wedge such that the first device op blocks
+    forever with the GIL held (BASELINE.md round-2..4 postmortems) — an
+    interactive CLI must degrade to the host platform instead of hanging.
+    Skipped when: TPUSIM_PROBE=0, an explicit platform pin is active
+    (JAX_PLATFORMS=cpu / --platform / tests' conftest), or a probe passed
+    within the last 10 minutes (stamp file — repeat CLI invocations on a
+    healthy tunnel pay the ~13s probe once)."""
+    global _probe_checked
+    if _probe_checked or os.environ.get("TPUSIM_PROBE") == "0":
+        return
+    _probe_checked = True
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if _xb._backends:
+            # already initialized: the init-hang this guard exists for is
+            # behind us, re-pinning platforms would be a no-op, and a probe
+            # SUBPROCESS would open a second concurrent tunnel client —
+            # itself a suspected wedge trigger (BASELINE.md round-4)
+            return
+    except Exception:  # pragma: no cover - private-API drift
+        pass
+    try:
+        plats = str(jax.config.jax_platforms or "").split(",")
+        if plats[0].strip().lower() == "cpu":
+            # the FIRST entry wins platform selection: "cpu" / "cpu,axon"
+            # never touches the tunnel, but "axon,cpu" (what the axon
+            # plugin force-installs) absolutely does
+            return
+    except AttributeError:  # pragma: no cover - very old jax
+        pass
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    # per-uid names: on a shared host another user's stale stamp would be
+    # unreadable/unwritable and must not affect (or crash) this process
+    uid = getattr(os, "getuid", lambda: 0)()
+    stamp = os.path.join(tempfile.gettempdir(), f"tpusim_probe_ok.{uid}")
+    stamp_bad = os.path.join(tempfile.gettempdir(), f"tpusim_probe_bad.{uid}")
+    log = _logging.getLogger(__name__)
+
+    def _pin_cpu(why: str) -> None:
+        log.warning(
+            "%s; running on the CPU backend (set TPUSIM_PROBE=0 or "
+            "--platform to override)", why)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as exc:  # backends already initialized
+            log.warning("could not pin jax to cpu: %s", exc)
+
+    try:
+        if time.time() - os.path.getmtime(stamp) < 600:
+            return
+    except OSError:
+        pass
+    try:
+        # a recent failed probe: don't make every process re-pay the full
+        # probe timeout against a tunnel known to be wedged
+        if time.time() - os.path.getmtime(stamp_bad) < 120:
+            _pin_cpu("accelerator probe failed <120s ago (wedged tunnel?)")
+            return
+    except OSError:
+        pass
+    if not timeout:
+        timeout = float(os.environ.get("TPUSIM_PROBE_TIMEOUT", "40"))
+    def _touch(path: str) -> None:
+        # stamp upkeep must never fail the probe verdict or the caller
+        try:
+            with open(path, "w"):
+                pass
+        except OSError:
+            pass
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c",
+             "import jax\nimport jax.numpy as jnp\n"
+             "assert int(jnp.ones((8, 8)).sum()) == 64"],
+            timeout=timeout, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    except Exception:
+        _touch(stamp_bad)
+        _pin_cpu(f"accelerator probe did not answer within {timeout:.0f}s "
+                 "(wedged tunnel?)")
+    else:
+        _touch(stamp)
+        try:
+            os.remove(stamp_bad)
+        except OSError:
+            pass
+
+
 _x64_enabled = False
 
 

@@ -284,7 +284,18 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
 # ---------------------------------------------------------------------------
 
 
-def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int):
+def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
+                 group: int):
+    """Kernel body for one grid step of `group` consecutive pods.
+
+    Mosaic requires the sublane (second-to-last) block dim to be a multiple
+    of 8 or the whole array axis, so per-pod operands stream in blocks of
+    `group`=SUBLANES pods and the kernel statically unrolls the sequential
+    per-pod step `group` times (carry reads re-load the output refs, so pod
+    j sees pod j-1's bind). Binds are masked whole-row vector updates — a
+    one-hot (1,Npad) `pick` row — rather than dynamic-lane scalar stores,
+    which Mosaic does not lower."""
+
     def kernel(*refs):
         (rc_r, rm_r, rg_r, re_r, nzc_r, nzm_r, zr_r, be_r,
          sel_r, tol_r, intol_r, aff_r, av_r, host_r,
@@ -314,149 +325,159 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int):
             if num_scalars:
                 ous_r[:] = ius_r[:]
 
-        rc = rc_r[0, 0]
-        rm = rm_r[0, 0]
-        rg = rg_r[0, 0]
-        re = re_r[0, 0]
-        nzc = nzc_r[0, 0]
-        nzm = nzm_r[0, 0]
-        check_res = zr_r[0, 0] == 0
-        best_effort = be_r[0, 0] != 0
-        rr = omisc_r[0, 0]
-
-        used_c = ouc_r[:]
-        used_m = oum_r[:]
-        used_g = oug_r[:]
-        used_e = oue_r[:]
-        nz_c = onzc_r[:]
-        nz_m = onzm_r[:]
-        pc = opc_r[:]
         acpu = acpu_r[:]
         amem = amem_r[:]
-
-        # ---- filter stages, predicatesOrdering (kernels._evaluate) ----
+        agpu = agpu_r[:]
+        aeph = aeph_r[:]
+        allowed = allowed_r[:]
         cond = cond_r[:]
         fail_cond = cond != 0
-
-        insuff_pods = (pc + 1) > allowed_r[:]
-        insuff_cpu = check_res & (acpu < used_c + rc)
-        insuff_mem = check_res & (amem < used_m + rm)
-        insuff_gpu = check_res & (agpu_r[:] < used_g + rg)
-        insuff_eph = check_res & (aeph_r[:] < used_e + re)
-        fail_res = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
-                    | insuff_eph)
-        scalar_bits = None
+        mpr = mpr_r[:] != 0
+        dpr_fail = dpr_r[:] != 0
         if num_scalars:
             asc = ascal_r[:]
-            us = ous_r[:]
-            for si in range(num_scalars):
-                ins = check_res & (asc[si:si + 1, :]
-                                   < us[si:si + 1, :] + rs_r[0, si])
-                fail_res = fail_res | ins
-                bit = ins.astype(jnp.int32) << (NUM_FIXED_BITS + si)
-                scalar_bits = bit if scalar_bits is None else scalar_bits | bit
-        host_bad = host_r[:] == 0
-        sel_bad = sel_r[:] == 0
-        fail_general = fail_res | host_bad | sel_bad
-        bits_general = (
-            insuff_pods.astype(jnp.int32) << BIT_INSUFFICIENT_PODS
-            | insuff_cpu.astype(jnp.int32) << BIT_INSUFFICIENT_CPU
-            | insuff_mem.astype(jnp.int32) << BIT_INSUFFICIENT_MEMORY
-            | insuff_gpu.astype(jnp.int32) << BIT_INSUFFICIENT_GPU
-            | insuff_eph.astype(jnp.int32) << BIT_INSUFFICIENT_EPHEMERAL
-            | host_bad.astype(jnp.int32) << BIT_HOSTNAME_MISMATCH
-            | sel_bad.astype(jnp.int32) << BIT_NODE_SELECTOR_MISMATCH)
-        if scalar_bits is not None:
-            bits_general = bits_general | scalar_bits
-        fail_taint = tol_r[:] == 0
-        fail_mem_pr = (mpr_r[:] != 0) & best_effort
-        fail_disk_pr = dpr_r[:] != 0
 
-        feasible = ~(fail_cond | fail_general | fail_taint | fail_mem_pr
-                     | fail_disk_pr)
-        # short-circuit reason selection: first failing stage wins
-        reason = jnp.zeros_like(cond)
-        stages = ((fail_cond, cond),
-                  (fail_general, bits_general),
-                  (fail_taint, jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED),
-                  (fail_mem_pr, jnp.int32(1) << BIT_MEMORY_PRESSURE),
-                  (fail_disk_pr, jnp.int32(1) << BIT_DISK_PRESSURE))
-        for fail, bits in reversed(stages):
-            reason = jnp.where(fail, bits, reason)
-        n_feasible = jnp.sum(feasible.astype(jnp.int32), dtype=jnp.int32)
-        found = n_feasible > 0
+        for j in range(group):
+            rc = rc_r[j, 0]
+            rm = rm_r[j, 0]
+            rg = rg_r[j, 0]
+            re = re_r[j, 0]
+            nzc = nzc_r[j, 0]
+            nzm = nzm_r[j, 0]
+            check_res = zr_r[j, 0] == 0
+            best_effort = be_r[j, 0] != 0
+            rr = omisc_r[0, 0]
 
-        # ---- score (int32 throughout; products bounded by plan_fast) ----
-        total_c = nz_c + nzc
-        total_m = nz_m + nzm
+            used_c = ouc_r[:]
+            used_m = oum_r[:]
+            used_g = oug_r[:]
+            used_e = oue_r[:]
+            nz_c = onzc_r[:]
+            nz_m = onzm_r[:]
+            pc = opc_r[:]
 
-        def ratio(req, cap):
-            valid = (cap > 0) & (req <= cap)
-            if most_requested:
-                expr = (req * MAX_PRIORITY) // jnp.maximum(cap, 1)
-            else:
-                expr = ((cap - req) * MAX_PRIORITY) // jnp.maximum(cap, 1)
-            return jnp.where(valid, expr, 0)
+            # ---- filter stages, predicatesOrdering (kernels._evaluate) ----
+            insuff_pods = (pc + 1) > allowed
+            insuff_cpu = check_res & (acpu < used_c + rc)
+            insuff_mem = check_res & (amem < used_m + rm)
+            insuff_gpu = check_res & (agpu < used_g + rg)
+            insuff_eph = check_res & (aeph < used_e + re)
+            fail_res = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
+                        | insuff_eph)
+            scalar_bits = None
+            if num_scalars:
+                us = ous_r[:]
+                for si in range(num_scalars):
+                    ins = check_res & (asc[si:si + 1, :]
+                                       < us[si:si + 1, :] + rs_r[j, si])
+                    fail_res = fail_res | ins
+                    bit = ins.astype(jnp.int32) << (NUM_FIXED_BITS + si)
+                    scalar_bits = (bit if scalar_bits is None
+                                   else scalar_bits | bit)
+            host_bad = host_r[j:j + 1, :] == 0
+            sel_bad = sel_r[j:j + 1, :] == 0
+            fail_general = fail_res | host_bad | sel_bad
+            bits_general = (
+                insuff_pods.astype(jnp.int32) << BIT_INSUFFICIENT_PODS
+                | insuff_cpu.astype(jnp.int32) << BIT_INSUFFICIENT_CPU
+                | insuff_mem.astype(jnp.int32) << BIT_INSUFFICIENT_MEMORY
+                | insuff_gpu.astype(jnp.int32) << BIT_INSUFFICIENT_GPU
+                | insuff_eph.astype(jnp.int32) << BIT_INSUFFICIENT_EPHEMERAL
+                | host_bad.astype(jnp.int32) << BIT_HOSTNAME_MISMATCH
+                | sel_bad.astype(jnp.int32) << BIT_NODE_SELECTOR_MISMATCH)
+            if scalar_bits is not None:
+                bits_general = bits_general | scalar_bits
+            fail_taint = tol_r[j:j + 1, :] == 0
+            fail_mem_pr = mpr & best_effort
+            fail_disk_pr = dpr_fail
 
-        score = (ratio(total_c, acpu) + ratio(total_m, amem)) // 2
-        # balanced (exact rational, DEVIATIONS.md #16): products fit int32
-        num = jnp.abs(total_c * amem - total_m * acpu)
-        den = acpu * amem
-        bal = (MAX_PRIORITY * (den - num)) // jnp.maximum(den, 1)
-        bal_zero = ((acpu == 0) | (total_c >= acpu)
-                    | (amem == 0) | (total_m >= amem))
-        score = score + jnp.where(bal_zero, 0, bal)
-        # NodeAffinityPriority normalize over feasible nodes
-        aff = aff_r[:]
-        aff_max = jnp.max(jnp.where(feasible, aff, 0))
-        score = score + jnp.where(
-            aff_max > 0, MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
-        # TaintTolerationPriority reversed normalize
-        intol = intol_r[:]
-        intol_max = jnp.max(jnp.where(feasible, intol, 0))
-        score = score + jnp.where(
-            intol_max > 0,
-            MAX_PRIORITY - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
-            MAX_PRIORITY)
-        score = score + av_r[:] * AVOID_PODS_WEIGHT
+            feasible = ~(fail_cond | fail_general | fail_taint | fail_mem_pr
+                         | fail_disk_pr)
+            # short-circuit reason selection: first failing stage wins
+            reason = jnp.zeros_like(cond)
+            stages = ((fail_cond, cond),
+                      (fail_general, bits_general),
+                      (fail_taint, jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED),
+                      (fail_mem_pr, jnp.int32(1) << BIT_MEMORY_PRESSURE),
+                      (fail_disk_pr, jnp.int32(1) << BIT_DISK_PRESSURE))
+            for fail, bits in reversed(stages):
+                reason = jnp.where(fail, bits, reason)
+            n_feasible = jnp.sum(feasible.astype(jnp.int32), dtype=jnp.int32)
+            found = n_feasible > 0
 
-        # ---- selectHost: stable-desc argmax + round-robin tie pick ----
-        masked = jnp.where(feasible, score, -1)
-        max_score = jnp.max(masked)
-        tie = feasible & (masked == max_score)
-        ties = jnp.maximum(jnp.sum(tie.astype(jnp.int32), dtype=jnp.int32), 1)
-        k = jnp.where(n_feasible > 1, rr % ties, 0)
-        rank = jnp.cumsum(tie.astype(jnp.int32), axis=1, dtype=jnp.int32) - 1
-        pick = tie & (rank == k)
-        idx_row = jax.lax.broadcasted_iota(jnp.int32, pick.shape, 1)
-        choice = jnp.min(jnp.where(pick, idx_row, jnp.int32(1 << 30)))
-        choice_r[0, 0] = jnp.where(found, choice, -1)
-        adv_r[0, 0] = (n_feasible > 1).astype(jnp.int32)
+            # ---- score (int32 throughout; products bounded by plan_fast) ----
+            total_c = nz_c + nzc
+            total_m = nz_m + nzm
 
-        # ---- reason histogram (zeros when scheduled) ----
-        fr = jnp.where(found, jnp.zeros_like(reason), reason)
-        for b in range(num_bits):
-            counts_r[0, b] = jnp.sum((fr >> b) & 1, dtype=jnp.int32)
-        counts_r[0, num_bits:] = jnp.zeros(
-            (counts_r.shape[1] - num_bits,), dtype=jnp.int32)
+            def ratio(req, cap):
+                valid = (cap > 0) & (req <= cap)
+                if most_requested:
+                    expr = (req * MAX_PRIORITY) // jnp.maximum(cap, 1)
+                else:
+                    expr = ((cap - req) * MAX_PRIORITY) // jnp.maximum(cap, 1)
+                return jnp.where(valid, expr, 0)
 
-        # ---- bind: single-element scatter-add at the chosen node ----
-        i = jnp.maximum(choice, 0)
+            score = (ratio(total_c, acpu) + ratio(total_m, amem)) // 2
+            # balanced (exact rational, DEVIATIONS.md #16): products fit int32
+            num = jnp.abs(total_c * amem - total_m * acpu)
+            den = acpu * amem
+            bal = (MAX_PRIORITY * (den - num)) // jnp.maximum(den, 1)
+            bal_zero = ((acpu == 0) | (total_c >= acpu)
+                        | (amem == 0) | (total_m >= amem))
+            score = score + jnp.where(bal_zero, 0, bal)
+            # NodeAffinityPriority normalize over feasible nodes
+            aff = aff_r[j:j + 1, :]
+            aff_max = jnp.max(jnp.where(feasible, aff, 0))
+            score = score + jnp.where(
+                aff_max > 0, MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
+            # TaintTolerationPriority reversed normalize
+            intol = intol_r[j:j + 1, :]
+            intol_max = jnp.max(jnp.where(feasible, intol, 0))
+            score = score + jnp.where(
+                intol_max > 0,
+                MAX_PRIORITY
+                - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
+                MAX_PRIORITY)
+            score = score + av_r[j:j + 1, :] * AVOID_PODS_WEIGHT
 
-        @pl.when(found)
-        def _bind():
-            ouc_r[0, i] = used_c[0, i] + rc
-            oum_r[0, i] = used_m[0, i] + rm
-            oug_r[0, i] = used_g[0, i] + rg
-            oue_r[0, i] = used_e[0, i] + re
-            onzc_r[0, i] = nz_c[0, i] + nzc
-            onzm_r[0, i] = nz_m[0, i] + nzm
-            opc_r[0, i] = pc[0, i] + 1
+            # ---- selectHost: stable-desc argmax + round-robin tie pick ----
+            masked = jnp.where(feasible, score, -1)
+            max_score = jnp.max(masked)
+            tie = feasible & (masked == max_score)
+            ties = jnp.maximum(
+                jnp.sum(tie.astype(jnp.int32), dtype=jnp.int32), 1)
+            k = jnp.where(n_feasible > 1, rr % ties, 0)
+            rank = (jnp.cumsum(tie.astype(jnp.int32), axis=1,
+                               dtype=jnp.int32) - 1)
+            pick = tie & (rank == k)
+            idx_row = jax.lax.broadcasted_iota(jnp.int32, pick.shape, 1)
+            choice = jnp.min(jnp.where(pick, idx_row, jnp.int32(1 << 30)))
+            choice_r[j, 0] = jnp.where(found, choice, -1)
+            adv_r[j, 0] = (n_feasible > 1).astype(jnp.int32)
+
+            # ---- reason histogram (zeros when scheduled) ----
+            fr = jnp.where(found, jnp.zeros_like(reason), reason)
+            for b in range(num_bits):
+                counts_r[j, b] = jnp.sum((fr >> b) & 1, dtype=jnp.int32)
+            counts_r[j, num_bits:] = jnp.zeros(
+                (counts_r.shape[1] - num_bits,), dtype=jnp.int32)
+
+            # ---- bind: one-hot masked whole-row updates (pick is all-False
+            # when nothing is feasible, so no `found` gate is needed) ----
+            ouc_r[:] = jnp.where(pick, used_c + rc, used_c)
+            oum_r[:] = jnp.where(pick, used_m + rm, used_m)
+            oug_r[:] = jnp.where(pick, used_g + rg, used_g)
+            oue_r[:] = jnp.where(pick, used_e + re, used_e)
+            onzc_r[:] = jnp.where(pick, nz_c + nzc, nz_c)
+            onzm_r[:] = jnp.where(pick, nz_m + nzm, nz_m)
+            opc_r[:] = jnp.where(pick, pc + 1, pc)
             if num_scalars:
                 for si in range(num_scalars):
-                    ous_r[si, i] = us[si, i] + rs_r[0, si]
+                    ous_r[si:si + 1, :] = jnp.where(
+                        pick, us[si:si + 1, :] + rs_r[j, si],
+                        us[si:si + 1, :])
 
-        omisc_r[0, 0] = rr + (n_feasible > 1).astype(jnp.int32)
+            omisc_r[0, 0] = rr + (n_feasible > 1).astype(jnp.int32)
 
     return kernel
 
@@ -464,16 +485,24 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int):
 @lru_cache(maxsize=16)
 def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
                 counts_w: int, num_scalars: int, srows: int, interpret: bool):
-    """jitted pallas_call for one (node-pad, chunk, scalar) shape."""
-    kernel = _make_kernel(most_requested, num_bits, num_scalars)
+    """jitted pallas_call for one (node-pad, chunk, scalar) shape.
+
+    k must be a multiple of SUBLANES: Mosaic rejects blocks whose sublane
+    dim is neither a multiple of 8 nor the whole axis, so per-pod operands
+    move in (SUBLANES, …) blocks and the grid covers k/SUBLANES steps of
+    SUBLANES statically-unrolled pods each."""
+    assert k % SUBLANES == 0, k
+    kernel = _make_kernel(most_requested, num_bits, num_scalars, SUBLANES)
 
     def smem_scalar():
-        return pl.BlockSpec((1, 1), lambda p: (p, 0), memory_space=_SMEM) \
-            if _SMEM is not None else pl.BlockSpec((1, 1), lambda p: (p, 0))
+        return pl.BlockSpec((SUBLANES, 1), lambda p: (p, 0),
+                            memory_space=_SMEM) \
+            if _SMEM is not None else pl.BlockSpec((SUBLANES, 1),
+                                                   lambda p: (p, 0))
 
     def row_per_pod(width=None):
         kw = {"memory_space": _VMEM} if _VMEM is not None else {}
-        return pl.BlockSpec((1, width or npad), lambda p: (p, 0), **kw)
+        return pl.BlockSpec((SUBLANES, width or npad), lambda p: (p, 0), **kw)
 
     def const_row(width=None, rows=1):
         kw = {"memory_space": _VMEM} if _VMEM is not None else {}
@@ -485,7 +514,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
                  if num_scalars else [])
     scalar_out = [const_row(rows=srows)] if num_scalars else []
     grid_spec = pl.GridSpec(
-        grid=(k,),
+        grid=(k // SUBLANES,),
         in_specs=(
             [smem_scalar() for _ in range(8)]           # pod scalars
             + [row_per_pod() for _ in range(6)]         # pregathered rows
@@ -497,11 +526,11 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
         out_specs=(
             [const_row() for _ in range(7)]             # carry out
             + [const_row(LANES)]                        # misc out
-            + [pl.BlockSpec((1, 1), lambda p: (p, 0),
+            + [pl.BlockSpec((SUBLANES, 1), lambda p: (p, 0),
                             **({"memory_space": _VMEM} if _VMEM else {}))]
-            + [pl.BlockSpec((1, counts_w), lambda p: (p, 0),
+            + [pl.BlockSpec((SUBLANES, counts_w), lambda p: (p, 0),
                             **({"memory_space": _VMEM} if _VMEM else {}))]
-            + [pl.BlockSpec((1, 1), lambda p: (p, 0),
+            + [pl.BlockSpec((SUBLANES, 1), lambda p: (p, 0),
                             **({"memory_space": _VMEM} if _VMEM else {}))]
             + scalar_out
         ),
@@ -543,7 +572,10 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     num_bits = NUM_FIXED_BITS + plan.num_scalars
     counts_w = LANES  # lane-aligned histogram row; decode slices [:num_bits]
     srows = plan.alloc_scalar.shape[0] if plan.num_scalars else 0
-    k = min(chunk, max(p, 1))
+    # round the chunk up to a SUBLANES multiple (Mosaic block granularity);
+    # tail rows ride the existing GHOST_REQ padding (infeasible everywhere,
+    # no carry/rr effect)
+    k = -(-min(chunk, max(p, 1)) // SUBLANES) * SUBLANES
     call = _build_call(npad, k, plan.most_requested, num_bits, counts_w,
                        plan.num_scalars, srows, interpret)
 

@@ -535,6 +535,11 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
         cc.run()
         return cc.status
     if backend == "jax":
+        # interactive robustness: a wedged accelerator tunnel must degrade
+        # to the CPU backend instead of hanging the first device op forever
+        from tpusim.jaxe import ensure_responsive_platform
+
+        ensure_responsive_platform()
         from tpusim.backends import get_backend
 
         if enable_volume_scheduling:

@@ -33,7 +33,7 @@ SIGTERM after a grace period, SIGKILL only as a last resort (a hard kill
 mid-op has permanently wedged the tunnel before; see BASELINE.md).
 
 Env knobs: TPUSIM_BENCH_PODS (default 100000), TPUSIM_BENCH_NODES (5000),
-TPUSIM_BENCH_BASELINE_PODS (200), TPUSIM_BENCH_BATCH (0 = exact scan),
+TPUSIM_BENCH_BASELINE_PODS (200),
 TPUSIM_BENCH_STALL_TIMEOUT (240s), TPUSIM_BENCH_INIT_TIMEOUT (75s — stall
 limit until the child reports its device list), TPUSIM_BENCH_PROBE_TIMEOUT
 (40s), TPUSIM_BENCH_RUN_TIMEOUT (2400s),
@@ -164,7 +164,7 @@ def _checksum(choices) -> int:
                                np.asarray(choices), -1)))
 
 
-def _run_once(config, carry, statics, xs, batch: int, chunk: int):
+def _run_once(config, carry, statics, xs, chunk: int):
     """One full scheduling pass; returns (choices np, checksum int, counts).
 
     Batches longer than `chunk` run through the double-buffered donated-carry
@@ -172,13 +172,10 @@ def _run_once(config, carry, statics, xs, batch: int, chunk: int):
     from tpusim.jaxe.kernels import (
         schedule_scan,
         schedule_scan_chunked,
-        schedule_wavefront,
     )
 
     p = int(xs.req_cpu.shape[0])
-    if batch > 0:
-        _, choices, counts, _ = schedule_wavefront(config, carry, statics, xs, batch)
-    elif chunk and p > chunk:
+    if chunk and p > chunk:
         t0 = time.perf_counter()
 
         def prog(ci, total, done):
@@ -194,7 +191,7 @@ def _run_once(config, carry, statics, xs, batch: int, chunk: int):
     return np.asarray(choices), _checksum(choices), np.asarray(counts)
 
 
-def measure_config(name: str, snapshot, pods, platform: str, batch: int,
+def measure_config(name: str, snapshot, pods, platform: str,
                    baseline_pods: int, chunk: int, timed_runs: int = 3):
     """Measure one ladder config; returns the result dict."""
     from tpusim.backends import ReferenceBackend
@@ -214,7 +211,7 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
         log(f"  reference loop: {sub} pods in {ref_elapsed:.1f}s "
             f"= {ref_rate:.1f} pods/s")
 
-    use_chunks = batch == 0 and chunk and num_pods > chunk
+    use_chunks = bool(chunk) and num_pods > chunk
     compiled, config, carry, statics, xs, cols = _prepare(
         snapshot, pods, to_device=not use_chunks)
     if compiled.unsupported:
@@ -222,7 +219,7 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
                 "value": 0, "unit": "pods/s", "vs_baseline": 0}
 
     fast_plan = None
-    if batch == 0 and os.environ.get("TPUSIM_FAST") == "1":
+    if os.environ.get("TPUSIM_FAST") == "1":
         # one shared gate (env flag + interpreter override + tpu backend):
         # off-TPU the kernel would run in the Pallas interpreter, which is
         # meaningless as a benchmark
@@ -262,7 +259,7 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
                 fast_plan = None
             else:
                 return f_choices, _checksum(f_choices), f_counts
-        return _run_once(config, carry, statics, xs, batch, chunk)
+        return _run_once(config, carry, statics, xs, chunk)
 
     t0 = time.perf_counter()
     choices, checksum, counts = one_pass(carry)
@@ -300,10 +297,7 @@ def measure_config(name: str, snapshot, pods, platform: str, batch: int,
             != ref_placements[i].node_name)
         log(f"  parity check on first {sub} pods: {mismatches} mismatches")
 
-    if batch == 0:
-        mode = "exact scan (pallas)" if fast_plan is not None else "exact scan"
-    else:
-        mode = f"wavefront K={batch}"
+    mode = "exact scan (pallas)" if fast_plan is not None else "exact scan"
     result = {
         "metric": f"scheduled pods/sec ({name}, {mode}, platform={platform}"
                   + (f", parity_mismatches={mismatches}" if mismatches is not None else "")
@@ -339,7 +333,6 @@ def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
     if platform == "cpu":
         num_pods, num_nodes = _cpu_sized_workload()
     baseline_pods = int(os.environ.get("TPUSIM_BENCH_BASELINE_PODS", 200))
-    batch = int(os.environ.get("TPUSIM_BENCH_BATCH", 0))
     chunk = int(os.environ.get("TPUSIM_BENCH_CHUNK", 131072))
 
     import jax
@@ -366,14 +359,14 @@ def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
         run_phases(real_platform, chunk)
         return
     if ladder:
-        run_ladder(real_platform, batch, baseline_pods, chunk)
+        run_ladder(real_platform, baseline_pods, chunk)
         return
 
     # stage 1: a small same-shape run — completes fast, leaves a valid JSON
     # line on stdout even if the full-size run later wedges
     small_snapshot, small_pods = build_workload(2_000, 500)
     small = measure_config("staged 2k Zipf pods, 500 nodes", small_snapshot,
-                           small_pods, real_platform, batch, baseline_pods,
+                           small_pods, real_platform, baseline_pods,
                            chunk, timed_runs=1)
     small["note"] = "staged small run; full-size run follows"
     print(json.dumps(small), flush=True)
@@ -382,7 +375,7 @@ def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
     snapshot, pods = build_workload(num_pods, num_nodes)
     result = measure_config(
         f"{num_pods // 1000}k Zipf pods, {num_nodes} heterogeneous nodes",
-        snapshot, pods, real_platform, batch, baseline_pods, chunk,
+        snapshot, pods, real_platform, baseline_pods, chunk,
         timed_runs=int(os.environ.get("TPUSIM_BENCH_TIMED_RUNS", 5)))
     print(json.dumps(result), flush=True)
 
@@ -403,7 +396,7 @@ def _ladder_configs() -> set:
     return wanted
 
 
-def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> None:
+def run_ladder(platform: str, baseline_pods: int, chunk: int) -> None:
     """BASELINE.md configs 1-5; one JSON line each."""
     from tpusim.api.podspec import expand_simulation_pods, parse_simulation_pods
     from tpusim.api.snapshot import synthetic_cluster
@@ -434,14 +427,14 @@ def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> Non
         results.append(measure_config(
             "config 1: quickstart 20 pods, 100 synthetic nodes",
             synthetic_cluster(100, milli_cpu=4000, memory=16 * 1024**3),
-            quick_pods, platform, batch, baseline_pods, chunk))
+            quick_pods, platform, baseline_pods, chunk))
         print(json.dumps(results[-1]), flush=True)
 
     if 2 in wanted:
         # 2. 1k uniform pods / 100 nodes
         snapshot, pods = uniform_workload(1_000, 100)
         results.append(measure_config("config 2: 1k uniform pods, 100 nodes",
-                                      snapshot, pods, platform, batch,
+                                      snapshot, pods, platform,
                                       baseline_pods, chunk))
         print(json.dumps(results[-1]), flush=True)
 
@@ -450,7 +443,7 @@ def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> Non
         snapshot, pods = build_workload(100_000, 5_000)
         results.append(measure_config(
             "config 3: 100k Zipf pods, 5k heterogeneous nodes",
-            snapshot, pods, platform, batch, baseline_pods, chunk))
+            snapshot, pods, platform, baseline_pods, chunk))
         print(json.dumps(results[-1]), flush=True)
 
     if 4 in wanted:
@@ -461,7 +454,7 @@ def run_ladder(platform: str, batch: int, baseline_pods: int, chunk: int) -> Non
         results.append(measure_config(
             f"config 4: {p4 // 1000}k Zipf pods, {n4} nodes, "
             "taints+node-affinity",
-            snapshot, pods, platform, batch, baseline_pods, chunk,
+            snapshot, pods, platform, baseline_pods, chunk,
             timed_runs=1))
         print(json.dumps(results[-1]), flush=True)
 
@@ -642,10 +635,10 @@ def run_phases(platform: str, chunk: int) -> None:
     The production pipeline is ONE fused device program (filter→score→
     select→bind), so phases have no individually observable device time
     there; the split below times phase-isolated jitted programs over the same
-    pods against a frozen snapshot (wavefront-style vmap): filter-only (score
+    pods against a frozen snapshot (vmapped over pods): filter-only (score
     ops dead-code-eliminated by XLA), filter+score, +select, and the full
-    step incl. the bind scatters. Also sweeps TPUSIM_SCAN_UNROLL and
-    wavefront K for the exact/wavefront modes."""
+    step incl. the bind scatters. Also sweeps TPUSIM_SCAN_UNROLL for the
+    exact scan."""
     import dataclasses
 
     import jax
@@ -655,9 +648,7 @@ def run_phases(platform: str, chunk: int) -> None:
         _evaluate,
         _select,
         carry_init,
-        make_wavefront_step,
         schedule_scan,
-        schedule_wavefront,
     )
 
     # 5k pods keeps the [P, N] phase-program intermediates ~200MB (int64):
@@ -716,19 +707,6 @@ def run_phases(platform: str, chunk: int) -> None:
                    best_unroll=int(best_unroll))
     print(json.dumps(summary), flush=True)
 
-    # --- wavefront K sweep ---
-    k_results = {}
-    for k in (64, 256, 1024, 4096):
-        t = timeit(lambda kk=k: schedule_wavefront(
-            config, carry_init(compiled), statics, xs, kk)[1], reps=3,
-                   label=f"wavefront K={k}")
-        k_results[str(k)] = round(num_pods / t, 1)
-        log(f"[wavefront K={k}] {num_pods / t:.0f} pods/s")
-    best_k = max(k_results, key=lambda k: k_results[k])
-    summary.update(wavefront_k_pods_per_s=k_results,
-                   best_wavefront_k=int(best_k))
-    print(json.dumps(summary), flush=True)
-
     # --- phase-isolated programs (vmapped over the pod axis, frozen carry) ---
     filter_fn = jax.jit(lambda c, s, x: jax.vmap(
         lambda xi: _evaluate(config, c, s, xi)[:2])(x))
@@ -742,14 +720,34 @@ def run_phases(platform: str, chunk: int) -> None:
         return jax.vmap(_select)(feasible, score, n_feasible, rr)
 
     select_fn = jax.jit(select_stage)
-    wave_step = jax.jit(lambda c, s, x, v: make_wavefront_step(config)(
-        (c, s), (x, v)))
-    valid = jnp.ones(num_pods, dtype=bool)
+
+    def full_stage(c, s, x):
+        # filter+score+select plus the bind scatters (segment-sum by chosen
+        # node) — the whole per-pod pipeline against the frozen carry
+        feasible, _, score, n_feasible, _aca = jax.vmap(
+            lambda xi: _evaluate(config, c, s, xi))(x)
+        rr = jnp.arange(feasible.shape[0], dtype=jnp.int64)
+        choices, founds = jax.vmap(_select)(feasible, score, n_feasible, rr)
+        n = c.used_cpu.shape[0]
+        gate = founds.astype(jnp.int64)
+        seg = jnp.where(gate == 1, choices, n)
+
+        def scatter(amounts, target):
+            return target + jax.ops.segment_sum(
+                amounts * gate, seg, num_segments=n + 1)[:n]
+
+        return (scatter(x.req_cpu, c.used_cpu),
+                scatter(x.req_mem, c.used_mem),
+                scatter(x.nz_cpu, c.nonzero_cpu),
+                scatter(x.nz_mem, c.nonzero_mem),
+                scatter(jnp.ones_like(gate), c.pod_count), choices)
+
+    full_fn = jax.jit(full_stage)
 
     t_filter = timeit(filter_fn, carry, statics, xs, label="filter")
     t_eval = timeit(eval_fn, carry, statics, xs, label="filter+score")
     t_select = timeit(select_fn, carry, statics, xs, label="+select")
-    t_full = timeit(wave_step, carry, statics, xs, valid, label="full step")
+    t_full = timeit(full_fn, carry, statics, xs, label="full step")
     phases = {
         "filter_us_per_pod": round(1e6 * t_filter / num_pods, 3),
         "score_us_per_pod": round(1e6 * max(t_eval - t_filter, 0.0) / num_pods, 3),

@@ -25,7 +25,7 @@ def _schedule(snapshot, pods, chunk: int):
     compiled, config, carry, statics, xs, _cols = bench._prepare(
         snapshot, pods, to_device=not use_chunks)
     assert not compiled.unsupported
-    return bench._run_once(config, carry, statics, xs, batch=0, chunk=chunk)
+    return bench._run_once(config, carry, statics, xs, chunk=chunk)
 
 
 def test_chunked_scan_matches_full_batch(workload):

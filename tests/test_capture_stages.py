@@ -1,0 +1,107 @@
+"""tools/tpu_capture.sh stage-completeness logic.
+
+The watcher (tools/tpu_watch.sh) re-runs the capture at every healthy
+probe; `stage_done` decides which stages already hold their TPU records
+and which re-run. Getting this wrong either skips a stage forever after a
+mid-stage wedge (losing the round's TPU evidence) or re-runs completed
+multi-minute stages against a tunnel that may wedge again — so the
+decision table is pinned here by driving the actual bash function.
+"""
+
+import json
+import subprocess
+
+import pytest
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def stage_done(tmp_path, records, spec):
+    art = tmp_path / "artifact.jsonl"
+    art.write_text("".join(
+        (json.dumps(r) if isinstance(r, dict) else r) + "\n"
+        for r in records))
+    script = tmp_path / "driver.sh"
+    script.write_text(
+        "#!/usr/bin/env bash\n"
+        f'source <(sed -n "/^stage_done()/,/^}}/p" {REPO}/tools/tpu_capture.sh)\n'
+        f'stage_done "{art}" "{spec}"\n')
+    return subprocess.run(["bash", str(script)]).returncode == 0
+
+
+def rec(config, platform="tpu", note=None, mode="exact scan", **extra):
+    r = {"metric": f"scheduled pods/sec (config {config}: ..., {mode}, "
+                   f"platform={platform}, placement_hash=abc)",
+         "value": 1.0, "unit": "pods/s", "vs_baseline": 0}
+    if note:
+        r["note"] = note
+    r.update(extra)
+    return r
+
+
+def test_complete_ladder_is_done(tmp_path):
+    records = [rec(c) for c in (1, 2, 3, 4, 5)]
+    assert stage_done(tmp_path, records, "configs:1,2,3,4,5")
+    # ...but config 6 lives in its own artifact and must not be claimed
+    assert not stage_done(tmp_path, records, "configs:6")
+
+
+def test_partial_artifact_reruns(tmp_path):
+    # mid-stage wedge: configs 1-2 landed, 3-5 missing -> the stage re-runs
+    assert not stage_done(tmp_path, [rec(1), rec(2)], "configs:1,2,3,4,5")
+
+
+def test_cpu_fallback_reruns(tmp_path):
+    records = [rec(c, platform="cpu") for c in (3, 4)]
+    assert not stage_done(tmp_path, records, "configs:3,4")
+
+
+def test_partial_note_still_counts(tmp_path):
+    # children print a config record only AFTER that config completes; the
+    # parent adds the "partial" note when the STAGE was interrupted later,
+    # so a noted record is still a valid measurement
+    records = [rec(5, note="partial: no output for 240s (stalled); stopped")]
+    assert stage_done(tmp_path, records, "configs:5")
+
+
+def test_truncated_tail_tolerated(tmp_path):
+    records = [rec(3), rec(4), '{"metric": "scheduled pods/sec (config 5']
+    assert stage_done(tmp_path, records, "configs:3,4")
+    assert not stage_done(tmp_path, records, "configs:3,4,5")
+
+
+def test_phases_spec(tmp_path):
+    partial = [{"metric": "per-phase split + tuning (platform=tpu)",
+                "value": 1.0, "unit": "pods/s", "vs_baseline": 0}]
+    assert not stage_done(tmp_path, partial, "phases")
+    full = [{"metric": "per-phase split + tuning (platform=tpu)",
+             "value": 1.0, "unit": "pods/s", "vs_baseline": 0,
+             "phases": {"filter_us_per_pod": 1.0}}]
+    assert stage_done(tmp_path, full, "phases")
+    cpu = [{"metric": "per-phase split + tuning (platform=cpu)",
+            "value": 1.0, "unit": "pods/s", "vs_baseline": 0,
+            "phases": {"filter_us_per_pod": 1.0}}]
+    assert not stage_done(tmp_path, cpu, "phases")
+
+
+def test_pallas_spec_rejects_xla_fallback_relabel(tmp_path):
+    # bench.py's never-crash path relabels a Mosaic failure as a plain XLA
+    # run (mode "exact scan"); that record must NOT satisfy the fastscan
+    # stage — otherwise the re-capture is silently skipped forever and the
+    # hash-parity check compares XLA against XLA (vacuous MATCH)
+    xla_fallback = [rec(3), rec(4)]
+    assert not stage_done(tmp_path, xla_fallback, "pallas:3,4")
+    real = [rec(3, mode="exact scan (pallas)"),
+            rec(4, mode="exact scan (pallas)")]
+    assert stage_done(tmp_path, real, "pallas:3,4")
+    mixed = [rec(3, mode="exact scan (pallas)"), rec(4)]
+    assert not stage_done(tmp_path, mixed, "pallas:3,4")
+
+
+def test_missing_artifact_reruns(tmp_path):
+    script = tmp_path / "driver.sh"
+    script.write_text(
+        "#!/usr/bin/env bash\n"
+        f'source <(sed -n "/^stage_done()/,/^}}/p" {REPO}/tools/tpu_capture.sh)\n'
+        f'stage_done "{tmp_path}/nope.jsonl" "configs:1"\n')
+    assert subprocess.run(["bash", str(script)]).returncode != 0

@@ -253,12 +253,12 @@ def test_auto_mode_env_gates(monkeypatch):
     assert backend._fast_path_enabled() == (False, False)
 
 
-def _run_auto(monkeypatch, corrupt=None, boom=False):
+def _run_auto(monkeypatch, corrupt=None, boom=False, num_pods=120):
     """Drive JaxBackend through the AUTO fast path on CPU (interpreter) by
     forcing the gate open with verification on; returns (baseline, auto)."""
     from tpusim.jaxe import backend, fastscan
 
-    snapshot, pods = build(3, num_nodes=20, num_pods=60)
+    snapshot, pods = build(3, num_nodes=20, num_pods=num_pods)
     monkeypatch.delenv("TPUSIM_FAST", raising=False)
     baseline = backend.JaxBackend().schedule(pods, snapshot)
 
@@ -285,6 +285,15 @@ def test_auto_verification_passes_and_trusts(monkeypatch):
     backend, baseline, auto = _run_auto(monkeypatch)
     assert _outcomes(auto) == _outcomes(baseline)
     assert backend._FAST_AUTO["verified"] is True
+    assert backend._FAST_AUTO["disabled"] is False
+
+
+def test_auto_small_batch_does_not_pin_trust(monkeypatch):
+    """A tiny first batch passing the comparison is weak evidence: it must
+    NOT exempt every later batch in the process from verification."""
+    backend, baseline, auto = _run_auto(monkeypatch, num_pods=20)
+    assert _outcomes(auto) == _outcomes(baseline)
+    assert backend._FAST_AUTO["verified"] is False
     assert backend._FAST_AUTO["disabled"] is False
 
 

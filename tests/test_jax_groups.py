@@ -342,7 +342,7 @@ def test_existing_preferred_terms_score_new_pod():
 
 
 # ---------------------------------------------------------------------------
-# randomized differential sweep + wavefront/what-if coverage
+# randomized differential sweep + what-if coverage
 # ---------------------------------------------------------------------------
 
 
@@ -385,17 +385,6 @@ def test_randomized_mixed_groups_parity():
         pods.append(make_pod(f"p{i}", milli_cpu=rng.randrange(50, 600),
                              memory=rng.randrange(2**20, 2**28), **kwargs))
     assert_parity(pods, snap)
-
-
-def test_wavefront_runs_with_groups():
-    """Wavefront mode threads the presence state between waves (approximate
-    within a wave, like resources; just assert it executes and is sane)."""
-    snap = ClusterSnapshot(nodes=[make_node(f"n{i}") for i in range(4)])
-    pods = [make_pod(f"p{i}", milli_cpu=10, labels={"app": "s"},
-                     affinity=_anti({"app": "s"})) for i in range(8)]
-    placements = JaxBackend(fallback="error", batch_size=2).schedule(pods, snap)
-    assert sum(1 for p in placements if p.scheduled) <= 4
-    assert sum(1 for p in placements if p.scheduled) >= 2
 
 
 def test_what_if_with_groups():

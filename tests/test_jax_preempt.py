@@ -144,11 +144,10 @@ def test_jax_preemption_empty_feed():
     assert status.stop_reason
 
 
-def test_jax_preemption_wavefront_chunk_invariant(monkeypatch):
-    """Wavefront mode (batch_size > 0) under the chunked dispatch loop:
-    chunk boundaries are wave-aligned and the carry flows across chunks, so
-    ANY chunk sizing must produce the outcome of a single full dispatch
-    (including the pow2 wave-bucket padding after preemptions)."""
+def test_jax_preemption_chunk_sizing_invariant(monkeypatch):
+    """The chunked dispatch loop: the carry flows across chunks, so ANY
+    chunk sizing must produce the outcome of a single full dispatch
+    (including the pow2 bucket padding after preemptions)."""
     import numpy as np
 
     rng = np.random.RandomState(11)
@@ -167,7 +166,7 @@ def test_jax_preemption_wavefront_chunk_invariant(monkeypatch):
         # fresh copies per run: the orchestrator seams mutate fed pods in
         # place (conditions, nominated node names)
         return run_simulation([p.copy() for p in pods], snap, backend="jax",
-                              enable_pod_priority=True, batch_size=4)
+                              enable_pod_priority=True)
 
     small = run(8, 16)
     single = run(1 << 20, 1 << 20)

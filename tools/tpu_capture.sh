@@ -20,7 +20,7 @@
 #                           comparing placement_hash fields across the files
 #                           (same-platform records only)
 #   r4_tpu_whatif1/2.jsonl — config-5 cold/warm compile-cache pair
-#   r4_tpu_phases.jsonl   — unroll + wavefront K sweeps and the phase split
+#   r4_tpu_phases.jsonl   — unroll sweep and the phase split
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -29,11 +29,18 @@ mkdir -p bench_results
 stage_done() {
     # stage_done <file> <spec>: is the artifact TPU-complete?
     # spec "configs:3,4" = a platform=tpu record per config number;
+    # spec "pallas:3,4"  = same, but ONLY records whose mode string is
+    #                      "exact scan (pallas)" count — bench.py's
+    #                      never-crash path relabels a Mosaic failure as a
+    #                      plain XLA run, which must NOT satisfy the
+    #                      fastscan stage (it would silently skip the
+    #                      re-capture and make the parity check vacuous);
     # spec "phases"      = a platform=tpu record carrying the phase split
     python - "$1" "$2" <<'PYEOF'
 import json, re, sys
 
 path, spec = sys.argv[1], sys.argv[2]
+need_pallas = spec.startswith("pallas:")
 have = set()
 phases_done = False
 try:
@@ -49,6 +56,8 @@ try:
             metric = rec.get("metric", "")
             if "platform=tpu" not in metric:
                 continue
+            if need_pallas and "exact scan (pallas)" not in metric:
+                continue  # XLA fallback relabel: not fastscan evidence
             # NOTE: a "partial" note still counts — children print a config
             # record only AFTER that config completes; the parent adds the
             # note when the stage was interrupted later
@@ -120,7 +129,7 @@ run_stage preempt configs:6 bench_results/r4_tpu_preempt.jsonl \
     python bench.py --ladder
 
 echo "== stage 2: Pallas fastscan, configs 3-4 =="
-run_stage fastscan configs:3,4 bench_results/r4_tpu_fast.jsonl \
+run_stage fastscan pallas:3,4 bench_results/r4_tpu_fast.jsonl \
     bench_results/r4_tpu_fast.log \
     env TPUSIM_FAST=1 TPUSIM_BENCH_LADDER_CONFIGS=3,4 python bench.py --ladder
 
@@ -137,7 +146,7 @@ run_stage whatif2 configs:5 bench_results/r4_tpu_whatif2.jsonl \
 t_end=$(date +%s)
 echo "== config-5 second-run wall: $((t_end - t_start))s (criterion <60s for the child's end-to-end; see [config 5] line in r4_tpu_whatif2.log; 0s = both runs were already captured) =="
 
-echo "== stage 4: phase split + unroll/wavefront sweeps =="
+echo "== stage 4: phase split + unroll sweep =="
 run_stage phases phases bench_results/r4_tpu_phases.jsonl \
     bench_results/r4_tpu_phases.log python bench.py --phases
 
@@ -145,7 +154,7 @@ echo "== hash parity check (fastscan vs XLA scan, same-platform records only) ==
 if ! python - <<'EOF'
 import json, re, sys
 
-def hashes(path):
+def hashes(path, need_pallas=False):
     out = {}
     try:
         with open(path) as f:
@@ -160,6 +169,9 @@ def hashes(path):
                     # completed records
                     continue
                 metric = rec.get("metric", "")
+                if need_pallas and "exact scan (pallas)" not in metric:
+                    continue  # XLA fallback relabel: comparing it to the
+                    #           ladder would be XLA-vs-XLA, vacuously equal
                 m = re.search(r"(config \d).*platform=(\w+).*"
                               r"placement_hash=([0-9a-f]+)", metric)
                 if m:
@@ -172,7 +184,7 @@ def hashes(path):
     return out
 
 ladder = hashes("bench_results/r4_tpu_ladder.jsonl")
-fast = hashes("bench_results/r4_tpu_fast.jsonl")
+fast = hashes("bench_results/r4_tpu_fast.jsonl", need_pallas=True)
 ok = True
 compared = 0
 for key, h in fast.items():

@@ -2,7 +2,7 @@
 
 Reference: cmd/app/server.go + cmd/app/options/options.go. Flag surface kept
 (--kubeconfig --podspec --algorithmprovider), extended per BASELINE.json with
---backend and --batch-size, plus snapshot sources replacing the live-cluster
+--backend, plus snapshot sources replacing the live-cluster
 List (this environment has no kube apiserver): --snapshot / --nodes / --pods /
 --synthetic-nodes.
 """
@@ -70,9 +70,6 @@ def build_parser() -> argparse.ArgumentParser:
                              "nodes [100k] run on the host engine, avoiding "
                              "device-dispatch latency on tiny runs; larger "
                              "ones use the jax engine)")
-    parser.add_argument("--batch-size", type=int, default=0,
-                        help="Wavefront batch size for the jax backend "
-                             "(0 = exact sequential mode)")
     # snapshot sources
     parser.add_argument("--snapshot", default="",
                         help="Combined ClusterSnapshot JSON ({nodes, pods, services})")
@@ -337,9 +334,6 @@ def main(argv=None) -> int:
         print(f"error: {policy_err}", file=sys.stderr)
         return 2
 
-    if args.batch_size and args.backend == "reference":
-        print("error: --batch-size requires the jax backend", file=sys.stderr)
-        return 2
     events = None
     if args.event_log:
         from tpusim.framework.events import load_event_log
@@ -353,7 +347,7 @@ def main(argv=None) -> int:
     start = time.perf_counter()
     try:
         status = run_simulation(pods, snapshot, provider=args.algorithmprovider,
-                                backend=args.backend, batch_size=args.batch_size,
+                                backend=args.backend,
                                 enable_pod_priority=args.enable_pod_priority,
                                 enable_volume_scheduling=args.enable_volume_scheduling,
                                 policy=policy, events=events)

@@ -1,17 +1,12 @@
 """JaxBackend: the SimulatorBackend implementation running on TPU/XLA.
 
-Exactness contract (default sequential scan, batch_size=0): placements are
+Exactness contract: placements are
 IDENTICAL to ReferenceBackend — verified by randomized differential tests —
 across the full DefaultProvider feature set: resources/conditions/pressure,
 taints/tolerations, node selectors, node affinity, hostname pins, scalar
 resources, controller-avoid annotations, host ports,
 services/selector-spreading, and inter-pod (anti)affinity (pod-group presence
 state carried on device; state.GroupTables).
-
-Wavefront mode (batch_size=K>0) is fast but approximate: carry state is frozen
-within a wave, so same-wave pods do not see each other's resource usage,
-host-port occupancy, anti-affinity presence, or spreading counts; the
-exactness contract holds only across wave boundaries.
 
 Compile-time fallbacks route to the reference backend (fallback="reference")
 or raise (fallback="error"): pod-group budget overruns (merged groups >
@@ -48,7 +43,6 @@ from tpusim.jaxe.kernels import (
     pod_columns_to_host,
     schedule_scan,
     schedule_scan_chunked,
-    schedule_wavefront,
     statics_to_device,
 )
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
@@ -127,13 +121,9 @@ class JaxBackend:
     name = "jax"
 
     def __init__(self, provider: str = DEFAULT_PROVIDER, fallback: str = "reference",
-                 hard_pod_affinity_symmetric_weight: int = 10, batch_size: int = 0,
+                 hard_pod_affinity_symmetric_weight: int = 10,
                  policy=None, compiled_policy=None, extender_transport=None):
-        """batch_size=0: exact sequential scan. batch_size=K>0: wavefront mode —
-        waves of K pods against frozen snapshots (fast, approximate: pods in a
-        wave don't see each other's binds).
-
-        policy: an engine.policy.Policy compiled to static gating + weights
+        """policy: an engine.policy.Policy compiled to static gating + weights
         (jaxe.policyc) — replaces the provider's predicate/priority sets like
         factory.go CreateFromConfig; host-bound policy features (extenders,
         ServiceAffinity, ...) route through the fallback. compiled_policy: a
@@ -144,8 +134,6 @@ class JaxBackend:
             raise KeyError(f"plugin {provider!r} has not been registered")
         if fallback not in ("reference", "error"):
             raise ValueError("fallback must be 'reference' or 'error'")
-        if batch_size < 0:
-            raise ValueError("batch_size must be >= 0")
         if not 1 <= hard_pod_affinity_symmetric_weight <= 100:
             # factory.go:1024-1026 — the host backend rejects this range in
             # _create_from_keys; the device backend must match
@@ -155,7 +143,6 @@ class JaxBackend:
         self.provider = provider
         self.fallback = fallback
         self.hard_pod_affinity_symmetric_weight = hard_pod_affinity_symmetric_weight
-        self.batch_size = batch_size
         self.policy = policy
         self.extender_transport = extender_transport
         if policy is not None and compiled_policy is None:
@@ -243,7 +230,7 @@ class JaxBackend:
         # pure wasted latency on exactly the hot path the feature accelerates
         fplan = None
         fast_verify = False
-        if self.batch_size == 0 and cp is None:
+        if cp is None:
             fast_on, fast_verify = _fast_path_enabled()
             if fast_on:
                 from tpusim.jaxe.fastscan import plan_fast
@@ -295,7 +282,7 @@ class JaxBackend:
         import os as _os
 
         scan_chunk = int(_os.environ.get("TPUSIM_SCAN_CHUNK", 131072))
-        use_chunks = (fplan is None and self.batch_size == 0
+        use_chunks = (fplan is None
                       and scan_chunk > 0 and len(pods) > scan_chunk)
         if fplan is None:
             carry = carry_init(compiled)
@@ -363,10 +350,22 @@ class JaxBackend:
                             and np.array_equal(vcnt,
                                                np.asarray(counts)[:m]))
                     if same:
-                        _FAST_AUTO["verified"] = True
-                        log.info("pallas fast path self-verified on the "
-                                 "first %d pods; trusting it for this "
-                                 "process", m)
+                        # pin the process-wide trust only on a batch big
+                        # enough to be real evidence — a tiny first batch
+                        # (or one with few feasible placements) passing
+                        # trivially must not exempt every later batch
+                        # from verification
+                        min_pin = int(_os.environ.get(
+                            "TPUSIM_FAST_VERIFY_MIN", 64))
+                        if m >= min_pin:
+                            _FAST_AUTO["verified"] = True
+                            log.info("pallas fast path self-verified on "
+                                     "the first %d pods; trusting it for "
+                                     "this process", m)
+                        else:
+                            log.info("pallas fast path verified on %d "
+                                     "pods (< %d): keeping per-batch "
+                                     "verification on", m, min_pin)
                     else:
                         log.warning(
                             "pallas fast path DISAGREES with the XLA scan "
@@ -377,9 +376,6 @@ class JaxBackend:
                         _discard_fast_path()
         if fplan is not None:
             pass  # fast path already produced choices/counts
-        elif self.batch_size > 0:
-            _, choices, counts, _ = schedule_wavefront(config, carry, statics,
-                                                       xs, self.batch_size)
         elif use_chunks:
             _, choices, counts, _ = schedule_scan_chunked(
                 config, carry, statics, xs, scan_chunk)

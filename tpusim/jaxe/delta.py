@@ -663,7 +663,7 @@ class IncrementalCluster:
     # -- scheduling ---------------------------------------------------------
 
     def schedule(self, pods: List[Pod], provider: str = "DefaultProvider",
-                 batch_size: int = 0, fallback: str = "reference",
+                 fallback: str = "reference",
                  hard_pod_affinity_symmetric_weight: int = 10):
         """Compile the batch against the current picture and run the jax
         backend; placements are NOT folded back into the event log (feed bind
@@ -672,7 +672,7 @@ class IncrementalCluster:
         from tpusim.jaxe.backend import JaxBackend
 
         backend = JaxBackend(
-            provider=provider, fallback=fallback, batch_size=batch_size,
+            provider=provider, fallback=fallback,
             hard_pod_affinity_symmetric_weight=hard_pod_affinity_symmetric_weight)
         return backend.schedule(pods, self.to_snapshot(),
                                 precompiled=self.compile(pods))

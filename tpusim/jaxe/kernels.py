@@ -6,16 +6,14 @@ Reference mapping:
   selectHost       (generic_scheduler.go:183-198)  -> masked argmax + round-robin tie pick
   assume/bind      (scheduler.go:431-497)          -> scatter-add into the carry
 
-Two execution modes (SURVEY.md §7 step 5):
-  schedule_scan      — EXACT: one lax.scan step per pod; pod t's bind is seen
-                       by pod t+1, identical to the Go loop.
-  schedule_wavefront — FAST/approximate: K pods evaluated against a frozen
-                       snapshot per wave (vmap), binds applied between waves.
-                       Within a wave pods don't see each other's binds, so a
-                       nearly-full node can be overcommitted; exact when pods
-                       in a wave commute (uniform workloads). The rr counter
-                       bookkeeping matches the sequential rule given the
-                       frozen state (exclusive cumsum of "selectHost called").
+Execution mode (SURVEY.md §7 step 5): schedule_scan — EXACT: one lax.scan
+step per pod; pod t's bind is seen by pod t+1, identical to the Go loop.
+(A "wavefront" approximate mode — K pods vmapped against a frozen snapshot
+per wave — existed through round 4 and was removed: measured on the
+BASELINE.md phase shape it was slower than the exact scan at every K on
+CPU AND overestimated schedulable capacity by 8-75% under saturation,
+because pods in a wave don't see each other's binds; see BASELINE.md
+"wavefront verdict".)
 """
 
 from __future__ import annotations
@@ -177,7 +175,7 @@ class Statics(NamedTuple):
 
 
 class PodX(NamedTuple):
-    """One pod's columns (scan xs slice / wavefront row)."""
+    """One pod's columns (scan xs slice)."""
 
     req_cpu: jnp.ndarray
     req_mem: jnp.ndarray
@@ -869,8 +867,8 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         bits_stack = jnp.stack([
             jnp.broadcast_to(bits, fail.shape) for fail, bits in stages])
         aca_counts = (fail_stack, bits_stack)
-        # reason_bits stays zero in count mode: both consumers (the scan
-        # step's cond and the wavefront hist) read aca_counts instead
+        # reason_bits stays zero in count mode: the scan step's consumer
+        # reads aca_counts instead
     else:
         # short-circuit reason selection: first failing stage wins (padded
         # nodes fail at the cond stage, whose sentinel bit is never decoded)
@@ -1227,116 +1225,3 @@ def pad_infeasible_rows(xs, pad: int):
 
     return PodX(*(pad_field(name, arr)
                   for name, arr in zip(PodX._fields, xs)))
-
-
-def make_wavefront_step(config: EngineConfig):
-    """One wave: evaluate K pods against the frozen carry, then apply binds."""
-
-    def step(state: tuple, wave):
-        carry, st = state
-        xs, valid = wave  # PodX with leading K axis, valid[K] (padding mask)
-
-        feasible, reason_bits, score, n_feasible, aca_counts = jax.vmap(
-            lambda x: _evaluate(config, carry, st, x))(xs)
-
-        # rr bookkeeping: pod k sees rr advanced by every prior in-wave pod
-        # that would have invoked selectHost (n_feasible > 1), matching the
-        # sequential rule against the frozen snapshot.
-        advances = ((n_feasible > 1) & valid).astype(jnp.int64)
-        rr_offsets = carry.rr + jnp.cumsum(advances) - advances
-        choices, founds = jax.vmap(_select)(feasible, score, n_feasible, rr_offsets)
-
-        gate = (founds & valid).astype(jnp.int64)
-        n = carry.used_cpu.shape[0]
-        seg = jnp.where(gate == 1, choices, n)  # padding/unschedulable -> dump row
-
-        def scatter(amounts, target):
-            return target + jax.ops.segment_sum(amounts * gate, seg,
-                                                num_segments=n + 1)[:n]
-
-        gate32 = gate.astype(jnp.int32)
-        idxs = jnp.maximum(choices, 0)
-        if (config.has_ports or config.has_services or config.has_interpod
-                or config.has_disk_conflict):
-            presence = carry.presence.at[xs.group_id, idxs].add(gate32)
-        else:
-            presence = carry.presence
-        if config.has_maxpd:
-            added = jax.ops.segment_sum(
-                st.vol_mask[xs.group_id].astype(jnp.int32) * gate32[:, None],
-                seg, num_segments=n + 1)[:n] > 0
-            used_vols = carry.used_vols | added
-        else:
-            used_vols = carry.used_vols
-        if config.has_interpod:
-            k_count = st.topo_dom.shape[0]
-            dom_at = st.topo_dom[:, idxs]                   # [K, W]
-            presence_dom = carry.presence_dom.at[
-                xs.group_id[:, None], jnp.arange(k_count)[None, :],
-                dom_at.T].add(gate32[:, None])
-        else:
-            presence_dom = carry.presence_dom
-        if config.policy is not None and config.policy.sa_enabled:
-            # earliest matching bind in the wave locks each sig (assigned
-            # order == bind order == wave position)
-            match_fw = st.saa_rows[:, xs.group_id] & (gate == 1)[None, :]
-            has = jnp.any(match_fw, axis=1)                     # [F]
-            first_w = jnp.argmax(match_fw, axis=1)              # [F]
-            cand = idxs[first_w].astype(jnp.int32)
-            sa_lock = jnp.where((carry.sa_lock == -1) & has, cand,
-                                carry.sa_lock)
-        else:
-            sa_lock = carry.sa_lock
-        new_carry = Carry(
-            used_cpu=scatter(xs.req_cpu, carry.used_cpu),
-            used_mem=scatter(xs.req_mem, carry.used_mem),
-            used_gpu=scatter(xs.req_gpu, carry.used_gpu),
-            used_eph=scatter(xs.req_eph, carry.used_eph),
-            used_scalar=carry.used_scalar + jax.ops.segment_sum(
-                xs.req_scalar * gate[:, None], seg, num_segments=n + 1)[:n],
-            nonzero_cpu=scatter(xs.nz_cpu, carry.nonzero_cpu),
-            nonzero_mem=scatter(xs.nz_mem, carry.nonzero_mem),
-            pod_count=scatter(jnp.ones_like(gate), carry.pod_count),
-            presence=presence, presence_dom=presence_dom,
-            used_vols=used_vols, sa_lock=sa_lock,
-            rr=carry.rr + jnp.sum(advances))
-
-        # wavefront computes histograms for the whole wave regardless (the
-        # jnp.where evaluates both sides), matching the pre-existing cost
-        hist = (jax.vmap(
-            lambda a: _aca_histogram(a, config.num_reason_bits))(aca_counts)
-            if aca_counts is not None else jax.vmap(
-            lambda b: _reason_histogram(b, config.num_reason_bits))(reason_bits))
-        counts = jnp.where(
-            (founds | ~valid)[:, None],
-            jnp.zeros((1, config.num_reason_bits), dtype=jnp.int32),
-            hist)
-        choices = jnp.where(valid, choices, -1)  # _select already yields -1 on not-found
-        return (new_carry, st), (choices, counts, advances > 0)
-
-    return step
-
-
-@partial(jax.jit, static_argnames=("config", "batch_size"))
-def schedule_wavefront(config: EngineConfig, carry: Carry, statics: Statics,
-                       xs: PodX, batch_size: int):
-    """Fast mode: waves of `batch_size` pods against frozen snapshots."""
-    p = xs.req_cpu.shape[0]
-    num_waves = -(-p // batch_size)
-    padded = num_waves * batch_size
-    pad = padded - p
-
-    def pad_field(a):
-        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-        return jnp.pad(a, widths).reshape((num_waves, batch_size) + a.shape[1:])
-
-    xs_w = PodX(*(pad_field(f) for f in xs))
-    valid = pad_field(jnp.ones(p, dtype=bool))
-
-    step = make_wavefront_step(config)
-    (final_carry, _), (choices, counts, advanced) = jax.lax.scan(
-        step, (carry, statics), (xs_w, valid))
-    return (final_carry,
-            choices.reshape(padded)[:p],
-            counts.reshape(padded, config.num_reason_bits)[:p],
-            advanced.reshape(padded)[:p])

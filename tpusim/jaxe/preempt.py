@@ -84,7 +84,6 @@ from tpusim.jaxe.kernels import (
     config_for,
     pod_columns_to_host,
     schedule_scan,
-    schedule_wavefront,
     statics_to_device,
 )
 from tpusim.jaxe.state import NUM_FIXED_BITS, reason_strings
@@ -169,7 +168,7 @@ class _PreemptBound:
 
 
 def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
-                        provider: str = DEFAULT_PROVIDER, batch_size: int = 0,
+                        provider: str = DEFAULT_PROVIDER,
                         hard_pod_affinity_symmetric_weight: int = 10,
                         incremental: IncrementalCluster = None) -> Status:
     """Run `pods` (podspec order; the LIFO feed reversal happens here, like
@@ -229,11 +228,6 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
     chunk0 = max(1, int(os.environ.get("TPUSIM_PREEMPT_CHUNK0", "128")))
     chunk_max = max(chunk0,
                     int(os.environ.get("TPUSIM_PREEMPT_CHUNK_MAX", "8192")))
-    if batch_size > 0:
-        # wavefront waves must tile chunks exactly so wave boundaries (and
-        # the frozen-carry approximation) match the unchunked dispatch
-        chunk0 = -(-chunk0 // batch_size) * batch_size
-        chunk_max = max(chunk0, chunk_max // batch_size * batch_size)
 
     from time import perf_counter
 
@@ -297,20 +291,15 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                 off = pos - base
                 sl = PodX(*(a[off:off + take] for a in xs_all))
                 dispatch_start = perf_counter()
-                # pow2 buckets (whole waves in wavefront mode) bound XLA
-                # recompiles to O(log chunk_max): arbitrary tail lengths
-                # after a preemption would otherwise each trace a fresh
-                # program (infeasible pad rows never bind or advance rr)
-                bucket = (_next_pow2(-(-take // batch_size)) * batch_size
-                          if batch_size > 0 else _next_pow2(take))
+                # pow2 buckets bound XLA recompiles to O(log chunk_max):
+                # arbitrary tail lengths after a preemption would otherwise
+                # each trace a fresh program (infeasible pad rows never bind
+                # or advance rr)
+                bucket = _next_pow2(take)
                 sl = pad_infeasible_rows(sl, bucket - take)
                 xs = PodX(*(jnp.asarray(a) for a in sl))
-                if batch_size > 0:
-                    carry_out, choices, counts, advanced = schedule_wavefront(
-                        config, carry, statics, xs, batch_size)
-                else:
-                    carry_out, choices, counts, advanced = schedule_scan(
-                        config, carry, statics, xs)
+                carry_out, choices, counts, advanced = schedule_scan(
+                    config, carry, statics, xs)
                 choices = np.asarray(choices)[:take]
                 counts = np.asarray(counts)[:take]
                 advanced = np.asarray(advanced)[:take]

@@ -446,15 +446,14 @@ def new_cluster_capacity(config: SchedulerServerConfig, new_pods: List[Pod],
 def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    provider: str = DEFAULT_PROVIDER, backend: str = "reference",
                    scheduler_name: str = DEFAULT_SCHEDULER_NAME,
-                   batch_size: int = 0, enable_pod_priority: bool = False,
+                   enable_pod_priority: bool = False,
                    enable_volume_scheduling: bool = False,
                    policy: Optional[Policy] = None,
                    events: Optional[list] = None) -> Status:
     """High-level entry: run `pods` (in podspec order; the LIFO feed reversal
     happens inside, matching the reference) against `snapshot` and return the
     final Status. backend='jax' routes the batch through the TPU engine and
-    reconstructs the same Status/report shape; batch_size>0 selects the jax
-    backend's wavefront mode.
+    reconstructs the same Status/report shape.
 
     events: an optional [(ADDED|MODIFIED|DELETED, Pod|Node|Service), ...]
     watch-event log (framework.events.load_event_log) replayed on top of
@@ -483,23 +482,13 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
         # shape). Sized AFTER the event-log fold so node-adding logs count.
         # The rule intentionally avoids initializing jax — merely listing
         # devices can block on a wedged tunnel. Volume scheduling is
-        # host-bound and wins over everything, including a wavefront request
-        # (batch_size is then ignored, like the host-bound-policy path).
+        # host-bound and wins over everything.
         import os as _os
 
         threshold = int(_os.environ.get("TPUSIM_AUTO_THRESHOLD", 100_000))
         tiny = len(pods) * max(len(snapshot.nodes), 1) < threshold
         if enable_volume_scheduling:
-            if batch_size:
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "volume scheduling is host-bound: running the reference "
-                    "engine; --batch-size is ignored")
-                batch_size = 0
             backend = "reference"
-        elif batch_size:
-            backend = "jax"  # an explicit wavefront request wins
         else:
             backend = "reference" if tiny else "jax"
     compiled_policy = None
@@ -519,8 +508,7 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                       "; ".join(sorted(set(compiled_policy.unsupported))[:5]))
             logging.getLogger(__name__).warning(
                 "policy is host-bound (%s): running the reference "
-                "orchestrator instead of the jax backend%s", reason,
-                "; --batch-size is ignored" if batch_size else "")
+                "orchestrator instead of the jax backend", reason)
             backend = "reference"
     if backend == "reference":
         cc = ClusterCapacity(
@@ -552,10 +540,8 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
             from tpusim.jaxe.preempt import run_with_preemption
 
             return run_with_preemption(pods, snapshot, provider=provider,
-                                       batch_size=batch_size,
                                        incremental=incremental)
-        jax_backend = get_backend("jax", provider=provider,
-                                  batch_size=batch_size, policy=policy,
+        jax_backend = get_backend("jax", provider=provider, policy=policy,
                                   compiled_policy=compiled_policy)
         feed = list(reversed(pods))  # the LIFO queue pops the last element first
         precompiled = (incremental.compile(feed) if incremental is not None

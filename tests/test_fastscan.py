@@ -266,8 +266,10 @@ def _run_auto(monkeypatch, corrupt=None, boom=False, num_pods=120):
     monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
     monkeypatch.setattr(backend, "_fast_path_enabled", lambda: (True, True))
     real = fastscan.fast_scan
+    calls = []
 
     def wrapped(plan, **kw):
+        calls.append(1)
         if boom:
             raise RuntimeError("mosaic said no")
         choices, counts, adv = real(plan, **kw)
@@ -278,20 +280,24 @@ def _run_auto(monkeypatch, corrupt=None, boom=False, num_pods=120):
 
     monkeypatch.setattr(fastscan, "fast_scan", wrapped)
     auto = backend.JaxBackend().schedule(pods, snapshot)
-    return backend, baseline, auto
+    return backend, baseline, auto, calls
 
 
 def test_auto_verification_passes_and_trusts(monkeypatch):
-    backend, baseline, auto = _run_auto(monkeypatch)
+    backend, baseline, auto, calls = _run_auto(monkeypatch)
+    assert calls, "pallas fast path did not engage"
     assert _outcomes(auto) == _outcomes(baseline)
     assert backend._FAST_AUTO["verified"] is True
     assert backend._FAST_AUTO["disabled"] is False
 
 
-def test_auto_small_batch_does_not_pin_trust(monkeypatch):
-    """A tiny first batch passing the comparison is weak evidence: it must
-    NOT exempt every later batch in the process from verification."""
-    backend, baseline, auto = _run_auto(monkeypatch, num_pods=20)
+def test_auto_small_batch_skips_fast_path(monkeypatch):
+    """An unverified batch below TPUSIM_FAST_VERIFY_MIN must not run the
+    kernel at all: running it plus a full XLA replay would be strictly
+    slower than plain XLA, and passing on tiny evidence must not pin
+    process-wide trust either."""
+    backend, baseline, auto, calls = _run_auto(monkeypatch, num_pods=20)
+    assert not calls  # routed straight to the XLA scan
     assert _outcomes(auto) == _outcomes(baseline)
     assert backend._FAST_AUTO["verified"] is False
     assert backend._FAST_AUTO["disabled"] is False
@@ -300,7 +306,7 @@ def test_auto_small_batch_does_not_pin_trust(monkeypatch):
 def test_auto_verification_mismatch_falls_back(monkeypatch):
     """A kernel that lowers but miscomputes must lose to the XLA scan: the
     guardrail discards the fast results and pins the process off."""
-    backend, baseline, auto = _run_auto(
+    backend, baseline, auto, _calls = _run_auto(
         monkeypatch, corrupt=lambda c: -1 if c >= 0 else 0)
     assert _outcomes(auto) == _outcomes(baseline)
     assert backend._FAST_AUTO["disabled"] is True
@@ -310,7 +316,7 @@ def test_auto_fast_path_exception_falls_back(monkeypatch):
     """A Mosaic rejection raises inside fast_scan: results still come from
     the XLA scan and the process never retries the fast path (an abrupt
     child exit mid-device-context has wedged the axon tunnel before)."""
-    backend, baseline, auto = _run_auto(monkeypatch, boom=True)
+    backend, baseline, auto, _calls = _run_auto(monkeypatch, boom=True)
     assert _outcomes(auto) == _outcomes(baseline)
     assert backend._FAST_AUTO["disabled"] is True
 

@@ -150,8 +150,21 @@ def sig(status):
             sorted(p.name for p in status.preempted_pods))
 
 
+def _bound_compile_state(seed: int) -> None:
+    """Every 40 seeds, clear jax's compilation caches mid-axis: XLA:CPU's
+    native compiler segfaults once ~200+ cached executables accumulate in
+    one process, and a 150-seed single axis gets there on its own (observed
+    in round 4) — the autouse between-axes clear is not enough for long
+    campaigns."""
+    if seed and seed % 40 == 0:
+        import jax
+
+        jax.clear_caches()
+
+
 def test_fuzz_provider_parity():
     for seed in range(_fuzz_seeds(6)):
+        _bound_compile_state(seed)
         rng = random.Random(1000 + seed)
         snapshot = random_cluster(rng)
         pods = random_pods(rng, rng.randint(20, 30))
@@ -175,6 +188,7 @@ def test_fuzz_policy_parity():
                  "TaintTolerationPriority", "SelectorSpreadPriority",
                  "InterPodAffinityPriority", "ImageLocalityPriority"]
     for seed in range(_fuzz_seeds(4)):
+        _bound_compile_state(seed)
         rng = random.Random(2000 + seed)
         snapshot = random_cluster(rng)
         pods = random_pods(rng, rng.randint(15, 25))
@@ -233,6 +247,7 @@ def test_fuzz_policy_parity():
 
 def test_fuzz_preemption_parity():
     for seed in range(_fuzz_seeds(3)):
+        _bound_compile_state(seed)
         rng = random.Random(3000 + seed)
         snapshot = random_cluster(rng)
         for p in snapshot.pods:
@@ -317,6 +332,7 @@ def test_fuzz_volume_scalar_parity():
     from tpusim.jaxe.delta import IncrementalCluster
 
     for seed in range(_fuzz_seeds(4)):
+        _bound_compile_state(seed)
         rng = random.Random(4000 + seed)
         snapshot = random_volume_cluster(rng)
         pods = random_volume_pods(rng, rng.randint(12, 20),

@@ -232,6 +232,20 @@ class JaxBackend:
         fast_verify = False
         if cp is None:
             fast_on, fast_verify = _fast_path_enabled()
+            if fast_on and fast_verify:
+                # AUTO mode, not yet trusted: a batch too small to pin
+                # trust (< TPUSIM_FAST_VERIFY_MIN) would run the kernel
+                # AND a full XLA replay — strictly slower than plain XLA.
+                # Small batches gain nothing from the fast path anyway;
+                # route them straight to the XLA scan.
+                import os as _osm
+
+                if len(pods) < int(_osm.environ.get(
+                        "TPUSIM_FAST_VERIFY_MIN", 64)):
+                    fast_on = fast_verify = False
+                    log.info("pallas fast path deferred: %d pods is below "
+                             "the self-verification threshold; using the "
+                             "XLA scan", len(pods))
             if fast_on:
                 from tpusim.jaxe.fastscan import plan_fast
 

@@ -332,3 +332,136 @@ def test_too_many_scalar_kinds_fall_back():
     plan, reason = plan_fast(config, compiled, cols)
     assert plan is None
     assert "reason-bit budget" in reason
+
+
+# --------------------------------------------------------------------------
+# pod-group features: host ports / disk conflicts / spreading / volume zones
+# run on the fast path via the [Gpad, Npad] presence carry (round 4)
+# --------------------------------------------------------------------------
+
+from tpusim.api.snapshot import make_pod_volume, make_pv, make_pvc  # noqa: E402
+from tpusim.api.types import (  # noqa: E402
+    LABEL_ZONE_FAILURE_DOMAIN,
+    ContainerPort,
+    Service,
+)
+
+
+def _service(name, selector, namespace="default"):
+    return Service.from_obj(
+        {"metadata": {"name": name, "namespace": namespace},
+         "spec": {"selector": selector}})
+
+
+def _port_pod(name, port, **kw):
+    p = make_pod(name, milli_cpu=100, **kw)
+    p.spec.containers[0].ports = [ContainerPort.from_obj(
+        {"containerPort": port, "hostPort": port})]
+    return p
+
+
+def test_host_ports_parity_and_exhaustion():
+    nodes = [make_node(f"n{i}") for i in range(3)]
+    pods = [_port_pod(f"p{i}", 8080) for i in range(6)] \
+        + [_port_pod("other", 9090)]
+    choices = _diff(ClusterSnapshot(nodes=nodes), pods)
+    # one 8080 pod per node, then port-exhausted; 9090 still fits
+    assert int((choices >= 0).sum()) == 4
+    assert choices[-1] >= 0
+
+
+def test_host_ports_seeded_presence():
+    """Running pods' port occupancy must block from the very first pod."""
+    nodes = [make_node(f"n{i}") for i in range(2)]
+    seeded = _port_pod("seed", 8080, node_name="n0", phase="Running")
+    pods = [_port_pod(f"p{i}", 8080) for i in range(2)]
+    choices = _diff(ClusterSnapshot(nodes=nodes, pods=[seeded]), pods)
+    assert int((choices >= 0).sum()) == 1  # only n1 is free
+
+
+def test_disk_conflict_parity():
+    # RBD (not GCE PD/EBS): NoDiskConflict covers it while the maxpd
+    # volume-count predicates — still a fast-path fallback — do not
+    nodes = [make_node(f"n{i}") for i in range(2)]
+    vol = [make_pod_volume("v", {"rbd": {"monitors": ["a", "b"],
+                                         "pool": "test", "image": "bar"}})]
+    pods = [make_pod(f"p{i}", milli_cpu=100, volumes=vol) for i in range(4)]
+    choices = _diff(ClusterSnapshot(nodes=nodes), pods)
+    # the same RBD image cannot mount read-write on two pods per node
+    assert int((choices >= 0).sum()) == 2
+
+
+def test_selector_spread_parity_plain_and_zones():
+    nodes = [make_node(f"n{i}", labels={
+        LABEL_ZONE_FAILURE_DOMAIN: f"z{i % 2}"}) for i in range(4)]
+    nodes.append(make_node("n-nozone"))
+    existing = [make_pod(f"e{i}", node_name=f"n{i % 2}", phase="Running",
+                         labels={"app": "api"}) for i in range(3)]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing,
+                           services=[_service("api", {"app": "api"})])
+    pods = [make_pod(f"p{i}", milli_cpu=10, labels={"app": "api"})
+            for i in range(8)]
+    choices = _diff(snap, pods)
+    assert int((choices >= 0).sum()) == 8
+
+
+def test_volume_zone_parity():
+    nodes = [make_node(f"n{i}", labels={
+        LABEL_ZONE_FAILURE_DOMAIN: f"z{i % 2}"}) for i in range(4)]
+    pvs = [make_pv("pv-a", labels={LABEL_ZONE_FAILURE_DOMAIN: "z0"})]
+    pvcs = [make_pvc("claim-a", volume_name="pv-a")]
+    pods = [make_pod(f"p{i}", milli_cpu=10,
+                     volumes=[make_pod_volume("v", pvc="claim-a")])
+            for i in range(3)]
+    snap = ClusterSnapshot(nodes=nodes, pvs=pvs, pvcs=pvcs)
+    choices = _diff(snap, pods)
+    # all pods pinned to z0 nodes (n0, n2) by the bound PV's zone label
+    assert all(int(c) % 2 == 0 for c in choices if c >= 0)
+    assert int((choices >= 0).sum()) == 3
+
+
+def test_all_group_features_combined_parity():
+    """Ports + spreading + disk conflicts + volume zones in ONE workload,
+    byte-identical to the XLA scan (choices, counts, rr advancement)."""
+    rng = np.random.RandomState(7)
+    nodes = [make_node(f"n{i}", milli_cpu=2000, memory=4 * 1024**3,
+                       labels={LABEL_ZONE_FAILURE_DOMAIN: f"z{i % 2}"})
+             for i in range(8)]
+    pvs = [make_pv("pv-z", labels={LABEL_ZONE_FAILURE_DOMAIN: "z1"})]
+    pvcs = [make_pvc("claim-z", volume_name="pv-z")]
+    existing = [make_pod(f"e{i}", node_name=f"n{i % 3}", phase="Running",
+                         labels={"app": "web"}) for i in range(4)]
+    svc = [_service("web", {"app": "web"})]
+    vol = [make_pod_volume("d", {"rbd": {"monitors": ["m"],
+                                         "pool": "p", "image": "x"}})]
+    pods = []
+    for i in range(40):
+        kw = {}
+        if i % 3 == 0:
+            kw["labels"] = {"app": "web"}
+        if i % 7 == 0:
+            kw["volumes"] = [make_pod_volume("v", pvc="claim-z")]
+        elif i % 5 == 0:
+            kw["volumes"] = vol
+        p = make_pod(f"p{i}", milli_cpu=int(rng.randint(1, 6)) * 100,
+                     memory=int(rng.randint(1, 8)) * 2**26, **kw)
+        if i % 4 == 0:
+            p.spec.containers[0].ports = [ContainerPort.from_obj(
+                {"containerPort": 80, "hostPort": 8000 + (i % 2)})]
+        pods.append(p)
+    snap = ClusterSnapshot(nodes=nodes, pods=existing, services=svc,
+                           pvs=pvs, pvcs=pvcs)
+    choices = _diff(snap, pods)
+    assert 0 < int((choices >= 0).sum()) <= len(pods)
+
+
+def test_group_budget_falls_back(monkeypatch):
+    monkeypatch.setenv("TPUSIM_FAST_MAX_GROUPS", "2")
+    nodes = [make_node("n0")]
+    pods = [_port_pod(f"p{i}", 8000 + i) for i in range(4)]
+    compiled, cols = compile_cluster(ClusterSnapshot(nodes=nodes), pods)
+    config = config_for([compiled], most_requested=False,
+                        num_reason_bits=NUM_FIXED_BITS)
+    plan, reason = plan_fast(config, compiled, cols)
+    assert plan is None
+    assert "unrolled-loop budget" in reason

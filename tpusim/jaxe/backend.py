@@ -59,7 +59,9 @@ def _fast_path_enabled() -> tuple[bool, bool]:
     """Returns (enabled, verify).
 
     TPUSIM_FAST=1 forces the Pallas fused-scan fast path (jaxe.fastscan) on
-    for eligible group-free workloads, =0 forces it off. Unset = AUTO: on
+    for eligible workloads (group-free, plus ports/disk-conflict/spreading/
+    volume-zone group features within the fast-path budgets), =0 forces it
+    off. Unset = AUTO: on
     TPU the fast path is default-ON with first-chunk self-verification —
     before trusting a process's first fast run, the backend re-runs the
     leading pods through the XLA scan and compares choices bit-for-bit,

@@ -13,9 +13,16 @@ Semantics are IDENTICAL to the XLA path for eligible workloads (differential
 tests drive both); ineligible workloads fall back to `schedule_scan`.
 
 Eligibility (checked by `plan_fast`, reasons returned):
-  * no pod-group features — host ports, services/spreading, inter-pod
-    (anti)affinity, volume predicates (`EngineConfig.has_*` all False), no
-    policy, no ServiceAffinity;
+  * pod-group features run natively through a [Gpad, Npad] presence carry
+    (round 4): host ports, NoDiskConflict, services/SelectorSpreadPriority
+    (incl. the zone blend), and NoVolumeZoneConflict — per-pod group rows
+    stream through SMEM and all group state is accessed via statically
+    -unrolled loops over Gpad with (g == gid)-masked row ops (no dynamic
+    indexing; Mosaic-safe). Bounded by TPUSIM_FAST_MAX_GROUPS (16) merged
+    groups / TPUSIM_FAST_MAX_ZONES (16) zone domains, and the spread
+    blend's int32 product bound. Still host/XLA-bound: inter-pod
+    (anti)affinity ([G,K,D] topo state), maxpd volume counts ([N,V]
+    union), policies, ServiceAffinity;
   * every resource quantity reduces exactly to int32: values are divided by
     the per-axis gcd (exact — fractions and fit comparisons are
     unit-invariant) and the reduced values must stay under 2^29, with the
@@ -70,8 +77,10 @@ from tpusim.jaxe.kernels import (
     EngineConfig,
 )
 from tpusim.jaxe.state import (
+    BIT_DISK_CONFLICT,
     BIT_DISK_PRESSURE,
     BIT_HOSTNAME_MISMATCH,
+    BIT_HOST_PORTS,
     BIT_INSUFFICIENT_CPU,
     BIT_INSUFFICIENT_EPHEMERAL,
     BIT_INSUFFICIENT_GPU,
@@ -80,6 +89,7 @@ from tpusim.jaxe.state import (
     BIT_MEMORY_PRESSURE,
     BIT_NODE_SELECTOR_MISMATCH,
     BIT_TAINTS_NOT_TOLERATED,
+    BIT_VOLUME_ZONE_CONFLICT,
 )
 
 INT_LIMIT = 1 << 29          # per-value bound after gcd reduction
@@ -139,6 +149,25 @@ class FastPlan:
     alloc_scalar: Optional[np.ndarray] = None   # [Srows, Npad]
     used_scalar: Optional[np.ndarray] = None    # [Srows, Npad] init carry
     req_scalar: Optional[np.ndarray] = None     # [P, S]; chunks pad to LANES
+    # pod-group features (num_groups == 0 -> group-free kernel). Presence is
+    # a [Gpad, Npad] int32 carry; per-pod group rows are SMEM-streamed
+    # scalars read in a statically-unrolled loop over Gpad (no dynamic
+    # indexing — Mosaic-safe), and the bind updates presence via
+    # (g == gid) masked whole-row adds.
+    num_groups: int = 0          # Gpad (sublane-padded merged group count)
+    has_ports: bool = False
+    has_disk: bool = False
+    has_spread: bool = False
+    has_vol_zone: bool = False
+    presence: Optional[np.ndarray] = None    # [Gpad, Npad] int32 init carry
+    gid: Optional[np.ndarray] = None         # [P] int32 merged group id
+    port_row: Optional[np.ndarray] = None    # [P, Gpad] int32 0/1 conflicts
+    disk_row: Optional[np.ndarray] = None    # [P, Gpad] int32 0/1 conflicts
+    ss_row: Optional[np.ndarray] = None      # [P, Gpad] int32 0/1 spread set
+    zone_ok_tbl: Optional[np.ndarray] = None  # [G, Npad] int32 0/1 by gid
+    zone_onehot: Optional[np.ndarray] = None  # [Zpad, Npad] int32; row 0 =
+    #                                           the unlabeled dom-0 sentinel
+    n_zone_doms: int = 0         # Zpad (sublane-padded)
 
 
 def _gcd_reduce(arrays) -> Tuple[int, list]:
@@ -157,10 +186,34 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     """Build the int32 plan, or (None, reason) when ineligible."""
     if config.policy is not None:
         return None, "policy configured"
-    for flag in ("has_ports", "has_services", "has_interpod",
-                 "has_disk_conflict", "has_maxpd", "has_vol_zone"):
+    # interpod carries [G, K, D] topo-domain state and maxpd a [N, V] volume
+    # union — both beyond the kernel's presence model; everything else
+    # group-bound (ports, disk conflicts, spreading, volume zones) runs via
+    # the [Gpad, Npad] presence carry when the group count fits the
+    # unrolled-loop budget
+    for flag in ("has_interpod", "has_maxpd"):
         if getattr(config, flag):
             return None, f"pod-group feature {flag}"
+    gt = compiled.groups
+    group_bound = (config.has_ports or config.has_services
+                   or config.has_disk_conflict or config.has_vol_zone)
+    # presence is only read by ports/disk/spread; a vol-zone-only workload
+    # streams per-pod zone rows (gathered by group id from an HBM table)
+    # and needs neither the presence carry nor the unrolled-loop budget
+    needs_presence = (config.has_ports or config.has_services
+                      or config.has_disk_conflict)
+    num_g = int(gt.presence.shape[0]) if group_bound else 0
+    if needs_presence:
+        max_g = int(os.environ.get("TPUSIM_FAST_MAX_GROUPS", 16))
+        if num_g > max_g:
+            return None, (f"{num_g} pod groups exceed the fast-path "
+                          f"unrolled-loop budget ({max_g}; "
+                          "TPUSIM_FAST_MAX_GROUPS)")
+        if config.has_services:
+            max_z = int(os.environ.get("TPUSIM_FAST_MAX_ZONES", 16))
+            if config.n_zone_doms > max_z:
+                return None, (f"{config.n_zone_doms} zone domains exceed "
+                              f"the fast-path budget ({max_z})")
     n_scal = len(compiled.scalar_names)
     if NUM_FIXED_BITS + n_scal > PAD_SENTINEL_BIT:
         return None, (f"{n_scal} scalar resource kinds exceed the int32 "
@@ -214,6 +267,38 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     n = len(np.asarray(s.alloc_cpu))
     npad = -(-max(n, 1) // LANES) * LANES
 
+    gpad = zpad = 0
+    if needs_presence:
+        gpad = max(-(-num_g // SUBLANES) * SUBLANES, SUBLANES)
+    if group_bound:
+        # SelectorSpreadPriority's zone blend multiplies per-node by
+        # per-zone counts: bound both from the seeded presence plus the
+        # worst case every remaining slot fills with matched pods, and
+        # require the blend products to fit int32 (exactness contract)
+        if config.has_services:
+            col_tot = gt.presence.sum(axis=0).astype(np.int64)  # [N]
+            allowed_pods_max = int(np.max(s.allowed_pods, initial=0))
+            bound_node = int(col_tot.max(initial=0)) + allowed_pods_max
+            zd = np.asarray(gt.zone_dom, dtype=np.int64)
+            bound_zone = 1
+            for dom in np.unique(zd):
+                if dom == 0:
+                    # the unlabeled dom-0 sentinel never enters a zone
+                    # product (the kernel loops z >= 1; the XLA path zeroes
+                    # zcnt[0]) — including it would spuriously reject
+                    # zone-label-free clusters at scale
+                    continue
+                in_dom = zd == dom
+                bound_zone = max(bound_zone,
+                                 int(col_tot[in_dom].sum())
+                                 + int(in_dom.sum()) * allowed_pods_max)
+            if 3 * MAX_PRIORITY * bound_node * bound_zone >= (1 << 31):
+                return None, ("spread zone-blend products exceed int32 "
+                              f"(node bound {bound_node} x zone bound "
+                              f"{bound_zone})")
+            zpad = max(-(-config.n_zone_doms // SUBLANES) * SUBLANES,
+                       SUBLANES)
+
     def node_row(a, fill=0):
         a = np.asarray(a, dtype=np.int64).astype(np.int32)
         out = np.full((1, npad), fill, dtype=np.int32)
@@ -247,6 +332,40 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
             used_scalar[si, :n] = u_s.astype(np.int32)
             req_scalar[:, si] = r_s.astype(np.int32)
 
+    presence = gid = port_row = disk_row = ss_row = None
+    zone_ok_tbl = zone_onehot = None
+    if group_bound:
+        gid = pods(cols.group_id)
+    if needs_presence:
+        presence = np.zeros((gpad, npad), dtype=np.int32)
+        presence[:num_g, :n] = gt.presence.astype(np.int32)
+
+        def per_pod_grow(row_of_group):
+            # row_of_group [G, G] -> per-pod [P, Gpad] int32 0/1
+            out = np.zeros((len(gid), gpad), dtype=np.int32)
+            out[:, :num_g] = row_of_group[gid]
+            return out
+
+        if config.has_ports:
+            # conflict of MY port set vs each group's port set
+            # (kernels._evaluate: port_conflict[port_sig[g]][port_sig])
+            port_row = per_pod_grow(
+                gt.port_conflict[gt.port_sig][:, gt.port_sig]
+                .astype(np.int32))
+        if config.has_disk_conflict:
+            disk_row = per_pod_grow(
+                gt.disk_conflict[gt.disk_sig][:, gt.disk_sig]
+                .astype(np.int32))
+        if config.has_services:
+            ss_row = per_pod_grow(
+                gt.ss_rows[gt.ss_sig].astype(np.int32))
+            zone_onehot = np.zeros((zpad, npad), dtype=np.int32)
+            zd = np.asarray(gt.zone_dom, dtype=np.int64)
+            for z in range(config.n_zone_doms):
+                zone_onehot[z, :n] = (zd == z).astype(np.int32)
+    if config.has_vol_zone:
+        zone_ok_tbl = table_rows(gt.zone_ok, fill=0)
+
     plan = FastPlan(
         num_nodes=n, num_pods=len(np.asarray(cols.req_cpu)),
         most_requested=config.most_requested, num_scalars=n_scal,
@@ -275,6 +394,12 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
         sel_id=pods(cols.sel_id), tol_id=pods(cols.tol_id),
         aff_id=pods(cols.aff_id), avoid_id=pods(cols.avoid_id),
         host_id=pods(cols.host_id),
+        num_groups=gpad, has_ports=config.has_ports,
+        has_disk=config.has_disk_conflict,
+        has_spread=config.has_services, has_vol_zone=config.has_vol_zone,
+        presence=presence, gid=gid, port_row=port_row, disk_row=disk_row,
+        ss_row=ss_row, zone_ok_tbl=zone_ok_tbl, zone_onehot=zone_onehot,
+        n_zone_doms=zpad if config.has_services else 0,
     )
     return plan, ""
 
@@ -285,7 +410,9 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
 
 
 def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
-                 group: int):
+                 group: int, gpad: int = 0, zpad: int = 0,
+                 has_ports: bool = False, has_disk: bool = False,
+                 has_spread: bool = False, has_vol_zone: bool = False):
     """Kernel body for one grid step of `group` consecutive pods.
 
     Mosaic requires the sublane (second-to-last) block dim to be a multiple
@@ -294,7 +421,13 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
     per-pod step `group` times (carry reads re-load the output refs, so pod
     j sees pod j-1's bind). Binds are masked whole-row vector updates — a
     one-hot (1,Npad) `pick` row — rather than dynamic-lane scalar stores,
-    which Mosaic does not lower."""
+    which Mosaic does not lower.
+
+    gpad > 0 enables the pod-group path: a [Gpad, Npad] presence carry, per
+    -pod SMEM group rows (conflict/spread flags vs each group), and all
+    group state access via statically-unrolled loops over Gpad with
+    (g == gid)-masked row ops — no dynamic indexing anywhere."""
+    group_bound = gpad > 0
 
     def kernel(*refs):
         (rc_r, rm_r, rg_r, re_r, nzc_r, nzm_r, zr_r, be_r,
@@ -306,10 +439,36 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
         if num_scalars:
             rs_r, ascal_r, ius_r = refs[at:at + 3]
             at += 3
+        if has_vol_zone:
+            # vol-zone only needs per-pod static rows, not the presence
+            # carry (kernels.py skips presence updates for it too)
+            vz_r = refs[at]
+            at += 1
+        if group_bound:
+            gid_r = refs[at]
+            at += 1
+            if has_spread:
+                zoh_r = refs[at]
+                at += 1
+            ipres_r = refs[at]
+            at += 1
+            if has_ports:
+                prow_r = refs[at]
+                at += 1
+            if has_disk:
+                drow_r = refs[at]
+                at += 1
+            if has_spread:
+                ssrow_r = refs[at]
+                at += 1
         (ouc_r, oum_r, oug_r, oue_r, onzc_r, onzm_r, opc_r, omisc_r,
          choice_r, counts_r, adv_r) = refs[at:at + 11]
+        at += 11
         if num_scalars:
-            ous_r = refs[at + 11]
+            ous_r = refs[at]
+            at += 1
+        if group_bound:
+            opres_r = refs[at]
         p = pl.program_id(0)
 
         @pl.when(p == 0)
@@ -324,6 +483,8 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
             omisc_r[:] = imisc_r[:]
             if num_scalars:
                 ous_r[:] = ius_r[:]
+            if group_bound:
+                opres_r[:] = ipres_r[:]
 
         acpu = acpu_r[:]
         amem = amem_r[:]
@@ -387,20 +548,52 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 | sel_bad.astype(jnp.int32) << BIT_NODE_SELECTOR_MISMATCH)
             if scalar_bits is not None:
                 bits_general = bits_general | scalar_bits
+            if group_bound:
+                gid_s = gid_r[j, 0]
+                pres_rows = [opres_r[g2:g2 + 1, :] for g2 in range(gpad)]
+            if has_ports:
+                # PodFitsHostPorts (predicates.go:1019-1039), part of
+                # GeneralPredicates: my port set conflicts with the port
+                # set of any group present on the node
+                port_bad = fail_cond & False
+                for g2 in range(gpad):
+                    port_bad = port_bad | jnp.where(
+                        prow_r[j, g2] != 0, pres_rows[g2] > 0, False)
+                fail_general = fail_general | port_bad
+                bits_general = bits_general | (
+                    port_bad.astype(jnp.int32) << BIT_HOST_PORTS)
             fail_taint = tol_r[j:j + 1, :] == 0
             fail_mem_pr = mpr & best_effort
             fail_disk_pr = dpr_fail
 
-            feasible = ~(fail_cond | fail_general | fail_taint | fail_mem_pr
-                         | fail_disk_pr)
             # short-circuit reason selection: first failing stage wins
+            # (ordering: cond -> general -> NoDiskConflict -> taints ->
+            # NoVolumeZoneConflict -> memory pressure -> disk pressure,
+            # matching predicatesOrdering in kernels._evaluate)
+            stages = [(fail_cond, cond), (fail_general, bits_general)]
+            if has_disk:
+                # NoDiskConflict (predicates.go:266-276): my volume set
+                # conflicts with the volume set of any group present
+                fail_disk = fail_cond & False
+                for g2 in range(gpad):
+                    fail_disk = fail_disk | jnp.where(
+                        drow_r[j, g2] != 0, pres_rows[g2] > 0, False)
+                stages.append(
+                    (fail_disk, jnp.int32(1) << BIT_DISK_CONFLICT))
+            stages.append(
+                (fail_taint, jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED))
+            if has_vol_zone:
+                # NoVolumeZoneConflict (predicates.go:510-533): static per
+                # (volume-set, node) row, pregathered per pod
+                fail_vz = vz_r[j:j + 1, :] == 0
+                stages.append(
+                    (fail_vz, jnp.int32(1) << BIT_VOLUME_ZONE_CONFLICT))
+            stages += [(fail_mem_pr, jnp.int32(1) << BIT_MEMORY_PRESSURE),
+                       (fail_disk_pr, jnp.int32(1) << BIT_DISK_PRESSURE)]
+            feasible = jnp.ones_like(fail_cond)
             reason = jnp.zeros_like(cond)
-            stages = ((fail_cond, cond),
-                      (fail_general, bits_general),
-                      (fail_taint, jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED),
-                      (fail_mem_pr, jnp.int32(1) << BIT_MEMORY_PRESSURE),
-                      (fail_disk_pr, jnp.int32(1) << BIT_DISK_PRESSURE))
             for fail, bits in reversed(stages):
+                feasible = feasible & ~fail
                 reason = jnp.where(fail, bits, reason)
             n_feasible = jnp.sum(feasible.astype(jnp.int32), dtype=jnp.int32)
             found = n_feasible > 0
@@ -439,6 +632,36 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
                 MAX_PRIORITY)
             score = score + av_r[j:j + 1, :] * AVOID_PODS_WEIGHT
+            if has_spread:
+                # SelectorSpreadPriority (selector_spreading.go:66-175):
+                # per-node count of pods matched by my services' selectors
+                # (groups flagged in my ss row), node/zone-blended exact
+                # normalize — int32 products bounded by plan_fast's gate
+                cnt = jnp.zeros_like(score)
+                for g2 in range(gpad):
+                    cnt = cnt + jnp.where(
+                        ssrow_r[j, g2] != 0, pres_rows[g2], 0)
+                fcnt = jnp.where(feasible, cnt, 0)
+                max_node = jnp.max(fcnt)
+                row0 = zoh_r[0:1, :]
+                zvalid = row0 == 0  # rows 1.. are real zone domains
+                zper = jnp.zeros_like(cnt)
+                max_zone = jnp.int32(0)
+                for z in range(1, zpad):
+                    zrow = zoh_r[z:z + 1, :]
+                    zc = jnp.sum(zrow * fcnt, dtype=jnp.int32)
+                    zper = zper + zc * zrow
+                    max_zone = jnp.maximum(max_zone, zc)
+                have_zones = jnp.any(feasible & zvalid)
+                node_num = jnp.where(max_node > 0, max_node - cnt, 1)
+                node_den = jnp.maximum(max_node, 1)
+                zone_num = jnp.where(max_zone > 0, max_zone - zper, 1)
+                zone_den = jnp.maximum(max_zone, 1)
+                plain = (MAX_PRIORITY * node_num) // node_den
+                blend = (MAX_PRIORITY
+                         * (node_num * zone_den + 2 * zone_num * node_den)
+                         ) // (3 * node_den * zone_den)
+                score = score + jnp.where(have_zones & zvalid, blend, plain)
 
             # ---- selectHost: stable-desc argmax + round-robin tie pick ----
             masked = jnp.where(feasible, score, -1)
@@ -476,6 +699,12 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                     ous_r[si:si + 1, :] = jnp.where(
                         pick, us[si:si + 1, :] + rs_r[j, si],
                         us[si:si + 1, :])
+            if group_bound:
+                # presence[gid, choice] += 1 via (g == gid)-masked row adds
+                pick_i = pick.astype(jnp.int32)
+                for g2 in range(gpad):
+                    opres_r[g2:g2 + 1, :] = jnp.where(
+                        gid_s == g2, pres_rows[g2] + pick_i, pres_rows[g2])
 
             omisc_r[0, 0] = rr + (n_feasible > 1).astype(jnp.int32)
 
@@ -484,21 +713,29 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
 
 @lru_cache(maxsize=16)
 def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
-                counts_w: int, num_scalars: int, srows: int, interpret: bool):
-    """jitted pallas_call for one (node-pad, chunk, scalar) shape.
+                counts_w: int, num_scalars: int, srows: int, interpret: bool,
+                gpad: int = 0, zpad: int = 0, has_ports: bool = False,
+                has_disk: bool = False, has_spread: bool = False,
+                has_vol_zone: bool = False):
+    """jitted pallas_call for one (node-pad, chunk, scalar, group) shape.
 
     k must be a multiple of SUBLANES: Mosaic rejects blocks whose sublane
     dim is neither a multiple of 8 nor the whole axis, so per-pod operands
     move in (SUBLANES, …) blocks and the grid covers k/SUBLANES steps of
     SUBLANES statically-unrolled pods each."""
     assert k % SUBLANES == 0, k
-    kernel = _make_kernel(most_requested, num_bits, num_scalars, SUBLANES)
+    group_bound = gpad > 0
+    kernel = _make_kernel(most_requested, num_bits, num_scalars, SUBLANES,
+                          gpad, zpad, has_ports, has_disk, has_spread,
+                          has_vol_zone)
 
-    def smem_scalar():
-        return pl.BlockSpec((SUBLANES, 1), lambda p: (p, 0),
+    def smem_rows(width=1):
+        return pl.BlockSpec((SUBLANES, width), lambda p: (p, 0),
                             memory_space=_SMEM) \
-            if _SMEM is not None else pl.BlockSpec((SUBLANES, 1),
+            if _SMEM is not None else pl.BlockSpec((SUBLANES, width),
                                                    lambda p: (p, 0))
+
+    smem_scalar = smem_rows
 
     def row_per_pod(width=None):
         kw = {"memory_space": _VMEM} if _VMEM is not None else {}
@@ -513,6 +750,24 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
                   const_row(rows=srows)]         # init used_scalar
                  if num_scalars else [])
     scalar_out = [const_row(rows=srows)] if num_scalars else []
+    # group inputs (order mirrors the kernel's unpack): [zone rows], gid,
+    # [zone onehot], presence init, [port rows], [disk rows], [spread rows]
+    group_in = []
+    group_out = []
+    if has_vol_zone:
+        group_in.append(row_per_pod())                 # zone_ok rows
+    if group_bound:
+        group_in.append(smem_scalar())                 # gid
+        if has_spread:
+            group_in.append(const_row(rows=zpad))      # zone onehot
+        group_in.append(const_row(rows=gpad))          # presence init
+        if has_ports:
+            group_in.append(smem_rows(gpad))           # port conflict rows
+        if has_disk:
+            group_in.append(smem_rows(gpad))           # disk conflict rows
+        if has_spread:
+            group_in.append(smem_rows(gpad))           # spread-set rows
+        group_out.append(const_row(rows=gpad))         # presence out
     grid_spec = pl.GridSpec(
         grid=(k // SUBLANES,),
         in_specs=(
@@ -522,6 +777,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
             + [const_row() for _ in range(7)]           # init carry
             + [const_row(LANES)]                        # init misc (rr)
             + scalar_in
+            + group_in
         ),
         out_specs=(
             [const_row() for _ in range(7)]             # carry out
@@ -533,6 +789,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
             + [pl.BlockSpec((SUBLANES, 1), lambda p: (p, 0),
                             **({"memory_space": _VMEM} if _VMEM else {}))]
             + scalar_out
+            + group_out
         ),
     )
     i32 = jnp.int32
@@ -543,6 +800,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
            jax.ShapeDtypeStruct((k, counts_w), i32),
            jax.ShapeDtypeStruct((k, 1), i32)]
         + ([jax.ShapeDtypeStruct((srows, npad), i32)] if num_scalars else [])
+        + ([jax.ShapeDtypeStruct((gpad, npad), i32)] if group_bound else [])
     )
     call = pl.pallas_call(kernel, grid_spec=grid_spec,
                           out_shape=out_shape, interpret=interpret)
@@ -576,8 +834,11 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     # tail rows ride the existing GHOST_REQ padding (infeasible everywhere,
     # no carry/rr effect)
     k = -(-min(chunk, max(p, 1)) // SUBLANES) * SUBLANES
+    gpad = plan.num_groups
     call = _build_call(npad, k, plan.most_requested, num_bits, counts_w,
-                       plan.num_scalars, srows, interpret)
+                       plan.num_scalars, srows, interpret,
+                       gpad, plan.n_zone_doms, plan.has_ports,
+                       plan.has_disk, plan.has_spread, plan.has_vol_zone)
 
     statics = [jnp.asarray(a) for a in (
         plan.alloc_cpu, plan.alloc_mem, plan.alloc_gpu, plan.alloc_eph,
@@ -592,11 +853,23 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     if plan.num_scalars:
         ascal = jnp.asarray(plan.alloc_scalar)
         scal_carry = jnp.asarray(plan.used_scalar)
+    if gpad:
+        pres_carry = jnp.asarray(plan.presence)
+        zone_oh = (jnp.asarray(plan.zone_onehot)
+                   if plan.has_spread else None)
+    zone_tbl = (jnp.asarray(plan.zone_ok_tbl)
+                if plan.has_vol_zone else None)
 
     def col(a, fill):
         out = np.full(k, fill, dtype=np.int32)
         out[:a.shape[0]] = a
         return out.reshape(k, 1)
+
+    def grow(a):
+        # per-pod [*, Gpad] group rows for one chunk; ghost rows all-zero
+        out = np.zeros((k, gpad), dtype=np.int32)
+        out[:a.shape[0]] = a
+        return out
 
     # Dispatch chunks ahead of fetching their per-pod outputs: the carry
     # chains device-to-device (out[:7] feed the next call unmaterialized),
@@ -646,11 +919,30 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
             rs = np.zeros((k, LANES), dtype=np.int32)
             rs[:sl.stop - sl.start, :plan.num_scalars] = plan.req_scalar[sl]
             args += [jnp.asarray(rs), ascal, scal_carry]
+        if gpad or plan.has_vol_zone:
+            gids = col(plan.gid[sl], 0)
+        if plan.has_vol_zone:
+            args.append(zone_tbl[gids[:, 0]])
+        if gpad:
+            args.append(jnp.asarray(gids))
+            if plan.has_spread:
+                args.append(zone_oh)
+            args.append(pres_carry)
+            if plan.has_ports:
+                args.append(jnp.asarray(grow(plan.port_row[sl])))
+            if plan.has_disk:
+                args.append(jnp.asarray(grow(plan.disk_row[sl])))
+            if plan.has_spread:
+                args.append(jnp.asarray(grow(plan.ss_row[sl])))
         out = call(*args)
         carry = list(out[:7])
         misc = out[7]
+        oat = 11
         if plan.num_scalars:
-            scal_carry = out[11]
+            scal_carry = out[oat]
+            oat += 1
+        if gpad:
+            pres_carry = out[oat]
         pending.append((out[8], out[9], out[10], sl.stop - sl.start))
         if sync_every and len(pending) > sync_every:
             drain_one()

@@ -455,6 +455,79 @@ def test_all_group_features_combined_parity():
     assert 0 < int((choices >= 0).sum()) <= len(pods)
 
 
+def test_fuzz_group_fast_path_parity():
+    """Randomized mixed group workloads (ports + services/zones + RBD disk
+    conflicts + PVC volume zones + plain pods) through plan_fast/fast_scan
+    vs the XLA scan, bit-for-bit. TPUSIM_FUZZ_SEEDS scales the sweep."""
+    import os
+    import random
+
+    seeds = max(int(os.environ.get("TPUSIM_FUZZ_SEEDS", "3")), 1)
+    skipped = 0
+    for seed in range(min(seeds, 25)):
+        rng = random.Random(9000 + seed)
+        n_nodes = rng.randint(4, 10)
+        nodes = []
+        for i in range(n_nodes):
+            labels = {}
+            if rng.random() < 0.7:
+                labels[LABEL_ZONE_FAILURE_DOMAIN] = f"z{i % 3}"
+            nodes.append(make_node(
+                f"n{i}", milli_cpu=rng.choice([1000, 2000, 4000]),
+                memory=rng.choice([2, 4, 8]) * 1024**3,
+                pods=rng.choice([5, 20, 110]), labels=labels or None))
+        pvs = [make_pv("pv-z", labels={LABEL_ZONE_FAILURE_DOMAIN: "z1"})]
+        pvcs = [make_pvc("claim-z", volume_name="pv-z")]
+        services = [_service("s0", {"app": "a0"}),
+                    _service("s1", {"app": "a1"})]
+        existing = [make_pod(f"e{i}", node_name=f"n{i % n_nodes}",
+                             phase="Running",
+                             labels={"app": f"a{i % 2}"})
+                    for i in range(rng.randint(0, 5))]
+        pods = []
+        for i in range(rng.randint(15, 35)):
+            kw = {}
+            if rng.random() < 0.5:
+                kw["labels"] = {"app": f"a{rng.randrange(3)}"}
+            r = rng.random()
+            if r < 0.15:
+                kw["volumes"] = [make_pod_volume("v", pvc="claim-z")]
+            elif r < 0.3:
+                kw["volumes"] = [make_pod_volume(
+                    "d", {"rbd": {"monitors": ["m"], "pool": "p",
+                                  "image": f"img{rng.randrange(2)}"}})]
+            p = make_pod(f"p{i}", milli_cpu=rng.randrange(1, 12) * 100,
+                         memory=rng.randrange(1, 12) * 2**26, **kw)
+            if rng.random() < 0.4:
+                p.spec.containers[0].ports = [ContainerPort.from_obj(
+                    {"containerPort": 80,
+                     "hostPort": rng.choice([8080, 9090])})]
+            pods.append(p)
+        snap = ClusterSnapshot(nodes=nodes, pods=existing,
+                               services=services, pvs=pvs, pvcs=pvcs)
+        compiled, cols = compile_cluster(snap, pods)
+        assert not compiled.unsupported, compiled.unsupported
+        config = config_for(
+            [compiled], most_requested=bool(rng.getrandbits(1)),
+            num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+        plan, reason = plan_fast(config, compiled, cols)
+        if plan is None:
+            # budget rejections are legitimate (e.g. many merged groups);
+            # they must never be wrong-answer escapes, so count them
+            skipped += 1
+            continue
+        _, choices, counts, advanced = schedule_scan(
+            config, carry_init(compiled), statics_to_device(compiled),
+            pod_columns_to_device(cols))
+        f_choices, f_counts, f_adv = fast_scan(plan, chunk=16)
+        assert np.array_equal(f_choices, np.asarray(choices)), f"seed {seed}"
+        assert np.array_equal(f_counts, np.asarray(counts)), f"seed {seed}"
+        assert np.array_equal(f_adv, np.asarray(advanced)), f"seed {seed}"
+    # the sweep must mostly engage the fast path to mean anything
+    assert skipped <= max(1, min(seeds, 25) // 3), \
+        f"{skipped} of {min(seeds, 25)} seeds fell back"
+
+
 def test_group_budget_falls_back(monkeypatch):
     monkeypatch.setenv("TPUSIM_FAST_MAX_GROUPS", "2")
     nodes = [make_node("n0")]

@@ -528,6 +528,42 @@ def test_fuzz_group_fast_path_parity():
         f"{skipped} of {min(seeds, 25)} seeds fell back"
 
 
+def test_fast_path_over_incremental_compile(monkeypatch):
+    """The event-log path hands a cached (CompiledCluster, PodColumns) into
+    JaxBackend.schedule; the fast path must consume that incremental state
+    (updated dynamic columns, presence) identically to a fresh compile."""
+    from tpusim.framework.store import ADDED, DELETED
+    from tpusim.jaxe import fastscan
+    from tpusim.jaxe.delta import IncrementalCluster
+
+    snap = ClusterSnapshot(
+        nodes=[make_node(f"n{i}") for i in range(4)],
+        services=[_service("web", {"app": "web"})])
+    inc = IncrementalCluster(snap)
+    inc.apply(ADDED, make_node("n4"))
+    inc.apply(ADDED, make_pod("placed", milli_cpu=500, node_name="n0",
+                              phase="Running", labels={"app": "web"}))
+    gone = _port_pod("gone", 8080, node_name="n1", phase="Running")
+    inc.apply(ADDED, gone)
+    inc.apply(DELETED, gone)
+    pods = [_port_pod(f"p{i}", 8080,
+                      labels={"app": "web"} if i % 2 == 0 else None)
+            for i in range(6)]
+
+    baseline = inc.schedule([p.copy() for p in pods], fallback="error")
+    monkeypatch.setenv("TPUSIM_FAST", "1")
+    monkeypatch.setenv("TPUSIM_FAST_INTERPRET", "1")
+    runs = []
+    real = fastscan.fast_scan
+    monkeypatch.setattr(fastscan, "fast_scan",
+                        lambda plan, **kw: runs.append(1) or real(plan, **kw))
+    fast = inc.schedule([p.copy() for p in pods], fallback="error")
+    assert runs, "fast path did not engage on the incremental compile"
+    assert _outcomes(fast) == _outcomes(baseline)
+    # port occupancy of the deleted pod must be gone: one 8080 pod per node
+    assert sum(1 for p in fast if p.node_name) == 5
+
+
 def test_group_budget_falls_back(monkeypatch):
     monkeypatch.setenv("TPUSIM_FAST_MAX_GROUPS", "2")
     nodes = [make_node("n0")]

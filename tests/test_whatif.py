@@ -122,6 +122,102 @@ class TestWhatIf:
         assert [r.unschedulable for r in results] == [1, 1]
 
 
+class TestFastLoop:
+    """The Pallas fast loop replaces the vmap(S)xscan(P) program when every
+    scenario is fast-eligible (and the fast path is on); results must be
+    byte-identical to the batched program."""
+
+    def _scenarios(self):
+        # bucketed (gcd-reducible) memory so the int32 narrowing passes —
+        # like the BASELINE workloads; scenario()'s raw random bytes are
+        # deliberately int32-ineligible
+        out = []
+        for seed in range(3):
+            rng = np.random.RandomState(100 + seed)
+            nodes = [make_node(f"f{seed}-n{i}",
+                               milli_cpu=int(rng.choice([2000, 4000])),
+                               memory=int(rng.choice([4, 8])) * 1024**3,
+                               labels={"zone": f"z{i % 3}"})
+                     for i in range(10 + seed)]
+            pods = [make_pod(f"f{seed}-p{i}",
+                             milli_cpu=int(rng.choice([100, 400, 900])),
+                             memory=int(rng.choice([64, 256, 1024]))
+                             * 1024 * 1024,
+                             node_selector=({"zone": f"z{i % 3}"}
+                                            if i % 5 == 0 else None))
+                    for i in range(25)]
+            out.append((ClusterSnapshot(nodes=nodes), pods))
+        return out
+
+    def test_fast_loop_matches_vmap_program(self, monkeypatch):
+        scenarios = self._scenarios()
+        vmap_results = run_what_if(scenarios)
+        from tpusim.jaxe import backend, fastscan
+
+        monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
+        monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+        monkeypatch.setattr(backend, "_fast_path_enabled",
+                            lambda: (True, True))
+        # 25-pod scenarios are real evidence at this threshold
+        monkeypatch.setenv("TPUSIM_FAST_VERIFY_MIN", "16")
+        runs = []
+        real = fastscan.fast_scan
+        monkeypatch.setattr(
+            fastscan, "fast_scan",
+            lambda plan, **kw: runs.append(1) or real(plan, **kw))
+        fast_results = run_what_if(scenarios)
+        assert len(runs) == len(scenarios), "fast loop did not engage"
+        for fr, vr in zip(fast_results, vmap_results):
+            assert placements_key(fr.placements) == \
+                placements_key(vr.placements)
+            assert (fr.scheduled, fr.unschedulable) == \
+                (vr.scheduled, vr.unschedulable)
+        # scenario 0's self-verification pinned process-wide trust
+        assert backend._FAST_AUTO["verified"] is True
+
+    def test_ineligible_scenario_keeps_vmap_program(self, monkeypatch):
+        scenarios = self._scenarios()
+        # make scenario 1 interpod-bound: fast-ineligible
+        snap, pods = scenarios[1]
+        pods[0] = make_pod(
+            "interpod", milli_cpu=100, labels={"app": "a"},
+            affinity={"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "a"}},
+                     "topologyKey": "kubernetes.io/hostname"}]}})
+        from tpusim.jaxe import backend, fastscan
+
+        monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
+        monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+        monkeypatch.setattr(backend, "_fast_path_enabled",
+                            lambda: (True, True))
+        monkeypatch.setattr(
+            fastscan, "fast_scan",
+            lambda plan, **kw: (_ for _ in ()).throw(
+                AssertionError("fast loop must not engage")))
+        results = run_what_if(scenarios)  # falls back to the vmap program
+        assert len(results) == len(scenarios)
+
+    def test_kernel_failure_falls_back_to_vmap(self, monkeypatch):
+        scenarios = self._scenarios()
+        vmap_results = run_what_if(scenarios)
+        from tpusim.jaxe import backend, fastscan
+
+        monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
+        monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+        monkeypatch.setattr(backend, "_fast_path_enabled",
+                            lambda: (True, True))
+        monkeypatch.setattr(
+            fastscan, "fast_scan",
+            lambda plan, **kw: (_ for _ in ()).throw(
+                RuntimeError("mosaic said no")))
+        results = run_what_if(scenarios)
+        assert backend._FAST_AUTO["disabled"] is True
+        for fr, vr in zip(results, vmap_results):
+            assert placements_key(fr.placements) == \
+                placements_key(vr.placements)
+
+
 def test_what_if_with_policy_matches_per_scenario_runs():
     """A batch-wide policy: each scenario's what-if placements equal a
     standalone jax policy run over the same snapshot+pods."""

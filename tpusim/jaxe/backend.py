@@ -55,6 +55,35 @@ log = logging.getLogger(__name__)
 _FAST_AUTO = {"disabled": False, "verified": False}
 
 
+def _auto_verify_and_pin(config, compiled, cols, choices, counts) -> bool:
+    """AUTO-mode guardrail (shared by run_batch and the what-if fast loop):
+    replay the leading pods through the XLA scan and compare bit-for-bit.
+    Returns True when the fast results may be used; on disagreement the
+    fast path is disabled for the process. Trust is pinned process-wide
+    only on a batch of TPUSIM_FAST_VERIFY_MIN+ pods."""
+    import os as _os
+
+    from tpusim.jaxe.fastscan import verify_against_xla
+
+    m = min(int(_os.environ.get("TPUSIM_FAST_VERIFY_PODS", 512)),
+            len(np.asarray(cols.req_cpu)))
+    if not verify_against_xla(config, compiled, cols, choices, counts, m):
+        _FAST_AUTO["disabled"] = True
+        log.warning("pallas fast path DISAGREES with the XLA scan on the "
+                    "first %d pods; disabling it for this process and "
+                    "re-running on the XLA scan", m)
+        return False
+    min_pin = int(_os.environ.get("TPUSIM_FAST_VERIFY_MIN", 64))
+    if m >= min_pin:
+        _FAST_AUTO["verified"] = True
+        log.info("pallas fast path self-verified on the first %d pods; "
+                 "trusting it for this process", m)
+    else:
+        log.info("pallas fast path verified on %d pods (< %d): keeping "
+                 "per-batch verification on", m, min_pin)
+    return True
+
+
 def _fast_path_enabled() -> tuple[bool, bool]:
     """Returns (enabled, verify).
 
@@ -345,51 +374,11 @@ class JaxBackend:
                             type(exc).__name__, exc)
                 _discard_fast_path()
             else:
-                if fast_verify:
-                    # AUTO-mode guardrail (one per process): the kernel may
-                    # lower but miscompile — before trusting it, replay the
-                    # leading pods through the XLA scan and compare both
-                    # placements and reason histograms bit-for-bit
-                    from tpusim.jaxe.kernels import _tree_to_device
-
-                    m = min(int(_os.environ.get(
-                        "TPUSIM_FAST_VERIFY_PODS", 512)), len(pods))
-                    xs_h = pod_columns_to_host(cols)
-                    xs_head = _tree_to_device(
-                        type(xs_h)(*(a[:m] for a in xs_h)))
-                    _, vch, vcnt, _ = schedule_scan(
-                        config, carry_init(compiled),
-                        statics_to_device(compiled), xs_head)
-                    vch = np.asarray(vch)
-                    vcnt = np.asarray(vcnt)
-                    same = (np.array_equal(vch, np.asarray(choices)[:m])
-                            and np.array_equal(vcnt,
-                                               np.asarray(counts)[:m]))
-                    if same:
-                        # pin the process-wide trust only on a batch big
-                        # enough to be real evidence — a tiny first batch
-                        # (or one with few feasible placements) passing
-                        # trivially must not exempt every later batch
-                        # from verification
-                        min_pin = int(_os.environ.get(
-                            "TPUSIM_FAST_VERIFY_MIN", 64))
-                        if m >= min_pin:
-                            _FAST_AUTO["verified"] = True
-                            log.info("pallas fast path self-verified on "
-                                     "the first %d pods; trusting it for "
-                                     "this process", m)
-                        else:
-                            log.info("pallas fast path verified on %d "
-                                     "pods (< %d): keeping per-batch "
-                                     "verification on", m, min_pin)
-                    else:
-                        log.warning(
-                            "pallas fast path DISAGREES with the XLA scan "
-                            "on the first %d pods (%d choice mismatches); "
-                            "disabling it for this process and re-running "
-                            "on the XLA scan", m,
-                            int((vch != np.asarray(choices)[:m]).sum()))
-                        _discard_fast_path()
+                if fast_verify and not _auto_verify_and_pin(
+                        config, compiled, cols, choices, counts):
+                    # the kernel lowered but miscomputed: the guardrail
+                    # already disabled it process-wide; rerun on XLA
+                    _discard_fast_path()
         if fplan is not None:
             pass  # fast path already produced choices/counts
         elif use_chunks:

@@ -807,6 +807,37 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
     return jax.jit(lambda *args: call(*args))
 
 
+def verify_against_xla(config, compiled, cols, choices, counts,
+                       max_pods: int = 512) -> bool:
+    """Replay the first max_pods pods through the XLA scan and compare the
+    kernel's choices AND reason histograms bit-for-bit (the AUTO-mode
+    guardrail shared by JaxBackend and the what-if fast loop). Histogram
+    widths may differ when a what-if batch unifies scalar axes — the
+    common prefix must match and the excess columns must be zero."""
+    from tpusim.jaxe.kernels import (
+        _tree_to_device,
+        carry_init,
+        pod_columns_to_host,
+        schedule_scan,
+        statics_to_device,
+    )
+
+    m = min(max_pods, len(np.asarray(cols.req_cpu)))
+    xs_h = pod_columns_to_host(cols)
+    xs_head = _tree_to_device(type(xs_h)(*(a[:m] for a in xs_h)))
+    _, vch, vcnt, _ = schedule_scan(config, carry_init(compiled),
+                                    statics_to_device(compiled), xs_head)
+    vch = np.asarray(vch)
+    vcnt = np.asarray(vcnt)
+    fch = np.asarray(choices)[:m]
+    fcnt = np.asarray(counts)[:m]
+    if not np.array_equal(vch, fch):
+        return False
+    w = min(vcnt.shape[1], fcnt.shape[1])
+    return (np.array_equal(vcnt[:, :w], fcnt[:, :w])
+            and not vcnt[:, w:].any() and not fcnt[:, w:].any())
+
+
 def fast_scan(plan: FastPlan, chunk: int = 0,
               interpret: Optional[bool] = None, progress=None
               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

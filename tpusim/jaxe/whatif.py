@@ -22,6 +22,7 @@ Shape unification:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
@@ -54,6 +55,8 @@ from tpusim.jaxe.kernels import (
 )
 from tpusim.jaxe.sharding import pad_node_axis, snap_shardings
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
+
+log = logging.getLogger(__name__)
 
 GHOST_CPU = np.int64(1) << 61  # larger than any allocatable: never feasible
 
@@ -145,12 +148,14 @@ def _unify(statics: Statics, carry: Carry, xs: PodX, targets: dict,
 def _prepare_host_batch(scenarios, provider: str,
                         hard_pod_affinity_symmetric_weight: int, policy,
                         n_snap_shards: int, n_node_shards: int):
-    """Compile + shape-unify + pad the batch on host numpy.
+    """Compile the batch on host numpy (shape unification is deferred:
+    `_unify_batch` pads the returned host_trees for the vmap program — the
+    Pallas fast loop consumes the per-scenario compiled state directly and
+    must not pay for padding it would throw away).
 
     Returns (prep, early): `early` is the finished result list when nothing
     needs the device (no scenarios / all zero-node); otherwise `prep` is
-    (config, per_scenario host (carry, statics, xs) tuples padded to the
-    snap-shard multiple, real_count, batch_indices, compiled_list,
+    (config, host_trees, real_count, batch_indices, compiled_list,
     empty_results).
     """
     if provider not in _KNOWN_PROVIDERS:
@@ -249,11 +254,29 @@ def _prepare_host_batch(scenarios, provider: str,
         host_trees.append((host_statics, host_carry,
                            pod_columns_to_host(cols)))
 
-    # common shapes
-    targets = _axis_targets(host_trees)
     s_max = max(len(c.scalar_names) for c, _ in compiled_list)
+    real_count = len(host_trees)
+    config = config_for(
+        [c for c, _ in compiled_list],
+        most_requested=provider in _MOST_REQUESTED_PROVIDERS,
+        num_reason_bits=NUM_FIXED_BITS + s_max,
+        hard_weight=hard_pod_affinity_symmetric_weight)
+    if cp is not None:
+        from dataclasses import replace as _dc_replace
+
+        config = _dc_replace(config, policy=cp.spec, n_saa_doms=n_saa_doms)
+    return (config, host_trees, real_count, batch_indices, compiled_list,
+            empty_results), None
+
+
+def _unify_batch(scenarios, host_trees, batch_indices,
+                 n_snap_shards: int, n_node_shards: int):
+    """Shape-unify + pad the compiled host trees for the batched vmap
+    program; returns per_scenario (carry, statics, xs) tuples padded to
+    the snap-shard multiple."""
+    targets = _axis_targets(host_trees)
     p_max = max(len(scenarios[i][1]) for i in batch_indices)
-    n_max = max(c.statics.alloc_cpu.shape[0] for c, _ in compiled_list)
+    n_max = max(s.alloc_cpu.shape[0] for s, _, _ in host_trees)
     # one pad target: max nodes rounded up to the node-shard multiple
     n_target = -(-n_max // n_node_shards) * n_node_shards
 
@@ -264,21 +287,9 @@ def _prepare_host_batch(scenarios, provider: str,
         per_scenario.append((carry, statics, xs))
 
     # pad the scenario axis to the snap-shard multiple with replicas
-    real_count = len(per_scenario)
     while len(per_scenario) % n_snap_shards != 0:
         per_scenario.append(per_scenario[0])
-
-    config = config_for(
-        [c for c, _ in compiled_list],
-        most_requested=provider in _MOST_REQUESTED_PROVIDERS,
-        num_reason_bits=NUM_FIXED_BITS + s_max,
-        hard_weight=hard_pod_affinity_symmetric_weight)
-    if cp is not None:
-        from dataclasses import replace as _dc_replace
-
-        config = _dc_replace(config, policy=cp.spec, n_saa_doms=n_saa_doms)
-    return (config, per_scenario, real_count, batch_indices, compiled_list,
-            empty_results), None
+    return per_scenario
 
 
 def _stack_host(per_scenario):
@@ -307,6 +318,56 @@ def _decode_batch(scenarios, batch_indices, compiled_list, empty_results,
     return [batch_results[i] for i in range(len(scenarios))]
 
 
+def _try_fast_loop(scenarios, config, batch_indices, compiled_list,
+                   empty_results, real_count):
+    """Run every scenario through the Pallas fast path sequentially;
+    returns the decoded results, or None to fall back to the batched vmap
+    program (ineligible scenario, fast path off/disabled, kernel failure,
+    or a failed AUTO self-verification)."""
+    from tpusim.jaxe.backend import (
+        _FAST_AUTO,
+        _auto_verify_and_pin,
+        _fast_path_enabled,
+    )
+    from tpusim.jaxe.fastscan import fast_scan, plan_fast
+
+    fast_on, fast_verify = _fast_path_enabled()
+    if not fast_on:
+        return None
+    plans = []
+    for b, (compiled, cols) in enumerate(compiled_list):
+        plan, why = plan_fast(config, compiled, cols)
+        if plan is None:
+            log.info("what-if fast loop ineligible (scenario %d: %s); "
+                     "using the batched vmap program", batch_indices[b], why)
+            return None
+        plans.append(plan)
+    choices_list = []
+    counts_list = []
+    for b, plan in enumerate(plans):
+        try:
+            choices, counts, _adv = fast_scan(plan)
+        except Exception as exc:
+            log.warning("what-if fast loop failed (%s: %s); falling back "
+                        "to the batched vmap program",
+                        type(exc).__name__, exc)
+            _FAST_AUTO["disabled"] = True
+            return None
+        if fast_verify and not _FAST_AUTO["verified"]:
+            # every scenario verifies until one is big enough to pin
+            # process-wide trust — a small scenario 0 passing trivially
+            # must not exempt the rest of the batch
+            compiled, cols = compiled_list[b]
+            if not _auto_verify_and_pin(config, compiled, cols,
+                                        choices, counts):
+                return None
+        choices_list.append(choices)
+        counts_list.append(counts)
+    return _decode_batch(scenarios, batch_indices, compiled_list,
+                         empty_results, real_count, choices_list,
+                         counts_list)
+
+
 def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
                 provider: str = "DefaultProvider",
                 mesh: Optional[object] = None,
@@ -332,9 +393,24 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         n_snap_shards, n_node_shards)
     if prep is None:
         return early
-    (config, per_scenario, real_count, batch_indices, compiled_list,
+    (config, host_trees, real_count, batch_indices, compiled_list,
      empty_results) = prep
 
+    if mesh is None and config.policy is None:
+        # Pallas fast loop: per-scenario kernels instead of the single
+        # vmap(S)xscan(P) program, whose XLA compile alone costs ~2min at
+        # the 50x20k BASELINE config-5 shape. Engages only when EVERY
+        # scenario is fast-eligible and the fast path is on for this
+        # process (AUTO on TPU, sharing the backend's self-verification
+        # state); anything else keeps the batched program. Runs BEFORE the
+        # shape unification below, which the fast loop never needs.
+        fast = _try_fast_loop(scenarios, config, batch_indices,
+                              compiled_list, empty_results, real_count)
+        if fast is not None:
+            return fast
+
+    per_scenario = _unify_batch(scenarios, host_trees, batch_indices,
+                                n_snap_shards, n_node_shards)
     host_carries, host_statics, host_xs = _stack_host(per_scenario)
     if mesh is not None:
         # sharded upload straight from host numpy — materializing on the
@@ -387,8 +463,10 @@ def run_what_if_multihost(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]]
         n_snap_shards=nproc, n_node_shards=n_node)
     if prep is None:
         return early
-    (config, per_scenario, real_count, batch_indices, compiled_list,
+    (config, host_trees, real_count, batch_indices, compiled_list,
      empty_results) = prep
+    per_scenario = _unify_batch(scenarios, host_trees, batch_indices,
+                                n_snap_shards=nproc, n_node_shards=n_node)
 
     # jax.devices() orders process 0's devices first, then process 1's, ...
     # so reshaping to (nproc, n_node) gives each process its own snap row

@@ -218,6 +218,53 @@ class TestFastLoop:
                 placements_key(vr.placements)
 
 
+def test_fuzz_what_if_fast_loop_parity(monkeypatch):
+    """Randomized eligible batches: the fast loop must match the vmap
+    program scenario-for-scenario. TPUSIM_FUZZ_SEEDS scales the sweep."""
+    import os
+    import random
+
+    from tpusim.jaxe import backend, fastscan
+
+    seeds = min(max(int(os.environ.get("TPUSIM_FUZZ_SEEDS", "2")), 1), 10)
+    orig_gate = backend._fast_path_enabled
+    orig_fast = fastscan.fast_scan
+    for seed in range(seeds):
+        rng = random.Random(7000 + seed)
+        scenarios = []
+        for s in range(rng.randint(2, 4)):
+            nodes = [make_node(f"z{seed}-{s}-n{i}",
+                               milli_cpu=rng.choice([1000, 2000, 4000]),
+                               memory=rng.choice([2, 4, 8]) * 1024**3,
+                               pods=rng.choice([4, 110]),
+                               labels={"zone": f"z{i % 2}"})
+                     for i in range(rng.randint(3, 8))]
+            pods = [make_pod(f"z{seed}-{s}-p{i}",
+                             milli_cpu=rng.randrange(1, 10) * 100,
+                             memory=rng.randrange(1, 8) * 256 * 1024 * 1024,
+                             node_selector=({"zone": f"z{i % 3}"}
+                                            if rng.random() < 0.3 else None))
+                    for i in range(rng.randint(8, 20))]
+            scenarios.append((ClusterSnapshot(nodes=nodes), pods))
+        vmap_results = run_what_if(scenarios)
+        monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
+        monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+        monkeypatch.setattr(backend, "_fast_path_enabled",
+                            lambda: (True, True))
+        runs = []
+        monkeypatch.setattr(
+            fastscan, "fast_scan",
+            lambda plan, **kw: runs.append(1) or orig_fast(plan, **kw))
+        fast_results = run_what_if(scenarios)
+        # un-patch before the next seed's vmap reference run
+        monkeypatch.setattr(fastscan, "fast_scan", orig_fast)
+        monkeypatch.setattr(backend, "_fast_path_enabled", orig_gate)
+        assert runs, f"seed {seed}: fast loop did not engage"
+        for i, (fr, vr) in enumerate(zip(fast_results, vmap_results)):
+            assert placements_key(fr.placements) == \
+                placements_key(vr.placements), f"seed {seed} scenario {i}"
+
+
 def test_what_if_with_policy_matches_per_scenario_runs():
     """A batch-wide policy: each scenario's what-if placements equal a
     standalone jax policy run over the same snapshot+pods."""

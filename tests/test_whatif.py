@@ -246,11 +246,18 @@ def test_fuzz_what_if_fast_loop_parity(monkeypatch):
                                             if rng.random() < 0.3 else None))
                     for i in range(rng.randint(8, 20))]
             scenarios.append((ClusterSnapshot(nodes=nodes), pods))
+        # the reference run must NOT take the fast loop (on TPU the AUTO
+        # gate is default-on and earlier tests may have pinned trust)
+        monkeypatch.setattr(backend, "_fast_path_enabled",
+                            lambda: (False, False))
         vmap_results = run_what_if(scenarios)
         monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
         monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+        # verify OFF: the fast results must stand on their own — with
+        # verification on, a divergence would silently fall back to the
+        # vmap program and the parity assert would compare vmap vs vmap
         monkeypatch.setattr(backend, "_fast_path_enabled",
-                            lambda: (True, True))
+                            lambda: (True, False))
         runs = []
         monkeypatch.setattr(
             fastscan, "fast_scan",

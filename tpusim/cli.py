@@ -208,6 +208,10 @@ def run_what_if_cli(args) -> int:
 
     # a wedged accelerator tunnel must degrade to CPU, not hang the dispatch
     ensure_responsive_platform()
+    if args.verbosity >= 5:
+        print("note: the per-node score dump (--v 5) is produced by the "
+              "host engine; --what-if always runs the batched device "
+              "program and emits no dump.", file=sys.stderr)
 
     try:
         with open(args.what_if) as f:
@@ -292,8 +296,6 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", args.platform)
         # an explicit pin is a deliberate choice: the wedged-tunnel probe
         # guard must neither delay it nor silently override it with CPU
-        import os
-
         os.environ["TPUSIM_PROBE"] = "0"
 
     if args.what_if:
@@ -343,6 +345,22 @@ def main(argv=None) -> int:
         except (OSError, ValueError) as exc:
             print(f"error: invalid event log: {exc}", file=sys.stderr)
             return 2
+
+    if args.verbosity >= 5:
+        # the per-node score dump is a host-engine trace; the device
+        # pipeline is one fused program with no per-node observability
+        # point — warn whenever THIS invocation will run on the device
+        # (explicit jax, or auto above the tiny-workload threshold)
+        threshold = int(os.environ.get("TPUSIM_AUTO_THRESHOLD", 100_000))
+        tiny = len(pods) * max(len(snapshot.nodes), 1) < threshold
+        device_bound = (args.backend == "jax"
+                        or (args.backend == "auto" and not tiny
+                            and not args.enable_volume_scheduling))
+        if device_bound:
+            print("note: the per-node score dump (--v 5) is produced by "
+                  "the host engine; this run uses the fused device "
+                  "program. Use --backend reference to see the dump.",
+                  file=sys.stderr)
 
     start = time.perf_counter()
     try:

@@ -350,12 +350,21 @@ def main(argv=None) -> int:
         # the per-node score dump is a host-engine trace; the device
         # pipeline is one fused program with no per-node observability
         # point — warn whenever THIS invocation will run on the device
-        # (explicit jax, or auto above the tiny-workload threshold)
-        threshold = int(os.environ.get("TPUSIM_AUTO_THRESHOLD", 100_000))
-        tiny = len(pods) * max(len(snapshot.nodes), 1) < threshold
+        # (explicit jax, or auto routing away from the host engine; auto
+        # sizes AFTER the event-log fold, so count node adds/deletes)
+        from tpusim.api.types import Node
+        from tpusim.framework.store import ADDED, DELETED
+        from tpusim.simulator import auto_routes_to_host
+
+        n_nodes = len(snapshot.nodes)
+        for etype, obj in events or []:
+            if isinstance(obj, Node):
+                n_nodes += 1 if etype == ADDED else \
+                    -1 if etype == DELETED else 0
         device_bound = (args.backend == "jax"
-                        or (args.backend == "auto" and not tiny
-                            and not args.enable_volume_scheduling))
+                        or (args.backend == "auto" and not auto_routes_to_host(
+                            len(pods), n_nodes,
+                            args.enable_volume_scheduling)))
         if device_bound:
             print("note: the per-node score dump (--v 5) is produced by "
                   "the host engine; this run uses the fused device "

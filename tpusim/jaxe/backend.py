@@ -22,6 +22,7 @@ natively on BOTH the fresh and incremental (event-log) paths.
 from __future__ import annotations
 
 import logging
+import os
 from typing import List
 
 import numpy as np
@@ -61,11 +62,9 @@ def _auto_verify_and_pin(config, compiled, cols, choices, counts) -> bool:
     Returns True when the fast results may be used; on disagreement the
     fast path is disabled for the process. Trust is pinned process-wide
     only on a batch of TPUSIM_FAST_VERIFY_MIN+ pods."""
-    import os as _os
-
     from tpusim.jaxe.fastscan import verify_against_xla
 
-    m = min(int(_os.environ.get("TPUSIM_FAST_VERIFY_PODS", 512)),
+    m = min(int(os.environ.get("TPUSIM_FAST_VERIFY_PODS", 512)),
             len(np.asarray(cols.req_cpu)))
     if not verify_against_xla(config, compiled, cols, choices, counts, m):
         _FAST_AUTO["disabled"] = True
@@ -73,7 +72,7 @@ def _auto_verify_and_pin(config, compiled, cols, choices, counts) -> bool:
                     "first %d pods; disabling it for this process and "
                     "re-running on the XLA scan", m)
         return False
-    min_pin = int(_os.environ.get("TPUSIM_FAST_VERIFY_MIN", 64))
+    min_pin = int(os.environ.get("TPUSIM_FAST_VERIFY_MIN", 64))
     if m >= min_pin:
         _FAST_AUTO["verified"] = True
         log.info("pallas fast path self-verified on the first %d pods; "
@@ -98,8 +97,6 @@ def _fast_path_enabled() -> tuple[bool, bool]:
     disagreement. Off-TPU the kernel would run in the Pallas interpreter —
     far slower than the XLA scan — so non-TPU backends require the explicit
     opt-in with TPUSIM_FAST_INTERPRET=1 (correctness runs)."""
-    import os
-
     env = os.environ.get("TPUSIM_FAST")
     if env == "0":
         return False, False
@@ -269,9 +266,7 @@ class JaxBackend:
                 # AND a full XLA replay — strictly slower than plain XLA.
                 # Small batches gain nothing from the fast path anyway;
                 # route them straight to the XLA scan.
-                import os as _osm
-
-                if len(pods) < int(_osm.environ.get(
+                if len(pods) < int(os.environ.get(
                         "TPUSIM_FAST_VERIFY_MIN", 64)):
                     fast_on = fast_verify = False
                     log.info("pallas fast path deferred: %d pods is below "
@@ -324,9 +319,7 @@ class JaxBackend:
         # double-buffered chunked scan: pod columns stay host-side and stream
         # to HBM chunk by chunk, bit-identical to the single dispatch
         # (SURVEY.md §7 hard part 6 — 1M-pod batches).
-        import os as _os
-
-        scan_chunk = int(_os.environ.get("TPUSIM_SCAN_CHUNK", 131072))
+        scan_chunk = int(os.environ.get("TPUSIM_SCAN_CHUNK", 131072))
         use_chunks = (fplan is None
                       and scan_chunk > 0 and len(pods) > scan_chunk)
         if fplan is None:
@@ -346,9 +339,9 @@ class JaxBackend:
         dispatch_start = perf_counter()
 
         def _discard_fast_path():
-            # pay the uploads the fast path deferred and disable it for the
-            # rest of the process; returns the XLA-scan inputs + a fresh
-            # dispatch clock
+            # pay the uploads the fast path deferred, disable it for the
+            # rest of the process, and rebuild the XLA-scan inputs (set
+            # via nonlocal) with a fresh dispatch clock
             nonlocal fplan, statics, carry, use_chunks, xs, dispatch_start
             _FAST_AUTO["disabled"] = True
             fplan = None
@@ -379,13 +372,13 @@ class JaxBackend:
                     # the kernel lowered but miscomputed: the guardrail
                     # already disabled it process-wide; rerun on XLA
                     _discard_fast_path()
-        if fplan is not None:
-            pass  # fast path already produced choices/counts
-        elif use_chunks:
-            _, choices, counts, _ = schedule_scan_chunked(
-                config, carry, statics, xs, scan_chunk)
-        else:
-            _, choices, counts, _ = schedule_scan(config, carry, statics, xs)
+        if fplan is None:  # fast path off, ineligible, or discarded above
+            if use_chunks:
+                _, choices, counts, _ = schedule_scan_chunked(
+                    config, carry, statics, xs, scan_chunk)
+            else:
+                _, choices, counts, _ = schedule_scan(config, carry,
+                                                      statics, xs)
         choices = np.asarray(choices)
         counts = np.asarray(counts)
         metrics.scheduling_algorithm_latency.observe(

@@ -735,8 +735,6 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
             if _SMEM is not None else pl.BlockSpec((SUBLANES, width),
                                                    lambda p: (p, 0))
 
-    smem_scalar = smem_rows
-
     def row_per_pod(width=None):
         kw = {"memory_space": _VMEM} if _VMEM is not None else {}
         return pl.BlockSpec((SUBLANES, width or npad), lambda p: (p, 0), **kw)
@@ -757,7 +755,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
     if has_vol_zone:
         group_in.append(row_per_pod())                 # zone_ok rows
     if group_bound:
-        group_in.append(smem_scalar())                 # gid
+        group_in.append(smem_rows())                   # gid
         if has_spread:
             group_in.append(const_row(rows=zpad))      # zone onehot
         group_in.append(const_row(rows=gpad))          # presence init
@@ -771,7 +769,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
     grid_spec = pl.GridSpec(
         grid=(k // SUBLANES,),
         in_specs=(
-            [smem_scalar() for _ in range(8)]           # pod scalars
+            [smem_rows() for _ in range(8)]             # pod scalars
             + [row_per_pod() for _ in range(6)]         # pregathered rows
             + [const_row() for _ in range(8)]           # statics
             + [const_row() for _ in range(7)]           # init carry

@@ -11,6 +11,7 @@ are reproduced exactly.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from time import perf_counter
 from typing import Dict, List, Optional
@@ -443,6 +444,24 @@ def new_cluster_capacity(config: SchedulerServerConfig, new_pods: List[Pod],
     return ClusterCapacity(config, new_pods, scheduled_pods, nodes, services)
 
 
+def auto_routes_to_host(num_pods: int, num_nodes: int,
+                        enable_volume_scheduling: bool = False) -> bool:
+    """The --backend auto routing rule (shared with the CLI's --v 5 note;
+    callers size num_nodes AFTER any event-log fold, since node-adding
+    logs count toward the threshold).
+
+    Tiny workloads lose to device-dispatch latency (BASELINE.md: the
+    20-pod quickstart runs ~400x slower through an accelerator tunnel than
+    the host engine; the crossover sits around config 2's 1k x 100 shape).
+    Intentionally avoids initializing jax — merely listing devices can
+    block on a wedged tunnel. Volume scheduling is host-bound and wins
+    over everything."""
+    if enable_volume_scheduling:
+        return True
+    threshold = int(os.environ.get("TPUSIM_AUTO_THRESHOLD", 100_000))
+    return num_pods * max(num_nodes, 1) < threshold
+
+
 def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    provider: str = DEFAULT_PROVIDER, backend: str = "reference",
                    scheduler_name: str = DEFAULT_SCHEDULER_NAME,
@@ -476,21 +495,11 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
             pvs=folded.pvs, pvcs=folded.pvcs,
             storage_classes=snapshot.storage_classes)
     if backend == "auto":
-        # Tiny workloads lose to device-dispatch latency (BASELINE.md: the
-        # 20-pod quickstart runs ~400x slower through an accelerator tunnel
-        # than the host engine; the crossover sits around config 2's 1k x 100
-        # shape). Sized AFTER the event-log fold so node-adding logs count.
-        # The rule intentionally avoids initializing jax — merely listing
-        # devices can block on a wedged tunnel. Volume scheduling is
-        # host-bound and wins over everything.
-        import os as _os
-
-        threshold = int(_os.environ.get("TPUSIM_AUTO_THRESHOLD", 100_000))
-        tiny = len(pods) * max(len(snapshot.nodes), 1) < threshold
-        if enable_volume_scheduling:
-            backend = "reference"
-        else:
-            backend = "reference" if tiny else "jax"
+        # sized AFTER the event-log fold above, so node-adding logs count
+        backend = ("reference"
+                   if auto_routes_to_host(len(pods), len(snapshot.nodes),
+                                          enable_volume_scheduling)
+                   else "jax")
     compiled_policy = None
     if policy is not None and backend == "jax":
         # compile (and validate) the policy for the device engine; the one

@@ -85,7 +85,7 @@ def test_plan_attempts_promotion(monkeypatch):
     # parse as a valid config subset
     monkeypatch.setenv("TPUSIM_BENCH_LADDER_CONFIGS",
                        bench.AUTOLADDER_DEFAULT_CONFIGS)
-    assert bench._ladder_configs() == {3, 4, 5}
+    assert bench._ladder_configs() == {3, 4, 5, 6}
 
     # explicit --ladder/--phases: no promotion (caller controls the configs)
     assert bench.plan_attempts("tpu", True, False, 1)[1] is False
@@ -99,3 +99,22 @@ def test_plan_attempts_promotion(monkeypatch):
     # a user override of the configs passes validation too
     monkeypatch.setenv("TPUSIM_BENCH_LADDER_CONFIGS", "3,6")
     assert bench._ladder_configs() == {3, 6}
+
+
+def test_pick_headline_prefers_clean_pallas_config3():
+    """The driver-artifact summary (VERDICT r4 item 5): a clean pallas
+    config-3 record is the headline; a MISMATCHed one must never be."""
+    import bench
+
+    xla3 = {"metric": "scheduled pods/sec (config 3: ..., exact scan, "
+                      "platform=tpu, placement_hash=aaa)", "value": 1.0}
+    fast3 = {"metric": "scheduled pods/sec (config 3: ..., exact scan "
+                       "(pallas), platform=tpu, fast_parity=match, "
+                       "placement_hash=aaa)", "value": 3.0}
+    bad3 = dict(fast3, error="pallas placements diverge from the XLA scan "
+                             "on this workload; rate untrustworthy")
+    six = {"metric": "scheduled pods/sec (config 6: ...)", "value": 2.0}
+    assert bench.pick_headline([xla3, fast3, six]) is fast3
+    assert bench.pick_headline([fast3, xla3, six]) is fast3
+    assert bench.pick_headline([xla3, bad3, six]) is xla3
+    assert bench.pick_headline([six]) is six

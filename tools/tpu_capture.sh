@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Round-4 TPU capture runbook: run whenever the axon tunnel is healthy.
+# Round-5 TPU capture runbook: run whenever the axon tunnel is healthy.
 # Sequential by design — ONE TPU client at a time; never kill -9 a child
 # (bench.py's own watchdog stops children SIGINT-first).
 #
@@ -10,17 +10,20 @@
 # back to CPU or still left configs missing — a wedge/heal cycle therefore
 # resumes exactly at the first incomplete TPU artifact.
 #
+# STAGE ORDER is priority order (round-5 VERDICT #1): the Pallas fastscan
+# evidence comes FIRST — if the window allows nothing else, take that.
+# Hash parity for the fastscan is checked against the freshest XLA-scan
+# ladder records available (r5, falling back to r4: the ladder workloads
+# are seed-deterministic and the XLA scan's placements are pinned by
+# goldens — r2 and r4 produced identical platform=tpu hashes for configs
+# 3 and 4, so cross-round comparison is sound).
+#
 # Produces, under bench_results/:
-#   r4_tpu_ladder.jsonl   — configs 1-5 (config 6 has its own artifact:
-#                           the first capture's stage-1 child was
-#                           watchdog-killed during config 6)
-#   r4_tpu_preempt.jsonl  — config 6, the preemption hybrid
-#   r4_tpu_fast.jsonl     — Pallas fastscan on configs 3-4 (TPUSIM_FAST=1);
-#                           hash parity vs the XLA scan is checked by
-#                           comparing placement_hash fields across the files
-#                           (same-platform records only)
-#   r4_tpu_whatif1/2.jsonl — config-5 cold/warm compile-cache pair
-#   r4_tpu_phases.jsonl   — unroll sweep and the phase split
+#   r5_tpu_fast.jsonl     — Pallas fastscan on configs 3-4 (TPUSIM_FAST=1)
+#   r5_tpu_preempt.jsonl  — config 6, the preemption hybrid
+#   r5_tpu_whatif1/2.jsonl — config-5 cold/warm compile-cache pair
+#   r5_tpu_ladder.jsonl   — configs 1-5 XLA-scan ladder
+#   r5_tpu_phases.jsonl   — unroll sweep and the phase split
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -102,56 +105,10 @@ run_stage() {
     fi
 }
 
-probe() {
-    timeout 60 python -c "
-import jax; d = jax.devices()
-import jax.numpy as jnp
-assert int(jnp.ones((8, 8)).sum()) == 64
-print('PROBE OK:', d)" 2>&1 | tail -1
-}
-
-echo "== pre-flight probe =="
-if ! probe | grep -q "PROBE OK"; then
-    echo "tunnel not healthy; aborting (re-run when the probe passes)" >&2
-    exit 1
-fi
-
-echo "== stage 1: full ladder (configs 1-5; 6 is stage 1b) =="
-run_stage ladder configs:1,2,3,4,5 bench_results/r4_tpu_ladder.jsonl \
-    bench_results/r4_tpu_ladder.log python bench.py --ladder
-
-echo "== stage 1b: preemption hybrid (config 6; own artifact — the stage-1 =="
-echo "== child was watchdog-killed here in the first capture, so the ladder =="
-echo "== artifact is TPU-complete for configs 1-5 only) =="
-run_stage preempt configs:6 bench_results/r4_tpu_preempt.jsonl \
-    bench_results/r4_tpu_preempt.log \
-    env TPUSIM_BENCH_LADDER_CONFIGS=6 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
-    python bench.py --ladder
-
-echo "== stage 2: Pallas fastscan, configs 3-4 =="
-run_stage fastscan pallas:3,4 bench_results/r4_tpu_fast.jsonl \
-    bench_results/r4_tpu_fast.log \
-    env TPUSIM_FAST=1 TPUSIM_BENCH_LADDER_CONFIGS=3,4 python bench.py --ladder
-
-echo "== stage 3: config-5 warm-cache pair (criterion: 2nd fresh-process run <60s) =="
-run_stage whatif1 configs:5 bench_results/r4_tpu_whatif1.jsonl \
-    bench_results/r4_tpu_whatif1.log \
-    env TPUSIM_BENCH_LADDER_CONFIGS=5 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
-    python bench.py --ladder
-t_start=$(date +%s)
-run_stage whatif2 configs:5 bench_results/r4_tpu_whatif2.jsonl \
-    bench_results/r4_tpu_whatif2.log \
-    env TPUSIM_BENCH_LADDER_CONFIGS=5 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
-    python bench.py --ladder
-t_end=$(date +%s)
-echo "== config-5 second-run wall: $((t_end - t_start))s (criterion <60s for the child's end-to-end; see [config 5] line in r4_tpu_whatif2.log; 0s = both runs were already captured) =="
-
-echo "== stage 4: phase split + unroll sweep =="
-run_stage phases phases bench_results/r4_tpu_phases.jsonl \
-    bench_results/r4_tpu_phases.log python bench.py --phases
-
-echo "== hash parity check (fastscan vs XLA scan, same-platform records only) =="
-if ! python - <<'EOF'
+parity_check() {
+    # fastscan-vs-XLA placement-hash parity, same-platform records only;
+    # r5 ladder records win, r4 fills any config the r5 ladder lacks yet
+    python - <<'EOF'
 import json, re, sys
 
 def hashes(path, need_pallas=False):
@@ -183,11 +140,14 @@ def hashes(path, need_pallas=False):
         pass
     return out
 
+# cross-round fallback is sound: the ladder workloads are seeded and the
+# XLA scan is golden-pinned (r2 == r4 hashes on configs 3-4, platform=tpu)
 ladder = hashes("bench_results/r4_tpu_ladder.jsonl")
-fast = hashes("bench_results/r4_tpu_fast.jsonl", need_pallas=True)
+ladder.update(hashes("bench_results/r5_tpu_ladder.jsonl"))
+fast = hashes("bench_results/r5_tpu_fast.jsonl", need_pallas=True)
 ok = True
 compared = 0
-for key, h in fast.items():
+for key, h in sorted(fast.items()):
     want = ladder.get(key)
     if want is None:
         print(f"{key}: fastscan={h} (no same-platform ladder record; skipped)")
@@ -202,8 +162,76 @@ if not compared:
     ok = False
 sys.exit(0 if ok else 1)
 EOF
-then
+}
+
+probe() {
+    timeout 60 python -c "
+import jax; d = jax.devices()
+import jax.numpy as jnp
+assert int(jnp.ones((8, 8)).sum()) == 64
+print('PROBE OK:', d)" 2>&1 | tail -1
+}
+
+echo "== pre-flight probe =="
+if ! probe | grep -q "PROBE OK"; then
+    echo "tunnel not healthy; aborting (re-run when the probe passes)" >&2
+    exit 1
+fi
+
+echo "== stage 1: Pallas fastscan, configs 3-4 (the round's #1 artifact) =="
+run_stage fastscan pallas:3,4 bench_results/r5_tpu_fast.jsonl \
+    bench_results/r5_tpu_fast.log \
+    env TPUSIM_FAST=1 TPUSIM_BENCH_LADDER_CONFIGS=3,4 python bench.py --ladder
+
+echo "== stage 1 parity (vs freshest XLA ladder records) =="
+if parity_check; then
+    rm -f bench_results/r5_parity_FAILED.txt
+else
+    # a MISMATCH is a Mosaic-vs-XLA numerics finding worth more than the
+    # benchmark: preserve the artifacts and flag it loudly, but DON'T abort
+    # — exiting here would dead-loop the watcher (the fastscan records
+    # exist, so the stage skips and parity fails again) and starve every
+    # later stage of its window. The final parity check governs exit code.
+    parity_check > bench_results/r5_parity_FAILED.txt 2>&1 || true
+    echo "== PARITY MISMATCH — preserved in r5_parity_FAILED.txt; the" \
+         "fastscan rate is NOT trustworthy; continuing with later stages ==" >&2
+fi
+
+echo "== stage 2: preemption hybrid (config 6) =="
+run_stage preempt configs:6 bench_results/r5_tpu_preempt.jsonl \
+    bench_results/r5_tpu_preempt.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=6 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+
+echo "== stage 3: config-5 warm-cache pair (criterion: 2nd fresh-process run <60s) =="
+run_stage whatif1 configs:5 bench_results/r5_tpu_whatif1.jsonl \
+    bench_results/r5_tpu_whatif1.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=5 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+t_start=$(date +%s)
+run_stage whatif2 configs:5 bench_results/r5_tpu_whatif2.jsonl \
+    bench_results/r5_tpu_whatif2.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=5 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+t_end=$(date +%s)
+echo "== config-5 second-run wall: $((t_end - t_start))s (criterion <60s for the child's end-to-end; see [config 5] line in r5_tpu_whatif2.log; 0s = both runs were already captured) =="
+
+echo "== stage 4: full XLA ladder (configs 1-5; fresh same-round parity anchors) =="
+run_stage ladder configs:1,2,3,4,5 bench_results/r5_tpu_ladder.jsonl \
+    bench_results/r5_tpu_ladder.log \
+    env TPUSIM_FAST=0 TPUSIM_BENCH_LADDER_CONFIGS=1,2,3,4,5 \
+    python bench.py --ladder
+
+echo "== stage 5: phase split + unroll sweep =="
+run_stage phases phases bench_results/r5_tpu_phases.jsonl \
+    bench_results/r5_tpu_phases.log python bench.py --phases
+
+echo "== final hash parity check (now incl. same-round ladder records) =="
+if ! parity_check; then
     echo "== PARITY CHECK FAILED — do not record the fastscan rate ==" >&2
     exit 1
 fi
+# the capture verified clean end-to-end: a stage-1 flag from comparing
+# against r4-only anchors is superseded by the same-round check above
+rm -f bench_results/r5_parity_FAILED.txt
 echo "== capture complete; update BASELINE.md with the numbers above =="

@@ -238,7 +238,7 @@ def test_auto_mode_env_gates(monkeypatch):
     monkeypatch.delenv("TPUSIM_FAST", raising=False)
     monkeypatch.delenv("TPUSIM_FAST_INTERPRET", raising=False)
     monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
-    monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+    monkeypatch.setitem(backend._FAST_AUTO, "verified_sigs", set())
     # this suite runs on the CPU backend: AUTO must stay off (the
     # interpreter is not a fast path)
     assert backend._fast_path_enabled() == (False, True)
@@ -263,7 +263,7 @@ def _run_auto(monkeypatch, corrupt=None, boom=False, num_pods=120):
     baseline = backend.JaxBackend().schedule(pods, snapshot)
 
     monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
-    monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+    monkeypatch.setitem(backend._FAST_AUTO, "verified_sigs", set())
     monkeypatch.setattr(backend, "_fast_path_enabled", lambda: (True, True))
     real = fastscan.fast_scan
     calls = []
@@ -287,7 +287,7 @@ def test_auto_verification_passes_and_trusts(monkeypatch):
     backend, baseline, auto, calls = _run_auto(monkeypatch)
     assert calls, "pallas fast path did not engage"
     assert _outcomes(auto) == _outcomes(baseline)
-    assert backend._FAST_AUTO["verified"] is True
+    assert backend._FAST_AUTO["verified_sigs"]
     assert backend._FAST_AUTO["disabled"] is False
 
 
@@ -299,7 +299,7 @@ def test_auto_small_batch_skips_fast_path(monkeypatch):
     backend, baseline, auto, calls = _run_auto(monkeypatch, num_pods=20)
     assert not calls  # routed straight to the XLA scan
     assert _outcomes(auto) == _outcomes(baseline)
-    assert backend._FAST_AUTO["verified"] is False
+    assert not backend._FAST_AUTO["verified_sigs"]
     assert backend._FAST_AUTO["disabled"] is False
 
 
@@ -574,3 +574,53 @@ def test_group_budget_falls_back(monkeypatch):
     plan, reason = plan_fast(config, compiled, cols)
     assert plan is None
     assert "unrolled-loop budget" in reason
+
+
+def test_failure_classification(monkeypatch):
+    """ADVICE r4: transient runtime errors (device OOM etc) must not
+    permanently disable the fast path — but three in a row do, and a
+    compile/lowering rejection does immediately."""
+    from tpusim.jaxe import backend
+
+    monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
+    monkeypatch.setitem(backend._FAST_AUTO, "transient", 0)
+    oom = RuntimeError("RESOURCE_EXHAUSTED: out of memory while allocating")
+    backend._note_fast_failure(oom)
+    assert backend._FAST_AUTO["disabled"] is False
+    backend._note_fast_failure(oom)
+    assert backend._FAST_AUTO["disabled"] is False
+    backend._note_fast_failure(oom)
+    assert backend._FAST_AUTO["disabled"] is True
+
+    monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
+    monkeypatch.setitem(backend._FAST_AUTO, "transient", 0)
+    backend._note_fast_failure(RuntimeError(
+        "Mosaic failed to compile TPU kernel: unsupported block shape"))
+    assert backend._FAST_AUTO["disabled"] is True
+
+
+def test_forced_mode_honors_disabled(monkeypatch):
+    """ADVICE r4: a persistently failing kernel under TPUSIM_FAST=1 must not
+    re-attempt (and re-upload the plan) on every batch."""
+    from tpusim.jaxe import backend
+
+    monkeypatch.setenv("TPUSIM_FAST", "1")
+    monkeypatch.setenv("TPUSIM_FAST_INTERPRET", "1")
+    monkeypatch.setitem(backend._FAST_AUTO, "disabled", True)
+    assert backend._fast_path_enabled() == (False, False)
+
+
+def test_trust_is_per_kernel_signature(monkeypatch):
+    """ADVICE r4 (medium): trust pinned by one kernel variant must not
+    exempt a different variant — a workload with different feature flags
+    or node padding re-verifies."""
+    from tpusim.jaxe import backend
+
+    backend_, baseline, auto, calls = _run_auto(monkeypatch)
+    sigs = backend_._FAST_AUTO["verified_sigs"]
+    assert len(sigs) == 1
+    sig = next(iter(sigs))
+    # same variant: no re-verification wanted; different npad: verify again
+    assert backend_._FAST_AUTO["disabled"] is False
+    other = (sig[0] + 128,) + sig[1:]
+    assert other not in sigs

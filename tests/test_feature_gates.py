@@ -1,0 +1,107 @@
+"""ApplyFeatureGates registry surgery (defaults.go:181-205).
+
+TaintNodesByCondition: CheckNodeCondition is removed everywhere and
+PodToleratesNodeTaints becomes MANDATORY (applied even to key sets that
+do not list it); ResourceLimitsPriorityFunction registers
+ResourceLimitsPriority at weight 1. Both default off, and an ungated run
+is byte-identical to a gated-off run.
+"""
+
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.engine import predicates as preds
+from tpusim.engine.providers import (
+    DEFAULT_PROVIDER,
+    PluginFactoryArgs,
+    apply_feature_gates,
+    create_from_provider,
+    default_registry,
+    parse_feature_gates,
+)
+from tpusim.simulator import SchedulerServerConfig, ClusterCapacity
+
+
+def test_parse_feature_gates():
+    assert parse_feature_gates("") == {}
+    assert parse_feature_gates("TaintNodesByCondition=true") == {
+        "TaintNodesByCondition": True}
+    assert parse_feature_gates(
+        "TaintNodesByCondition=false, PodPriority=true") == {
+        "TaintNodesByCondition": False, "PodPriority": True}
+    with pytest.raises(ValueError, match="unrecognized feature gate"):
+        parse_feature_gates("NoSuchGate=true")
+    with pytest.raises(ValueError, match="invalid value"):
+        parse_feature_gates("PodPriority=yes")
+    with pytest.raises(ValueError, match="missing bool"):
+        parse_feature_gates("PodPriority")
+
+
+def test_taint_gate_registry_surgery():
+    r = default_registry()
+    apply_feature_gates(r, {"TaintNodesByCondition": True})
+    # CheckNodeCondition gone from the registry and every provider
+    assert preds.CHECK_NODE_CONDITION_PRED not in r.fit_predicates
+    assert preds.CHECK_NODE_CONDITION_PRED not in r.mandatory_fit_predicates
+    for pred_keys, _ in r.providers.values():
+        assert preds.CHECK_NODE_CONDITION_PRED not in pred_keys
+        assert preds.POD_TOLERATES_NODE_TAINTS_PRED in pred_keys
+    # PodToleratesNodeTaints is mandatory: built even from keys omitting it
+    assert preds.POD_TOLERATES_NODE_TAINTS_PRED in r.mandatory_fit_predicates
+    built = r.build_predicates({preds.GENERAL_PRED}, PluginFactoryArgs())
+    assert preds.POD_TOLERATES_NODE_TAINTS_PRED in built
+
+
+def test_resource_limits_gate_registers_priority():
+    r = default_registry()
+    assert "ResourceLimitsPriority" not in r.priority_factories
+    apply_feature_gates(r, {"ResourceLimitsPriorityFunction": True})
+    f = r.priority_factories["ResourceLimitsPriority"]
+    assert f.weight == 1
+    # registration only: no provider selects it (matching Go, where the
+    # gate registers the function but provider sets are unchanged)
+    for _, pri_keys in r.providers.values():
+        assert "ResourceLimitsPriority" not in pri_keys
+
+
+def test_gates_off_is_identity():
+    r1, r2 = default_registry(), default_registry()
+    apply_feature_gates(r2, {"TaintNodesByCondition": False,
+                             "ResourceLimitsPriorityFunction": False})
+    assert set(r1.fit_predicates) == set(r2.fit_predicates)
+    assert r1.mandatory_fit_predicates == r2.mandatory_fit_predicates
+    assert set(r1.priority_factories) == set(r2.priority_factories)
+    assert {k: (sorted(v[0]), sorted(v[1]))
+            for k, v in r1.providers.items()} \
+        == {k: (sorted(v[0]), sorted(v[1])) for k, v in r2.providers.items()}
+
+
+def _run(gates):
+    # one NotReady node (CheckNodeCondition would reject it) that also
+    # carries an intolerable taint: with TaintNodesByCondition on, the
+    # failure reason flips from the node-condition check to the taint check
+    node = make_node("n1", milli_cpu=4000, memory=16 * 1024**3,
+                     taints=[{"key": "node.kubernetes.io/not-ready",
+                              "effect": "NoSchedule"}])
+    node.status.conditions = [type(node.status.conditions[0])(
+        type="Ready", status="False")] if node.status.conditions else []
+    pod = make_pod("p1", milli_cpu=100, memory=1024**2)
+    cc = ClusterCapacity(
+        SchedulerServerConfig(feature_gates=gates),
+        new_pods=[pod], scheduled_pods=[], nodes=[node])
+    cc.run()
+    return cc.status
+
+
+def test_taint_gate_end_to_end():
+    base = _run(None)
+    assert base.failed_pods
+    msg_off = base.failed_pods[0].status.conditions[0].message
+    gated = _run({"TaintNodesByCondition": True})
+    msg_on = gated.failed_pods[0].status.conditions[0].message
+    # gated-off keeps the CheckNodeCondition reason; gated-on fails on the
+    # taint instead (PodToleratesNodeTaints is now mandatory and the
+    # node-condition predicate no longer exists)
+    assert "NodeNotReady" in msg_off or "node(s) were not ready" in msg_off
+    assert "taint" in msg_on
+    assert msg_on != msg_off

@@ -155,7 +155,7 @@ class TestFastLoop:
         from tpusim.jaxe import backend, fastscan
 
         monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
-        monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+        monkeypatch.setitem(backend._FAST_AUTO, "verified_sigs", set())
         monkeypatch.setattr(backend, "_fast_path_enabled",
                             lambda: (True, True))
         # 25-pod scenarios are real evidence at this threshold
@@ -173,7 +173,7 @@ class TestFastLoop:
             assert (fr.scheduled, fr.unschedulable) == \
                 (vr.scheduled, vr.unschedulable)
         # scenario 0's self-verification pinned process-wide trust
-        assert backend._FAST_AUTO["verified"] is True
+        assert backend._FAST_AUTO["verified_sigs"]
 
     def test_ineligible_scenario_keeps_vmap_program(self, monkeypatch):
         scenarios = self._scenarios()
@@ -188,7 +188,7 @@ class TestFastLoop:
         from tpusim.jaxe import backend, fastscan
 
         monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
-        monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+        monkeypatch.setitem(backend._FAST_AUTO, "verified_sigs", set())
         monkeypatch.setattr(backend, "_fast_path_enabled",
                             lambda: (True, True))
         monkeypatch.setattr(
@@ -204,7 +204,7 @@ class TestFastLoop:
         from tpusim.jaxe import backend, fastscan
 
         monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
-        monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+        monkeypatch.setitem(backend._FAST_AUTO, "verified_sigs", set())
         monkeypatch.setattr(backend, "_fast_path_enabled",
                             lambda: (True, True))
         monkeypatch.setattr(
@@ -252,7 +252,7 @@ def test_fuzz_what_if_fast_loop_parity(monkeypatch):
                             lambda: (False, False))
         vmap_results = run_what_if(scenarios)
         monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
-        monkeypatch.setitem(backend._FAST_AUTO, "verified", False)
+        monkeypatch.setitem(backend._FAST_AUTO, "verified_sigs", set())
         # verify OFF: the fast results must stand on their own — with
         # verification on, a divergence would silently fall back to the
         # vmap program and the parity assert would compare vmap vs vmap

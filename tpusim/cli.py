@@ -106,6 +106,14 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Enable the VolumeScheduling feature gate "
                              "(CheckVolumeBinding + delayed PV binding); "
                              "reference backend only")
+    parser.add_argument("--feature-gates", default="",
+                        help="Comma-separated key=bool feature gates "
+                             "(kube --feature-gates format): "
+                             "TaintNodesByCondition, "
+                             "ResourceLimitsPriorityFunction (registry "
+                             "surgery, defaults.go:181-205), plus "
+                             "PodPriority / VolumeScheduling as aliases "
+                             "for the dedicated flags")
     parser.add_argument("--platform", default=os.environ.get("TPUSIM_PLATFORM", ""),
                         help="Pin the jax platform (e.g. cpu) — needed because "
                              "the TPU plugin can override JAX_PLATFORMS; default "
@@ -279,6 +287,21 @@ def run_what_if_cli(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    feature_gates = None
+    if args.feature_gates:
+        from tpusim.engine.providers import parse_feature_gates
+
+        try:
+            feature_gates = parse_feature_gates(args.feature_gates)
+        except ValueError as exc:
+            print(f"error: --feature-gates: {exc}", file=sys.stderr)
+            return 2
+        # PodPriority / VolumeScheduling gate the same behavior as the
+        # dedicated flags (scheduler.go:175,210-213)
+        if feature_gates.pop("PodPriority", False):
+            args.enable_pod_priority = True
+        if feature_gates.pop("VolumeScheduling", False):
+            args.enable_volume_scheduling = True
 
     if args.verbosity >= 5:
         # glog -v analog: V(5)+ turns on the engine's per-node score dump
@@ -377,7 +400,8 @@ def main(argv=None) -> int:
                                 backend=args.backend,
                                 enable_pod_priority=args.enable_pod_priority,
                                 enable_volume_scheduling=args.enable_volume_scheduling,
-                                policy=policy, events=events)
+                                policy=policy, events=events,
+                                feature_gates=feature_gates)
     except (ValueError, KeyError) as exc:
         # invalid policy/provider/plugin names surfaced at build time
         # (PolicyError is a ValueError; the registry raises KeyError)

@@ -315,6 +315,62 @@ def default_registry() -> AlgorithmRegistry:
     return r
 
 
+KNOWN_FEATURE_GATES = {"TaintNodesByCondition", "ResourceLimitsPriorityFunction",
+                       "PodPriority", "VolumeScheduling"}
+
+
+def parse_feature_gates(spec: str) -> Dict[str, bool]:
+    """Parse the kube --feature-gates map flag ("Key=true,Other=false");
+    unknown keys and non-boolean values are rejected like
+    utilfeature.DefaultFeatureGate.Set does."""
+    gates: Dict[str, bool] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, val = part.partition("=")
+        key = key.strip()
+        if key not in KNOWN_FEATURE_GATES:
+            raise ValueError(f"unrecognized feature gate: {key}")
+        if not sep:
+            raise ValueError(f"missing bool value for {key}")
+        val = val.strip().lower()
+        if val not in ("true", "false"):
+            raise ValueError(
+                f"invalid value of {key}={val}, err: strconv.ParseBool: "
+                f"parsing {val!r}: invalid syntax")
+        gates[key] = val == "true"
+    return gates
+
+
+def apply_feature_gates(registry: AlgorithmRegistry,
+                        gates: Dict[str, bool]) -> None:
+    """ApplyFeatureGates (defaults.go:181-205): feature-gate-driven registry
+    surgery, run before provider/policy assembly like the scheduler app does.
+
+    TaintNodesByCondition: CheckNodeCondition is removed (from the registry
+    AND every provider's key set) and PodToleratesNodeTaints becomes a
+    MANDATORY predicate inserted into every provider — fit is then
+    determined by whether the pod tolerates all of the node's taints.
+    ResourceLimitsPriorityFunction: registers ResourceLimitsPriority at
+    weight 1 (registration only — selection still follows the provider or
+    policy keys, matching the Go behavior). Both gates default off in this
+    k8s vintage."""
+    if gates.get("TaintNodesByCondition"):
+        registry.remove_fit_predicate(preds.CHECK_NODE_CONDITION_PRED)
+        for pred_keys, _pri_keys in registry.providers.values():
+            pred_keys.discard(preds.CHECK_NODE_CONDITION_PRED)
+        registry.register_mandatory_fit_predicate(
+            preds.POD_TOLERATES_NODE_TAINTS_PRED,
+            preds.pod_tolerates_node_taints)
+        for pred_keys, _pri_keys in registry.providers.values():
+            pred_keys.add(preds.POD_TOLERATES_NODE_TAINTS_PRED)
+    if gates.get("ResourceLimitsPriorityFunction"):
+        registry.register_priority_function2(
+            "ResourceLimitsPriority", prios.resource_limits_priority_map,
+            None, 1)
+
+
 def _selector_spread_map_reduce(args: PluginFactoryArgs):
     spread = args.selector_spread()
     return spread.calculate_spread_priority_map, spread.calculate_spread_priority_reduce

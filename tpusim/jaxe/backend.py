@@ -51,21 +51,72 @@ from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
 log = logging.getLogger(__name__)
 
 
-# process-wide fast-path auto-mode state: flips to disabled the first time a
-# self-verification chunk disagrees with the XLA scan (never re-enabled)
-_FAST_AUTO = {"disabled": False, "verified": False}
+# process-wide fast-path auto-mode state: `disabled` flips the first time a
+# self-verification chunk disagrees with the XLA scan or the kernel fails to
+# compile/lower (never re-enabled); `verified_sigs` holds the kernel
+# signatures (the _build_call variant: shape pads + feature flags) whose
+# first large-enough batch verified — each distinct Pallas/Mosaic kernel
+# variant earns trust separately (ADVICE r4: a process whose first verified
+# batch was group-free must not run the group-featured kernel unverified);
+# `transient` counts consecutive runtime (non-compile) failures — transient
+# errors like a one-off device OOM do not permanently disable the path, but
+# three in a row do.
+_FAST_AUTO = {"disabled": False, "verified_sigs": set(), "transient": 0}
+
+_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "OOM",
+                      "UNAVAILABLE", "DEADLINE_EXCEEDED", "CANCELLED")
+_MAX_TRANSIENT_FAILURES = 3
 
 
-def _auto_verify_and_pin(config, compiled, cols, choices, counts) -> bool:
+def plan_signature(plan) -> tuple:
+    """The kernel-variant key for AUTO-mode trust: mirrors the _build_call
+    cache key's semantic axes (node padding, feature flags, scalar/group
+    widths) — a Mosaic miscompile is per compiled variant, so verification
+    of one variant must not exempt another."""
+    return (plan.alloc_cpu.shape[1], plan.most_requested, plan.num_scalars,
+            plan.num_groups, plan.n_zone_doms, plan.has_ports,
+            plan.has_disk, plan.has_spread, plan.has_vol_zone)
+
+
+def _note_fast_failure(exc: Exception) -> None:
+    """Classify a fast-path failure: compile/lowering rejections disable the
+    path permanently (re-attempting re-uploads the plan and fails again);
+    transient runtime errors keep it enabled until _MAX_TRANSIENT_FAILURES
+    consecutive strikes (ADVICE r4)."""
+    msg = f"{type(exc).__name__}: {exc}"
+    if any(marker in msg for marker in _TRANSIENT_MARKERS):
+        _FAST_AUTO["transient"] += 1
+        if _FAST_AUTO["transient"] >= _MAX_TRANSIENT_FAILURES:
+            _FAST_AUTO["disabled"] = True
+            log.warning("pallas fast path: %d consecutive transient "
+                        "failures; disabling it for this process",
+                        _FAST_AUTO["transient"])
+        else:
+            log.warning("pallas fast path: transient failure %d/%d (%s); "
+                        "will retry on the next batch",
+                        _FAST_AUTO["transient"], _MAX_TRANSIENT_FAILURES,
+                        msg)
+        return
+    _FAST_AUTO["disabled"] = True
+    log.warning("pallas fast path: compile/lowering failure (%s); "
+                "disabling it for this process", msg)
+
+
+def _auto_verify_and_pin(config, compiled, cols, choices, counts,
+                         sig: tuple, limit: int = None) -> bool:
     """AUTO-mode guardrail (shared by run_batch and the what-if fast loop):
     replay the leading pods through the XLA scan and compare bit-for-bit.
     Returns True when the fast results may be used; on disagreement the
-    fast path is disabled for the process. Trust is pinned process-wide
-    only on a batch of TPUSIM_FAST_VERIFY_MIN+ pods."""
+    fast path is disabled for the process. Trust is pinned per kernel
+    signature, only on a batch of TPUSIM_FAST_VERIFY_MIN+ pods."""
     from tpusim.jaxe.fastscan import verify_against_xla
 
     m = min(int(os.environ.get("TPUSIM_FAST_VERIFY_PODS", 512)),
             len(np.asarray(cols.req_cpu)))
+    if limit is not None:
+        # the caller produced fewer rows than the full batch (the
+        # preemption hybrid verifies on its first speculation chunk)
+        m = min(m, limit)
     if not verify_against_xla(config, compiled, cols, choices, counts, m):
         _FAST_AUTO["disabled"] = True
         log.warning("pallas fast path DISAGREES with the XLA scan on the "
@@ -74,9 +125,9 @@ def _auto_verify_and_pin(config, compiled, cols, choices, counts) -> bool:
         return False
     min_pin = int(os.environ.get("TPUSIM_FAST_VERIFY_MIN", 64))
     if m >= min_pin:
-        _FAST_AUTO["verified"] = True
+        _FAST_AUTO["verified_sigs"].add(sig)
         log.info("pallas fast path self-verified on the first %d pods; "
-                 "trusting it for this process", m)
+                 "trusting kernel variant %s for this process", m, sig)
     else:
         log.info("pallas fast path verified on %d pods (< %d): keeping "
                  "per-batch verification on", m, min_pin)
@@ -84,21 +135,28 @@ def _auto_verify_and_pin(config, compiled, cols, choices, counts) -> bool:
 
 
 def _fast_path_enabled() -> tuple[bool, bool]:
-    """Returns (enabled, verify).
+    """Returns (enabled, auto_mode).
 
     TPUSIM_FAST=1 forces the Pallas fused-scan fast path (jaxe.fastscan) on
     for eligible workloads (group-free, plus ports/disk-conflict/spreading/
     volume-zone group features within the fast-path budgets), =0 forces it
     off. Unset = AUTO: on
     TPU the fast path is default-ON with first-chunk self-verification —
-    before trusting a process's first fast run, the backend re-runs the
-    leading pods through the XLA scan and compares choices bit-for-bit,
+    before trusting a kernel variant's first fast run, the backend re-runs
+    the leading pods through the XLA scan and compares choices bit-for-bit,
     falling back (and disabling the fast path for the process) on any
     disagreement. Off-TPU the kernel would run in the Pallas interpreter —
     far slower than the XLA scan — so non-TPU backends require the explicit
-    opt-in with TPUSIM_FAST_INTERPRET=1 (correctness runs)."""
+    opt-in with TPUSIM_FAST_INTERPRET=1 (correctness runs).
+
+    A process-wide `disabled` flag (verify disagreement, compile/lowering
+    failure, or repeated transient failures) is honored in BOTH modes: a
+    persistently failing kernel under forced TPUSIM_FAST=1 must not
+    re-attempt (and re-upload the plan) on every batch (ADVICE r4)."""
     env = os.environ.get("TPUSIM_FAST")
     if env == "0":
+        return False, False
+    if _FAST_AUTO["disabled"]:
         return False, False
     if env == "1":
         if os.environ.get("TPUSIM_FAST_INTERPRET") == "1":
@@ -107,12 +165,9 @@ def _fast_path_enabled() -> tuple[bool, bool]:
 
         return jax.default_backend() == "tpu", False
     # AUTO (round-3 VERDICT item 2: default-on on TPU, kill-switch kept)
-    if _FAST_AUTO["disabled"]:
-        return False, False
     import jax
 
-    return (jax.default_backend() == "tpu",
-            not _FAST_AUTO["verified"])
+    return jax.default_backend() == "tpu", True
 
 _MOST_REQUESTED_PROVIDERS = {CLUSTER_AUTOSCALER_PROVIDER, TD_PROVIDER}
 _KNOWN_PROVIDERS = {DEFAULT_PROVIDER} | _MOST_REQUESTED_PROVIDERS
@@ -258,20 +313,19 @@ class JaxBackend:
         # pure wasted latency on exactly the hot path the feature accelerates
         fplan = None
         fast_verify = False
+        fast_sig = None
         if cp is None:
-            fast_on, fast_verify = _fast_path_enabled()
-            if fast_on and fast_verify:
-                # AUTO mode, not yet trusted: a batch too small to pin
-                # trust (< TPUSIM_FAST_VERIFY_MIN) would run the kernel
-                # AND a full XLA replay — strictly slower than plain XLA.
-                # Small batches gain nothing from the fast path anyway;
-                # route them straight to the XLA scan.
-                if len(pods) < int(os.environ.get(
-                        "TPUSIM_FAST_VERIFY_MIN", 64)):
-                    fast_on = fast_verify = False
-                    log.info("pallas fast path deferred: %d pods is below "
-                             "the self-verification threshold; using the "
-                             "XLA scan", len(pods))
+            fast_on, auto_mode = _fast_path_enabled()
+            if (fast_on and auto_mode and not _FAST_AUTO["verified_sigs"]
+                    and len(pods) < int(os.environ.get(
+                        "TPUSIM_FAST_VERIFY_MIN", 64))):
+                # no variant is trusted yet, so this small batch would be
+                # deferred after planning anyway — skip the O(nodes+pods)
+                # gcd reduction entirely (the pre-signature fast exit)
+                fast_on = False
+                log.info("pallas fast path deferred: %d pods is below "
+                         "the self-verification threshold; using the "
+                         "XLA scan", len(pods))
             if fast_on:
                 from tpusim.jaxe.fastscan import plan_fast
 
@@ -279,6 +333,22 @@ class JaxBackend:
                 if fplan is None:
                     log.info("pallas fast path ineligible (%s); using the "
                              "XLA scan", why)
+                else:
+                    fast_sig = plan_signature(fplan)
+                    fast_verify = (auto_mode and fast_sig
+                                   not in _FAST_AUTO["verified_sigs"])
+            if fplan is not None and fast_verify and len(pods) < int(
+                    os.environ.get("TPUSIM_FAST_VERIFY_MIN", 64)):
+                # AUTO mode, variant not yet trusted: a batch too small to
+                # pin trust (< TPUSIM_FAST_VERIFY_MIN) would run the kernel
+                # AND a full XLA replay — strictly slower than plain XLA.
+                # Small batches gain nothing from the fast path anyway;
+                # route them straight to the XLA scan.
+                fplan = None
+                fast_verify = False
+                log.info("pallas fast path deferred: %d pods is below "
+                         "the self-verification threshold; using the "
+                         "XLA scan", len(pods))
         sa_lock_init = None
         if fplan is not None:
             statics = None
@@ -339,11 +409,11 @@ class JaxBackend:
         dispatch_start = perf_counter()
 
         def _discard_fast_path():
-            # pay the uploads the fast path deferred, disable it for the
-            # rest of the process, and rebuild the XLA-scan inputs (set
-            # via nonlocal) with a fresh dispatch clock
+            # pay the uploads the fast path deferred and rebuild the
+            # XLA-scan inputs (set via nonlocal) with a fresh dispatch
+            # clock; whether the path stays disabled for the process is the
+            # caller's call (_note_fast_failure / _auto_verify_and_pin)
             nonlocal fplan, statics, carry, use_chunks, xs, dispatch_start
-            _FAST_AUTO["disabled"] = True
             fplan = None
             statics = statics_to_device(compiled)
             carry = carry_init(compiled)
@@ -361,14 +431,18 @@ class JaxBackend:
                 # A Mosaic lowering/compile rejection on this backend must
                 # degrade to the XLA scan, not crash the process: an abrupt
                 # exit mid-device-context has wedged the axon tunnel before
-                # (round-4 capture, BASELINE.md).
+                # (round-4 capture, BASELINE.md). _note_fast_failure
+                # decides whether the failure disables the path for the
+                # process (compile/lowering) or allows retries (transient).
                 log.warning("pallas fast path failed on this backend "
                             "(%s: %s); falling back to the XLA scan",
                             type(exc).__name__, exc)
+                _note_fast_failure(exc)
                 _discard_fast_path()
             else:
+                _FAST_AUTO["transient"] = 0
                 if fast_verify and not _auto_verify_and_pin(
-                        config, compiled, cols, choices, counts):
+                        config, compiled, cols, choices, counts, fast_sig):
                     # the kernel lowered but miscomputed: the guardrail
                     # already disabled it process-wide; rerun on XLA
                     _discard_fast_path()

@@ -168,6 +168,95 @@ class FastPlan:
     zone_onehot: Optional[np.ndarray] = None  # [Zpad, Npad] int32; row 0 =
     #                                           the unlabeled dom-0 sentinel
     n_zone_doms: int = 0         # Zpad (sublane-padded)
+    # per-axis gcds the int32 reduction divided by — the preemption hybrid
+    # re-arms its carry from refreshed ORIGINAL-unit aggregates by dividing
+    # through these (exact when every placed pod's request was folded into
+    # the gcd via plan_fast's placed_pods; rearm_carry verifies anyway)
+    gcds: Tuple[int, int, int, int] = (1, 1, 1, 1)   # cpu, mem, gpu, eph
+    scalar_gcds: Tuple[int, ...] = ()
+
+
+@dataclass
+class FastCarry:
+    """Device/host carry state threaded through fast_scan calls: the seven
+    [1, Npad] node rows, the rr misc row, and the optional scalar / group
+    -presence rows. Arrays may be numpy (fresh/re-armed) or jax device
+    arrays (chained from a previous call's carry_out)."""
+
+    rows: list               # [used_c, used_m, used_g, used_e, nz_c, nz_m, pc]
+    misc: object             # [1, LANES] int32; rr at [0, 0]
+    scal: Optional[object] = None    # [Srows, Npad] int32
+    pres: Optional[object] = None    # [Gpad, Npad] int32
+
+
+def init_carry(plan: FastPlan, rr: int = 0) -> FastCarry:
+    """The carry at the plan's initial cluster state."""
+    misc = np.zeros((1, LANES), dtype=np.int32)
+    misc[0, 0] = rr
+    return FastCarry(
+        rows=[plan.used_cpu, plan.used_mem, plan.used_gpu, plan.used_eph,
+              plan.nonzero_cpu, plan.nonzero_mem, plan.pod_count],
+        misc=misc,
+        scal=plan.used_scalar if plan.num_scalars else None,
+        pres=plan.presence if plan.num_groups else None)
+
+
+def rearm_carry(plan: FastPlan, compiled, rr: int) -> Optional[FastCarry]:
+    """Rebuild the carry from a refreshed CompiledCluster's ORIGINAL-unit
+    dynamic aggregates (IncrementalCluster.refresh_dynamic after preemption
+    churn: binds streamed in as ADDED, victims as DELETED). Every value must
+    divide exactly by the plan's per-axis gcd and stay inside the int32
+    budget — guaranteed when plan_fast folded all placed pods' requests into
+    the gcds, verified here regardless. Returns None when the refreshed
+    state can't be expressed in plan units (caller re-plans or falls back).
+    """
+    d = compiled.dynamic
+    n = plan.num_nodes
+    npad = plan.alloc_cpu.shape[1]
+
+    def reduce_row(agg, g):
+        a = np.asarray(agg, dtype=np.int64)
+        if g > 1:
+            if (a % g).any():
+                return None
+            a = a // g
+        if a.size and int(a.max(initial=0)) >= INT_LIMIT:
+            return None
+        out = np.zeros((1, npad), dtype=np.int32)
+        out[0, :n] = a.astype(np.int32)
+        return out
+
+    gc, gm, gg, ge = plan.gcds
+    rows = [reduce_row(d.used_cpu, gc), reduce_row(d.used_mem, gm),
+            reduce_row(d.used_gpu, gg), reduce_row(d.used_eph, ge),
+            reduce_row(d.nonzero_cpu, gc), reduce_row(d.nonzero_mem, gm),
+            reduce_row(d.pod_count, 1)]
+    if any(r is None for r in rows):
+        return None
+    scal = None
+    if plan.num_scalars:
+        srows = plan.used_scalar.shape[0]
+        scal = np.zeros((srows, npad), dtype=np.int32)
+        us = np.asarray(d.used_scalar, dtype=np.int64)
+        for si, g in enumerate(plan.scalar_gcds):
+            col = us[:, si]
+            if g > 1:
+                if (col % g).any():
+                    return None
+                col = col // g
+            if col.size and int(col.max(initial=0)) >= INT_LIMIT:
+                return None
+            scal[si, :n] = col.astype(np.int32)
+    pres = None
+    if plan.num_groups:
+        gt = compiled.groups
+        if gt.presence.shape[0] > plan.num_groups:
+            return None  # group universe grew: the plan's rows are stale
+        pres = np.zeros((plan.num_groups, npad), dtype=np.int32)
+        pres[:gt.presence.shape[0], :n] = gt.presence.astype(np.int32)
+    misc = np.zeros((1, LANES), dtype=np.int32)
+    misc[0, 0] = rr
+    return FastCarry(rows=rows, misc=misc, scal=scal, pres=pres)
 
 
 def _gcd_reduce(arrays) -> Tuple[int, list]:
@@ -181,9 +270,45 @@ def _gcd_reduce(arrays) -> Tuple[int, list]:
     return g, [np.asarray(a, dtype=np.int64) // g for a in arrays]
 
 
+def placed_pod_values(placed_pods, scalar_names) -> dict:
+    """Per-pod request values of already-placed pods, by axis — folded into
+    plan_fast's gcds so a preemption victim's deletion keeps every refreshed
+    aggregate an exact multiple of the reduction unit (the staged round-5
+    design: victim-adjusted sums then divide exactly)."""
+    from tpusim.engine.resources import (
+        get_nonzero_pod_request,
+        get_resource_request,
+    )
+
+    vals = {"cpu": [], "mem": [], "gpu": [], "eph": [],
+            "scalar": [[] for _ in scalar_names]}
+    idx = {name: i for i, name in enumerate(scalar_names)}
+    for pod in placed_pods:
+        req = get_resource_request(pod)
+        nz = get_nonzero_pod_request(pod)
+        vals["cpu"] += [req.milli_cpu, nz.milli_cpu]
+        vals["mem"] += [req.memory, nz.memory]
+        vals["gpu"].append(req.nvidia_gpu)
+        vals["eph"].append(req.ephemeral_storage)
+        for name, v in (req.scalar or {}).items():
+            if name in idx:
+                vals["scalar"][idx[name]].append(v)
+    return {"cpu": np.asarray(vals["cpu"], dtype=np.int64),
+            "mem": np.asarray(vals["mem"], dtype=np.int64),
+            "gpu": np.asarray(vals["gpu"], dtype=np.int64),
+            "eph": np.asarray(vals["eph"], dtype=np.int64),
+            "scalar": [np.asarray(col, dtype=np.int64)
+                       for col in vals["scalar"]]}
+
+
 def plan_fast(config: EngineConfig, compiled: CompiledCluster,
-              cols: PodColumns) -> Tuple[Optional[FastPlan], str]:
-    """Build the int32 plan, or (None, reason) when ineligible."""
+              cols: PodColumns, placed_pods=None
+              ) -> Tuple[Optional[FastPlan], str]:
+    """Build the int32 plan, or (None, reason) when ineligible.
+
+    placed_pods: pods already bound in the snapshot (preemption callers) —
+    their per-pod request/nonzero values join the gcd reduction so victim
+    deletions keep refreshed aggregates expressible in plan units."""
     if config.policy is not None:
         return None, "policy configured"
     # interpod carries [G, K, D] topo-domain state and maxpd a [N, V] volume
@@ -221,22 +346,37 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
                       f"({PAD_SENTINEL_BIT - NUM_FIXED_BITS})")
     s, t, d = compiled.statics, compiled.tables, compiled.dynamic
 
-    g_cpu, (ac, rc, nzc, uc, nzuc) = _gcd_reduce(
-        [s.alloc_cpu, cols.req_cpu, cols.nz_cpu, d.used_cpu, d.nonzero_cpu])
-    g_mem, (am, rm, nzm, um, nzum) = _gcd_reduce(
-        [s.alloc_mem, cols.req_mem, cols.nz_mem, d.used_mem, d.nonzero_mem])
-    g_gpu, (ag, rg, ug) = _gcd_reduce([s.alloc_gpu, cols.req_gpu, d.used_gpu])
-    g_eph, (ae, re_, ue) = _gcd_reduce([s.alloc_eph, cols.req_eph, d.used_eph])
+    placed = (placed_pod_values(placed_pods, compiled.scalar_names)
+              if placed_pods else None)
+
+    def axis(key):
+        # extra per-placed-pod values join the gcd but are discarded after
+        # (only the gcd itself matters for them)
+        return [placed[key]] if placed is not None else []
+
+    g_cpu, (ac, rc, nzc, uc, nzuc, *_) = _gcd_reduce(
+        [s.alloc_cpu, cols.req_cpu, cols.nz_cpu, d.used_cpu, d.nonzero_cpu]
+        + axis("cpu"))
+    g_mem, (am, rm, nzm, um, nzum, *_) = _gcd_reduce(
+        [s.alloc_mem, cols.req_mem, cols.nz_mem, d.used_mem, d.nonzero_mem]
+        + axis("mem"))
+    g_gpu, (ag, rg, ug, *_) = _gcd_reduce(
+        [s.alloc_gpu, cols.req_gpu, d.used_gpu] + axis("gpu"))
+    g_eph, (ae, re_, ue, *_) = _gcd_reduce(
+        [s.alloc_eph, cols.req_eph, d.used_eph] + axis("eph"))
     # each scalar axis reduces independently (fit comparisons never mix axes)
     scal_cols = []
+    scal_gcds = []
     if n_scal:
         ascal = np.asarray(s.alloc_scalar, dtype=np.int64).reshape(-1, n_scal)
         rscal = np.asarray(cols.req_scalar, dtype=np.int64).reshape(-1, n_scal)
         uscal = np.asarray(d.used_scalar, dtype=np.int64).reshape(-1, n_scal)
         for si in range(n_scal):
-            _, (a_s, r_s, u_s) = _gcd_reduce(
-                [ascal[:, si], rscal[:, si], uscal[:, si]])
+            extra = [placed["scalar"][si]] if placed is not None else []
+            g_s, (a_s, r_s, u_s, *_) = _gcd_reduce(
+                [ascal[:, si], rscal[:, si], uscal[:, si]] + extra)
             scal_cols.append((a_s, r_s, u_s))
+            scal_gcds.append(g_s)
 
     checks = [("cpu", (ac, rc, nzc, uc, nzuc)),
               ("memory", (am, rm, nzm, um, nzum)),
@@ -400,6 +540,7 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
         presence=presence, gid=gid, port_row=port_row, disk_row=disk_row,
         ss_row=ss_row, zone_ok_tbl=zone_ok_tbl, zone_onehot=zone_onehot,
         n_zone_doms=zpad if config.has_services else 0,
+        gcds=(g_cpu, g_mem, g_gpu, g_eph), scalar_gcds=tuple(scal_gcds),
     )
     return plan, ""
 
@@ -837,14 +978,24 @@ def verify_against_xla(config, compiled, cols, choices, counts,
 
 
 def fast_scan(plan: FastPlan, chunk: int = 0,
-              interpret: Optional[bool] = None, progress=None
-              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Run the full pod batch; returns (choices[P], counts[P,B], advanced[P]).
+              interpret: Optional[bool] = None, progress=None,
+              start: int = 0, stop: Optional[int] = None,
+              carry_in: Optional[FastCarry] = None,
+              return_carry: bool = False, fixed_chunk: bool = False):
+    """Run pods [start, stop) of the plan; returns (choices, counts,
+    advanced) over that span, plus the FastCarry out when return_carry.
 
     chunk: pods per kernel invocation (TPUSIM_FAST_CHUNK, default 512 — each
     chunk pregathers its signature rows as [chunk, Npad] int32 arrays, so the
     chunk size bounds that transient HBM footprint). interpret=None
     auto-selects interpreter mode off-TPU (tests run on CPU).
+
+    carry_in: resume from an explicit carry (a previous call's carry_out or
+    rearm_carry after preemption churn) instead of the plan's initial state.
+    fixed_chunk: keep the kernel chunk at exactly `chunk` even when the span
+    is shorter — the preemption hybrid's pow2 buckets then reuse one
+    compiled kernel per bucket size instead of tracing per tail length
+    (ghost padding rows are infeasible everywhere: no carry/rr effect).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -855,6 +1006,9 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
             chunk = 512
     chunk = max(chunk, 1)
     p = plan.num_pods
+    if stop is None:
+        stop = p
+    span = stop - start
     npad = plan.alloc_cpu.shape[1]
     num_bits = NUM_FIXED_BITS + plan.num_scalars
     counts_w = LANES  # lane-aligned histogram row; decode slices [:num_bits]
@@ -862,7 +1016,8 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     # round the chunk up to a SUBLANES multiple (Mosaic block granularity);
     # tail rows ride the existing GHOST_REQ padding (infeasible everywhere,
     # no carry/rr effect)
-    k = -(-min(chunk, max(p, 1)) // SUBLANES) * SUBLANES
+    k = -(-(chunk if fixed_chunk else min(chunk, max(span, 1)))
+          // SUBLANES) * SUBLANES
     gpad = plan.num_groups
     call = _build_call(npad, k, plan.most_requested, num_bits, counts_w,
                        plan.num_scalars, srows, interpret,
@@ -875,15 +1030,15 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     tables = [jnp.asarray(a) for a in (
         plan.selector_ok, plan.taint_ok, plan.intolerable,
         plan.aff_count, plan.avoid_score, plan.host_ok)]
-    carry = [jnp.asarray(a) for a in (
-        plan.used_cpu, plan.used_mem, plan.used_gpu, plan.used_eph,
-        plan.nonzero_cpu, plan.nonzero_mem, plan.pod_count)]
-    misc = jnp.zeros((1, LANES), dtype=jnp.int32)
+    if carry_in is None:
+        carry_in = init_carry(plan)
+    carry = [jnp.asarray(a) for a in carry_in.rows]
+    misc = jnp.asarray(carry_in.misc)
     if plan.num_scalars:
         ascal = jnp.asarray(plan.alloc_scalar)
-        scal_carry = jnp.asarray(plan.used_scalar)
+        scal_carry = jnp.asarray(carry_in.scal)
     if gpad:
-        pres_carry = jnp.asarray(plan.presence)
+        pres_carry = jnp.asarray(carry_in.pres)
         zone_oh = (jnp.asarray(plan.zone_onehot)
                    if plan.has_spread else None)
     zone_tbl = (jnp.asarray(plan.zone_ok_tbl)
@@ -911,7 +1066,10 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     # buffers), so (a) retained HBM stays O(sync_every * chunk), not
     # O(num_pods), (b) the caller's progress/stall watchdog trails real
     # completion by at most sync_every chunks.
-    sync_every = int(os.environ.get("TPUSIM_FAST_SYNC_EVERY", "64"))
+    # clamp to >= 1: 0 would silently disable the drain and retain every
+    # chunk's output buffers on device for the whole run — O(num_pods) HBM,
+    # contradicting the documented O(sync_every * chunk) bound (ADVICE r4)
+    sync_every = max(1, int(os.environ.get("TPUSIM_FAST_SYNC_EVERY", "64")))
     results = []   # host triples (choices[n], counts[n,B], adv[n])
     pending = []   # FIFO of (choices_dev, counts_dev, adv_dev, n_real)
 
@@ -921,9 +1079,9 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
                         np.asarray(ocnt)[:n_real, :num_bits],
                         np.asarray(oadv)[:n_real, 0] != 0))
 
-    num_chunks = -(-p // k) if p else 0
+    num_chunks = -(-span // k) if span > 0 else 0
     for ci in range(num_chunks):
-        sl = slice(ci * k, min((ci + 1) * k, p))
+        sl = slice(start + ci * k, min(start + (ci + 1) * k, stop))
         # ghost padding: infeasible everywhere, no carry/rr effect
         scalars = [
             col(plan.req_cpu[sl], GHOST_REQ), col(plan.req_mem[sl], 0),
@@ -982,8 +1140,16 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     while pending:
         drain_one()
     if not results:
-        return (np.zeros(0, np.int32), np.zeros((0, num_bits), np.int32),
+        out3 = (np.zeros(0, np.int32), np.zeros((0, num_bits), np.int32),
                 np.zeros(0, bool))
-    return (np.concatenate([r[0] for r in results]),
-            np.concatenate([r[1] for r in results]),
-            np.concatenate([r[2] for r in results]))
+    else:
+        out3 = (np.concatenate([r[0] for r in results]),
+                np.concatenate([r[1] for r in results]),
+                np.concatenate([r[2] for r in results]))
+    if not return_carry:
+        return out3
+    carry_out = FastCarry(
+        rows=list(carry), misc=misc,
+        scal=scal_carry if plan.num_scalars else None,
+        pres=pres_carry if gpad else None)
+    return out3 + (carry_out,)

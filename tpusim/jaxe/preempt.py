@@ -229,6 +229,28 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
     chunk_max = max(chunk0,
                     int(os.environ.get("TPUSIM_PREEMPT_CHUNK_MAX", "8192")))
 
+    # Pallas fast path for the speculation chunks (staged round-5 design):
+    # the same kernel the plain batch path runs, driven with explicit
+    # carry-in/out over pow2 buckets; after a preemption the carry re-arms
+    # from refresh_dynamic's original-unit aggregates divided by the plan's
+    # gcds (exact — placed pods' requests joined the gcd fold). Host arms
+    # (victim selection, binds, report) are untouched: placements stay
+    # byte-identical to the XLA hybrid, pinned by the differential suites.
+    from tpusim.jaxe.backend import (
+        _FAST_AUTO,
+        _auto_verify_and_pin,
+        _fast_path_enabled,
+        _note_fast_failure,
+        plan_signature,
+    )
+    from tpusim.jaxe.fastscan import fast_scan, init_carry, plan_fast, rearm_carry
+
+    # placed-pod values for the gcd fold: initial snapshot placements plus
+    # every pod bound during the run (appended at both bind arms below — a
+    # superset is safe: the gcd over a superset still divides every victim
+    # adjustment, so victims are never removed from this list either)
+    placed_for_gcd = [p for p in snapshot.pods if p.spec.node_name]
+
     from time import perf_counter
 
     from tpusim.framework.metrics import since_in_microseconds
@@ -278,28 +300,91 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                 num_reason_bits=num_bits,
                 hard_weight=hard_pod_affinity_symmetric_weight)
             ensure_x64()
-            statics = statics_to_device(compiled)
-            xs_all = pod_columns_to_host(cols)
             strings = reason_strings(compiled.scalar_names)
             names = compiled.statics.names
-            base = pos            # xs_all row i holds feed[base + i]
-            carry = carry_init(compiled)._replace(rr=np.int64(rr_start))
+            base = pos            # plan/column row i holds feed[base + i]
+
+            # fast-path decision BEFORE the statics upload (same rule as
+            # backend.schedule): when the kernel engages, the XLA-scan
+            # inputs are never materialized
+            fplan = fcarry = fsig = None
+            fverify = False
+            fast_on, auto_mode = _fast_path_enabled()
+            if fast_on:
+                fplan, why = plan_fast(config, compiled, cols,
+                                       placed_pods=placed_for_gcd)
+                if fplan is None:
+                    log.info("preemption fast path ineligible (%s); using "
+                             "the XLA scan", why)
+                else:
+                    fsig = plan_signature(fplan)
+                    if (auto_mode
+                            and fsig not in _FAST_AUTO["verified_sigs"]
+                            and not (pos == 0 and rr_start == 0)):
+                        # verification replays from carry_init (rr=0): an
+                        # unverified variant can only earn trust on the
+                        # run's very first chunk — later compiles of an
+                        # untrusted variant stay on the XLA scan
+                        log.info("preemption fast path deferred: kernel "
+                                 "variant unverified and the run is past "
+                                 "its first chunk")
+                        fplan = fsig = None
+                    else:
+                        fcarry = init_carry(fplan, rr=rr_start)
+                        fverify = (auto_mode and fsig
+                                   not in _FAST_AUTO["verified_sigs"])
+            statics = xs_all = carry = None
+            if fplan is None:
+                statics = statics_to_device(compiled)
+                xs_all = pod_columns_to_host(cols)
+                carry = carry_init(compiled)._replace(rr=np.int64(rr_start))
             chunk = chunk0
 
             while pos < len(feed):
                 take = min(chunk, len(feed) - pos)
                 off = pos - base
-                sl = PodX(*(a[off:off + take] for a in xs_all))
                 dispatch_start = perf_counter()
-                # pow2 buckets bound XLA recompiles to O(log chunk_max):
-                # arbitrary tail lengths after a preemption would otherwise
-                # each trace a fresh program (infeasible pad rows never bind
-                # or advance rr)
+                # pow2 buckets bound recompiles to O(log chunk_max) on both
+                # engines: arbitrary tail lengths after a preemption would
+                # otherwise each trace a fresh program (infeasible pad rows
+                # never bind or advance rr)
                 bucket = _next_pow2(take)
-                sl = pad_infeasible_rows(sl, bucket - take)
-                xs = PodX(*(jnp.asarray(a) for a in sl))
-                carry_out, choices, counts, advanced = schedule_scan(
-                    config, carry, statics, xs)
+                if fplan is not None:
+                    try:
+                        choices, counts, advanced, fc_out = fast_scan(
+                            fplan, chunk=bucket, start=off, stop=off + take,
+                            carry_in=fcarry, return_carry=True,
+                            fixed_chunk=True)
+                    except Exception as exc:
+                        # degrade without crashing mid-device-context; the
+                        # outer loop recompiles feed[pos:] and re-decides
+                        # the engine (disabled after compile/lowering or
+                        # repeated transient failures)
+                        log.warning("preemption fast path failed (%s: %s); "
+                                    "re-running on the XLA scan",
+                                    type(exc).__name__, exc)
+                        _note_fast_failure(exc)
+                        break
+                    _FAST_AUTO["transient"] = 0
+                    if fverify:
+                        # ONCE, on the run's first chunk only (the plan
+                        # gate guarantees pos==0, rr_start==0 there):
+                        # verify_against_xla replays the LEADING pods from
+                        # carry_init, which matches no later chunk's
+                        # chained-carry state — comparing those would be
+                        # pods-vs-different-pods
+                        fverify = False
+                        if not _auto_verify_and_pin(
+                                config, compiled, cols, choices, counts,
+                                fsig, limit=take):
+                            break
+                    carry_out = fc_out
+                else:
+                    sl = PodX(*(a[off:off + take] for a in xs_all))
+                    sl = pad_infeasible_rows(sl, bucket - take)
+                    xs = PodX(*(jnp.asarray(a) for a in sl))
+                    carry_out, choices, counts, advanced = schedule_scan(
+                        config, carry, statics, xs)
                 choices = np.asarray(choices)[:take]
                 counts = np.asarray(counts)[:take]
                 advanced = np.asarray(advanced)[:take]
@@ -316,6 +401,7 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                         placed, _ = cc.resource_store.get(ResourceType.PODS,
                                                           pod.key())
                         inc.apply(ADDED, placed)
+                        placed_for_gcd.append(placed)
                         placed_priorities[get_pod_priority(placed)] += 1
                         if bound is not None:
                             bound.update(placed, +1)
@@ -367,6 +453,7 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                         placed, _ = cc.resource_store.get(ResourceType.PODS,
                                                           pod.key())
                         inc.apply(ADDED, placed)
+                        placed_for_gcd.append(placed)
                         placed_priorities[get_pod_priority(placed)] += 1
                         if bound is not None:
                             bound.update(placed, +1)
@@ -402,7 +489,10 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
 
                 if not mutated:
                     pos += take
-                    carry = carry_out
+                    if fplan is not None:
+                        fcarry = carry_out
+                    else:
+                        carry = carry_out
                     rr_start += int(np.sum(advanced))
                     chunk = min(chunk * 2, chunk_max)
                     continue
@@ -415,7 +505,18 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                 if refreshed is None:
                     break
                 compiled = refreshed
-                carry = carry_init(compiled)._replace(rr=np.int64(rr_start))
+                if fplan is not None:
+                    # original-unit aggregates -> plan units via the stored
+                    # gcds (exact by the placed-pod fold; verified anyway)
+                    fcarry = rearm_carry(fplan, compiled, rr_start)
+                    if fcarry is None:
+                        log.info("preemption fast path: refreshed state "
+                                 "not expressible in plan units; "
+                                 "recompiling")
+                        break
+                else:
+                    carry = carry_init(compiled)._replace(
+                        rr=np.int64(rr_start))
                 chunk = chunk0
 
 

@@ -328,10 +328,12 @@ def _try_fast_loop(scenarios, config, batch_indices, compiled_list,
         _FAST_AUTO,
         _auto_verify_and_pin,
         _fast_path_enabled,
+        _note_fast_failure,
+        plan_signature,
     )
     from tpusim.jaxe.fastscan import fast_scan, plan_fast
 
-    fast_on, fast_verify = _fast_path_enabled()
+    fast_on, auto_mode = _fast_path_enabled()
     if not fast_on:
         return None
     plans = []
@@ -351,15 +353,17 @@ def _try_fast_loop(scenarios, config, batch_indices, compiled_list,
             log.warning("what-if fast loop failed (%s: %s); falling back "
                         "to the batched vmap program",
                         type(exc).__name__, exc)
-            _FAST_AUTO["disabled"] = True
+            _note_fast_failure(exc)
             return None
-        if fast_verify and not _FAST_AUTO["verified"]:
-            # every scenario verifies until one is big enough to pin
-            # process-wide trust — a small scenario 0 passing trivially
-            # must not exempt the rest of the batch
+        _FAST_AUTO["transient"] = 0
+        sig = plan_signature(plan)
+        if auto_mode and sig not in _FAST_AUTO["verified_sigs"]:
+            # every scenario verifies until its kernel variant is trusted —
+            # a small scenario 0 passing trivially must not exempt the rest
+            # of the batch (trust pins only at TPUSIM_FAST_VERIFY_MIN+ pods)
             compiled, cols = compiled_list[b]
             if not _auto_verify_and_pin(config, compiled, cols,
-                                        choices, counts):
+                                        choices, counts, sig):
                 return None
         choices_list.append(choices)
         counts_list.append(counts)

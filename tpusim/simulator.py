@@ -27,8 +27,10 @@ from tpusim.engine.policy import Policy
 from tpusim.engine.providers import (
     DEFAULT_PROVIDER,
     PluginFactoryArgs,
+    apply_feature_gates,
     create_from_config,
     create_from_provider,
+    default_registry,
 )
 from tpusim.engine.resources import NodeInfo
 from tpusim.framework.events import Recorder
@@ -59,6 +61,10 @@ class SchedulerServerConfig:
     # VolumeScheduling feature gate (scheduler.go:175; off in the reference's
     # 1.10 defaults): enables CheckVolumeBinding + delayed-binding semantics
     enable_volume_scheduling: bool = False
+    # registry-surgery gates (ApplyFeatureGates, defaults.go:181-205):
+    # TaintNodesByCondition / ResourceLimitsPriorityFunction — both default
+    # off in this k8s vintage; applied before provider/policy assembly
+    feature_gates: Optional[Dict[str, bool]] = None
 
 
 class ClusterCapacity:
@@ -144,15 +150,21 @@ class ClusterCapacity:
         ] if config.policy is not None else []
         self.scheduling_queue = new_scheduling_queue(config.enable_pod_priority)
         self.pod_backoff = PodBackoff()  # MakeDefaultErrorFunc's backoff state
+        registry = None
+        if config.feature_gates:
+            # ApplyFeatureGates runs before provider/policy assembly, like
+            # the scheduler app (defaults.go:181-205)
+            registry = default_registry()
+            apply_feature_gates(registry, config.feature_gates)
         if config.policy is not None:
             # AlgorithmSource.Policy path (simulator.go:383-424 →
             # factory.go CreateFromConfig)
             self.scheduler: GenericScheduler = create_from_config(
-                config.policy, args,
+                config.policy, args, registry=registry,
                 extender_transport=config.extender_transport)
         else:
             self.scheduler = create_from_provider(
-                config.algorithm_provider, args)
+                config.algorithm_provider, args, registry=registry)
         self.scheduler.scheduling_queue = self.scheduling_queue
         if config.enable_equivalence_cache:
             self.scheduler.equivalence_cache = EquivalenceCache(
@@ -468,7 +480,8 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    enable_pod_priority: bool = False,
                    enable_volume_scheduling: bool = False,
                    policy: Optional[Policy] = None,
-                   events: Optional[list] = None) -> Status:
+                   events: Optional[list] = None,
+                   feature_gates: Optional[Dict[str, bool]] = None) -> Status:
     """High-level entry: run `pods` (in podspec order; the LIFO feed reversal
     happens inside, matching the reference) against `snapshot` and return the
     final Status. backend='jax' routes the batch through the TPU engine and
@@ -500,6 +513,21 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    if auto_routes_to_host(len(pods), len(snapshot.nodes),
                                           enable_volume_scheduling)
                    else "jax")
+    if feature_gates and any(feature_gates.get(g) for g in
+                             ("TaintNodesByCondition",
+                              "ResourceLimitsPriorityFunction")) \
+            and backend == "jax":
+        # registry surgery is host-registry-bound; the gated predicate/
+        # priority sets have no compiled device shape (both gates default
+        # off upstream, so the ungated device engine matches executed
+        # reference behavior)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "feature gates %s are host-bound: running the reference "
+            "orchestrator instead of the jax backend",
+            sorted(k for k, v in feature_gates.items() if v))
+        backend = "reference"
     compiled_policy = None
     if policy is not None and backend == "jax":
         # compile (and validate) the policy for the device engine; the one
@@ -525,7 +553,8 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                                   algorithm_provider=provider,
                                   policy=policy,
                                   enable_pod_priority=enable_pod_priority,
-                                  enable_volume_scheduling=enable_volume_scheduling),
+                                  enable_volume_scheduling=enable_volume_scheduling,
+                                  feature_gates=feature_gates),
             new_pods=pods, scheduled_pods=snapshot.pods, nodes=snapshot.nodes,
             services=snapshot.services, pvs=snapshot.pvs, pvcs=snapshot.pvcs,
             storage_classes=snapshot.storage_classes)

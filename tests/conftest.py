@@ -18,6 +18,15 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compile cache for the suite (interpreter-mode Pallas
+# kernels trace+compile in 30-90s per variant; cached, a re-run pays a
+# disk hit instead). TPUSIM_COMPILE_CACHE="" opts out; tpusim.jaxe reads
+# this at import and enables jax_compilation_cache_dir.
+os.environ.setdefault(
+    "TPUSIM_COMPILE_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -167,7 +167,9 @@ def test_empty_pod_batch():
     assert choices.shape == (0,) and counts.shape == (0, NUM_FIXED_BITS)
 
 
-def test_ineligible_workloads_report_reasons():
+def test_ineligible_workloads_report_reasons(monkeypatch):
+    # interpod is fast-path-native since round 5; budget overruns still
+    # report a reason (the topo-dom budget here, forced to 1)
     nodes = [make_node("n0")]
     pods = [make_pod("p0", milli_cpu=100, memory=2**20, labels={"app": "a"},
                      affinity={"podAffinity": {
@@ -178,8 +180,11 @@ def test_ineligible_workloads_report_reasons():
     config = config_for([compiled], most_requested=False,
                         num_reason_bits=NUM_FIXED_BITS)
     plan, reason = plan_fast(config, compiled, cols)
+    assert plan is not None
+    monkeypatch.setenv("TPUSIM_FAST_MAX_TOPO_DOMS", "1")
+    plan, reason = plan_fast(config, compiled, cols)
     assert plan is None
-    assert "has_interpod" in reason
+    assert "topology domains exceed" in reason
 
 
 def test_scalar_resources_eligible_and_exact():
@@ -624,3 +629,99 @@ def test_trust_is_per_kernel_signature(monkeypatch):
     assert backend_._FAST_AUTO["disabled"] is False
     other = (sig[0] + 128,) + sig[1:]
     assert other not in sigs
+
+
+def test_fuzz_interpod_fast_path_parity():
+    """Randomized inter-pod (anti)affinity workloads — required affinity /
+    anti-affinity, preferred terms with signed weights, hostname and label
+    topologies, pre-placed pods — through plan_fast/fast_scan vs the XLA
+    scan, bit-for-bit (round 5). TPUSIM_FUZZ_SEEDS scales the sweep."""
+    import os
+    import random
+
+    seeds = max(int(os.environ.get("TPUSIM_FUZZ_SEEDS", "3")), 1)
+    skipped = 0
+    for seed in range(min(seeds, 25)):
+        rng = random.Random(7100 + seed)
+        # kept small on purpose: every distinct group universe bakes its
+        # own kernel variant (exist-side tables are compile-time
+        # constants), and an interpreter-mode variant traces in ~1-2 min
+        # at Gpad 16 — diversity comes from seeds, not per-seed size
+        n_nodes = rng.randint(4, 8)
+        nodes = []
+        for i in range(n_nodes):
+            labels = {"rack": f"r{i % rng.choice([2, 3])}"}
+            if rng.random() < 0.8:
+                labels["zone"] = f"z{i % 3}"
+            nodes.append(make_node(
+                f"n{i}", milli_cpu=rng.choice([2000, 4000, 8000]),
+                memory=rng.choice([4, 8]) * 1024**3,
+                labels=labels))
+        apps = [f"a{j}" for j in range(2)]
+
+        def term(required=True):
+            t = {"labelSelector":
+                 {"matchLabels": {"app": rng.choice(apps)}},
+                 "topologyKey": rng.choice(
+                     ["zone", "rack", "kubernetes.io/hostname"])}
+            if required:
+                return t
+            return {"weight": rng.choice([-50, -1, 1, 10, 100]),
+                    "podAffinityTerm": t}
+
+        def affinity():
+            aff = {}
+            r = rng.random()
+            if r < 0.3:
+                aff["podAffinity"] = {
+                    "requiredDuringSchedulingIgnoredDuringExecution":
+                        [term() for _ in range(rng.randint(1, 2))]}
+            elif r < 0.55:
+                aff["podAntiAffinity"] = {
+                    "requiredDuringSchedulingIgnoredDuringExecution":
+                        [term() for _ in range(rng.randint(1, 2))]}
+            elif r < 0.8:
+                key = rng.choice(["podAffinity", "podAntiAffinity"])
+                aff[key] = {
+                    "preferredDuringSchedulingIgnoredDuringExecution":
+                        [term(False) for _ in range(rng.randint(1, 2))]}
+            return aff or None
+
+        existing = []
+        for i in range(rng.randint(0, 4)):
+            kw = {"labels": {"app": rng.choice(apps)}}
+            a = affinity()
+            if a:
+                kw["affinity"] = a
+            existing.append(make_pod(
+                f"e{i}", node_name=f"n{i % n_nodes}", phase="Running",
+                milli_cpu=100, **kw))
+        pods = []
+        for i in range(rng.randint(10, 16)):
+            kw = {"labels": {"app": rng.choice(apps)}}
+            a = affinity()
+            if a:
+                kw["affinity"] = a
+            pods.append(make_pod(
+                f"p{i}", milli_cpu=rng.randrange(1, 8) * 100,
+                memory=rng.randrange(1, 8) * 2**26, **kw))
+        snap = ClusterSnapshot(nodes=nodes, pods=existing)
+        compiled, cols = compile_cluster(snap, pods)
+        assert not compiled.unsupported, compiled.unsupported
+        config = config_for(
+            [compiled], most_requested=bool(rng.getrandbits(1)),
+            num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+        assert config.has_interpod
+        plan, reason = plan_fast(config, compiled, cols)
+        if plan is None:
+            skipped += 1
+            continue
+        _, choices, counts, advanced = schedule_scan(
+            config, carry_init(compiled), statics_to_device(compiled),
+            pod_columns_to_device(cols))
+        f_choices, f_counts, f_adv = fast_scan(plan, chunk=16)
+        assert np.array_equal(f_choices, np.asarray(choices)), f"seed {seed}"
+        assert np.array_equal(f_counts, np.asarray(counts)), f"seed {seed}"
+        assert np.array_equal(f_adv, np.asarray(advanced)), f"seed {seed}"
+    assert skipped <= max(1, min(seeds, 25) // 2), \
+        f"{skipped} of {min(seeds, 25)} seeds fell back"

@@ -105,3 +105,25 @@ def test_taint_gate_end_to_end():
     assert "NodeNotReady" in msg_off or "node(s) were not ready" in msg_off
     assert "taint" in msg_on
     assert msg_on != msg_off
+
+
+def test_run_simulation_gate_aliases():
+    """Library callers passing PodPriority via feature_gates get preemption
+    without going through the CLI's alias mapping."""
+    from tpusim.simulator import run_simulation
+
+    node = make_node("n1", milli_cpu=1000, memory=4 * 1024**3)
+    low = make_pod("low", milli_cpu=1000, memory=1024**2)
+    low.spec.node_name = "n1"
+    low.spec.priority = 0
+    hi = make_pod("hi", milli_cpu=1000, memory=1024**2)
+    hi.spec.priority = 1000
+    from tpusim.api.snapshot import ClusterSnapshot
+
+    snap = ClusterSnapshot(nodes=[node], pods=[low])
+    st_off = run_simulation([hi], snap, backend="reference")
+    assert len(st_off.failed_pods) == 1  # no preemption without the gate
+    st_on = run_simulation([hi], snap, backend="reference",
+                           feature_gates={"PodPriority": True})
+    assert [p.metadata.name for p in st_on.successful_pods] == ["hi"]
+    assert [p.metadata.name for p in st_on.preempted_pods] == ["low"]

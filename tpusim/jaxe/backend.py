@@ -73,9 +73,20 @@ def plan_signature(plan) -> tuple:
     cache key's semantic axes (node padding, feature flags, scalar/group
     widths) — a Mosaic miscompile is per compiled variant, so verification
     of one variant must not exempt another."""
-    return (plan.alloc_cpu.shape[1], plan.most_requested, plan.num_scalars,
-            plan.num_groups, plan.n_zone_doms, plan.has_ports,
-            plan.has_disk, plan.has_spread, plan.has_vol_zone)
+    sig = (plan.alloc_cpu.shape[1], plan.most_requested, plan.num_scalars,
+           plan.num_groups, plan.n_zone_doms, plan.has_ports,
+           plan.has_disk, plan.has_spread, plan.has_vol_zone)
+    if plan.has_interpod:
+        # the exist-side tables and hard weight are BAKED into the compiled
+        # kernel (part of the _build_call cache key): same dims with
+        # different constants is a different Mosaic program and must earn
+        # trust separately
+        sig += (plan.n_topo_keys, plan.n_topo_doms_ip, plan.ta, plan.tb,
+                plan.tp, plan.hard_weight, plan.exist_anti_key,
+                plan.exist_anti_mask, plan.exist_anti_empty,
+                plan.exist_pref_key, plan.exist_pref_w,
+                plan.exist_aff_key, plan.exist_aff_mask)
+    return sig
 
 
 def _note_fast_failure(exc: Exception) -> None:

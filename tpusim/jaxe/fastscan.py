@@ -18,7 +18,7 @@ Eligibility (checked by `plan_fast`, reasons returned):
     (incl. the zone blend), and NoVolumeZoneConflict — per-pod group rows
     stream through SMEM and all group state is accessed via statically
     -unrolled loops over Gpad with (g == gid)-masked row ops (no dynamic
-    indexing; Mosaic-safe). Bounded by TPUSIM_FAST_MAX_GROUPS (16) merged
+    indexing; Mosaic-safe). Bounded by TPUSIM_FAST_MAX_GROUPS (32) merged
     groups / TPUSIM_FAST_MAX_ZONES (16) zone domains, and the spread
     blend's int32 product bound. Still host/XLA-bound: inter-pod
     (anti)affinity ([G,K,D] topo state), maxpd volume counts ([N,V]
@@ -77,8 +77,12 @@ from tpusim.jaxe.kernels import (
     EngineConfig,
 )
 from tpusim.jaxe.state import (
+    BIT_AFFINITY_NOT_MATCH,
+    BIT_AFFINITY_RULES,
+    BIT_ANTI_AFFINITY_RULES,
     BIT_DISK_CONFLICT,
     BIT_DISK_PRESSURE,
+    BIT_EXISTING_ANTI_AFFINITY,
     BIT_HOSTNAME_MISMATCH,
     BIT_HOST_PORTS,
     BIT_INSUFFICIENT_CPU,
@@ -174,6 +178,34 @@ class FastPlan:
     # the gcd via plan_fast's placed_pods; rearm_carry verifies anyway)
     gcds: Tuple[int, int, int, int] = (1, 1, 1, 1)   # cpu, mem, gpu, eph
     scalar_gcds: Tuple[int, ...] = ()
+    # inter-pod (anti)affinity (round 5). Own required/preferred terms run
+    # through per-pod match rows + domain segment sums recomputed from the
+    # presence carry (dc_at == broadcast-back of the per-domain sums of
+    # mcount — identical to the XLA path's _seg_rows + take_along_axis);
+    # the existing-pods side (their anti-affinity / preferred terms vs ME)
+    # rides a [Gpad*K, Dpad] presence_dom carry with the per-(group, term)
+    # keys, weights, and validity masks baked into the kernel as static
+    # constants, so per-pod operands reduce to pure match bits.
+    has_interpod: bool = False
+    n_topo_keys: int = 0           # K (rows of topo_dom; Gpad*K presence_dom rows)
+    n_topo_doms_ip: int = 0        # REAL domain count incl. the invalid-0
+    #                                bucket (the unroll bound; presence_dom's
+    #                                lane axis is padded to 128 separately)
+    ta: int = 0                    # own required-affinity term slots
+    tb: int = 0                    # own required-anti-affinity term slots
+    tp: int = 0                    # own preferred term slots
+    hard_weight: int = 10
+    topo_rows: Optional[np.ndarray] = None       # [Kpad8, Npad] int32 dom ids
+    presence_dom: Optional[np.ndarray] = None    # [Gpad*K, Dpad] int32 init
+    ipod: Optional[np.ndarray] = None            # [P, Wip] per-pod packed row
+    # static exist-side tables (baked into the kernel; part of its cache key)
+    exist_anti_key: Tuple[int, ...] = ()     # [G*Tb] topo-key per term
+    exist_anti_mask: Tuple[int, ...] = ()    # [G*Tb] valid & ~empty
+    exist_anti_empty: Tuple[int, ...] = ()   # [G*Tb] valid & empty (fail_all)
+    exist_pref_key: Tuple[int, ...] = ()     # [G*Tp]
+    exist_pref_w: Tuple[int, ...] = ()       # [G*Tp] signed int weights
+    exist_aff_key: Tuple[int, ...] = ()      # [G*Ta]
+    exist_aff_mask: Tuple[int, ...] = ()     # [G*Ta] valid & ~empty
 
 
 @dataclass
@@ -187,6 +219,7 @@ class FastCarry:
     misc: object             # [1, LANES] int32; rr at [0, 0]
     scal: Optional[object] = None    # [Srows, Npad] int32
     pres: Optional[object] = None    # [Gpad, Npad] int32
+    pd: Optional[object] = None      # [Gpad*K, Dpad] int32 (interpod)
 
 
 def init_carry(plan: FastPlan, rr: int = 0) -> FastCarry:
@@ -198,7 +231,8 @@ def init_carry(plan: FastPlan, rr: int = 0) -> FastCarry:
               plan.nonzero_cpu, plan.nonzero_mem, plan.pod_count],
         misc=misc,
         scal=plan.used_scalar if plan.num_scalars else None,
-        pres=plan.presence if plan.num_groups else None)
+        pres=plan.presence if plan.num_groups else None,
+        pd=plan.presence_dom if plan.has_interpod else None)
 
 
 def rearm_carry(plan: FastPlan, compiled, rr: int) -> Optional[FastCarry]:
@@ -247,16 +281,62 @@ def rearm_carry(plan: FastPlan, compiled, rr: int) -> Optional[FastCarry]:
             if col.size and int(col.max(initial=0)) >= INT_LIMIT:
                 return None
             scal[si, :n] = col.astype(np.int32)
-    pres = None
+    pres = pd = None
     if plan.num_groups:
         gt = compiled.groups
         if gt.presence.shape[0] > plan.num_groups:
             return None  # group universe grew: the plan's rows are stale
         pres = np.zeros((plan.num_groups, npad), dtype=np.int32)
         pres[:gt.presence.shape[0], :n] = gt.presence.astype(np.int32)
+        if plan.has_interpod:
+            if gt.topo_dom.shape[0] != plan.n_topo_keys:
+                return None  # topology-key universe changed
+            pd = embed_presence_dom(gt.presence, gt.topo_dom,
+                                    plan.n_topo_doms_ip, plan.num_groups,
+                                    plan.presence_dom.shape[1])
     misc = np.zeros((1, LANES), dtype=np.int32)
     misc[0, 0] = rr
-    return FastCarry(rows=rows, misc=misc, scal=scal, pres=pres)
+    return FastCarry(rows=rows, misc=misc, scal=scal, pres=pres, pd=pd)
+
+
+class IpLayout:
+    """Static offsets into the per-pod packed interpod row (int32 lanes).
+
+    Own-term data (my group's required affinity / anti-affinity / preferred
+    terms): match bits vs every group, topo-key ids, and flag bits. Exist
+    -side data (other groups' terms evaluated against ME): pure match bits
+    — their keys, weights, and validity masks are compile-time constants
+    baked into the kernel."""
+
+    def __init__(self, ta: int, tb: int, tp: int, gpad: int):
+        off = 0
+
+        def take(n):
+            nonlocal off
+            at = off
+            off += n
+            return at
+
+        self.aff_match = take(ta * gpad)    # [t*gpad+g]
+        self.aff_key = take(ta)
+        self.aff_valid = take(ta)
+        self.aff_empty = take(ta)
+        self.aff_host = take(ta)
+        self.aff_self = take(ta)
+        self.aff_unpl = take(ta)
+        self.aff_err = take(1)
+        self.anti_match = take(tb * gpad)
+        self.anti_key = take(tb)
+        self.anti_valid = take(tb)
+        self.anti_host = take(tb)
+        self.anti_err = take(1)
+        self.pref_match = take(tp * gpad)
+        self.pref_key = take(tp)
+        self.pref_w = take(tp)              # signed int weights
+        self.ex_anti = take(gpad * tb)      # [g*tb+t] term matches ME
+        self.ex_pref = take(gpad * tp)
+        self.ex_aff = take(gpad * ta)
+        self.width = max(-(-off // LANES) * LANES, LANES)
 
 
 def _gcd_reduce(arrays) -> Tuple[int, list]:
@@ -268,6 +348,21 @@ def _gcd_reduce(arrays) -> Tuple[int, list]:
     if g <= 1:
         return max(g, 1), [np.asarray(a, dtype=np.int64) for a in arrays]
     return g, [np.asarray(a, dtype=np.int64) // g for a in arrays]
+
+
+def embed_presence_dom(presence, topo_dom, d_doms: int, gpad: int,
+                      dpad: int) -> np.ndarray:
+    """[G, K, D] presence_dom -> the kernel's [Gpad*K, Dpad] row-interleaved
+    carry layout (row g*K + k): ONE definition shared by plan_fast and
+    rearm_carry so the embedding can never diverge between the initial
+    plan and a post-preemption re-arm."""
+    from tpusim.jaxe.kernels import _presence_dom_init
+
+    pd3 = _presence_dom_init(presence, topo_dom, d_doms)
+    g, k_keys, _ = pd3.shape
+    out = np.zeros((gpad * k_keys, dpad), dtype=np.int32)
+    out[:g * k_keys, :d_doms] = pd3.reshape(g * k_keys, d_doms)
+    return out
 
 
 def placed_pod_values(placed_pods, scalar_names) -> dict:
@@ -311,25 +406,26 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     deletions keep refreshed aggregates expressible in plan units."""
     if config.policy is not None:
         return None, "policy configured"
-    # interpod carries [G, K, D] topo-domain state and maxpd a [N, V] volume
-    # union — both beyond the kernel's presence model; everything else
-    # group-bound (ports, disk conflicts, spreading, volume zones) runs via
-    # the [Gpad, Npad] presence carry when the group count fits the
-    # unrolled-loop budget
-    for flag in ("has_interpod", "has_maxpd"):
-        if getattr(config, flag):
-            return None, f"pod-group feature {flag}"
+    # maxpd carries a [N, V] per-node volume-id union — beyond the kernel's
+    # presence model; every other pod-group feature (ports, disk conflicts,
+    # spreading, volume zones, and — round 5 — inter-pod (anti)affinity)
+    # runs via the [Gpad, Npad] presence carry (+ the [Gpad*K, Dpad]
+    # presence_dom carry for interpod's existing-pods side) when the group
+    # count fits the unrolled-loop budget
+    if config.has_maxpd:
+        return None, "pod-group feature has_maxpd"
     gt = compiled.groups
     group_bound = (config.has_ports or config.has_services
-                   or config.has_disk_conflict or config.has_vol_zone)
-    # presence is only read by ports/disk/spread; a vol-zone-only workload
-    # streams per-pod zone rows (gathered by group id from an HBM table)
-    # and needs neither the presence carry nor the unrolled-loop budget
+                   or config.has_disk_conflict or config.has_vol_zone
+                   or config.has_interpod)
+    # presence is only read by ports/disk/spread/interpod; a vol-zone-only
+    # workload streams per-pod zone rows (gathered by group id from an HBM
+    # table) and needs neither the presence carry nor the unrolled budget
     needs_presence = (config.has_ports or config.has_services
-                      or config.has_disk_conflict)
+                      or config.has_disk_conflict or config.has_interpod)
     num_g = int(gt.presence.shape[0]) if group_bound else 0
     if needs_presence:
-        max_g = int(os.environ.get("TPUSIM_FAST_MAX_GROUPS", 16))
+        max_g = int(os.environ.get("TPUSIM_FAST_MAX_GROUPS", 32))
         if num_g > max_g:
             return None, (f"{num_g} pod groups exceed the fast-path "
                           f"unrolled-loop budget ({max_g}; "
@@ -339,6 +435,40 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
             if config.n_zone_doms > max_z:
                 return None, (f"{config.n_zone_doms} zone domains exceed "
                               f"the fast-path budget ({max_z})")
+    ip_dims = None
+    if config.has_interpod:
+        k_keys = int(gt.topo_dom.shape[0])
+        d_doms = int(config.n_topo_doms)
+        ta = int(gt.aff_valid.shape[1])
+        tb = int(gt.anti_valid.shape[1])
+        tp = int(gt.pref_w.shape[1])
+        max_k = int(os.environ.get("TPUSIM_FAST_MAX_TOPO_KEYS", 4))
+        max_d = int(os.environ.get("TPUSIM_FAST_MAX_TOPO_DOMS", 64))
+        max_t = int(os.environ.get("TPUSIM_FAST_MAX_TERMS", 4))
+        if k_keys > max_k:
+            return None, (f"{k_keys} topology keys exceed the fast-path "
+                          f"budget ({max_k}; TPUSIM_FAST_MAX_TOPO_KEYS)")
+        if d_doms > max_d:
+            return None, (f"{d_doms} topology domains exceed the fast-path "
+                          f"budget ({max_d}; TPUSIM_FAST_MAX_TOPO_DOMS)")
+        if max(ta, tb, tp) > max_t:
+            return None, (f"{max(ta, tb, tp)} inter-pod terms exceed the "
+                          f"fast-path budget ({max_t}; "
+                          "TPUSIM_FAST_MAX_TERMS)")
+        if not np.all(gt.pref_w == np.round(gt.pref_w)):
+            return None, "non-integral preferred inter-pod weights"
+        # InterPodAffinityPriority counts stay int32: bound |counts| by the
+        # total weight mass times the largest possible pod population
+        total_pods = int(gt.presence.sum()) + len(np.asarray(cols.req_cpu))
+        w_own = int(np.abs(gt.pref_w).sum(axis=1).max(initial=0))
+        w_exist = int(np.abs(gt.pref_w).sum()) + config.hard_weight * int(
+            (gt.aff_valid & ~gt.aff_empty).sum())
+        bound_counts = (w_own + w_exist) * max(total_pods, 1)
+        if MAX_PRIORITY * 2 * bound_counts >= (1 << 31):
+            return None, ("inter-pod priority counts exceed int32 "
+                          f"(weight mass {w_own + w_exist} x "
+                          f"{total_pods} pods)")
+        ip_dims = (k_keys, d_doms, ta, tb, tp)
     n_scal = len(compiled.scalar_names)
     if NUM_FIXED_BITS + n_scal > PAD_SENTINEL_BIT:
         return None, (f"{n_scal} scalar resource kinds exceed the int32 "
@@ -506,6 +636,82 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     if config.has_vol_zone:
         zone_ok_tbl = table_rows(gt.zone_ok, fill=0)
 
+    topo_rows = presence_dom = ip_tbl = None
+    ip_static = {}
+    k_keys = d_doms_real = ta = tb = tp = 0
+    if config.has_interpod:
+        k_keys, d_doms, ta, tb, tp = ip_dims
+        d_doms_real = d_doms
+        kpad8 = max(-(-k_keys // SUBLANES) * SUBLANES, SUBLANES)
+        dpad = max(-(-d_doms // LANES) * LANES, LANES)
+        topo_rows = np.zeros((kpad8, npad), dtype=np.int32)
+        topo_rows[:k_keys, :n] = gt.topo_dom.astype(np.int32)
+        # pad rows and pad nodes keep domain 0 ("label missing": never
+        # matches, and pad nodes are infeasible everywhere anyway)
+        presence_dom = embed_presence_dom(gt.presence, gt.topo_dom, d_doms,
+                                          gpad, dpad)
+        # every per-pod interpod operand is a pure function of the pod's
+        # GROUP, so the packed rows live in a [Gpad, Wip] table gathered by
+        # group id per chunk on device — no O(P) host materialization
+        L = IpLayout(ta, tb, tp, gpad)
+        ip_tbl = np.zeros((gpad, L.width), dtype=np.int32)
+        gi = np.arange(num_g)
+        tm = gt.term_match.astype(np.int32)            # [Td, G]
+
+        def put(offset, arr):
+            a = np.asarray(arr).reshape(num_g, -1).astype(np.int32)
+            ip_tbl[:num_g, offset:offset + a.shape[1]] = a
+
+        def pad_groups(a3):
+            # [G, T, G] match tensor -> [G, T, Gpad]
+            out = np.zeros((num_g, a3.shape[1], gpad), np.int32)
+            out[:, :, :num_g] = a3
+            return out
+
+        put(L.aff_match, pad_groups(tm[gt.aff_term[gi]]))
+        put(L.aff_key, gt.aff_key[gi])
+        put(L.aff_valid, gt.aff_valid[gi])
+        put(L.aff_empty, gt.aff_empty[gi])
+        put(L.aff_host, gt.aff_hostname[gi])
+        put(L.aff_self, gt.aff_self[gi])
+        put(L.aff_unpl, gt.aff_unplaced[gi])
+        put(L.aff_err, gt.aff_err[gi])
+        put(L.anti_match, pad_groups(tm[gt.anti_term[gi]]))
+        put(L.anti_key, gt.anti_key[gi])
+        put(L.anti_valid, gt.anti_valid[gi])
+        put(L.anti_host, gt.anti_hostname[gi])
+        put(L.anti_err, gt.anti_err[gi])
+        put(L.pref_match, pad_groups(tm[gt.pref_term[gi]]))
+        put(L.pref_key, gt.pref_key[gi])
+        put(L.pref_w, np.round(gt.pref_w[gi]).astype(np.int64))
+        # exist side: does group g2's term t match ME — transpose of the
+        # same factored tables, padded on the OUTER group axis
+        def exist_bits(term_ids, t_):
+            # [G_me, Gpad * t_]: bit (g2, t) = term_match[term_ids[g2, t], me]
+            a = tm[term_ids][:, :, gi]                 # [G, T, G_me]
+            out = np.zeros((num_g, gpad, t_), np.int32)
+            out[:, :num_g] = a.transpose(2, 0, 1)
+            return out
+
+        put(L.ex_anti, exist_bits(gt.anti_term, tb))
+        put(L.ex_pref, exist_bits(gt.pref_term, tp))
+        put(L.ex_aff, exist_bits(gt.aff_term, ta))
+
+        def bake(a, t_, dtype=np.int64):
+            out = np.zeros((gpad, t_), dtype=dtype)
+            out[:num_g] = a
+            return tuple(int(v) for v in out.flatten())
+
+        ip_static = dict(
+            exist_anti_key=bake(gt.anti_key, tb),
+            exist_anti_mask=bake(gt.anti_valid & ~gt.anti_empty, tb),
+            exist_anti_empty=bake(gt.anti_valid & gt.anti_empty, tb),
+            exist_pref_key=bake(gt.pref_key, tp),
+            exist_pref_w=bake(np.round(gt.pref_w).astype(np.int64), tp),
+            exist_aff_key=bake(gt.aff_key, ta),
+            exist_aff_mask=bake(gt.aff_valid & ~gt.aff_empty, ta),
+        )
+
     plan = FastPlan(
         num_nodes=n, num_pods=len(np.asarray(cols.req_cpu)),
         most_requested=config.most_requested, num_scalars=n_scal,
@@ -541,6 +747,10 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
         ss_row=ss_row, zone_ok_tbl=zone_ok_tbl, zone_onehot=zone_onehot,
         n_zone_doms=zpad if config.has_services else 0,
         gcds=(g_cpu, g_mem, g_gpu, g_eph), scalar_gcds=tuple(scal_gcds),
+        has_interpod=config.has_interpod, n_topo_keys=k_keys,
+        n_topo_doms_ip=d_doms_real, ta=ta, tb=tb, tp=tp,
+        hard_weight=config.hard_weight, topo_rows=topo_rows,
+        presence_dom=presence_dom, ipod=ip_tbl, **ip_static,
     )
     return plan, ""
 
@@ -550,10 +760,53 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
 # ---------------------------------------------------------------------------
 
 
+@dataclass(frozen=True)
+class IpConst:
+    """Compile-time interpod constants baked into one kernel variant (and
+    therefore part of the _build_call cache key): dimensions plus the
+    exist-side per-(group, term) key/weight/mask tables — per-pod operands
+    then carry only match bits."""
+
+    k_keys: int
+    kpad8: int              # sublane-padded rows of the static topo block
+    d_doms: int             # REAL domain count (the unroll bound)
+    dpad: int               # lane-padded presence_dom width
+    ta: int
+    tb: int
+    tp: int
+    hard_weight: int
+    wip: int
+    exist_anti_key: Tuple[int, ...]
+    exist_anti_mask: Tuple[int, ...]
+    exist_anti_empty: Tuple[int, ...]
+    exist_pref_key: Tuple[int, ...]
+    exist_pref_w: Tuple[int, ...]
+    exist_aff_key: Tuple[int, ...]
+    exist_aff_mask: Tuple[int, ...]
+
+
+def ip_const_of(plan: FastPlan) -> Optional[IpConst]:
+    if not plan.has_interpod:
+        return None
+    return IpConst(
+        k_keys=plan.n_topo_keys, kpad8=plan.topo_rows.shape[0],
+        d_doms=plan.n_topo_doms_ip, dpad=plan.presence_dom.shape[1],
+        ta=plan.ta, tb=plan.tb, tp=plan.tp, hard_weight=plan.hard_weight,
+        wip=plan.ipod.shape[1],
+        exist_anti_key=plan.exist_anti_key,
+        exist_anti_mask=plan.exist_anti_mask,
+        exist_anti_empty=plan.exist_anti_empty,
+        exist_pref_key=plan.exist_pref_key,
+        exist_pref_w=plan.exist_pref_w,
+        exist_aff_key=plan.exist_aff_key,
+        exist_aff_mask=plan.exist_aff_mask)
+
+
 def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                  group: int, gpad: int = 0, zpad: int = 0,
                  has_ports: bool = False, has_disk: bool = False,
-                 has_spread: bool = False, has_vol_zone: bool = False):
+                 has_spread: bool = False, has_vol_zone: bool = False,
+                 ip: Optional[IpConst] = None):
     """Kernel body for one grid step of `group` consecutive pods.
 
     Mosaic requires the sublane (second-to-last) block dim to be a multiple
@@ -602,6 +855,11 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
             if has_spread:
                 ssrow_r = refs[at]
                 at += 1
+            if ip is not None:
+                topo_r = refs[at]      # [Kpad8, Npad] static domain rows
+                iprow_r = refs[at + 1]  # per-pod packed interpod rows
+                ipd_r = refs[at + 2]   # [Gpad*K, Dpad] presence_dom init
+                at += 3
         (ouc_r, oum_r, oug_r, oue_r, onzc_r, onzm_r, opc_r, omisc_r,
          choice_r, counts_r, adv_r) = refs[at:at + 11]
         at += 11
@@ -610,6 +868,9 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
             at += 1
         if group_bound:
             opres_r = refs[at]
+            at += 1
+            if ip is not None:
+                opd_r = refs[at]
         p = pl.program_id(0)
 
         @pl.when(p == 0)
@@ -626,6 +887,8 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 ous_r[:] = ius_r[:]
             if group_bound:
                 opres_r[:] = ipres_r[:]
+                if ip is not None:
+                    opd_r[:] = ipd_r[:]
 
         acpu = acpu_r[:]
         amem = amem_r[:]
@@ -692,6 +955,37 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
             if group_bound:
                 gid_s = gid_r[j, 0]
                 pres_rows = [opres_r[g2:g2 + 1, :] for g2 in range(gpad)]
+            if ip is not None:
+                K, D = ip.k_keys, ip.d_doms
+                L = IpLayout(ip.ta, ip.tb, ip.tp, gpad)
+                pd_rows = [opd_r[r:r + 1, :] for r in range(gpad * K)]
+                topo_k = [topo_r[k:k + 1, :] for k in range(K)]
+
+                def ip_own_term(match_off, key_off, t):
+                    """One own term: (mcount row, dc_at row, domsel row).
+                    dc_at[n] == the XLA path's take-along of _seg_rows —
+                    the per-domain sum of matched presence broadcast back
+                    to nodes. Computed directly from mcount with D scalar
+                    segment reductions (pd[g,k,d] is the domain-d sum of
+                    presence[g], so Σ_g match·pd == Σ_{n∈d} mcount):
+                    pad nodes carry domain 0 and zero presence, so they
+                    never contaminate a real domain's sum."""
+                    mcount = jnp.zeros_like(cond)
+                    for g2 in range(gpad):
+                        mcount = mcount + jnp.where(
+                            iprow_r[j, match_off + t * gpad + g2] != 0,
+                            pres_rows[g2], 0)
+                    key_t = iprow_r[j, key_off + t]
+                    domsel = jnp.zeros_like(cond)
+                    for k in range(K):
+                        domsel = jnp.where(key_t == k, topo_k[k], domsel)
+                    dc_at = jnp.zeros_like(cond)
+                    for d in range(D):
+                        in_d = domsel == d
+                        seg_d = jnp.sum(jnp.where(in_d, mcount, 0),
+                                        dtype=jnp.int32)
+                        dc_at = dc_at + jnp.where(in_d, seg_d, 0)
+                    return mcount, dc_at, domsel
             if has_ports:
                 # PodFitsHostPorts (predicates.go:1019-1039), part of
                 # GeneralPredicates: my port set conflicts with the port
@@ -731,6 +1025,79 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                     (fail_vz, jnp.int32(1) << BIT_VOLUME_ZONE_CONFLICT))
             stages += [(fail_mem_pr, jnp.int32(1) << BIT_MEMORY_PRESSURE),
                        (fail_disk_pr, jnp.int32(1) << BIT_DISK_PRESSURE)]
+            if ip is not None:
+                # MatchInterPodAffinity (predicates.go:1125-1450) — last in
+                # predicatesOrdering; mirrors kernels._evaluate's stage.
+                # own required affinity terms
+                aff_fail = fail_cond & False
+                for t in range(ip.ta):
+                    mcount, dc_at, domsel = ip_own_term(
+                        L.aff_match, L.aff_key, t)
+                    valid_t = iprow_r[j, L.aff_valid + t] != 0
+                    host_t = iprow_r[j, L.aff_host + t] != 0
+                    self_t = iprow_r[j, L.aff_self + t] != 0
+                    unpl_t = iprow_r[j, L.aff_unpl + t] != 0
+                    valid_dom = domsel > 0
+                    on_node = mcount > 0
+                    term_matches = jnp.where(host_t, valid_dom & on_node,
+                                             valid_dom & (dc_at > 0))
+                    # hostname terms scan only this node's pods; otherwise
+                    # "a matching pod exists" is global (incl. unplaced
+                    # snapshot pods)
+                    exists_any = (jnp.sum(mcount, dtype=jnp.int32) > 0) \
+                        | unpl_t
+                    exists = jnp.where(host_t, on_node, exists_any)
+                    term_ok = term_matches | ((~exists) & self_t)
+                    aff_fail = aff_fail | (valid_t & ~term_ok)
+                aff_fail = aff_fail | (iprow_r[j, L.aff_err] != 0)
+                # own required anti-affinity terms
+                anti_fail = fail_cond & False
+                for t in range(ip.tb):
+                    bmcount, bdc_at, bdomsel = ip_own_term(
+                        L.anti_match, L.anti_key, t)
+                    valid_t = iprow_r[j, L.anti_valid + t] != 0
+                    host_t = iprow_r[j, L.anti_host + t] != 0
+                    bvalid_dom = bdomsel > 0
+                    b_matches = jnp.where(host_t, bvalid_dom & (bmcount > 0),
+                                          bvalid_dom & (bdc_at > 0))
+                    anti_fail = anti_fail | (valid_t & b_matches)
+                anti_fail = anti_fail | (iprow_r[j, L.anti_err] != 0)
+                # existing pods' anti-affinity vs me (symmetric; runs first
+                # in the reference's check order). Keys/masks are static:
+                # only referenced (group, term) pairs generate any code.
+                Bk = [jnp.zeros_like(pd_rows[0]) for _ in range(K)]
+                fail_all = jnp.int32(0)
+                for g2 in range(gpad):
+                    for t in range(ip.tb):
+                        idx = g2 * ip.tb + t
+                        if ip.exist_anti_mask[idx]:
+                            k_gt = ip.exist_anti_key[idx]
+                            mbit = iprow_r[j, L.ex_anti + idx] != 0
+                            Bk[k_gt] = Bk[k_gt] + jnp.where(
+                                mbit, pd_rows[g2 * K + k_gt], 0)
+                        if ip.exist_anti_empty[idx]:
+                            gp = jnp.sum(pres_rows[g2],
+                                         dtype=jnp.int32) > 0
+                            mbit = iprow_r[j, L.ex_anti + idx] != 0
+                            fail_all = fail_all | (
+                                mbit & gp).astype(jnp.int32)
+                exist_fail = fail_cond & False
+                for k in range(K):
+                    for d in range(1, D):
+                        exist_fail = exist_fail | (
+                            (topo_k[k] == d) & (Bk[k][0, d] > 0))
+                exist_fail = exist_fail | (fail_all != 0)
+                fail_interpod = exist_fail | aff_fail | anti_fail
+                # two reasons per failure: the umbrella + the specific rule
+                # in the engine's check order
+                ip_bits = (jnp.int32(1) << BIT_AFFINITY_NOT_MATCH) | \
+                    jnp.where(
+                        exist_fail,
+                        jnp.int32(1) << BIT_EXISTING_ANTI_AFFINITY,
+                        jnp.where(aff_fail,
+                                  jnp.int32(1) << BIT_AFFINITY_RULES,
+                                  jnp.int32(1) << BIT_ANTI_AFFINITY_RULES))
+                stages.append((fail_interpod, ip_bits))
             feasible = jnp.ones_like(fail_cond)
             reason = jnp.zeros_like(cond)
             for fail, bits in reversed(stages):
@@ -803,6 +1170,52 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                          * (node_num * zone_den + 2 * zone_num * node_den)
                          ) // (3 * node_den * zone_den)
                 score = score + jnp.where(have_zones & zvalid, blend, plain)
+            if ip is not None:
+                # InterPodAffinityPriority (interpod_affinity.go:118+):
+                # (a) my preferred terms over existing pods, (b) existing
+                # pods' preferred terms over me, (c) their required
+                # affinity x hard weight — int32 throughout (plan_fast
+                # bounds the weight mass x pod population)
+                counts_row = jnp.zeros_like(score)
+                for t in range(ip.tp):
+                    _, pdc_at, pdomsel = ip_own_term(
+                        L.pref_match, L.pref_key, t)
+                    w_t = iprow_r[j, L.pref_w + t]
+                    counts_row = counts_row + jnp.where(
+                        pdomsel > 0, pdc_at, 0) * w_t
+                Wk = [jnp.zeros_like(pd_rows[0]) for _ in range(K)]
+                for g2 in range(gpad):
+                    for t in range(ip.tp):
+                        idx = g2 * ip.tp + t
+                        w_s = ip.exist_pref_w[idx]
+                        if w_s:
+                            k_gt = ip.exist_pref_key[idx]
+                            mbit = iprow_r[j, L.ex_pref + idx] != 0
+                            Wk[k_gt] = Wk[k_gt] + jnp.where(
+                                mbit, pd_rows[g2 * K + k_gt] * w_s, 0)
+                    for t in range(ip.ta):
+                        idx = g2 * ip.ta + t
+                        if ip.exist_aff_mask[idx]:
+                            k_gt = ip.exist_aff_key[idx]
+                            mbit = iprow_r[j, L.ex_aff + idx] != 0
+                            Wk[k_gt] = Wk[k_gt] + jnp.where(
+                                mbit,
+                                pd_rows[g2 * K + k_gt] * ip.hard_weight, 0)
+                for k in range(K):
+                    for d in range(1, D):
+                        counts_row = counts_row + jnp.where(
+                            topo_k[k] == d, Wk[k][0, d], 0)
+                big_i = jnp.int32(1 << 30)
+                maxc = jnp.maximum(
+                    jnp.max(jnp.where(feasible, counts_row, -big_i)), 0)
+                minc = jnp.minimum(
+                    jnp.min(jnp.where(feasible, counts_row, big_i)), 0)
+                rng_i = maxc - minc
+                score = score + jnp.where(
+                    rng_i > 0,
+                    (MAX_PRIORITY * (counts_row - minc))
+                    // jnp.maximum(rng_i, 1),
+                    0)
 
             # ---- selectHost: stable-desc argmax + round-robin tie pick ----
             masked = jnp.where(feasible, score, -1)
@@ -846,6 +1259,22 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 for g2 in range(gpad):
                     opres_r[g2:g2 + 1, :] = jnp.where(
                         gid_s == g2, pres_rows[g2] + pick_i, pres_rows[g2])
+            if ip is not None:
+                # presence_dom[gid, k, dom_k(choice)] += 1: the chosen
+                # node's domain id per key is a one-hot-extracted scalar
+                # (pick is one-hot), then a lane-one-hot masked row add —
+                # all-False pick (no feasible node) adds nothing
+                found_i = found.astype(jnp.int32)
+                for k in range(K):
+                    chosen_dom = jnp.sum(jnp.where(pick, topo_k[k], 0),
+                                         dtype=jnp.int32)
+                    ohrow = (jax.lax.broadcasted_iota(
+                        jnp.int32, pd_rows[0].shape, 1)
+                        == chosen_dom).astype(jnp.int32) * found_i
+                    for g2 in range(gpad):
+                        r = g2 * K + k
+                        opd_r[r:r + 1, :] = jnp.where(
+                            gid_s == g2, pd_rows[r] + ohrow, pd_rows[r])
 
             omisc_r[0, 0] = rr + (n_feasible > 1).astype(jnp.int32)
 
@@ -857,7 +1286,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
                 counts_w: int, num_scalars: int, srows: int, interpret: bool,
                 gpad: int = 0, zpad: int = 0, has_ports: bool = False,
                 has_disk: bool = False, has_spread: bool = False,
-                has_vol_zone: bool = False):
+                has_vol_zone: bool = False, ip: Optional[IpConst] = None):
     """jitted pallas_call for one (node-pad, chunk, scalar, group) shape.
 
     k must be a multiple of SUBLANES: Mosaic rejects blocks whose sublane
@@ -868,7 +1297,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
     group_bound = gpad > 0
     kernel = _make_kernel(most_requested, num_bits, num_scalars, SUBLANES,
                           gpad, zpad, has_ports, has_disk, has_spread,
-                          has_vol_zone)
+                          has_vol_zone, ip)
 
     def smem_rows(width=1):
         return pl.BlockSpec((SUBLANES, width), lambda p: (p, 0),
@@ -906,7 +1335,14 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
             group_in.append(smem_rows(gpad))           # disk conflict rows
         if has_spread:
             group_in.append(smem_rows(gpad))           # spread-set rows
+        if ip is not None:
+            group_in.append(const_row(rows=ip.kpad8))  # static topo rows
+            group_in.append(row_per_pod(ip.wip))       # per-pod ip rows
+            group_in.append(const_row(ip.dpad,
+                                      rows=gpad * ip.k_keys))  # pd init
         group_out.append(const_row(rows=gpad))         # presence out
+        if ip is not None:
+            group_out.append(const_row(ip.dpad, rows=gpad * ip.k_keys))
     grid_spec = pl.GridSpec(
         grid=(k // SUBLANES,),
         in_specs=(
@@ -940,6 +1376,8 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
            jax.ShapeDtypeStruct((k, 1), i32)]
         + ([jax.ShapeDtypeStruct((srows, npad), i32)] if num_scalars else [])
         + ([jax.ShapeDtypeStruct((gpad, npad), i32)] if group_bound else [])
+        + ([jax.ShapeDtypeStruct((gpad * ip.k_keys, ip.dpad), i32)]
+           if ip is not None else [])
     )
     call = pl.pallas_call(kernel, grid_spec=grid_spec,
                           out_shape=out_shape, interpret=interpret)
@@ -1019,10 +1457,12 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     k = -(-(chunk if fixed_chunk else min(chunk, max(span, 1)))
           // SUBLANES) * SUBLANES
     gpad = plan.num_groups
+    ipc = ip_const_of(plan)
     call = _build_call(npad, k, plan.most_requested, num_bits, counts_w,
                        plan.num_scalars, srows, interpret,
                        gpad, plan.n_zone_doms, plan.has_ports,
-                       plan.has_disk, plan.has_spread, plan.has_vol_zone)
+                       plan.has_disk, plan.has_spread, plan.has_vol_zone,
+                       ipc)
 
     statics = [jnp.asarray(a) for a in (
         plan.alloc_cpu, plan.alloc_mem, plan.alloc_gpu, plan.alloc_eph,
@@ -1041,6 +1481,10 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
         pres_carry = jnp.asarray(carry_in.pres)
         zone_oh = (jnp.asarray(plan.zone_onehot)
                    if plan.has_spread else None)
+    if ipc is not None:
+        topo_dev = jnp.asarray(plan.topo_rows)
+        ip_tbl_dev = jnp.asarray(plan.ipod)
+        pd_carry = jnp.asarray(carry_in.pd)
     zone_tbl = (jnp.asarray(plan.zone_ok_tbl)
                 if plan.has_vol_zone else None)
 
@@ -1121,6 +1565,13 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
                 args.append(jnp.asarray(grow(plan.disk_row[sl])))
             if plan.has_spread:
                 args.append(jnp.asarray(grow(plan.ss_row[sl])))
+            if ipc is not None:
+                args.append(topo_dev)
+                # per-pod interpod rows: device gather from the per-group
+                # table (pad rows gather row 0 of a zero-padded table;
+                # ghost pods are infeasible everywhere regardless)
+                args.append(ip_tbl_dev[gids[:, 0]])
+                args.append(pd_carry)
         out = call(*args)
         carry = list(out[:7])
         misc = out[7]
@@ -1130,6 +1581,9 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
             oat += 1
         if gpad:
             pres_carry = out[oat]
+            oat += 1
+        if ipc is not None:
+            pd_carry = out[oat]
         pending.append((out[8], out[9], out[10], sl.stop - sl.start))
         if sync_every and len(pending) > sync_every:
             drain_one()
@@ -1151,5 +1605,6 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     carry_out = FastCarry(
         rows=list(carry), misc=misc,
         scal=scal_carry if plan.num_scalars else None,
-        pres=pres_carry if gpad else None)
+        pres=pres_carry if gpad else None,
+        pd=pd_carry if ipc is not None else None)
     return out3 + (carry_out,)

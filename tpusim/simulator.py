@@ -513,6 +513,16 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    if auto_routes_to_host(len(pods), len(snapshot.nodes),
                                           enable_volume_scheduling)
                    else "jax")
+    if feature_gates:
+        # PodPriority / VolumeScheduling gate the same behavior as the
+        # dedicated parameters (scheduler.go:175,210-213) for library
+        # callers; the registry-surgery gates pass through to
+        # apply_feature_gates
+        feature_gates = dict(feature_gates)
+        if feature_gates.pop("PodPriority", False):
+            enable_pod_priority = True
+        if feature_gates.pop("VolumeScheduling", False):
+            enable_volume_scheduling = True
     if feature_gates and any(feature_gates.get(g) for g in
                              ("TaintNodesByCondition",
                               "ResourceLimitsPriorityFunction")) \

@@ -501,6 +501,15 @@ def test_fuzz_group_fast_path_parity():
                 kw["volumes"] = [make_pod_volume(
                     "d", {"rbd": {"monitors": ["m"], "pool": "p",
                                   "image": f"img{rng.randrange(2)}"}})]
+            elif r < 0.4:
+                # MaxPD (fast-path-native since round 5): exercises the
+                # used-volume union carry and the shared-volumeID disk
+                # -conflict path; exhaustion of the per-type LIMIT is
+                # pinned separately by test_maxpd_exhaustion_parity,
+                # which forces KUBE_MAX_PD_VOLS low
+                kw["volumes"] = [make_pod_volume(
+                    "b", {"awsElasticBlockStore":
+                          {"volumeID": f"ebs{rng.randrange(4)}"}})]
             p = make_pod(f"p{i}", milli_cpu=rng.randrange(1, 12) * 100,
                          memory=rng.randrange(1, 12) * 2**26, **kw)
             if rng.random() < 0.4:
@@ -725,3 +734,57 @@ def test_fuzz_interpod_fast_path_parity():
         assert np.array_equal(f_adv, np.asarray(advanced)), f"seed {seed}"
     assert skipped <= max(1, min(seeds, 25) // 2), \
         f"{skipped} of {min(seeds, 25)} seeds fell back"
+
+
+def test_maxpd_exhaustion_parity(monkeypatch):
+    """Max{EBS,GCE}VolumeCount on the fast path: per-node unique-volume
+    unions ride the [Vpad, Npad] bit carry; limits exhaust (forced low via
+    KUBE_MAX_PD_VOLS so BIT_MAX_VOLUME_COUNT actually fires) and
+    placements + reason histograms stay bit-identical to the XLA scan
+    (round 5)."""
+    import random
+
+    from tpusim.jaxe.state import BIT_MAX_VOLUME_COUNT
+
+    monkeypatch.setenv("KUBE_MAX_PD_VOLS", "3")
+    rng = random.Random(5)
+    nodes = [make_node(f"n{i}", milli_cpu=64000, memory=256 * 1024**3,
+                       pods=200) for i in range(6)]
+    existing = [make_pod(
+        f"e{i}", node_name=f"n{i % 6}", phase="Running", milli_cpu=100,
+        volumes=[make_pod_volume(
+            "v", {"awsElasticBlockStore": {"volumeID": f"ebs{i % 5}"}})])
+        for i in range(8)]
+    pods = []
+    for i in range(120):
+        vols = []
+        r = rng.random()
+        if r < 0.5:
+            vols.append(make_pod_volume(
+                "v", {"awsElasticBlockStore":
+                      {"volumeID": f"ebs{rng.randrange(8)}"}}))
+        elif r < 0.7:
+            vols.append(make_pod_volume(
+                "v", {"gcePersistentDisk": {"pdName":
+                                            f"gce{rng.randrange(4)}"}}))
+        pods.append(make_pod(f"p{i}", milli_cpu=100, memory=64 * 1024**2,
+                             volumes=vols or None))
+    snap = ClusterSnapshot(nodes=nodes, pods=existing)
+    compiled, cols = compile_cluster(snap, pods)
+    assert not compiled.unsupported
+    config = config_for([compiled], most_requested=False,
+                        num_reason_bits=NUM_FIXED_BITS
+                        + len(compiled.scalar_names))
+    assert config.has_maxpd
+    plan, why = plan_fast(config, compiled, cols)
+    assert plan is not None, why
+    f_choices, f_counts, _ = fast_scan(plan, chunk=32)
+    _, choices, counts, _ = schedule_scan(
+        config, carry_init(compiled), statics_to_device(compiled),
+        pod_columns_to_device(cols))
+    assert 0 < int((np.asarray(choices) >= 0).sum()) < len(pods)
+    # the exhaustion branch must actually fire, not just NoDiskConflict
+    assert int(np.asarray(counts)[:, BIT_MAX_VOLUME_COUNT].sum()) > 0
+    assert np.array_equal(f_choices, np.asarray(choices))
+    w = f_counts.shape[1]
+    assert np.array_equal(f_counts, np.asarray(counts)[:, :w])

@@ -86,6 +86,9 @@ def plan_signature(plan) -> tuple:
                 plan.exist_anti_mask, plan.exist_anti_empty,
                 plan.exist_pref_key, plan.exist_pref_w,
                 plan.exist_aff_key, plan.exist_aff_mask)
+    if plan.has_maxpd:
+        # volume type triples and limits are baked into the kernel variant
+        sig += (plan.n_vols, plan.vol_type3, plan.maxpd_limits)
     return sig
 
 

@@ -20,9 +20,18 @@ Eligibility (checked by `plan_fast`, reasons returned):
     -unrolled loops over Gpad with (g == gid)-masked row ops (no dynamic
     indexing; Mosaic-safe). Bounded by TPUSIM_FAST_MAX_GROUPS (32) merged
     groups / TPUSIM_FAST_MAX_ZONES (16) zone domains, and the spread
-    blend's int32 product bound. Still host/XLA-bound: inter-pod
-    (anti)affinity ([G,K,D] topo state), maxpd volume counts ([N,V]
-    union), policies, ServiceAffinity;
+    blend's int32 product bound;
+  * inter-pod (anti)affinity runs natively (round 5): own terms via per
+    -pod match rows + D scalar segment reductions over the presence carry,
+    the existing-pods side via a [Gpad*K, Dpad] presence_dom carry with
+    per-(group, term) constants baked into the kernel variant, and
+    InterPodAffinityPriority in exact int32 — bounded by
+    TPUSIM_FAST_MAX_TOPO_KEYS (4), _MAX_TOPO_DOMS (64), _MAX_TERMS (4)
+    and an int32 weight-mass bound;
+  * MaxPD volume counts run natively (round 5): the [N, V] used-volume
+    union as a [Vpad, Npad] bit carry with baked type triples/limits,
+    bounded by TPUSIM_FAST_MAX_VOLS (32). Still host/XLA-bound: policies
+    (incl. ServiceAffinity) and extenders;
   * every resource quantity reduces exactly to int32: values are divided by
     the per-axis gcd (exact — fractions and fit comparisons are
     unit-invariant) and the reduced values must stay under 2^29, with the
@@ -78,6 +87,7 @@ from tpusim.jaxe.kernels import (
 )
 from tpusim.jaxe.state import (
     BIT_AFFINITY_NOT_MATCH,
+    BIT_MAX_VOLUME_COUNT,
     BIT_AFFINITY_RULES,
     BIT_ANTI_AFFINITY_RULES,
     BIT_DISK_CONFLICT,
@@ -206,6 +216,17 @@ class FastPlan:
     exist_pref_w: Tuple[int, ...] = ()       # [G*Tp] signed int weights
     exist_aff_key: Tuple[int, ...] = ()      # [G*Ta]
     exist_aff_mask: Tuple[int, ...] = ()     # [G*Ta] valid & ~empty
+    # Max{EBS,GCEPD,AzureDisk}VolumeCount (round 5): the [N, V] per-node
+    # used-volume union becomes a [Vpad8, Npad] 0/1 carry; per-pod volume
+    # masks ride a [Gpad?, Vpad] group table gathered by group id (maxpd
+    # needs no presence), and the per-volume type triples + per-type
+    # limits are baked into the kernel variant.
+    has_maxpd: bool = False
+    n_vols: int = 0                          # V real volume ids
+    used_vols: Optional[np.ndarray] = None   # [Vpad8, Npad] init carry
+    vol_tbl: Optional[np.ndarray] = None     # [G, Vpad] mask by group id
+    vol_type3: Tuple[int, ...] = ()          # [V*3] type bits (EBS,GCE,AZ)
+    maxpd_limits: Tuple[int, int, int] = (0, 0, 0)
 
 
 @dataclass
@@ -220,6 +241,7 @@ class FastCarry:
     scal: Optional[object] = None    # [Srows, Npad] int32
     pres: Optional[object] = None    # [Gpad, Npad] int32
     pd: Optional[object] = None      # [Gpad*K, Dpad] int32 (interpod)
+    uv: Optional[object] = None      # [Vpad8, Npad] 0/1 int32 (maxpd)
 
 
 def init_carry(plan: FastPlan, rr: int = 0) -> FastCarry:
@@ -232,7 +254,8 @@ def init_carry(plan: FastPlan, rr: int = 0) -> FastCarry:
         misc=misc,
         scal=plan.used_scalar if plan.num_scalars else None,
         pres=plan.presence if plan.num_groups else None,
-        pd=plan.presence_dom if plan.has_interpod else None)
+        pd=plan.presence_dom if plan.has_interpod else None,
+        uv=plan.used_vols if plan.has_maxpd else None)
 
 
 def rearm_carry(plan: FastPlan, compiled, rr: int) -> Optional[FastCarry]:
@@ -294,9 +317,21 @@ def rearm_carry(plan: FastPlan, compiled, rr: int) -> Optional[FastCarry]:
             pd = embed_presence_dom(gt.presence, gt.topo_dom,
                                     plan.n_topo_doms_ip, plan.num_groups,
                                     plan.presence_dom.shape[1])
+    uv = None
+    if plan.has_maxpd:
+        gt = compiled.groups
+        if gt.vol_mask.shape[1] != plan.n_vols:
+            return None  # volume-id universe changed
+        # valid because refresh_dynamic only succeeds with CLEAN group
+        # tables: a volume-carrying bind or victim dirties them and forces
+        # the full recompile path instead
+        uv = np.zeros_like(plan.used_vols)
+        uv[:plan.n_vols, :plan.num_nodes] = \
+            gt.used_vols_init.T.astype(np.int32)
     misc = np.zeros((1, LANES), dtype=np.int32)
     misc[0, 0] = rr
-    return FastCarry(rows=rows, misc=misc, scal=scal, pres=pres, pd=pd)
+    return FastCarry(rows=rows, misc=misc, scal=scal, pres=pres, pd=pd,
+                     uv=uv)
 
 
 class IpLayout:
@@ -413,11 +448,16 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     # presence_dom carry for interpod's existing-pods side) when the group
     # count fits the unrolled-loop budget
     if config.has_maxpd:
-        return None, "pod-group feature has_maxpd"
+        n_vols_real = int(compiled.groups.vol_mask.shape[1])
+        max_v = int(os.environ.get("TPUSIM_FAST_MAX_VOLS", 32))
+        if n_vols_real > max_v:
+            return None, (f"{n_vols_real} MaxPD volume ids exceed the "
+                          f"fast-path budget ({max_v}; "
+                          "TPUSIM_FAST_MAX_VOLS)")
     gt = compiled.groups
     group_bound = (config.has_ports or config.has_services
                    or config.has_disk_conflict or config.has_vol_zone
-                   or config.has_interpod)
+                   or config.has_interpod or config.has_maxpd)
     # presence is only read by ports/disk/spread/interpod; a vol-zone-only
     # workload streams per-pod zone rows (gathered by group id from an HBM
     # table) and needs neither the presence carry nor the unrolled budget
@@ -636,6 +676,22 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     if config.has_vol_zone:
         zone_ok_tbl = table_rows(gt.zone_ok, fill=0)
 
+    used_vols = vol_tbl = None
+    n_vols = 0
+    vol_type3 = ()
+    mp_limits = (0, 0, 0)
+    if config.has_maxpd:
+        n_vols = n_vols_real
+        vpad8 = max(-(-n_vols // SUBLANES) * SUBLANES, SUBLANES)
+        vpad_l = max(-(-n_vols // LANES) * LANES, LANES)
+        used_vols = np.zeros((vpad8, npad), dtype=np.int32)
+        used_vols[:n_vols, :n] = gt.used_vols_init.T.astype(np.int32)
+        vol_tbl = np.zeros((max(num_g, 1), vpad_l), dtype=np.int32)
+        vol_tbl[:num_g, :n_vols] = gt.vol_mask.astype(np.int32)
+        vol_type3 = tuple(int(v) for v in
+                          np.asarray(gt.vol_type, dtype=np.int64).flatten())
+        mp_limits = tuple(int(x) for x in config.maxpd_limits)
+
     topo_rows = presence_dom = ip_tbl = None
     ip_static = {}
     k_keys = d_doms_real = ta = tb = tp = 0
@@ -751,6 +807,8 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
         n_topo_doms_ip=d_doms_real, ta=ta, tb=tb, tp=tp,
         hard_weight=config.hard_weight, topo_rows=topo_rows,
         presence_dom=presence_dom, ipod=ip_tbl, **ip_static,
+        has_maxpd=config.has_maxpd, n_vols=n_vols, used_vols=used_vols,
+        vol_tbl=vol_tbl, vol_type3=vol_type3, maxpd_limits=mp_limits,
     )
     return plan, ""
 
@@ -802,11 +860,32 @@ def ip_const_of(plan: FastPlan) -> Optional[IpConst]:
         exist_aff_mask=plan.exist_aff_mask)
 
 
+@dataclass(frozen=True)
+class MpConst:
+    """Compile-time MaxPD constants baked into one kernel variant: volume
+    count/padding, per-volume type triples, and per-type limits."""
+
+    n_vols: int
+    vpad8: int       # sublane-padded carry rows
+    vpad_l: int      # lane-padded per-pod mask row width
+    vol_type3: Tuple[int, ...]              # [V*3] (EBS, GCE, AzureDisk)
+    limits: Tuple[int, int, int]
+
+
+def mp_const_of(plan: FastPlan) -> Optional[MpConst]:
+    if not plan.has_maxpd:
+        return None
+    return MpConst(n_vols=plan.n_vols, vpad8=plan.used_vols.shape[0],
+                   vpad_l=plan.vol_tbl.shape[1], vol_type3=plan.vol_type3,
+                   limits=plan.maxpd_limits)
+
+
 def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                  group: int, gpad: int = 0, zpad: int = 0,
                  has_ports: bool = False, has_disk: bool = False,
                  has_spread: bool = False, has_vol_zone: bool = False,
-                 ip: Optional[IpConst] = None):
+                 ip: Optional[IpConst] = None,
+                 mp: Optional[MpConst] = None):
     """Kernel body for one grid step of `group` consecutive pods.
 
     Mosaic requires the sublane (second-to-last) block dim to be a multiple
@@ -838,6 +917,10 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
             # carry (kernels.py skips presence updates for it too)
             vz_r = refs[at]
             at += 1
+        if mp is not None:
+            mvrow_r = refs[at]     # per-pod volume-mask rows [SUB, Vpad_l]
+            iuv_r = refs[at + 1]   # used-vols init carry [Vpad8, Npad]
+            at += 2
         if group_bound:
             gid_r = refs[at]
             at += 1
@@ -871,6 +954,9 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
             at += 1
             if ip is not None:
                 opd_r = refs[at]
+                at += 1
+        if mp is not None:
+            ouv_r = refs[at]
         p = pl.program_id(0)
 
         @pl.when(p == 0)
@@ -889,6 +975,8 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 opres_r[:] = ipres_r[:]
                 if ip is not None:
                     opd_r[:] = ipd_r[:]
+            if mp is not None:
+                ouv_r[:] = iuv_r[:]
 
         acpu = acpu_r[:]
         amem = amem_r[:]
@@ -1017,6 +1105,29 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                     (fail_disk, jnp.int32(1) << BIT_DISK_CONFLICT))
             stages.append(
                 (fail_taint, jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED))
+            if mp is not None:
+                # Max{EBS,GCEPD,AzureDisk}VolumeCount (predicates.go:422
+                # -460): unique relevant volume ids on the node incl. mine
+                # vs the per-type limit; a pod adding no relevant volumes
+                # passes regardless. Type triples are static, so only
+                # typed volumes generate code.
+                uv_rows = [ouv_r[v:v + 1, :] for v in range(mp.n_vols)]
+                fail_maxpd = fail_cond & False
+                for t3 in range(3):
+                    typed = [v for v in range(mp.n_vols)
+                             if mp.vol_type3[v * 3 + t3]]
+                    if not typed:
+                        continue
+                    myc = jnp.int32(0)
+                    cnt = jnp.zeros_like(cond)
+                    for v in typed:
+                        mb = mvrow_r[j, v] != 0
+                        myc = myc + mb.astype(jnp.int32)
+                        cnt = cnt + jnp.where(mb, 1, uv_rows[v])
+                    fail_maxpd = fail_maxpd | (
+                        (myc > 0) & (cnt > mp.limits[t3]))
+                stages.append(
+                    (fail_maxpd, jnp.int32(1) << BIT_MAX_VOLUME_COUNT))
             if has_vol_zone:
                 # NoVolumeZoneConflict (predicates.go:510-533): static per
                 # (volume-set, node) row, pregathered per pod
@@ -1259,6 +1370,11 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 for g2 in range(gpad):
                     opres_r[g2:g2 + 1, :] = jnp.where(
                         gid_s == g2, pres_rows[g2] + pick_i, pres_rows[g2])
+            if mp is not None:
+                for v in range(mp.n_vols):
+                    mb = mvrow_r[j, v] != 0
+                    ouv_r[v:v + 1, :] = jnp.where(
+                        pick & mb, 1, uv_rows[v])
             if ip is not None:
                 # presence_dom[gid, k, dom_k(choice)] += 1: the chosen
                 # node's domain id per key is a one-hot-extracted scalar
@@ -1286,7 +1402,8 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
                 counts_w: int, num_scalars: int, srows: int, interpret: bool,
                 gpad: int = 0, zpad: int = 0, has_ports: bool = False,
                 has_disk: bool = False, has_spread: bool = False,
-                has_vol_zone: bool = False, ip: Optional[IpConst] = None):
+                has_vol_zone: bool = False, ip: Optional[IpConst] = None,
+                mp: Optional[MpConst] = None):
     """jitted pallas_call for one (node-pad, chunk, scalar, group) shape.
 
     k must be a multiple of SUBLANES: Mosaic rejects blocks whose sublane
@@ -1297,7 +1414,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
     group_bound = gpad > 0
     kernel = _make_kernel(most_requested, num_bits, num_scalars, SUBLANES,
                           gpad, zpad, has_ports, has_disk, has_spread,
-                          has_vol_zone, ip)
+                          has_vol_zone, ip, mp)
 
     def smem_rows(width=1):
         return pl.BlockSpec((SUBLANES, width), lambda p: (p, 0),
@@ -1324,6 +1441,9 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
     group_out = []
     if has_vol_zone:
         group_in.append(row_per_pod())                 # zone_ok rows
+    if mp is not None:
+        group_in.append(row_per_pod(mp.vpad_l))        # volume-mask rows
+        group_in.append(const_row(rows=mp.vpad8))      # used-vols init
     if group_bound:
         group_in.append(smem_rows())                   # gid
         if has_spread:
@@ -1343,6 +1463,8 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
         group_out.append(const_row(rows=gpad))         # presence out
         if ip is not None:
             group_out.append(const_row(ip.dpad, rows=gpad * ip.k_keys))
+    if mp is not None:
+        group_out.append(const_row(rows=mp.vpad8))     # used-vols out
     grid_spec = pl.GridSpec(
         grid=(k // SUBLANES,),
         in_specs=(
@@ -1378,6 +1500,8 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
         + ([jax.ShapeDtypeStruct((gpad, npad), i32)] if group_bound else [])
         + ([jax.ShapeDtypeStruct((gpad * ip.k_keys, ip.dpad), i32)]
            if ip is not None else [])
+        + ([jax.ShapeDtypeStruct((mp.vpad8, npad), i32)]
+           if mp is not None else [])
     )
     call = pl.pallas_call(kernel, grid_spec=grid_spec,
                           out_shape=out_shape, interpret=interpret)
@@ -1458,11 +1582,12 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
           // SUBLANES) * SUBLANES
     gpad = plan.num_groups
     ipc = ip_const_of(plan)
+    mpc = mp_const_of(plan)
     call = _build_call(npad, k, plan.most_requested, num_bits, counts_w,
                        plan.num_scalars, srows, interpret,
                        gpad, plan.n_zone_doms, plan.has_ports,
                        plan.has_disk, plan.has_spread, plan.has_vol_zone,
-                       ipc)
+                       ipc, mpc)
 
     statics = [jnp.asarray(a) for a in (
         plan.alloc_cpu, plan.alloc_mem, plan.alloc_gpu, plan.alloc_eph,
@@ -1485,6 +1610,9 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
         topo_dev = jnp.asarray(plan.topo_rows)
         ip_tbl_dev = jnp.asarray(plan.ipod)
         pd_carry = jnp.asarray(carry_in.pd)
+    if mpc is not None:
+        vol_tbl_dev = jnp.asarray(plan.vol_tbl)
+        uv_carry = jnp.asarray(carry_in.uv)
     zone_tbl = (jnp.asarray(plan.zone_ok_tbl)
                 if plan.has_vol_zone else None)
 
@@ -1550,10 +1678,13 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
             rs = np.zeros((k, LANES), dtype=np.int32)
             rs[:sl.stop - sl.start, :plan.num_scalars] = plan.req_scalar[sl]
             args += [jnp.asarray(rs), ascal, scal_carry]
-        if gpad or plan.has_vol_zone:
+        if gpad or plan.has_vol_zone or mpc is not None:
             gids = col(plan.gid[sl], 0)
         if plan.has_vol_zone:
             args.append(zone_tbl[gids[:, 0]])
+        if mpc is not None:
+            args.append(vol_tbl_dev[gids[:, 0]])
+            args.append(uv_carry)
         if gpad:
             args.append(jnp.asarray(gids))
             if plan.has_spread:
@@ -1584,6 +1715,9 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
             oat += 1
         if ipc is not None:
             pd_carry = out[oat]
+            oat += 1
+        if mpc is not None:
+            uv_carry = out[oat]
         pending.append((out[8], out[9], out[10], sl.stop - sl.start))
         if sync_every and len(pending) > sync_every:
             drain_one()
@@ -1606,5 +1740,6 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
         rows=list(carry), misc=misc,
         scal=scal_carry if plan.num_scalars else None,
         pres=pres_carry if gpad else None,
-        pd=pd_carry if ipc is not None else None)
+        pd=pd_carry if ipc is not None else None,
+        uv=uv_carry if mpc is not None else None)
     return out3 + (carry_out,)

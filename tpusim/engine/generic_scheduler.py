@@ -43,7 +43,7 @@ from tpusim.engine.predicates import (
     get_predicate_metadata,
 )
 from tpusim.engine.priorities import HostPriority, PriorityConfig
-from tpusim.engine.resources import NodeInfo
+from tpusim.engine.resources import NodeInfo, get_resource_request
 from tpusim.engine.trace import Trace
 from tpusim.framework.metrics import register as register_metrics, since_in_microseconds
 from tpusim.engine.util import (
@@ -504,24 +504,28 @@ class GenericScheduler:
         violating, non_violating = self._filter_pods_with_pdb_violation(
             potential_victims, pdbs)
 
-        chain = self._reprieve_chain()
+        reprieve = self._make_arithmetic_reprieve(pod, meta, info_copy,
+                                                 victims)
+        if reprieve is None:
+            chain = self._reprieve_chain()
 
-        def reprieve(p) -> bool:
-            add_pod(p)
-            # the full-ordering fit above already passed on the stripped
-            # node; fit is an order-independent AND over the predicate set,
-            # so the boolean-only chain (pod-set-dependent predicates,
-            # cheapest first) gives the identical outcome
-            fits = True
-            for predicate in chain:
-                ok, _ = predicate(pod, meta, info_copy)
-                if not ok:
-                    fits = False
-                    break
-            if not fits:
-                remove_pod(p)
-                victims.append(p)
-            return fits
+            def reprieve(p) -> bool:
+                add_pod(p)
+                # the full-ordering fit above already passed on the
+                # stripped node; fit is an order-independent AND over the
+                # predicate set, so the boolean-only chain (pod-set
+                # -dependent predicates, cheapest first) gives the
+                # identical outcome
+                fits = True
+                for predicate in chain:
+                    ok, _ = predicate(pod, meta, info_copy)
+                    if not ok:
+                        fits = False
+                        break
+                if not fits:
+                    remove_pod(p)
+                    victims.append(p)
+                return fits
 
         for p in violating:
             if not reprieve(p):
@@ -529,6 +533,112 @@ class GenericScheduler:
         for p in non_violating:
             reprieve(p)
         return victims, num_violating, True
+
+    # workload feature hints, settable by the device-engine hybrid
+    # (jaxe/preempt.py) which statically knows whether ANY pod in the run —
+    # new or placed — carries host ports / conflictable volumes / MaxPD
+    # volumes / inter-pod terms. A reprieve-chain predicate for an absent
+    # feature is constant-true over every (pod, victim set) of the run, so
+    # eliding it cannot change any outcome; when the elided chain is
+    # exactly PodFitsResources, reprieve decisions reduce to pure integer
+    # arithmetic with no NodeInfo/metadata mutation at all.
+    reprieve_feature_hints = None
+
+    def _make_arithmetic_reprieve(self, pod, meta, info_copy, victims):
+        """Returns the integer-arithmetic reprieve closure, or None when the
+        hinted elision leaves more than PodFitsResources in the chain (the
+        generic clone/add path then runs)."""
+        hints = self.reprieve_feature_hints
+        if hints is None:
+            return None
+        from tpusim.engine.predicates import (
+            no_disk_conflict,
+            pod_fits_host_ports,
+            pod_fits_resources,
+        )
+        from tpusim.engine.predicates import (
+            MAX_AZURE_DISK_VOLUME_COUNT_PRED,
+            MAX_EBS_VOLUME_COUNT_PRED,
+            MAX_GCE_PD_VOLUME_COUNT_PRED,
+            MATCH_INTERPOD_AFFINITY_PRED,
+        )
+
+        maxpd = {self.predicates.get(k)
+                 for k in (MAX_EBS_VOLUME_COUNT_PRED,
+                           MAX_GCE_PD_VOLUME_COUNT_PRED,
+                           MAX_AZURE_DISK_VOLUME_COUNT_PRED)}
+        interpod = self.predicates.get(MATCH_INTERPOD_AFFINITY_PRED)
+        chain = self._reprieve_chain()
+        if pod_fits_resources not in chain:
+            # a set with neither GeneralPredicates nor PodFitsResources
+            # must not have resource checks imposed on it (the chain-based
+            # reprieve would never apply them)
+            return None
+        for fn in chain:
+            if fn is pod_fits_resources:
+                continue
+            if fn is pod_fits_host_ports and not hints.get("has_ports"):
+                continue
+            if fn is no_disk_conflict and not hints.get("has_disk_conflict"):
+                continue
+            if fn in maxpd and not hints.get("has_maxpd"):
+                continue
+            if fn is interpod and not hints.get("has_interpod"):
+                continue
+            return None  # a live pod-set-dependent predicate remains
+
+        # mirror pod_fits_resources (predicates.go:706-776) exactly: pod
+        # count always; resource axes only for a nonzero-request pod;
+        # extender-ignored extended resources skipped
+        preq = meta.pod_request if meta is not None \
+            else get_resource_request(pod)
+        zero_req = (preq.milli_cpu == 0 and preq.memory == 0
+                    and preq.nvidia_gpu == 0
+                    and preq.ephemeral_storage == 0 and not preq.scalar)
+        alloc = info_copy.allocatable_resource
+        allowed = info_copy.allowed_pod_number()
+        used = info_copy.requested_resource
+        ignored = getattr(meta, "ignored_extended_resources", None) or set()
+        scal_names = [name for name in preq.scalar
+                      if not ("/" in name and name in ignored)]
+        state = {
+            "n": len(info_copy.pods),
+            "cpu": used.milli_cpu + preq.milli_cpu,
+            "mem": used.memory + preq.memory,
+            "gpu": used.nvidia_gpu + preq.nvidia_gpu,
+            "eph": used.ephemeral_storage + preq.ephemeral_storage,
+            "scal": {name: used.scalar.get(name, 0) + preq.scalar[name]
+                     for name in scal_names},
+        }
+
+        def reprieve_math(v) -> bool:
+            vr = get_resource_request(v)
+            fits = state["n"] + 2 <= allowed  # +v +the incoming pod
+            if fits and not zero_req:
+                fits = (alloc.milli_cpu >= state["cpu"] + vr.milli_cpu
+                        and alloc.memory >= state["mem"] + vr.memory
+                        and alloc.nvidia_gpu >= state["gpu"] + vr.nvidia_gpu
+                        and alloc.ephemeral_storage
+                        >= state["eph"] + vr.ephemeral_storage)
+                if fits and scal_names:
+                    for name in scal_names:
+                        if alloc.scalar.get(name, 0) < state["scal"][name] \
+                                + vr.scalar.get(name, 0):
+                            fits = False
+                            break
+            if fits:
+                state["n"] += 1
+                state["cpu"] += vr.milli_cpu
+                state["mem"] += vr.memory
+                state["gpu"] += vr.nvidia_gpu
+                state["eph"] += vr.ephemeral_storage
+                for name in scal_names:
+                    state["scal"][name] += vr.scalar.get(name, 0)
+            else:
+                victims.append(v)
+            return fits
+
+        return reprieve_math
 
     def _fits_sans_nominated(self, pod, meta, node_info):
         """podFitsOnNode with queue=nil and no ecache (the preemption calls)."""

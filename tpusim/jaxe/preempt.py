@@ -300,6 +300,16 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                 num_reason_bits=num_bits,
                 hard_weight=hard_pod_affinity_symmetric_weight)
             ensure_x64()
+            # workload feature hints for the arithmetic reprieve fast path
+            # (generic_scheduler._make_arithmetic_reprieve): compiled flags
+            # cover new AND placed pods, so an absent feature's reprieve
+            # predicate is constant-true for the whole run
+            cc.scheduler.reprieve_feature_hints = {
+                "has_ports": config.has_ports,
+                "has_disk_conflict": config.has_disk_conflict,
+                "has_maxpd": config.has_maxpd,
+                "has_interpod": config.has_interpod,
+            }
             strings = reason_strings(compiled.scalar_names)
             names = compiled.statics.names
             base = pos            # plan/column row i holds feed[base + i]

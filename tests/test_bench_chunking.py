@@ -118,3 +118,59 @@ def test_pick_headline_prefers_clean_pallas_config3():
     assert bench.pick_headline([fast3, xla3, six]) is fast3
     assert bench.pick_headline([xla3, bad3, six]) is xla3
     assert bench.pick_headline([six]) is six
+
+
+def test_probe_wedge_cache(monkeypatch, tmp_path):
+    """A wedged probe verdict is cached for the TTL (back-to-back capture
+    stages skip straight to CPU), a healthy probe always re-takes and
+    clears the marker, and TTL=0 disables the cache."""
+    import time as _time
+
+    import bench
+
+    marker = tmp_path / ".probe_wedged_at"
+    monkeypatch.setattr(bench, "_PROBE_WEDGE_CACHE", str(marker))
+    monkeypatch.setenv("TPUSIM_BENCH_PROBE_CACHE_TTL", "120")
+
+    calls = []
+
+    class FakeProc:
+        def __init__(self, *a, **kw):
+            calls.append(1)
+
+        def communicate(self, timeout=None):
+            raise bench.subprocess.TimeoutExpired("x", timeout)
+
+        def wait(self, timeout=None):
+            return 0
+
+        def kill(self):
+            pass
+
+    monkeypatch.setattr(bench.subprocess, "Popen", FakeProc)
+    monkeypatch.setattr(bench, "_graceful_stop", lambda *a: None)
+    assert bench.preflight_probe(0.01) is None
+    assert marker.exists()
+    assert bench.preflight_probe(0.01) is None
+    assert len(calls) == 1  # second call skipped via the cache
+
+    # stale marker: probe re-taken
+    marker.write_text(str(_time.time() - 1000))
+    assert bench.preflight_probe(0.01) is None
+    assert len(calls) == 2
+
+    # TTL=0 disables
+    monkeypatch.setenv("TPUSIM_BENCH_PROBE_CACHE_TTL", "0")
+    assert bench.preflight_probe(0.01) is None
+    assert len(calls) == 3
+
+    # healthy probe clears the marker
+    class GoodProc(FakeProc):
+        def communicate(self, timeout=None):
+            return "PROBE tpu 64\n", ""
+
+    monkeypatch.setenv("TPUSIM_BENCH_PROBE_CACHE_TTL", "120")
+    marker.write_text(str(_time.time() - 1000))
+    monkeypatch.setattr(bench.subprocess, "Popen", GoodProc)
+    assert bench.preflight_probe(0.01) == "tpu"
+    assert not marker.exists()

@@ -788,3 +788,79 @@ def test_maxpd_exhaustion_parity(monkeypatch):
     assert np.array_equal(f_choices, np.asarray(choices))
     w = f_counts.shape[1]
     assert np.array_equal(f_counts, np.asarray(counts)[:, :w])
+
+
+def test_fuzz_policy_fast_path_parity():
+    """Randomized statically-gateable policies (predicate subsets incl.
+    individually-named GeneralPredicates parts, random priority weights)
+    through plan_fast/fast_scan vs the XLA scan, bit-for-bit (round 5)."""
+    import os
+    import random
+    from dataclasses import replace as dc_replace
+
+    from tpusim.engine.policy import decode_policy
+    from tpusim.jaxe.policyc import compile_policy
+
+    seeds = max(int(os.environ.get("TPUSIM_FUZZ_SEEDS", "3")), 1)
+    skipped = 0
+    pred_pool = ["GeneralPredicates", "PodFitsResources", "HostName",
+                 "MatchNodeSelector", "PodToleratesNodeTaints",
+                 "CheckNodeMemoryPressure", "CheckNodeDiskPressure",
+                 "NoDiskConflict", "MaxEBSVolumeCount"]
+    prio_pool = ["LeastRequestedPriority", "MostRequestedPriority",
+                 "BalancedResourceAllocation", "NodeAffinityPriority",
+                 "TaintTolerationPriority", "NodePreferAvoidPodsPriority"]
+    for seed in range(min(seeds, 25)):
+        rng = random.Random(8200 + seed)
+        preds = rng.sample(pred_pool, rng.randint(1, 5))
+        prios = [{"name": n, "weight": rng.randint(1, 5)}
+                 for n in rng.sample(prio_pool, rng.randint(1, 4))]
+        policy = decode_policy({
+            "kind": "Policy", "apiVersion": "v1",
+            "predicates": [{"name": n} for n in preds],
+            "priorities": prios,
+        })
+        cp = compile_policy(policy)
+        assert not cp.unsupported
+        nodes = [make_node(
+            f"n{i}", milli_cpu=rng.choice([1000, 2000, 4000]),
+            memory=rng.choice([2, 4, 8]) * 1024**3,
+            labels={"zone": f"z{i % 3}"},
+            taints=([{"key": "d", "value": "b", "effect": "NoSchedule"}]
+                    if i % 3 == 0 else None))
+            for i in range(rng.randint(4, 10))]
+        pods = []
+        for i in range(rng.randint(15, 30)):
+            kw = {}
+            if rng.random() < 0.3:
+                kw["tolerations"] = [{"key": "d", "operator": "Equal",
+                                      "value": "b",
+                                      "effect": "NoSchedule"}]
+            if rng.random() < 0.2:
+                kw["node_selector"] = {"zone": f"z{rng.randrange(3)}"}
+            pods.append(make_pod(
+                f"p{i}", milli_cpu=rng.randrange(1, 12) * 100,
+                memory=rng.randrange(1, 12) * 2**26, **kw))
+        snap = ClusterSnapshot(nodes=nodes)
+        compiled, cols = compile_cluster(snap, pods)
+        assert not compiled.unsupported
+        config = config_for(
+            [compiled], most_requested=False,
+            num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+        config = dc_replace(config, policy=cp.spec)
+        plan, reason = plan_fast(config, compiled, cols)
+        if plan is None:
+            skipped += 1
+            continue
+        _, choices, counts, advanced = schedule_scan(
+            config, carry_init(compiled), statics_to_device(compiled),
+            pod_columns_to_device(cols))
+        f_choices, f_counts, f_adv = fast_scan(plan, chunk=16)
+        assert np.array_equal(f_choices, np.asarray(choices)), \
+            f"seed {seed} preds={preds} prios={prios}"
+        assert np.array_equal(
+            f_counts, np.asarray(counts)[:, :f_counts.shape[1]]), \
+            f"seed {seed} preds={preds}"
+        assert np.array_equal(f_adv, np.asarray(advanced)), f"seed {seed}"
+    assert skipped <= max(1, min(seeds, 25) // 3), \
+        f"{skipped} of {min(seeds, 25)} seeds fell back"

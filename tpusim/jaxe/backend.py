@@ -88,7 +88,11 @@ def plan_signature(plan) -> tuple:
                 plan.exist_aff_key, plan.exist_aff_mask)
     if plan.has_maxpd:
         # volume type triples and limits are baked into the kernel variant
-        sig += (plan.n_vols, plan.vol_type3, plan.maxpd_limits)
+        sig += (plan.n_vols, plan.vol_type3, plan.maxpd_limits,
+                plan.maxpd_enabled)
+    if plan.policy is not None:
+        # the whole PolicySpec (hashable) is baked into the variant
+        sig += (plan.policy,)
     return sig
 
 
@@ -328,29 +332,28 @@ class JaxBackend:
         fplan = None
         fast_verify = False
         fast_sig = None
-        if cp is None:
-            fast_on, auto_mode = _fast_path_enabled()
-            if (fast_on and auto_mode and not _FAST_AUTO["verified_sigs"]
-                    and len(pods) < int(os.environ.get(
-                        "TPUSIM_FAST_VERIFY_MIN", 64))):
-                # no variant is trusted yet, so this small batch would be
-                # deferred after planning anyway — skip the O(nodes+pods)
-                # gcd reduction entirely (the pre-signature fast exit)
-                fast_on = False
-                log.info("pallas fast path deferred: %d pods is below "
-                         "the self-verification threshold; using the "
-                         "XLA scan", len(pods))
-            if fast_on:
-                from tpusim.jaxe.fastscan import plan_fast
+        fast_on, auto_mode = _fast_path_enabled()
+        if (fast_on and auto_mode and not _FAST_AUTO["verified_sigs"]
+                and len(pods) < int(os.environ.get(
+                    "TPUSIM_FAST_VERIFY_MIN", 64))):
+            # no variant is trusted yet, so this small batch would be
+            # deferred after planning anyway — skip the O(nodes+pods)
+            # gcd reduction entirely (the pre-signature fast exit)
+            fast_on = False
+            log.info("pallas fast path deferred: %d pods is below "
+                     "the self-verification threshold; using the "
+                     "XLA scan", len(pods))
+        if fast_on:
+            from tpusim.jaxe.fastscan import plan_fast
 
-                fplan, why = plan_fast(config, compiled, cols)
-                if fplan is None:
-                    log.info("pallas fast path ineligible (%s); using the "
-                             "XLA scan", why)
-                else:
-                    fast_sig = plan_signature(fplan)
-                    fast_verify = (auto_mode and fast_sig
-                                   not in _FAST_AUTO["verified_sigs"])
+            fplan, why = plan_fast(config, compiled, cols)
+            if fplan is None:
+                log.info("pallas fast path ineligible (%s); using the "
+                         "XLA scan", why)
+            else:
+                fast_sig = plan_signature(fplan)
+                fast_verify = (auto_mode and fast_sig
+                               not in _FAST_AUTO["verified_sigs"])
             if fplan is not None and fast_verify and len(pods) < int(
                     os.environ.get("TPUSIM_FAST_VERIFY_MIN", 64)):
                 # AUTO mode, variant not yet trusted: a batch too small to

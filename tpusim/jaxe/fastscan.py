@@ -30,8 +30,15 @@ Eligibility (checked by `plan_fast`, reasons returned):
     and an int32 weight-mass bound;
   * MaxPD volume counts run natively (round 5): the [N, V] used-volume
     union as a [Vpad, Npad] bit carry with baked type triples/limits,
-    bounded by TPUSIM_FAST_MAX_VOLS (32). Still host/XLA-bound: policies
-    (incl. ServiceAffinity) and extenders;
+    bounded by TPUSIM_FAST_MAX_VOLS (32);
+  * statically-gateable POLICIES compile into the kernel (round 5): the
+    PolicySpec (predicate subset incl. individually-named
+    GeneralPredicates parts, priority weights, per-type MaxPD enables,
+    hard weight) is baked into the kernel variant like the interpod
+    constants. Still host/XLA-bound: label-presence rows, label
+    priorities, ServiceAffinity/ServiceAntiAffinity, ImageLocality,
+    alwaysCheckAllPredicates, the NoExecute-only taint predicate, and
+    extenders;
   * every resource quantity reduces exactly to int32: values are divided by
     the per-axis gcd (exact — fractions and fit comparisons are
     unit-invariant) and the reduced values must stay under 2^29, with the
@@ -79,6 +86,23 @@ except Exception:  # pragma: no cover - exercised only on exotic builds
     pltpu = None
     _VMEM = _SMEM = None
 
+from tpusim.engine.predicates import (
+    CHECK_NODE_DISK_PRESSURE_PRED,
+    CHECK_NODE_MEMORY_PRESSURE_PRED,
+    GENERAL_PRED,
+    HOSTNAME_PRED,
+    MATCH_INTERPOD_AFFINITY_PRED,
+    MATCH_NODE_SELECTOR_PRED,
+    MAX_AZURE_DISK_VOLUME_COUNT_PRED,
+    MAX_EBS_VOLUME_COUNT_PRED,
+    MAX_GCE_PD_VOLUME_COUNT_PRED,
+    NO_DISK_CONFLICT_PRED,
+    NO_VOLUME_ZONE_CONFLICT_PRED,
+    POD_FITS_HOST_PORTS_PRED,
+    POD_FITS_RESOURCES_PRED,
+    POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+    POD_TOLERATES_NODE_TAINTS_PRED,
+)
 from tpusim.jaxe.state import NUM_FIXED_BITS, CompiledCluster, PodColumns
 from tpusim.jaxe.kernels import (
     AVOID_PODS_WEIGHT,
@@ -188,6 +212,9 @@ class FastPlan:
     # the gcd via plan_fast's placed_pods; rearm_carry verifies anyway)
     gcds: Tuple[int, int, int, int] = (1, 1, 1, 1)   # cpu, mem, gpu, eph
     scalar_gcds: Tuple[int, ...] = ()
+    # statically-gateable policy (round 5): the PolicySpec itself (hashable)
+    # is baked into the kernel variant — stage gating + score weights
+    policy: Optional[object] = None
     # inter-pod (anti)affinity (round 5). Own required/preferred terms run
     # through per-pod match rows + domain segment sums recomputed from the
     # presence carry (dc_at == broadcast-back of the per-domain sums of
@@ -222,6 +249,7 @@ class FastPlan:
     # needs no presence), and the per-volume type triples + per-type
     # limits are baked into the kernel variant.
     has_maxpd: bool = False
+    maxpd_enabled: Tuple[bool, bool, bool] = (True, True, True)
     n_vols: int = 0                          # V real volume ids
     used_vols: Optional[np.ndarray] = None   # [Vpad8, Npad] init carry
     vol_tbl: Optional[np.ndarray] = None     # [G, Vpad] mask by group id
@@ -439,8 +467,31 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     placed_pods: pods already bound in the snapshot (preemption callers) —
     their per-pod request/nonzero values join the gcd reduction so victim
     deletions keep refreshed aggregates expressible in plan units."""
-    if config.policy is not None:
-        return None, "policy configured"
+    ps = config.policy
+    if ps is not None:
+        # statically-gateable policies compile into the kernel (round 5);
+        # host/XLA-bound policy classes keep the logged fallback
+        blockers = []
+        if ps.label_rows:
+            blockers.append("label-presence predicate rows")
+        if ps.has_label_prio:
+            blockers.append("label priorities")
+        if ps.saa_weights:
+            blockers.append("ServiceAntiAffinity priorities")
+        if ps.sa_enabled or ps.sa_slots:
+            blockers.append("ServiceAffinity predicates")
+        if ps.ports_slots:
+            blockers.append("tail PodFitsPorts alias slots")
+        if ps.w_image:
+            blockers.append("ImageLocalityPriority")
+        if ps.always_check_all:
+            blockers.append("alwaysCheckAllPredicates")
+        if ps.pred_keys is not None \
+                and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED \
+                in ps.pred_keys:
+            blockers.append("NoExecute-only taint predicate")
+        if blockers:
+            return None, "policy: " + "; ".join(blockers)
     # maxpd carries a [N, V] per-node volume-id union — beyond the kernel's
     # presence model; every other pod-group feature (ports, disk conflicts,
     # spreading, volume zones, and — round 5 — inter-pod (anti)affinity)
@@ -504,7 +555,8 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
         w_exist = int(np.abs(gt.pref_w).sum()) + config.hard_weight * int(
             (gt.aff_valid & ~gt.aff_empty).sum())
         bound_counts = (w_own + w_exist) * max(total_pods, 1)
-        if MAX_PRIORITY * 2 * bound_counts >= (1 << 31):
+        w_ip_eff = 1 if ps is None else max(ps.w_interpod, 1)
+        if MAX_PRIORITY * 2 * w_ip_eff * bound_counts >= (1 << 31):
             return None, ("inter-pod priority counts exceed int32 "
                           f"(weight mass {w_own + w_exist} x "
                           f"{total_pods} pods)")
@@ -567,11 +619,23 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
         max(nzm.max(initial=0), nzum.max(initial=0), 0))
     if 10 * bound_c * bound_m >= (1 << 31):
         return None, "balanced-allocation product exceeds int32"
+    if ps is not None:
+        # the weighted sum of 0..MAX_PRIORITY components must stay int32
+        # (each component is bounded by MAX_PRIORITY after its normalize;
+        # avoid rides its own table check below via the policy weight)
+        w_total = (ps.w_least + ps.w_most + ps.w_balanced + ps.w_node_aff
+                   + ps.w_taint + ps.w_spread + ps.w_interpod)
+        if w_total * MAX_PRIORITY >= (1 << 30):
+            return None, "policy priority weights exceed the int32 budget"
+        if ps.w_balanced and 10 * ps.w_balanced * bound_c * bound_m \
+                >= (1 << 31):
+            return None, "weighted balanced-allocation exceeds int32"
+    w_avoid_eff = AVOID_PODS_WEIGHT if ps is None else ps.w_avoid
     for name, table in (("affinity", t.affinity_count),
                         ("intolerable", t.intolerable),
                         ("avoid", t.avoid_score)):
         if table.size and MAX_PRIORITY * int(np.max(np.abs(table))) * max(
-                AVOID_PODS_WEIGHT if name == "avoid" else 1, 1) >= (1 << 31):
+                w_avoid_eff if name == "avoid" else 1, 1) >= (1 << 31):
             return None, f"{name} table exceeds int32"
 
     n = len(np.asarray(s.alloc_cpu))
@@ -602,7 +666,9 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
                 bound_zone = max(bound_zone,
                                  int(col_tot[in_dom].sum())
                                  + int(in_dom.sum()) * allowed_pods_max)
-            if 3 * MAX_PRIORITY * bound_node * bound_zone >= (1 << 31):
+            w_spread_eff = 1 if ps is None else max(ps.w_spread, 1)
+            if 3 * MAX_PRIORITY * w_spread_eff * bound_node * bound_zone \
+                    >= (1 << 31):
                 return None, ("spread zone-blend products exceed int32 "
                               f"(node bound {bound_node} x zone bound "
                               f"{bound_zone})")
@@ -680,7 +746,12 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     n_vols = 0
     vol_type3 = ()
     mp_limits = (0, 0, 0)
-    if config.has_maxpd:
+    mp_enabled = (True, True, True)
+    if config.has_maxpd and ps is not None and ps.pred_keys is not None:
+        mp_enabled = (MAX_EBS_VOLUME_COUNT_PRED in ps.pred_keys,
+                      MAX_GCE_PD_VOLUME_COUNT_PRED in ps.pred_keys,
+                      MAX_AZURE_DISK_VOLUME_COUNT_PRED in ps.pred_keys)
+    if config.has_maxpd and any(mp_enabled):
         n_vols = n_vols_real
         vpad8 = max(-(-n_vols // SUBLANES) * SUBLANES, SUBLANES)
         vpad_l = max(-(-n_vols // LANES) * LANES, LANES)
@@ -807,8 +878,10 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
         n_topo_doms_ip=d_doms_real, ta=ta, tb=tb, tp=tp,
         hard_weight=config.hard_weight, topo_rows=topo_rows,
         presence_dom=presence_dom, ipod=ip_tbl, **ip_static,
-        has_maxpd=config.has_maxpd, n_vols=n_vols, used_vols=used_vols,
+        has_maxpd=config.has_maxpd and any(mp_enabled),
+        maxpd_enabled=mp_enabled, n_vols=n_vols, used_vols=used_vols,
         vol_tbl=vol_tbl, vol_type3=vol_type3, maxpd_limits=mp_limits,
+        policy=ps,
     )
     return plan, ""
 
@@ -870,6 +943,7 @@ class MpConst:
     vpad_l: int      # lane-padded per-pod mask row width
     vol_type3: Tuple[int, ...]              # [V*3] (EBS, GCE, AzureDisk)
     limits: Tuple[int, int, int]
+    enabled3: Tuple[bool, bool, bool] = (True, True, True)
 
 
 def mp_const_of(plan: FastPlan) -> Optional[MpConst]:
@@ -877,7 +951,7 @@ def mp_const_of(plan: FastPlan) -> Optional[MpConst]:
         return None
     return MpConst(n_vols=plan.n_vols, vpad8=plan.used_vols.shape[0],
                    vpad_l=plan.vol_tbl.shape[1], vol_type3=plan.vol_type3,
-                   limits=plan.maxpd_limits)
+                   limits=plan.maxpd_limits, enabled3=plan.maxpd_enabled)
 
 
 def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
@@ -885,7 +959,7 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                  has_ports: bool = False, has_disk: bool = False,
                  has_spread: bool = False, has_vol_zone: bool = False,
                  ip: Optional[IpConst] = None,
-                 mp: Optional[MpConst] = None):
+                 mp: Optional[MpConst] = None, ps=None):
     """Kernel body for one grid step of `group` consecutive pods.
 
     Mosaic requires the sublane (second-to-last) block dim to be a multiple
@@ -901,6 +975,22 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
     group state access via statically-unrolled loops over Gpad with
     (g == gid)-masked row ops — no dynamic indexing anywhere."""
     group_bound = gpad > 0
+
+    # policy gating + weights (kernels._evaluate's on()/part_on and the
+    # weighted-sum table, generic_scheduler.go:631-639) — all static, so
+    # gated-off stages and zero-weight components generate no code
+    en = None if ps is None else ps.pred_keys
+
+    def on(name):
+        return en is None or name in en
+
+    def part(name):
+        return en is not None and name in en
+
+    from tpusim.jaxe.kernels import policy_weights
+
+    (w_least, w_most, w_balanced, w_node_aff, w_taint, w_avoid, w_spread,
+     w_interpod) = policy_weights(ps, most_requested)
 
     def kernel(*refs):
         (rc_r, rm_r, rg_r, re_r, nzc_r, nzm_r, zr_r, be_r,
@@ -1009,37 +1099,53 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
             nz_m = onzm_r[:]
             pc = opc_r[:]
 
-            # ---- filter stages, predicatesOrdering (kernels._evaluate) ----
-            insuff_pods = (pc + 1) > allowed
-            insuff_cpu = check_res & (acpu < used_c + rc)
-            insuff_mem = check_res & (amem < used_m + rm)
-            insuff_gpu = check_res & (agpu < used_g + rg)
-            insuff_eph = check_res & (aeph < used_e + re)
-            fail_res = (insuff_pods | insuff_cpu | insuff_mem | insuff_gpu
-                        | insuff_eph)
-            scalar_bits = None
-            if num_scalars:
+            # ---- filter stages, predicatesOrdering (kernels._evaluate).
+            # Stage gating mirrors _evaluate's on()/part_on(): a policy
+            # (baked statically into this variant) enables GeneralPredicates
+            # and/or its individually-named parts, each a separate stage at
+            # its ordering slot; ps None = the provider's full pipeline ----
+            general_on = on(GENERAL_PRED)
+            need_res = general_on or part(POD_FITS_RESOURCES_PRED)
+            need_host = general_on or part(HOSTNAME_PRED)
+            need_sel = general_on or part(MATCH_NODE_SELECTOR_PRED)
+            if need_res:
+                insuff_pods = (pc + 1) > allowed
+                insuff_cpu = check_res & (acpu < used_c + rc)
+                insuff_mem = check_res & (amem < used_m + rm)
+                insuff_gpu = check_res & (agpu < used_g + rg)
+                insuff_eph = check_res & (aeph < used_e + re)
+                fail_res = (insuff_pods | insuff_cpu | insuff_mem
+                            | insuff_gpu | insuff_eph)
+                bits_res = (
+                    insuff_pods.astype(jnp.int32) << BIT_INSUFFICIENT_PODS
+                    | insuff_cpu.astype(jnp.int32) << BIT_INSUFFICIENT_CPU
+                    | insuff_mem.astype(jnp.int32)
+                    << BIT_INSUFFICIENT_MEMORY
+                    | insuff_gpu.astype(jnp.int32) << BIT_INSUFFICIENT_GPU
+                    | insuff_eph.astype(jnp.int32)
+                    << BIT_INSUFFICIENT_EPHEMERAL)
+                if num_scalars:
+                    us = ous_r[:]
+                    for si in range(num_scalars):
+                        ins = check_res & (asc[si:si + 1, :]
+                                           < us[si:si + 1, :] + rs_r[j, si])
+                        fail_res = fail_res | ins
+                        bits_res = bits_res | (
+                            ins.astype(jnp.int32)
+                            << (NUM_FIXED_BITS + si))
+            elif num_scalars:
                 us = ous_r[:]
-                for si in range(num_scalars):
-                    ins = check_res & (asc[si:si + 1, :]
-                                       < us[si:si + 1, :] + rs_r[j, si])
-                    fail_res = fail_res | ins
-                    bit = ins.astype(jnp.int32) << (NUM_FIXED_BITS + si)
-                    scalar_bits = (bit if scalar_bits is None
-                                   else scalar_bits | bit)
-            host_bad = host_r[j:j + 1, :] == 0
-            sel_bad = sel_r[j:j + 1, :] == 0
-            fail_general = fail_res | host_bad | sel_bad
-            bits_general = (
-                insuff_pods.astype(jnp.int32) << BIT_INSUFFICIENT_PODS
-                | insuff_cpu.astype(jnp.int32) << BIT_INSUFFICIENT_CPU
-                | insuff_mem.astype(jnp.int32) << BIT_INSUFFICIENT_MEMORY
-                | insuff_gpu.astype(jnp.int32) << BIT_INSUFFICIENT_GPU
-                | insuff_eph.astype(jnp.int32) << BIT_INSUFFICIENT_EPHEMERAL
-                | host_bad.astype(jnp.int32) << BIT_HOSTNAME_MISMATCH
-                | sel_bad.astype(jnp.int32) << BIT_NODE_SELECTOR_MISMATCH)
-            if scalar_bits is not None:
-                bits_general = bits_general | scalar_bits
+            if need_host:
+                host_bad = host_r[j:j + 1, :] == 0
+            if need_sel:
+                sel_bad = sel_r[j:j + 1, :] == 0
+            if general_on:
+                fail_general = fail_res | host_bad | sel_bad
+                bits_general = (
+                    bits_res
+                    | host_bad.astype(jnp.int32) << BIT_HOSTNAME_MISMATCH
+                    | sel_bad.astype(jnp.int32)
+                    << BIT_NODE_SELECTOR_MISMATCH)
             if group_bound:
                 gid_s = gid_r[j, 0]
                 pres_rows = [opres_r[g2:g2 + 1, :] for g2 in range(gpad)]
@@ -1074,7 +1180,7 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                                         dtype=jnp.int32)
                         dc_at = dc_at + jnp.where(in_d, seg_d, 0)
                     return mcount, dc_at, domsel
-            if has_ports:
+            if has_ports and (general_on or part(POD_FITS_HOST_PORTS_PRED)):
                 # PodFitsHostPorts (predicates.go:1019-1039), part of
                 # GeneralPredicates: my port set conflicts with the port
                 # set of any group present on the node
@@ -1082,19 +1188,30 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 for g2 in range(gpad):
                     port_bad = port_bad | jnp.where(
                         prow_r[j, g2] != 0, pres_rows[g2] > 0, False)
-                fail_general = fail_general | port_bad
-                bits_general = bits_general | (
-                    port_bad.astype(jnp.int32) << BIT_HOST_PORTS)
-            fail_taint = tol_r[j:j + 1, :] == 0
-            fail_mem_pr = mpr & best_effort
-            fail_disk_pr = dpr_fail
+                if general_on:
+                    fail_general = fail_general | port_bad
+                    bits_general = bits_general | (
+                        port_bad.astype(jnp.int32) << BIT_HOST_PORTS)
 
-            # short-circuit reason selection: first failing stage wins
-            # (ordering: cond -> general -> NoDiskConflict -> taints ->
-            # NoVolumeZoneConflict -> memory pressure -> disk pressure,
-            # matching predicatesOrdering in kernels._evaluate)
-            stages = [(fail_cond, cond), (fail_general, bits_general)]
-            if has_disk:
+            # short-circuit reason selection: first failing stage wins in
+            # predicatesOrdering (cond -> general -> hostname -> ports ->
+            # selector -> resources -> NoDiskConflict -> taints -> MaxPD ->
+            # NoVolumeZoneConflict -> memory pressure -> disk pressure ->
+            # interpod, matching kernels._evaluate incl. policy part slots)
+            stages = [(fail_cond, cond)]
+            if general_on:
+                stages.append((fail_general, bits_general))
+            if part(HOSTNAME_PRED):
+                stages.append(
+                    (host_bad, jnp.int32(1) << BIT_HOSTNAME_MISMATCH))
+            if part(POD_FITS_HOST_PORTS_PRED) and has_ports:
+                stages.append((port_bad, jnp.int32(1) << BIT_HOST_PORTS))
+            if part(MATCH_NODE_SELECTOR_PRED):
+                stages.append(
+                    (sel_bad, jnp.int32(1) << BIT_NODE_SELECTOR_MISMATCH))
+            if part(POD_FITS_RESOURCES_PRED):
+                stages.append((fail_res, bits_res))
+            if has_disk and on(NO_DISK_CONFLICT_PRED):
                 # NoDiskConflict (predicates.go:266-276): my volume set
                 # conflicts with the volume set of any group present
                 fail_disk = fail_cond & False
@@ -1103,8 +1220,10 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                         drow_r[j, g2] != 0, pres_rows[g2] > 0, False)
                 stages.append(
                     (fail_disk, jnp.int32(1) << BIT_DISK_CONFLICT))
-            stages.append(
-                (fail_taint, jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED))
+            if on(POD_TOLERATES_NODE_TAINTS_PRED):
+                fail_taint = tol_r[j:j + 1, :] == 0
+                stages.append(
+                    (fail_taint, jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED))
             if mp is not None:
                 # Max{EBS,GCEPD,AzureDisk}VolumeCount (predicates.go:422
                 # -460): unique relevant volume ids on the node incl. mine
@@ -1114,6 +1233,8 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 uv_rows = [ouv_r[v:v + 1, :] for v in range(mp.n_vols)]
                 fail_maxpd = fail_cond & False
                 for t3 in range(3):
+                    if not mp.enabled3[t3]:
+                        continue  # policy-disabled type (XLA: limit 2^30)
                     typed = [v for v in range(mp.n_vols)
                              if mp.vol_type3[v * 3 + t3]]
                     if not typed:
@@ -1128,15 +1249,19 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                         (myc > 0) & (cnt > mp.limits[t3]))
                 stages.append(
                     (fail_maxpd, jnp.int32(1) << BIT_MAX_VOLUME_COUNT))
-            if has_vol_zone:
+            if has_vol_zone and on(NO_VOLUME_ZONE_CONFLICT_PRED):
                 # NoVolumeZoneConflict (predicates.go:510-533): static per
                 # (volume-set, node) row, pregathered per pod
                 fail_vz = vz_r[j:j + 1, :] == 0
                 stages.append(
                     (fail_vz, jnp.int32(1) << BIT_VOLUME_ZONE_CONFLICT))
-            stages += [(fail_mem_pr, jnp.int32(1) << BIT_MEMORY_PRESSURE),
-                       (fail_disk_pr, jnp.int32(1) << BIT_DISK_PRESSURE)]
-            if ip is not None:
+            if on(CHECK_NODE_MEMORY_PRESSURE_PRED):
+                stages.append((mpr & best_effort,
+                               jnp.int32(1) << BIT_MEMORY_PRESSURE))
+            if on(CHECK_NODE_DISK_PRESSURE_PRED):
+                stages.append((dpr_fail,
+                               jnp.int32(1) << BIT_DISK_PRESSURE))
+            if ip is not None and on(MATCH_INTERPOD_AFFINITY_PRED):
                 # MatchInterPodAffinity (predicates.go:1125-1450) — last in
                 # predicatesOrdering; mirrors kernels._evaluate's stage.
                 # own required affinity terms
@@ -1217,41 +1342,59 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
             n_feasible = jnp.sum(feasible.astype(jnp.int32), dtype=jnp.int32)
             found = n_feasible > 0
 
-            # ---- score (int32 throughout; products bounded by plan_fast) ----
-            total_c = nz_c + nzc
-            total_m = nz_m + nzm
+            # ---- score (weighted sum, generic_scheduler.go:631-639;
+            # int32 throughout — products bounded by plan_fast; weights
+            # are compile-time statics, so zero-weight components generate
+            # no code, exactly like kernels._evaluate's gating) ----
+            score = jnp.zeros_like(cond)
+            if w_least or w_most or w_balanced:
+                total_c = nz_c + nzc
+                total_m = nz_m + nzm
 
-            def ratio(req, cap):
+            def ratio(req, cap, most):
                 valid = (cap > 0) & (req <= cap)
-                if most_requested:
+                if most:
                     expr = (req * MAX_PRIORITY) // jnp.maximum(cap, 1)
                 else:
                     expr = ((cap - req) * MAX_PRIORITY) // jnp.maximum(cap, 1)
                 return jnp.where(valid, expr, 0)
 
-            score = (ratio(total_c, acpu) + ratio(total_m, amem)) // 2
-            # balanced (exact rational, DEVIATIONS.md #16): products fit int32
-            num = jnp.abs(total_c * amem - total_m * acpu)
-            den = acpu * amem
-            bal = (MAX_PRIORITY * (den - num)) // jnp.maximum(den, 1)
-            bal_zero = ((acpu == 0) | (total_c >= acpu)
-                        | (amem == 0) | (total_m >= amem))
-            score = score + jnp.where(bal_zero, 0, bal)
-            # NodeAffinityPriority normalize over feasible nodes
-            aff = aff_r[j:j + 1, :]
-            aff_max = jnp.max(jnp.where(feasible, aff, 0))
-            score = score + jnp.where(
-                aff_max > 0, MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
-            # TaintTolerationPriority reversed normalize
-            intol = intol_r[j:j + 1, :]
-            intol_max = jnp.max(jnp.where(feasible, intol, 0))
-            score = score + jnp.where(
-                intol_max > 0,
-                MAX_PRIORITY
-                - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
-                MAX_PRIORITY)
-            score = score + av_r[j:j + 1, :] * AVOID_PODS_WEIGHT
-            if has_spread:
+            if w_least:
+                score = score + w_least * (
+                    (ratio(total_c, acpu, False)
+                     + ratio(total_m, amem, False)) // 2)
+            if w_most:
+                score = score + w_most * (
+                    (ratio(total_c, acpu, True)
+                     + ratio(total_m, amem, True)) // 2)
+            if w_balanced:
+                # balanced (exact rational, DEVIATIONS.md #16): products
+                # fit int32
+                num = jnp.abs(total_c * amem - total_m * acpu)
+                den = acpu * amem
+                bal = (MAX_PRIORITY * (den - num)) // jnp.maximum(den, 1)
+                bal_zero = ((acpu == 0) | (total_c >= acpu)
+                            | (amem == 0) | (total_m >= amem))
+                score = score + w_balanced * jnp.where(bal_zero, 0, bal)
+            if w_node_aff:
+                # NodeAffinityPriority normalize over feasible nodes
+                aff = aff_r[j:j + 1, :]
+                aff_max = jnp.max(jnp.where(feasible, aff, 0))
+                score = score + w_node_aff * jnp.where(
+                    aff_max > 0,
+                    MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
+            if w_taint:
+                # TaintTolerationPriority reversed normalize
+                intol = intol_r[j:j + 1, :]
+                intol_max = jnp.max(jnp.where(feasible, intol, 0))
+                score = score + w_taint * jnp.where(
+                    intol_max > 0,
+                    MAX_PRIORITY
+                    - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
+                    MAX_PRIORITY)
+            if w_avoid:
+                score = score + av_r[j:j + 1, :] * w_avoid
+            if has_spread and w_spread:
                 # SelectorSpreadPriority (selector_spreading.go:66-175):
                 # per-node count of pods matched by my services' selectors
                 # (groups flagged in my ss row), node/zone-blended exact
@@ -1280,8 +1423,9 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 blend = (MAX_PRIORITY
                          * (node_num * zone_den + 2 * zone_num * node_den)
                          ) // (3 * node_den * zone_den)
-                score = score + jnp.where(have_zones & zvalid, blend, plain)
-            if ip is not None:
+                score = score + w_spread * jnp.where(
+                    have_zones & zvalid, blend, plain)
+            if ip is not None and w_interpod:
                 # InterPodAffinityPriority (interpod_affinity.go:118+):
                 # (a) my preferred terms over existing pods, (b) existing
                 # pods' preferred terms over me, (c) their required
@@ -1322,7 +1466,7 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 minc = jnp.minimum(
                     jnp.min(jnp.where(feasible, counts_row, big_i)), 0)
                 rng_i = maxc - minc
-                score = score + jnp.where(
+                score = score + w_interpod * jnp.where(
                     rng_i > 0,
                     (MAX_PRIORITY * (counts_row - minc))
                     // jnp.maximum(rng_i, 1),
@@ -1403,7 +1547,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
                 gpad: int = 0, zpad: int = 0, has_ports: bool = False,
                 has_disk: bool = False, has_spread: bool = False,
                 has_vol_zone: bool = False, ip: Optional[IpConst] = None,
-                mp: Optional[MpConst] = None):
+                mp: Optional[MpConst] = None, ps=None):
     """jitted pallas_call for one (node-pad, chunk, scalar, group) shape.
 
     k must be a multiple of SUBLANES: Mosaic rejects blocks whose sublane
@@ -1414,7 +1558,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
     group_bound = gpad > 0
     kernel = _make_kernel(most_requested, num_bits, num_scalars, SUBLANES,
                           gpad, zpad, has_ports, has_disk, has_spread,
-                          has_vol_zone, ip, mp)
+                          has_vol_zone, ip, mp, ps)
 
     def smem_rows(width=1):
         return pl.BlockSpec((SUBLANES, width), lambda p: (p, 0),
@@ -1587,7 +1731,7 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
                        plan.num_scalars, srows, interpret,
                        gpad, plan.n_zone_doms, plan.has_ports,
                        plan.has_disk, plan.has_spread, plan.has_vol_zone,
-                       ipc, mpc)
+                       ipc, mpc, plan.policy)
 
     statics = [jnp.asarray(a) for a in (
         plan.alloc_cpu, plan.alloc_mem, plan.alloc_gpu, plan.alloc_eph,

@@ -551,6 +551,19 @@ def _seg_rows(values, doms, num_segments: int):
     )(values, doms)
 
 
+def policy_weights(ps, most_requested: bool) -> tuple:
+    """The score-component weight table (generic_scheduler.go:631-639),
+    shared by the XLA scan and the Pallas fast kernel so the ps-None
+    provider defaults (the most_requested swap, AVOID_PODS_WEIGHT) can
+    never drift between the two engines: (least, most, balanced, node_aff,
+    taint, avoid, spread, interpod)."""
+    if ps is None:
+        w_least, w_most = (0, 1) if most_requested else (1, 0)
+        return (w_least, w_most, 1, 1, 1, AVOID_PODS_WEIGHT, 1, 1)
+    return (ps.w_least, ps.w_most, ps.w_balanced, ps.w_node_aff,
+            ps.w_taint, ps.w_avoid, ps.w_spread, ps.w_interpod)
+
+
 def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     """Filter + score one pod against the carried aggregates.
 
@@ -877,17 +890,9 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     n_feasible = jnp.sum(feasible)
 
     # ---- score (weighted sum, generic_scheduler.go:631-639) ----
-    if ps is None:
-        w_least, w_most = (0, 1) if config.most_requested else (1, 0)
-        w_balanced = w_node_aff = w_taint = w_spread = w_interpod = 1
-        w_avoid = AVOID_PODS_WEIGHT
-        label_prio_on = False
-    else:
-        w_least, w_most = ps.w_least, ps.w_most
-        w_balanced, w_node_aff = ps.w_balanced, ps.w_node_aff
-        w_taint, w_avoid = ps.w_taint, ps.w_avoid
-        w_spread, w_interpod = ps.w_spread, ps.w_interpod
-        label_prio_on = ps.has_label_prio
+    (w_least, w_most, w_balanced, w_node_aff, w_taint, w_avoid, w_spread,
+     w_interpod) = policy_weights(ps, config.most_requested)
+    label_prio_on = ps is not None and ps.has_label_prio
 
     score = jnp.zeros_like(st.alloc_cpu)
     if w_least or w_most or w_balanced:

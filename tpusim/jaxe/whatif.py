@@ -400,7 +400,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     (config, host_trees, real_count, batch_indices, compiled_list,
      empty_results) = prep
 
-    if mesh is None and config.policy is None:
+    if mesh is None:
         # Pallas fast loop: per-scenario kernels instead of the single
         # vmap(S)xscan(P) program, whose XLA compile alone costs ~2min at
         # the 50x20k BASELINE config-5 shape. Engages only when EVERY

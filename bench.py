@@ -364,7 +364,9 @@ def measure_fast_extra(name, plan, platform, num_pods, timed_runs,
 
     try:
         t0 = time.perf_counter()
-        f_choices, _fc, _fa = fast_scan(plan, progress=fprog)
+        with stage_heartbeat("  pallas cold run (Mosaic compile gives no "
+                             "incremental progress)"):
+            f_choices, _fc, _fa = fast_scan(plan, progress=fprog)
         log(f"  pallas cold (incl Mosaic compile): "
             f"{time.perf_counter() - t0:.1f}s")
         f_times = []

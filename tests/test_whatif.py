@@ -569,3 +569,47 @@ class TestWhatIfGroupBound:
                 placements_key(backend.schedule(pods, snap)))
         for got, want in zip(batched, backend_singles):
             assert placements_key(got.placements) == want
+
+
+def test_policy_what_if_fast_loop_matches_vmap(monkeypatch):
+    """Round 5: a statically-gateable POLICY batch routes through the
+    Pallas fast loop (per-scenario kernels) and matches the batched vmap
+    program exactly."""
+    import numpy as np
+
+    from tpusim.engine.policy import decode_policy
+    from tpusim.jaxe import backend, fastscan
+
+    policy = decode_policy({
+        "kind": "Policy", "apiVersion": "v1",
+        "predicates": [{"name": "GeneralPredicates"},
+                       {"name": "PodToleratesNodeTaints"}],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 1},
+                       {"name": "NodeAffinityPriority", "weight": 2}]})
+    rng = np.random.RandomState(0)
+    scenarios = []
+    for s_i in range(3):
+        nodes = [make_node(f"n{i}", milli_cpu=4000, memory=16 * 1024**3)
+                 for i in range(10 + s_i)]
+        pods = [make_pod(f"p{i}", milli_cpu=int(rng.choice([500, 1000])),
+                         memory=2**28) for i in range(80)]
+        scenarios.append((ClusterSnapshot(nodes=nodes), pods))
+
+    vmap_res = run_what_if(scenarios, policy=policy)
+
+    monkeypatch.setenv("TPUSIM_FAST", "1")
+    monkeypatch.setenv("TPUSIM_FAST_INTERPRET", "1")
+    monkeypatch.setitem(backend._FAST_AUTO, "disabled", False)
+    calls = []
+    real = fastscan.fast_scan
+
+    def wrapped(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fastscan, "fast_scan", wrapped)
+    fast_res = run_what_if(scenarios, policy=policy)
+    assert len(calls) == len(scenarios)
+    for a, b in zip(fast_res, vmap_res):
+        assert [(p.node_name, p.message) for p in a.placements] \
+            == [(p.node_name, p.message) for p in b.placements]

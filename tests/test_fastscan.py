@@ -864,3 +864,68 @@ def test_fuzz_policy_fast_path_parity():
         assert np.array_equal(f_adv, np.asarray(advanced)), f"seed {seed}"
     assert skipped <= max(1, min(seeds, 25) // 3), \
         f"{skipped} of {min(seeds, 25)} seeds fell back"
+
+
+def test_every_group_feature_combined_parity():
+    """The strongest single operand-ordering test: ports + services/spread
+    + disk conflicts + volume zones + MaxPD + inter-pod anti-affinity ALL
+    active in ONE kernel variant, bit-identical to the XLA scan."""
+    import random
+
+    from tpusim.api.snapshot import make_pv, make_pvc
+
+    rng = random.Random(99)
+    nodes = [make_node(
+        f"n{i}", milli_cpu=16000, memory=64 * 1024**3, pods=60,
+        labels={LABEL_ZONE_FAILURE_DOMAIN: f"z{i % 3}",
+                "rack": f"r{i % 2}"}) for i in range(8)]
+    svc = [_service("web", {"app": "a0"})]
+    pvs = [make_pv("pv-z", labels={LABEL_ZONE_FAILURE_DOMAIN: "z1"})]
+    pvcs = [make_pvc("claim-z", volume_name="pv-z")]
+    existing = [make_pod(f"e{i}", node_name=f"n{i % 8}", phase="Running",
+                         milli_cpu=100, labels={"app": f"a{i % 2}"})
+                for i in range(6)]
+    pods = []
+    for i in range(40):
+        kw = {"labels": {"app": f"a{rng.randrange(2)}"}}
+        r = rng.random()
+        if r < 0.2:
+            kw["affinity"] = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector":
+                     {"matchLabels": {"app": kw["labels"]["app"]}},
+                     "topologyKey": "rack"}]}}
+        elif r < 0.35:
+            kw["volumes"] = [make_pod_volume("v", pvc="claim-z")]
+        elif r < 0.5:
+            kw["volumes"] = [make_pod_volume(
+                "b", {"awsElasticBlockStore":
+                      {"volumeID": f"ebs{rng.randrange(3)}"}})]
+        p = make_pod(f"p{i}", milli_cpu=rng.randrange(1, 8) * 100,
+                     memory=rng.randrange(1, 8) * 2**26, **kw)
+        if rng.random() < 0.3:
+            p.spec.containers[0].ports = [ContainerPort.from_obj(
+                {"containerPort": 80,
+                 "hostPort": rng.choice([8080, 9090])})]
+        pods.append(p)
+    snap = ClusterSnapshot(nodes=nodes, pods=existing, services=svc,
+                           pvs=pvs, pvcs=pvcs)
+    compiled, cols = compile_cluster(snap, pods)
+    assert not compiled.unsupported
+    config = config_for([compiled], most_requested=False,
+                        num_reason_bits=NUM_FIXED_BITS
+                        + len(compiled.scalar_names))
+    for flag in ("has_ports", "has_services", "has_disk_conflict",
+                 "has_vol_zone", "has_maxpd", "has_interpod"):
+        assert getattr(config, flag), flag
+    plan, why = plan_fast(config, compiled, cols)
+    assert plan is not None, why
+    f_choices, f_counts, f_adv = fast_scan(plan, chunk=16)
+    _, choices, counts, advanced = schedule_scan(
+        config, carry_init(compiled), statics_to_device(compiled),
+        pod_columns_to_device(cols))
+    assert 0 < int((np.asarray(choices) >= 0).sum()) < len(pods)
+    assert np.array_equal(f_choices, np.asarray(choices))
+    assert np.array_equal(f_counts,
+                          np.asarray(counts)[:, :f_counts.shape[1]])
+    assert np.array_equal(f_adv, np.asarray(advanced))

@@ -250,3 +250,64 @@ def test_preempt_fast_verify_once_small_chunk0(monkeypatch):
     assert {p.metadata.name: p.spec.node_name
             for p in fast.successful_pods} \
         == {p.metadata.name: p.spec.node_name for p in base.successful_pods}
+
+
+def test_preempt_fast_path_with_interpod(monkeypatch):
+    """Preemption + inter-pod anti-affinity together on the fast path: the
+    post-victim re-arm must rebuild BOTH the presence and presence_dom
+    carries (rearm_carry's interpod branch) and stay outcome-identical to
+    the XLA hybrid."""
+    import random
+
+    from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+    from tpusim.jaxe import fastscan
+    from tpusim.jaxe.preempt import run_with_preemption
+
+    rng = random.Random(31)
+    nodes = [make_node(f"n{i}", milli_cpu=2000, memory=8 * 1024**3,
+                       labels={"zone": f"z{i % 3}"}) for i in range(12)]
+    low = []
+    for i in range(20):
+        p = make_pod(f"low{i}", milli_cpu=800, memory=2**28,
+                     labels={"app": "lo"})
+        p.spec.node_name = f"n{i % 12}"
+        p.spec.priority = 0
+        low.append(p)
+    pods = []
+    for i in range(60):
+        kw = {"labels": {"app": f"a{rng.randrange(2)}"}}
+        if rng.random() < 0.3:
+            kw["affinity"] = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector":
+                     {"matchLabels": {"app": kw["labels"]["app"]}},
+                     "topologyKey": "zone"}]}}
+        p = make_pod(f"p{i}", milli_cpu=rng.choice([400, 800]),
+                     memory=2**28, **kw)
+        p.spec.priority = int(rng.choice([0, 500, 1000]))
+        pods.append(p)
+    snap = ClusterSnapshot(nodes=nodes, pods=low)
+
+    def outcome(st):
+        return ({p.metadata.name: p.spec.node_name
+                 for p in st.successful_pods},
+                sorted(p.metadata.name for p in st.failed_pods),
+                sorted(p.metadata.name for p in st.preempted_pods))
+
+    monkeypatch.delenv("TPUSIM_FAST", raising=False)
+    base = run_with_preemption(pods, snap)
+    assert base.preempted_pods  # the shape must actually preempt
+
+    monkeypatch.setenv("TPUSIM_FAST", "1")
+    monkeypatch.setenv("TPUSIM_FAST_INTERPRET", "1")
+    calls = []
+    real = fastscan.fast_scan
+
+    def wrapped(*a, **kw):
+        calls.append(kw.get("carry_in") is not None)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fastscan, "fast_scan", wrapped)
+    fast = run_with_preemption(pods, snap)
+    assert calls and all(calls)
+    assert outcome(fast) == outcome(base)

@@ -48,9 +48,11 @@ emits a second "(pallas)" record with in-process hash parity per config).
 
 from __future__ import annotations
 
+import glob
 import hashlib
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -61,6 +63,103 @@ import numpy as np
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------------------
+# idle-host envelope guard (VERDICT r5 Weak #3)
+#
+# A contended driver host can halve a median without any code regression
+# (round 5: 6,232 pods/s under load vs 10,036 idle at the IDENTICAL
+# placement hash). Every record is therefore compared against the last
+# committed BENCH_r*.json record for the same (placement_hash, platform)
+# whose load1 stamp looked idle; a >20% warm-median deviation is stamped
+# into the record itself so the artifact trail carries the explanation.
+# --------------------------------------------------------------------------
+
+# load1 above this means the prior record itself ran contended and is no
+# anchor; the committed idle records sit at 0.4-0.6
+IDLE_LOAD1_MAX = float(os.environ.get("TPUSIM_BENCH_IDLE_LOAD1", "2.0"))
+
+
+def _envelope_key(record: dict):
+    """(placement_hash, platform) from a pods/s record's metric string, or
+    None when the record carries no hash (hash equality is what pins 'same
+    shape AND same placements' across rounds)."""
+    if record.get("unit") != "pods/s":
+        return None
+    m = record.get("metric", "")
+    ph = re.search(r"placement_hash=([0-9a-f]+)", m)
+    pl = re.search(r"platform=(\w+)", m)
+    if not ph or not pl:
+        return None
+    return ph.group(1), pl.group(1)
+
+
+def _record_median_s(record: dict):
+    """Comparable warm seconds: the warm_s median when the record has one,
+    else the implied seconds-per-(value unit) — config-6 records are a
+    single end-to-end run and ship no warm_s spread."""
+    med = (record.get("warm_s") or {}).get("median")
+    if med is not None:
+        return float(med)
+    value = record.get("value")
+    if value:
+        return 1.0 / float(value)
+    return None
+
+
+def load_idle_envelopes(bench_dir: str = None) -> dict:
+    """(placement_hash, platform) -> (round_tag, warm_median_s) from the
+    newest committed BENCH_r*.json whose record ran on an idle host
+    (0 <= load1 <= IDLE_LOAD1_MAX) without an error flag."""
+    if bench_dir is None:
+        bench_dir = os.path.dirname(os.path.abspath(__file__))
+    envelopes = {}
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        recs = doc.get("parsed")
+        recs = [recs] if isinstance(recs, dict) else (recs or [])
+        tag = re.search(r"(r\d+)", os.path.basename(path))
+        tag = tag.group(1) if tag else os.path.basename(path)
+        for rec in recs:
+            if not isinstance(rec, dict) or rec.get("error"):
+                continue
+            key = _envelope_key(rec)
+            med = _record_median_s(rec)
+            load1 = rec.get("load1", -1.0)
+            if key is None or med is None:
+                continue
+            if not 0 <= load1 <= IDLE_LOAD1_MAX:
+                continue
+            envelopes[key] = (tag, med)  # later rounds overwrite earlier
+    return envelopes
+
+
+_ENVELOPES = None
+
+
+def stamp_envelope_deviation(result: dict, envelopes: dict = None) -> dict:
+    """Stamp `envelope_deviation` (e.g. "+73% vs r04 idle") into `result`
+    when its warm median deviates >20% from the last idle-host record for
+    the same (placement_hash, platform). Mutates and returns `result`."""
+    global _ENVELOPES
+    if envelopes is None:
+        if _ENVELOPES is None:
+            _ENVELOPES = load_idle_envelopes()
+        envelopes = _ENVELOPES
+    key = _envelope_key(result)
+    med = _record_median_s(result)
+    if key is None or med is None or key not in envelopes:
+        return result
+    tag, idle_med = envelopes[key]
+    dev = (med - idle_med) / idle_med
+    if abs(dev) > 0.20:
+        result["envelope_deviation"] = f"{dev:+.0%} vs {tag} idle"
+    return result
 
 
 # --------------------------------------------------------------------------
@@ -343,8 +442,8 @@ def measure_config(name: str, snapshot, pods, platform: str,
             extra = measure_fast_extra(name, dual_plan, platform, num_pods,
                                        timed_runs, phash, ref_rate, load1)
             if extra is not None:
-                print(json.dumps(extra), flush=True)
-    return result
+                print(json.dumps(stamp_envelope_deviation(extra)), flush=True)
+    return stamp_envelope_deviation(result)
 
 
 def measure_fast_extra(name, plan, platform, num_pods, timed_runs,
@@ -664,6 +763,12 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
             or (p.name in jax_failed) != (p.name in ref_failed))
         log(f"  parity check on first {sub} pods: {mismatches} mismatches")
 
+    from tpusim.jaxe.preempt import (
+        PREEMPT_CLASS_STATS,
+        reset_preempt_class_stats,
+    )
+
+    reset_preempt_class_stats()
     t0 = time.perf_counter()
     with stage_heartbeat("[config 6] hybrid still running"):
         status = run_simulation([p.copy() for p in pods], snapshot,
@@ -671,9 +776,24 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
     e2e = max(time.perf_counter() - t0, 1e-9)
     rate = p6 / e2e
     preempted = len(status.preempted_pods)
+    victim_paths = dict(PREEMPT_CLASS_STATS)
+    # outcome hash spanning placements AND the victim set — the config-6
+    # analog of the scan's placement_hash, so the idle-envelope guard can
+    # pin "same workload, same outcome" across rounds
+    phash = hashlib.sha256(
+        ("|".join(f"{p.name}:{p.spec.node_name}"
+                  for p in status.successful_pods)
+         + "#" + ",".join(sorted(p.name for p in status.preempted_pods))
+         ).encode()).hexdigest()[:16]
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = -1.0
     log(f"  hybrid end-to-end: {p6} pods in {e2e:.1f}s = {rate:.0f} pods/s "
         f"({len(status.successful_pods)} scheduled, "
-        f"{len(status.failed_pods)} unschedulable, {preempted} preempted)")
+        f"{len(status.failed_pods)} unschedulable, {preempted} preempted) "
+        f"placement_hash={phash} load1={load1:.1f} "
+        f"victim_paths={victim_paths}")
 
     # the honest 10x criterion needs the reference on the FULL feed at EQUAL
     # preemption counts (the parity subsample saturates nothing and preempts
@@ -702,16 +822,21 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
         vs_baseline = round(rate / ref_rate, 2)
         ref_note = (f", ref_full={ref_rate:.0f}pods/s"
                     f"/{len(ref_full.preempted_pods)}preempted")
-    return {
+    return stamp_envelope_deviation({
         "metric": f"scheduled pods/sec (config 6: {p6 // 1000}k "
                   f"priority-banded pods, {n6} nodes, preemption hybrid, "
                   f"platform={platform}, preempted={preempted}"
                   + (f", parity_mismatches={mismatches}"
-                     if mismatches is not None else "") + ref_note + ")",
+                     if mismatches is not None else "") + ref_note
+                  + f", placement_hash={phash})",
         "value": round(rate, 1),
         "unit": "pods/s",
         "vs_baseline": vs_baseline,
-    }
+        "load1": round(load1, 2),
+        # victim-selection path split (device kernel vs host oracle) for the
+        # arithmetic-reprieve offload — preempt.PREEMPT_CLASS_STATS
+        "victim_paths": victim_paths,
+    })
 
 
 def run_phases(platform: str, chunk: int) -> None:

@@ -1,0 +1,89 @@
+"""The idle-host envelope guard (bench.stamp_envelope_deviation): committed
+BENCH_r*.json records anchor every new record's warm median for the same
+(placement_hash, platform); a synthetic slow run must self-label with the
+deviation vs the idle anchor instead of masquerading as a regression
+(VERDICT r5 Weak #3 — round 5 halved the headline under driver-host load
+at an IDENTICAL placement hash)."""
+
+import json
+
+import bench
+
+METRIC = ("scheduled pods/sec (20k Zipf pods, 2000 heterogeneous nodes, "
+          "exact scan, platform=cpu, parity_mismatches=0, "
+          "placement_hash=8e5277049eff2d41)")
+
+
+def _doc(value, median, load1, metric=METRIC, error=None):
+    rec = {"metric": metric, "value": value, "unit": "pods/s",
+           "warm_runs": 5,
+           "warm_s": {"min": round(median - 0.05, 3), "median": median,
+                      "max": round(median + 0.1, 3)},
+           "load1": load1}
+    if error:
+        rec["error"] = error
+    return json.dumps({"n": 4, "rc": 0, "parsed": rec})
+
+
+def test_synthetic_slow_run_self_labels(tmp_path):
+    # the literal round-4/round-5 pair: idle 1.854s median vs contended
+    # 3.209s at the same placement hash — the slow record must say so
+    (tmp_path / "BENCH_r04.json").write_text(_doc(10789.4, 1.854, 0.41))
+    envelopes = bench.load_idle_envelopes(str(tmp_path))
+    slow = {"metric": METRIC, "value": 6231.8, "unit": "pods/s",
+            "warm_s": {"min": 2.986, "median": 3.209, "max": 3.369},
+            "load1": 5.2}
+    bench.stamp_envelope_deviation(slow, envelopes)
+    assert slow["envelope_deviation"] == "+73% vs r04 idle"
+
+
+def test_within_envelope_is_not_stamped(tmp_path):
+    (tmp_path / "BENCH_r04.json").write_text(_doc(10789.4, 1.854, 0.41))
+    envelopes = bench.load_idle_envelopes(str(tmp_path))
+    ok = {"metric": METRIC, "value": 10100.0, "unit": "pods/s",
+          "warm_s": {"min": 1.9, "median": 1.98, "max": 2.1}, "load1": 0.5}
+    bench.stamp_envelope_deviation(ok, envelopes)
+    assert "envelope_deviation" not in ok
+
+
+def test_contended_prior_record_is_no_anchor(tmp_path):
+    # a prior record that itself ran hot (load1 above the idle gate) or
+    # carries an error flag must not become the envelope
+    (tmp_path / "BENCH_r03.json").write_text(_doc(6000.0, 3.3, 7.5))
+    (tmp_path / "BENCH_r04.json").write_text(
+        _doc(6100.0, 3.2, 0.4, error="checksum drift"))
+    assert bench.load_idle_envelopes(str(tmp_path)) == {}
+
+
+def test_newest_idle_round_wins(tmp_path):
+    (tmp_path / "BENCH_r03.json").write_text(_doc(9000.0, 2.2, 0.5))
+    (tmp_path / "BENCH_r04.json").write_text(_doc(10789.4, 1.854, 0.41))
+    envelopes = bench.load_idle_envelopes(str(tmp_path))
+    assert envelopes[("8e5277049eff2d41", "cpu")] == ("r04", 1.854)
+
+
+def test_config6_value_only_record_compares_by_rate(tmp_path):
+    # config-6 records are a single end-to-end run with no warm_s spread:
+    # the guard falls back to implied seconds-per-pod from the rate
+    metric = ("scheduled pods/sec (config 6: 6k priority-banded pods, 300 "
+              "nodes, preemption hybrid, platform=cpu, preempted=31, "
+              "placement_hash=aabbccddeeff0011)")
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(
+        {"n": 6, "rc": 0,
+         "parsed": {"metric": metric, "value": 2800.0, "unit": "pods/s",
+                    "load1": 0.4}}))
+    envelopes = bench.load_idle_envelopes(str(tmp_path))
+    slow = {"metric": metric, "value": 1400.0, "unit": "pods/s", "load1": 6.0}
+    bench.stamp_envelope_deviation(slow, envelopes)
+    assert slow["envelope_deviation"] == "+100% vs r06 idle"
+
+
+def test_different_hash_or_platform_not_compared(tmp_path):
+    (tmp_path / "BENCH_r04.json").write_text(_doc(10789.4, 1.854, 0.41))
+    envelopes = bench.load_idle_envelopes(str(tmp_path))
+    other = {"metric": METRIC.replace("8e5277049eff2d41", "0000000000000000"),
+             "value": 100.0, "unit": "pods/s",
+             "warm_s": {"min": 100.0, "median": 200.0, "max": 300.0},
+             "load1": 0.3}
+    bench.stamp_envelope_deviation(other, envelopes)
+    assert "envelope_deviation" not in other

@@ -105,3 +105,69 @@ def test_missing_artifact_reruns(tmp_path):
         f'source <(sed -n "/^stage_done()/,/^}}/p" {REPO}/tools/tpu_capture.sh)\n'
         f'stage_done "{tmp_path}/nope.jsonl" "configs:1"\n')
     assert subprocess.run(["bash", str(script)]).returncode != 0
+
+
+# --- stage 0: the all-variants kernel smoke ---
+
+
+def smoke_done(tmp_path, content):
+    (tmp_path / "bench_results").mkdir(exist_ok=True)
+    if content is not None:
+        (tmp_path / "bench_results/r5_tpu_smoke.txt").write_text(content)
+    script = tmp_path / "driver.sh"
+    script.write_text(
+        "#!/usr/bin/env bash\n"
+        f'source <(sed -n "/^smoke_done()/,/^}}/p" {REPO}/tools/tpu_capture.sh)\n'
+        "smoke_done\n")
+    return subprocess.run(["bash", str(script)],
+                          cwd=tmp_path).returncode == 0
+
+
+def test_smoke_requires_tpu_completion(tmp_path):
+    # an interpreter-mode (CPU) sweep proves nothing about Mosaic lowering
+    # and must not certify stage 0; a failed sweep has no COMPLETE line
+    assert not smoke_done(tmp_path, None)
+    assert not smoke_done(
+        tmp_path, "SMOKE base: OK hash=ab\n"
+                  "SMOKE COMPLETE: 9 variants, platform=cpu (155.3s)\n")
+    assert not smoke_done(
+        tmp_path, "SMOKE FAILED: interpod: choices diverge\n")
+    assert smoke_done(
+        tmp_path, "SMOKE base: OK hash=ab\n"
+                  "SMOKE COMPLETE: 9 variants, platform=tpu (41.0s)\n")
+
+
+def test_smoke_variants_cover_every_kernel_class():
+    """The stage-0 sweep must keep one batch per kernel-variant class —
+    a class silently dropped from the list would certify a surface it
+    never ran (the capture's whole-surface claim becomes a lie)."""
+    import re
+
+    src = open(f"{REPO}/tools/tpu_smoke.py").read()
+    names = set(re.findall(r'^\s+\("(\w+)", _\w+, (?:True|False)\)',
+                           src, re.M))
+    assert names == {"base", "most_requested", "ports", "disk", "spread",
+                     "vol_zone", "interpod", "maxpd"}
+    assert "run_preempt_variant" in src  # the victim kernel rides along
+
+
+# --- the watcher's round-start PID check ---
+
+
+def test_watcher_refuses_second_instance(tmp_path):
+    import os
+    import shutil
+
+    (tmp_path / "tools").mkdir()
+    shutil.copy(f"{REPO}/tools/tpu_watch.sh", tmp_path / "tools/tpu_watch.sh")
+    (tmp_path / "bench_results").mkdir()
+    # a LIVE pid in the pidfile: the second watcher must refuse to start
+    # (two watchers = two TPU clients racing the tunnel)
+    (tmp_path / "bench_results/tpu_watch.pid").write_text(str(os.getpid()))
+    res = subprocess.run(["bash", "tools/tpu_watch.sh"], cwd=tmp_path,
+                         capture_output=True, text=True, timeout=30)
+    assert res.returncode == 1
+    assert "already running" in res.stderr
+    # the refused start must not clobber the live watcher's pidfile
+    assert (tmp_path / "bench_results/tpu_watch.pid").read_text() \
+        == str(os.getpid())

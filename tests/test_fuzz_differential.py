@@ -262,6 +262,85 @@ def test_fuzz_preemption_parity():
         assert sig(jx) == sig(ref), f"seed {seed}"
 
 
+def test_fuzz_preemption_banded_saturated_parity():
+    """Priority-banded SATURATED workloads: every seed drives preemption
+    chains through the arithmetic-reprieve dispatch seam, so the device
+    victim-selection kernel (jaxe/kernels.preempt_select) is exercised
+    against the host oracle across node counts, victim shapes (incl.
+    zero-request pods — the kernel's static zero_req variant) and band
+    overlaps. Half the seeds clear the kernel's per-variant trust so the
+    first-use verification path re-runs; a nonzero `fallback` count means
+    the kernel DISAGREED with the host pipeline — a hard failure here, not
+    a fallback to tolerate. The occasional host-ports pod flips the whole
+    run to the general class, covering the class-dispatch seam itself."""
+    from tpusim.api.types import ContainerPort
+    from tpusim.jaxe.backend import _VICTIM_AUTO
+    from tpusim.jaxe.preempt import (
+        PREEMPT_CLASS_STATS,
+        reset_preempt_class_stats,
+    )
+
+    reset_preempt_class_stats()
+    for seed in range(_fuzz_seeds(4)):
+        _bound_compile_state(seed)
+        rng = random.Random(7000 + seed)
+        if seed % 2 == 0:
+            _VICTIM_AUTO["verified_sigs"].clear()
+        n_nodes = rng.randint(3, 8)
+        nodes = [make_node(f"n{i}", milli_cpu=rng.choice([1000, 2000, 4000]),
+                           memory=rng.choice([2, 4, 8]) * 1024 * MB,
+                           pods=rng.choice([5, 110]),
+                           labels={"zone": f"z{i % 3}"})
+                 for i in range(n_nodes)]
+        placed = []
+        for i in range(rng.randint(n_nodes, 3 * n_nodes)):
+            zero = rng.random() < 0.15
+            p = make_pod(f"placed-{i}",
+                         milli_cpu=0 if zero else rng.choice([200, 700, 1500]),
+                         memory=0 if zero else rng.choice([0, 128, 512]) * MB,
+                         node_name=f"n{rng.randrange(n_nodes)}",
+                         phase="Running")
+            p.spec.priority = rng.choice([0, 0, 1, 2, 4])
+            placed.append(p)
+        # the class flags are workload-wide: ONE ports pod anywhere demotes
+        # the whole run to the general class, so ports seeds are explicit
+        # (otherwise ~every seed would carry one and the kernel never runs)
+        with_ports = seed % 3 == 2
+        pods = []
+        for i in range(rng.randint(15, 25)):
+            zero = rng.random() < 0.15
+            p = make_pod(f"pod-{i}",
+                         milli_cpu=0 if zero else rng.choice([300, 800, 1800]),
+                         memory=0 if zero else rng.choice([0, 256, 1024]) * MB)
+            p.spec.priority = rng.choice([0, 1, 3, 5, 5, 9])
+            if with_ports and rng.random() < 0.3:
+                p.spec.containers[0].ports = [ContainerPort.from_obj(
+                    {"containerPort": 8080, "hostPort": 8080})]
+            pods.append(p)
+        snapshot = ClusterSnapshot(nodes=nodes, pods=placed)
+        ref = run_simulation(list(pods), snapshot, backend="reference",
+                             enable_pod_priority=True)
+        jx = run_simulation(list(pods), snapshot, backend="jax",
+                            enable_pod_priority=True)
+        assert sig(jx) == sig(ref), f"seed {seed}"
+        if seed % 2 == 1:
+            # node-sharded mesh leg: the same banded workload with the
+            # speculation chunks dispatched over the 8-way virtual mesh
+            import jax
+
+            from tpusim.jaxe.preempt import run_with_preemption
+            from tpusim.jaxe.sharding import make_mesh
+
+            if len(jax.devices()) >= 8:
+                ms = run_with_preemption([p.copy() for p in pods], snapshot,
+                                         mesh=make_mesh(8, snap=1))
+                assert sig(ms) == sig(ref), f"seed {seed} (mesh)"
+    assert PREEMPT_CLASS_STATS.get("fallback", 0) == 0, PREEMPT_CLASS_STATS
+    assert (PREEMPT_CLASS_STATS.get("device", 0)
+            + PREEMPT_CLASS_STATS.get("device_verified", 0)) > 0, \
+        dict(PREEMPT_CLASS_STATS)
+
+
 def _fuzz_seeds(default: int) -> int:
     """TPUSIM_FUZZ_SEEDS scales the committed quick sweeps into extended
     campaigns (COVERAGE.md 'verification campaign')."""

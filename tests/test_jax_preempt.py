@@ -177,6 +177,53 @@ def test_jax_preemption_chunk_sizing_invariant(monkeypatch):
     assert small.preempted_pods
 
 
+def _node_mesh_or_skip():
+    import jax
+    import pytest
+
+    from tpusim.jaxe.sharding import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh(8, snap=1)
+
+
+def test_jax_preemption_node_sharded_mesh_matches_single_device():
+    """The hybrid with the node axis sharded over the 8-way mesh (speculation
+    chunks dispatch under `with mesh`, the carry re-arm after every preemption
+    lands back on the mesh) must stay byte-identical to the single-device
+    hybrid on a priority-banded saturated workload — including the device
+    victim-selection arm, whose kernel runs unsharded off the host victim
+    table. 10 nodes over 8 shards also exercises the node-axis padding."""
+    import bench
+    from tpusim.jaxe.preempt import run_with_preemption
+
+    mesh = _node_mesh_or_skip()
+    snap, pods = bench.build_workload(400, 10, priorities=True, seed=41)
+    base = run_with_preemption([p.copy() for p in pods], snap)
+    assert base.preempted_pods, "workload drifted: nothing preempted"
+    sharded = run_with_preemption([p.copy() for p in pods], snap, mesh=mesh)
+    assert status_sig(sharded) == status_sig(base)
+    assert len(sharded.preempted_pods) == len(base.preempted_pods)
+
+
+def test_jax_preemption_mesh_host_arm_parity(monkeypatch):
+    """TPUSIM_PREEMPT_DEVICE=0 forces host victim selection; under the mesh
+    the outcome must still match the single-device run with the device kernel
+    on — the victim arm and the scan sharding are independent axes."""
+    import bench
+    from tpusim.jaxe.preempt import run_with_preemption
+
+    mesh = _node_mesh_or_skip()
+    snap, pods = bench.build_workload(400, 10, priorities=True, seed=43)
+    monkeypatch.delenv("TPUSIM_PREEMPT_DEVICE", raising=False)
+    base = run_with_preemption([p.copy() for p in pods], snap)
+    assert base.preempted_pods
+    monkeypatch.setenv("TPUSIM_PREEMPT_DEVICE", "0")
+    sharded = run_with_preemption([p.copy() for p in pods], snap, mesh=mesh)
+    assert status_sig(sharded) == status_sig(base)
+
+
 def test_preempt_fast_path_engages_and_matches(monkeypatch):
     """Round-5: the preemption hybrid drives its speculation chunks through
     the Pallas kernel (interpreter here), re-arming the carry from

@@ -181,6 +181,36 @@ fi
 # stage left, or every bench child would skip its probe into CPU fallback
 rm -f bench_results/.probe_wedged_at
 
+smoke_done() {
+    # the smoke certifies only when the whole sweep completed ON TPU —
+    # an interpreter-mode (CPU) run proves nothing about Mosaic lowering
+    grep -q "SMOKE COMPLETE: .* platform=tpu" \
+        bench_results/r5_tpu_smoke.txt 2>/dev/null
+}
+
+echo "== stage 0: all-variants kernel smoke (tiny shapes, <60s on TPU) =="
+if smoke_done; then
+    echo "== stage 'smoke' already certified on TPU; skipping =="
+else
+    # one tiny batch per kernel-variant class (base/most-requested/ports/
+    # disk/spread/vol-zone/interpod/maxpd + the preempt-victim kernel),
+    # each hash-checked against the XLA scan in-process: even a ~2-minute
+    # healthy window certifies Mosaic lowering of the whole surface
+    if ! python tools/tpu_smoke.py \
+            2> >(tee bench_results/r5_tpu_smoke.log >&2) \
+            | tee bench_results/r5_tpu_smoke.txt; then
+        echo "== stage 'smoke' FAILED — a kernel-variant class does not" \
+             "lower or diverges from the XLA scan; aborting (the watcher" \
+             "retries at the next healthy probe) ==" >&2
+        exit 1
+    fi
+    if ! smoke_done; then
+        echo "== smoke ran off-TPU (CPU fallback); aborting so the" \
+             "watcher retries at the next healthy probe ==" >&2
+        exit 1
+    fi
+fi
+
 echo "== stage 1: Pallas fastscan, configs 3-4 (the round's #1 artifact) =="
 run_stage fastscan pallas:3,4 bench_results/r5_tpu_fast.jsonl \
     bench_results/r5_tpu_fast.log \

@@ -1,0 +1,325 @@
+#!/usr/bin/env python
+"""Stage-0 all-variants TPU smoke: one tiny-shape batch per kernel-variant
+class, each checked for placement-hash parity against the XLA scan in the
+same process.
+
+The capture runbook (tools/tpu_capture.sh) runs this FIRST: even a
+~2-minute healthy tunnel window then certifies that every Pallas
+kernel-variant class — base scan, MostRequested scoring, host-ports,
+disk-conflict, selector-spreading, volume-zone, inter-pod affinity,
+max-PD volume counts — actually lowers through Mosaic and agrees with
+the XLA scan bit-for-bit, plus that the preemption victim-selection
+kernel (jaxe/preempt.py) byte-matches the host oracle. Shapes are tiny
+(<=8 nodes, <=24 pods) so the whole sweep compiles and runs in well
+under a minute on a healthy TPU; off-TPU the Pallas kernels auto-select
+interpreter mode, so the same script validates on CPU (slower).
+
+Each variant prints one line:
+
+    SMOKE <variant>: OK hash=<sha256[:16]> scheduled=<n>/<total> (<s>s)
+
+and the script ends with `SMOKE COMPLETE: <n> variants, platform=<p>`
+(exit 0) or `SMOKE FAILED: ...` (exit 1). TPUSIM_SMOKE_VARIANTS=a,b
+restricts the sweep (debugging a single variant class).
+"""
+
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from tpusim.jaxe import ensure_x64  # noqa: E402
+
+ensure_x64()
+
+from tpusim.api.snapshot import (  # noqa: E402
+    ClusterSnapshot,
+    make_node,
+    make_pod,
+    make_pod_volume,
+    make_pv,
+    make_pvc,
+)
+from tpusim.api.types import (  # noqa: E402
+    LABEL_ZONE_FAILURE_DOMAIN,
+    ContainerPort,
+    Service,
+)
+from tpusim.jaxe.fastscan import fast_scan, plan_fast  # noqa: E402
+from tpusim.jaxe.kernels import (  # noqa: E402
+    carry_init,
+    config_for,
+    pod_columns_to_device,
+    schedule_scan,
+    statics_to_device,
+)
+from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster  # noqa: E402
+
+
+def _service(name, selector):
+    return Service.from_obj({"metadata": {"name": name,
+                                          "namespace": "default"},
+                             "spec": {"selector": selector}})
+
+
+def _port_pod(name, port, **kw):
+    p = make_pod(name, milli_cpu=100, **kw)
+    p.spec.containers[0].ports = [ContainerPort.from_obj(
+        {"containerPort": port, "hostPort": port})]
+    return p
+
+
+# --- one tiny workload per kernel-variant class -------------------------
+
+
+def _base():
+    """Group-free scan: taints, selectors, pins, preferred node affinity."""
+    nodes = [make_node(f"n{i}", milli_cpu=(500, 1000, 2000)[i % 3],
+                       memory=(1 + i % 3) * 1024**3, pods=(4, 8, 110)[i % 3],
+                       labels={"zone": f"z{i % 3}"},
+                       taints=[{"key": "dedicated", "value": "batch",
+                                "effect": "NoSchedule"}] if i % 3 == 0
+                       else None,
+                       unschedulable=(i == 5)) for i in range(8)]
+    seeded = [make_pod(f"r{i}", milli_cpu=300, memory=2**28,
+                       node_name=f"n{i}", phase="Running") for i in range(4)]
+    pods = []
+    for i in range(24):
+        kw = {}
+        if i % 5 == 0:
+            kw["tolerations"] = [{"key": "dedicated", "operator": "Equal",
+                                  "value": "batch", "effect": "NoSchedule"}]
+        if i % 4 == 0:
+            kw["node_selector"] = {"zone": f"z{i % 4}"}  # z3 never matches
+        if i % 9 == 0:
+            kw["node_name"] = f"n{i % 10}"  # pins, one dangling
+        pods.append(make_pod(f"p{i}", milli_cpu=(1 + i % 6) * 200,
+                             memory=(1 + i % 4) * 2**27, **kw))
+    return ClusterSnapshot(nodes=nodes, pods=seeded), pods
+
+
+def _ports():
+    nodes = [make_node(f"n{i}") for i in range(3)]
+    seeded = _port_pod("seed", 8080, node_name="n0", phase="Running")
+    pods = [_port_pod(f"p{i}", 8080) for i in range(5)] \
+        + [_port_pod("other", 9090)]
+    return ClusterSnapshot(nodes=nodes, pods=[seeded]), pods
+
+
+def _disk():
+    nodes = [make_node(f"n{i}") for i in range(2)]
+    vol = [make_pod_volume("v", {"rbd": {"monitors": ["a"], "pool": "p",
+                                         "image": "img"}})]
+    pods = [make_pod(f"p{i}", milli_cpu=100, volumes=vol) for i in range(4)]
+    return ClusterSnapshot(nodes=nodes), pods
+
+
+def _spread():
+    nodes = [make_node(f"n{i}", labels={
+        LABEL_ZONE_FAILURE_DOMAIN: f"z{i % 2}"}) for i in range(4)]
+    existing = [make_pod(f"e{i}", node_name=f"n{i % 2}", phase="Running",
+                         labels={"app": "api"}) for i in range(3)]
+    snap = ClusterSnapshot(nodes=nodes, pods=existing,
+                           services=[_service("api", {"app": "api"})])
+    return snap, [make_pod(f"p{i}", milli_cpu=10, labels={"app": "api"})
+                  for i in range(8)]
+
+
+def _vol_zone():
+    nodes = [make_node(f"n{i}", labels={
+        LABEL_ZONE_FAILURE_DOMAIN: f"z{i % 2}"}) for i in range(4)]
+    pvs = [make_pv("pv-a", labels={LABEL_ZONE_FAILURE_DOMAIN: "z0"})]
+    pvcs = [make_pvc("claim-a", volume_name="pv-a")]
+    pods = [make_pod(f"p{i}", milli_cpu=10,
+                     volumes=[make_pod_volume("v", pvc="claim-a")])
+            for i in range(3)]
+    return ClusterSnapshot(nodes=nodes, pvs=pvs, pvcs=pvcs), pods
+
+
+def _interpod():
+    nodes = [make_node(f"n{i}", milli_cpu=4000, memory=8 * 1024**3,
+                       labels={"zone": f"z{i % 2}", "rack": f"r{i % 3}"})
+             for i in range(6)]
+    existing = [make_pod(f"e{i}", node_name=f"n{i}", phase="Running",
+                         milli_cpu=100, labels={"app": ("a0", "a1")[i % 2]})
+                for i in range(3)]
+    pods = []
+    for i in range(12):
+        aff = None
+        if i % 3 == 0:
+            aff = {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "a0"}},
+                     "topologyKey": "zone"}]}}
+        elif i % 3 == 1:
+            aff = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "a1"}},
+                     "topologyKey": "rack"}]}}
+        pods.append(make_pod(f"p{i}", milli_cpu=200, memory=2**27,
+                             labels={"app": ("a0", "a1")[i % 2]},
+                             affinity=aff))
+    return ClusterSnapshot(nodes=nodes, pods=existing), pods
+
+
+def _maxpd():
+    # KUBE_MAX_PD_VOLS forced low so the volume-count limit actually fires
+    os.environ["KUBE_MAX_PD_VOLS"] = "2"
+    nodes = [make_node(f"n{i}", milli_cpu=64000, memory=64 * 1024**3,
+                       pods=100) for i in range(3)]
+    existing = [make_pod(
+        f"e{i}", node_name=f"n{i % 3}", phase="Running", milli_cpu=100,
+        volumes=[make_pod_volume(
+            "v", {"awsElasticBlockStore": {"volumeID": f"ebs{i}"}})])
+        for i in range(3)]
+    pods = [make_pod(
+        f"p{i}", milli_cpu=100, memory=2**26,
+        volumes=[make_pod_volume(
+            "v", {"awsElasticBlockStore": {"volumeID": f"ebs{i % 5}"}})])
+        for i in range(10)]
+    return ClusterSnapshot(nodes=nodes, pods=existing), pods
+
+
+PALLAS_VARIANTS = [
+    # (name, workload builder, most_requested)
+    ("base", _base, False),
+    ("most_requested", _base, True),
+    ("ports", _ports, False),
+    ("disk", _disk, False),
+    ("spread", _spread, False),
+    ("vol_zone", _vol_zone, False),
+    ("interpod", _interpod, False),
+    ("maxpd", _maxpd, False),
+]
+
+
+def run_pallas_variant(name, build, most_requested):
+    """Pallas fast path vs the XLA scan, bit-for-bit, on one tiny batch."""
+    snapshot, pods = build()
+    compiled, cols = compile_cluster(snapshot, pods)
+    assert not compiled.unsupported, (name, compiled.unsupported)
+    config = config_for(
+        [compiled], most_requested=most_requested,
+        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+    plan, reason = plan_fast(config, compiled, cols)
+    if plan is None:
+        raise AssertionError(f"variant {name} ineligible for the fast "
+                             f"path: {reason}")
+    _, choices, counts, advanced = schedule_scan(
+        config, carry_init(compiled), statics_to_device(compiled),
+        pod_columns_to_device(cols))
+    f_choices, f_counts, f_adv = fast_scan(plan, chunk=16)
+    choices, counts = np.asarray(choices), np.asarray(counts)
+    if not np.array_equal(f_choices, choices):
+        raise AssertionError(f"variant {name}: choices diverge from the "
+                             f"XLA scan")
+    w = f_counts.shape[1]
+    if not np.array_equal(f_counts, counts[:, :w]):
+        raise AssertionError(f"variant {name}: reason histograms diverge")
+    if not np.array_equal(f_adv, np.asarray(advanced)):
+        raise AssertionError(f"variant {name}: rr advancement diverges")
+    h = hashlib.sha256(
+        choices.tobytes() + counts.tobytes()).hexdigest()[:16]
+    return h, int((choices >= 0).sum()), len(pods)
+
+
+def _preempt_workload():
+    """Arithmetic-reprieve class: packed low-priority residents, banded
+    incoming pods — only PodFitsResources can flip, so the device
+    victim-selection kernel handles every preemption."""
+    nodes = [make_node(f"n{i}", milli_cpu=2000, memory=4 * 1024**3)
+             for i in range(4)]
+    residents = []
+    for i in range(4):
+        p = make_pod(f"fill{i}", milli_cpu=1800, memory=2**28,
+                     node_name=f"n{i}", phase="Running")
+        p.spec.priority = 0
+        residents.append(p)
+    pods = []
+    for i in range(8):
+        p = make_pod(f"p{i}", milli_cpu=600, memory=2**26)
+        p.spec.priority = (0, 500, 1000)[i % 3]
+        pods.append(p)
+    return ClusterSnapshot(nodes=nodes, pods=residents), pods
+
+
+def run_preempt_variant():
+    """Device victim-selection kernel vs the host oracle on a tiny banded
+    batch: same placements, same victims, and the device arm actually
+    fired (an all-host run must not certify the kernel)."""
+    from tpusim.jaxe.preempt import (
+        PREEMPT_CLASS_STATS,
+        reset_preempt_class_stats,
+        run_with_preemption,
+    )
+
+    def sig(status):
+        return ([(p.name, p.spec.node_name)
+                 for p in status.successful_pods],
+                sorted(p.name for p in status.preempted_pods),
+                [p.name for p in status.failed_pods])
+
+    snapshot, pods = _preempt_workload()
+    reset_preempt_class_stats()
+    os.environ.pop("TPUSIM_PREEMPT_DEVICE", None)  # AUTO: verify-then-trust
+    dev = run_with_preemption([p.copy() for p in pods], snapshot)
+    paths = dict(PREEMPT_CLASS_STATS)
+    if not dev.preempted_pods:
+        raise AssertionError("preempt workload evicted nothing; the "
+                             "victim kernel was never exercised")
+    if not (paths.get("device") or paths.get("device_verified")):
+        raise AssertionError(f"victim selection never took the device "
+                             f"arm: {paths}")
+    os.environ["TPUSIM_PREEMPT_DEVICE"] = "0"
+    try:
+        host = run_with_preemption([p.copy() for p in pods], snapshot)
+    finally:
+        os.environ.pop("TPUSIM_PREEMPT_DEVICE", None)
+    if sig(dev) != sig(host):
+        raise AssertionError("device victim selection diverges from the "
+                             "host oracle")
+    h = hashlib.sha256(repr(sig(dev)).encode()).hexdigest()[:16]
+    return h, len(dev.preempted_pods), paths
+
+
+def main() -> int:
+    import jax
+
+    platform = jax.default_backend()
+    only = [v for v in os.environ.get("TPUSIM_SMOKE_VARIANTS", "").split(",")
+            if v]
+    t0 = time.time()
+    ran = 0
+    for name, build, most in PALLAS_VARIANTS:
+        if only and name not in only:
+            continue
+        t = time.time()
+        try:
+            h, scheduled, total = run_pallas_variant(name, build, most)
+        except Exception as exc:  # noqa: BLE001 — one line per failure
+            print(f"SMOKE FAILED: {name}: {exc}", flush=True)
+            return 1
+        ran += 1
+        print(f"SMOKE {name}: OK hash={h} scheduled={scheduled}/{total} "
+              f"({time.time() - t:.1f}s)", flush=True)
+    if not only or "preempt_victim" in only:
+        t = time.time()
+        try:
+            h, n_victims, paths = run_preempt_variant()
+        except Exception as exc:  # noqa: BLE001
+            print(f"SMOKE FAILED: preempt_victim: {exc}", flush=True)
+            return 1
+        ran += 1
+        print(f"SMOKE preempt_victim: OK hash={h} victims={n_victims} "
+              f"paths={paths} ({time.time() - t:.1f}s)", flush=True)
+    print(f"SMOKE COMPLETE: {ran} variants, platform={platform} "
+          f"({time.time() - t0:.1f}s)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,19 @@
 cd "$(dirname "$0")/.."
 log=bench_results/tpu_watch.log
 mkdir -p bench_results
-echo "$(date -u +%H:%M:%S) watcher started" >> "$log"
+# round-start PID check: a second watcher would mean two TPU clients
+# racing the tunnel (probe vs capture), which is exactly the wedge this
+# script exists to avoid — refuse to start while one is alive; a stale
+# pidfile (dead pid) is reclaimed
+pidfile=bench_results/tpu_watch.pid
+if [ -f "$pidfile" ] && kill -0 "$(cat "$pidfile" 2>/dev/null)" 2>/dev/null; then
+    echo "watcher already running (pid $(cat "$pidfile")); refusing to" \
+         "start a second TPU client" >&2
+    exit 1
+fi
+echo $$ > "$pidfile"
+trap 'rm -f "$pidfile"' EXIT
+echo "$(date -u +%H:%M:%S) watcher started (pid $$)" >> "$log"
 while true; do
     if timeout 60 python -c "
 import jax; jax.devices()

@@ -544,13 +544,18 @@ class GenericScheduler:
     # arithmetic with no NodeInfo/metadata mutation at all.
     reprieve_feature_hints = None
 
-    def _make_arithmetic_reprieve(self, pod, meta, info_copy, victims):
-        """Returns the integer-arithmetic reprieve closure, or None when the
-        hinted elision leaves more than PodFitsResources in the chain (the
-        generic clone/add path then runs)."""
+    def preemption_reprieve_class(self) -> str:
+        """The class-dispatch seam for device-side victim selection
+        (jaxe/preempt.py): "arithmetic" when the workload feature hints
+        elide every pod-set-dependent predicate except PodFitsResources
+        from the reprieve chain — victim search is then pure integer
+        arithmetic over resource aggregates, the shape the device kernel
+        (jaxe/kernels.py preempt_select) reproduces bit-for-bit.
+        "general" keeps the host clone/add reprieve pipeline (inter-pod
+        -affinity-sensitive victims, port/volume interactions)."""
         hints = self.reprieve_feature_hints
         if hints is None:
-            return None
+            return "general"
         from tpusim.engine.predicates import (
             no_disk_conflict,
             pod_fits_host_ports,
@@ -573,7 +578,7 @@ class GenericScheduler:
             # a set with neither GeneralPredicates nor PodFitsResources
             # must not have resource checks imposed on it (the chain-based
             # reprieve would never apply them)
-            return None
+            return "general"
         for fn in chain:
             if fn is pod_fits_resources:
                 continue
@@ -585,7 +590,15 @@ class GenericScheduler:
                 continue
             if fn is interpod and not hints.get("has_interpod"):
                 continue
-            return None  # a live pod-set-dependent predicate remains
+            return "general"  # a live pod-set-dependent predicate remains
+        return "arithmetic"
+
+    def _make_arithmetic_reprieve(self, pod, meta, info_copy, victims):
+        """Returns the integer-arithmetic reprieve closure, or None when
+        preemption_reprieve_class() is "general" (the generic clone/add
+        path then runs)."""
+        if self.preemption_reprieve_class() != "arithmetic":
+            return None
 
         # mirror pod_fits_resources (predicates.go:706-776) exactly: pod
         # count always; resource axes only for a nonzero-request pod;

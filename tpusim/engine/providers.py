@@ -24,6 +24,25 @@ TD_PROVIDER = "TalkintDataProvider"
 
 DEFAULT_HARD_POD_AFFINITY_SYMMETRIC_WEIGHT = 1  # schedulerapi default; simulator passes 10
 
+# the DefaultProvider predicate key set (defaults.go:169-205), shared by all
+# three shipped providers; module-level so policy compilation
+# (jaxe/policyc.classify_preemption_class) can classify a provider-default
+# policy without assembling a registry
+DEFAULT_PREDICATE_KEYS = frozenset({
+    preds.NO_VOLUME_ZONE_CONFLICT_PRED,
+    preds.MAX_EBS_VOLUME_COUNT_PRED,
+    preds.MAX_GCE_PD_VOLUME_COUNT_PRED,
+    preds.MAX_AZURE_DISK_VOLUME_COUNT_PRED,
+    preds.MATCH_INTERPOD_AFFINITY_PRED,
+    preds.NO_DISK_CONFLICT_PRED,
+    preds.GENERAL_PRED,
+    preds.CHECK_NODE_MEMORY_PRESSURE_PRED,
+    preds.CHECK_NODE_DISK_PRESSURE_PRED,
+    preds.CHECK_NODE_CONDITION_PRED,
+    preds.POD_TOLERATES_NODE_TAINTS_PRED,
+    preds.CHECK_VOLUME_BINDING_PRED,
+})
+
 
 @dataclass
 class PluginFactoryArgs:
@@ -230,20 +249,7 @@ def default_registry() -> AlgorithmRegistry:
     r.register_fit_predicate(preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
                              preds.pod_tolerates_node_no_execute_taints)
 
-    default_predicate_keys = {
-        preds.NO_VOLUME_ZONE_CONFLICT_PRED,
-        preds.MAX_EBS_VOLUME_COUNT_PRED,
-        preds.MAX_GCE_PD_VOLUME_COUNT_PRED,
-        preds.MAX_AZURE_DISK_VOLUME_COUNT_PRED,
-        preds.MATCH_INTERPOD_AFFINITY_PRED,
-        preds.NO_DISK_CONFLICT_PRED,
-        preds.GENERAL_PRED,
-        preds.CHECK_NODE_MEMORY_PRESSURE_PRED,
-        preds.CHECK_NODE_DISK_PRESSURE_PRED,
-        preds.CHECK_NODE_CONDITION_PRED,
-        preds.POD_TOLERATES_NODE_TAINTS_PRED,
-        preds.CHECK_VOLUME_BINDING_PRED,
-    }
+    default_predicate_keys = set(DEFAULT_PREDICATE_KEYS)
 
     # --- priorities (defaults.go:219-259 + init extras) ---
     r.register_priority_config_factory(

@@ -187,6 +187,37 @@ def _fast_path_enabled() -> tuple[bool, bool]:
 
     return jax.default_backend() == "tpu", True
 
+# process-wide trust state for the device-side preemption victim-selection
+# kernel (jaxe/preempt.py), mirroring _FAST_AUTO: `disabled` flips on the
+# first device/host disagreement (never re-enabled); `verified_sigs` holds
+# (candidate_bucket, victim_bucket, zero_req) kernel-variant signatures whose
+# first device-selected preemption byte-matched the full host oracle
+# (selectVictimsOnNode + pickOneNodeForPreemption on cloned NodeInfos) —
+# pow2-bucketed shapes mean each compiled variant earns trust separately.
+_VICTIM_AUTO = {"disabled": False, "verified_sigs": set()}
+
+
+def victim_kernel_enabled() -> tuple[bool, bool]:
+    """Returns (enabled, auto_mode) for the preemption victim-selection
+    kernel.
+
+    TPUSIM_PREEMPT_DEVICE=0 forces the host pipeline, =1 forces the device
+    kernel WITHOUT first-use verification (benchmark/debug). Unset = AUTO:
+    default-ON on every backend — the kernel is a jitted XLA scan (not
+    Pallas), fast on CPU too — with first-preemption-per-variant
+    verification against the host oracle; any disagreement disables the
+    kernel for the process and the host result is used, so AUTO can never
+    change behavior. The `disabled` flag is honored in both modes."""
+    env = os.environ.get("TPUSIM_PREEMPT_DEVICE")
+    if env == "0":
+        return False, False
+    if _VICTIM_AUTO["disabled"]:
+        return False, False
+    if env == "1":
+        return True, False
+    return True, True
+
+
 _MOST_REQUESTED_PROVIDERS = {CLUSTER_AUTOSCALER_PROVIDER, TD_PROVIDER}
 _KNOWN_PROVIDERS = {DEFAULT_PROVIDER} | _MOST_REQUESTED_PROVIDERS
 

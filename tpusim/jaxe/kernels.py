@@ -1230,3 +1230,99 @@ def pad_infeasible_rows(xs, pad: int):
 
     return PodX(*(pad_field(name, arr)
                   for name, arr in zip(PodX._fields, xs)))
+
+
+# --------------------------------------------------------------------------
+# Device-side preemption victim selection — the arithmetic-reprieve class.
+#
+# Reference mapping (all in core/generic_scheduler.go):
+#   selectVictimsOnNode (:583-665)    -> masked scan over priority-sorted
+#                                        victim slots, one candidate node per
+#                                        lane; the reprieve re-check reduces
+#                                        to PodFitsResources' integer
+#                                        arithmetic in this class (the host
+#                                        mirror is GenericScheduler.
+#                                        _make_arithmetic_reprieve)
+#   pickOneNodeForPreemption (:739-831) -> five tie-break criteria as masked
+#                                        reductions over the lane axis
+#
+# The host side (jaxe/preempt.py) computes the candidate lanes (static
+# predicate mask + stripped-node resource fit) and the priority-sorted victim
+# slots from its columnar pod table; the kernel runs the cumulative reprieve
+# and the pick. Lane and slot axes are pow2-bucketed by the caller, bounding
+# recompiles to O(log C · log V) variants.
+
+PRIO_SUM_OFFSET = 1 << 31  # util.MAX_INT32 + 1 (pickOneNode criterion 4)
+
+
+def _preempt_select_impl(zero_req: bool, lane_valid, node_idx,
+                         alloc_cpu, alloc_mem, alloc_gpu, alloc_eph, allowed,
+                         n_base, base_cpu, base_mem, base_gpu, base_eph,
+                         v_prio, v_cpu, v_mem, v_gpu, v_eph, v_valid):
+    """One failed pod against C candidate lanes × V victim slots.
+
+    Per-lane inputs ([C], int64 unless noted): node_idx = global node index
+    (insertion-order tie-breaks), alloc_* / allowed = node allocatables,
+    n_base = resident pods AFTER stripping every lower-priority pod,
+    base_* = stripped usage PLUS the incoming pod's request (the
+    _make_arithmetic_reprieve state seed). Slot inputs ([C, V]): the lane's
+    lower-priority pods sorted priority-desc (stable by NodeInfo.pods
+    position); v_valid masks real slots. zero_req (static): the incoming
+    pod requests nothing, so only the pod-count check applies
+    (predicates.go:706-776 early-out).
+
+    Returns (winner, empty_winner, victim[C, V] bool, num[C]):
+    winner = node_idx picked by criteria 2-5 over lanes with victims
+    (num_violating is uniformly 0 in this class — no PDBs), empty_winner =
+    first-in-order lane with zero victims (criterion 1; its existence means
+    the node fit without preempting anyone, i.e. a device/host scan
+    disagreement the caller must resolve on the host). Both are the big
+    sentinel when no lane qualifies."""
+
+    def step(state, slot):
+        n, cpu, mem, gpu, eph = state
+        vp, vc, vm, vg, ve, valid = slot
+        # state holds the incoming pod's request already; +2 = +victim +pod
+        fits = n + 2 <= allowed
+        if not zero_req:
+            fits = fits & ((alloc_cpu >= cpu + vc)
+                           & (alloc_mem >= mem + vm)
+                           & (alloc_gpu >= gpu + vg)
+                           & (alloc_eph >= eph + ve))
+        reprieved = fits & valid
+        state = (n + reprieved.astype(jnp.int64),
+                 cpu + jnp.where(reprieved, vc, 0),
+                 mem + jnp.where(reprieved, vm, 0),
+                 gpu + jnp.where(reprieved, vg, 0),
+                 eph + jnp.where(reprieved, ve, 0))
+        return state, valid & ~fits
+
+    state0 = (n_base, base_cpu, base_mem, base_gpu, base_eph)
+    xs = (v_prio.T, v_cpu.T, v_mem.T, v_gpu.T, v_eph.T, v_valid.T)
+    _, victim_cols = jax.lax.scan(step, state0, xs)
+    victim = victim_cols.T  # [C, V]
+
+    big = jnp.int64(1) << 62
+    num = jnp.sum(victim, axis=1)
+    empty = lane_valid & (num == 0)
+    empty_winner = jnp.min(jnp.where(empty, node_idx, big))
+
+    # criterion 3: lowest highest-victim priority — slots are priority-desc,
+    # so the first masked slot per lane carries the lane's highest
+    first = jnp.argmax(victim, axis=1)
+    highest = jnp.take_along_axis(v_prio, first[:, None], axis=1)[:, 0]
+    # criterion 4: smallest sum(priority + MAX_INT32 + 1) over victims
+    psum = jnp.sum(jnp.where(victim, v_prio + PRIO_SUM_OFFSET, 0), axis=1)
+
+    # staged min-filters (criteria 2 is a no-op: num_violating uniformly 0);
+    # a single surviving lane passes every later filter unchanged, matching
+    # the host's len(names) > 1 guards
+    sel = lane_valid & (num > 0)
+    sel = sel & (highest == jnp.min(jnp.where(sel, highest, big)))
+    sel = sel & (psum == jnp.min(jnp.where(sel, psum, big)))
+    sel = sel & (num == jnp.min(jnp.where(sel, num, big)))
+    winner = jnp.min(jnp.where(sel, node_idx, big))  # criterion 5: first
+    return winner, empty_winner, victim, num
+
+
+preempt_select = partial(jax.jit, static_argnums=(0,))(_preempt_select_impl)

@@ -62,6 +62,73 @@ COMPILABLE_PREDS = frozenset({
     preds.POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
 })
 
+# --- preemption victim-selection class (decided at policy-compile time) ----
+#
+# A predicate key set is "arithmetic-reprieve" eligible when every registered
+# predicate is either the resource check (PodFitsResources, or the resource
+# half of GeneralPredicates) or provably victim-invariant — its outcome never
+# depends on which pods remain on the node (generic_scheduler.
+# _POD_SET_INDEPENDENT_PREDS). Victim search then reduces to pure integer
+# arithmetic over resource aggregates, which jaxe/preempt.py routes to the
+# device kernel (kernels.preempt_select); everything else keeps the host
+# clone/add reprieve pipeline. Pod-set-DEPENDENT predicates whose feature is
+# absent from the whole workload (no host ports anywhere, no conflictable or
+# MaxPD volumes, no inter-pod terms) are constant-true for every victim set
+# of the run, so the run-time feature flags can elide them — the same rule
+# GenericScheduler.preemption_reprieve_class applies to the reprieve chain.
+
+# pod-set-dependent predicate key -> workload feature flag that elides it
+_FEATURE_GATED_PREDS: Dict[str, str] = {
+    preds.POD_FITS_HOST_PORTS_PRED: "has_ports",
+    preds.NO_DISK_CONFLICT_PRED: "has_disk_conflict",
+    preds.MAX_EBS_VOLUME_COUNT_PRED: "has_maxpd",
+    preds.MAX_GCE_PD_VOLUME_COUNT_PRED: "has_maxpd",
+    preds.MAX_AZURE_DISK_VOLUME_COUNT_PRED: "has_maxpd",
+    preds.MATCH_INTERPOD_AFFINITY_PRED: "has_interpod",
+}
+
+
+def classify_preemption_class(pred_keys, feature_flags=None,
+                              has_extenders: bool = False):
+    """Classify a predicate key set for preemption victim selection.
+
+    Returns ("arithmetic" | "general", reason). pred_keys None means the
+    provider-default set (a policy that omits `predicates`). feature_flags
+    maps has_ports/has_disk_conflict/has_maxpd/has_interpod to whether the
+    feature occurs anywhere in the workload (new AND placed pods); None —
+    the policy-compile-time call, before any workload is known — treats
+    every feature as present, so "arithmetic" at compile time means
+    arithmetic for EVERY workload."""
+    if has_extenders:
+        return "general", "extenders re-filter preemption candidates"
+    if pred_keys is None:
+        from tpusim.engine.providers import DEFAULT_PREDICATE_KEYS
+        pred_keys = DEFAULT_PREDICATE_KEYS
+    from tpusim.engine.generic_scheduler import _POD_SET_INDEPENDENT_PREDS
+
+    keys = set(pred_keys)
+    flags = feature_flags or {}
+    if (preds.GENERAL_PRED not in keys
+            and preds.POD_FITS_RESOURCES_PRED not in keys):
+        return "general", "no resource predicate registered"
+    for key in sorted(keys):
+        if key == preds.POD_FITS_RESOURCES_PRED:
+            continue
+        if key == preds.GENERAL_PRED:
+            # GeneralPredicates bundles PodFitsHostPorts (pod-set-dependent)
+            if flags.get("has_ports", True):
+                return "general", "GeneralPredicates with host ports in the workload"
+            continue
+        if key in _POD_SET_INDEPENDENT_PREDS:
+            continue
+        flag = _FEATURE_GATED_PREDS.get(
+            "PodFitsHostPorts" if key == _TAIL_PORTS_ALIAS else key)
+        if flag is not None and not flags.get(flag, True):
+            continue
+        return "general", f"pod-set-dependent predicate {key}"
+    return "arithmetic", ""
+
+
 # 1.0 backward-compat alias (defaults.go:63-65). NOT aliased to the
 # hostports slot: the host engine evaluates registry keys outside
 # predicates.Ordering() at the alphabetical TAIL slot (the documented
@@ -123,6 +190,12 @@ class CompiledPolicy:
     sa_entries: tuple = ()
     # host-bound features forcing the reference fallback (empty = compilable)
     unsupported: List[str] = field(default_factory=list)
+    # preemption victim-selection class, decided at policy-compile time with
+    # every workload feature assumed present ("arithmetic" here = device
+    # -kernel eligible for EVERY workload; run-time feature flags can still
+    # upgrade a "general" set — see classify_preemption_class)
+    preemption_class: str = "general"
+    preemption_class_reason: str = ""
 
 
 def compile_policy(policy: Policy) -> CompiledPolicy:
@@ -297,11 +370,19 @@ def compile_policy(policy: Policy) -> CompiledPolicy:
         # (factory.go:1024-1026) — both backends must reject identically
         raise ValueError(f"invalid hardPodAffinitySymmetricWeight: {hard}, "
                          "must be in the range 1-100")
+    pclass, pclass_why = classify_preemption_class(
+        frozenset(pred_keys) if pred_keys is not None else None,
+        has_extenders=bool(policy.extender_configs))
+    if pclass == "arithmetic" and sa_entries:
+        pclass, pclass_why = ("general", "ServiceAffinity first-matching-pod "
+                              "lock is pod-set-dependent")
     return CompiledPolicy(spec=spec, hard_weight=hard,
                           label_rows=label_rows,
                           label_prios=label_prios, saa_entries=saa_entries,
                           sa_entries=tuple(sa_entries),
-                          unsupported=unsupported)
+                          unsupported=unsupported,
+                          preemption_class=pclass,
+                          preemption_class_reason=pclass_why)
 
 
 def _label_pred_row(nodes_by_idx: list, entries) -> np.ndarray:

@@ -102,6 +102,17 @@ def pad_node_axis(statics: Statics, carry: Carry, n_shards: int
             _pad_node_tree(carry, CARRY_AXES, pad), n)
 
 
+def pad_carry_node_axis(carry: Carry, n_shards: int) -> Carry:
+    """Pad ONLY the carry's node axis to the mesh multiple (the preemption
+    hybrid's re-arm path: statics were padded and placed at compile time and
+    are reused; the fresh carry must match their padded node extent)."""
+    name = next(n for n, spec in CARRY_AXES.items() if "node" in spec)
+    ax = CARRY_AXES[name].index("node")
+    n = getattr(carry, name).shape[ax]
+    pad = _pad_to(n, n_shards) - n
+    return carry if pad == 0 else _pad_node_tree(carry, CARRY_AXES, pad)
+
+
 def _sharding_tree(tree_cls, axes_map, mesh: Mesh, leading: Optional[str] = None):
     fields = {}
     for name, spec in axes_map.items():

@@ -1258,3 +1258,39 @@ def compile_cluster(snapshot: ClusterSnapshot, pods: List[Pod],
 
 def reason_strings(scalar_names: List[str]) -> List[str]:
     return REASON_STRINGS + [f"Insufficient {name}" for name in scalar_names]
+
+
+def victim_order_columns(pods: List, node_index: dict):
+    """Victim-ordering columns for device-side preemption (jaxe/preempt.py
+    _VictimTable seed): one row per PLACED pod of `pods`, in list order.
+
+    Row order is the parity-critical part: the host oracle's
+    sort_by_priority_desc over NodeInfo.pods is a STABLE sort, and
+    NodeInfo.pods is append-ordered (snapshot order, then bind order), so a
+    table seeded in snapshot order and appended to on every bind reproduces
+    the host's victim ordering with a stable (-priority, row) lexsort.
+
+    Returns (node_i int32[R], prio int64[R], req int64[R, 4] —
+    cpu/mem/gpu/eph in get_resource_request units — and the row-parallel
+    list of pod objects). Pods without a known node are skipped (they can
+    never be victims: victim selection only reads NodeInfo.pods)."""
+    from tpusim.engine.resources import get_resource_request
+    from tpusim.engine.util import get_pod_priority
+
+    rows = [(node_index[p.spec.node_name], p) for p in pods
+            if p.spec.node_name and p.spec.node_name in node_index]
+    r = len(rows)
+    node_i = np.zeros(r, dtype=np.int32)
+    prio = np.zeros(r, dtype=np.int64)
+    req = np.zeros((r, 4), dtype=np.int64)
+    objs = []
+    for k, (i, p) in enumerate(rows):
+        node_i[k] = i
+        prio[k] = get_pod_priority(p)
+        pr = get_resource_request(p)
+        req[k, 0] = pr.milli_cpu
+        req[k, 1] = pr.memory
+        req[k, 2] = pr.nvidia_gpu
+        req[k, 3] = pr.ephemeral_storage
+        objs.append(p)
+    return node_i, prio, req, objs

@@ -396,6 +396,17 @@ class ClusterCapacity:
             node, victims, to_clear = None, [], []
         metrics.preemption_evaluation.observe(
             since_in_microseconds(preemption_start))
+        return self.commit_preemption(pod, node, victims, to_clear)
+
+    def commit_preemption(self, pod: Pod, node, victims, to_clear):
+        """The side-effect half of attempt_preemption (preempt.go:45-75):
+        clear losing nominations, nominate the pod, delete victims from the
+        store (mutating the cache through the DELETED events), and emit the
+        Preempted events. Split out so the jax backend's device-side victim
+        selection (tpusim/jaxe/preempt.py) can commit a kernel-picked
+        (node, victims) through the exact same store/status/event sequence
+        the host pipeline uses."""
+        metrics = self.metrics
         metrics.preemption_victims.set(len(victims))
         for p in to_clear:
             p.status.nominated_node_name = ""

@@ -292,6 +292,19 @@ def _run_once(config, carry, statics, xs, chunk: int):
     return np.asarray(choices), _checksum(choices), np.asarray(counts)
 
 
+def _metrics_snapshot(reset: bool = False) -> dict:
+    """Per-config snapshot of the framework metrics registry (ISSUE 2):
+    every BENCH record embeds one so the trajectory files say which
+    path (route/AUTO transitions/victim split) produced each number."""
+    from tpusim.framework.metrics import register
+
+    reg = register()
+    snap = reg.snapshot()
+    if reset:
+        reg.reset()
+    return snap
+
+
 def measure_config(name: str, snapshot, pods, platform: str,
                    baseline_pods: int, chunk: int, timed_runs: int = 3):
     """Measure one ladder config; returns the result dict."""
@@ -300,6 +313,7 @@ def measure_config(name: str, snapshot, pods, platform: str,
 
     num_pods, num_nodes = len(pods), len(snapshot.nodes)
     log(f"[{name}] {num_pods} pods x {num_nodes} nodes")
+    _metrics_snapshot(reset=True)  # per-config registry window
 
     ref_rate = None
     mismatches = None
@@ -411,6 +425,18 @@ def measure_config(name: str, snapshot, pods, platform: str,
             != ref_placements[i].node_name)
         log(f"  parity check on first {sub} pods: {mismatches} mismatches")
 
+    # the ladder drives the kernels directly (not backend.schedule), so the
+    # route/dispatch families are fed here from the measured passes
+    from tpusim.framework.metrics import register as _register_metrics
+
+    _reg = _register_metrics()
+    for t in [cold] + warm_times:
+        _reg.backend_dispatch_latency.observe(t * 1e6)
+    _reg.backend_route.inc(
+        "fastscan" if fast_plan is not None
+        else ("xla_chunked" if use_chunks else "xla_scan"),
+        1 + len(warm_times))
+
     mode = "exact scan (pallas)" if fast_plan is not None else "exact scan"
     result = {
         "metric": f"scheduled pods/sec ({name}, {mode}, platform={platform}"
@@ -427,6 +453,7 @@ def measure_config(name: str, snapshot, pods, platform: str,
                    "median": round(warm, 3),
                    "max": round(max(warm_times), 3)},
         "load1": round(load1, 2),
+        "metrics": _metrics_snapshot(reset=True),
     }
     if drift:
         result["error"] = "checksum drift across timed runs; rate unreliable"
@@ -498,6 +525,13 @@ def measure_fast_extra(name, plan, platform, num_pods, timed_runs,
                    "max": round(max(f_times), 3)},
         "load1": round(load1, 2),
     }
+    from tpusim.framework.metrics import register as _register_metrics
+
+    _reg = _register_metrics()
+    for t in f_times:
+        _reg.backend_dispatch_latency.observe(t * 1e6)
+    _reg.backend_route.inc("fastscan", len(f_times))
+    extra["metrics"] = _metrics_snapshot(reset=True)
     if f_hash != xla_hash:
         extra["error"] = ("pallas placements diverge from the XLA "
                           "scan on this workload; rate untrustworthy")
@@ -677,7 +711,8 @@ def run_ladder(platform: str, baseline_pods: int, chunk: int) -> None:
                       f"{p_scen // 1000}k batched what-if, end-to-end incl. "
                       f"compile, platform={platform})",
             "value": round(total / e2e, 1), "unit": "pods/s",
-            "vs_baseline": 0})
+            "vs_baseline": 0,
+            "metrics": _metrics_snapshot(reset=True)})
         print(json.dumps(results[-1]), flush=True)
 
     if 6 in wanted:
@@ -769,11 +804,14 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
     )
 
     reset_preempt_class_stats()
+    _metrics_snapshot(reset=True)  # registry window for the timed run only
     t0 = time.perf_counter()
     with stage_heartbeat("[config 6] hybrid still running"):
         status = run_simulation([p.copy() for p in pods], snapshot,
                                 backend="jax", enable_pod_priority=True)
     e2e = max(time.perf_counter() - t0, 1e-9)
+    # captured before the full-feed reference run below feeds the registry
+    metrics_snap = _metrics_snapshot(reset=True)
     rate = p6 / e2e
     preempted = len(status.preempted_pods)
     victim_paths = dict(PREEMPT_CLASS_STATS)
@@ -836,6 +874,7 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
         # victim-selection path split (device kernel vs host oracle) for the
         # arithmetic-reprieve offload — preempt.PREEMPT_CLASS_STATS
         "victim_paths": victim_paths,
+        "metrics": metrics_snap,
     })
 
 
@@ -901,6 +940,7 @@ def run_phases(platform: str, chunk: int) -> None:
         "value": 0.0,
         "unit": "pods/s",
         "vs_baseline": 0,
+        "metrics": _metrics_snapshot(reset=True),
     }
 
     # --- exact-scan unroll sweep ---

@@ -7,9 +7,14 @@ utiltrace (trace.go) with the 100ms slow-schedule threshold
 (generic_scheduler.go:113-114).
 """
 
+import re
+import threading
+
 from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
 from tpusim.engine.trace import Trace
 from tpusim.framework.metrics import (
+    Gauge,
+    LabeledCounter,
     SchedulerMetrics,
     exponential_buckets,
     register,
@@ -87,6 +92,90 @@ class TestObservationSeams:
         m = register()
         assert m.preemption_attempts.value >= 1
         assert m.preemption_evaluation.count >= 1
+
+
+# Prometheus text exposition format, per the reference exposition docs:
+# HELP/TYPE comment lines, then samples `name{label="value"} number`.
+_PROM_LINE = re.compile(
+    r"^(?:"
+    r"# HELP [a-zA-Z_:][a-zA-Z0-9_:]* \S.*"
+    r"|# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (?:counter|gauge|histogram|summary|untyped)"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(?:\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*")*\})?'
+    r" (?:[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|[-+]?Inf|NaN)"
+    r")$"
+)
+
+
+class TestExposition:
+    def test_gauge_set_is_locked(self):
+        g = Gauge("g", "h")
+        # concurrent set() must not race (the reference GaugeVec is
+        # goroutine-safe); 4 writer threads, final value is one of theirs
+        threads = [threading.Thread(target=lambda v=v: [g.set(v)
+                                                        for _ in range(200)])
+                   for v in (1.0, 2.0, 3.0, 4.0)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert g.value in (1.0, 2.0, 3.0, 4.0)
+
+    def test_expose_registration_order(self):
+        m = SchedulerMetrics()
+        text = m.expose()
+        typed = [line.split()[2] for line in text.splitlines()
+                 if line.startswith("# TYPE ")]
+        assert typed == [metric.name for metric in m._all()]
+        # reference families first, backend families after
+        assert typed.index("scheduler_binding_latency_microseconds") \
+            < typed.index("tpusim_backend_compile_latency_microseconds")
+
+    def test_expose_golden_text_format(self):
+        m = SchedulerMetrics()
+        m.binding_latency.observe(1500)
+        m.preemption_victims.set(2)
+        m.preemption_attempts.inc()
+        m.backend_route.inc("fastscan", 3)
+        m.backend_auto_transitions.inc("verify_pass")
+        text = m.expose()
+        assert text.endswith("\n")
+        assert not text.endswith("\n\n")
+        for line in text.splitlines():
+            assert _PROM_LINE.match(line), f"malformed exposition line: {line!r}"
+        assert 'tpusim_backend_route_total{route="fastscan"} 3' in text
+        assert ('tpusim_backend_auto_transitions_total'
+                '{transition="verify_pass"} 1') in text
+
+    def test_labeled_counter(self):
+        c = LabeledCounter("x_total", "help", "route")
+        c.inc("b")
+        c.inc("a", 2)
+        c.inc("b")
+        assert c.get("a") == 2
+        assert c.get("b") == 2
+        assert c.get("missing") == 0
+        lines = c.expose()
+        # sample lines sorted by label value, after HELP/TYPE
+        assert lines[2:] == ['x_total{route="a"} 2', 'x_total{route="b"} 2']
+        c.reset()
+        assert c.get("a") == 0
+        assert c.expose() == ["# HELP x_total help", "# TYPE x_total counter"]
+
+    def test_snapshot_shape(self):
+        m = SchedulerMetrics()
+        assert m.snapshot() == {}  # empty registry → empty snapshot
+        m.binding_latency.observe(1500)
+        m.backend_route.inc("xla_scan")
+        m.preemption_attempts.inc()
+        snap = m.snapshot()
+        assert snap["scheduler_binding_latency_microseconds"] == {
+            "count": 1, "sum": 1500}
+        assert snap["tpusim_backend_route_total"] == {"xla_scan": 1.0}
+        assert snap["scheduler_total_preemption_attempts"] == 1.0
+        # untouched families stay absent
+        assert "scheduler_pod_preemption_victims" not in snap
 
 
 class TestTrace:

@@ -1,0 +1,39 @@
+"""Observability smoke (ISSUE 2): every tpusim module imports cleanly with
+the flight recorder wired in, and the disabled-recorder path stays
+allocation-free — a full simulation run with no recorder installed must
+produce zero spans and hand every call site the shared no-op singleton."""
+
+import importlib
+import pkgutil
+
+import tpusim
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.obs import recorder as flight
+from tpusim.obs.recorder import NOOP_SPAN
+from tpusim.simulator import run_simulation
+
+
+def test_every_module_imports():
+    failures = []
+    for info in pkgutil.walk_packages(tpusim.__path__,
+                                      prefix=tpusim.__name__ + "."):
+        try:
+            importlib.import_module(info.name)
+        except Exception as exc:  # noqa: BLE001 — collect them all
+            failures.append(f"{info.name}: {type(exc).__name__}: {exc}")
+    assert not failures, "\n".join(failures)
+
+
+def test_disabled_recorder_allocates_no_spans():
+    flight.uninstall()
+    assert flight.get_recorder() is None
+    nodes = [make_node(f"n{i}", milli_cpu=4000, memory=2**33)
+             for i in range(3)]
+    pods = [make_pod(f"p{i}", milli_cpu=100, memory=2**20) for i in range(4)]
+    status = run_simulation(pods, ClusterSnapshot(nodes=nodes))
+    assert len(status.successful_pods) == 4
+    # still disabled, and every span request resolves to the one shared
+    # falsy no-op object — no Span/dict allocation happened per pod
+    assert flight.get_recorder() is None
+    assert flight.span("pod_attempt") is NOOP_SPAN
+    assert flight.span("device_dispatch", "device") is NOOP_SPAN

@@ -286,36 +286,77 @@ def run_preempt_variant():
     return h, len(dev.preempted_pods), paths
 
 
+def _write_smoke_trace(recorder):
+    """Persist the sweep's flight-recorder trace; never fail the smoke."""
+    path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
+        os.path.dirname(__file__), "..", "bench_results", "smoke_trace.json")
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        recorder.write(path)
+    except OSError as exc:
+        print(f"SMOKE trace write failed: {exc}", flush=True)
+        return
+    print(f"SMOKE trace: {os.path.normpath(path)} "
+          f"({len(recorder.events)} events)", flush=True)
+
+
 def main() -> int:
     import jax
+
+    from tpusim.obs import recorder as flight
 
     platform = jax.default_backend()
     only = [v for v in os.environ.get("TPUSIM_SMOKE_VARIANTS", "").split(",")
             if v]
+    recorder = flight.install(flight.FlightRecorder())
     t0 = time.time()
     ran = 0
-    for name, build, most in PALLAS_VARIANTS:
-        if only and name not in only:
-            continue
-        t = time.time()
-        try:
-            h, scheduled, total = run_pallas_variant(name, build, most)
-        except Exception as exc:  # noqa: BLE001 — one line per failure
-            print(f"SMOKE FAILED: {name}: {exc}", flush=True)
-            return 1
-        ran += 1
-        print(f"SMOKE {name}: OK hash={h} scheduled={scheduled}/{total} "
-              f"({time.time() - t:.1f}s)", flush=True)
-    if not only or "preempt_victim" in only:
-        t = time.time()
-        try:
-            h, n_victims, paths = run_preempt_variant()
-        except Exception as exc:  # noqa: BLE001
-            print(f"SMOKE FAILED: preempt_victim: {exc}", flush=True)
-            return 1
-        ran += 1
-        print(f"SMOKE preempt_victim: OK hash={h} victims={n_victims} "
-              f"paths={paths} ({time.time() - t:.1f}s)", flush=True)
+    try:
+        for name, build, most in PALLAS_VARIANTS:
+            if only and name not in only:
+                continue
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", name)
+            try:
+                h, scheduled, total = run_pallas_variant(name, build, most)
+            except Exception as exc:  # noqa: BLE001 — one line per failure
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: {name}: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("scheduled", f"{scheduled}/{total}")
+            vsp.end()
+            ran += 1
+            print(f"SMOKE {name}: OK hash={h} scheduled={scheduled}/{total} "
+                  f"({time.time() - t:.1f}s)", flush=True)
+        if not only or "preempt_victim" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "preempt_victim")
+            try:
+                h, n_victims, paths = run_preempt_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: preempt_victim: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("victims", n_victims)
+            vsp.end()
+            ran += 1
+            print(f"SMOKE preempt_victim: OK hash={h} victims={n_victims} "
+                  f"paths={paths} ({time.time() - t:.1f}s)", flush=True)
+    finally:
+        flight.uninstall()
+        _write_smoke_trace(recorder)
     print(f"SMOKE COMPLETE: {ran} variants, platform={platform} "
           f"({time.time() - t0:.1f}s)", flush=True)
     return 0

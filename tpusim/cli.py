@@ -123,10 +123,21 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--quiet", action="store_true",
                         help="Only print the summary counts and timing")
     parser.add_argument("--v", type=int, default=0, dest="verbosity",
-                        help="Log verbosity (glog analog). >=5 enables the "
-                             "per-node score dump: every priority's score per "
-                             "node and the post-extender aggregate "
+                        help="Log verbosity (glog analog). >=2 surfaces the "
+                             "tpusim.* loggers on stderr (slow-schedule "
+                             "traces, backend routing); >=5 enables DEBUG "
+                             "plus the per-node score dump: every priority's "
+                             "score per node and the post-extender aggregate "
                              "(generic_scheduler.go:618-622,670-674)")
+    parser.add_argument("--trace-out", default="",
+                        help="Write the flight-recorder timeline after the "
+                             "run: Chrome trace_event JSON (Perfetto-"
+                             "loadable) by default, or a raw span stream "
+                             "with a .jsonl extension")
+    parser.add_argument("--metrics-out", default="",
+                        help="Write the scheduler metrics registry in "
+                             "Prometheus text exposition format after the "
+                             "run")
     return parser
 
 
@@ -285,6 +296,15 @@ def run_what_if_cli(args) -> int:
     return 0
 
 
+def _write_metrics(path: str) -> None:
+    """Dump the registry in Prometheus text exposition format (the scrape
+    body the reference never served; framework/metrics.py docstring)."""
+    from tpusim.framework.metrics import register
+
+    with open(path, "w") as f:
+        f.write(register().expose())
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     feature_gates = None
@@ -303,12 +323,18 @@ def main(argv=None) -> int:
         if feature_gates.pop("VolumeScheduling", False):
             args.enable_volume_scheduling = True
 
-    if args.verbosity >= 5:
-        # glog -v analog: V(5)+ turns on the engine's per-node score dump
+    if args.verbosity >= 2:
+        # glog -v analog. The tpusim.* loggers (engine/trace.py slow-
+        # schedule traces, backend routing decisions) emit into the root
+        # logger, which python leaves handler-less: configure it so V(2)+
+        # actually prints. V(5)+ additionally turns on DEBUG, including
+        # the engine's per-node score dump.
         import logging
 
         logging.basicConfig(stream=sys.stderr, format="%(message)s")
-        logging.getLogger("tpusim.engine").setLevel(logging.DEBUG)
+        # "tpusim.engine" and "tpusim.trace" inherit the package level
+        logging.getLogger("tpusim").setLevel(
+            logging.DEBUG if args.verbosity >= 5 else logging.INFO)
 
     # (An env-level JAX_PLATFORMS=cpu pin is honored by the import-time guard
     # in tpusim/jaxe/__init__.py — every jax-using path imports that module
@@ -327,7 +353,15 @@ def main(argv=None) -> int:
                   "(what-if scenarios carry their own snapshots)",
                   file=sys.stderr)
             return 2
-        return run_what_if_cli(args)
+        if args.trace_out:
+            print("error: --trace-out cannot be combined with --what-if "
+                  "(scenario runs share one process; their spans would "
+                  "interleave on a single timeline)", file=sys.stderr)
+            return 2
+        rc = run_what_if_cli(args)
+        if rc == 0 and args.metrics_out:
+            _write_metrics(args.metrics_out)
+        return rc
     if args.mesh:
         print("error: --mesh applies only to --what-if (the single-run scan "
               "is sequential; scale it via more nodes per snapshot)",
@@ -394,6 +428,12 @@ def main(argv=None) -> int:
                   "program. Use --backend reference to see the dump.",
                   file=sys.stderr)
 
+    recorder = None
+    if args.trace_out:
+        from tpusim.obs import recorder as flight
+
+        recorder = flight.install(flight.FlightRecorder())
+
     start = time.perf_counter()
     try:
         status = run_simulation(pods, snapshot, provider=args.algorithmprovider,
@@ -408,6 +448,25 @@ def main(argv=None) -> int:
         print(f"error: {exc}", file=sys.stderr)
         return 2
     elapsed = time.perf_counter() - start
+
+    if recorder is not None:
+        from tpusim.obs import recorder as flight
+
+        flight.uninstall()
+        try:
+            recorder.write(args.trace_out)
+        except OSError as exc:
+            print(f"error: failed to write trace: {exc}", file=sys.stderr)
+            return 2
+        if not args.quiet:
+            print(f"trace: {args.trace_out} "
+                  f"({len(recorder.events)} events)", file=sys.stderr)
+    if args.metrics_out:
+        try:
+            _write_metrics(args.metrics_out)
+        except OSError as exc:
+            print(f"error: failed to write metrics: {exc}", file=sys.stderr)
+            return 2
 
     report = get_report(status)
     if args.print_requirements and not args.quiet:

@@ -46,6 +46,7 @@ from tpusim.engine.priorities import HostPriority, PriorityConfig
 from tpusim.engine.resources import NodeInfo, get_resource_request
 from tpusim.engine.trace import Trace
 from tpusim.framework.metrics import register as register_metrics, since_in_microseconds
+from tpusim.obs import recorder as flight
 from tpusim.engine.util import (
     MAX_INT32,
     get_pod_priority as util_get_pod_priority,
@@ -87,12 +88,18 @@ class FitError(SchedulingError):
         self.failed_predicates = failed_predicates
         super().__init__(self.error())
 
-    def error(self) -> str:
+    def reason_histogram(self) -> Dict[str, int]:
+        """Per-pod attribution: failure reason -> number of nodes rejected
+        for it (the aggregation behind error(), exposed for telemetry)."""
         reasons: Dict[str, int] = {}
         for preds in self.failed_predicates.values():
             for reason in preds:
                 key = reason.get_reason()
                 reasons[key] = reasons.get(key, 0) + 1
+        return reasons
+
+    def error(self) -> str:
+        reasons = self.reason_histogram()
         reason_strings = sorted(f"{v} {k}" for k, v in reasons.items())
         return (NO_NODE_AVAILABLE_MSG.format(self.num_all_nodes)
                 + ": " + ", ".join(reason_strings) + ".")
@@ -350,20 +357,33 @@ class GenericScheduler:
             if not nodes:
                 raise ERR_NO_NODES_AVAILABLE
             start = _now()
-            filtered, failed_predicate_map = self.find_nodes_that_fit(
-                pod, nodes, node_info_map)
+            with flight.span("predicates") as fsp:
+                filtered, failed_predicate_map = self.find_nodes_that_fit(
+                    pod, nodes, node_info_map)
+                if fsp:
+                    fsp.set("nodes", len(nodes))
+                    fsp.set("feasible", len(filtered))
             metrics.predicate_evaluation.observe(since_in_microseconds(start))
             trace.step("Computing predicates")
             if not filtered:
-                raise FitError(pod, len(nodes), failed_predicate_map)
+                fit_err = FitError(pod, len(nodes), failed_predicate_map)
+                if flight.get_recorder() is not None:
+                    flight.instant("fit_error", "host", {
+                        "pod": f"{pod.namespace}/{pod.name}",
+                        "nodes": len(nodes),
+                        "reasons": fit_err.reason_histogram(),
+                    })
+                raise fit_err
             start = _now()
             if len(filtered) == 1:
                 metrics.priority_evaluation.observe(since_in_microseconds(start))
                 return filtered[0].name
-            priority_list = self.prioritize_nodes(pod, node_info_map, filtered)
+            with flight.span("priorities"):
+                priority_list = self.prioritize_nodes(pod, node_info_map, filtered)
             metrics.priority_evaluation.observe(since_in_microseconds(start))
             trace.step("Prioritizing")
-            host = self.select_host(priority_list)
+            with flight.span("select_host"):
+                host = self.select_host(priority_list)
             trace.step("Selecting host")
             return host
         finally:
@@ -461,12 +481,16 @@ class GenericScheduler:
         pick-one tie-breaking (Go iterates a map in random order)."""
         meta = self.predicate_meta_producer(pod, node_info_map)
         result: Dict[str, tuple] = {}
-        for node in potential:
-            meta_copy = meta.shallow_copy() if meta is not None else None
-            victims, violations, fits = self._select_victims_on_node(
-                pod, meta_copy, node_info_map[node.name], pdbs)
-            if fits:
-                result[node.name] = (victims, violations)
+        with flight.span("preempt_candidates") as csp:
+            for node in potential:
+                meta_copy = meta.shallow_copy() if meta is not None else None
+                victims, violations, fits = self._select_victims_on_node(
+                    pod, meta_copy, node_info_map[node.name], pdbs)
+                if fits:
+                    result[node.name] = (victims, violations)
+            if csp:
+                csp.set("candidates", len(potential))
+                csp.set("fitting", len(result))
         return result
 
     def _select_victims_on_node(self, pod: Pod, meta, node_info: NodeInfo,

@@ -94,12 +94,15 @@ class Gauge:
         self.name = name
         self.help = help_text
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, value: float) -> None:
-        self.value = value
+        with self._lock:
+            self.value = value
 
     def reset(self) -> None:
-        self.value = 0.0
+        with self._lock:
+            self.value = 0.0
 
     def expose(self) -> List[str]:
         return [f"# HELP {self.name} {self.help}",
@@ -107,48 +110,106 @@ class Gauge:
                 f"{self.name} {self.value:g}"]
 
 
+class LabeledCounter:
+    """A counter family with one label dimension (prometheus CounterVec).
+
+    Used by the `tpusim_backend_*` families where the interesting fact is
+    *which* path/transition fired, not just how often anything did.
+    """
+
+    def __init__(self, name: str, help_text: str, label: str):
+        self.name = name
+        self.help = help_text
+        self.label = label
+        self.values: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, label_value: str, amount: float = 1.0) -> None:
+        with self._lock:
+            self.values[label_value] = self.values.get(label_value, 0.0) + amount
+
+    def get(self, label_value: str) -> float:
+        with self._lock:
+            return self.values.get(label_value, 0.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.values.clear()
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self.values.items())
+        for label_value, value in items:
+            lines.append(f'{self.name}{{{self.label}="{label_value}"}} {value:g}')
+        return lines
+
+
 _LATENCY_BUCKETS = exponential_buckets(1000, 2, 15)
 
 
 class SchedulerMetrics:
-    """The metric set of metrics/metrics.go:29-91, names preserved."""
+    """The metric set of metrics/metrics.go:29-91, names preserved, plus
+    the `tpusim_backend_*` families for the device engine (ISSUE 2)."""
 
     def __init__(self):
         s = SCHEDULER_SUBSYSTEM
-        self.e2e_scheduling_latency = Histogram(
+        self._registry: List = []
+        self.e2e_scheduling_latency = self._reg(Histogram(
             f"{s}_e2e_scheduling_latency_microseconds",
             "E2e scheduling latency (scheduling algorithm + binding)",
-            _LATENCY_BUCKETS)
-        self.scheduling_algorithm_latency = Histogram(
+            _LATENCY_BUCKETS))
+        self.scheduling_algorithm_latency = self._reg(Histogram(
             f"{s}_scheduling_algorithm_latency_microseconds",
-            "Scheduling algorithm latency", _LATENCY_BUCKETS)
-        self.predicate_evaluation = Histogram(
+            "Scheduling algorithm latency", _LATENCY_BUCKETS))
+        self.predicate_evaluation = self._reg(Histogram(
             f"{s}_scheduling_algorithm_predicate_evaluation",
             "Scheduling algorithm predicate evaluation duration",
-            _LATENCY_BUCKETS)
-        self.priority_evaluation = Histogram(
+            _LATENCY_BUCKETS))
+        self.priority_evaluation = self._reg(Histogram(
             f"{s}_scheduling_algorithm_priority_evaluation",
             "Scheduling algorithm priority evaluation duration",
-            _LATENCY_BUCKETS)
-        self.preemption_evaluation = Histogram(
+            _LATENCY_BUCKETS))
+        self.preemption_evaluation = self._reg(Histogram(
             f"{s}_scheduling_algorithm_preemption_evaluation",
             "Scheduling algorithm preemption evaluation duration",
-            _LATENCY_BUCKETS)
-        self.binding_latency = Histogram(
+            _LATENCY_BUCKETS))
+        self.binding_latency = self._reg(Histogram(
             f"{s}_binding_latency_microseconds", "Binding latency",
-            _LATENCY_BUCKETS)
-        self.preemption_victims = Gauge(
+            _LATENCY_BUCKETS))
+        self.preemption_victims = self._reg(Gauge(
             f"{s}_pod_preemption_victims",
-            "Number of selected preemption victims")
-        self.preemption_attempts = Counter(
+            "Number of selected preemption victims"))
+        self.preemption_attempts = self._reg(Counter(
             f"{s}_total_preemption_attempts",
-            "Total preemption attempts in the cluster till now")
+            "Total preemption attempts in the cluster till now"))
+        # device-engine telemetry (no reference analog; new families)
+        self.backend_compile_latency = self._reg(Histogram(
+            "tpusim_backend_compile_latency_microseconds",
+            "Jax backend cluster compile (interning + device tables) walltime",
+            _LATENCY_BUCKETS))
+        self.backend_dispatch_latency = self._reg(Histogram(
+            "tpusim_backend_dispatch_latency_microseconds",
+            "Jax backend device dispatch walltime per batch or chunk",
+            _LATENCY_BUCKETS))
+        self.backend_route = self._reg(LabeledCounter(
+            "tpusim_backend_route_total",
+            "Scheduling batches by execution route", "route"))
+        self.backend_auto_transitions = self._reg(LabeledCounter(
+            "tpusim_backend_auto_transitions_total",
+            "Fast-path AUTO verify-then-trust state transitions",
+            "transition"))
+        self.backend_victim_path = self._reg(LabeledCounter(
+            "tpusim_backend_victim_path_total",
+            "Preemption victim-selection path per attempt", "path"))
+
+    def _reg(self, metric):
+        self._registry.append(metric)
+        return metric
 
     def _all(self):
-        return [self.e2e_scheduling_latency, self.scheduling_algorithm_latency,
-                self.binding_latency, self.predicate_evaluation,
-                self.priority_evaluation, self.preemption_evaluation,
-                self.preemption_victims, self.preemption_attempts]
+        return list(self._registry)
 
     def reset(self) -> None:
         for metric in self._all():
@@ -156,11 +217,30 @@ class SchedulerMetrics:
 
     def expose(self) -> str:
         """Prometheus text exposition format (the scrape body the reference
-        would have served had it started its metrics server)."""
+        would have served had it started its metrics server). Families are
+        emitted in registration order."""
         lines: List[str] = []
         for metric in self._all():
             lines.extend(metric.expose())
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, object]:
+        """Compact JSON-able snapshot of every non-empty family; embedded
+        in BENCH records so trajectory files say which path produced each
+        number."""
+        out: Dict[str, object] = {}
+        for metric in self._all():
+            if isinstance(metric, Histogram):
+                if metric.count:
+                    out[metric.name] = {"count": metric.count,
+                                        "sum": round(metric.total, 3)}
+            elif isinstance(metric, LabeledCounter):
+                if metric.values:
+                    out[metric.name] = dict(sorted(metric.values.items()))
+            else:
+                if metric.value:
+                    out[metric.name] = metric.value
+        return out
 
 
 # module-level default registry, mirroring the Go package-level metrics +

@@ -47,6 +47,7 @@ from tpusim.jaxe.kernels import (
     statics_to_device,
 )
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
+from tpusim.obs import recorder as flight
 
 log = logging.getLogger(__name__)
 
@@ -104,8 +105,10 @@ def _note_fast_failure(exc: Exception) -> None:
     msg = f"{type(exc).__name__}: {exc}"
     if any(marker in msg for marker in _TRANSIENT_MARKERS):
         _FAST_AUTO["transient"] += 1
+        flight.note_auto_transition("discard_transient")
         if _FAST_AUTO["transient"] >= _MAX_TRANSIENT_FAILURES:
             _FAST_AUTO["disabled"] = True
+            flight.note_auto_transition("discard_permanent")
             log.warning("pallas fast path: %d consecutive transient "
                         "failures; disabling it for this process",
                         _FAST_AUTO["transient"])
@@ -116,6 +119,7 @@ def _note_fast_failure(exc: Exception) -> None:
                         msg)
         return
     _FAST_AUTO["disabled"] = True
+    flight.note_auto_transition("discard_permanent")
     log.warning("pallas fast path: compile/lowering failure (%s); "
                 "disabling it for this process", msg)
 
@@ -137,13 +141,16 @@ def _auto_verify_and_pin(config, compiled, cols, choices, counts,
         m = min(m, limit)
     if not verify_against_xla(config, compiled, cols, choices, counts, m):
         _FAST_AUTO["disabled"] = True
+        flight.note_auto_transition("verify_fail", str(sig))
         log.warning("pallas fast path DISAGREES with the XLA scan on the "
                     "first %d pods; disabling it for this process and "
                     "re-running on the XLA scan", m)
         return False
+    flight.note_auto_transition("verify_pass", str(sig))
     min_pin = int(os.environ.get("TPUSIM_FAST_VERIFY_MIN", 64))
     if m >= min_pin:
         _FAST_AUTO["verified_sigs"].add(sig)
+        flight.note_auto_transition("pin", str(sig))
         log.info("pallas fast path self-verified on the first %d pods; "
                  "trusting kernel variant %s for this process", m, sig)
     else:
@@ -298,8 +305,12 @@ class JaxBackend:
                               reason="Unschedulable", message=msg) for p in pods]
         # a wedged accelerator tunnel must degrade to CPU, not hang the
         # first device op (or the AUTO fast-path gate's default_backend())
+        from time import perf_counter
+
+        from tpusim.framework.metrics import register, since_in_microseconds
         from tpusim.jaxe import ensure_responsive_platform
 
+        metrics = register()
         ensure_responsive_platform()
 
         cp = self._compiled_policy
@@ -312,15 +323,24 @@ class JaxBackend:
                        in cp.spec.pred_keys)
         need_saa = cp is not None and (bool(cp.spec.saa_weights)
                                        or cp.spec.sa_enabled)
-        compiled, cols = precompiled or compile_cluster(
-            snapshot, pods, need_noexec=need_noexec, need_saa=need_saa)
+        def _timed_compile():
+            compile_start = perf_counter()
+            with flight.span("compile_cluster") as csp:
+                out = compile_cluster(snapshot, pods, need_noexec=need_noexec,
+                                      need_saa=need_saa)
+                if csp:
+                    csp.set("pods", len(pods))
+                    csp.set("nodes", len(snapshot.nodes))
+            metrics.backend_compile_latency.observe(
+                since_in_microseconds(compile_start))
+            return out
+
+        compiled, cols = precompiled or _timed_compile()
         if (need_noexec and not compiled.has_noexec_table) \
                 or (need_saa and not compiled.has_saa_table):
             # a precompiled (event-log/incremental) state built without the
             # policy-only tables: recompile fresh for this rare combination
-            compiled, cols = compile_cluster(snapshot, pods,
-                                             need_noexec=need_noexec,
-                                             need_saa=need_saa)
+            compiled, cols = _timed_compile()
         unsupported = list(compiled.unsupported)
         if cp is not None:
             unsupported.extend(cp.unsupported)
@@ -330,6 +350,7 @@ class JaxBackend:
                 raise NotImplementedError(
                     f"jax backend does not yet carry state for: {detail}")
             log.warning("jax backend falling back to reference for: %s", detail)
+            flight.note_route("reference_fallback", len(pods))
             return ReferenceBackend(
                 provider=self.provider, policy=self.policy,
                 extender_transport=self.extender_transport,
@@ -371,6 +392,7 @@ class JaxBackend:
             # deferred after planning anyway — skip the O(nodes+pods)
             # gcd reduction entirely (the pre-signature fast exit)
             fast_on = False
+            flight.note_auto_transition("defer")
             log.info("pallas fast path deferred: %d pods is below "
                      "the self-verification threshold; using the "
                      "XLA scan", len(pods))
@@ -394,6 +416,7 @@ class JaxBackend:
                 # route them straight to the XLA scan.
                 fplan = None
                 fast_verify = False
+                flight.note_auto_transition("defer")
                 log.info("pallas fast path deferred: %d pods is below "
                          "the self-verification threshold; using the "
                          "XLA scan", len(pods))
@@ -450,11 +473,8 @@ class JaxBackend:
         # device program, so the whole batch dispatch lands in the algorithm
         # histogram (the per-phase split of metrics.go has no device analog);
         # e2e additionally covers host-side result materialization.
-        from time import perf_counter
-
-        from tpusim.framework.metrics import register, since_in_microseconds
-        metrics = register()
         dispatch_start = perf_counter()
+        dsp = flight.span("device_dispatch", "device")
 
         def _discard_fast_path():
             # pay the uploads the fast path deferred and rebuild the
@@ -474,7 +494,8 @@ class JaxBackend:
             from tpusim.jaxe.fastscan import fast_scan
 
             try:
-                choices, counts, _adv = fast_scan(fplan)
+                with flight.profiled("tpusim:fast_scan"):
+                    choices, counts, _adv = fast_scan(fplan)
             except Exception as exc:
                 # A Mosaic lowering/compile rejection on this backend must
                 # degrade to the XLA scan, not crash the process: an abrupt
@@ -494,21 +515,44 @@ class JaxBackend:
                     # the kernel lowered but miscomputed: the guardrail
                     # already disabled it process-wide; rerun on XLA
                     _discard_fast_path()
+                elif auto_mode and not fast_verify:
+                    # already-pinned variant ran without re-verification
+                    flight.note_auto_transition("trust", str(fast_sig))
         if fplan is None:  # fast path off, ineligible, or discarded above
-            if use_chunks:
-                _, choices, counts, _ = schedule_scan_chunked(
-                    config, carry, statics, xs, scan_chunk)
-            else:
-                _, choices, counts, _ = schedule_scan(config, carry,
-                                                      statics, xs)
+            with flight.profiled("tpusim:schedule_scan"):
+                if use_chunks:
+                    _, choices, counts, _ = schedule_scan_chunked(
+                        config, carry, statics, xs, scan_chunk)
+                else:
+                    _, choices, counts, _ = schedule_scan(config, carry,
+                                                          statics, xs)
         choices = np.asarray(choices)
         counts = np.asarray(counts)
+        if fplan is not None:
+            # the interpreter only engages on the explicit TPUSIM_FAST=1
+            # opt-in (see _fast_path_enabled)
+            route = ("fastscan_interpret"
+                     if os.environ.get("TPUSIM_FAST") == "1"
+                     and os.environ.get("TPUSIM_FAST_INTERPRET") == "1"
+                     else "fastscan")
+        else:
+            route = "xla_chunked" if use_chunks else "xla_scan"
+        flight.note_route(route, len(pods))
+        if dsp:
+            dsp.set("route", route)
+            dsp.set("pods", len(pods))
+            if fast_sig is not None:
+                dsp.set("sig", str(fast_sig))
+            dsp.end()
+        metrics.backend_dispatch_latency.observe(
+            since_in_microseconds(dispatch_start))
         metrics.scheduling_algorithm_latency.observe(
             since_in_microseconds(dispatch_start))
 
         strings = reason_strings(compiled.scalar_names)
-        placements, _ = decode_placements(pods, choices, counts,
-                                          compiled.statics.names, strings)
+        with flight.span("decode_placements"):
+            placements, _ = decode_placements(pods, choices, counts,
+                                              compiled.statics.names, strings)
         # e2e additionally covers host-side result materialization
         metrics.e2e_scheduling_latency.observe(
             since_in_microseconds(dispatch_start))

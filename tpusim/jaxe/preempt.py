@@ -91,6 +91,7 @@ from tpusim.jaxe.kernels import (
 )
 from tpusim.jaxe.policyc import classify_preemption_class
 from tpusim.jaxe.state import NUM_FIXED_BITS, reason_strings, victim_order_columns
+from tpusim.obs import recorder as flight
 
 log = logging.getLogger(__name__)
 
@@ -110,6 +111,14 @@ PREEMPT_CLASS_STATS: Counter = Counter()
 
 def reset_preempt_class_stats() -> None:
     PREEMPT_CLASS_STATS.clear()
+
+
+def _note_victim_path(path: str) -> None:
+    """One preemption's victim-selection path: bumps the in-module Counter
+    (read by tests/bench) and the tpusim_backend_victim_path_total metric
+    family + recorder instant in one place."""
+    PREEMPT_CLASS_STATS[path] += 1
+    flight.note_victim_path(path)
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
@@ -442,13 +451,13 @@ def _device_preempt(cc, vtable: _VictimTable, compiled, cols, row, pod: Pod,
                  == [v.key() for v in payload])
         if agree:
             _VICTIM_AUTO["verified_sigs"].add(sig)
-            PREEMPT_CLASS_STATS["device_verified"] += 1
+            _note_victim_path("device_verified")
             log.info("preempt-victim kernel verified against the host "
                      "oracle (variant %s); trusting it for this process",
                      sig)
         else:
             _VICTIM_AUTO["disabled"] = True
-            PREEMPT_CLASS_STATS["fallback"] += 1
+            _note_victim_path("fallback")
             log.error(
                 "preempt-victim kernel DISAGREES with the host oracle for "
                 "pod %s (device: %s + %d victims; host: %s + %d victims); "
@@ -466,7 +475,7 @@ def _device_preempt(cc, vtable: _VictimTable, compiled, cols, row, pod: Pod,
     metrics.preemption_attempts.inc()
     to_clear = cc.scheduler._get_lower_priority_nominated_pods(pod, name)
     metrics.preemption_evaluation.observe(since_in_microseconds(start))
-    PREEMPT_CLASS_STATS["device"] += 1
+    _note_victim_path("device")
     node, victims = cc.commit_preemption(pod, by_name[name], payload,
                                          to_clear)
     return "committed", (node, victims)
@@ -603,7 +612,13 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
             # (re)compile feed[pos:] against the current picture; reached once up
             # front and again only after structural churn (volume-carrying binds
             # or victims dirty the group tables — refresh_dynamic covers the rest)
-            compiled, cols = inc.compile(feed[pos:])
+            compile_start = perf_counter()
+            with flight.span("compile_cluster") as csp:
+                compiled, cols = inc.compile(feed[pos:])
+                if csp:
+                    csp.set("pods", len(feed) - pos)
+            metrics.backend_compile_latency.observe(
+                since_in_microseconds(compile_start))
             if compiled.unsupported:
                 if not first_compile:
                     raise RuntimeError(
@@ -711,12 +726,15 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                 carry = carry_init(compiled)._replace(rr=np.int64(rr_start))
                 if mesh is not None:
                     statics, carry = _mesh_place(mesh, carry, statics)
+            flight.note_route("fastscan" if fplan is not None else "xla_scan",
+                              len(feed) - pos)
             chunk = chunk0
 
             while pos < len(feed):
                 take = min(chunk, len(feed) - pos)
                 off = pos - base
                 dispatch_start = perf_counter()
+                dsp = flight.span("device_dispatch", "device")
                 # pow2 buckets bound recompiles to O(log chunk_max) on both
                 # engines: arbitrary tail lengths after a preemption would
                 # otherwise each trace a fresh program (infeasible pad rows
@@ -724,10 +742,11 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                 bucket = _next_pow2(take)
                 if fplan is not None:
                     try:
-                        choices, counts, advanced, fc_out = fast_scan(
-                            fplan, chunk=bucket, start=off, stop=off + take,
-                            carry_in=fcarry, return_carry=True,
-                            fixed_chunk=True)
+                        with flight.profiled("tpusim:fast_scan"):
+                            choices, counts, advanced, fc_out = fast_scan(
+                                fplan, chunk=bucket, start=off,
+                                stop=off + take, carry_in=fcarry,
+                                return_carry=True, fixed_chunk=True)
                     except Exception as exc:
                         # degrade without crashing mid-device-context; the
                         # outer loop recompiles feed[pos:] and re-decides
@@ -737,6 +756,9 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                                     "re-running on the XLA scan",
                                     type(exc).__name__, exc)
                         _note_fast_failure(exc)
+                        if dsp:
+                            dsp.set("error", type(exc).__name__)
+                            dsp.end()
                         break
                     _FAST_AUTO["transient"] = 0
                     if fverify:
@@ -750,6 +772,8 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                         if not _auto_verify_and_pin(
                                 config, compiled, cols, choices, counts,
                                 fsig, limit=take):
+                            if dsp:
+                                dsp.end()
                             break
                     carry_out = fc_out
                 else:
@@ -762,15 +786,24 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                         rep = NamedSharding(mesh, PartitionSpec())
                         xs = jax.tree.map(
                             lambda a: jax.device_put(a, rep), xs)
-                        with mesh:
+                        with mesh, flight.profiled("tpusim:schedule_scan"):
                             carry_out, choices, counts, advanced = \
                                 schedule_scan(config, carry, statics, xs)
                     else:
-                        carry_out, choices, counts, advanced = schedule_scan(
-                            config, carry, statics, xs)
+                        with flight.profiled("tpusim:schedule_scan"):
+                            carry_out, choices, counts, advanced = \
+                                schedule_scan(config, carry, statics, xs)
                 choices = np.asarray(choices)[:take]
                 counts = np.asarray(counts)[:take]
                 advanced = np.asarray(advanced)[:take]
+                if dsp:
+                    dsp.set("engine", "fastscan" if fplan is not None
+                            else "xla_scan")
+                    dsp.set("chunk", bucket)
+                    dsp.set("take", take)
+                    dsp.end()
+                metrics.backend_dispatch_latency.observe(
+                    since_in_microseconds(dispatch_start))
                 metrics.scheduling_algorithm_latency.observe(
                     since_in_microseconds(dispatch_start))
 
@@ -875,7 +908,7 @@ def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,
                         fit_err = FitError(pod, len(cc.nodes), failed)
                         cand = (bound.candidates(pod)
                                 if bound is not None else None)
-                        PREEMPT_CLASS_STATS["host"] += 1
+                        _note_victim_path("host")
                         node, victims = cc.attempt_preemption(
                             pod, fit_err,
                             candidate_filter=(cand.__contains__

@@ -1,0 +1,13 @@
+"""Observability: flight recorder spans + backend telemetry helpers.
+
+`tpusim.obs.recorder` holds the span/event subsystem; the metric
+families it feeds live in `tpusim.framework.metrics` so the reference
+registry stays the single exposition surface.
+"""
+
+from tpusim.obs.recorder import (  # noqa: F401
+    FlightRecorder,
+    get_recorder,
+    install,
+    uninstall,
+)

@@ -1,0 +1,276 @@
+"""Flight recorder: span/event timeline across the host/device boundary.
+
+Every scheduling attempt — host phases (queue wait, predicates,
+priorities, select host, preempt, assume, bind) and device work
+(cluster compile, kernel dispatch per variant signature, AUTO
+verify-then-trust transitions, victim-path selection) — lands on one
+timeline, exported as Chrome ``trace_event`` JSON (loadable in
+Perfetto / chrome://tracing) or a raw JSONL span stream.
+
+Design constraints (ISSUE 2):
+
+- **Zero-cost when disabled.** `span()` returns a falsy shared no-op
+  singleton when no recorder is installed: no dict, no Span object, no
+  per-pod allocation. Call sites guard argument construction with
+  ``if sp:`` so label strings are never built on the disabled path.
+- **Deterministic under an injected clock.** The recorder never calls
+  `time.perf_counter` directly; the clock is a constructor argument so
+  goldens can pin span structure byte-for-byte.
+
+Counters/histograms do NOT live here — they land in the
+`framework/metrics.py` registry (`tpusim_backend_*` families) so the
+reference exposition surface stays unified; the `note_*` helpers below
+bridge both sinks.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from tpusim.framework import metrics as _metrics
+
+PID = 1
+# Stable Perfetto track ids per category.
+_TIDS = {"host": 1, "device": 2, "tool": 3}
+
+
+class _NoopSpan:
+    """Shared do-nothing span; falsy so call sites can skip building args."""
+
+    __slots__ = ()
+
+    def __bool__(self) -> bool:
+        return False
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: object) -> bool:
+        return False
+
+    def set(self, key: str, value: Any) -> None:
+        pass
+
+    def end(self) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    __slots__ = ("rec", "name", "cat", "t0", "args")
+
+    def __init__(self, rec: "FlightRecorder", name: str, cat: str, t0: float):
+        self.rec = rec
+        self.name = name
+        self.cat = cat
+        self.t0 = t0
+        self.args: Optional[Dict[str, Any]] = None
+
+    def __bool__(self) -> bool:
+        return True
+
+    def set(self, key: str, value: Any) -> None:
+        if self.args is None:
+            self.args = {}
+        self.args[key] = value
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc: object) -> bool:
+        self.end()
+        return False
+
+    def end(self) -> None:
+        self.rec._finish(self)
+
+
+class FlightRecorder:
+    """Collects complete ('X') and instant ('i') trace events in memory."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self.clock: Callable[[], float] = clock or time.perf_counter
+        self._epoch = self.clock()
+        self.events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    # -- timestamps -------------------------------------------------------
+    def _ts(self, t: float) -> float:
+        return round((t - self._epoch) * 1e6, 3)
+
+    # -- recording --------------------------------------------------------
+    def span(self, name: str, cat: str = "host") -> Span:
+        return Span(self, name, cat, self.clock())
+
+    def _finish(self, span: Span) -> None:
+        t1 = self.clock()
+        ev: Dict[str, Any] = {
+            "name": span.name,
+            "cat": span.cat,
+            "ph": "X",
+            "ts": self._ts(span.t0),
+            "dur": round((t1 - span.t0) * 1e6, 3),
+            "pid": PID,
+            "tid": _TIDS.get(span.cat, _TIDS["tool"]),
+        }
+        if span.args:
+            ev["args"] = span.args
+        with self._lock:
+            self.events.append(ev)
+
+    def add_span(self, name: str, cat: str, t0: float, t1: float,
+                 args: Optional[Dict[str, Any]] = None) -> None:
+        """Record a span from explicit clock readings (e.g. queue wait)."""
+        ev: Dict[str, Any] = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": self._ts(t0),
+            "dur": round((t1 - t0) * 1e6, 3),
+            "pid": PID,
+            "tid": _TIDS.get(cat, _TIDS["tool"]),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+
+    def instant(self, name: str, cat: str = "host",
+                args: Optional[Dict[str, Any]] = None) -> None:
+        ev: Dict[str, Any] = {
+            "name": name,
+            "cat": cat,
+            "ph": "i",
+            "s": "g",
+            "ts": self._ts(self.clock()),
+            "pid": PID,
+            "tid": _TIDS.get(cat, _TIDS["tool"]),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+
+    # -- export -----------------------------------------------------------
+    def to_chrome(self) -> Dict[str, Any]:
+        meta = [
+            {"name": "process_name", "ph": "M", "ts": 0, "pid": PID, "tid": 0,
+             "args": {"name": "tpusim"}},
+        ]
+        for cat, tid in sorted(_TIDS.items(), key=lambda kv: kv[1]):
+            meta.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": PID,
+                         "tid": tid, "args": {"name": cat}})
+        with self._lock:
+            events = list(self.events)
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def to_chrome_json(self) -> str:
+        return json.dumps(self.to_chrome(), sort_keys=True,
+                          separators=(",", ":")) + "\n"
+
+    def to_jsonl(self) -> str:
+        with self._lock:
+            events = list(self.events)
+        return "".join(
+            json.dumps(ev, sort_keys=True, separators=(",", ":")) + "\n"
+            for ev in events)
+
+    def write(self, path: str) -> None:
+        """Chrome trace for ``.json``, raw span stream for ``.jsonl``."""
+        text = self.to_jsonl() if path.endswith(".jsonl") else self.to_chrome_json()
+        with open(path, "w") as f:
+            f.write(text)
+
+
+# -- module-level active recorder ----------------------------------------
+
+_active: Optional[FlightRecorder] = None
+
+
+def install(rec: FlightRecorder) -> FlightRecorder:
+    global _active
+    _active = rec
+    return rec
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _active
+
+
+def span(name: str, cat: str = "host") -> Any:
+    """A live Span when a recorder is installed, else the shared no-op.
+
+    Deliberately takes no args kwargs: attach labels via ``span.set``
+    inside an ``if sp:`` guard so the disabled path allocates nothing.
+    """
+    rec = _active
+    if rec is None:
+        return NOOP_SPAN
+    return rec.span(name, cat)
+
+
+def instant(name: str, cat: str = "host",
+            args: Optional[Dict[str, Any]] = None) -> None:
+    rec = _active
+    if rec is not None:
+        rec.instant(name, cat, args)
+
+
+# -- telemetry bridges (metrics registry + recorder instants) ------------
+
+def note_auto_transition(kind: str, sig: Optional[str] = None) -> None:
+    """AUTO verify-then-trust transition: verify_pass/verify_fail/pin/
+    trust/defer/discard_transient/discard_permanent."""
+    _metrics.register().backend_auto_transitions.inc(kind)
+    rec = _active
+    if rec is not None:
+        rec.instant("auto:" + kind, "device",
+                    {"sig": sig} if sig is not None else None)
+
+
+def note_route(route: str, pods: Optional[int] = None) -> None:
+    """Batch execution route: fastscan/fastscan_interpret/xla_scan/
+    xla_chunked/reference_fallback."""
+    _metrics.register().backend_route.inc(route)
+    rec = _active
+    if rec is not None:
+        rec.instant("route:" + route, "device",
+                    {"pods": pods} if pods is not None else None)
+
+
+def note_victim_path(path: str) -> None:
+    """Preemption victim-selection path: device/device_verified/host/
+    fallback (mirrors jaxe.preempt.PREEMPT_CLASS_STATS)."""
+    _metrics.register().backend_victim_path.inc(path)
+    rec = _active
+    if rec is not None:
+        rec.instant("victim:" + path, "device")
+
+
+# -- jax.profiler bridge --------------------------------------------------
+
+_annotation_cls: Any = None
+
+
+def profiled(name: str) -> Any:
+    """`jax.profiler.TraceAnnotation` context so XLA profiles line up
+    with recorder spans; degrades to a null context without jax."""
+    global _annotation_cls
+    if _annotation_cls is None:
+        try:
+            from jax.profiler import TraceAnnotation
+            _annotation_cls = TraceAnnotation
+        except Exception:  # pragma: no cover - jax is a hard dep in practice
+            import contextlib
+            _annotation_cls = contextlib.nullcontext
+    return _annotation_cls(name)

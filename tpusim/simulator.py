@@ -38,6 +38,7 @@ from tpusim.framework.metrics import register as register_metrics, since_in_micr
 from tpusim.framework.report import GeneralReview, Status, get_report
 from tpusim.framework.store import ADDED, DELETED, MODIFIED, PodQueue, ResourceStore
 from tpusim.framework.strategy import PredictiveStrategy
+from tpusim.obs import recorder as flight
 
 DEFAULT_SCHEDULER_NAME = "TD-Scheduler"  # options.go:49
 
@@ -301,13 +302,27 @@ class ClusterCapacity:
         back anyway. Deviation from the reference, documented: the transient
         Unschedulable condition the Go scheduler sets before a successful
         preemption is not recorded in FailedPods."""
+        sp = flight.span("pod_attempt")
+        if not sp:
+            return self._schedule_one_inner(pod, preempt_budget)
+        sp.set("pod", pod.key())
+        sp.set("preempt_budget", preempt_budget)
+        try:
+            outcome = self._schedule_one_inner(pod, preempt_budget)
+            sp.set("outcome", outcome)
+            return outcome
+        finally:
+            sp.end()
+
+    def _schedule_one_inner(self, pod: Pod, preempt_budget: int) -> str:
         metrics = self.metrics
         e2e_start = algo_start = perf_counter()
         # the algorithm runs against the cache's generation-checked snapshot,
         # not the live view (generic_scheduler.go:129)
         node_infos = self.refresh_node_info_snapshot()
         try:
-            host = self.scheduler.schedule(pod, self.nodes, node_infos)
+            with flight.span("schedule"):
+                host = self.scheduler.schedule(pod, self.nodes, node_infos)
             metrics.scheduling_algorithm_latency.observe(
                 since_in_microseconds(algo_start))
         except FitError as fit_err:
@@ -348,7 +363,8 @@ class ClusterCapacity:
         assumed = pod.copy()
         assumed.spec.node_name = host
         try:
-            self.cache.assume_pod(assumed)
+            with flight.span("assume"):
+                self.cache.assume_pod(assumed)
         except CacheError as cache_err:
             # assume error arm (scheduler.go:377-380 → config.Error): the pod
             # is reported failed, the run continues — e.g. a fed pod whose
@@ -360,7 +376,10 @@ class ClusterCapacity:
         # binding latency + e2e (scheduler.go:425,492)
         binding_start = perf_counter()
         try:
-            self.bind(pod, host)
+            with flight.span("bind") as bsp:
+                if bsp:
+                    bsp.set("host", host)
+                self.bind(pod, host)
         except SchedulingError:
             # bind error arm (scheduler.go:484-496): forget the assumed pod
             # so its resources are returned, then surface the error
@@ -383,6 +402,7 @@ class ClusterCapacity:
         metrics = self.metrics
         preemption_start = perf_counter()
         metrics.preemption_attempts.inc()
+        psp = flight.span("preempt")
         try:
             # Preempt runs against the same cached snapshot the failed
             # Schedule used (g.cachedNodeInfoMap, generic_scheduler.go:205)
@@ -394,6 +414,11 @@ class ClusterCapacity:
             # logged-and-dropped in the reference (scheduler.go:
             # 449-451); the pod still gets its Unschedulable condition
             node, victims, to_clear = None, [], []
+        if psp:
+            psp.set("pod", pod.key())
+            psp.set("node", node.name if node is not None else "")
+            psp.set("victims", len(victims))
+            psp.end()
         metrics.preemption_evaluation.observe(
             since_in_microseconds(preemption_start))
         return self.commit_preemption(pod, node, victims, to_clear)
@@ -437,13 +462,22 @@ class ClusterCapacity:
     def run(self) -> None:
         """Reference: simulator.go:187-213 — feed one pod at a time until the
         queue drains; the stop-reason strings match the Go format verbatim."""
+        rec = flight.get_recorder()
+        idle_since = rec.clock() if rec is not None else 0.0
         pod = self._next_pod()
         if pod is None:
             self.status.stop_reason = self.STOP_REASONS["run"]
             self.close()
             return
         while pod is not None:
+            if rec is not None:
+                # time the pod sat in the LIFO feed since the scheduler
+                # last went idle (the reference's scheduling-queue wait)
+                rec.add_span("queue_wait", "host", idle_since, rec.clock(),
+                             {"pod": pod.key()})
             outcome = self._schedule_one(pod)
+            if rec is not None:
+                idle_since = rec.clock()
             next_pod = self._next_pod()
             if next_pod is None:
                 self.status.stop_reason = self.STOP_REASONS[outcome]
@@ -605,8 +639,12 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
         feed = list(reversed(pods))  # the LIFO queue pops the last element first
         precompiled = (incremental.compile(feed) if incremental is not None
                        and feed and snapshot.nodes else None)
-        placements = jax_backend.schedule(feed, snapshot,
-                                          precompiled=precompiled)
+        with flight.span("backend_schedule") as bsp:
+            if bsp:
+                bsp.set("backend", "jax")
+                bsp.set("pods", len(feed))
+            placements = jax_backend.schedule(feed, snapshot,
+                                              precompiled=precompiled)
         status = Status(scheduled_pods=list(snapshot.pods))
         for placement in placements:
             if placement.scheduled:

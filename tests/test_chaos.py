@@ -1,0 +1,376 @@
+"""Chaos engine unit coverage: the fault-plan schema, the dispatch circuit
+breaker's full state machine, backoff under an injected clock, the bounded
+watch buffer's "410 Gone" overflow, reflector reconvergence, and
+equivalence-cache invalidation when churn deletes a node between attempts.
+
+The end-to-end seeded campaigns live in test_chaos_fuzz.py; this module
+pins each layer's mechanism in isolation.
+"""
+
+import json
+
+import pytest
+
+from tpusim.api.snapshot import make_node, make_pod, synthetic_cluster
+from tpusim.api.types import Pod, ResourceType
+from tpusim.chaos import (
+    BreakerState,
+    ChaosClock,
+    ChaosEngine,
+    ChurnEvent,
+    CircuitBreaker,
+    DeviceFaultPlan,
+    DeviceInjector,
+    FabricFaultPlan,
+    FabricInjector,
+    FaultPlan,
+    InjectedDeviceError,
+    load_plan,
+    random_plan,
+)
+from tpusim.chaos.plan import PlanError
+from tpusim.engine.util import PodBackoff
+from tpusim.framework.events import WatchBuffer, WatchExpiredError
+from tpusim.framework.metrics import register as register_metrics
+from tpusim.framework.reflector import Reflector
+from tpusim.framework.restclient import FakeRESTClient
+from tpusim.framework.store import ResourceStore
+from tpusim.simulator import (
+    ClusterCapacity,
+    SchedulerServerConfig,
+    run_simulation,
+)
+
+
+def _pod(i, cpu=500, ns="default"):
+    return make_pod(f"p{i}", milli_cpu=cpu, memory=1024**3, namespace=ns)
+
+
+# ---------------------------------------------------------------------------
+# fault-plan schema
+# ---------------------------------------------------------------------------
+
+
+def test_plan_json_round_trip(tmp_path):
+    plan = FaultPlan(
+        seed=42, max_retries=2,
+        churn=[ChurnEvent(at=2, action="node_delete", target="node-1"),
+               ChurnEvent(at=4, action="node_flap", target="node-0",
+                          restore_after=2),
+               ChurnEvent(at=5, action="pod_evict", target="default/web-1")],
+        fabric=FabricFaultPlan(drop=[4], dup=[7], disconnect=[9]),
+        device=DeviceFaultPlan(faults={0: "exception", 3: "corrupt_silent"},
+                               failure_threshold=2, cooldown=1))
+    path = tmp_path / "plan.json"
+    path.write_text(plan.to_json())
+    loaded = load_plan(str(path))
+    assert loaded == plan
+    # byte-stable: serialize(load(serialize(p))) == serialize(p)
+    assert loaded.to_json() == plan.to_json()
+
+
+def test_plan_empty_sections_omitted():
+    obj = FaultPlan(seed=1).to_obj()
+    assert set(obj) == {"seed", "max_retries"}
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda o: o.update(bogus=1), "unknown plan key"),
+    (lambda o: o["churn"].append({"at": 0, "action": "node_melt",
+                                  "target": "n"}), "unknown churn action"),
+    (lambda o: o["churn"].append({"at": -1, "action": "node_delete",
+                                  "target": "n"}), "negative boundary"),
+    (lambda o: o["churn"].append({"at": 0, "action": "node_flap",
+                                  "target": "n"}), "restore_after"),
+    (lambda o: o.update(fabric={"drop": [1], "dup": [1]}), "both"),
+    (lambda o: o.update(device={"faults": {"0": "segfault"}}),
+     "unknown device fault"),
+    (lambda o: o.update(device={"faults": {}, "failure_threshold": 0}),
+     "failure_threshold"),
+    (lambda o: o.update(device={"faults": {}, "verify": "never"}), "verify"),
+])
+def test_plan_validation_rejects(mutate, match):
+    obj = FaultPlan(seed=0, churn=[]).to_obj()
+    obj["churn"] = []
+    mutate(obj)
+    with pytest.raises(PlanError, match=match):
+        FaultPlan.from_obj(obj)
+
+
+def test_random_plan_deterministic_and_valid():
+    nodes = [f"node-{i}" for i in range(6)]
+    pods = [f"default/p{i}" for i in range(8)]
+    a = random_plan(123, nodes, pods, attempts=8, device_dispatches=4)
+    b = random_plan(123, nodes, pods, attempts=8, device_dispatches=4)
+    assert a == b and a.to_json() == b.to_json()
+    # keep_nodes: the first node is never churned
+    assert all(ev.target != "node-0" for ev in a.churn
+               if ev.action != "pod_evict")
+    assert random_plan(124, nodes, pods, attempts=8) != a
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_full_cycle():
+    brk = CircuitBreaker("device", failure_threshold=2, cooldown=2)
+    assert brk.state is BreakerState.CLOSED and brk.allow()
+    brk.record_failure("boom 1")
+    assert brk.state is BreakerState.CLOSED  # below threshold
+    brk.record_failure("boom 2")
+    assert brk.state is BreakerState.OPEN
+    # cooldown counted in DENIED dispatches, not wall time
+    assert not brk.allow()
+    assert not brk.allow()
+    assert brk.state is BreakerState.HALF_OPEN
+    assert brk.allow() and brk.probing
+    brk.record_success()
+    assert brk.state is BreakerState.CLOSED and not brk.probing
+    assert [t for t, _ in brk.transitions] == ["open", "half_open", "close"]
+
+
+def test_breaker_reopen_on_failed_probe():
+    brk = CircuitBreaker("device", failure_threshold=1, cooldown=1)
+    brk.record_failure("boom")
+    assert not brk.allow()                      # denial 1 -> half-open
+    assert brk.state is BreakerState.HALF_OPEN
+    brk.record_failure("probe died")
+    assert brk.state is BreakerState.OPEN
+    assert [t for t, _ in brk.transitions] == ["open", "half_open", "reopen"]
+
+
+def test_breaker_success_resets_failure_streak():
+    brk = CircuitBreaker("device", failure_threshold=2, cooldown=1)
+    brk.record_failure("a")
+    brk.record_success()
+    brk.record_failure("b")
+    assert brk.state is BreakerState.CLOSED  # streak broke: 1, not 2
+
+
+def test_breaker_transitions_reach_metrics():
+    reg = register_metrics()
+    before = dict(reg.breaker_transitions.values)
+    brk = CircuitBreaker("device", failure_threshold=1, cooldown=1)
+    brk.record_failure("boom")
+    brk.allow()
+    brk.record_success()
+    for transition in ("open", "half_open", "close"):
+        assert reg.breaker_transitions.values.get(transition, 0) \
+            == before.get(transition, 0) + 1
+    assert reg.breaker_state.value == 0.0  # closed again
+
+
+# ---------------------------------------------------------------------------
+# injectors
+# ---------------------------------------------------------------------------
+
+
+def test_device_injector_scripts_by_dispatch_index():
+    inj = DeviceInjector({0: "exception", 2: "corrupt_silent"})
+    with pytest.raises(InjectedDeviceError):
+        inj.begin_dispatch()
+    assert inj.begin_dispatch() is None
+    assert inj.begin_dispatch() == "corrupt_silent"
+    assert inj.injected == [(0, "exception"), (2, "corrupt_silent")]
+
+
+def test_fabric_injector_classifies_by_global_index():
+    inj = FabricInjector(drop={1}, dup={2}, disconnect={3})
+    got = [inj.on_event("pods", "ADDED") for _ in range(5)]
+    assert got == ["deliver", "drop", "dup", "disconnect", "deliver"]
+
+
+# ---------------------------------------------------------------------------
+# PodBackoff under an injected clock (satellite: injectable backoff clock)
+# ---------------------------------------------------------------------------
+
+
+def test_pod_backoff_injected_clock():
+    clock = ChaosClock(start=100.0)
+    backoff = PodBackoff(clock=clock)
+    key = "default/p0"
+    assert backoff.try_backoff_and_wait(key)      # first touch creates entry
+    backoff.get_backoff_time(key)                 # failure: backoff doubles
+    assert not backoff.try_backoff_and_wait(key)  # clock has not moved
+    clock.advance(1.9)
+    assert not backoff.try_backoff_and_wait(key)  # 1.9s < 2s backoff
+    clock.advance(0.1)
+    assert backoff.try_backoff_and_wait(key)      # exactly at expiry
+    # deterministic doubling under the same clock: 2s -> 4s
+    backoff.get_backoff_time(key)
+    clock.advance(3.9)
+    assert not backoff.try_backoff_and_wait(key)
+    clock.advance(0.1)
+    assert backoff.try_backoff_and_wait(key)
+
+
+def test_pod_backoff_default_clock_unchanged():
+    # the injectable-clock seam must not alter wall-clock behavior
+    backoff = PodBackoff()
+    assert backoff.try_backoff_and_wait("default/p0")
+    backoff.get_backoff_time("default/p0")
+    assert not backoff.try_backoff_and_wait("default/p0")
+
+
+# ---------------------------------------------------------------------------
+# bounded watch buffer: overflow == "410 Gone" (satellite: WatchBuffer)
+# ---------------------------------------------------------------------------
+
+
+def test_watch_buffer_overflow_raises_410():
+    reg = register_metrics()
+    before = reg.watch_overflow.values.get("pods", 0)
+    buf = WatchBuffer(maxsize=3, resource="pods")
+    for i in range(5):  # 2 past the window
+        buf.emit("ADDED", make_pod(f"p{i}"))
+    assert buf.closed
+    with pytest.raises(WatchExpiredError) as exc:
+        buf.read(timeout=0)
+    assert exc.value.code == 410
+    # the torn window is discarded — and every later read fails too
+    with pytest.raises(WatchExpiredError):
+        buf.read(timeout=0)
+    assert reg.watch_overflow.values.get("pods", 0) == before + 1
+
+
+def test_watch_buffer_disconnect_keeps_queued_frames():
+    buf = WatchBuffer(maxsize=10, resource="pods")
+    buf.emit("ADDED", make_pod("p0"))
+    buf.close_with_error(WatchExpiredError("chaos: disconnect"))
+    ev = buf.read(timeout=0)
+    assert ev is not None and ev.object.name == "p0"
+    with pytest.raises(WatchExpiredError):
+        buf.read(timeout=0)
+
+
+def test_watch_buffer_unbounded_never_overflows():
+    buf = WatchBuffer(maxsize=0, resource="pods")
+    for i in range(100):
+        buf.emit("ADDED", make_pod(f"p{i}"))
+    assert not buf.closed
+
+
+# ---------------------------------------------------------------------------
+# reflector: relist-on-410 reconvergence
+# ---------------------------------------------------------------------------
+
+
+def _fabric_fixture():
+    store = ResourceStore()
+    client = FakeRESTClient(store)
+    return store, client
+
+
+def test_reflector_reconverges_after_drop_and_disconnect():
+    store, client = _fabric_fixture()
+    events = []
+    refl = Reflector(client, ResourceType.PODS,
+                     handler=lambda t, o: events.append((t, o.key())))
+    store.add(ResourceType.PODS, _pod(0))
+    assert refl.sync() == 1
+    client.fault_injector = FabricInjector(drop={1}, dup={2}, disconnect={4})
+    store.add(ResourceType.PODS, _pod(1))   # 0: delivered
+    store.add(ResourceType.PODS, _pod(2))   # 1: dropped
+    store.add(ResourceType.PODS, _pod(3))   # 2: duplicated
+    refl.sync()
+    # the dropped frame silently diverged the bare mirror...
+    assert "default/p2" not in refl.known
+    store.delete(ResourceType.PODS, _pod(3))  # 3: delivered
+    store.add(ResourceType.PODS, _pod(4))     # 4: disconnect (frame lost)
+    refl.sync()
+    # ...and the disconnect-triggered relist healed everything
+    assert refl.relists == 1
+    assert sorted(refl.known) == ["default/p0", "default/p1", "default/p2",
+                                  "default/p4"]
+    assert set(sorted(refl.known)) == {p.key() for p
+                                       in store.list(ResourceType.PODS)}
+
+
+def test_reflector_reconverges_after_overflow():
+    store, client = _fabric_fixture()
+    refl = Reflector(client, ResourceType.PODS)
+    refl.sync()
+    refl._buf.maxsize = 3  # shrink the live window to force the overflow
+    for i in range(8):
+        store.add(ResourceType.PODS, _pod(i))
+    assert refl.sync() >= 8 - 3  # relist resynced whatever the tear lost
+    assert refl.relists == 1
+    assert len(refl.known) == 8
+
+
+# ---------------------------------------------------------------------------
+# churn through the store fabric (satellite: ecache invalidation)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_cc(plan, num_nodes=3, num_pods=4, **config_kw):
+    snap = synthetic_cluster(num_nodes)
+    pods = [_pod(i) for i in range(num_pods)]
+    engine = ChaosEngine(plan)
+    cc = ClusterCapacity(SchedulerServerConfig(**config_kw), pods, [],
+                         snap.nodes, chaos=engine)
+    return cc, engine
+
+
+def test_node_delete_invalidates_ecache_between_attempts():
+    plan = FaultPlan(seed=0, churn=[
+        ChurnEvent(at=1, action="node_delete", target="node-1")])
+    cc, engine = _chaos_cc(plan, enable_equivalence_cache=True)
+    ecache = cc.scheduler.equivalence_cache
+    assert ecache is not None
+    # attempt 1 cached predicate verdicts for node-1...
+    ecache.update("node-1", "GeneralPredicates", 123, True, [])
+    assert ecache.lookup("node-1", "GeneralPredicates", 123) == (True, [])
+    engine.fire_boundary()   # boundary 0: nothing due
+    engine.fire_boundary()   # boundary 1: node_delete -> DELETED via store
+    # ...and the deletion rode the event fabric into whole-node invalidation
+    assert ecache.lookup("node-1", "GeneralPredicates", 123) is None
+    assert "node-1" not in cc.cache.nodes
+    assert all(n.name != "node-1" for n in cc.nodes)
+    assert engine.fired == [(1, "node_delete", "node-1")]
+
+
+def test_node_delete_clears_nominations():
+    plan = FaultPlan(seed=0, churn=[
+        ChurnEvent(at=0, action="node_delete", target="node-1")])
+    cc, engine = _chaos_cc(plan, enable_pod_priority=True)
+    nominee = _pod(99)
+    nominee.status.nominated_node_name = "node-1"
+    cc.scheduling_queue.add_unschedulable_if_not_present(nominee)
+    assert cc.scheduling_queue.waiting_pods_for_node("node-1")
+    engine.fire_boundary()
+    assert not cc.scheduling_queue.waiting_pods_for_node("node-1")
+    assert nominee.status.nominated_node_name == ""
+
+
+def test_pod_evict_requeues_fed_pod():
+    plan = FaultPlan(seed=0, max_retries=2, churn=[
+        ChurnEvent(at=3, action="pod_evict", target="default/p0")])
+    snap = synthetic_cluster(2)
+    status = run_simulation([_pod(i) for i in range(3)], snap,
+                            backend="reference", chaos_plan=plan)
+    assert status.chaos_violations == []
+    # the evicted pod was re-fed and landed again
+    assert status.chaos_summary["evicted"] == ["default/p0"]
+    assert "default/p0" in {p.key() for p in status.successful_pods}
+
+
+def test_node_flap_restores_and_reschedules():
+    # one big pod only node-1 can hold after node-0 is cordoned; flap
+    # node-1 away and back: the pod must park, then land on the restore
+    plan = FaultPlan(seed=0, max_retries=3, churn=[
+        ChurnEvent(at=0, action="node_flap", target="node-1",
+                   restore_after=2)])
+    nodes = [make_node("node-0", milli_cpu=1000), make_node("node-1")]
+    pod = make_pod("big", milli_cpu=2000, memory=1024**3)
+    from tpusim.api.snapshot import ClusterSnapshot
+
+    status = run_simulation([pod], ClusterSnapshot(nodes=nodes),
+                            backend="reference", chaos_plan=plan)
+    assert status.chaos_violations == []
+    assert [p.spec.node_name for p in status.successful_pods] == ["node-1"]
+    summary = status.chaos_summary
+    assert summary["churn_fired"] == 1
+    assert summary["retries"].get("default/big", 0) >= 1

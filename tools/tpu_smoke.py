@@ -286,6 +286,37 @@ def run_preempt_variant():
     return h, len(dev.preempted_pods), paths
 
 
+def run_chaos_breaker_variant():
+    """Dispatch circuit breaker under scripted device faults: two injected
+    exceptions trip it OPEN, the cooldown denial flips it HALF_OPEN, and a
+    verified probe CLOSES it again — while every emitted batch stays
+    byte-identical to the host pipeline (verify="all"). Certifies that a
+    flaky accelerator degrades and RECOVERS instead of being benched for
+    the life of the process."""
+    from tpusim.backends import placement_hash
+    from tpusim.chaos import DeviceFaultPlan
+    from tpusim.jaxe.backend import JaxBackend, install_chaos, uninstall_chaos
+
+    snapshot, pods = _base()
+    backend = JaxBackend()
+    expected = placement_hash(backend.schedule(pods, snapshot))
+    breaker = install_chaos(DeviceFaultPlan(
+        faults={0: "exception", 1: "exception"},
+        failure_threshold=2, cooldown=1))
+    try:
+        for _ in range(4):  # fault, fault->open, denied->half_open, probe
+            got = placement_hash(backend.schedule(pods, snapshot))
+            if got != expected:
+                raise AssertionError(
+                    "placements diverged from the clean run under chaos")
+    finally:
+        uninstall_chaos()
+    transitions = [t for t, _ in breaker.transitions]
+    if transitions != ["open", "half_open", "close"]:
+        raise AssertionError(f"breaker cycle incomplete: {transitions}")
+    return expected[:16], transitions
+
+
 def _write_smoke_trace(recorder):
     """Persist the sweep's flight-recorder trace; never fail the smoke."""
     path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
@@ -354,6 +385,26 @@ def main() -> int:
             ran += 1
             print(f"SMOKE preempt_victim: OK hash={h} victims={n_victims} "
                   f"paths={paths} ({time.time() - t:.1f}s)", flush=True)
+        if not only or "chaos_breaker" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "chaos_breaker")
+            try:
+                h, transitions = run_chaos_breaker_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: chaos_breaker: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("transitions", "->".join(transitions))
+            vsp.end()
+            ran += 1
+            print(f"SMOKE chaos_breaker: OK hash={h} "
+                  f"transitions={'->'.join(transitions)} "
+                  f"({time.time() - t:.1f}s)", flush=True)
     finally:
         flight.uninstall()
         _write_smoke_trace(recorder)

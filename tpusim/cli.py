@@ -98,6 +98,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "GSPMD collectives (jaxe/sharding.py). Needs "
                              "SNAP*NODE visible jax devices; default "
                              "single-device.")
+    parser.add_argument("--chaos-plan", default="",
+                        help="Fault-plan JSON (tpusim.chaos schema: churn/"
+                             "fabric/device sections) injected into the run; "
+                             "the summary line reports invariant violations "
+                             "and a non-empty audit exits 1")
+    parser.add_argument("--chaos-seed", type=int, default=None,
+                        help="Generate a seeded adversarial fault plan "
+                             "against the loaded workload instead of (or "
+                             "overriding the seed of) --chaos-plan; "
+                             "deterministic per seed")
     parser.add_argument("--enable-pod-priority", action="store_true",
                         help="Enable the PodPriority feature gate (preemption). "
                              "On the jax backend this runs the host-device "
@@ -428,6 +438,28 @@ def main(argv=None) -> int:
                   "program. Use --backend reference to see the dump.",
                   file=sys.stderr)
 
+    chaos_plan = None
+    if args.chaos_plan or args.chaos_seed is not None:
+        from tpusim.chaos import load_plan, random_plan
+        from tpusim.chaos.plan import PlanError
+
+        try:
+            if args.chaos_plan:
+                chaos_plan = load_plan(args.chaos_plan)
+                if args.chaos_seed is not None:
+                    chaos_plan.seed = args.chaos_seed
+            else:
+                # seed-only: generate an adversarial plan against the
+                # loaded workload (deterministic per seed)
+                chaos_plan = random_plan(
+                    args.chaos_seed,
+                    node_names=[n.name for n in snapshot.nodes],
+                    pod_keys=[p.key() for p in pods],
+                    attempts=max(len(pods), 1))
+        except (OSError, PlanError) as exc:
+            print(f"error: invalid chaos plan: {exc}", file=sys.stderr)
+            return 2
+
     recorder = None
     if args.trace_out:
         from tpusim.obs import recorder as flight
@@ -441,7 +473,8 @@ def main(argv=None) -> int:
                                 enable_pod_priority=args.enable_pod_priority,
                                 enable_volume_scheduling=args.enable_volume_scheduling,
                                 policy=policy, events=events,
-                                feature_gates=feature_gates)
+                                feature_gates=feature_gates,
+                                chaos_plan=chaos_plan)
     except (ValueError, KeyError) as exc:
         # invalid policy/provider/plugin names surfaced at build time
         # (PolicyError is a ValueError; the registry raises KeyError)
@@ -481,6 +514,21 @@ def main(argv=None) -> int:
           f"{len(status.scheduled_pods)} pre-scheduled "
           f"[{args.backend} backend, {elapsed:.3f}s, {rate:.0f} pods/s]")
     print(f"StopReason: {status.stop_reason.strip()}")
+    if chaos_plan is not None:
+        summary = getattr(status, "chaos_summary", None) or {}
+        violations = getattr(status, "chaos_violations", None) or []
+        fired = summary.get("churn_fired", 0)
+        fabric = len(summary.get("fabric_injected", []))
+        device = (len(summary.get("device_injected", []))
+                  or len(summary.get("breaker_transitions", [])))
+        print(f"Chaos: {fired} churn event(s), {fabric} fabric fault(s), "
+              f"{device} device fault/transition(s), "
+              f"{len(violations)} invariant violation(s) [seed "
+              f"{chaos_plan.seed}]")
+        if violations:
+            for violation in violations:
+                print(f"chaos violation: {violation}", file=sys.stderr)
+            return 1
     return 0
 
 

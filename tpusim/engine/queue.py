@@ -100,6 +100,12 @@ class SchedulingQueue:
     def waiting_pods_for_node(self, node_name: str) -> List[Pod]:
         raise NotImplementedError
 
+    def clear_nominations_for_node(self, node_name: str) -> List[Pod]:
+        """Drop every nomination pointing at `node_name` (the node left the
+        cluster; a nomination on it is a promise that can't be kept) and
+        return the affected pods so the caller can clear their status."""
+        return []
+
 
 class FIFO(SchedulingQueue):
     """Reference: scheduling_queue.go:73-139 — wrapper over cache.FIFO."""
@@ -327,6 +333,15 @@ class PriorityQueue(SchedulingQueue):
 
     def waiting_pods_for_node(self, node_name: str) -> List[Pod]:
         return list(self._nominated.get(node_name, []))
+
+    def clear_nominations_for_node(self, node_name: str) -> List[Pod]:
+        cleared = self._nominated.pop(node_name, [])
+        if cleared:
+            # the parked pods lost their claim on the dead node; re-activate
+            # them so they re-attempt against the surviving cluster
+            self._move_pods_to_active_queue(
+                [p for p in cleared if p.key() in self._unschedulable])
+        return list(cleared)
 
     def __len__(self) -> int:
         return len(self._active_items) + len(self._unschedulable)

@@ -33,29 +33,87 @@ class WatchEvent:
                            "object": self.object.to_obj()}, sort_keys=True)
 
 
+# A lagging watcher's buffered window is finite, like an apiserver's etcd
+# watch cache: past this many undrained frames the stream dies with the
+# "410 Gone" analog and the consumer must relist (WatchExpiredError).
+DEFAULT_WATCH_BUFFER_SIZE = 4096
+
+
+class WatchExpiredError(Exception):
+    """The watch stream's buffered window is gone — the apiserver's
+    "410 Gone" / "too old resource version". The consumer cannot resume
+    from where it was; it must relist and re-watch (see
+    framework/reflector.py)."""
+
+    code = 410
+
+
 class WatchBuffer:
-    """An unbounded FIFO of watch events; close() wakes readers."""
+    """A bounded FIFO of watch events; close() wakes readers.
+
+    Overflow tears the stream: queued-but-undrained frames are discarded
+    (that window is exactly what the consumer can no longer trust) and
+    every subsequent read() raises :class:`WatchExpiredError`."""
 
     _CLOSED = object()
+    _ERROR = object()
 
-    def __init__(self):
-        self._q: queue.Queue = queue.Queue()
+    def __init__(self, maxsize: int = DEFAULT_WATCH_BUFFER_SIZE,
+                 resource: str = ""):
+        # +1 slot so the error sentinel always fits after a drain
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize + 1 if maxsize
+                                           else 0)
+        self.maxsize = maxsize
+        self.resource = resource
         self.closed = False
+        self.error: Optional[Exception] = None
 
     def emit(self, event_type: str, obj) -> None:
-        if not self.closed:
-            self._q.put(WatchEvent(event_type, obj))
+        if self.closed:
+            return
+        if self.maxsize and self._q.qsize() >= self.maxsize:
+            self._overflow()
+            return
+        self._q.put(WatchEvent(event_type, obj))
+
+    def _overflow(self) -> None:
+        from tpusim.obs.recorder import note_watch_overflow
+
+        note_watch_overflow(self.resource or "unknown")
+        self.close_with_error(WatchExpiredError(
+            f"watch buffer overflow ({self.maxsize} undrained frames) on "
+            f"{self.resource or 'stream'}: too old resource version"),
+            drop_pending=True)
 
     def close(self) -> None:
         if not self.closed:
             self.closed = True
             self._q.put(self._CLOSED)
 
+    def close_with_error(self, exc: Exception,
+                         drop_pending: bool = False) -> None:
+        """Terminate the stream with a transport error: readers drain any
+        surviving frames, then read() raises `exc` (once per call)."""
+        if self.closed:
+            return
+        self.closed = True
+        self.error = exc
+        if drop_pending:
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+        self._q.put(self._ERROR)
+
     def read(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         try:
             item = self._q.get(timeout=timeout)
         except queue.Empty:
             return None
+        if item is self._ERROR:
+            self._q.put(self._ERROR)  # every subsequent read fails too
+            raise self.error
         if item is self._CLOSED:
             return None
         return item
@@ -71,7 +129,7 @@ class WatchBuffer:
 def watch_resource(store: ResourceStore, resource: ResourceType) -> WatchBuffer:
     """Subscribe to a resource: current objects replay as ADDED, then live
     events stream (restclient.go:380-426 list+watch semantics)."""
-    buf = WatchBuffer()
+    buf = WatchBuffer(resource=resource.value)
     for obj in store.list(resource):
         buf.emit(ADDED, obj)
     store.register_event_handler(resource, buf.emit)

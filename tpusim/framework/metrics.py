@@ -203,6 +203,21 @@ class SchedulerMetrics:
         self.backend_victim_path = self._reg(LabeledCounter(
             "tpusim_backend_victim_path_total",
             "Preemption victim-selection path per attempt", "path"))
+        # chaos-engine telemetry (ISSUE 3): injected faults by kind, watch
+        # buffer overflows by resource, and the dispatch circuit breaker
+        self.fault_injected = self._reg(LabeledCounter(
+            "tpusim_fault_injected_total",
+            "Chaos faults injected, by fault kind", "kind"))
+        self.watch_overflow = self._reg(LabeledCounter(
+            "tpusim_watch_overflow_total",
+            "Watch streams terminated on buffer overflow (410 Gone analog)",
+            "resource"))
+        self.breaker_transitions = self._reg(LabeledCounter(
+            "tpusim_breaker_transitions_total",
+            "Device-dispatch circuit breaker transitions", "transition"))
+        self.breaker_state = self._reg(Gauge(
+            "tpusim_breaker_state",
+            "Device-dispatch breaker state (0 closed, 0.5 half-open, 1 open)"))
 
     def _reg(self, metric):
         self._registry.append(metric)

@@ -29,7 +29,13 @@ import numpy as np
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.api.types import Pod
-from tpusim.backends import Placement, ReferenceBackend, bind_pod, mark_unschedulable
+from tpusim.backends import (
+    Placement,
+    ReferenceBackend,
+    bind_pod,
+    mark_unschedulable,
+    placement_hash,
+)
 from tpusim.engine.generic_scheduler import NO_NODE_AVAILABLE_MSG
 from tpusim.engine.providers import (
     CLUSTER_AUTOSCALER_PROVIDER,
@@ -229,6 +235,36 @@ _MOST_REQUESTED_PROVIDERS = {CLUSTER_AUTOSCALER_PROVIDER, TD_PROVIDER}
 _KNOWN_PROVIDERS = {DEFAULT_PROVIDER} | _MOST_REQUESTED_PROVIDERS
 
 
+# process-wide chaos seam (ISSUE 3): when installed, every JaxBackend
+# dispatch flows through the circuit breaker (closed → open on repeated
+# device faults → half-open re-probe → closed) and the DeviceInjector
+# scripts per-dispatch exceptions/corruptions. Upgrades the _FAST_AUTO
+# three-strikes-and-permanently-out policy into a RECOVERING state
+# machine: a flaky device degrades to the host pipeline and comes back,
+# and under chaos no placement is ever emitted unverified (verify="all")
+# or un-re-probed (half-open).
+_CHAOS = {"injector": None, "breaker": None, "verify": "all"}
+
+
+def install_chaos(device_plan):
+    """Arm the device-fault layer of a chaos plan
+    (tpusim.chaos.plan.DeviceFaultPlan). Returns the CircuitBreaker so
+    callers can assert on its transition audit trail."""
+    from tpusim.chaos.breaker import CircuitBreaker
+    from tpusim.chaos.engine import DeviceInjector
+
+    device_plan.validate()
+    breaker = CircuitBreaker("device", device_plan.failure_threshold,
+                             device_plan.cooldown)
+    _CHAOS.update(injector=DeviceInjector(device_plan.faults),
+                  breaker=breaker, verify=device_plan.verify)
+    return breaker
+
+
+def uninstall_chaos() -> None:
+    _CHAOS.update(injector=None, breaker=None, verify="all")
+
+
 def format_fit_error(num_nodes: int, counts: np.ndarray, strings: List[str]) -> str:
     """Byte-identical FitError.Error() (generic_scheduler.go:71-90)."""
     reason_strs = sorted(f"{int(c)} {strings[i]}"
@@ -291,8 +327,54 @@ class JaxBackend:
             compiled_policy = compile_policy(policy)
         self._compiled_policy = compiled_policy
 
+    def _reference(self, pods: List[Pod],
+                   snapshot: ClusterSnapshot) -> List[Placement]:
+        return ReferenceBackend(
+            provider=self.provider, policy=self.policy,
+            extender_transport=self.extender_transport,
+            hard_pod_affinity_symmetric_weight=self.hard_pod_affinity_symmetric_weight,
+        ).schedule(pods, snapshot)
+
     def schedule(self, pods: List[Pod], snapshot: ClusterSnapshot,
                  precompiled=None) -> List[Placement]:
+        """Device dispatch behind the chaos circuit breaker (when armed via
+        install_chaos; a no-op wrapper otherwise). The contract under
+        chaos: a denied or faulted dispatch routes the batch through the
+        host pipeline (byte-identical placements), a half-open probe — and
+        every dispatch under verify="all" — is host-verified before its
+        placements are emitted, so a flaky device can never surface an
+        unverified result, and a recovered device is re-trusted after one
+        verified probe."""
+        breaker = _CHAOS["breaker"]
+        if breaker is None:
+            return self._schedule_on_device(pods, snapshot, precompiled)
+        if not pods:
+            return []
+        from tpusim.chaos.engine import DeviceFault
+
+        if not breaker.allow():
+            flight.note_route("breaker_fallback", len(pods))
+            return self._reference(pods, snapshot)
+        probing = breaker.probing
+        try:
+            placements = self._schedule_on_device(pods, snapshot, precompiled)
+        except DeviceFault as exc:
+            breaker.record_failure(f"{type(exc).__name__}: {exc}")
+            flight.note_route("breaker_fallback", len(pods))
+            return self._reference(pods, snapshot)
+        if probing or _CHAOS["verify"] == "all":
+            expected = self._reference(pods, snapshot)
+            if placement_hash(placements) != placement_hash(expected):
+                # silent corruption: in-range but wrong placements — only
+                # the host parity digest catches it
+                breaker.record_failure("device/host placement divergence")
+                flight.note_route("breaker_fallback", len(pods))
+                return expected
+        breaker.record_success()
+        return placements
+
+    def _schedule_on_device(self, pods: List[Pod], snapshot: ClusterSnapshot,
+                            precompiled=None) -> List[Placement]:
         """precompiled: an optional (CompiledCluster, PodColumns) pair for
         `pods` against `snapshot` — the incremental event-log path
         (jaxe.delta.IncrementalCluster.compile) hands its cached state in
@@ -473,6 +555,13 @@ class JaxBackend:
         # device program, so the whole batch dispatch lands in the algorithm
         # histogram (the per-phase split of metrics.go has no device analog);
         # e2e additionally covers host-side result materialization.
+        # chaos seam: scripted dispatch faults raise here (outside the fast
+        # path's own try/except — an injected device death is not a Mosaic
+        # lowering failure and must reach the circuit breaker, not flip
+        # _FAST_AUTO); scripted corruptions apply to the results below
+        _corrupt_kind = None
+        if _CHAOS["injector"] is not None:
+            _corrupt_kind = _CHAOS["injector"].begin_dispatch()
         dispatch_start = perf_counter()
         dsp = flight.span("device_dispatch", "device")
 
@@ -528,6 +617,23 @@ class JaxBackend:
                                                           statics, xs)
         choices = np.asarray(choices)
         counts = np.asarray(counts)
+        if _CHAOS["injector"] is not None:
+            if _corrupt_kind is not None:
+                from tpusim.chaos.engine import DeviceInjector
+
+                choices, counts = DeviceInjector.corrupt(_corrupt_kind,
+                                                         choices, counts)
+            # structural validation always runs under chaos: out-of-range
+            # choices and NaN counts never reach decode_placements
+            from tpusim.chaos.engine import DeviceOutputError
+
+            n_nodes = len(compiled.statics.names)
+            if choices.size and (int(choices.max()) >= n_nodes
+                                 or int(choices.min()) < -1):
+                raise DeviceOutputError(
+                    f"device choice out of range [-1, {n_nodes})")
+            if np.isnan(np.asarray(counts, dtype=np.float64)).any():
+                raise DeviceOutputError("NaN in device reason counts")
         if fplan is not None:
             # the interpreter only engages on the explicit TPUSIM_FAST=1
             # opt-in (see _fast_path_enabled)

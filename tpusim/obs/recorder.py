@@ -257,6 +257,40 @@ def note_victim_path(path: str) -> None:
         rec.instant("victim:" + path, "device")
 
 
+def note_fault(kind: str, args: Optional[Dict[str, Any]] = None) -> None:
+    """A chaos-injected fault: node_delete/node_cordon/node_flap/
+    node_restore/pod_evict, watch_drop/watch_dup/watch_disconnect,
+    device_exception/device_corrupt_*, invariant_violation."""
+    _metrics.register().fault_injected.inc(kind)
+    rec = _active
+    if rec is not None:
+        rec.instant("fault:" + kind, "host", args)
+
+
+def note_breaker(name: str, transition: str, state_value: float,
+                 detail: Optional[str] = None) -> None:
+    """A dispatch circuit-breaker transition: open/half_open/reopen/close.
+    Mirrors the live state into the tpusim_breaker_state gauge."""
+    reg = _metrics.register()
+    reg.breaker_transitions.inc(transition)
+    reg.breaker_state.set(state_value)
+    rec = _active
+    if rec is not None:
+        args: Dict[str, Any] = {"breaker": name}
+        if detail:
+            args["detail"] = detail
+        rec.instant("breaker:" + transition, "device", args)
+
+
+def note_watch_overflow(resource: str) -> None:
+    """A watch stream died on buffer overflow (the "410 Gone" analog):
+    the consumer must relist to resync."""
+    _metrics.register().watch_overflow.inc(resource)
+    rec = _active
+    if rec is not None:
+        rec.instant("watch_overflow", "host", {"resource": resource})
+
+
 # -- jax.profiler bridge --------------------------------------------------
 
 _annotation_cls: Any = None

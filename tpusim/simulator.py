@@ -14,7 +14,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 from time import perf_counter
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.api.types import Node, Pod, PodCondition, ResourceType
@@ -75,11 +75,18 @@ class ClusterCapacity:
                  scheduled_pods: List[Pod], nodes: List[Node],
                  services: Optional[list] = None,
                  pvs: Optional[list] = None, pvcs: Optional[list] = None,
-                 storage_classes: Optional[list] = None):
+                 storage_classes: Optional[list] = None,
+                 chaos: Optional[object] = None,
+                 backoff_clock: Optional[Callable[[], float]] = None):
         self.config = config
         self.status = Status()
         self.report: Optional[GeneralReview] = None
         self.closed = False
+        # chaos engine (tpusim.chaos.ChaosEngine): fires scripted churn at
+        # pod-attempt boundaries and audits end-state invariants; its
+        # deterministic clock drives the backoff below so retry order is a
+        # pure function of the fault plan
+        self.chaos = chaos.attach(self) if chaos is not None else None
 
         # --- store + queue + strategy + recorder (simulator.go:286-342) ---
         self.resource_store = ResourceStore()
@@ -150,7 +157,13 @@ class ClusterCapacity:
             and pp.argument.service_affinity is not None
         ] if config.policy is not None else []
         self.scheduling_queue = new_scheduling_queue(config.enable_pod_priority)
-        self.pod_backoff = PodBackoff()  # MakeDefaultErrorFunc's backoff state
+        # MakeDefaultErrorFunc's backoff state; the clock is injectable
+        # (chaos > explicit > wall monotonic) so backoff expiry is testable
+        # and chaos replays are byte-stable
+        if self.chaos is not None:
+            backoff_clock = self.chaos.clock
+        self.pod_backoff = (PodBackoff(clock=backoff_clock)
+                            if backoff_clock is not None else PodBackoff())
         registry = None
         if config.feature_gates:
             # ApplyFeatureGates runs before provider/policy assembly, like
@@ -246,9 +259,22 @@ class ClusterCapacity:
         stored, exists = self.resource_store.get(ResourceType.PODS, pod.key())
         if not exists:
             raise SchedulingError(f"Unable to bind, pod {pod.key()} not found")
+        if self.chaos is not None and node_name in self.chaos.deleted_nodes:
+            # churn fires only at attempt boundaries, so the algorithm can
+            # never legitimately pick a node deleted before its snapshot:
+            # reaching here means stale state leaked through a seam
+            self.chaos.record_violation(
+                f"bind of {pod.key()} to deleted node {node_name}")
         updated = stored.copy()
         updated.spec.node_name = node_name
         updated.status.phase = "Running"
+        if self.chaos is not None:
+            # a chaos retry can bind a pod that already parked as
+            # unschedulable in an earlier attempt; the terminal buckets
+            # must stay disjoint (the reference never retries, so only
+            # the chaos arm can hit this)
+            self.status.failed_pods = [
+                p for p in self.status.failed_pods if p.key() != pod.key()]
         self.strategy.add(updated)  # -> store.update -> Modified -> cache AddPod
         self.scheduling_queue.delete(updated)
         self.pod_backoff.clear_pod_backoff(updated.key())
@@ -462,6 +488,8 @@ class ClusterCapacity:
     def run(self) -> None:
         """Reference: simulator.go:187-213 — feed one pod at a time until the
         queue drains; the stop-reason strings match the Go format verbatim."""
+        if self.chaos is not None:
+            return self._run_chaos()
         rec = flight.get_recorder()
         idle_since = rec.clock() if rec is not None else 0.0
         pod = self._next_pod()
@@ -484,6 +512,49 @@ class ClusterCapacity:
                 self.close()
                 return
             pod = next_pod
+
+    def _run_chaos(self) -> None:
+        """The chaos arm of run(): identical seams and scheduling path, but
+        every attempt boundary fires due churn first, and after the LIFO
+        feed drains, churn-reactivated pods (evicted-and-requeued, or
+        parked pods a returning node released) get bounded re-attempts out
+        of the scheduling queue — gated per pod by the plan's max_retries
+        and by PodBackoff under the chaos clock. A global attempt budget
+        guarantees termination for any plan."""
+        chaos = self.chaos
+        outcome = "run"
+        max_at = max([ev.at for ev in chaos.plan.churn] +
+                     [ev.at + ev.restore_after for ev in chaos.plan.churn],
+                     default=0)
+        budget = ((len(self.pod_queue) + len(self.status.scheduled_pods)
+                   + len(chaos.plan.churn) + 8)
+                  * (chaos.plan.max_retries + 2) + max_at + 64)
+        spent = 0
+        while spent < budget:
+            spent += 1
+            chaos.fire_boundary()
+            pod = self._next_pod()
+            if pod is not None:
+                chaos.note_fed(pod)
+                outcome = self._schedule_one(pod)
+                continue
+            if chaos.has_pending_churn():
+                # churn scheduled past the attempt horizon may still evict
+                # and requeue; keep ticking boundaries until it lands
+                continue
+            retry = self.scheduling_queue.pop()
+            if retry is None:
+                break
+            if chaos.allow_retry(retry):
+                outcome = self._schedule_one(retry)
+        if spent >= budget:
+            chaos.record_violation(
+                f"attempt budget exhausted ({budget}): the run did not "
+                "quiesce")
+        chaos.flush()
+        self.status.stop_reason = self.STOP_REASONS.get(
+            outcome, self.STOP_REASONS["run"])
+        self.close()
 
     def close(self) -> None:
         self.closed = True
@@ -526,7 +597,8 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                    enable_volume_scheduling: bool = False,
                    policy: Optional[Policy] = None,
                    events: Optional[list] = None,
-                   feature_gates: Optional[Dict[str, bool]] = None) -> Status:
+                   feature_gates: Optional[Dict[str, bool]] = None,
+                   chaos_plan: Optional[object] = None) -> Status:
     """High-level entry: run `pods` (in podspec order; the LIFO feed reversal
     happens inside, matching the reference) against `snapshot` and return the
     final Status. backend='jax' routes the batch through the TPU engine and
@@ -537,7 +609,15 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
     `snapshot` before scheduling — the reference's watch fabric
     (restclient.go:218-236 → informer cache mutations) as data. On the jax
     backend the replay drives the IncrementalCluster column caches
-    (jaxe/delta.py), so compiled state is patched, not rebuilt."""
+    (jaxe/delta.py), so compiled state is patched, not rebuilt.
+
+    chaos_plan: an optional tpusim.chaos.FaultPlan. Churn and fabric
+    sections drive the reference orchestrator (a jax-backend request with
+    those sections reroutes host-side with a warning, like the other
+    host-bound features); the device section arms the dispatch circuit
+    breaker + fault injector around the jax backend. The returned Status
+    gains `chaos_summary` (fired faults, retries) and `chaos_violations`
+    (end-state invariant audit; empty = degraded gracefully)."""
     incremental = None
     if events:
         from tpusim.jaxe.delta import IncrementalCluster
@@ -568,6 +648,19 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
             enable_pod_priority = True
         if feature_gates.pop("VolumeScheduling", False):
             enable_volume_scheduling = True
+    if chaos_plan is not None:
+        chaos_plan.validate()
+        if backend == "jax" and not chaos_plan.host_sections_empty():
+            # churn fires at per-pod attempt boundaries and fabric faults
+            # hit watch streams — both exist only in the host orchestrator
+            # (the jax batch path has neither); device faults alone stay
+            # on the device path, absorbed by the circuit breaker
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "chaos churn/fabric sections are host-bound: running the "
+                "reference orchestrator instead of the jax backend")
+            backend = "reference"
     if feature_gates and any(feature_gates.get(g) for g in
                              ("TaintNodesByCondition",
                               "ResourceLimitsPriorityFunction")) \
@@ -603,6 +696,11 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                 "orchestrator instead of the jax backend", reason)
             backend = "reference"
     if backend == "reference":
+        chaos_engine = None
+        if chaos_plan is not None:
+            from tpusim.chaos import ChaosEngine
+
+            chaos_engine = ChaosEngine(chaos_plan)
         cc = ClusterCapacity(
             SchedulerServerConfig(scheduler_name=scheduler_name,
                                   algorithm_provider=provider,
@@ -612,8 +710,13 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                                   feature_gates=feature_gates),
             new_pods=pods, scheduled_pods=snapshot.pods, nodes=snapshot.nodes,
             services=snapshot.services, pvs=snapshot.pvs, pvcs=snapshot.pvcs,
-            storage_classes=snapshot.storage_classes)
+            storage_classes=snapshot.storage_classes, chaos=chaos_engine)
         cc.run()
+        if chaos_engine is not None:
+            from tpusim.chaos import check_invariants
+
+            cc.status.chaos_violations = check_invariants(cc, chaos_engine)
+            cc.status.chaos_summary = chaos_engine.summary()
         return cc.status
     if backend == "jax":
         # interactive robustness: a wedged accelerator tunnel must degrade
@@ -639,12 +742,23 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
         feed = list(reversed(pods))  # the LIFO queue pops the last element first
         precompiled = (incremental.compile(feed) if incremental is not None
                        and feed and snapshot.nodes else None)
-        with flight.span("backend_schedule") as bsp:
-            if bsp:
-                bsp.set("backend", "jax")
-                bsp.set("pods", len(feed))
-            placements = jax_backend.schedule(feed, snapshot,
-                                              precompiled=precompiled)
+        breaker = None
+        if chaos_plan is not None and not chaos_plan.device.empty():
+            from tpusim.jaxe.backend import install_chaos
+
+            breaker = install_chaos(chaos_plan.device)
+        try:
+            with flight.span("backend_schedule") as bsp:
+                if bsp:
+                    bsp.set("backend", "jax")
+                    bsp.set("pods", len(feed))
+                placements = jax_backend.schedule(feed, snapshot,
+                                                  precompiled=precompiled)
+        finally:
+            if breaker is not None:
+                from tpusim.jaxe.backend import uninstall_chaos
+
+                uninstall_chaos()
         status = Status(scheduled_pods=list(snapshot.pods))
         for placement in placements:
             if placement.scheduled:
@@ -654,5 +768,9 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
         last_failed = placements and not placements[-1].scheduled
         status.stop_reason = ("Fail to get next pod: No pods left\n" if last_failed
                               else "fail to get next pod: No pods left\n")
+        if breaker is not None:
+            status.chaos_summary = {
+                "breaker_transitions": list(breaker.transitions)}
+            status.chaos_violations = []
         return status
     raise ValueError(f"unknown backend {backend!r}")

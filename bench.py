@@ -19,10 +19,12 @@ JSON line, even from a killed child.
 
 Workloads (BASELINE.md config ladder): the headline is config 3 — 100k mixed
 Zipf-sized pods onto 5k heterogeneous nodes (taints/tolerations slice), exact
-sequential semantics. `python bench.py --ladder` measures all five configs
+sequential semantics. `python bench.py --ladder` measures the full ladder
 (20-pod quickstart; 1k uniform/100; 100k Zipf/5k; 1M/10k with
-taints+affinity via the chunked donated scan; 50×20k batched what-if) and
-prints one JSON line per config plus a summary line.
+taints+affinity via the chunked donated scan; 50×20k batched what-if;
+priority-band preemption; policy residue — label rows + ServiceAffinity +
+ImageLocality on the 10k-node snapshot) and prints one JSON line per config
+plus a summary line.
 
 Before any measurement attempt the parent runs a PRE-FLIGHT PROBE: one tiny
 device op in a subprocess under TPUSIM_BENCH_PROBE_TIMEOUT (40s). A wedged
@@ -39,7 +41,7 @@ limit until the child reports its device list), TPUSIM_BENCH_PROBE_TIMEOUT
 (40s), TPUSIM_BENCH_RUN_TIMEOUT (2400s),
 TPUSIM_BENCH_RETRIES (2), TPUSIM_BENCH_CPU_PODS/_NODES (CPU-fallback shape),
 TPUSIM_BENCH_CHUNK (131072; chunked-scan chunk length — the 100k headline runs as ONE dispatch, 1M runs 8 chunks of ~12s each, inside the stall watchdog), TPUSIM_SCAN_UNROLL,
-TPUSIM_BENCH_LADDER_CONFIGS (ladder subset, e.g. "3,5"), TPUSIM_FAST=1
+TPUSIM_BENCH_LADDER_CONFIGS (ladder subset, e.g. "3,7"), TPUSIM_FAST=1
 (Pallas fused-scan fast path for eligible group-free workloads; TPU only
 unless TPUSIM_FAST_INTERPRET=1), TPUSIM_FAST_CHUNK (512),
 TPUSIM_BENCH_DUAL_FAST=0 (disable the default-on TPU dual measurement that
@@ -227,6 +229,94 @@ def uniform_workload(num_pods: int, num_nodes: int):
     return ClusterSnapshot(nodes=nodes), pods
 
 
+# Config-7 policy: every residue family the fused scan had to absorb —
+# label-presence predicate rows (foo), ServiceAffinity over region (per
+# -segment first-pod locks), NodeLabel preference (bar), SAA spreading over
+# zone, and ImageLocality via the signature-table streaming path.
+POLICY_RESIDUE = {
+    "kind": "Policy", "apiVersion": "v1",
+    "predicates": [
+        {"name": "MatchNodeSelector"},
+        {"name": "PodFitsResources"},
+        {"name": "TestServiceAffinity",
+         "argument": {"serviceAffinity": {"labels": ["region"]}}},
+        {"name": "TestLabelsPresence",
+         "argument": {"labelsPresence": {"labels": ["foo"],
+                                         "presence": True}}},
+    ],
+    "priorities": [
+        {"name": "LeastRequestedPriority", "weight": 1},
+        {"name": "BalancedResourceAllocation", "weight": 1},
+        {"name": "ImageLocalityPriority", "weight": 2},
+        {"name": "TestServiceAntiAffinity", "weight": 3,
+         "argument": {"serviceAntiAffinity": {"label": "zone"}}},
+        {"name": "TestLabelPreference", "weight": 2,
+         "argument": {"labelPreference": {"label": "bar",
+                                          "presence": True}}},
+    ],
+}
+
+
+def policy_residue_workload(num_pods: int, num_nodes: int, seed: int = 777):
+    """Config-7 shape: config-3 Zipf resource pressure plus the label /
+    service / image structure POLICY_RESIDUE reads — region (4 domains,
+    ServiceAffinity), zone (6 domains, SAA spreading), foo on 2/3 of nodes
+    (presence rows), bar on half (NodeLabel preference), an 8-image catalog
+    on odd nodes (ImageLocality). Half the services are seeded with running
+    pods (pre-bound region locks); the rest bind their first-pod lock
+    inside the scan — the carry slots the fast path has to thread."""
+    from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+    from tpusim.api.types import ContainerImage, Service
+
+    MB = 1024 * 1024
+    rng = np.random.RandomState(seed)
+    nodes = []
+    for i in range(num_nodes):
+        shape = i % 3
+        labels = {"region": f"r{i % 4}", "zone": f"z{i % 6}"}
+        if i % 3 != 2:
+            labels["foo"] = "x"
+        if i % 2 == 0:
+            labels["bar"] = "y"
+        node = make_node(f"node-{i}", milli_cpu=[4000, 8000, 16000][shape],
+                         memory=[8, 16, 32][shape] * 1024**3, pods=110,
+                         labels=labels)
+        if i % 2 == 1:
+            node.status.images = [
+                ContainerImage(names=[f"img-{j}:v1"], size_bytes=400 * MB)
+                for j in range(8) if (i + j) % 3 == 0]
+        nodes.append(node)
+
+    n_svc = 6
+    services = [Service.from_obj({
+        "metadata": {"name": f"svc{j}", "namespace": "default"},
+        "spec": {"selector": {"app": f"app{j}"}}}) for j in range(n_svc)]
+    placed = [make_pod(f"placed-{i}", milli_cpu=200, memory=128 * MB,
+                       node_name=f"node-{i % num_nodes}", phase="Running",
+                       labels={"app": f"app{i % (n_svc // 2)}"})
+              for i in range(min(num_nodes, 64))]
+
+    cpu_buckets = np.array([50, 100, 250, 500, 1000, 2000, 4000])
+    mem_buckets = np.array([64, 128, 256, 512, 1024, 2048, 4096]) * 2**20
+    weights = 1.0 / np.arange(1, len(cpu_buckets) + 1) ** 1.1
+    weights /= weights.sum()
+    cpu_idx = rng.choice(len(cpu_buckets), size=num_pods, p=weights)
+    mem_idx = rng.choice(len(mem_buckets), size=num_pods, p=weights)
+    pods = []
+    for i in range(num_pods):
+        kw = {}
+        if i % 5 == 0:
+            kw["node_selector"] = {"region": f"r{i % 4}"}
+        pod = make_pod(f"p-{i}", milli_cpu=int(cpu_buckets[cpu_idx[i]]),
+                       memory=int(mem_buckets[mem_idx[i]]),
+                       labels={"app": f"app{i % n_svc}"} if i % 3 else None,
+                       **kw)
+        if i % 4 == 0:
+            pod.spec.containers[0].image = f"img-{i % 8}:v1"
+        pods.append(pod)
+    return ClusterSnapshot(nodes=nodes, pods=placed, services=services), pods
+
+
 # --------------------------------------------------------------------------
 # child: the measurements (inside the watchdogged subprocess)
 # --------------------------------------------------------------------------
@@ -255,6 +345,60 @@ def _prepare(snapshot, pods, provider_most_requested=False, to_device=True):
     xs = (pod_columns_to_device(cols) if to_device
           else pod_columns_to_host(cols))
     return compiled, config, carry, statics, xs, cols
+
+
+def _prepare_policy(snapshot, pods, policy, to_device=True):
+    """Policy-aware _prepare: compile the policy-as-data, build the static
+    residue tables once (label rows, NodeLabel priority, image signatures,
+    SAA domains, ServiceAffinity pins — policyc.build_policy_tables), and
+    graft them into the XLA statics plus the sa_lock carry, exactly as
+    backend._schedule_on_device does. Returns the tables as a 7th element
+    so plan_fast can prove fast-path eligibility for the same config."""
+    from dataclasses import replace as _dc_replace
+
+    from tpusim.engine.policy import decode_policy
+    from tpusim.engine.predicates import (
+        POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+    )
+    from tpusim.jaxe.kernels import (
+        _tree_to_device,
+        carry_init,
+        config_for,
+        pod_columns_to_device,
+        pod_columns_to_host,
+        statics_to_host,
+    )
+    from tpusim.jaxe.policyc import build_policy_tables, compile_policy
+    from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster
+
+    cp = compile_policy(decode_policy(policy))
+    if cp.unsupported:
+        raise ValueError(f"policy unsupported: {cp.unsupported}")
+    need_noexec = (cp.spec.pred_keys is not None
+                   and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
+                   in cp.spec.pred_keys)
+    need_saa = bool(cp.spec.saa_weights) or cp.spec.sa_enabled
+    t0 = time.perf_counter()
+    compiled, cols = compile_cluster(snapshot, pods, need_noexec=need_noexec,
+                                     need_saa=need_saa)
+    log(f"  host compile (intern+tables): {time.perf_counter() - t0:.1f}s")
+    config = config_for(
+        [compiled], most_requested=False,
+        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+    config = _dc_replace(config, policy=cp.spec)
+    # fills cols.img_id / cols.sa_self_id in place — must run before the
+    # pod columns are shipped to the device
+    ptabs = build_policy_tables(cp, snapshot, pods, compiled, cols)
+    if cp.saa_entries:
+        config = _dc_replace(config, n_saa_doms=ptabs.n_saa_doms)
+    statics = _tree_to_device(statics_to_host(compiled)._replace(
+        label_ok=ptabs.label_ok, label_prio=ptabs.label_prio,
+        image_score=ptabs.image_score, saa_dom=ptabs.saa_dom,
+        sa_pin=ptabs.sa_pin, sa_val=ptabs.sa_val))
+    carry = carry_init(compiled)._replace(sa_lock=ptabs.sa_lock_init)
+    xs = (pod_columns_to_device(cols) if to_device
+          else pod_columns_to_host(cols))
+    return compiled, config, carry, statics, xs, cols, ptabs
 
 
 def _checksum(choices) -> int:
@@ -306,8 +450,12 @@ def _metrics_snapshot(reset: bool = False) -> dict:
 
 
 def measure_config(name: str, snapshot, pods, platform: str,
-                   baseline_pods: int, chunk: int, timed_runs: int = 3):
-    """Measure one ladder config; returns the result dict."""
+                   baseline_pods: int, chunk: int, timed_runs: int = 3,
+                   policy=None):
+    """Measure one ladder config; returns the result dict. `policy` (a
+    policy-as-data dict) routes both the reference loop and the device scan
+    through the compiled policy; fast-path eligibility for it is probed on
+    every platform (planning is host-only) and stamped on the record."""
     from tpusim.backends import ReferenceBackend
     from tpusim.jaxe.kernels import carry_init
 
@@ -319,19 +467,40 @@ def measure_config(name: str, snapshot, pods, platform: str,
     mismatches = None
     sub = min(baseline_pods, num_pods)
     if sub:
+        if policy is not None:
+            from tpusim.engine.policy import decode_policy
+            ref_backend = ReferenceBackend(policy=decode_policy(policy))
+        else:
+            ref_backend = ReferenceBackend()
         t0 = time.perf_counter()
-        ref_placements = ReferenceBackend().schedule(pods[:sub], snapshot)
+        ref_placements = ref_backend.schedule(pods[:sub], snapshot)
         ref_elapsed = max(time.perf_counter() - t0, 1e-9)
         ref_rate = sub / ref_elapsed
         log(f"  reference loop: {sub} pods in {ref_elapsed:.1f}s "
             f"= {ref_rate:.1f} pods/s")
 
     use_chunks = bool(chunk) and num_pods > chunk
-    compiled, config, carry, statics, xs, cols = _prepare(
-        snapshot, pods, to_device=not use_chunks)
+    ptabs = None
+    if policy is not None:
+        compiled, config, carry, statics, xs, cols, ptabs = _prepare_policy(
+            snapshot, pods, policy, to_device=not use_chunks)
+    else:
+        compiled, config, carry, statics, xs, cols = _prepare(
+            snapshot, pods, to_device=not use_chunks)
     if compiled.unsupported:
         return {"metric": f"{name} (unsupported: {compiled.unsupported})",
                 "value": 0, "unit": "pods/s", "vs_baseline": 0}
+
+    fast_probe = None
+    if policy is not None:
+        # eligibility evidence on every platform (host-only planning): the
+        # measured pallas record itself needs a TPU (dual mode below)
+        from tpusim.jaxe.fastscan import plan_fast as _probe_plan_fast
+
+        fast_probe = _probe_plan_fast(config, compiled, cols, ptabs=ptabs)
+        log("  policy fast-path: "
+            + ("eligible" if fast_probe[0] is not None
+               else f"ineligible ({fast_probe[1]})"))
 
     fast_plan = None
     fast_env = os.environ.get("TPUSIM_FAST")
@@ -353,7 +522,8 @@ def measure_config(name: str, snapshot, pods, platform: str,
                 "using the XLA scan (set TPUSIM_FAST_INTERPRET=1 to force "
                 "the interpreter for correctness checks)")
         else:
-            fast_plan, why = plan_fast(config, compiled, cols)
+            fast_plan, why = (fast_probe if fast_probe is not None
+                              else plan_fast(config, compiled, cols))
             if fast_plan is None:
                 log(f"  pallas fast path ineligible ({why}); "
                     "using the XLA scan")
@@ -398,6 +568,8 @@ def measure_config(name: str, snapshot, pods, platform: str,
     drift = False
     for _ in range(timed_runs):
         carry = carry_init(compiled)  # fresh carry (the donated one is gone)
+        if ptabs is not None:
+            carry = carry._replace(sa_lock=ptabs.sa_lock_init)
         t0 = time.perf_counter()
         choices, cs, counts = one_pass(carry)
         warm_times.append(time.perf_counter() - t0)
@@ -455,6 +627,10 @@ def measure_config(name: str, snapshot, pods, platform: str,
         "load1": round(load1, 2),
         "metrics": _metrics_snapshot(reset=True),
     }
+    if fast_probe is not None:
+        result["fast_eligible"] = fast_probe[0] is not None
+        if fast_probe[0] is None:
+            result["fast_ineligible_why"] = fast_probe[1]
     if drift:
         result["error"] = "checksum drift across timed runs; rate unreliable"
 
@@ -590,7 +766,21 @@ def run_child(platform: str, ladder: bool, phases: bool = False) -> None:
     small["note"] = "staged small run; full-size run follows"
     print(json.dumps(small), flush=True)
 
-    # stage 2: the headline config — >=5 warm runs for a variance envelope
+    # stage 2: the policy-residue config at the same full-size shape
+    # (ISSUE 4): every driver capture carries fast-path eligibility
+    # evidence for the policy features (plan-level on CPU; on TPU the dual
+    # measurement also emits the measured "(pallas)" record). Runs before
+    # the headline so the parent's last-JSON-line summary stays the
+    # round-comparable headline config.
+    psnap, ppods = policy_residue_workload(num_pods, num_nodes)
+    pol = measure_config(
+        f"{num_pods // 1000}k Zipf pods, {num_nodes} nodes, policy residue "
+        "(labels+ServiceAffinity+ImageLocality)",
+        psnap, ppods, real_platform, baseline_pods, chunk,
+        policy=POLICY_RESIDUE)
+    print(json.dumps(pol), flush=True)
+
+    # final stage: the headline config — >=5 warm runs for a variance envelope
     snapshot, pods = build_workload(num_pods, num_nodes)
     result = measure_config(
         f"{num_pods // 1000}k Zipf pods, {num_nodes} heterogeneous nodes",
@@ -604,14 +794,14 @@ def _ladder_configs() -> set:
     without repeating the whole ladder). Called in the PARENT before any
     child spawns: a typo'd knob must fail instantly, not burn the full
     retry ladder (each child pays backend init) producing "no JSON line"."""
-    raw = os.environ.get("TPUSIM_BENCH_LADDER_CONFIGS", "1,2,3,4,5,6")
+    raw = os.environ.get("TPUSIM_BENCH_LADDER_CONFIGS", "1,2,3,4,5,6,7")
     try:
         wanted = {int(c) for c in raw.split(",") if c.strip()}
     except ValueError:
         wanted = set()
-    if not wanted or not wanted <= {1, 2, 3, 4, 5, 6}:
+    if not wanted or not wanted <= {1, 2, 3, 4, 5, 6, 7}:
         raise SystemExit(
-            f"TPUSIM_BENCH_LADDER_CONFIGS={raw!r}: need values in 1-6")
+            f"TPUSIM_BENCH_LADDER_CONFIGS={raw!r}: need values in 1-7")
     return wanted
 
 
@@ -717,6 +907,21 @@ def run_ladder(platform: str, baseline_pods: int, chunk: int) -> None:
 
     if 6 in wanted:
         results.append(measure_preemption(platform, baseline_pods))
+        print(json.dumps(results[-1]), flush=True)
+
+    if 7 in wanted:
+        # 7. policy residue (ISSUE 4): label rows + ServiceAffinity +
+        # ImageLocality on the 10k-node snapshot. Eligibility is probed on
+        # every platform; the measured "(pallas)" record lands via the
+        # dual measurement on TPU.
+        p7, n7 = ((200_000, 10_000) if platform != "cpu"
+                  else _cpu_sized_workload())
+        snapshot, pods = policy_residue_workload(p7, n7)
+        results.append(measure_config(
+            f"config 7: {p7 // 1000}k Zipf pods, {n7} nodes, policy residue "
+            "(labels+ServiceAffinity+ImageLocality)",
+            snapshot, pods, platform, baseline_pods, chunk,
+            policy=POLICY_RESIDUE))
         print(json.dumps(results[-1]), flush=True)
 
 
@@ -1206,7 +1411,7 @@ def run_watchdogged(cmd, stall_timeout: float, total_timeout: float,
 
 # the ladder subset a healthy accelerator promotes the default run to
 # (VERDICT r3 item 1: the north-star shapes)
-AUTOLADDER_DEFAULT_CONFIGS = "3,4,5,6"
+AUTOLADDER_DEFAULT_CONFIGS = "3,4,5,6,7"
 
 
 def pick_headline(json_lines):

@@ -60,3 +60,16 @@ def pytest_collection_modifyitems(config, items):
             mod = mod[:-3]
         if mod in SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_fast_auto():
+    """Reset the jaxe backend's process-wide trust state (fast-path AUTO
+    flags, victim-kernel trust, chaos breaker seam) around every test: a
+    test tripping the transient/verify path must not flip fast-path
+    eligibility for the rest of the session (ISSUE 4 satellite)."""
+    from tpusim.jaxe.backend import reset_fast_auto
+
+    reset_fast_auto()
+    yield
+    reset_fast_auto()

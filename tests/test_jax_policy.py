@@ -800,3 +800,194 @@ def test_policy_custom_arg_under_alias_name_keeps_its_own_key():
     # presence=True on 'disktype': only the ssd-labeled nodes qualify
     assert all(p.spec.node_name in ("n0", "n2", "n4")
                for p in status.successful_pods)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 4 — policy residue closed: every non-extender compat policy is
+# Pallas fast-path eligible, and the fast route is byte-identical. The
+# cheap legs (planning-only eligibility, fallback observability) run in
+# tier-1; the full end-to-end interpreter matrix is the slow sweep in
+# test_fuzz_differential.py.
+# ---------------------------------------------------------------------------
+
+import json
+import os
+
+COMPAT_FIXTURE = os.path.join(os.path.dirname(__file__),
+                              "compat_policies.json")
+with open(COMPAT_FIXTURE) as _f:
+    COMPAT_POLICIES = json.load(_f)
+
+
+def compat_cluster():
+    """A cluster exercising every residue feature the compat policies use:
+    region/zone/foo/bar labels (ServiceAffinity + presence rows + NodeLabel
+    priority), a service + labeled running pods (spreading / SAA / SA
+    first-pod locks), and node images (ImageLocality)."""
+    from tpusim.api.types import ContainerImage, Service
+
+    MB = 1024 * 1024
+    nodes = []
+    for i in range(9):
+        labels = {"region": f"r{i % 2}", "zone": f"z{i % 3}"}
+        if i % 3 != 2:
+            labels["foo"] = "x"
+        if i % 2 == 0:
+            labels["bar"] = "y"
+        node = make_node(f"n{i}", milli_cpu=[2000, 4000, 8000][i % 3],
+                         memory=16 * 1024**3, labels=labels)
+        if i % 2 == 1:
+            node.status.images = [ContainerImage(names=[f"img-{i % 3}:v1"],
+                                                 size_bytes=400 * MB)]
+        nodes.append(node)
+    services = [Service.from_obj({
+        "metadata": {"name": "svc0", "namespace": "default"},
+        "spec": {"selector": {"app": "app0"}}})]
+    placed = [make_pod(f"placed-{i}", milli_cpu=200, memory=128 * MB,
+                       node_name=f"n{i % 9}", phase="Running",
+                       labels={"app": f"app{i % 2}"}) for i in range(4)]
+    return ClusterSnapshot(nodes=nodes, pods=placed, services=services)
+
+
+def compat_workload(k=70):
+    MB = 1024 * 1024
+    pods = []
+    for i in range(k):
+        kw = {}
+        if i % 5 == 0:
+            kw["node_selector"] = {"region": f"r{i % 2}"}
+        p = make_pod(f"pod-{i}", milli_cpu=[100, 400, 900][i % 3],
+                     memory=[64, 256][i % 2] * MB,
+                     labels={"app": f"app{i % 2}"} if i % 3 else None, **kw)
+        if i % 4 == 0:
+            p.spec.containers[0].image = f"img-{i % 3}:v1"
+        pods.append(p)
+    return pods
+
+
+def _compat_plan(version, snapshot, pods):
+    """Mirror the backend's planning flow for one compat policy; returns
+    (plan, why) from plan_fast without running any kernel."""
+    from dataclasses import replace as _dc_replace
+
+    from tpusim.engine.policy import decode_policy
+    from tpusim.engine.predicates import (
+        POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+    )
+    from tpusim.jaxe.fastscan import plan_fast
+    from tpusim.jaxe.kernels import config_for
+    from tpusim.jaxe.policyc import build_policy_tables
+    from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster
+
+    cp = compile_policy(decode_policy(COMPAT_POLICIES[version]))
+    assert not cp.unsupported, cp.unsupported
+    need_noexec = (cp.spec.pred_keys is not None
+                   and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
+                   in cp.spec.pred_keys)
+    need_saa = bool(cp.spec.saa_weights) or cp.spec.sa_enabled
+    compiled, cols = compile_cluster(snapshot, pods, need_noexec=need_noexec,
+                                     need_saa=need_saa)
+    assert not compiled.unsupported
+    config = config_for(
+        [compiled], most_requested=False,
+        num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
+    config = _dc_replace(config, policy=cp.spec)
+    ptabs = build_policy_tables(cp, snapshot, pods, compiled, cols)
+    if cp.saa_entries:
+        config = _dc_replace(config, n_saa_doms=ptabs.n_saa_doms)
+    return plan_fast(config, compiled, cols, ptabs=ptabs)
+
+
+def test_compat_policies_all_fast_path_eligible():
+    """The ROADMAP item-4 done condition, planning leg: plan_fast returns a
+    plan (no `policy:` blocker) for EVERY non-extender policy in
+    compat_policies.json. Planning is host-only — no kernel compiles — so
+    the whole matrix fits in tier-1."""
+    snapshot = compat_cluster()
+    pods = compat_workload()
+    for version in sorted(COMPAT_POLICIES):
+        plan, why = _compat_plan(version, snapshot, pods)
+        assert plan is not None, f"policy {version} ineligible: {why}"
+        assert plan.policy is not None
+
+
+def test_compat_policy_fast_parity_smoke(monkeypatch):
+    """One end-to-end residue policy (1.1: ServiceAffinity + SAA + label
+    presence rows + NodeLabel priority) through the Pallas kernel in
+    interpreter mode: byte-identical to the reference engine, with the
+    kernel actually engaging and zero fast-path fallbacks recorded."""
+    from tpusim.engine.policy import decode_policy
+    from tpusim.framework.metrics import register
+    from tpusim.jaxe import fastscan
+
+    snapshot = compat_cluster()
+    pods = compat_workload()
+    policy = decode_policy(COMPAT_POLICIES["1.1"])
+    ref = run_simulation(list(pods), snapshot, backend="reference",
+                         policy=policy)
+    monkeypatch.setenv("TPUSIM_FAST", "1")
+    monkeypatch.setenv("TPUSIM_FAST_INTERPRET", "1")
+    runs = []
+    real_fast_scan = fastscan.fast_scan
+    monkeypatch.setattr(
+        fastscan, "fast_scan",
+        lambda plan, **kw: runs.append(1) or real_fast_scan(plan, **kw))
+    fallback = register().fast_fallback
+    before = dict(fallback.values)
+    jx = run_simulation(list(pods), snapshot, backend="jax", policy=policy)
+    assert runs, "pallas fast path did not engage"
+    assert fallback.values == before, \
+        f"unexpected fast-path fallbacks: {fallback.values}"
+    assert sig(jx) == sig(ref)
+
+
+def test_fast_fallback_counter_classifies_blockers(monkeypatch):
+    """The observability satellite: a plan_fast rejection lands in
+    tpusim_fast_fallback_total under a low-cardinality blocker class, and
+    the flight recorder gets a fallback: instant."""
+    from tpusim.engine.policy import decode_policy
+    from tpusim.framework.metrics import register
+    from tpusim.jaxe.backend import _fast_fallback_key
+
+    # key classification covers every plan_fast reason family
+    assert _fast_fallback_key(
+        "3 ServiceAffinity lock segments exceed the fast-path budget "
+        "(16; TPUSIM_FAST_MAX_SA_SEGS)") == "sa_segs_budget"
+    assert _fast_fallback_key("NoExecute taint table not compiled") \
+        == "tables_not_compiled"
+    assert _fast_fallback_key("something new") == "other"
+
+    # end-to-end: choke the SA budget so a residue policy falls back, and
+    # assert the counter moved under the classified key
+    monkeypatch.setenv("TPUSIM_FAST", "1")
+    monkeypatch.setenv("TPUSIM_FAST_INTERPRET", "1")
+    monkeypatch.setenv("TPUSIM_FAST_MAX_SA_SEGS", "0")
+    fallback = register().fast_fallback
+    before = fallback.get("sa_segs_budget")
+    policy = decode_policy(COMPAT_POLICIES["1.1"])
+    snapshot = compat_cluster()
+    pods = compat_workload(12)
+    ref = run_simulation(list(pods), snapshot, backend="reference",
+                         policy=policy)
+    jx = run_simulation(list(pods), snapshot, backend="jax", policy=policy)
+    assert sig(jx) == sig(ref)  # the XLA fallback stays byte-identical
+    assert fallback.get("sa_segs_budget") >= before + 1
+
+
+def test_reset_fast_auto_restores_boot_state():
+    """The test-isolation satellite: reset_fast_auto clears the process-wide
+    trust/breaker state the autouse conftest fixture depends on."""
+    from tpusim.jaxe import backend
+
+    backend._FAST_AUTO["disabled"] = True
+    backend._FAST_AUTO["verified_sigs"].add(("sig",))
+    backend._FAST_AUTO["transient"] = 2
+    backend._VICTIM_AUTO["disabled"] = True
+    backend._VICTIM_AUTO["verified_sigs"].add(("v",))
+    backend.reset_fast_auto()
+    assert backend._FAST_AUTO == {"disabled": False, "verified_sigs": set(),
+                                  "transient": 0}
+    assert backend._VICTIM_AUTO == {"disabled": False,
+                                    "verified_sigs": set()}
+    assert backend._CHAOS == {"injector": None, "breaker": None,
+                              "verify": "all"}

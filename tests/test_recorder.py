@@ -226,7 +226,7 @@ class TestAutoTransitions:
         from tpusim.jaxe import backend as jb
 
         monkeypatch.setattr("tpusim.jaxe.fastscan.verify_against_xla",
-                            lambda *a: True)
+                            lambda *a, **kw: True)
         cols = types.SimpleNamespace(req_cpu=np.zeros(128))
         sig = ("variant", 0)
         assert jb._auto_verify_and_pin(None, None, cols, None, None, sig)
@@ -244,7 +244,7 @@ class TestAutoTransitions:
         from tpusim.jaxe import backend as jb
 
         monkeypatch.setattr("tpusim.jaxe.fastscan.verify_against_xla",
-                            lambda *a: False)
+                            lambda *a, **kw: False)
         cols = types.SimpleNamespace(req_cpu=np.zeros(128))
         assert not jb._auto_verify_and_pin(None, None, cols, None, None,
                                            ("v", 1))

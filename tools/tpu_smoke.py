@@ -7,7 +7,10 @@ The capture runbook (tools/tpu_capture.sh) runs this FIRST: even a
 ~2-minute healthy tunnel window then certifies that every Pallas
 kernel-variant class — base scan, MostRequested scoring, host-ports,
 disk-conflict, selector-spreading, volume-zone, inter-pod affinity,
-max-PD volume counts — actually lowers through Mosaic and agrees with
+max-PD volume counts, and the policy-residue classes (label-presence
+rows + NodeLabel preference, ServiceAffinity first-pod locks,
+ImageLocality, NoExecute-taint predicate, alwaysCheckAllPredicates
+count-mode) — actually lowers through Mosaic and agrees with
 the XLA scan bit-for-bit, plus that the preemption victim-selection
 kernel (jaxe/preempt.py) byte-matches the host oracle. Shapes are tiny
 (<=8 nodes, <=24 pods) so the whole sweep compiles and runs in well
@@ -46,6 +49,7 @@ from tpusim.api.snapshot import (  # noqa: E402
 )
 from tpusim.api.types import (  # noqa: E402
     LABEL_ZONE_FAILURE_DOMAIN,
+    ContainerImage,
     ContainerPort,
     Service,
 )
@@ -184,6 +188,120 @@ def _maxpd():
     return ClusterSnapshot(nodes=nodes, pods=existing), pods
 
 
+# --- policy-residue variant classes (ISSUE 4): one tiny workload per
+# residue family the fused scan absorbed; builders return a third element
+# (the policy-as-data dict) and run_pallas_variant compiles it like the
+# backend does -------------------------------------------------------------
+
+
+def _pol(preds, prios, **extra):
+    d = {"kind": "Policy", "apiVersion": "v1",
+         "predicates": preds, "priorities": prios}
+    d.update(extra)
+    return d
+
+
+def _residue_nodes(n=6):
+    nodes = []
+    for i in range(n):
+        labels = {"region": f"r{i % 2}", "zone": f"z{i % 3}"}
+        if i % 3 != 2:
+            labels["foo"] = "x"
+        if i % 2 == 0:
+            labels["bar"] = "y"
+        node = make_node(f"n{i}", milli_cpu=(800, 1600, 3200)[i % 3],
+                         memory=(2 + i % 3) * 2**30, labels=labels)
+        if i % 2 == 1:
+            node.status.images = [ContainerImage(
+                names=[f"img-{i % 3}:v1"], size_bytes=400 * 1024**2)]
+        nodes.append(node)
+    return nodes
+
+
+def _pol_labels():
+    """Label-presence predicate rows + NodeLabel preference."""
+    pods = [make_pod(f"p{i}", milli_cpu=(1 + i % 4) * 150, memory=2**27)
+            for i in range(10)]
+    return ClusterSnapshot(nodes=_residue_nodes()), pods, _pol(
+        [{"name": "PodFitsResources"},
+         {"name": "TestLabelsPresence",
+          "argument": {"labelsPresence": {"labels": ["foo"],
+                                          "presence": True}}}],
+        [{"name": "LeastRequestedPriority", "weight": 1},
+         {"name": "TestLabelPreference", "weight": 2,
+          "argument": {"labelPreference": {"label": "bar",
+                                           "presence": True}}}])
+
+
+def _pol_service_affinity():
+    """ServiceAffinity region locks: one service pre-bound by a running
+    pod, one binding its first-pod lock inside the scan."""
+    nodes = _residue_nodes()
+    placed = [make_pod("seed", milli_cpu=100, memory=2**26, node_name="n0",
+                       phase="Running", labels={"app": "api"})]
+    snap = ClusterSnapshot(nodes=nodes, pods=placed,
+                           services=[_service("api", {"app": "api"}),
+                                     _service("web", {"app": "web"})])
+    pods = [make_pod(f"p{i}", milli_cpu=150, memory=2**26,
+                     labels={"app": ("api", "web")[i % 2]})
+            for i in range(8)]
+    return snap, pods, _pol(
+        [{"name": "PodFitsResources"},
+         {"name": "TestServiceAffinity",
+          "argument": {"serviceAffinity": {"labels": ["region"]}}}],
+        [{"name": "LeastRequestedPriority", "weight": 1}])
+
+
+def _pol_image():
+    """ImageLocality via the signature-table streaming path."""
+    pods = []
+    for i in range(9):
+        p = make_pod(f"p{i}", milli_cpu=150, memory=2**26)
+        if i % 2 == 0:
+            p.spec.containers[0].image = f"img-{i % 3}:v1"
+        pods.append(p)
+    return ClusterSnapshot(nodes=_residue_nodes()), pods, _pol(
+        [{"name": "PodFitsResources"}],
+        [{"name": "ImageLocalityPriority", "weight": 2},
+         {"name": "LeastRequestedPriority", "weight": 1}])
+
+
+def _pol_noexec():
+    """NoExecute-only taint predicate (policy-registered variant)."""
+    nodes = [make_node(f"n{i}", milli_cpu=(800, 1600, 3200)[i % 3],
+                       memory=(2 + i % 3) * 2**30,
+                       labels={"zone": f"z{i % 3}"},
+                       taints=[{"key": "evict", "value": "now",
+                                "effect": "NoExecute"}] if i % 3 == 0
+                       else None) for i in range(6)]
+    pods = []
+    for i in range(8):
+        kw = {}
+        if i % 2 == 0:
+            kw["tolerations"] = [{"key": "evict", "operator": "Equal",
+                                  "value": "now", "effect": "NoExecute"}]
+        pods.append(make_pod(f"p{i}", milli_cpu=150, memory=2**26, **kw))
+    return ClusterSnapshot(nodes=nodes), pods, _pol(
+        [{"name": "PodFitsResources"},
+         {"name": "PodToleratesNodeNoExecuteTaints"}],
+        [{"name": "LeastRequestedPriority", "weight": 1}])
+
+
+def _pol_count_mode():
+    """alwaysCheckAllPredicates: per-stage failure bits stay live past the
+    first miss (pods failing resources AND the presence row)."""
+    snap, pods, _ = _pol_labels()
+    pods = pods + [make_pod(f"big{i}", milli_cpu=50_000, memory=2**27)
+                   for i in range(3)]
+    return snap, pods, _pol(
+        [{"name": "PodFitsResources"},
+         {"name": "TestLabelsPresence",
+          "argument": {"labelsPresence": {"labels": ["foo"],
+                                          "presence": True}}}],
+        [{"name": "LeastRequestedPriority", "weight": 1}],
+        alwaysCheckAllPredicates=True)
+
+
 PALLAS_VARIANTS = [
     # (name, workload builder, most_requested)
     ("base", _base, False),
@@ -194,24 +312,66 @@ PALLAS_VARIANTS = [
     ("vol_zone", _vol_zone, False),
     ("interpod", _interpod, False),
     ("maxpd", _maxpd, False),
+    ("pol_labels", _pol_labels, False),
+    ("pol_service_affinity", _pol_service_affinity, False),
+    ("pol_image", _pol_image, False),
+    ("pol_noexec", _pol_noexec, False),
+    ("pol_count_mode", _pol_count_mode, False),
 ]
 
 
 def run_pallas_variant(name, build, most_requested):
     """Pallas fast path vs the XLA scan, bit-for-bit, on one tiny batch."""
-    snapshot, pods = build()
-    compiled, cols = compile_cluster(snapshot, pods)
+    built = build()
+    snapshot, pods = built[:2]
+    policy = built[2] if len(built) > 2 else None
+    cp = ptabs = None
+    if policy is not None:
+        from dataclasses import replace as _dc_replace
+
+        from tpusim.engine.policy import decode_policy
+        from tpusim.engine.predicates import (
+            POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+        )
+        from tpusim.jaxe.policyc import build_policy_tables, compile_policy
+
+        cp = compile_policy(decode_policy(policy))
+        assert not cp.unsupported, (name, cp.unsupported)
+        need_noexec = (cp.spec.pred_keys is not None
+                       and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
+                       in cp.spec.pred_keys)
+        need_saa = bool(cp.spec.saa_weights) or cp.spec.sa_enabled
+        compiled, cols = compile_cluster(snapshot, pods,
+                                         need_noexec=need_noexec,
+                                         need_saa=need_saa)
+    else:
+        compiled, cols = compile_cluster(snapshot, pods)
     assert not compiled.unsupported, (name, compiled.unsupported)
     config = config_for(
         [compiled], most_requested=most_requested,
         num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names))
-    plan, reason = plan_fast(config, compiled, cols)
+    if cp is not None:
+        config = _dc_replace(config, policy=cp.spec)
+        ptabs = build_policy_tables(cp, snapshot, pods, compiled, cols)
+        if cp.saa_entries:
+            config = _dc_replace(config, n_saa_doms=ptabs.n_saa_doms)
+    plan, reason = plan_fast(config, compiled, cols, ptabs=ptabs)
     if plan is None:
         raise AssertionError(f"variant {name} ineligible for the fast "
                              f"path: {reason}")
+    if cp is not None:
+        from tpusim.jaxe.kernels import _tree_to_device, statics_to_host
+
+        statics = _tree_to_device(statics_to_host(compiled)._replace(
+            label_ok=ptabs.label_ok, label_prio=ptabs.label_prio,
+            image_score=ptabs.image_score, saa_dom=ptabs.saa_dom,
+            sa_pin=ptabs.sa_pin, sa_val=ptabs.sa_val))
+        carry = carry_init(compiled)._replace(sa_lock=ptabs.sa_lock_init)
+    else:
+        statics = statics_to_device(compiled)
+        carry = carry_init(compiled)
     _, choices, counts, advanced = schedule_scan(
-        config, carry_init(compiled), statics_to_device(compiled),
-        pod_columns_to_device(cols))
+        config, carry, statics, pod_columns_to_device(cols))
     f_choices, f_counts, f_adv = fast_scan(plan, chunk=16)
     choices, counts = np.asarray(choices), np.asarray(counts)
     if not np.array_equal(f_choices, choices):

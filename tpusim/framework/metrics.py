@@ -203,6 +203,9 @@ class SchedulerMetrics:
         self.backend_victim_path = self._reg(LabeledCounter(
             "tpusim_backend_victim_path_total",
             "Preemption victim-selection path per attempt", "path"))
+        self.fast_fallback = self._reg(LabeledCounter(
+            "tpusim_fast_fallback_total",
+            "Pallas fast-path plan rejections by blocker class", "reason"))
         # chaos-engine telemetry (ISSUE 3): injected faults by kind, watch
         # buffer overflows by resource, and the dispatch circuit breaker
         self.fault_injected = self._reg(LabeledCounter(

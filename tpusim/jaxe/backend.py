@@ -75,6 +75,63 @@ _TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "OOM",
 _MAX_TRANSIENT_FAILURES = 3
 
 
+def reset_fast_auto() -> None:
+    """Reset every process-wide fast-path/victim-kernel trust flag to its
+    boot state. Test isolation ONLY: a test that trips the transient path or
+    a verify failure would otherwise leak `disabled`/pinned-signature state
+    into every later test in the process (ordering could then flip fast-path
+    eligibility mid-session). Wired as an autouse fixture in
+    tests/conftest.py; production code never calls it."""
+    _FAST_AUTO["disabled"] = False
+    _FAST_AUTO["verified_sigs"] = set()
+    _FAST_AUTO["transient"] = 0
+    _VICTIM_AUTO["disabled"] = False
+    _VICTIM_AUTO["verified_sigs"] = set()
+    # disarm any leftover chaos seam (breaker + injector) the same way
+    uninstall_chaos()
+
+
+# plan_fast ineligibility reasons, classified into low-cardinality counter
+# keys (the raw strings embed counts/budgets and would explode the label
+# space); ordered — first substring match wins
+_FALLBACK_KEYS = (
+    ("policy static tables unavailable", "policy_tables_missing"),
+    ("not compiled", "tables_not_compiled"),
+    ("ServiceAffinity lock segments", "sa_segs_budget"),
+    ("ServiceAffinity entry labels", "sa_segs_budget"),
+    ("ServiceAntiAffinity label domains", "saa_doms_budget"),
+    ("ServiceAntiAffinity spread counts", "saa_int32"),
+    ("negative", "negative_scores"),
+    ("pod groups exceed", "groups_budget"),
+    ("zone domains exceed", "zones_budget"),
+    ("topology keys exceed", "interpod_budget"),
+    ("topology domains exceed", "interpod_budget"),
+    ("inter-pod terms exceed", "interpod_budget"),
+    ("inter-pod priority counts", "interpod_int32"),
+    ("non-integral preferred inter-pod", "interpod_weights"),
+    ("MaxPD volume ids", "maxpd_budget"),
+    ("scalar resource kinds", "reason_bits_budget"),
+    ("priority weights exceed", "score_int32"),
+    ("int32", "int32_overflow"),
+)
+
+
+def _fast_fallback_key(why: str) -> str:
+    for marker, key in _FALLBACK_KEYS:
+        if marker in why:
+            return key
+    return "other"
+
+
+def _note_fast_fallback(metrics, why: str) -> None:
+    """Surface a plan_fast rejection as observability (ISSUE 4 satellite):
+    a labeled counter keyed by blocker class plus a flight-recorder instant
+    carrying the full reason string."""
+    key = _fast_fallback_key(why)
+    metrics.fast_fallback.inc(key)
+    flight.note_fast_fallback(key, why)
+
+
 def plan_signature(plan) -> tuple:
     """The kernel-variant key for AUTO-mode trust: mirrors the _build_call
     cache key's semantic axes (node padding, feature flags, scalar/group
@@ -98,8 +155,12 @@ def plan_signature(plan) -> tuple:
         sig += (plan.n_vols, plan.vol_type3, plan.maxpd_limits,
                 plan.maxpd_enabled)
     if plan.policy is not None:
-        # the whole PolicySpec (hashable) is baked into the variant
-        sig += (plan.policy,)
+        # the whole PolicySpec (hashable) is baked into the variant, plus
+        # the policy-residue table dims/flags (PolConst rides the
+        # _build_call cache key the same way)
+        from tpusim.jaxe.fastscan import pol_const_of
+
+        sig += (plan.policy, pol_const_of(plan))
     return sig
 
 
@@ -131,7 +192,8 @@ def _note_fast_failure(exc: Exception) -> None:
 
 
 def _auto_verify_and_pin(config, compiled, cols, choices, counts,
-                         sig: tuple, limit: int = None) -> bool:
+                         sig: tuple, limit: int = None,
+                         statics=None, carry=None) -> bool:
     """AUTO-mode guardrail (shared by run_batch and the what-if fast loop):
     replay the leading pods through the XLA scan and compare bit-for-bit.
     Returns True when the fast results may be used; on disagreement the
@@ -145,7 +207,8 @@ def _auto_verify_and_pin(config, compiled, cols, choices, counts,
         # the caller produced fewer rows than the full batch (the
         # preemption hybrid verifies on its first speculation chunk)
         m = min(m, limit)
-    if not verify_against_xla(config, compiled, cols, choices, counts, m):
+    if not verify_against_xla(config, compiled, cols, choices, counts, m,
+                              statics=statics, carry=carry):
         _FAST_AUTO["disabled"] = True
         flight.note_auto_transition("verify_fail", str(sig))
         log.warning("pallas fast path DISAGREES with the XLA scan on the "
@@ -448,16 +511,21 @@ class JaxBackend:
             most_requested=self.provider in _MOST_REQUESTED_PROVIDERS,
             num_reason_bits=num_bits,
             hard_weight=hard_weight)
+        ptabs = None
         if cp is not None:
             from dataclasses import replace as _dc_replace
 
-            config = _dc_replace(config, policy=cp.spec)
-            if cp.saa_entries:
-                from tpusim.jaxe.policyc import saa_dom_rows
+            from tpusim.jaxe.policyc import build_policy_tables
 
-                saa_dom, n_saa_doms = saa_dom_rows(cp, snapshot.nodes,
-                                                   compiled.node_index)
-                config = _dc_replace(config, n_saa_doms=n_saa_doms)
+            config = _dc_replace(config, policy=cp.spec)
+            # one host-side table build feeds BOTH device routes: plan_fast
+            # bakes these into the Pallas plan, the XLA branch grafts them
+            # onto the trivial statics rows below (also fills cols.img_id /
+            # cols.sa_self_id in place), so the two engines cannot drift on
+            # their inputs
+            ptabs = build_policy_tables(cp, snapshot, pods, compiled, cols)
+            if cp.saa_entries:
+                config = _dc_replace(config, n_saa_doms=ptabs.n_saa_doms)
 
         ensure_x64()
         # fast-path decision BEFORE any device upload: when the Pallas plan
@@ -481,8 +549,9 @@ class JaxBackend:
         if fast_on:
             from tpusim.jaxe.fastscan import plan_fast
 
-            fplan, why = plan_fast(config, compiled, cols)
+            fplan, why = plan_fast(config, compiled, cols, ptabs=ptabs)
             if fplan is None:
+                _note_fast_fallback(metrics, why)
                 log.info("pallas fast path ineligible (%s); using the "
                          "XLA scan", why)
             else:
@@ -502,42 +571,28 @@ class JaxBackend:
                 log.info("pallas fast path deferred: %d pods is below "
                          "the self-verification threshold; using the "
                          "XLA scan", len(pods))
-        sa_lock_init = None
-        if fplan is not None:
-            statics = None
-        elif cp is None:
-            statics = statics_to_device(compiled)
-        else:
+        def _xla_statics():
+            if cp is None:
+                return statics_to_device(compiled)
             # overwrite the trivial custom-plugin rows with the policy's
-            # per-node tables (ordering by the compiled node index)
+            # per-node tables (ordering by the compiled node index); the
+            # trivial PolicyTables shapes match statics_to_host exactly, so
+            # the unconditional replace is byte-identical for policies
+            # without the corresponding feature
             from tpusim.jaxe.kernels import _tree_to_device, statics_to_host
-            from tpusim.jaxe.policyc import (
-                image_locality_columns,
-                policy_static_rows,
-            )
 
-            label_ok, label_prio = policy_static_rows(
-                cp, snapshot.nodes, compiled.node_index)
-            host_statics = statics_to_host(compiled)._replace(
-                label_ok=label_ok, label_prio=label_prio)
-            if cp.spec.w_image:
-                # ImageLocality rides an interned pod-image signature table;
-                # the pod column is filled here (state leaves it zeroed)
-                cols.img_id, image_score = image_locality_columns(
-                    pods, snapshot.nodes, compiled.node_index)
-                host_statics = host_statics._replace(image_score=image_score)
-            if cp.saa_entries:
-                host_statics = host_statics._replace(saa_dom=saa_dom)
-            if cp.spec.sa_enabled:
-                from tpusim.jaxe.policyc import service_affinity_columns
+            return _tree_to_device(statics_to_host(compiled)._replace(
+                label_ok=ptabs.label_ok, label_prio=ptabs.label_prio,
+                image_score=ptabs.image_score, saa_dom=ptabs.saa_dom,
+                sa_pin=ptabs.sa_pin, sa_val=ptabs.sa_val))
 
-                (cols.sa_self_id, sa_pin, sa_val,
-                 sa_lock_init) = service_affinity_columns(
-                    cp, pods, snapshot, compiled.node_index,
-                    compiled.groups.saa_defs)
-                host_statics = host_statics._replace(
-                    sa_pin=sa_pin, sa_val=sa_val)
-            statics = _tree_to_device(host_statics)
+        def _xla_carry():
+            carry = carry_init(compiled)
+            if cp is not None and cp.spec.sa_enabled:
+                carry = carry._replace(sa_lock=ptabs.sa_lock_init)
+            return carry
+
+        statics = None if fplan is not None else _xla_statics()
         # Batches beyond TPUSIM_SCAN_CHUNK pods run through the
         # double-buffered chunked scan: pod columns stay host-side and stream
         # to HBM chunk by chunk, bit-identical to the single dispatch
@@ -546,9 +601,7 @@ class JaxBackend:
         use_chunks = (fplan is None
                       and scan_chunk > 0 and len(pods) > scan_chunk)
         if fplan is None:
-            carry = carry_init(compiled)
-            if sa_lock_init is not None:
-                carry = carry._replace(sa_lock=sa_lock_init)
+            carry = _xla_carry()
             xs = (pod_columns_to_host(cols) if use_chunks
                   else pod_columns_to_device(cols))
         # On TPU the per-pod filter→score→select→bind pipeline is one fused
@@ -572,8 +625,8 @@ class JaxBackend:
             # caller's call (_note_fast_failure / _auto_verify_and_pin)
             nonlocal fplan, statics, carry, use_chunks, xs, dispatch_start
             fplan = None
-            statics = statics_to_device(compiled)
-            carry = carry_init(compiled)
+            statics = _xla_statics()
+            carry = _xla_carry()
             use_chunks = scan_chunk > 0 and len(pods) > scan_chunk
             xs = (pod_columns_to_host(cols) if use_chunks
                   else pod_columns_to_device(cols))
@@ -600,7 +653,8 @@ class JaxBackend:
             else:
                 _FAST_AUTO["transient"] = 0
                 if fast_verify and not _auto_verify_and_pin(
-                        config, compiled, cols, choices, counts, fast_sig):
+                        config, compiled, cols, choices, counts, fast_sig,
+                        statics=_xla_statics(), carry=_xla_carry()):
                     # the kernel lowered but miscomputed: the guardrail
                     # already disabled it process-wide; rerun on XLA
                     _discard_fast_path()

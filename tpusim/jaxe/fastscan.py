@@ -31,14 +31,21 @@ Eligibility (checked by `plan_fast`, reasons returned):
   * MaxPD volume counts run natively (round 5): the [N, V] used-volume
     union as a [Vpad, Npad] bit carry with baked type triples/limits,
     bounded by TPUSIM_FAST_MAX_VOLS (32);
-  * statically-gateable POLICIES compile into the kernel (round 5): the
-    PolicySpec (predicate subset incl. individually-named
-    GeneralPredicates parts, priority weights, per-type MaxPD enables,
-    hard weight) is baked into the kernel variant like the interpod
-    constants. Still host/XLA-bound: label-presence rows, label
-    priorities, ServiceAffinity/ServiceAntiAffinity, ImageLocality,
-    alwaysCheckAllPredicates, the NoExecute-only taint predicate, and
-    extenders;
+  * POLICIES compile into the kernel in full (rounds 5-6): the PolicySpec
+    (predicate subset incl. individually-named GeneralPredicates parts,
+    priority weights, per-type MaxPD enables, hard weight) is baked into
+    the kernel variant like the interpod constants, and the round-6
+    residue classes all run natively — label-presence predicate rows and
+    the NoExecute-only taint table as static mask stages at their
+    ordering slots, NodeLabel/LabelPreference priorities as a pre
+    -weighted score row, ImageLocality through the signature-table
+    streaming path, ServiceAntiAffinity via per-pod first-service rows
+    over the presence carry, ServiceAffinity predicates via pin/value
+    label rows plus first-matching-pod lock slots riding the misc carry
+    lanes (bounded by TPUSIM_FAST_MAX_SA_SEGS, default 16), and
+    alwaysCheckAllPredicates count-mode by keeping every stage's failure
+    bits live through the full chain. Only extenders stay host-bound
+    (they call out to HTTP processes — no device analog);
   * every resource quantity reduces exactly to int32: values are divided by
     the per-axis gcd (exact — fractions and fit comparisons are
     unit-invariant) and the reduced values must stay under 2^29, with the
@@ -88,7 +95,11 @@ except Exception:  # pragma: no cover - exercised only on exotic builds
 
 from tpusim.engine.predicates import (
     CHECK_NODE_DISK_PRESSURE_PRED,
+    CHECK_NODE_LABEL_PRESENCE_PRED,
     CHECK_NODE_MEMORY_PRESSURE_PRED,
+    CHECK_NODE_UNSCHEDULABLE_PRED,
+    CHECK_SERVICE_AFFINITY_PRED,
+    CHECK_VOLUME_BINDING_PRED,
     GENERAL_PRED,
     HOSTNAME_PRED,
     MATCH_INTERPOD_AFFINITY_PRED,
@@ -125,7 +136,10 @@ from tpusim.jaxe.state import (
     BIT_INSUFFICIENT_MEMORY,
     BIT_INSUFFICIENT_PODS,
     BIT_MEMORY_PRESSURE,
+    BIT_NODE_LABEL_PRESENCE,
     BIT_NODE_SELECTOR_MISMATCH,
+    BIT_NODE_UNSCHEDULABLE,
+    BIT_SERVICE_AFFINITY,
     BIT_TAINTS_NOT_TOLERATED,
     BIT_VOLUME_ZONE_CONFLICT,
 )
@@ -255,6 +269,27 @@ class FastPlan:
     vol_tbl: Optional[np.ndarray] = None     # [G, Vpad] mask by group id
     vol_type3: Tuple[int, ...] = ()          # [V*3] type bits (EBS,GCE,AZ)
     maxpd_limits: Tuple[int, int, int] = (0, 0, 0)
+    # full policy residue (round 6): label-presence mask rows, the pre
+    # -weighted NodeLabel/LabelPreference priority row, the ImageLocality
+    # signature table, the NoExecute-only taint table, ServiceAntiAffinity
+    # label domains, and the ServiceAffinity pin/value/lock tables. All
+    # int32, node axis padded to Npad; ServiceAffinity locks ride the misc
+    # carry lanes 1..Fd (first-matching-pod node index, -1 unlocked, -2
+    # permanently unpinned).
+    label_tbl: Optional[np.ndarray] = None      # [Lpad8, Npad] 0/1 pass
+    label_prio_row: Optional[np.ndarray] = None  # [1, Npad] pre-weighted
+    image_tbl: Optional[np.ndarray] = None      # [Si, Npad] by img_id
+    img_id: Optional[np.ndarray] = None         # [P] int32
+    noexec_tbl: Optional[np.ndarray] = None     # [Ctol, Npad] by tol_id
+    saa_row: Optional[np.ndarray] = None        # [P, Gpad] first-service row
+    saa_dom_tbl: Optional[np.ndarray] = None    # [Epad8, Npad] label doms
+    n_saa_doms: int = 0                         # unroll bound (incl. dom 0)
+    sa_sig: Optional[np.ndarray] = None         # [P] first-service sig id
+    sa_pin_row: Optional[np.ndarray] = None     # [P, La8] own selector pins
+    sa_match_row: Optional[np.ndarray] = None   # [P, Fd8] bind match bits
+    sa_val_tbl: Optional[np.ndarray] = None     # [Lapad8, Npad] label values
+    sa_lock_init: Optional[np.ndarray] = None   # [Fd] int32 lock seeds
+    sa_la: int = 0                              # real concatenated SA labels
 
 
 @dataclass
@@ -276,6 +311,9 @@ def init_carry(plan: FastPlan, rr: int = 0) -> FastCarry:
     """The carry at the plan's initial cluster state."""
     misc = np.zeros((1, LANES), dtype=np.int32)
     misc[0, 0] = rr
+    if plan.sa_lock_init is not None:
+        # ServiceAffinity first-matching-pod locks ride misc lanes 1..Fd
+        misc[0, 1:1 + len(plan.sa_lock_init)] = plan.sa_lock_init
     return FastCarry(
         rows=[plan.used_cpu, plan.used_mem, plan.used_gpu, plan.used_eph,
               plan.nonzero_cpu, plan.nonzero_mem, plan.pod_count],
@@ -295,6 +333,12 @@ def rearm_carry(plan: FastPlan, compiled, rr: int) -> Optional[FastCarry]:
     the gcds, verified here regardless. Returns None when the refreshed
     state can't be expressed in plan units (caller re-plans or falls back).
     """
+    if plan.sa_lock_init is not None:
+        # ServiceAffinity locks are pod-assignment history the refreshed
+        # cluster tables cannot reproduce; SA policies never reach the
+        # preemption hybrid (policyc forces preemption_class "general"),
+        # so this is a defensive fallback, not a hot path
+        return None
     d = compiled.dynamic
     n = plan.num_nodes
     npad = plan.alloc_cpu.shape[1]
@@ -460,38 +504,61 @@ def placed_pod_values(placed_pods, scalar_names) -> dict:
 
 
 def plan_fast(config: EngineConfig, compiled: CompiledCluster,
-              cols: PodColumns, placed_pods=None
+              cols: PodColumns, placed_pods=None, ptabs=None
               ) -> Tuple[Optional[FastPlan], str]:
     """Build the int32 plan, or (None, reason) when ineligible.
 
     placed_pods: pods already bound in the snapshot (preemption callers) —
     their per-pod request/nonzero values join the gcd reduction so victim
-    deletions keep refreshed aggregates expressible in plan units."""
+    deletions keep refreshed aggregates expressible in plan units.
+
+    ptabs: policyc.PolicyTables with the host-built residue-class arrays
+    (label rows, label priorities, image scores, ServiceAntiAffinity
+    domains, ServiceAffinity pins/values/locks). Required whenever the
+    policy uses any residue class; callers without them (the preemption
+    hybrid compiles policy-free configs) simply stay on the XLA scan."""
     ps = config.policy
-    if ps is not None:
-        # statically-gateable policies compile into the kernel (round 5);
-        # host/XLA-bound policy classes keep the logged fallback
-        blockers = []
-        if ps.label_rows:
-            blockers.append("label-presence predicate rows")
-        if ps.has_label_prio:
-            blockers.append("label priorities")
-        if ps.saa_weights:
-            blockers.append("ServiceAntiAffinity priorities")
-        if ps.sa_enabled or ps.sa_slots:
-            blockers.append("ServiceAffinity predicates")
-        if ps.ports_slots:
-            blockers.append("tail PodFitsPorts alias slots")
-        if ps.w_image:
-            blockers.append("ImageLocalityPriority")
-        if ps.always_check_all:
-            blockers.append("alwaysCheckAllPredicates")
-        if ps.pred_keys is not None \
-                and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED \
-                in ps.pred_keys:
-            blockers.append("NoExecute-only taint predicate")
-        if blockers:
-            return None, "policy: " + "; ".join(blockers)
+    pol_label = ps is not None and bool(ps.label_rows)
+    pol_prio = ps is not None and ps.has_label_prio
+    pol_image = ps is not None and bool(ps.w_image)
+    pol_saa = ps is not None and bool(ps.saa_weights)
+    pol_sa = ps is not None and (ps.sa_enabled or bool(ps.sa_slots))
+    pol_noexec = (ps is not None and ps.pred_keys is not None
+                  and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
+                  in ps.pred_keys)
+    pol_any = (pol_label or pol_prio or pol_image or pol_saa or pol_sa
+               or pol_noexec)
+    if pol_any:
+        # every residue class compiles into the kernel (round 6) — the
+        # remaining rejections are table availability and unroll budgets,
+        # never the feature itself
+        if ptabs is None:
+            return None, ("policy static tables unavailable (caller did "
+                          "not supply them)")
+        if pol_noexec and not compiled.has_noexec_table:
+            return None, "NoExecute taint table not compiled"
+        if (pol_sa or pol_saa) and not compiled.has_saa_table:
+            return None, "ServiceAffinity signature tables not compiled"
+        if pol_sa:
+            fd_real = int(compiled.groups.saa_rows.shape[0])
+            la_real = int(sum(ps.sa_segs))
+            max_sa = int(os.environ.get("TPUSIM_FAST_MAX_SA_SEGS", 16))
+            # lock slots ride misc carry lanes 1..Fd (lane 0 is rr)
+            if fd_real > min(max_sa, LANES - 1):
+                return None, (f"{fd_real} ServiceAffinity lock segments "
+                              f"exceed the fast-path budget "
+                              f"({min(max_sa, LANES - 1)}; "
+                              "TPUSIM_FAST_MAX_SA_SEGS)")
+            if la_real > max_sa:
+                return None, (f"{la_real} ServiceAffinity entry labels "
+                              f"exceed the fast-path budget ({max_sa}; "
+                              "TPUSIM_FAST_MAX_SA_SEGS)")
+        if pol_saa:
+            max_sz = int(os.environ.get("TPUSIM_FAST_MAX_ZONES", 16))
+            if config.n_saa_doms > max_sz:
+                return None, (f"{config.n_saa_doms} ServiceAntiAffinity "
+                              f"label domains exceed the fast-path budget "
+                              f"({max_sz}; TPUSIM_FAST_MAX_ZONES)")
     # maxpd carries a [N, V] per-node volume-id union — beyond the kernel's
     # presence model; every other pod-group feature (ports, disk conflicts,
     # spreading, volume zones, and — round 5 — inter-pod (anti)affinity)
@@ -508,12 +575,16 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     gt = compiled.groups
     group_bound = (config.has_ports or config.has_services
                    or config.has_disk_conflict or config.has_vol_zone
-                   or config.has_interpod or config.has_maxpd)
-    # presence is only read by ports/disk/spread/interpod; a vol-zone-only
-    # workload streams per-pod zone rows (gathered by group id from an HBM
-    # table) and needs neither the presence carry nor the unrolled budget
+                   or config.has_interpod or config.has_maxpd or pol_saa)
+    # presence is only read by ports/disk/spread/interpod/SAA; a vol-zone
+    # -only workload streams per-pod zone rows (gathered by group id from an
+    # HBM table) and needs neither the presence carry nor the unrolled
+    # budget. SAA reads presence but (on service-less clusters) must not
+    # force the bind to UPDATE it — the kernel's presence write mirrors the
+    # XLA gate (ports|services|disk|interpod) separately.
     needs_presence = (config.has_ports or config.has_services
-                      or config.has_disk_conflict or config.has_interpod)
+                      or config.has_disk_conflict or config.has_interpod
+                      or pol_saa)
     num_g = int(gt.presence.shape[0]) if group_bound else 0
     if needs_presence:
         max_g = int(os.environ.get("TPUSIM_FAST_MAX_GROUPS", 32))
@@ -622,10 +693,42 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
     if ps is not None:
         # the weighted sum of 0..MAX_PRIORITY components must stay int32
         # (each component is bounded by MAX_PRIORITY after its normalize;
-        # avoid rides its own table check below via the policy weight)
+        # avoid rides its own table check below via the policy weight).
+        # Residue-class score rows join the mass: label priorities and
+        # image scores are pre-computed host-side, SAA contributes another
+        # 0..MAX_PRIORITY component per entry.
         w_total = (ps.w_least + ps.w_most + ps.w_balanced + ps.w_node_aff
-                   + ps.w_taint + ps.w_spread + ps.w_interpod)
-        if w_total * MAX_PRIORITY >= (1 << 30):
+                   + ps.w_taint + ps.w_spread + ps.w_interpod
+                   + sum(ps.saa_weights))
+        pol_mass = 0
+        if pol_prio:
+            lp64 = np.asarray(ptabs.label_prio, dtype=np.int64)
+            if lp64.size and int(lp64.min(initial=0)) < 0:
+                # the kernel's argmax uses -1 as the infeasible sentinel
+                # (matching the XLA _select); negative scores would
+                # collide with it
+                return None, ("negative label priority scores exceed the "
+                              "fast-path score model")
+            pol_mass += int(lp64.max(initial=0))
+        if pol_image:
+            im64 = np.asarray(ptabs.image_score, dtype=np.int64)
+            if ps.w_image < 0 or (im64.size
+                                  and int(im64.min(initial=0)) < 0):
+                return None, ("negative image-locality scores exceed the "
+                              "fast-path score model")
+            pol_mass += ps.w_image * int(im64.max(initial=0))
+        if pol_saa and ps.saa_weights and min(ps.saa_weights) < 0:
+            return None, ("negative ServiceAntiAffinity weights exceed "
+                          "the fast-path score model")
+        if pol_saa:
+            # the SAA normalize multiplies MAX_PRIORITY by the feasible
+            # matched-pod total before the divide
+            total_pods_saa = (int(gt.presence.sum())
+                              + len(np.asarray(cols.req_cpu)))
+            if MAX_PRIORITY * max(total_pods_saa, 1) >= (1 << 31):
+                return None, ("ServiceAntiAffinity spread counts exceed "
+                              f"int32 ({total_pods_saa} pods)")
+        if w_total * MAX_PRIORITY + pol_mass >= (1 << 30):
             return None, "policy priority weights exceed the int32 budget"
         if ps.w_balanced and 10 * ps.w_balanced * bound_c * bound_m \
                 >= (1 << 31):
@@ -839,6 +942,56 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
             exist_aff_mask=bake(gt.aff_valid & ~gt.aff_empty, ta),
         )
 
+    label_tbl = label_prio_row = image_tbl = img_id_col = noexec_tbl = None
+    saa_row = saa_dom_tbl = None
+    sa_sig = sa_pin_row = sa_match_row = sa_val_tbl = sa_lock_init = None
+    n_saa_doms_p = 0
+    sa_la = 0
+    if pol_any:
+        gid_all = pods(cols.group_id)
+        if pol_label:
+            # whatif axis-unification may pad the shared table wider than
+            # this scenario's policy needs — slice to the spec's own rows
+            lr = np.asarray(ptabs.label_ok)[:len(ps.label_rows)]
+            lpad8 = max(-(-lr.shape[0] // SUBLANES) * SUBLANES, SUBLANES)
+            label_tbl = np.zeros((lpad8, npad), dtype=np.int32)
+            label_tbl[:lr.shape[0], :n] = lr.astype(np.int32)
+        if pol_prio:
+            label_prio_row = node_row(ptabs.label_prio)
+        if pol_image:
+            image_tbl = table_rows(ptabs.image_score)
+            img_id_col = pods(cols.img_id)
+        if pol_noexec:
+            noexec_tbl = table_rows(t.taint_ok_noexec)
+        if pol_saa:
+            n_saa_doms_p = int(config.n_saa_doms)
+            saa_row = np.zeros((len(gid_all), gpad), dtype=np.int32)
+            saa_row[:, :num_g] = \
+                gt.saa_rows[gt.saa_sig[gid_all]].astype(np.int32)
+            ne = len(ps.saa_weights)
+            dom_rows_p = np.asarray(ptabs.saa_dom)[:ne]
+            epad8 = max(-(-ne // SUBLANES) * SUBLANES, SUBLANES)
+            saa_dom_tbl = np.zeros((epad8, npad), dtype=np.int32)
+            saa_dom_tbl[:ne, :n] = dom_rows_p.astype(np.int32)
+        if pol_sa:
+            sa_la = int(sum(ps.sa_segs))
+            fd_real = int(gt.saa_rows.shape[0])
+            fd8 = max(-(-fd_real // SUBLANES) * SUBLANES, SUBLANES)
+            la8 = max(-(-max(sa_la, 1) // SUBLANES) * SUBLANES, SUBLANES)
+            sa_sig = pods(gt.saa_sig[gid_all])
+            pin = np.asarray(ptabs.sa_pin)[pods(cols.sa_self_id)][:, :sa_la]
+            sa_pin_row = np.zeros((len(gid_all), la8), dtype=np.int32)
+            sa_pin_row[:, :sa_la] = pin.astype(np.int32)
+            sa_match_row = np.zeros((len(gid_all), fd8), dtype=np.int32)
+            sa_match_row[:, :fd_real] = \
+                gt.saa_rows[:, gid_all].T.astype(np.int32)
+            lapad8 = max(-(-max(sa_la, 1) // SUBLANES) * SUBLANES, SUBLANES)
+            sa_val_tbl = np.zeros((lapad8, npad), dtype=np.int32)
+            sa_val_tbl[:sa_la, :n] = \
+                np.asarray(ptabs.sa_val)[:sa_la].astype(np.int32)
+            sa_lock_init = np.asarray(ptabs.sa_lock_init,
+                                      dtype=np.int32)[:fd_real]
+
     plan = FastPlan(
         num_nodes=n, num_pods=len(np.asarray(cols.req_cpu)),
         most_requested=config.most_requested, num_scalars=n_scal,
@@ -882,6 +1035,11 @@ def plan_fast(config: EngineConfig, compiled: CompiledCluster,
         maxpd_enabled=mp_enabled, n_vols=n_vols, used_vols=used_vols,
         vol_tbl=vol_tbl, vol_type3=vol_type3, maxpd_limits=mp_limits,
         policy=ps,
+        label_tbl=label_tbl, label_prio_row=label_prio_row,
+        image_tbl=image_tbl, img_id=img_id_col, noexec_tbl=noexec_tbl,
+        saa_row=saa_row, saa_dom_tbl=saa_dom_tbl, n_saa_doms=n_saa_doms_p,
+        sa_sig=sa_sig, sa_pin_row=sa_pin_row, sa_match_row=sa_match_row,
+        sa_val_tbl=sa_val_tbl, sa_lock_init=sa_lock_init, sa_la=sa_la,
     )
     return plan, ""
 
@@ -954,12 +1112,62 @@ def mp_const_of(plan: FastPlan) -> Optional[MpConst]:
                    limits=plan.maxpd_limits, enabled3=plan.maxpd_enabled)
 
 
+@dataclass(frozen=True)
+class PolConst:
+    """Compile-time policy-residue dimensions baked into one kernel variant
+    (round 6). The PolicySpec itself already rides the _build_call cache
+    key; these are the cluster-dependent axis sizes the spec alone cannot
+    name: label-row padding, ServiceAntiAffinity domain rows/unroll bound,
+    and the ServiceAffinity label/lock-slot widths."""
+
+    lpad8: int = 0        # label-presence mask rows (0 = no label input)
+    epad8: int = 0        # ServiceAntiAffinity dom-row padding
+    n_saa_doms: int = 0   # SAA label-domain unroll bound (incl. bucket 0)
+    la: int = 0           # real concatenated ServiceAffinity entry labels
+    la8: int = 0          # SMEM pin-row width
+    fd: int = 0           # ServiceAffinity lock slots (misc lanes 1..fd)
+    fd8: int = 0          # SMEM match-row width
+    lapad8: int = 0       # sa_val table rows
+    has_label: bool = False
+    has_prio: bool = False
+    has_image: bool = False
+    has_noexec: bool = False
+    has_saa: bool = False
+    has_sa: bool = False
+
+
+def pol_const_of(plan: FastPlan) -> Optional[PolConst]:
+    if plan.policy is None:
+        return None
+    has_label = plan.label_tbl is not None
+    has_prio = plan.label_prio_row is not None
+    has_image = plan.image_tbl is not None
+    has_noexec = plan.noexec_tbl is not None
+    has_saa = plan.saa_dom_tbl is not None
+    has_sa = plan.sa_val_tbl is not None
+    if not (has_label or has_prio or has_image or has_noexec or has_saa
+            or has_sa):
+        return None
+    return PolConst(
+        lpad8=plan.label_tbl.shape[0] if has_label else 0,
+        epad8=plan.saa_dom_tbl.shape[0] if has_saa else 0,
+        n_saa_doms=plan.n_saa_doms,
+        la=plan.sa_la,
+        la8=plan.sa_pin_row.shape[1] if has_sa else 0,
+        fd=len(plan.sa_lock_init) if has_sa else 0,
+        fd8=plan.sa_match_row.shape[1] if has_sa else 0,
+        lapad8=plan.sa_val_tbl.shape[0] if has_sa else 0,
+        has_label=has_label, has_prio=has_prio, has_image=has_image,
+        has_noexec=has_noexec, has_saa=has_saa, has_sa=has_sa)
+
+
 def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                  group: int, gpad: int = 0, zpad: int = 0,
                  has_ports: bool = False, has_disk: bool = False,
                  has_spread: bool = False, has_vol_zone: bool = False,
                  ip: Optional[IpConst] = None,
-                 mp: Optional[MpConst] = None, ps=None):
+                 mp: Optional[MpConst] = None,
+                 pol: Optional[PolConst] = None, ps=None):
     """Kernel body for one grid step of `group` consecutive pods.
 
     Mosaic requires the sublane (second-to-last) block dim to be a multiple
@@ -975,6 +1183,10 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
     group state access via statically-unrolled loops over Gpad with
     (g == gid)-masked row ops — no dynamic indexing anywhere."""
     group_bound = gpad > 0
+    # the bind only UPDATES the presence carry when the XLA make_step does
+    # (ports|services|disk|interpod); an SAA-only plan reads presence
+    # frozen at its seeded state, exactly like the host path
+    pres_update = has_ports or has_disk or has_spread or (ip is not None)
 
     # policy gating + weights (kernels._evaluate's on()/part_on and the
     # weighted-sum table, generic_scheduler.go:631-639) — all static, so
@@ -991,6 +1203,8 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
 
     (w_least, w_most, w_balanced, w_node_aff, w_taint, w_avoid, w_spread,
      w_interpod) = policy_weights(ps, most_requested)
+
+    aca = ps is not None and ps.always_check_all
 
     def kernel(*refs):
         (rc_r, rm_r, rg_r, re_r, nzc_r, nzm_r, zr_r, be_r,
@@ -1033,6 +1247,29 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                 iprow_r = refs[at + 1]  # per-pod packed interpod rows
                 ipd_r = refs[at + 2]   # [Gpad*K, Dpad] presence_dom init
                 at += 3
+        if pol is not None:
+            if pol.has_label:
+                ltbl_r = refs[at]      # [Lpad8, Npad] 0/1 pass masks
+                at += 1
+            if pol.has_prio:
+                lprio_r = refs[at]     # [1, Npad] pre-weighted priorities
+                at += 1
+            if pol.has_image:
+                img_r = refs[at]       # per-pod image score rows
+                at += 1
+            if pol.has_noexec:
+                nx_r = refs[at]        # per-pod NoExecute tolerance rows
+                at += 1
+            if pol.has_saa:
+                samrow_r = refs[at]      # SMEM [SUB, gpad] my-service row
+                saadom_r = refs[at + 1]  # [Epad8, Npad] label domains
+                at += 2
+            if pol.has_sa:
+                sasig_r = refs[at]        # SMEM [SUB, 1] first-service sig
+                sapin_r = refs[at + 1]    # SMEM [SUB, la8] own pins
+                samatch_r = refs[at + 2]  # SMEM [SUB, fd8] bind match bits
+                saval_r = refs[at + 3]    # [Lapad8, Npad] label values
+                at += 4
         (ouc_r, oum_r, oug_r, oue_r, onzc_r, onzm_r, opc_r, omisc_r,
          choice_r, counts_r, adv_r) = refs[at:at + 11]
         at += 11
@@ -1180,10 +1417,13 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                                         dtype=jnp.int32)
                         dc_at = dc_at + jnp.where(in_d, seg_d, 0)
                     return mcount, dc_at, domsel
-            if has_ports and (general_on or part(POD_FITS_HOST_PORTS_PRED)):
+            ports_alias_on = ps is not None and bool(ps.ports_slots)
+            if has_ports and (general_on or part(POD_FITS_HOST_PORTS_PRED)
+                              or ports_alias_on):
                 # PodFitsHostPorts (predicates.go:1019-1039), part of
-                # GeneralPredicates: my port set conflicts with the port
-                # set of any group present on the node
+                # GeneralPredicates (or re-emitted at an alias tail slot):
+                # my port set conflicts with the port set of any group
+                # present on the node
                 port_bad = fail_cond & False
                 for g2 in range(gpad):
                     port_bad = port_bad | jnp.where(
@@ -1193,24 +1433,103 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                     bits_general = bits_general | (
                         port_bad.astype(jnp.int32) << BIT_HOST_PORTS)
 
+            # ---- ServiceAffinity shared prelude (kernels._evaluate): the
+            # lock is the entry-independent first-matching-pod node index
+            # for MY first-service signature (-1 unlocked, -2 permanently
+            # unpinned, >= 0 a node), read fresh from the misc carry lanes
+            # so pod j sees pod j-1's bind ----
+            if pol is not None and pol.has_sa:
+                sasig = sasig_r[j, 0]
+                sa_lock = jnp.int32(-1)
+                for f in range(pol.fd):
+                    sa_lock = jnp.where(sasig == f, omisc_r[0, 1 + f],
+                                        sa_lock)
+                sa_li = jnp.maximum(sa_lock, 0)
+                idx_n = jax.lax.broadcasted_iota(jnp.int32, cond.shape, 1)
+                sa_own_l = []
+                sa_lock_l = []
+                for l_ in range(pol.la):
+                    val_l = saval_r[l_:l_ + 1, :]
+                    pin_l = sapin_r[j, l_]
+                    unres = pin_l == 0
+                    # own: my pin (any value when unresolved); lock: the
+                    # locked node's value, binding only when I'm unresolved
+                    # and the locked node actually carries the label
+                    sa_own_l.append(unres | (val_l == pin_l))
+                    locked_v = jnp.sum(
+                        jnp.where(idx_n == sa_li, val_l, 0),
+                        dtype=jnp.int32)
+                    pinned = unres & (locked_v > 0)
+                    sa_lock_l.append(~pinned | (val_l == locked_v))
+                sa_off = [0]
+                for seg in ps.sa_segs:
+                    sa_off.append(sa_off[-1] + seg)
+
+                def sa_fail(e):
+                    ok_own = fail_cond | True
+                    ok_lock = fail_cond | True
+                    for l_ in range(sa_off[e], sa_off[e + 1]):
+                        ok_own = ok_own & sa_own_l[l_]
+                        ok_lock = ok_lock & sa_lock_l[l_]
+                    return ~(ok_own & (ok_lock | (sa_lock < 0)))
+
+            # policy label-presence / ServiceAffinity / ports-alias stages
+            # fire at the ordering slot they were registered under,
+            # mirroring kernels._evaluate's emit_label
+            stages = []
+            label_at = {}
+            if ps is not None:
+                for i_l, slot in enumerate(ps.label_rows):
+                    label_at.setdefault(slot, []).append(i_l)
+
+            def emit_label(slot_name):
+                if ps is None:
+                    return
+                for i_l in label_at.get(slot_name, ()):
+                    stages.append(
+                        (ltbl_r[i_l:i_l + 1, :] == 0,
+                         jnp.int32(1) << BIT_NODE_LABEL_PRESENCE))
+                for e, slot in enumerate(ps.sa_slots):
+                    if slot == slot_name:
+                        stages.append(
+                            (sa_fail(e),
+                             jnp.int32(1) << BIT_SERVICE_AFFINITY))
+                if slot_name in ps.ports_slots and has_ports:
+                    stages.append(
+                        (port_bad, jnp.int32(1) << BIT_HOST_PORTS))
+
             # short-circuit reason selection: first failing stage wins in
             # predicatesOrdering (cond -> general -> hostname -> ports ->
-            # selector -> resources -> NoDiskConflict -> taints -> MaxPD ->
-            # NoVolumeZoneConflict -> memory pressure -> disk pressure ->
-            # interpod, matching kernels._evaluate incl. policy part slots)
-            stages = [(fail_cond, cond)]
+            # selector -> resources -> NoDiskConflict -> taints ->
+            # NoExecute -> MaxPD -> NoVolumeZoneConflict -> memory pressure
+            # -> disk pressure -> interpod, matching kernels._evaluate
+            # incl. policy part slots and every emit_label ordering slot)
+            stages.append((fail_cond, cond))
+            if aca and en is not None \
+                    and CHECK_NODE_UNSCHEDULABLE_PRED in en:
+                # count mode re-reports unschedulable as its own stage on
+                # top of the condition stage (kernels._evaluate)
+                stages.append(
+                    ((cond & (jnp.int32(1) << BIT_NODE_UNSCHEDULABLE)) != 0,
+                     jnp.int32(1) << BIT_NODE_UNSCHEDULABLE))
+            emit_label(CHECK_NODE_UNSCHEDULABLE_PRED)
             if general_on:
                 stages.append((fail_general, bits_general))
+            emit_label(GENERAL_PRED)
             if part(HOSTNAME_PRED):
                 stages.append(
                     (host_bad, jnp.int32(1) << BIT_HOSTNAME_MISMATCH))
+            emit_label(HOSTNAME_PRED)
             if part(POD_FITS_HOST_PORTS_PRED) and has_ports:
                 stages.append((port_bad, jnp.int32(1) << BIT_HOST_PORTS))
+            emit_label(POD_FITS_HOST_PORTS_PRED)
             if part(MATCH_NODE_SELECTOR_PRED):
                 stages.append(
                     (sel_bad, jnp.int32(1) << BIT_NODE_SELECTOR_MISMATCH))
+            emit_label(MATCH_NODE_SELECTOR_PRED)
             if part(POD_FITS_RESOURCES_PRED):
                 stages.append((fail_res, bits_res))
+            emit_label(POD_FITS_RESOURCES_PRED)
             if has_disk and on(NO_DISK_CONFLICT_PRED):
                 # NoDiskConflict (predicates.go:266-276): my volume set
                 # conflicts with the volume set of any group present
@@ -1220,10 +1539,21 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                         drow_r[j, g2] != 0, pres_rows[g2] > 0, False)
                 stages.append(
                     (fail_disk, jnp.int32(1) << BIT_DISK_CONFLICT))
+            emit_label(NO_DISK_CONFLICT_PRED)
             if on(POD_TOLERATES_NODE_TAINTS_PRED):
                 fail_taint = tol_r[j:j + 1, :] == 0
                 stages.append(
                     (fail_taint, jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED))
+            emit_label(POD_TOLERATES_NODE_TAINTS_PRED)
+            if pol is not None and pol.has_noexec:
+                # the NoExecute-only taint predicate shares the taint
+                # reason bit (kernels._evaluate's noexec stage)
+                stages.append(
+                    (nx_r[j:j + 1, :] == 0,
+                     jnp.int32(1) << BIT_TAINTS_NOT_TOLERATED))
+            emit_label(POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED)
+            emit_label(CHECK_NODE_LABEL_PRESENCE_PRED)
+            emit_label(CHECK_SERVICE_AFFINITY_PRED)
             if mp is not None:
                 # Max{EBS,GCEPD,AzureDisk}VolumeCount (predicates.go:422
                 # -460): unique relevant volume ids on the node incl. mine
@@ -1249,18 +1579,25 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                         (myc > 0) & (cnt > mp.limits[t3]))
                 stages.append(
                     (fail_maxpd, jnp.int32(1) << BIT_MAX_VOLUME_COUNT))
+            emit_label(MAX_EBS_VOLUME_COUNT_PRED)
+            emit_label(MAX_GCE_PD_VOLUME_COUNT_PRED)
+            emit_label(MAX_AZURE_DISK_VOLUME_COUNT_PRED)
+            emit_label(CHECK_VOLUME_BINDING_PRED)
             if has_vol_zone and on(NO_VOLUME_ZONE_CONFLICT_PRED):
                 # NoVolumeZoneConflict (predicates.go:510-533): static per
                 # (volume-set, node) row, pregathered per pod
                 fail_vz = vz_r[j:j + 1, :] == 0
                 stages.append(
                     (fail_vz, jnp.int32(1) << BIT_VOLUME_ZONE_CONFLICT))
+            emit_label(NO_VOLUME_ZONE_CONFLICT_PRED)
             if on(CHECK_NODE_MEMORY_PRESSURE_PRED):
                 stages.append((mpr & best_effort,
                                jnp.int32(1) << BIT_MEMORY_PRESSURE))
+            emit_label(CHECK_NODE_MEMORY_PRESSURE_PRED)
             if on(CHECK_NODE_DISK_PRESSURE_PRED):
                 stages.append((dpr_fail,
                                jnp.int32(1) << BIT_DISK_PRESSURE))
+            emit_label(CHECK_NODE_DISK_PRESSURE_PRED)
             if ip is not None and on(MATCH_INTERPOD_AFFINITY_PRED):
                 # MatchInterPodAffinity (predicates.go:1125-1450) — last in
                 # predicatesOrdering; mirrors kernels._evaluate's stage.
@@ -1334,11 +1671,27 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                                   jnp.int32(1) << BIT_AFFINITY_RULES,
                                   jnp.int32(1) << BIT_ANTI_AFFINITY_RULES))
                 stages.append((fail_interpod, ip_bits))
+            emit_label(MATCH_INTERPOD_AFFINITY_PRED)
+            if ps is not None:
+                # alphabetical-tail alias slots (predicate names the host
+                # orders after the known pipeline)
+                tail_ks = sorted(
+                    int(s_.split(":", 1)[1])
+                    for s_ in set(ps.label_rows) | set(ps.sa_slots)
+                    | set(ps.ports_slots) if s_.startswith("tail:"))
+                for tk in tail_ks:
+                    emit_label(f"tail:{tk}")
             feasible = jnp.ones_like(fail_cond)
             reason = jnp.zeros_like(cond)
-            for fail, bits in reversed(stages):
-                feasible = feasible & ~fail
-                reason = jnp.where(fail, bits, reason)
+            if aca:
+                # count mode: no short-circuit — every stage's failure
+                # bits stay live for the histogram below
+                for fail, _ in stages:
+                    feasible = feasible & ~fail
+            else:
+                for fail, bits in reversed(stages):
+                    feasible = feasible & ~fail
+                    reason = jnp.where(fail, bits, reason)
             n_feasible = jnp.sum(feasible.astype(jnp.int32), dtype=jnp.int32)
             found = n_feasible > 0
 
@@ -1394,6 +1747,38 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                     MAX_PRIORITY)
             if w_avoid:
                 score = score + av_r[j:j + 1, :] * w_avoid
+            if pol is not None and pol.has_prio:
+                # NodeLabel/LabelPreference priorities: static pre-weighted
+                # per-node row (kernels._evaluate's st.label_prio)
+                score = score + lprio_r[0:1, :]
+            if pol is not None and pol.has_image:
+                # ImageLocalityPriority: static per (image-set, node) score
+                score = score + img_r[j:j + 1, :] * ps.w_image
+            if pol is not None and pol.has_saa:
+                # ServiceAntiAffinity (selector_spreading.go:176-280): per
+                # -node count of pods in MY first service, normalized per
+                # label domain; domain 0 = label missing (score stays 0)
+                saa_cnt = jnp.zeros_like(score)
+                for g2 in range(gpad):
+                    saa_cnt = saa_cnt + jnp.where(
+                        samrow_r[j, g2] != 0, pres_rows[g2], 0)
+                saa_fcnt = jnp.where(feasible, saa_cnt, 0)
+                saa_total = jnp.sum(saa_fcnt, dtype=jnp.int32)
+                for e, w_saa in enumerate(ps.saa_weights):
+                    dom_row = saadom_r[e:e + 1, :]
+                    labeled = dom_row > 0
+                    grp_at = jnp.zeros_like(score)
+                    for d2 in range(1, pol.n_saa_doms):
+                        in_d = dom_row == d2
+                        seg_d = jnp.sum(jnp.where(in_d, saa_fcnt, 0),
+                                        dtype=jnp.int32)
+                        grp_at = grp_at + jnp.where(in_d, seg_d, 0)
+                    f_sc = jnp.where(
+                        saa_total > 0,
+                        (MAX_PRIORITY * (saa_total - grp_at))
+                        // jnp.maximum(saa_total, 1),
+                        MAX_PRIORITY)
+                    score = score + jnp.where(labeled, f_sc, 0) * w_saa
             if has_spread and w_spread:
                 # SelectorSpreadPriority (selector_spreading.go:66-175):
                 # per-node count of pods matched by my services' selectors
@@ -1488,9 +1873,23 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
             adv_r[j, 0] = (n_feasible > 1).astype(jnp.int32)
 
             # ---- reason histogram (zeros when scheduled) ----
-            fr = jnp.where(found, jnp.zeros_like(reason), reason)
-            for b in range(num_bits):
-                counts_r[j, b] = jnp.sum((fr >> b) & 1, dtype=jnp.int32)
+            if aca:
+                # count mode: every failing stage contributes its decoded
+                # reasons (the host keeps evaluating past the first
+                # failure); pad nodes carry the int32 sentinel bit (the
+                # XLA path's bit-62 analog) and must contribute nothing
+                live = (cond & (jnp.int32(1) << PAD_SENTINEL_BIT)) == 0
+                for b in range(num_bits):
+                    tot_b = jnp.int32(0)
+                    for fail, bits in stages:
+                        tot_b = tot_b + jnp.sum(
+                            jnp.where(fail & live, (bits >> b) & 1, 0),
+                            dtype=jnp.int32)
+                    counts_r[j, b] = jnp.where(found, 0, tot_b)
+            else:
+                fr = jnp.where(found, jnp.zeros_like(reason), reason)
+                for b in range(num_bits):
+                    counts_r[j, b] = jnp.sum((fr >> b) & 1, dtype=jnp.int32)
             counts_r[j, num_bits:] = jnp.zeros(
                 (counts_r.shape[1] - num_bits,), dtype=jnp.int32)
 
@@ -1508,7 +1907,7 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                     ous_r[si:si + 1, :] = jnp.where(
                         pick, us[si:si + 1, :] + rs_r[j, si],
                         us[si:si + 1, :])
-            if group_bound:
+            if group_bound and pres_update:
                 # presence[gid, choice] += 1 via (g == gid)-masked row adds
                 pick_i = pick.astype(jnp.int32)
                 for g2 in range(gpad):
@@ -1536,6 +1935,15 @@ def _make_kernel(most_requested: bool, num_bits: int, num_scalars: int,
                         opd_r[r:r + 1, :] = jnp.where(
                             gid_s == g2, pd_rows[r] + ohrow, pd_rows[r])
 
+            if pol is not None and pol.has_sa and ps.sa_enabled:
+                # first matching bind locks each still-unlocked signature
+                # to the chosen node (kernels.make_step's sa_lock scatter)
+                for f in range(pol.fd):
+                    lock_f = omisc_r[0, 1 + f]
+                    omisc_r[0, 1 + f] = jnp.where(
+                        (lock_f == -1) & (samatch_r[j, f] != 0) & found,
+                        choice, lock_f)
+
             omisc_r[0, 0] = rr + (n_feasible > 1).astype(jnp.int32)
 
     return kernel
@@ -1547,7 +1955,8 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
                 gpad: int = 0, zpad: int = 0, has_ports: bool = False,
                 has_disk: bool = False, has_spread: bool = False,
                 has_vol_zone: bool = False, ip: Optional[IpConst] = None,
-                mp: Optional[MpConst] = None, ps=None):
+                mp: Optional[MpConst] = None,
+                pol: Optional[PolConst] = None, ps=None):
     """jitted pallas_call for one (node-pad, chunk, scalar, group) shape.
 
     k must be a multiple of SUBLANES: Mosaic rejects blocks whose sublane
@@ -1558,7 +1967,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
     group_bound = gpad > 0
     kernel = _make_kernel(most_requested, num_bits, num_scalars, SUBLANES,
                           gpad, zpad, has_ports, has_disk, has_spread,
-                          has_vol_zone, ip, mp, ps)
+                          has_vol_zone, ip, mp, pol, ps)
 
     def smem_rows(width=1):
         return pl.BlockSpec((SUBLANES, width), lambda p: (p, 0),
@@ -1609,6 +2018,26 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
             group_out.append(const_row(ip.dpad, rows=gpad * ip.k_keys))
     if mp is not None:
         group_out.append(const_row(rows=mp.vpad8))     # used-vols out
+    # policy-residue inputs (order mirrors the kernel's unpack); the
+    # ServiceAffinity locks ride the existing misc carry — no new outputs
+    pol_in = []
+    if pol is not None:
+        if pol.has_label:
+            pol_in.append(const_row(rows=pol.lpad8))   # label masks
+        if pol.has_prio:
+            pol_in.append(const_row())                 # label priority row
+        if pol.has_image:
+            pol_in.append(row_per_pod())               # image score rows
+        if pol.has_noexec:
+            pol_in.append(row_per_pod())               # noexec taint rows
+        if pol.has_saa:
+            pol_in.append(smem_rows(gpad))             # my-service rows
+            pol_in.append(const_row(rows=pol.epad8))   # saa label domains
+        if pol.has_sa:
+            pol_in.append(smem_rows())                 # first-service sig
+            pol_in.append(smem_rows(pol.la8))          # own pin rows
+            pol_in.append(smem_rows(pol.fd8))          # bind match rows
+            pol_in.append(const_row(rows=pol.lapad8))  # sa label values
     grid_spec = pl.GridSpec(
         grid=(k // SUBLANES,),
         in_specs=(
@@ -1619,6 +2048,7 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
             + [const_row(LANES)]                        # init misc (rr)
             + scalar_in
             + group_in
+            + pol_in
         ),
         out_specs=(
             [const_row() for _ in range(7)]             # carry out
@@ -1653,12 +2083,17 @@ def _build_call(npad: int, k: int, most_requested: bool, num_bits: int,
 
 
 def verify_against_xla(config, compiled, cols, choices, counts,
-                       max_pods: int = 512) -> bool:
+                       max_pods: int = 512, statics=None,
+                       carry=None) -> bool:
     """Replay the first max_pods pods through the XLA scan and compare the
     kernel's choices AND reason histograms bit-for-bit (the AUTO-mode
     guardrail shared by JaxBackend and the what-if fast loop). Histogram
     widths may differ when a what-if batch unifies scalar axes — the
-    common prefix must match and the excess columns must be zero."""
+    common prefix must match and the excess columns must be zero.
+
+    statics/carry: device-tree overrides for policies whose host statics
+    carry policy tables (label rows, image scores, ServiceAffinity state)
+    that the bare compiled-cluster trees lack."""
     from tpusim.jaxe.kernels import (
         _tree_to_device,
         carry_init,
@@ -1670,8 +2105,11 @@ def verify_against_xla(config, compiled, cols, choices, counts,
     m = min(max_pods, len(np.asarray(cols.req_cpu)))
     xs_h = pod_columns_to_host(cols)
     xs_head = _tree_to_device(type(xs_h)(*(a[:m] for a in xs_h)))
-    _, vch, vcnt, _ = schedule_scan(config, carry_init(compiled),
-                                    statics_to_device(compiled), xs_head)
+    if statics is None:
+        statics = statics_to_device(compiled)
+    if carry is None:
+        carry = carry_init(compiled)
+    _, vch, vcnt, _ = schedule_scan(config, carry, statics, xs_head)
     vch = np.asarray(vch)
     vcnt = np.asarray(vcnt)
     fch = np.asarray(choices)[:m]
@@ -1727,11 +2165,12 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
     gpad = plan.num_groups
     ipc = ip_const_of(plan)
     mpc = mp_const_of(plan)
+    polc = pol_const_of(plan)
     call = _build_call(npad, k, plan.most_requested, num_bits, counts_w,
                        plan.num_scalars, srows, interpret,
                        gpad, plan.n_zone_doms, plan.has_ports,
                        plan.has_disk, plan.has_spread, plan.has_vol_zone,
-                       ipc, mpc, plan.policy)
+                       ipc, mpc, polc, plan.policy)
 
     statics = [jnp.asarray(a) for a in (
         plan.alloc_cpu, plan.alloc_mem, plan.alloc_gpu, plan.alloc_eph,
@@ -1759,15 +2198,28 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
         uv_carry = jnp.asarray(carry_in.uv)
     zone_tbl = (jnp.asarray(plan.zone_ok_tbl)
                 if plan.has_vol_zone else None)
+    if polc is not None:
+        if polc.has_label:
+            ltbl_dev = jnp.asarray(plan.label_tbl)
+        if polc.has_prio:
+            lprio_dev = jnp.asarray(plan.label_prio_row)
+        if polc.has_image:
+            img_tbl_dev = jnp.asarray(plan.image_tbl)
+        if polc.has_noexec:
+            nx_tbl_dev = jnp.asarray(plan.noexec_tbl)
+        if polc.has_saa:
+            saadom_dev = jnp.asarray(plan.saa_dom_tbl)
+        if polc.has_sa:
+            saval_dev = jnp.asarray(plan.sa_val_tbl)
 
     def col(a, fill):
         out = np.full(k, fill, dtype=np.int32)
         out[:a.shape[0]] = a
         return out.reshape(k, 1)
 
-    def grow(a):
-        # per-pod [*, Gpad] group rows for one chunk; ghost rows all-zero
-        out = np.zeros((k, gpad), dtype=np.int32)
+    def grow(a, w=None):
+        # per-pod [*, W] group rows for one chunk; ghost rows all-zero
+        out = np.zeros((k, w or gpad), dtype=np.int32)
         out[:a.shape[0]] = a
         return out
 
@@ -1847,6 +2299,28 @@ def fast_scan(plan: FastPlan, chunk: int = 0,
                 # ghost pods are infeasible everywhere regardless)
                 args.append(ip_tbl_dev[gids[:, 0]])
                 args.append(pd_carry)
+        if polc is not None:
+            # residue-class policy operands (ghost pods gather row 0 /
+            # all-zero rows; they are infeasible everywhere regardless)
+            if polc.has_label:
+                args.append(ltbl_dev)
+            if polc.has_prio:
+                args.append(lprio_dev)
+            if polc.has_image:
+                iid = col(plan.img_id[sl], 0)
+                args.append(img_tbl_dev[iid[:, 0]])
+            if polc.has_noexec:
+                args.append(nx_tbl_dev[ids[1][:, 0]])
+            if polc.has_saa:
+                args.append(jnp.asarray(grow(plan.saa_row[sl])))
+                args.append(saadom_dev)
+            if polc.has_sa:
+                args.append(jnp.asarray(col(plan.sa_sig[sl], 0)))
+                args.append(jnp.asarray(grow(plan.sa_pin_row[sl],
+                                             polc.la8)))
+                args.append(jnp.asarray(grow(plan.sa_match_row[sl],
+                                             polc.fd8)))
+                args.append(saval_dev)
         out = call(*args)
         carry = list(out[:7])
         misc = out[7]

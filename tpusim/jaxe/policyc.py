@@ -578,3 +578,58 @@ def policy_static_rows(cp: CompiledPolicy, nodes,
             if exists == presence:
                 prio[i] += weight * MAX_PRIORITY
     return label_ok, prio
+
+
+@dataclass
+class PolicyTables:
+    """Host-side policy static tables, bundled for the Pallas fast path.
+
+    Built once per compile by build_policy_tables; plan_fast bakes these
+    into the kernel plan and the XLA branch overwrites the trivial Statics
+    rows from the same arrays, so both engines see identical inputs."""
+
+    label_ok: np.ndarray         # [L, N] bool  — label-presence pass masks
+    label_prio: np.ndarray       # [N] int64    — NodeLabel priority scores
+    image_score: np.ndarray      # [Si, N] int64 — ImageLocality table
+    has_image: bool              # policy weights ImageLocality
+    saa_dom: np.ndarray          # [E, N] int32 — SAA per-entry label domains
+    n_saa_doms: int              # shared segment count (incl. absent 0)
+    sa_pin: np.ndarray           # [Cs, La] int32 — per-pod-sig SA pins
+    sa_val: np.ndarray           # [La, N] int32 — SA node label values
+    sa_lock_init: np.ndarray     # [Fd] int32 — first-matching-pod locks
+
+
+def build_policy_tables(cp: CompiledPolicy, snapshot, pods,
+                        compiled, cols) -> PolicyTables:
+    """Assemble every policy static table the device engines consume.
+
+    Fills cols.img_id / cols.sa_self_id IN PLACE (per-pod signature columns)
+    and returns the node-axis tables. Centralizes what backend.schedule,
+    whatif's host-batch prep, and the fast-path planner all need so the two
+    device routes can't drift on their inputs."""
+    ps = cp.spec
+    nodes = snapshot.nodes
+    node_index = compiled.node_index
+    n = max(len(node_index), 1)
+    label_ok, label_prio = policy_static_rows(cp, nodes, node_index)
+    has_image = bool(ps.w_image)
+    if has_image:
+        img_id, image_score = image_locality_columns(pods, nodes, node_index)
+        cols.img_id[:] = img_id
+    else:
+        image_score = np.zeros((1, n), dtype=np.int64)
+    saa_dom, n_saa_doms = saa_dom_rows(cp, nodes, node_index)
+    if ps.sa_enabled or ps.sa_slots:
+        sa_self_id, sa_pin, sa_val, sa_lock_init = service_affinity_columns(
+            cp, pods, snapshot, node_index, compiled.groups.saa_defs)
+        cols.sa_self_id[:] = sa_self_id
+    else:
+        sa_pin = np.zeros((1, 1), dtype=np.int32)
+        sa_val = np.zeros((1, n), dtype=np.int32)
+        sa_lock_init = np.full(
+            compiled.groups.saa_rows.shape[0], -1, dtype=np.int32)
+    return PolicyTables(label_ok=label_ok, label_prio=label_prio,
+                        image_score=image_score, has_image=has_image,
+                        saa_dom=saa_dom, n_saa_doms=n_saa_doms,
+                        sa_pin=sa_pin, sa_val=sa_val,
+                        sa_lock_init=sa_lock_init)

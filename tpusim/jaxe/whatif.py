@@ -156,7 +156,8 @@ def _prepare_host_batch(scenarios, provider: str,
     Returns (prep, early): `early` is the finished result list when nothing
     needs the device (no scenarios / all zero-node); otherwise `prep` is
     (config, host_trees, real_count, batch_indices, compiled_list,
-    empty_results).
+    empty_results, ptabs_list) — ptabs_list holds each scenario's
+    PolicyTables (None without a policy) for the fast loop's planner.
     """
     if provider not in _KNOWN_PROVIDERS:
         raise KeyError(f"plugin {provider!r} has not been registered")
@@ -216,41 +217,27 @@ def _prepare_host_batch(scenarios, provider: str,
     # host-side trees: unify + pad on numpy, upload once after stacking
     n_saa_doms = 1
     host_trees = []
+    ptabs_list = []
     for b, (compiled, cols) in enumerate(compiled_list):
         host_statics = statics_to_host(compiled)
-        if cp is not None:
-            from tpusim.jaxe.policyc import (
-                image_locality_columns,
-                policy_static_rows,
-                saa_dom_rows,
-            )
-
-            snapshot, pods = scenarios[batch_indices[b]]
-            label_ok, label_prio = policy_static_rows(
-                cp, snapshot.nodes, compiled.node_index)
-            host_statics = host_statics._replace(label_ok=label_ok,
-                                                 label_prio=label_prio)
-            if cp.spec.w_image:
-                cols.img_id, image_score = image_locality_columns(
-                    pods, snapshot.nodes, compiled.node_index)
-                host_statics = host_statics._replace(image_score=image_score)
-            if cp.saa_entries:
-                saa_dom, doms = saa_dom_rows(cp, snapshot.nodes,
-                                             compiled.node_index)
-                host_statics = host_statics._replace(saa_dom=saa_dom)
-                n_saa_doms = max(n_saa_doms, doms)
         host_carry = carry_init_host(compiled)
-        if cp is not None and cp.spec.sa_enabled:
-            from tpusim.jaxe.policyc import service_affinity_columns
+        ptabs = None
+        if cp is not None:
+            # one build per scenario feeds the vmap statics AND the fast
+            # loop's plan (the trivial PolicyTables shapes match
+            # statics_to_host / carry_init_host, so unconditional replace
+            # is byte-identical for features the policy lacks)
+            from tpusim.jaxe.policyc import build_policy_tables
 
             snapshot, pods = scenarios[batch_indices[b]]
-            (cols.sa_self_id, sa_pin, sa_val,
-             sa_lock_init) = service_affinity_columns(
-                cp, pods, snapshot, compiled.node_index,
-                compiled.groups.saa_defs)
+            ptabs = build_policy_tables(cp, snapshot, pods, compiled, cols)
             host_statics = host_statics._replace(
-                sa_pin=sa_pin, sa_val=sa_val)
-            host_carry = host_carry._replace(sa_lock=sa_lock_init)
+                label_ok=ptabs.label_ok, label_prio=ptabs.label_prio,
+                image_score=ptabs.image_score, saa_dom=ptabs.saa_dom,
+                sa_pin=ptabs.sa_pin, sa_val=ptabs.sa_val)
+            host_carry = host_carry._replace(sa_lock=ptabs.sa_lock_init)
+            n_saa_doms = max(n_saa_doms, ptabs.n_saa_doms)
+        ptabs_list.append(ptabs)
         host_trees.append((host_statics, host_carry,
                            pod_columns_to_host(cols)))
 
@@ -266,7 +253,7 @@ def _prepare_host_batch(scenarios, provider: str,
 
         config = _dc_replace(config, policy=cp.spec, n_saa_doms=n_saa_doms)
     return (config, host_trees, real_count, batch_indices, compiled_list,
-            empty_results), None
+            empty_results, ptabs_list), None
 
 
 def _unify_batch(scenarios, host_trees, batch_indices,
@@ -319,16 +306,18 @@ def _decode_batch(scenarios, batch_indices, compiled_list, empty_results,
 
 
 def _try_fast_loop(scenarios, config, batch_indices, compiled_list,
-                   empty_results, real_count):
+                   empty_results, real_count, ptabs_list, host_trees):
     """Run every scenario through the Pallas fast path sequentially;
     returns the decoded results, or None to fall back to the batched vmap
     program (ineligible scenario, fast path off/disabled, kernel failure,
     or a failed AUTO self-verification)."""
+    from tpusim.framework.metrics import register
     from tpusim.jaxe.backend import (
         _FAST_AUTO,
         _auto_verify_and_pin,
         _fast_path_enabled,
         _note_fast_failure,
+        _note_fast_fallback,
         plan_signature,
     )
     from tpusim.jaxe.fastscan import fast_scan, plan_fast
@@ -338,8 +327,10 @@ def _try_fast_loop(scenarios, config, batch_indices, compiled_list,
         return None
     plans = []
     for b, (compiled, cols) in enumerate(compiled_list):
-        plan, why = plan_fast(config, compiled, cols)
+        plan, why = plan_fast(config, compiled, cols,
+                              ptabs=ptabs_list[b])
         if plan is None:
+            _note_fast_fallback(register(), why)
             log.info("what-if fast loop ineligible (scenario %d: %s); "
                      "using the batched vmap program", batch_indices[b], why)
             return None
@@ -362,8 +353,14 @@ def _try_fast_loop(scenarios, config, batch_indices, compiled_list,
             # a small scenario 0 passing trivially must not exempt the rest
             # of the batch (trust pins only at TPUSIM_FAST_VERIFY_MIN+ pods)
             compiled, cols = compiled_list[b]
-            if not _auto_verify_and_pin(config, compiled, cols,
-                                        choices, counts, sig):
+            # replay against the same policy-grafted statics/carry the
+            # batched vmap program would use for this scenario
+            from tpusim.jaxe.kernels import _tree_to_device
+
+            hs, hc, _ = host_trees[b]
+            if not _auto_verify_and_pin(
+                    config, compiled, cols, choices, counts, sig,
+                    statics=_tree_to_device(hs), carry=hc):
                 return None
         choices_list.append(choices)
         counts_list.append(counts)
@@ -398,7 +395,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     if prep is None:
         return early
     (config, host_trees, real_count, batch_indices, compiled_list,
-     empty_results) = prep
+     empty_results, ptabs_list) = prep
 
     if mesh is None:
         # Pallas fast loop: per-scenario kernels instead of the single
@@ -409,7 +406,8 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         # state); anything else keeps the batched program. Runs BEFORE the
         # shape unification below, which the fast loop never needs.
         fast = _try_fast_loop(scenarios, config, batch_indices,
-                              compiled_list, empty_results, real_count)
+                              compiled_list, empty_results, real_count,
+                              ptabs_list, host_trees)
         if fast is not None:
             return fast
 
@@ -468,7 +466,7 @@ def run_what_if_multihost(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]]
     if prep is None:
         return early
     (config, host_trees, real_count, batch_indices, compiled_list,
-     empty_results) = prep
+     empty_results, _ptabs_list) = prep
     per_scenario = _unify_batch(scenarios, host_trees, batch_indices,
                                 n_snap_shards=nproc, n_node_shards=n_node)
 

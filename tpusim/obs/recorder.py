@@ -248,6 +248,16 @@ def note_route(route: str, pods: Optional[int] = None) -> None:
                     {"pods": pods} if pods is not None else None)
 
 
+def note_fast_fallback(reason: str, detail: Optional[str] = None) -> None:
+    """plan_fast rejected a batch: `reason` is the low-cardinality blocker
+    class (backend._fast_fallback_key), `detail` the full reason string
+    (trace-only — too high-cardinality for a metric label)."""
+    rec = _active
+    if rec is not None:
+        rec.instant("fallback:" + reason, "device",
+                    {"why": detail} if detail is not None else None)
+
+
 def note_victim_path(path: str) -> None:
     """Preemption victim-selection path: device/device_verified/host/
     fallback (mirrors jaxe.preempt.PREEMPT_CLASS_STATS)."""

@@ -794,14 +794,14 @@ def _ladder_configs() -> set:
     without repeating the whole ladder). Called in the PARENT before any
     child spawns: a typo'd knob must fail instantly, not burn the full
     retry ladder (each child pays backend init) producing "no JSON line"."""
-    raw = os.environ.get("TPUSIM_BENCH_LADDER_CONFIGS", "1,2,3,4,5,6,7")
+    raw = os.environ.get("TPUSIM_BENCH_LADDER_CONFIGS", "1,2,3,4,5,6,7,8")
     try:
         wanted = {int(c) for c in raw.split(",") if c.strip()}
     except ValueError:
         wanted = set()
-    if not wanted or not wanted <= {1, 2, 3, 4, 5, 6, 7}:
+    if not wanted or not wanted <= {1, 2, 3, 4, 5, 6, 7, 8}:
         raise SystemExit(
-            f"TPUSIM_BENCH_LADDER_CONFIGS={raw!r}: need values in 1-7")
+            f"TPUSIM_BENCH_LADDER_CONFIGS={raw!r}: need values in 1-8")
     return wanted
 
 
@@ -923,6 +923,92 @@ def run_ladder(platform: str, baseline_pods: int, chunk: int) -> None:
             snapshot, pods, platform, baseline_pods, chunk,
             policy=POLICY_RESIDUE))
         print(json.dumps(results[-1]), flush=True)
+
+    if 8 in wanted:
+        results.append(measure_serve_fleet(platform))
+        print(json.dumps(results[-1]), flush=True)
+
+
+def measure_serve_fleet(platform: str) -> dict:
+    """Config 8: scenario-fleet serving throughput (tpusim/serve). One fixed
+    cluster size, N what-if requests whose pod counts stay inside ONE shape
+    class, so the cold pass traces exactly one program and every warm pass
+    must ride the warm-executable cache (compile_cache_hit stamps the
+    record; a warm trace is a regression). A second axis sweeps the
+    ("scenario", "node") mesh sizes the host exposes — the mesh-scaling
+    curve for the shard_map dispatch route."""
+    import jax
+
+    from tpusim.jaxe.whatif import compile_count
+    from tpusim.serve import ScenarioFleet, WhatIfRequest
+
+    n_req, p8, n8 = (64, 2_000, 200) if platform != "cpu" else (24, 400, 50)
+    bucket = 8
+    snapshot, pool = build_workload(p8, n8, seed=4242)
+    # pod counts in (p8/2, p8]: same power-of-two budget => one shape class
+    rng = np.random.RandomState(8)
+    sizes = [int(rng.randint(p8 // 2 + 1, p8 + 1)) for _ in range(n_req)]
+
+    def load():
+        return [WhatIfRequest(pods=pool[:n], snapshot_ref="base",
+                              cache_key=f"bench8-{i}-{n}")
+                for i, n in enumerate(sizes)]
+
+    def one_pass(fleet):
+        t0 = time.perf_counter()
+        responses = fleet.run(load())
+        elapsed = time.perf_counter() - t0
+        bad = [r for r in responses if not r.ok]
+        if bad:
+            raise RuntimeError(f"config 8: {len(bad)} requests failed: "
+                               f"{bad[0].error}")
+        return elapsed, responses
+
+    fleet = ScenarioFleet(bucket_size=bucket, flush_after_s=0.05)
+    fleet.register_snapshot("base", snapshot)
+    with stage_heartbeat("[config 8] serve fleet cold pass (XLA compile "
+                         "gives no incremental progress)"):
+        cold_e2e, _ = one_pass(fleet)
+    traces_before_warm = compile_count()
+    warm_e2e, warm_responses = one_pass(fleet)
+    warm_traces = compile_count() - traces_before_warm
+    cache_hit = warm_traces == 0 and all(r.compile_cache_hit
+                                         for r in warm_responses)
+    log(f"[config 8] {n_req} requests, bucket {bucket}: cold "
+        f"{n_req / cold_e2e:.1f}/s, warm {n_req / warm_e2e:.1f}/s, "
+        f"warm traces {warm_traces}")
+
+    mesh_curve = []
+    n_dev = len(jax.devices())
+    for m in (1, 2, 4, 8):
+        if m > n_dev or bucket % m != 0:
+            continue
+        from tpusim.jaxe.sharding import make_scenario_mesh
+
+        mfleet = ScenarioFleet(bucket_size=bucket, flush_after_s=0.05,
+                               mesh=make_scenario_mesh(m))
+        mfleet.register_snapshot("base", snapshot)
+        with stage_heartbeat(f"[config 8] mesh {m}x1 cold pass"):
+            m_cold, _ = one_pass(mfleet)
+        m_warm, _ = one_pass(mfleet)
+        mesh_curve.append({"mesh": f"{m}x1",
+                           "cold_scenarios_per_s": round(n_req / m_cold, 1),
+                           "scenarios_per_s": round(n_req / m_warm, 1)})
+        log(f"[config 8] mesh {m}x1: warm {n_req / m_warm:.1f} scenarios/s")
+
+    return {
+        "metric": f"what-if scenarios/sec (config 8: serve fleet, {n_req} "
+                  f"requests vs {n8} nodes, bucket {bucket}, warm pass, "
+                  f"platform={platform})",
+        "value": round(n_req / warm_e2e, 1), "unit": "scenarios/s",
+        "vs_baseline": 0,
+        "cold_scenarios_per_s": round(n_req / cold_e2e, 1),
+        "compile_cache_hit": cache_hit,
+        "warm_traces": warm_traces,
+        "mesh_curve": mesh_curve,
+        "fleet_stats": dict(fleet.executor.stats),
+        "metrics": _metrics_snapshot(reset=True),
+    }
 
 
 class stage_heartbeat:
@@ -1411,7 +1497,7 @@ def run_watchdogged(cmd, stall_timeout: float, total_timeout: float,
 
 # the ladder subset a healthy accelerator promotes the default run to
 # (VERDICT r3 item 1: the north-star shapes)
-AUTOLADDER_DEFAULT_CONFIGS = "3,4,5,6,7"
+AUTOLADDER_DEFAULT_CONFIGS = "3,4,5,6,7,8"
 
 
 def pick_headline(json_lines):

@@ -1,0 +1,287 @@
+"""Scenario-fleet serving tests (tpusim/serve).
+
+Correctness bar: the serve path — admission, shape-class bucketing, ghost
+padding, warm-executable reuse — must produce placements byte-identical
+(placement hash) to per-scenario run_what_if. The batcher and queue are
+tested host-side with injected clocks; warm repeats are proven by the
+whatif compile counter (zero traces), not by timing.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.backends import placement_hash
+from tpusim.framework.metrics import register
+from tpusim.jaxe.whatif import compile_count, run_what_if
+from tpusim.serve import (
+    REJECT_INVALID,
+    REJECT_QUEUE_FULL,
+    REJECT_UNKNOWN_SNAPSHOT,
+    AdmissionQueue,
+    Bucket,
+    PendingEntry,
+    ScenarioFleet,
+    ShapeClass,
+    ShapeClassBatcher,
+    WhatIfRequest,
+    shape_class_for,
+)
+from tpusim.serve.request import _budget
+
+needs_8_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh")
+
+
+def scenario(seed: int, num_nodes: int = 4, num_pods: int = 3):
+    rng = np.random.RandomState(seed)
+    nodes = [make_node(f"s{seed}-n{i}",
+                       milli_cpu=int(rng.choice([2000, 4000, 8000])),
+                       memory=int(rng.choice([4, 8])) * 1024**3)
+             for i in range(num_nodes)]
+    pods = [make_pod(f"s{seed}-p{i}",
+                     milli_cpu=int(rng.randint(100, 1500)),
+                     memory=int(rng.randint(2**20, 2**30)))
+            for i in range(num_pods)]
+    return ClusterSnapshot(nodes=nodes), pods
+
+
+def singleton_hash(snap, pods):
+    [result] = run_what_if([(snap, pods)])
+    return placement_hash(result.placements)
+
+
+# ---------------------------------------------------------------------------
+# shape classes
+# ---------------------------------------------------------------------------
+
+
+class TestShapeClass:
+    def test_budget_rounds_to_pow2_with_floor(self):
+        assert [_budget(n) for n in (1, 3, 4, 5, 8, 9, 100)] == \
+            [4, 4, 4, 8, 8, 16, 128]
+
+    def test_same_class_across_sizes_within_budget(self):
+        # 3 and 4 pods on 3 and 4 nodes land in one class (floor 4): one
+        # bucket, one executable
+        fleet = ScenarioFleet()
+        classes = set()
+        for num_nodes, num_pods in ((3, 3), (4, 4), (3, 4)):
+            snap, pods = scenario(1, num_nodes, num_pods)
+            staged, sc, _, _, _ = fleet.executor.stage(
+                WhatIfRequest(pods=pods, snapshot=snap))
+            classes.add(sc)
+        assert len(classes) == 1
+        (sc,) = classes
+        assert sc.n_nodes == 4 and sc.n_pods == 4
+
+    def test_deterministic(self):
+        fleet = ScenarioFleet()
+        snap, pods = scenario(2)
+        req = lambda: WhatIfRequest(pods=list(pods), snapshot=snap)  # noqa: E731
+        sc_a = fleet.executor.stage(req())[1]
+        sc_b = fleet.executor.stage(req())[1]
+        assert sc_a == sc_b and hash(sc_a) == hash(sc_b)
+
+
+# ---------------------------------------------------------------------------
+# batcher (host-only: fake staged entries, injected clock)
+# ---------------------------------------------------------------------------
+
+
+def _entry(shape_class, plan_sig="sig", at=0.0):
+    return PendingEntry(request=WhatIfRequest(pods=[make_pod("x")]),
+                        staged=None, future=None, admitted_at=at,
+                        shape_class=shape_class, plan_sig=plan_sig)
+
+
+class TestBatcher:
+    SC_A = ShapeClass(n_nodes=4, n_pods=4, axes=())
+    SC_B = ShapeClass(n_nodes=8, n_pods=4, axes=())
+
+    def test_fills_bucket_in_arrival_order(self):
+        batcher = ShapeClassBatcher(bucket_size=3, clock=lambda: 0.0)
+        entries = [_entry(self.SC_A) for _ in range(3)]
+        assert batcher.add(entries[0]) is None
+        assert batcher.add(entries[1]) is None
+        bucket = batcher.add(entries[2])
+        assert bucket is not None and bucket.entries == entries
+        assert bucket.ghosts == 0 and batcher.pending() == 0
+
+    def test_distinct_keys_do_not_share_buckets(self):
+        batcher = ShapeClassBatcher(bucket_size=2, clock=lambda: 0.0)
+        assert batcher.add(_entry(self.SC_A)) is None
+        assert batcher.add(_entry(self.SC_B)) is None
+        assert batcher.add(_entry(self.SC_A, plan_sig="other")) is None
+        assert batcher.pending() == 3  # three open buckets of one entry
+        full = batcher.add(_entry(self.SC_A))
+        assert full is not None and full.key == (self.SC_A, "sig")
+
+    def test_deadline_flush_under_injected_clock(self):
+        t = [0.0]
+        batcher = ShapeClassBatcher(bucket_size=4, flush_after_s=0.5,
+                                    clock=lambda: t[0])
+        batcher.add(_entry(self.SC_A, at=0.0))
+        t[0] = 0.2
+        batcher.add(_entry(self.SC_A, at=0.2))
+        assert batcher.due() == []  # oldest has waited 0.2 < 0.5
+        assert batcher.next_deadline() == pytest.approx(0.5)
+        t[0] = 0.49
+        assert batcher.due() == []
+        t[0] = 0.5  # the deadline is the oldest entry's, not the newest's
+        [bucket] = batcher.due()
+        assert len(bucket.entries) == 2 and bucket.ghosts == 2
+        assert batcher.due() == [] and batcher.next_deadline() is None
+
+    def test_flush_all_drains_everything(self):
+        batcher = ShapeClassBatcher(bucket_size=4, clock=lambda: 0.0)
+        batcher.add(_entry(self.SC_A))
+        batcher.add(_entry(self.SC_B))
+        buckets = batcher.flush_all()
+        assert len(buckets) == 2 and batcher.pending() == 0
+
+
+class TestAdmissionQueue:
+    def test_bounded_put_pop(self):
+        q = AdmissionQueue(maxsize=2)
+        assert q.put("a") and q.put("b")
+        assert not q.put("c")  # full: reject, never block
+        assert q.pop() == "a" and q.pop() == "b" and q.pop() is None
+
+    def test_close_rejects_new_but_drains_held(self):
+        q = AdmissionQueue(maxsize=4)
+        q.put("a")
+        q.close()
+        assert not q.put("b")
+        assert q.closed and q.pop() == "a"
+
+    def test_depth_gauge_tracks_transitions(self):
+        q = AdmissionQueue(maxsize=4)
+        q.put("a"), q.put("b")
+        assert register().serve_queue_depth.value == 2
+        q.pop()
+        assert register().serve_queue_depth.value == 1
+
+
+# ---------------------------------------------------------------------------
+# the fleet end-to-end (device dispatch)
+# ---------------------------------------------------------------------------
+
+
+class TestFleet:
+    def test_full_bucket_matches_run_what_if(self):
+        scenarios = [scenario(10 + s) for s in range(2)]
+        fleet = ScenarioFleet(bucket_size=2, flush_after_s=60.0)
+        responses = fleet.run([WhatIfRequest(pods=p, snapshot=s)
+                               for s, p in scenarios])
+        for resp, (snap, pods) in zip(responses, scenarios):
+            assert resp.ok, resp.error
+            assert resp.bucket_real == 2 and resp.bucket_ghosts == 0
+            assert placement_hash(resp.result.placements) == \
+                singleton_hash(snap, pods)
+
+    def test_ghost_padded_partial_bucket_matches_and_never_leaks(self):
+        snap, pods = scenario(12)
+        fleet = ScenarioFleet(bucket_size=2, flush_after_s=60.0)
+        [resp] = fleet.run([WhatIfRequest(pods=pods, snapshot=snap)])
+        assert resp.ok and resp.bucket_real == 1 and resp.bucket_ghosts == 1
+        # one response per real request; its placements cover exactly the
+        # request's pods (no ghost scenario, no pod-axis padding leaks out)
+        assert [p.pod.name for p in resp.result.placements] == \
+            [p.name for p in pods]
+        assert placement_hash(resp.result.placements) == \
+            singleton_hash(snap, pods)
+
+    def test_warm_repeat_skips_recompilation(self):
+        scenarios = [scenario(20 + s) for s in range(2)]
+        fleet = ScenarioFleet(bucket_size=2, flush_after_s=60.0)
+        load = lambda: [WhatIfRequest(pods=p, snapshot=s, cache_key=f"k{i}")  # noqa: E731
+                        for i, (s, p) in enumerate(scenarios)]
+        cold = fleet.run(load())
+        assert all(r.ok for r in cold)
+        before = compile_count()
+        warm = fleet.run(load())
+        assert compile_count() == before, \
+            "warm repeat of an identical shape class must not trace"
+        assert all(r.compile_cache_hit for r in warm)
+        assert fleet.executor.stats["staged_hits"] >= 2  # cache_key reuse
+        for a, b in zip(cold, warm):
+            assert placement_hash(a.result.placements) == \
+                placement_hash(b.result.placements)
+
+    def test_snapshot_ref_and_rejections(self):
+        snap, pods = scenario(30)
+        fleet = ScenarioFleet(bucket_size=2, flush_after_s=60.0)
+        fleet.register_snapshot("base", snap)
+        ok, missing, no_pods, no_nodes = fleet.run([
+            WhatIfRequest(pods=pods, snapshot_ref="base"),
+            WhatIfRequest(pods=pods, snapshot_ref="nope"),
+            WhatIfRequest(pods=[], snapshot_ref="base"),
+            WhatIfRequest(pods=pods, snapshot=ClusterSnapshot(nodes=[])),
+        ])
+        assert ok.ok and placement_hash(ok.result.placements) == \
+            singleton_hash(snap, pods)
+        assert missing.rejected == REJECT_UNKNOWN_SNAPSHOT
+        assert no_pods.rejected == REJECT_INVALID
+        assert no_nodes.rejected == REJECT_INVALID
+        assert "zero-node" in no_nodes.error
+        assert register().serve_rejected.values[REJECT_INVALID] >= 2
+
+    def test_queue_full_rejects_at_submit(self):
+        snap, pods = scenario(31)
+        fleet = ScenarioFleet(bucket_size=4, flush_after_s=60.0, max_queue=2)
+        futures = [fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+                   for _ in range(3)]
+        overflow = [f for f in futures if f.done()]
+        assert len(overflow) == 1
+        assert overflow[0].result().rejected == REJECT_QUEUE_FULL
+        fleet.drain()
+        accepted = [f.result() for f in futures if f.result().rejected is None]
+        assert len(accepted) == 2 and all(r.ok for r in accepted)
+
+    def test_deadline_flush_with_injected_clock(self):
+        snap, pods = scenario(32)
+        t = [0.0]
+        fleet = ScenarioFleet(bucket_size=4, flush_after_s=0.5,
+                              clock=lambda: t[0])
+        future = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+        fleet.pump()
+        assert not future.done()  # waiting for siblings until the deadline
+        t[0] = 0.49
+        fleet.pump()
+        assert not future.done()
+        t[0] = 0.51
+        fleet.pump()
+        resp = future.result()
+        assert resp.ok and resp.bucket_ghosts == 3
+        assert placement_hash(resp.result.placements) == \
+            singleton_hash(snap, pods)
+
+    def test_serve_metric_families_exposed(self):
+        snap, pods = scenario(33)
+        ScenarioFleet(bucket_size=2, flush_after_s=60.0).run(
+            [WhatIfRequest(pods=pods, snapshot=snap)])
+        text = register().expose()
+        for family in ("tpusim_serve_queue_depth",
+                       "tpusim_serve_batch_occupancy",
+                       "tpusim_serve_request_latency_microseconds",
+                       "tpusim_serve_dispatch_total"):
+            assert family in text, family
+
+    @needs_8_devices
+    def test_scenario_mesh_bucket_matches_run_what_if(self):
+        from tpusim.jaxe.sharding import make_scenario_mesh
+
+        mesh = make_scenario_mesh(8)
+        with pytest.raises(ValueError, match="does not divide"):
+            ScenarioFleet(bucket_size=6, mesh=mesh)
+        fleet = ScenarioFleet(bucket_size=8, flush_after_s=60.0, mesh=mesh)
+        scenarios = [scenario(40 + s) for s in range(3)]
+        responses = fleet.run([WhatIfRequest(pods=p, snapshot=s)
+                               for s, p in scenarios])
+        # 3 real scenarios ghost-padded to the 8-shard bucket
+        for resp, (snap, pods) in zip(responses, scenarios):
+            assert resp.ok and resp.bucket_ghosts == 5
+            assert placement_hash(resp.result.placements) == \
+                singleton_hash(snap, pods)

@@ -77,8 +77,11 @@ class TestWhatIf:
         with pytest.raises(KeyError):
             run_what_if([scenario(0, 3, 2)], provider="NoSuchProvider")
 
-    def test_empty(self):
-        assert run_what_if([]) == []
+    def test_empty_scenario_list_rejected(self):
+        # an empty study is a caller bug: surface it loudly instead of
+        # returning an empty list that reads like "everything scheduled"
+        with pytest.raises(ValueError, match="at least one"):
+            run_what_if([])
 
     @needs_8_devices
     def test_mesh_sharded_matches_singleton_runs(self):
@@ -102,24 +105,40 @@ class TestWhatIf:
         for got, want in zip(batched, singles):
             assert placements_key(got.placements) == want
 
-    def test_zero_node_scenario_mixed_into_batch(self):
-        # a zero-node scenario must resolve host-side (like the backend's
-        # empty guard) while the others run batched on device
+    @needs_8_devices
+    def test_scenario_mesh_matches_singleton_runs(self):
+        # the manual shard_map route: scenarios partitioned over the
+        # "scenario" axis, node columns whole per shard — same placements
+        # as the GSPMD vmap and the singleton runs
+        from tpusim.jaxe.sharding import make_scenario_mesh
+
+        scenarios = [scenario(50 + s, 6 + s, 5 + s) for s in range(5)]
+        batched = run_what_if(scenarios, mesh=make_scenario_mesh(8))
+        singles = singleton_results(scenarios)
+        assert len(batched) == 5
+        for got, want in zip(batched, singles):
+            assert placements_key(got.placements) == want
+
+    def test_zero_node_scenario_rejected_with_index(self):
+        # there is no node axis to pad onto; the error names the offender
+        # so a 50-scenario manifest is debuggable
         empty = (ClusterSnapshot(nodes=[]), [make_pod("lonely", milli_cpu=100)])
         scenarios = [scenario(30, 8, 5), empty, scenario(31, 6, 4)]
-        results = run_what_if(scenarios)
-        assert len(results) == 3
-        assert results[1].scheduled == 0 and results[1].unschedulable == 1
-        assert results[1].placements[0].message == \
-            "no nodes available to schedule pods"
-        singles = singleton_results([scenarios[0], scenarios[2]])
-        assert placements_key(results[0].placements) == singles[0]
-        assert placements_key(results[2].placements) == singles[1]
+        with pytest.raises(ValueError, match=r"scenario 1: .*zero-node"):
+            run_what_if(scenarios)
 
-    def test_all_scenarios_zero_nodes(self):
+    def test_all_scenarios_zero_nodes_rejected(self):
         empty = (ClusterSnapshot(nodes=[]), [make_pod("p", milli_cpu=10)])
-        results = run_what_if([empty, empty])
-        assert [r.unschedulable for r in results] == [1, 1]
+        with pytest.raises(ValueError, match=r"scenario 0: .*zero-node"):
+            run_what_if([empty, empty])
+
+    def test_unknown_mesh_axes_rejected(self):
+        from jax.sharding import Mesh
+
+        bogus = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                     ("model", "data"))
+        with pytest.raises(ValueError, match=r"axes \('model', 'data'\)"):
+            run_what_if([scenario(40, 4, 3)], mesh=bogus)
 
 
 class TestFastLoop:

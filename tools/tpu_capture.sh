@@ -193,7 +193,8 @@ if smoke_done; then
     echo "== stage 'smoke' already certified on TPU; skipping =="
 else
     # one tiny batch per kernel-variant class (base/most-requested/ports/
-    # disk/spread/vol-zone/interpod/maxpd + the preempt-victim kernel),
+    # disk/spread/vol-zone/interpod/maxpd + the preempt-victim kernel +
+    # the scenario-fleet serve path),
     # each hash-checked against the XLA scan in-process: even a ~2-minute
     # healthy window certifies Mosaic lowering of the whole surface
     if ! python tools/tpu_smoke.py \
@@ -254,6 +255,12 @@ echo "== config-5 second-run wall: $((t_end - t_start))s; CHILD end-to-end" \
     "(the <60s warm-cache criterion — harness probe/spawn overhead is not" \
     "cache-warmness): ${child_e2e:-n/a}s; 0s wall = both runs were already" \
     "captured =="
+
+echo "== stage 3b: scenario-fleet serving (config 8: scenarios/s, warm-cache + mesh curve) =="
+run_stage serve configs:8 bench_results/r5_tpu_serve.jsonl \
+    bench_results/r5_tpu_serve.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=8 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
 
 echo "== stage 4: full XLA ladder (configs 1-5; fresh same-round parity anchors) =="
 run_stage ladder configs:1,2,3,4,5 bench_results/r5_tpu_ladder.jsonl \

@@ -477,6 +477,51 @@ def run_chaos_breaker_variant():
     return expected[:16], transitions
 
 
+def run_serve_fleet_variant():
+    """Scenario fleet (tpusim/serve) on one tiny bucket: serve-path
+    placements must hash-match per-scenario run_what_if — including a
+    ghost-padded partial bucket — and an exact warm repeat must dispatch
+    without tracing a single fresh program."""
+    from tpusim.backends import placement_hash
+    from tpusim.jaxe.whatif import compile_count, run_what_if
+    from tpusim.serve import ScenarioFleet, WhatIfRequest
+
+    base = _base()[0]
+    scenarios = [(base, [make_pod(f"f{s}-p{i}", milli_cpu=(1 + i % 4) * 200,
+                                  memory=(1 + (s + i) % 3) * 2**27)
+                         for i in range(6 + s)])
+                 for s in range(3)]
+    fleet = ScenarioFleet(bucket_size=2, flush_after_s=60.0)
+    load = lambda: [WhatIfRequest(pods=pods, snapshot=snap,  # noqa: E731
+                                  cache_key=f"smoke-{i}")
+                    for i, (snap, pods) in enumerate(scenarios)]
+    # 3 requests / bucket 2: one full bucket + one ghost-padded partial
+    responses = fleet.run(load())
+    hashes = []
+    for resp, (snap, pods) in zip(responses, scenarios):
+        if not resp.ok:
+            raise AssertionError(f"serve request failed: {resp.error}")
+        got = placement_hash(resp.result.placements)
+        [single] = run_what_if([(snap, pods)])
+        want = placement_hash(single.placements)
+        if got != want:
+            raise AssertionError(
+                f"serve placements diverge from run_what_if "
+                f"(ghosts={resp.bucket_ghosts}): {got[:16]} != {want[:16]}")
+        hashes.append(got)
+    before = compile_count()
+    warm = fleet.run(load())
+    traced = compile_count() - before
+    if traced:
+        raise AssertionError(f"warm repeat traced {traced} program(s); "
+                             "the warm-executable cache is broken")
+    if not all(r.compile_cache_hit for r in warm):
+        raise AssertionError("warm responses missing the compile_cache_hit "
+                             "stamp")
+    h = hashlib.sha256("".join(hashes).encode()).hexdigest()[:16]
+    return h, len(responses), dict(fleet.executor.stats)
+
+
 def _write_smoke_trace(recorder):
     """Persist the sweep's flight-recorder trace; never fail the smoke."""
     path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
@@ -545,6 +590,26 @@ def main() -> int:
             ran += 1
             print(f"SMOKE preempt_victim: OK hash={h} victims={n_victims} "
                   f"paths={paths} ({time.time() - t:.1f}s)", flush=True)
+        if not only or "serve_fleet" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "serve_fleet")
+            try:
+                h, n_req, stats = run_serve_fleet_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: serve_fleet: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("requests", n_req)
+            vsp.end()
+            ran += 1
+            print(f"SMOKE serve_fleet: OK hash={h} requests={n_req} "
+                  f"warm_hits={stats['warm_hits']} "
+                  f"({time.time() - t:.1f}s)", flush=True)
         if not only or "chaos_breaker" in only:
             t = time.time()
             vsp = flight.span("smoke_variant")

@@ -306,6 +306,231 @@ def run_what_if_cli(args) -> int:
     return 0
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusim serve",
+        description="Scenario fleet: run the what-if capacity service over "
+                    "a snapshot and drive it with a synthetic request load "
+                    "(tpusim/serve; in-process — no network listener)")
+    parser.add_argument("--snapshot", default="",
+                        help="Combined ClusterSnapshot JSON ({nodes, pods})")
+    parser.add_argument("--nodes", default="", help="nodes.json checkpoint")
+    parser.add_argument("--synthetic-nodes", type=int, default=0,
+                        help="Generate N homogeneous synthetic nodes")
+    parser.add_argument("--synthetic-milli-cpu", type=int, default=4000)
+    parser.add_argument("--synthetic-memory", type=int, default=16 * 1024**3)
+    parser.add_argument("--podspec", required=True,
+                        help="YAML/JSON [{name, pod, num}] entries: the pod "
+                             "pool the load generator draws request "
+                             "workloads from")
+    parser.add_argument("--algorithmprovider", default="DefaultProvider")
+    parser.add_argument("--scheduler-policy-file", default="",
+                        help="schedulerapi/v1 Policy file applied to every "
+                             "request")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--requests", type=int, default=32,
+                        help="Synthetic what-if requests to generate")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="Load-generator seed (request sizes)")
+    parser.add_argument("--bucket-size", type=int, default=4,
+                        help="Scenarios per dispatched device program")
+    parser.add_argument("--flush-after-ms", type=float, default=50.0,
+                        help="Deadline before a partial bucket dispatches "
+                             "ghost-padded")
+    parser.add_argument("--max-queue", type=int, default=256,
+                        help="Admission queue bound (backpressure)")
+    parser.add_argument("--warm-repeats", type=int, default=1,
+                        help="Extra passes over the same request set: repeat "
+                             "traffic must ride the warm-executable and "
+                             "device-batch caches")
+    parser.add_argument("--mesh", default="",
+                        help="Scenario mesh 'SCENARIOxNODE' (e.g. 8x1) or "
+                             "just 'SCENARIO': shard each bucket over the "
+                             "mesh's scenario axis with shard_map "
+                             "(make_scenario_mesh); bucket size must divide "
+                             "over it")
+    parser.add_argument("--platform",
+                        default=os.environ.get("TPUSIM_PLATFORM", ""))
+    parser.add_argument("--quiet", action="store_true",
+                        help="Only print the summary lines")
+    parser.add_argument("--metrics-out", default="",
+                        help="Write the tpusim_serve_* metric families "
+                             "(Prometheus text format) after the run")
+    parser.add_argument("--trace-out", default="",
+                        help="Write the serve: span timeline (Chrome trace "
+                             "JSON, or .jsonl for raw spans)")
+    return parser
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def serve_cli(argv) -> int:
+    """`tpusim serve`: stand up a ScenarioFleet and load-generate against it."""
+    import random
+
+    args = build_serve_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        os.environ["TPUSIM_PROBE"] = "0"
+
+    from tpusim.jaxe import ensure_responsive_platform
+
+    ensure_responsive_platform()
+
+    # snapshot source (load_snapshot's flag subset; no live cluster, no
+    # running-pods checkpoint — the fleet schedules synthetic pods only)
+    try:
+        if args.snapshot:
+            snapshot = ClusterSnapshot.load(args.snapshot)
+        elif args.nodes:
+            snapshot = ClusterSnapshot(nodes=load_nodes_checkpoint(args.nodes))
+        elif args.synthetic_nodes:
+            snapshot = synthetic_cluster(
+                args.synthetic_nodes, milli_cpu=args.synthetic_milli_cpu,
+                memory=args.synthetic_memory)
+        else:
+            print("error: no cluster nodes; pass --snapshot, --nodes, or "
+                  "--synthetic-nodes", file=sys.stderr)
+            return 2
+        sim_pods = load_simulation_pods(args.podspec)
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    pool = expand_simulation_pods(sim_pods, namespace=args.namespace)
+    if not pool:
+        print("error: podspec expands to zero pods", file=sys.stderr)
+        return 2
+
+    policy = None
+    if args.scheduler_policy_file:
+        from tpusim.engine.policy import PolicyError, load_policy_file
+
+        try:
+            policy = load_policy_file(args.scheduler_policy_file)
+        except (OSError, PolicyError) as exc:
+            print(f"error: invalid scheduler policy: {exc}", file=sys.stderr)
+            return 2
+
+    mesh = None
+    if args.mesh:
+        import jax
+
+        from tpusim.jaxe.sharding import make_scenario_mesh
+
+        try:
+            scen_s, _, node_s = args.mesh.lower().partition("x")
+            scen, node = int(scen_s), int(node_s or 1)
+            if scen < 1 or node < 1:
+                raise ValueError
+        except ValueError:
+            print(f"error: --mesh {args.mesh!r}: want 'SCENARIOxNODE' "
+                  "(e.g. 8x1) or 'SCENARIO'", file=sys.stderr)
+            return 2
+        have = len(jax.devices())
+        if scen * node > have:
+            print(f"error: --mesh {args.mesh} needs {scen * node} devices, "
+                  f"{have} visible", file=sys.stderr)
+            return 2
+        mesh = make_scenario_mesh(scen * node, scenario=scen)
+
+    recorder = None
+    if args.trace_out:
+        from tpusim.obs import recorder as flight
+
+        recorder = flight.install(flight.FlightRecorder())
+
+    from tpusim.serve import ScenarioFleet, WhatIfRequest
+
+    try:
+        fleet = ScenarioFleet(provider=args.algorithmprovider,
+                              bucket_size=args.bucket_size,
+                              flush_after_s=args.flush_after_ms / 1000.0,
+                              max_queue=args.max_queue, mesh=mesh)
+    except (KeyError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    fleet.register_snapshot("base", snapshot)
+
+    # the load: random-size what-if queries drawn from the pod pool, each
+    # cache-keyed so warm repeats exercise the staged + device-batch caches
+    rng = random.Random(args.seed)
+    sizes = [rng.randint(1, len(pool)) for _ in range(args.requests)]
+    make_load = lambda: [  # noqa: E731
+        WhatIfRequest(pods=pool[:n], snapshot_ref="base", policy=policy,
+                      cache_key=f"load-{i}-{n}")
+        for i, n in enumerate(sizes)]
+
+    fleet.start()
+    try:
+        passes = []  # (label, elapsed, responses)
+        for rep in range(1 + max(0, args.warm_repeats)):
+            label = "cold" if rep == 0 else f"warm {rep}"
+            start = time.perf_counter()
+            futures = [fleet.submit(r) for r in make_load()]
+            responses = [f.result(timeout=600) for f in futures]
+            passes.append((label, time.perf_counter() - start, responses))
+    finally:
+        fleet.stop()
+
+    stats = fleet.executor.stats
+    exit_code = 0
+    for label, elapsed, responses in passes:
+        ok = [r for r in responses if r.ok]
+        rejected = [r for r in responses if r.rejected is not None]
+        errors = [r for r in responses if r.error and r.rejected is None]
+        lat = sorted(r.latency_s for r in ok)
+        rate = len(responses) / elapsed if elapsed > 0 else 0.0
+        hits = sum(1 for r in ok if r.compile_cache_hit)
+        print(f"{label}: {len(ok)}/{len(responses)} ok "
+              f"({len(rejected)} rejected, {len(errors)} failed), "
+              f"{rate:.1f} scenarios/s, latency p50/p90/max "
+              f"{_percentile(lat, 0.5) * 1e3:.1f}/"
+              f"{_percentile(lat, 0.9) * 1e3:.1f}/"
+              f"{(lat[-1] if lat else 0.0) * 1e3:.1f} ms, "
+              f"compile_cache_hit {hits}/{len(ok)}")
+        if not args.quiet:
+            for r in rejected[:5]:
+                print(f"  rejected {r.request_id}: [{r.rejected}] {r.error}",
+                      file=sys.stderr)
+            for r in errors[:5]:
+                print(f"  failed {r.request_id}: {r.error}", file=sys.stderr)
+        if errors:
+            exit_code = 1
+    print(f"fleet: {stats['dispatches']} dispatches "
+          f"({stats['warm_hits']} warm, {stats['device_batch_hits']} "
+          f"device-resident), {stats['traces']} program traces, "
+          f"{stats['staged_hits']} staged-cache hits"
+          + (f", mesh {mesh.shape['scenario']}x{mesh.shape['node']}"
+             if mesh is not None else ""))
+
+    if recorder is not None:
+        from tpusim.obs import recorder as flight
+
+        flight.uninstall()
+        try:
+            recorder.write(args.trace_out)
+        except OSError as exc:
+            print(f"error: failed to write trace: {exc}", file=sys.stderr)
+            return 2
+        if not args.quiet:
+            print(f"trace: {args.trace_out} ({len(recorder.events)} events)",
+                  file=sys.stderr)
+    if args.metrics_out:
+        try:
+            _write_metrics(args.metrics_out)
+        except OSError as exc:
+            print(f"error: failed to write metrics: {exc}", file=sys.stderr)
+            return 2
+    return exit_code
+
+
 def _write_metrics(path: str) -> None:
     """Dump the registry in Prometheus text exposition format (the scrape
     body the reference never served; framework/metrics.py docstring)."""
@@ -316,6 +541,10 @@ def _write_metrics(path: str) -> None:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        return serve_cli(argv[1:])
     args = build_parser().parse_args(argv)
     feature_gates = None
     if args.feature_gates:

@@ -221,6 +221,25 @@ class SchedulerMetrics:
         self.breaker_state = self._reg(Gauge(
             "tpusim_breaker_state",
             "Device-dispatch breaker state (0 closed, 0.5 half-open, 1 open)"))
+        # scenario-fleet serving telemetry (ISSUE 6): the what-if capacity
+        # service — admission queue, shape-class buckets, dispatch cache
+        self.serve_queue_depth = self._reg(Gauge(
+            "tpusim_serve_queue_depth",
+            "What-if requests admitted and waiting to be bucketed"))
+        self.serve_batch_occupancy = self._reg(Histogram(
+            "tpusim_serve_batch_occupancy",
+            "Real (non-ghost) scenarios per dispatched bucket",
+            [1, 2, 4, 8, 16, 32, 64]))
+        self.serve_request_latency = self._reg(Histogram(
+            "tpusim_serve_request_latency_microseconds",
+            "Admission to decoded-result latency per what-if request",
+            _LATENCY_BUCKETS))
+        self.serve_rejected = self._reg(LabeledCounter(
+            "tpusim_serve_rejected_total",
+            "What-if requests rejected at admission, by reason", "reason"))
+        self.serve_dispatch = self._reg(LabeledCounter(
+            "tpusim_serve_dispatch_total",
+            "Bucket dispatches by warm-executable-cache outcome", "path"))
 
     def _reg(self, metric):
         self._registry.append(metric)

@@ -24,7 +24,8 @@ registries, so new state fields inherit padding + sharding automatically.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import re
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpusim.jaxe.kernels import (
     CARRY_AXES,
     PAD_FILLS,
+    PODX_AXES,
     STATICS_AXES,
     Carry,
     PodX,
@@ -56,6 +58,89 @@ def make_mesh(n_devices: Optional[int] = None, snap: int = 1,
         raise ValueError(f"{n} devices do not factor into snap={snap}")
     grid = np.array(devices).reshape(snap, n // snap)
     return Mesh(grid, ("snap", "node"))
+
+
+def make_scenario_mesh(n_devices: Optional[int] = None,
+                       scenario: Optional[int] = None,
+                       devices: Optional[list] = None) -> Mesh:
+    """A ("scenario", "node") mesh for the shard_map what-if route: the
+    scenario axis is partitioned MANUALLY (whatif._scenario_sharded), with
+    node columns kept whole inside each shard — the per-step node reductions
+    (argmax, tie counts, rank cumsum) stay shard-local instead of becoming
+    collectives. `scenario` defaults to every visible device (node dim 1);
+    a node dim > 1 replicates the manual program across node rows."""
+    if devices is None:
+        devices = jax.devices()
+    devices = devices[: (n_devices or len(devices))]
+    n = len(devices)
+    scenario = scenario or n
+    if n % scenario != 0:
+        raise ValueError(f"{n} devices do not factor into scenario={scenario}")
+    grid = np.array(devices).reshape(scenario, n // scenario)
+    return Mesh(grid, ("scenario", "node"))
+
+
+def mesh_kind(mesh: Mesh) -> str:
+    """Which what-if route a mesh selects: "snap" (GSPMD vmap: snapshot axis
+    over "snap", node columns over "node") or "scenario" (manual shard_map
+    over "scenario", node columns whole per shard). Anything else is a
+    caller error surfaced here instead of as a KeyError inside dispatch."""
+    names = tuple(mesh.axis_names)
+    if names == ("snap", "node"):
+        return "snap"
+    if names == ("scenario", "node"):
+        return "scenario"
+    raise ValueError(
+        f"what-if mesh has axes {names!r}; want ('snap', 'node') "
+        "(make_mesh: GSPMD-sharded vmap) or ('scenario', 'node') "
+        "(make_scenario_mesh: manual shard_map over scenarios)")
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]],
+                          fields: Sequence[str],
+                          prefix: str = "") -> Dict[str, P]:
+    """Regex-rule PartitionSpec assignment (SNIPPETS [1] idiom): each field
+    is matched as "prefix/name" against the rules in order; the first hit
+    assigns its PartitionSpec, no hit means replicated (P()). Keeps the
+    sharding story declarative as state trees grow fields."""
+    out: Dict[str, P] = {}
+    for name in fields:
+        path = f"{prefix}/{name}" if prefix else name
+        for pattern, spec in rules:
+            if re.search(pattern, path):
+                out[name] = spec
+                break
+        else:
+            out[name] = P()
+    return out
+
+
+# The stacked what-if batch: every statics/carry/xs leaf gains a leading
+# scenario axis in _stack_host, so every tree matches its prefix rule; the
+# replicated default only catches future scalar/config leaves.
+SCENARIO_BATCH_RULES: Tuple[Tuple[str, P], ...] = (
+    (r"^statics/", P("scenario")),
+    (r"^carry/", P("scenario")),
+    (r"^xs/", P("scenario")),
+)
+
+
+def scenario_specs() -> Tuple[Carry, Statics, PodX]:
+    """PartitionSpec trees (carry, statics, xs) for the shard_map what-if
+    program, derived from the axis registries via the regex rules."""
+    ca = match_partition_rules(SCENARIO_BATCH_RULES, CARRY_AXES, "carry")
+    st = match_partition_rules(SCENARIO_BATCH_RULES, STATICS_AXES, "statics")
+    xs = match_partition_rules(SCENARIO_BATCH_RULES, PODX_AXES, "xs")
+    return Carry(**ca), Statics(**st), PodX(**xs)
+
+
+def scenario_shardings(mesh: Mesh) -> Tuple[Carry, Statics, PodX]:
+    """NamedSharding trees matching scenario_specs, for placing the stacked
+    host batch so the shard_map program starts without a reshard."""
+    ca, st, xs = scenario_specs()
+    named = lambda tree: type(tree)(  # noqa: E731
+        **{k: NamedSharding(mesh, v) for k, v in tree._asdict().items()})
+    return named(ca), named(st), named(xs)
 
 
 def _pad_to(n: int, multiple: int) -> int:

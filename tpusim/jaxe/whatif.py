@@ -33,7 +33,7 @@ import numpy as np
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.api.types import Pod
-from tpusim.backends import Placement, mark_unschedulable
+from tpusim.backends import Placement
 from tpusim.jaxe import ensure_x64
 from tpusim.jaxe.backend import (
     _KNOWN_PROVIDERS,
@@ -53,12 +53,30 @@ from tpusim.jaxe.kernels import (
     pod_columns_to_host,
     statics_to_host,
 )
-from tpusim.jaxe.sharding import pad_node_axis, snap_shardings
+from tpusim.jaxe.sharding import (
+    mesh_kind,
+    pad_node_axis,
+    scenario_shardings,
+    scenario_specs,
+    snap_shardings,
+)
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
 
 log = logging.getLogger(__name__)
 
 GHOST_CPU = np.int64(1) << 61  # larger than any allocatable: never feasible
+
+# Trace-time compile tally: the increments below run while jax TRACES a
+# program (cache miss), not when a cached executable re-runs — so the delta
+# across two calls says whether the second paid a compile. The serve
+# executor's warm-cache stamps and the bench config-8 `compile_cache_hit`
+# field are both read off this counter.
+_COMPILE_COUNTS = {"batched": 0, "scenario_sharded": 0}
+
+
+def compile_count() -> int:
+    """Total what-if program traces this process (see _COMPILE_COUNTS)."""
+    return sum(_COMPILE_COUNTS.values())
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -67,12 +85,51 @@ def _batched(config, carries, statics_b, xs_b):
     so jax's compile cache persists across run_what_if invocations: repeated
     what-if studies with matching shapes+config skip the (minutes-long on
     TPU) XLA compile that dominates a cold call (BASELINE.md config 5)."""
+    _COMPILE_COUNTS["batched"] += 1
 
     def one(carry, st, xs):
         _, choices, counts, _adv = _schedule_scan_impl(config, carry, st, xs)
         return choices, counts
 
     return jax.vmap(one)(carries, statics_b, xs_b)
+
+
+# (config, mesh) -> jitted shard_map program. jax's jit cache would dedupe
+# the executables anyway; this dict also dedupes the shard_map/closure
+# CONSTRUCTION and gives the serve executor a stable identity to key its
+# warm-cache bookkeeping on.
+_SCENARIO_PROGRAMS: dict = {}
+
+
+def _scenario_program(config, mesh):
+    """The manual shard_map route: scenarios partitioned over the mesh's
+    "scenario" axis, node columns whole per shard (make_scenario_mesh).
+    Cross-scenario communication is impossible by construction — each shard
+    runs the vmap-of-scan on its own scenario slice. check_rep=False because
+    out_specs carry no replicated axes to prove."""
+    fn = _SCENARIO_PROGRAMS.get((config, mesh))
+    if fn is None:
+        from jax.experimental.shard_map import shard_map
+
+        ca_spec, st_spec, xs_spec = scenario_specs()
+
+        def local(carries, statics_b, xs_b):
+            _COMPILE_COUNTS["scenario_sharded"] += 1
+
+            def one(carry, st, xs):
+                _, choices, counts, _adv = _schedule_scan_impl(
+                    config, carry, st, xs)
+                return choices, counts
+
+            return jax.vmap(one)(carries, statics_b, xs_b)
+
+        from jax.sharding import PartitionSpec as P
+
+        fn = jax.jit(shard_map(
+            local, mesh=mesh, in_specs=(ca_spec, st_spec, xs_spec),
+            out_specs=(P("scenario"), P("scenario")), check_rep=False))
+        _SCENARIO_PROGRAMS[(config, mesh)] = fn
+    return fn
 
 
 @dataclass
@@ -145,22 +202,11 @@ def _unify(statics: Statics, carry: Carry, xs: PodX, targets: dict,
     return Statics(**st_fields), Carry(**ca_fields), PodX(**fields)
 
 
-def _prepare_host_batch(scenarios, provider: str,
-                        hard_pod_affinity_symmetric_weight: int, policy,
-                        n_snap_shards: int, n_node_shards: int):
-    """Compile the batch on host numpy (shape unification is deferred:
-    `_unify_batch` pads the returned host_trees for the vmap program — the
-    Pallas fast loop consumes the per-scenario compiled state directly and
-    must not pay for padding it would throw away).
-
-    Returns (prep, early): `early` is the finished result list when nothing
-    needs the device (no scenarios / all zero-node); otherwise `prep` is
-    (config, host_trees, real_count, batch_indices, compiled_list,
-    empty_results, ptabs_list) — ptabs_list holds each scenario's
-    PolicyTables (None without a policy) for the fast loop's planner.
-    """
-    if provider not in _KNOWN_PROVIDERS:
-        raise KeyError(f"plugin {provider!r} has not been registered")
+def _policy_prep(policy, hard_pod_affinity_symmetric_weight: int):
+    """Compile the batch-wide policy once: (cp, need_noexec, need_saa,
+    hard_weight). Shared by run_what_if and the serve executor (which keys
+    its warm-executable cache on cp.spec — the what-if analog of the fast
+    path's plan_signature)."""
     cp = None
     if policy is not None:
         from tpusim.jaxe.policyc import compile_policy
@@ -182,87 +228,130 @@ def _prepare_host_batch(scenarios, provider: str,
                    in cp.spec.pred_keys)
     need_saa = cp is not None and (bool(cp.spec.saa_weights)
                                    or cp.spec.sa_enabled)
-    if not scenarios:
-        return None, []
-    ensure_x64()
+    return cp, need_noexec, need_saa, hard_pod_affinity_symmetric_weight
 
-    # zero-node scenarios can't join the batch (no node axis to pad onto);
-    # resolve them host-side exactly like JaxBackend.schedule's empty guard
-    empty_results: dict = {}
-    batch_indices: List[int] = []
-    compiled_list = []
-    for i, (snapshot, pods) in enumerate(scenarios):
-        if not snapshot.nodes:
-            msg = "no nodes available to schedule pods"
-            placements = [Placement(pod=mark_unschedulable(p, msg),
-                                    reason="Unschedulable", message=msg)
-                          for p in pods]
-            empty_results[i] = WhatIfResult(placements=placements, scheduled=0,
-                                            unschedulable=len(pods))
-            continue
-        compiled, cols = compile_cluster(snapshot, pods,
-                                         need_noexec=need_noexec,
-                                         need_saa=need_saa)
-        if compiled.unsupported:
-            detail = "; ".join(sorted(set(compiled.unsupported))[:5])
-            raise NotImplementedError(
-                "what-if batching requires jax-compilable scenarios; "
-                f"unsupported: {detail} (run this scenario on the reference "
-                "backend instead)")
-        batch_indices.append(i)
-        compiled_list.append((compiled, cols))
-    if not compiled_list:
-        return None, [empty_results[i] for i in range(len(scenarios))]
 
-    # host-side trees: unify + pad on numpy, upload once after stacking
+@dataclass
+class StagedScenario:
+    """One scenario compiled to host trees, ready to batch (run_what_if) or
+    bucket (tpusim.serve): the unit the serve snapshot cache stores."""
+
+    compiled: object
+    cols: object
+    statics: Statics
+    carry: Carry
+    xs: PodX
+    ptabs: object
+    n_saa_doms: int
+
+
+def _stage_scenario(snapshot: ClusterSnapshot, pods: List[Pod], cp,
+                    need_noexec: bool, need_saa: bool) -> StagedScenario:
+    """Host-stage one (snapshot, pods) scenario: compile_cluster + policy
+    tables + host trees. Raises ValueError for a zero-node snapshot (there
+    is no node axis to pad onto) and NotImplementedError for scenarios the
+    device engine can't express."""
+    if not snapshot.nodes:
+        raise ValueError(
+            "what-if scenario has a zero-node snapshot: nothing can "
+            "schedule; run scenarios against at least one node")
+    compiled, cols = compile_cluster(snapshot, pods, need_noexec=need_noexec,
+                                     need_saa=need_saa)
+    if compiled.unsupported:
+        detail = "; ".join(sorted(set(compiled.unsupported))[:5])
+        raise NotImplementedError(
+            "what-if batching requires jax-compilable scenarios; "
+            f"unsupported: {detail} (run this scenario on the reference "
+            "backend instead)")
+    host_statics = statics_to_host(compiled)
+    host_carry = carry_init_host(compiled)
+    ptabs = None
     n_saa_doms = 1
-    host_trees = []
-    ptabs_list = []
-    for b, (compiled, cols) in enumerate(compiled_list):
-        host_statics = statics_to_host(compiled)
-        host_carry = carry_init_host(compiled)
-        ptabs = None
-        if cp is not None:
-            # one build per scenario feeds the vmap statics AND the fast
-            # loop's plan (the trivial PolicyTables shapes match
-            # statics_to_host / carry_init_host, so unconditional replace
-            # is byte-identical for features the policy lacks)
-            from tpusim.jaxe.policyc import build_policy_tables
+    if cp is not None:
+        # one build per scenario feeds the vmap statics AND the fast
+        # loop's plan (the trivial PolicyTables shapes match
+        # statics_to_host / carry_init_host, so unconditional replace
+        # is byte-identical for features the policy lacks)
+        from tpusim.jaxe.policyc import build_policy_tables
 
-            snapshot, pods = scenarios[batch_indices[b]]
-            ptabs = build_policy_tables(cp, snapshot, pods, compiled, cols)
-            host_statics = host_statics._replace(
-                label_ok=ptabs.label_ok, label_prio=ptabs.label_prio,
-                image_score=ptabs.image_score, saa_dom=ptabs.saa_dom,
-                sa_pin=ptabs.sa_pin, sa_val=ptabs.sa_val)
-            host_carry = host_carry._replace(sa_lock=ptabs.sa_lock_init)
-            n_saa_doms = max(n_saa_doms, ptabs.n_saa_doms)
-        ptabs_list.append(ptabs)
-        host_trees.append((host_statics, host_carry,
-                           pod_columns_to_host(cols)))
+        ptabs = build_policy_tables(cp, snapshot, pods, compiled, cols)
+        host_statics = host_statics._replace(
+            label_ok=ptabs.label_ok, label_prio=ptabs.label_prio,
+            image_score=ptabs.image_score, saa_dom=ptabs.saa_dom,
+            sa_pin=ptabs.sa_pin, sa_val=ptabs.sa_val)
+        host_carry = host_carry._replace(sa_lock=ptabs.sa_lock_init)
+        n_saa_doms = ptabs.n_saa_doms
+    return StagedScenario(compiled=compiled, cols=cols, statics=host_statics,
+                          carry=host_carry, xs=pod_columns_to_host(cols),
+                          ptabs=ptabs, n_saa_doms=n_saa_doms)
 
-    s_max = max(len(c.scalar_names) for c, _ in compiled_list)
-    real_count = len(host_trees)
+
+def batch_config(compiled_list, provider: str, cp, hard_weight: int,
+                 n_saa_doms: int, num_scalars: Optional[int] = None):
+    """EngineConfig for a batch of compiled scenarios. num_scalars widens
+    the reason-bit space beyond the batch's own max (the serve executor
+    pins it to the shape class's scalar budget so every bucket of a class
+    traces one program; unused high bits never fire)."""
+    s_max = max(len(c.scalar_names) for c in compiled_list)
+    if num_scalars is not None:
+        s_max = max(s_max, num_scalars)
     config = config_for(
-        [c for c, _ in compiled_list],
+        compiled_list,
         most_requested=provider in _MOST_REQUESTED_PROVIDERS,
         num_reason_bits=NUM_FIXED_BITS + s_max,
-        hard_weight=hard_pod_affinity_symmetric_weight)
+        hard_weight=hard_weight)
     if cp is not None:
         from dataclasses import replace as _dc_replace
 
         config = _dc_replace(config, policy=cp.spec, n_saa_doms=n_saa_doms)
-    return (config, host_trees, real_count, batch_indices, compiled_list,
-            empty_results, ptabs_list), None
+    return config
 
 
-def _unify_batch(scenarios, host_trees, batch_indices,
-                 n_snap_shards: int, n_node_shards: int):
+def _prepare_host_batch(scenarios, provider: str,
+                        hard_pod_affinity_symmetric_weight: int, policy):
+    """Compile the batch on host numpy (shape unification is deferred:
+    `_unify_batch` pads the returned host_trees for the vmap program — the
+    Pallas fast loop consumes the per-scenario compiled state directly and
+    must not pay for padding it would throw away).
+
+    Returns (config, host_trees, compiled_list, ptabs_list) — ptabs_list
+    holds each scenario's PolicyTables (None without a policy) for the fast
+    loop's planner. Raises ValueError for input shapes that cannot batch
+    (empty scenario list, zero-node snapshots) — clear host-side errors
+    instead of a failure inside jit.
+    """
+    if provider not in _KNOWN_PROVIDERS:
+        raise KeyError(f"plugin {provider!r} has not been registered")
+    if not scenarios:
+        raise ValueError(
+            "run_what_if needs at least one (snapshot, pods) scenario")
+    cp, need_noexec, need_saa, hard_weight = _policy_prep(
+        policy, hard_pod_affinity_symmetric_weight)
+    ensure_x64()
+
+    staged: List[StagedScenario] = []
+    for i, (snapshot, pods) in enumerate(scenarios):
+        try:
+            staged.append(_stage_scenario(snapshot, pods, cp,
+                                          need_noexec, need_saa))
+        except ValueError as exc:
+            raise ValueError(f"scenario {i}: {exc}") from None
+
+    host_trees = [(s.statics, s.carry, s.xs) for s in staged]
+    compiled_list = [(s.compiled, s.cols) for s in staged]
+    ptabs_list = [s.ptabs for s in staged]
+    config = batch_config(
+        [s.compiled for s in staged], provider, cp, hard_weight,
+        n_saa_doms=max(s.n_saa_doms for s in staged))
+    return config, host_trees, compiled_list, ptabs_list
+
+
+def _unify_batch(host_trees, n_snap_shards: int, n_node_shards: int):
     """Shape-unify + pad the compiled host trees for the batched vmap
     program; returns per_scenario (carry, statics, xs) tuples padded to
     the snap-shard multiple."""
     targets = _axis_targets(host_trees)
-    p_max = max(len(scenarios[i][1]) for i in batch_indices)
+    p_max = max(np.asarray(xs.req_cpu).shape[0] for _, _, xs in host_trees)
     n_max = max(s.alloc_cpu.shape[0] for s, _, _ in host_trees)
     # one pad target: max nodes rounded up to the node-shard multiple
     n_target = -(-n_max // n_node_shards) * n_node_shards
@@ -288,25 +377,27 @@ def _stack_host(per_scenario):
             stack([t[2] for t in per_scenario]))
 
 
-def _decode_batch(scenarios, batch_indices, compiled_list, empty_results,
-                  real_count, choices_b, counts_b) -> List[WhatIfResult]:
-    batch_results: dict = {}
-    for b in range(real_count):
-        i = batch_indices[b]
-        compiled, _ = compiled_list[b]
-        _, pods = scenarios[i]
-        placements, scheduled = decode_placements(
-            pods, choices_b[b], counts_b[b], compiled.statics.names,
-            reason_strings(compiled.scalar_names))
-        batch_results[i] = WhatIfResult(placements=placements,
-                                        scheduled=scheduled,
-                                        unschedulable=len(pods) - scheduled)
-    batch_results.update(empty_results)
-    return [batch_results[i] for i in range(len(scenarios))]
+def decode_one(pods: List[Pod], compiled, choices, counts) -> WhatIfResult:
+    """Decode one scenario's device outputs back to placements (shared with
+    the serve executor, whose buckets decode only their REAL entries — ghost
+    scenarios and pod-axis padding never reach here)."""
+    placements, scheduled = decode_placements(
+        pods, choices, counts, compiled.statics.names,
+        reason_strings(compiled.scalar_names))
+    return WhatIfResult(placements=placements, scheduled=scheduled,
+                        unschedulable=len(pods) - scheduled)
 
 
-def _try_fast_loop(scenarios, config, batch_indices, compiled_list,
-                   empty_results, real_count, ptabs_list, host_trees):
+def _decode_batch(scenarios, compiled_list, choices_b,
+                  counts_b) -> List[WhatIfResult]:
+    # the batch may be longer than the scenario list (scenario-axis padding
+    # replicas); iterate the real scenarios only
+    return [decode_one(scenarios[b][1], compiled_list[b][0], choices_b[b],
+                       counts_b[b])
+            for b in range(len(scenarios))]
+
+
+def _try_fast_loop(scenarios, config, compiled_list, ptabs_list, host_trees):
     """Run every scenario through the Pallas fast path sequentially;
     returns the decoded results, or None to fall back to the batched vmap
     program (ineligible scenario, fast path off/disabled, kernel failure,
@@ -332,7 +423,7 @@ def _try_fast_loop(scenarios, config, batch_indices, compiled_list,
         if plan is None:
             _note_fast_fallback(register(), why)
             log.info("what-if fast loop ineligible (scenario %d: %s); "
-                     "using the batched vmap program", batch_indices[b], why)
+                     "using the batched vmap program", b, why)
             return None
         plans.append(plan)
     choices_list = []
@@ -364,9 +455,7 @@ def _try_fast_loop(scenarios, config, batch_indices, compiled_list,
                 return None
         choices_list.append(choices)
         counts_list.append(counts)
-    return _decode_batch(scenarios, batch_indices, compiled_list,
-                         empty_results, real_count, choices_list,
-                         counts_list)
+    return _decode_batch(scenarios, compiled_list, choices_list, counts_list)
 
 
 def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
@@ -378,24 +467,30 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     program. Pods are fed in podspec order (callers wanting reference LIFO
     parity pass the reversed list, as run_simulation does).
 
-    mesh: an optional ("snap", "node") jax.sharding.Mesh (sharding.make_mesh);
-    None runs single-device. The scenario count need not divide the snap axis —
-    the batch is padded with a replica of the first scenario and the padding
-    dropped on decode.
+    mesh: an optional jax.sharding.Mesh; None runs single-device. A
+    ("snap", "node") mesh (sharding.make_mesh) runs the GSPMD route: the
+    scenario axis sharded over "snap", node columns over "node" with XLA
+    collectives. A ("scenario", "node") mesh (sharding.make_scenario_mesh)
+    runs the manual shard_map route: scenarios partitioned with node columns
+    whole per shard — the serving shape, where scenario throughput is the
+    axis that matters. Any other axis names raise ValueError. The scenario
+    count need not divide the scenario/snap axis — the batch is padded with
+    a replica of the first scenario and the padding dropped on decode.
 
     policy: an engine.policy.Policy applied to EVERY scenario (one jitted
     program serves the batch, so the policy is batch-wide); host-bound policy
     features raise — what-if has no per-scenario host fallback.
+
+    Raises ValueError for inputs that cannot batch — empty scenario list,
+    zero-node snapshots, unknown mesh axes — before anything reaches jit.
     """
-    n_snap_shards = mesh.shape["snap"] if mesh is not None else 1
-    n_node_shards = mesh.shape["node"] if mesh is not None else 1
-    prep, early = _prepare_host_batch(
-        scenarios, provider, hard_pod_affinity_symmetric_weight, policy,
-        n_snap_shards, n_node_shards)
-    if prep is None:
-        return early
-    (config, host_trees, real_count, batch_indices, compiled_list,
-     empty_results, ptabs_list) = prep
+    kind = mesh_kind(mesh) if mesh is not None else None
+    n_snap_shards = 1 if mesh is None else (
+        mesh.shape["snap"] if kind == "snap" else mesh.shape["scenario"])
+    # the shard_map route keeps node columns whole per shard: no node pad
+    n_node_shards = mesh.shape["node"] if kind == "snap" else 1
+    config, host_trees, compiled_list, ptabs_list = _prepare_host_batch(
+        scenarios, provider, hard_pod_affinity_symmetric_weight, policy)
 
     if mesh is None:
         # Pallas fast loop: per-scenario kernels instead of the single
@@ -405,28 +500,34 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         # process (AUTO on TPU, sharing the backend's self-verification
         # state); anything else keeps the batched program. Runs BEFORE the
         # shape unification below, which the fast loop never needs.
-        fast = _try_fast_loop(scenarios, config, batch_indices,
-                              compiled_list, empty_results, real_count,
-                              ptabs_list, host_trees)
+        fast = _try_fast_loop(scenarios, config, compiled_list, ptabs_list,
+                              host_trees)
         if fast is not None:
             return fast
 
-    per_scenario = _unify_batch(scenarios, host_trees, batch_indices,
-                                n_snap_shards, n_node_shards)
+    per_scenario = _unify_batch(host_trees, n_snap_shards, n_node_shards)
     host_carries, host_statics, host_xs = _stack_host(per_scenario)
     if mesh is not None:
         # sharded upload straight from host numpy — materializing on the
         # default device first would double the transfer and peak memory
-        st_spec, ca_spec, xs_spec = snap_shardings(mesh)
+        if kind == "snap":
+            st_spec, ca_spec, xs_spec = snap_shardings(mesh)
+            xs_b = jax.tree.map(lambda a: jax.device_put(a, xs_spec), host_xs)
+        else:
+            ca_spec, st_spec, xs_sh = scenario_shardings(mesh)
+            xs_b = jax.tree.map(jax.device_put, host_xs, xs_sh)
         carries = jax.tree.map(jax.device_put, host_carries, ca_spec)
         statics_b = jax.tree.map(jax.device_put, host_statics, st_spec)
-        xs_b = jax.tree.map(lambda a: jax.device_put(a, xs_spec), host_xs)
     else:
         to_dev = lambda tree: jax.tree.map(jnp.asarray, tree)  # noqa: E731
         carries, statics_b, xs_b = (to_dev(host_carries),
                                     to_dev(host_statics), to_dev(host_xs))
 
-    if mesh is not None:
+    if kind == "scenario":
+        choices_b, counts_b = _scenario_program(config, mesh)(
+            carries, statics_b, xs_b)
+        choices_b = np.asarray(choices_b)
+    elif kind == "snap":
         with mesh:
             choices_b, counts_b = _batched(config, carries, statics_b, xs_b)
             choices_b = np.asarray(choices_b)
@@ -434,8 +535,7 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         choices_b, counts_b = _batched(config, carries, statics_b, xs_b)
         choices_b = np.asarray(choices_b)
     counts_b = np.asarray(counts_b)
-    return _decode_batch(scenarios, batch_indices, compiled_list,
-                         empty_results, real_count, choices_b, counts_b)
+    return _decode_batch(scenarios, compiled_list, choices_b, counts_b)
 
 
 def run_what_if_multihost(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
@@ -460,15 +560,10 @@ def run_what_if_multihost(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]]
 
     nproc = jax.process_count()
     n_node = jax.local_device_count()
-    prep, early = _prepare_host_batch(
-        scenarios, provider, hard_pod_affinity_symmetric_weight, policy,
-        n_snap_shards=nproc, n_node_shards=n_node)
-    if prep is None:
-        return early
-    (config, host_trees, real_count, batch_indices, compiled_list,
-     empty_results, _ptabs_list) = prep
-    per_scenario = _unify_batch(scenarios, host_trees, batch_indices,
-                                n_snap_shards=nproc, n_node_shards=n_node)
+    config, host_trees, compiled_list, _ptabs_list = _prepare_host_batch(
+        scenarios, provider, hard_pod_affinity_symmetric_weight, policy)
+    per_scenario = _unify_batch(host_trees, n_snap_shards=nproc,
+                                n_node_shards=n_node)
 
     # jax.devices() orders process 0's devices first, then process 1's, ...
     # so reshaping to (nproc, n_node) gives each process its own snap row
@@ -491,5 +586,4 @@ def run_what_if_multihost(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]]
         # fully replicated -> every shard addressable on every process
         choices_b = np.asarray(replicate(choices_b))
         counts_b = np.asarray(replicate(counts_b))
-    return _decode_batch(scenarios, batch_indices, compiled_list,
-                         empty_results, real_count, choices_b, counts_b)
+    return _decode_batch(scenarios, compiled_list, choices_b, counts_b)

@@ -292,6 +292,17 @@ def note_breaker(name: str, transition: str, state_value: float,
         rec.instant("breaker:" + transition, "device", args)
 
 
+def note_serve(event: str, args: Optional[Dict[str, Any]] = None) -> None:
+    """A scenario-fleet lifecycle point: admit/reject/bucket/flush/
+    dispatch/decode. Request-scoped phases additionally open `serve:*`
+    SPANS at the call sites (tpusim.serve.*) so a trace shows the
+    admission -> bucket -> dispatch -> decode pipeline per request; these
+    instants mark the zero-duration transitions between them."""
+    rec = _active
+    if rec is not None:
+        rec.instant("serve:" + event, "host", args)
+
+
 def note_watch_overflow(resource: str) -> None:
     """A watch stream died on buffer overflow (the "410 Gone" analog):
     the consumer must relist to resync."""

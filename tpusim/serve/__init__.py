@@ -1,0 +1,43 @@
+"""Scenario fleet: a sharded what-if capacity-planning service.
+
+An async front over `tpusim.jaxe.whatif`: requests are admitted through a
+bounded queue, bucketed into fixed shape classes, and dispatched — full or
+ghost-padded — as one device program per bucket, optionally shard_map'd over
+a ("scenario", "node") mesh. See service.ScenarioFleet for the lifecycle.
+"""
+
+from tpusim.serve.batcher import Bucket, PendingEntry, ShapeClassBatcher
+from tpusim.serve.executor import ServeExecutor
+from tpusim.serve.queue import AdmissionQueue
+from tpusim.serve.request import (
+    REJECT_INVALID,
+    REJECT_QUEUE_FULL,
+    REJECT_SHUTDOWN,
+    REJECT_UNKNOWN_SNAPSHOT,
+    REJECT_UNSUPPORTED,
+    ServeRejected,
+    ShapeClass,
+    WhatIfRequest,
+    WhatIfResponse,
+    shape_class_for,
+)
+from tpusim.serve.service import ScenarioFleet
+
+__all__ = [
+    "AdmissionQueue",
+    "Bucket",
+    "PendingEntry",
+    "REJECT_INVALID",
+    "REJECT_QUEUE_FULL",
+    "REJECT_SHUTDOWN",
+    "REJECT_UNKNOWN_SNAPSHOT",
+    "REJECT_UNSUPPORTED",
+    "ScenarioFleet",
+    "ServeExecutor",
+    "ServeRejected",
+    "ShapeClass",
+    "ShapeClassBatcher",
+    "WhatIfRequest",
+    "WhatIfResponse",
+    "shape_class_for",
+]

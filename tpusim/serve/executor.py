@@ -1,0 +1,255 @@
+"""Bucket executor: staging caches, warm executables, device dispatch.
+
+Three cache tiers keep repeat traffic off the slow paths:
+
+  staged-scenario cache — (cache_key, plan signature) -> host trees: repeat
+      queries skip compile_cluster + policy-table builds (the host staging
+      that dominates small-request latency).
+  warm-executable bookkeeping — (ShapeClass, plan signature): every bucket
+      of a class runs the SAME program shape, so jax's jit cache returns the
+      compiled executable; the whatif compile counter proves it (the delta
+      across a dispatch says whether XLA traced), and the outcome lands in
+      `tpusim_serve_dispatch_total{path}` and each response's
+      `compile_cache_hit`.
+  device-batch cache — a bucket whose every member carries a cache_key keeps
+      its stacked DEVICE arrays resident (LRU): an exact-repeat bucket skips
+      padding, stacking, and re-upload entirely.
+
+Dispatch runs the manual shard_map route when the executor holds a
+("scenario", "node") mesh (sharding.make_scenario_mesh), else the
+single-device vmap program. Ghost scenarios (replicas of the bucket's first
+real entry) fill deadline-flushed partial buckets; decode only ever walks the
+real entries, so ghosts cannot leak into responses.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.framework.metrics import register
+from tpusim.jaxe import ensure_x64
+from tpusim.jaxe.backend import _KNOWN_PROVIDERS
+from tpusim.jaxe.whatif import (
+    StagedScenario,
+    WhatIfResult,
+    _batched,
+    _policy_prep,
+    _scenario_program,
+    _stack_host,
+    _stage_scenario,
+    _unify,
+    batch_config,
+    compile_count,
+    decode_one,
+)
+from tpusim.jaxe.sharding import mesh_kind, pad_node_axis, scenario_shardings
+from tpusim.obs.recorder import note_serve, span
+from tpusim.serve.batcher import Bucket
+from tpusim.serve.request import (
+    REJECT_INVALID,
+    REJECT_UNKNOWN_SNAPSHOT,
+    REJECT_UNSUPPORTED,
+    ServeRejected,
+    ShapeClass,
+    WhatIfRequest,
+    shape_class_for,
+)
+
+
+class ServeExecutor:
+    def __init__(self, provider: str = "DefaultProvider",
+                 mesh: Optional[object] = None,
+                 max_staged: int = 128, max_device_batches: int = 8):
+        if provider not in _KNOWN_PROVIDERS:
+            raise KeyError(f"plugin {provider!r} has not been registered")
+        if mesh is not None and mesh_kind(mesh) != "scenario":
+            raise ValueError(
+                "ServeExecutor shards over scenarios: pass a "
+                "('scenario', 'node') mesh (sharding.make_scenario_mesh); "
+                f"got axes {tuple(mesh.axis_names)!r}")
+        ensure_x64()  # sentinel bits (62) and CPU nanos need int64 lanes
+        self.provider = provider
+        self.mesh = mesh
+        self._snapshots: Dict[str, ClusterSnapshot] = {}
+        # id(policy) -> (policy, prep): the policy ref keeps the id stable
+        self._policies: Dict[int, Tuple[Any, tuple]] = {}
+        self._staged: OrderedDict = OrderedDict()  # (cache_key, sig) -> (staged, sc)
+        self._max_staged = max_staged
+        self._device_batches: OrderedDict = OrderedDict()
+        self._max_device_batches = max_device_batches
+        self._warm: Dict[Tuple[ShapeClass, Any], Dict[str, int]] = {}
+        self.stats = {"dispatches": 0, "warm_hits": 0, "traces": 0,
+                      "staged_hits": 0, "device_batch_hits": 0}
+
+    # -- snapshot registry (the base clusters requests reference) ---------
+
+    def register_snapshot(self, ref: str, snapshot: ClusterSnapshot) -> str:
+        self._snapshots[ref] = snapshot
+        return ref
+
+    def snapshot_refs(self) -> List[str]:
+        return list(self._snapshots)
+
+    # -- staging -----------------------------------------------------------
+
+    def _policy(self, policy) -> tuple:
+        if policy is None:
+            return (None, False, False, 10)
+        hit = self._policies.get(id(policy))
+        if hit is not None and hit[0] is policy:
+            return hit[1]
+        try:
+            prep = _policy_prep(policy, 10)
+        except NotImplementedError as exc:
+            raise ServeRejected(REJECT_UNSUPPORTED, str(exc)) from None
+        except ValueError as exc:
+            raise ServeRejected(REJECT_INVALID, str(exc)) from None
+        self._policies[id(policy)] = (policy, prep)
+        return prep
+
+    def stage(self, request: WhatIfRequest):
+        """Resolve + host-stage one request: (staged, shape_class, plan_sig,
+        cp, hard_weight). Raises ServeRejected with a metric-ready reason."""
+        if not request.pods:
+            raise ServeRejected(REJECT_INVALID,
+                                "request carries an empty pod list")
+        if request.snapshot is not None:
+            snapshot = request.snapshot
+        elif request.snapshot_ref is not None:
+            snapshot = self._snapshots.get(request.snapshot_ref)
+            if snapshot is None:
+                raise ServeRejected(
+                    REJECT_UNKNOWN_SNAPSHOT,
+                    f"snapshot ref {request.snapshot_ref!r} is not "
+                    f"registered (known: {sorted(self._snapshots)})")
+        else:
+            raise ServeRejected(REJECT_INVALID,
+                                "request needs a snapshot or a snapshot_ref")
+        cp, need_noexec, need_saa, hard_weight = self._policy(request.policy)
+        # the what-if analog of the fast path's plan_signature: the policy
+        # spec is the part of the compiled program identity requests choose
+        plan_sig = (self.provider, cp.spec if cp is not None else None)
+        memo_key = ((request.cache_key, plan_sig)
+                    if request.cache_key is not None else None)
+        if memo_key is not None and memo_key in self._staged:
+            staged, shape_class = self._staged[memo_key]
+            self._staged.move_to_end(memo_key)
+            self.stats["staged_hits"] += 1
+            return staged, shape_class, plan_sig, cp, hard_weight
+        try:
+            staged = _stage_scenario(snapshot, request.pods, cp,
+                                     need_noexec, need_saa)
+        except ValueError as exc:
+            raise ServeRejected(REJECT_INVALID, str(exc)) from None
+        except NotImplementedError as exc:
+            raise ServeRejected(REJECT_UNSUPPORTED, str(exc)) from None
+        shape_class = shape_class_for(staged)
+        if memo_key is not None:
+            self._staged[memo_key] = (staged, shape_class)
+            while len(self._staged) > self._max_staged:
+                self._staged.popitem(last=False)
+        return staged, shape_class, plan_sig, cp, hard_weight
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _build_device_batch(self, bucket: Bucket):
+        shape_class, _ = bucket.key
+        targets = shape_class.targets
+        entries = bucket.entries
+        per_scenario = []
+        for e in entries:
+            statics, carry, xs = _unify(e.staged.statics, e.staged.carry,
+                                        e.staged.xs, targets,
+                                        shape_class.n_pods)
+            statics, carry, _ = pad_node_axis(statics, carry,
+                                              shape_class.n_nodes)
+            per_scenario.append((carry, statics, xs))
+        # ghost scenarios: replicas of the first real entry, never decoded
+        while len(per_scenario) < bucket.size:
+            per_scenario.append(per_scenario[0])
+        config = batch_config(
+            [e.staged.compiled for e in entries], self.provider,
+            entries[0].cp, entries[0].hard_weight,
+            n_saa_doms=max(e.staged.n_saa_doms for e in entries),
+            num_scalars=targets.get("scalar"))
+        host_carries, host_statics, host_xs = _stack_host(per_scenario)
+        if self.mesh is not None:
+            ca_sh, st_sh, xs_sh = scenario_shardings(self.mesh)
+            carries = jax.tree.map(jax.device_put, host_carries, ca_sh)
+            statics_b = jax.tree.map(jax.device_put, host_statics, st_sh)
+            xs_b = jax.tree.map(jax.device_put, host_xs, xs_sh)
+        else:
+            to_dev = lambda tree: jax.tree.map(jnp.asarray, tree)  # noqa: E731
+            carries, statics_b, xs_b = (to_dev(host_carries),
+                                        to_dev(host_statics), to_dev(host_xs))
+        return config, carries, statics_b, xs_b
+
+    def _device_batch(self, bucket: Bucket):
+        """(config, device trees), from the resident cache when the whole
+        bucket is cache-keyed and has been dispatched before."""
+        keys = [e.request.cache_key for e in bucket.entries]
+        dkey = None
+        if all(k is not None for k in keys):
+            dkey = (bucket.key, tuple(keys), bucket.size)
+            hit = self._device_batches.get(dkey)
+            if hit is not None:
+                self._device_batches.move_to_end(dkey)
+                self.stats["device_batch_hits"] += 1
+                return hit, True
+        built = self._build_device_batch(bucket)
+        if dkey is not None:
+            self._device_batches[dkey] = built
+            while len(self._device_batches) > self._max_device_batches:
+                self._device_batches.popitem(last=False)
+        return built, False
+
+    def dispatch(self, bucket: Bucket) -> Tuple[List[WhatIfResult], bool]:
+        """Run one bucket as one device program; returns (results aligned
+        with bucket.entries, compile_cache_hit). Ghost scenarios and padded
+        pods are dropped here — decode walks only the real entries."""
+        program_key = bucket.key
+        self.stats["dispatches"] += 1
+        sp = span("serve:dispatch")
+        with sp:
+            if sp:
+                sp.set("real", len(bucket.entries))
+                sp.set("ghosts", bucket.ghosts)
+                sp.set("shape", program_key[0].describe())
+            (config, carries, statics_b, xs_b), resident = \
+                self._device_batch(bucket)
+            seen = program_key in self._warm
+            before = compile_count()
+            if self.mesh is not None:
+                choices_b, counts_b = _scenario_program(config, self.mesh)(
+                    carries, statics_b, xs_b)
+            else:
+                choices_b, counts_b = _batched(config, carries, statics_b,
+                                               xs_b)
+            choices_b = np.asarray(choices_b)
+            counts_b = np.asarray(counts_b)
+            traced = compile_count() - before
+            warm = seen and traced == 0
+            stats = self._warm.setdefault(program_key,
+                                          {"dispatches": 0, "traces": 0})
+            stats["dispatches"] += 1
+            stats["traces"] += traced
+            self.stats["traces"] += traced
+            if warm:
+                self.stats["warm_hits"] += 1
+            path = ("device_cache" if resident and warm
+                    else "warm" if warm else "cold")
+            register().serve_dispatch.inc(path)
+            note_serve("dispatch", {"path": path,
+                                    "real": len(bucket.entries),
+                                    "ghosts": bucket.ghosts})
+        with span("serve:decode"):
+            results = [decode_one(e.request.pods, e.staged.compiled,
+                                  choices_b[i], counts_b[i])
+                       for i, e in enumerate(bucket.entries)]
+        return results, warm

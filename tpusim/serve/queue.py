@@ -1,0 +1,64 @@
+"""Bounded admission queue for the scenario fleet.
+
+Backpressure lives HERE, not in the batcher: a full queue rejects at submit
+time (`tpusim_serve_rejected_total{reason="queue_full"}`) so callers see
+overload immediately instead of watching latency grow without bound. Depth is
+mirrored into the `tpusim_serve_queue_depth` gauge on every transition.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Optional
+
+from tpusim.framework.metrics import register
+
+
+class AdmissionQueue:
+    """Thread-safe bounded FIFO. `put` never blocks (False on full/closed);
+    `pop` optionally waits. Closing wakes every waiter; a closed queue still
+    drains what it holds."""
+
+    def __init__(self, maxsize: int = 256):
+        if maxsize < 1:
+            raise ValueError(f"maxsize={maxsize}: need at least 1")
+        self.maxsize = maxsize
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def put(self, item: Any) -> bool:
+        with self._lock:
+            if self._closed or len(self._items) >= self.maxsize:
+                return False
+            self._items.append(item)
+            register().serve_queue_depth.set(len(self._items))
+            self._nonempty.notify()
+            return True
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Next item, or None when empty after `timeout` (0/None: no wait)."""
+        with self._lock:
+            if not self._items and timeout and not self._closed:
+                self._nonempty.wait(timeout)
+            if not self._items:
+                return None
+            item = self._items.popleft()
+            register().serve_queue_depth.set(len(self._items))
+            return item
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify_all()

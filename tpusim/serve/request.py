@@ -1,0 +1,113 @@
+"""Scenario-fleet request/response types and shape classes.
+
+A `WhatIfRequest` is one capacity question — "will these pods fit on this
+cluster?" — against either an inline snapshot or a `snapshot_ref` registered
+with the fleet (the device-resident snapshot cache). Requests are bucketed by
+`ShapeClass`: a fixed (node, pod, axis-budget) padding target, each dimension
+rounded up to a power of two, so every bucket of a class dispatches through
+ONE warm executable instead of tracing a fresh program per request shape
+(ROADMAP item 1: thousands of concurrent queries from a warm engine).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.api.types import Pod
+from tpusim.jaxe.whatif import WhatIfResult
+
+# admission rejection reasons (tpusim_serve_rejected_total{reason})
+REJECT_QUEUE_FULL = "queue_full"
+REJECT_INVALID = "invalid"
+REJECT_UNKNOWN_SNAPSHOT = "unknown_snapshot"
+REJECT_UNSUPPORTED = "unsupported"
+REJECT_SHUTDOWN = "shutdown"
+
+
+class ServeRejected(Exception):
+    """A request the fleet will not run; `reason` is the low-cardinality
+    metric label, str(exc) the human detail returned to the caller."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+_ids = itertools.count()
+
+
+@dataclass
+class WhatIfRequest:
+    """One capacity query. `cache_key` is an optional caller-chosen identity
+    for the (snapshot, pods) content: requests carrying one are eligible for
+    the staged-scenario and device-batch caches (repeat queries skip host
+    compile and re-upload entirely). Callers must not reuse a key for
+    different content."""
+
+    pods: List[Pod]
+    snapshot: Optional[ClusterSnapshot] = None
+    snapshot_ref: Optional[str] = None
+    policy: Any = None
+    cache_key: Optional[str] = None
+    request_id: str = field(default_factory=lambda: f"req-{next(_ids)}")
+
+
+@dataclass
+class WhatIfResponse:
+    request_id: str
+    result: Optional[WhatIfResult] = None
+    error: Optional[str] = None
+    rejected: Optional[str] = None  # a REJECT_* reason, None if admitted
+    bucket_real: int = 0    # real scenarios in the dispatched bucket
+    bucket_ghosts: int = 0  # ghost-scenario padding the bucket carried
+    compile_cache_hit: bool = False
+    latency_s: float = 0.0  # admission -> decoded result
+
+    @property
+    def ok(self) -> bool:
+        return self.rejected is None and self.error is None
+
+
+def _budget(n: int, floor: int = 4) -> int:
+    """Next power of two >= n, floored — the shape-class rounding. The floor
+    keeps the class count low for tiny scenarios (a 3-node and a 4-node
+    cluster share an executable)."""
+    return max(floor, 1 << max(0, (int(n) - 1).bit_length()))
+
+
+@dataclass(frozen=True)
+class ShapeClass:
+    """A fixed padding target: node/pod extents plus every named non-node
+    axis (signature tables, scalar resources, groups) from the kernels axis
+    registries. Two requests in the same class produce byte-identical array
+    SHAPES after padding, which is what lets them share one bucket and one
+    warm executable."""
+
+    n_nodes: int
+    n_pods: int
+    axes: Tuple[Tuple[str, int], ...]  # sorted (axis name, budget)
+
+    @property
+    def targets(self) -> Dict[str, int]:
+        return dict(self.axes)
+
+    def describe(self) -> str:
+        return f"nodes<={self.n_nodes} pods<={self.n_pods}"
+
+
+def shape_class_for(staged) -> ShapeClass:
+    """Derive the ShapeClass of one staged scenario (whatif.StagedScenario)
+    from its host trees — every axis the unifier would pad, rounded up to
+    its power-of-two budget. Deterministic: a pure function of the staged
+    array shapes."""
+    from tpusim.jaxe.whatif import _axis_targets
+
+    targets = _axis_targets([(staged.statics, staged.carry, staged.xs)])
+    return ShapeClass(
+        n_nodes=_budget(staged.statics.alloc_cpu.shape[0]),
+        n_pods=_budget(staged.xs.req_cpu.shape[0]),
+        axes=tuple(sorted((name, _budget(size))
+                          for name, size in targets.items())))

@@ -1,0 +1,198 @@
+"""ScenarioFleet: the async what-if capacity-planning service.
+
+Request lifecycle (each phase is a `serve:` flight-recorder span, so a trace
+shows admission -> bucket -> dispatch -> decode per request):
+
+  submit()      admission — bounded-queue backpressure; rejects resolve the
+                future immediately with a REJECT_* reason.
+  stage         host staging (worker side): snapshot resolution, policy
+                compile, compile_cluster — or a staged-cache hit.
+  bucket        shape-class filing; a FULL bucket dispatches at once, a
+                partial one waits for siblings until its deadline.
+  dispatch      one device program per bucket (ghost-padded if partial),
+                warm-executable + device-batch caches applied.
+  decode        per-request placements; futures resolve with WhatIfResponse.
+
+The worker thread (`start`/`stop`) gives the service its async shape; tests,
+the CLI client, and bench drive the same pipeline synchronously via `pump`/
+`drain` or the `run` convenience, which keeps every deadline decision under
+the injected clock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.framework.metrics import register
+from tpusim.obs.recorder import note_serve, span
+from tpusim.serve.batcher import Bucket, PendingEntry, ShapeClassBatcher
+from tpusim.serve.executor import ServeExecutor
+from tpusim.serve.queue import AdmissionQueue
+from tpusim.serve.request import (
+    REJECT_QUEUE_FULL,
+    REJECT_SHUTDOWN,
+    ServeRejected,
+    WhatIfRequest,
+    WhatIfResponse,
+)
+
+
+class ScenarioFleet:
+    def __init__(self, provider: str = "DefaultProvider",
+                 bucket_size: int = 4, flush_after_s: float = 0.05,
+                 max_queue: int = 256, mesh: Optional[object] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.executor = ServeExecutor(provider=provider, mesh=mesh)
+        if mesh is not None and bucket_size % mesh.shape["scenario"] != 0:
+            raise ValueError(
+                f"bucket_size={bucket_size} does not divide over the "
+                f"mesh's scenario axis ({mesh.shape['scenario']} shards)")
+        self.queue = AdmissionQueue(max_queue)
+        self.batcher = ShapeClassBatcher(bucket_size=bucket_size,
+                                         flush_after_s=flush_after_s,
+                                         clock=clock)
+        self._clock = clock
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+
+    def register_snapshot(self, ref: str, snapshot: ClusterSnapshot) -> str:
+        return self.executor.register_snapshot(ref, snapshot)
+
+    # -- admission ---------------------------------------------------------
+
+    def _reject(self, request: WhatIfRequest, reason: str,
+                message: str) -> WhatIfResponse:
+        register().serve_rejected.inc(reason)
+        note_serve("reject", {"id": request.request_id, "reason": reason})
+        return WhatIfResponse(request_id=request.request_id, error=message,
+                              rejected=reason)
+
+    def submit(self, request: WhatIfRequest) -> "Future[WhatIfResponse]":
+        """Admit one request; the future resolves to a WhatIfResponse (a
+        rejection resolves it immediately — submit never raises for
+        per-request problems)."""
+        future: "Future[WhatIfResponse]" = Future()
+        with span("serve:admit") as sp:
+            if sp:
+                sp.set("id", request.request_id)
+            if not self.queue.put((request, future, self._clock())):
+                reason = (REJECT_SHUTDOWN if self.queue.closed
+                          else REJECT_QUEUE_FULL)
+                future.set_result(self._reject(
+                    request, reason,
+                    "fleet is shutting down" if reason == REJECT_SHUTDOWN
+                    else f"admission queue full ({self.queue.maxsize})"))
+            else:
+                note_serve("admit", {"id": request.request_id})
+        return future
+
+    # -- pipeline ----------------------------------------------------------
+
+    def _process(self, request: WhatIfRequest, future: Future,
+                 admitted_at: float) -> None:
+        try:
+            with span("serve:stage") as sp:
+                if sp:
+                    sp.set("id", request.request_id)
+                (staged, shape_class, plan_sig, cp,
+                 hard_weight) = self.executor.stage(request)
+        except ServeRejected as exc:
+            future.set_result(self._reject(request, exc.reason, str(exc)))
+            return
+        entry = PendingEntry(request=request, staged=staged, future=future,
+                             admitted_at=admitted_at,
+                             shape_class=shape_class, plan_sig=plan_sig,
+                             cp=cp, hard_weight=hard_weight)
+        with span("serve:bucket"):
+            full = self.batcher.add(entry)
+        note_serve("bucket", {"id": request.request_id,
+                              "shape": shape_class.describe()})
+        if full is not None:
+            self._dispatch(full)
+
+    def _dispatch(self, bucket: Bucket) -> None:
+        reg = register()
+        reg.serve_batch_occupancy.observe(len(bucket.entries))
+        try:
+            results, warm = self.executor.dispatch(bucket)
+        except Exception as exc:  # a bucket failure fails its members only
+            for entry in bucket.entries:
+                entry.future.set_result(WhatIfResponse(
+                    request_id=entry.request.request_id,
+                    error=f"{type(exc).__name__}: {exc}"))
+            return
+        now = self._clock()
+        for entry, result in zip(bucket.entries, results):
+            latency = now - entry.admitted_at
+            reg.serve_request_latency.observe(latency * 1e6)
+            entry.future.set_result(WhatIfResponse(
+                request_id=entry.request.request_id, result=result,
+                bucket_real=len(bucket.entries),
+                bucket_ghosts=bucket.ghosts, compile_cache_hit=warm,
+                latency_s=latency))
+
+    def _flush_due(self) -> None:
+        for bucket in self.batcher.due():
+            note_serve("flush", {"real": len(bucket.entries),
+                                 "ghosts": bucket.ghosts})
+            self._dispatch(bucket)
+
+    # -- synchronous driving (tests, CLI client, bench) --------------------
+
+    def pump(self) -> None:
+        """Process everything already queued, then flush due buckets."""
+        while True:
+            item = self.queue.pop()
+            if item is None:
+                break
+            self._process(*item)
+        self._flush_due()
+
+    def drain(self) -> None:
+        """pump() + dispatch every partial bucket regardless of deadline."""
+        self.pump()
+        for bucket in self.batcher.flush_all():
+            self._dispatch(bucket)
+
+    def run(self, requests: Sequence[WhatIfRequest]) -> List[WhatIfResponse]:
+        """Synchronous convenience: submit all, drain, return responses in
+        submission order."""
+        futures = [self.submit(r) for r in requests]
+        self.drain()
+        return [f.result() for f in futures]
+
+    # -- worker thread (the async service shape) ---------------------------
+
+    def start(self) -> "ScenarioFleet":
+        if self._thread is not None:
+            raise RuntimeError("fleet already started")
+        self._stopping.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="scenario-fleet", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stopping.is_set():
+            deadline = self.batcher.next_deadline()
+            timeout = (max(0.001, deadline - self._clock())
+                       if deadline is not None else 0.05)
+            item = self.queue.pop(timeout=timeout)
+            if item is not None:
+                self._process(*item)
+            self._flush_due()
+        self.drain()
+
+    def stop(self) -> None:
+        """Stop admitting, finish what's queued (incl. partial buckets)."""
+        self.queue.close()
+        self._stopping.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            self._thread = None
+        else:
+            self.drain()

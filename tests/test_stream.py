@@ -1,0 +1,149 @@
+"""Churn-parity fuzz for the streaming runtime (ISSUE 7 tentpole test).
+
+The exactness contract under test: placements emitted from the
+device-resident O(delta) fast path are byte-identical (placement_hash) to
+scheduling every batch through a full re-stage — over seeded random event
+sequences mixing pod arrivals, evictions of bound pods, node flaps, and
+scripted device faults. run_stream_simulation(verify=True) runs the
+comparison arm per cycle: a fresh-compile JaxBackend.schedule against a
+parallel IncrementalCluster fed the identical event stream.
+
+A fast matrix rides tier-1; the wide sweep is marked ``slow``. The
+classification contract is asserted alongside: every cycle not served by
+the stream scan carries exactly one tpusim_stream_restage_total reason.
+"""
+
+import pytest
+
+from tpusim.chaos import DeviceFaultPlan, FaultPlan
+from tpusim.simulator import run_stream_simulation
+from tpusim.stream import MIN_BUCKET, bucket_size
+
+NODES = 8
+ARRIVALS = 8
+
+
+def _run(**kw):
+    kw.setdefault("num_nodes", NODES)
+    kw.setdefault("arrivals", ARRIVALS)
+    return run_stream_simulation(**kw)
+
+
+def _assert_accounted(out):
+    """Every cycle took exactly one path, and every non-stream cycle was
+    classified with exactly one restage reason."""
+    assert sum(out["paths"].values()) == out["cycles"]
+    off_stream = out["cycles"] - out["paths"].get("stream_scan", 0)
+    assert sum(out["restages"].values()) == off_stream
+
+
+def test_bucket_size_pow2_floor():
+    assert bucket_size(0) == MIN_BUCKET
+    assert bucket_size(1) == MIN_BUCKET
+    assert bucket_size(MIN_BUCKET) == MIN_BUCKET
+    assert bucket_size(MIN_BUCKET + 1) == MIN_BUCKET * 2
+    assert bucket_size(100) == 128
+
+
+@pytest.mark.parametrize("seed,flap_every,evict", [
+    (0, 0, 0.25),   # pure arrival+eviction churn: stream path steady state
+    (1, 4, 0.25),   # periodic structural flaps force classified restages
+    (2, 3, 0.5),    # heavy eviction pressure
+])
+def test_churn_parity_fast(seed, flap_every, evict):
+    out = _run(cycles=8, seed=seed, node_flap_every=flap_every,
+               evict_fraction=evict, verify=True)
+    assert out["verified"], out
+    assert out["mismatched_cycles"] == 0
+    _assert_accounted(out)
+    # churn without structural events stays on the fast path after warm-up
+    assert out["paths"].get("stream_scan", 0) >= 1
+    assert out["restages"].get("cold_start") == 1
+
+
+def test_flap_restages_classified_groups_dirty():
+    # flaps at cycles 3 and 6 (cordon), restore at 4: three structural
+    # cycles, each a groups_dirty restage; everything else streams
+    out = _run(cycles=7, seed=3, node_flap_every=3, verify=True)
+    assert out["verified"], out
+    _assert_accounted(out)
+    assert out["restages"] == {"cold_start": 1, "groups_dirty": 3}
+    assert out["paths"] == {"restage_scan": 4, "stream_scan": 3}
+    assert out["commits"] == 3  # one scatter commit per stream cycle
+
+
+def test_stream_matches_always_restage_chain():
+    """Restage-vs-stream parity without the reference in the loop: the
+    placement chains of the two arms are byte-identical."""
+    stream = _run(cycles=6, seed=4, node_flap_every=3)
+    restage = _run(cycles=6, seed=4, node_flap_every=3, always_restage=True)
+    assert stream["placement_chain"] == restage["placement_chain"]
+    assert restage["restages"] == {"forced_restage": 6}
+    assert restage["paths"] == {"restage_scan": 6}
+    assert restage["commits"] == 0
+
+
+def test_chaos_device_faults_masked_and_classified():
+    """Scripted device faults (a dead dispatch, a silent corruption) are
+    absorbed — emitted placements stay byte-identical to the fault-free
+    run — and every fallback cycle is classified."""
+    plan = FaultPlan(seed=0, device=DeviceFaultPlan(
+        faults={1: "exception", 3: "corrupt_silent"}))
+    clean = _run(cycles=6, seed=5)
+    chaotic = _run(cycles=6, seed=5, chaos_plan=plan)
+    assert chaotic["placement_chain"] == clean["placement_chain"]
+    _assert_accounted(chaotic)
+    # dispatch 1: DeviceFault -> host reference cycle
+    assert chaotic["restages"].get("device_fault") == 1
+    # dispatch 3: in-range corruption caught by verify="all" host compare
+    assert chaotic["restages"].get("verify_divergence") == 1
+    assert chaotic["paths"].get("host", 0) >= 1
+    assert "breaker_transitions" in chaotic
+
+
+def test_chaos_breaker_open_classified():
+    """Consecutive faults trip the breaker; denied cycles are classified
+    breaker_open and still emit correct placements via the host path."""
+    plan = FaultPlan(seed=0, device=DeviceFaultPlan(
+        faults={1: "exception", 2: "exception"},
+        failure_threshold=1, cooldown=1))
+    clean = _run(cycles=8, seed=6)
+    chaotic = _run(cycles=8, seed=6, chaos_plan=plan)
+    assert chaotic["placement_chain"] == clean["placement_chain"]
+    _assert_accounted(chaotic)
+    assert chaotic["restages"].get("device_fault", 0) >= 1
+    assert chaotic["restages"].get("breaker_open", 0) >= 1
+    transitions = chaotic["breaker_transitions"]
+    assert any(t[0] == "open" for t in transitions), transitions
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("flap_every,evict", [
+    (0, 0.1), (3, 0.4), (2, 0.6),
+])
+def test_churn_parity_sweep(seed, flap_every, evict):
+    out = run_stream_simulation(num_nodes=16, cycles=12, arrivals=16,
+                                seed=seed, node_flap_every=flap_every,
+                                evict_fraction=evict, verify=True)
+    assert out["verified"], out
+    assert out["mismatched_cycles"] == 0
+    _assert_accounted(out)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(3))
+def test_churn_parity_sweep_chaos(seed):
+    """Wide sweep with device faults layered over the churn: parity must
+    hold through fault, corruption, breaker, and recovery cycles."""
+    plan = FaultPlan(seed=seed, device=DeviceFaultPlan(
+        faults={2: "exception", 4: "corrupt_silent", 6: "corrupt_invalid"},
+        failure_threshold=2, cooldown=1))
+    clean = run_stream_simulation(num_nodes=16, cycles=10, arrivals=16,
+                                  seed=seed, node_flap_every=4,
+                                  evict_fraction=0.3)
+    chaotic = run_stream_simulation(num_nodes=16, cycles=10, arrivals=16,
+                                    seed=seed, node_flap_every=4,
+                                    evict_fraction=0.3, chaos_plan=plan)
+    assert chaotic["placement_chain"] == clean["placement_chain"]
+    _assert_accounted(chaotic)

@@ -144,3 +144,76 @@ def test_quickstart_watch_fabric_matches_direct_path():
     w_store = sorted((p.name, p.spec.node_name) for p
                      in watched.resource_store.list(ResourceType.PODS))
     assert d_store == w_store
+
+
+def test_stream_watch_overflow_relists_and_restages():
+    """The lossy "410 Gone" path end-to-end through the stream runtime: a
+    StreamSession fed exclusively by a Reflector has its node watch buffer
+    overflow mid-stream (frames are dropped, the stream closes with
+    WatchExpiredError), the reflector relists and replays the authoritative
+    diff, the session classifies a watch_expired device restage, and the
+    next cycle's placements are byte-identical to a fresh full-compile
+    reference on the post-loss authoritative state."""
+    from tpusim.api.snapshot import make_pod
+    from tpusim.backends import get_backend, placement_hash
+    from tpusim.framework.events import WatchBuffer
+    from tpusim.framework.store import ResourceStore
+    from tpusim.stream import StreamSession
+
+    snap = synthetic_cluster(4, milli_cpu=4000, memory=16 * 1024**3)
+    store = ResourceStore()
+    for n in snap.nodes:
+        store.add(ResourceType.NODES, n)
+    client = FakeRESTClient(store)
+
+    session = StreamSession()  # empty host picture: built from the watch
+    reflector = session.watch(client, ResourceType.NODES)
+    assert session.sync() == len(snap.nodes)  # initial list replay as ADDED
+    assert {n.name for n in session.inc.nodes} == {n.name for n in snap.nodes}
+
+    def batch(tag, n=8):
+        return [make_pod(f"{tag}-{i}", milli_cpu=100, memory=256 << 20)
+                for i in range(n)]
+
+    session.schedule(batch("cold"))
+    session.schedule(batch("warm"))
+    assert session.path_counts == {"restage_scan": 1, "stream_scan": 1}
+
+    # shrink the live shared stream so the next burst genuinely overflows
+    # (the default 4096-frame buffer would need that many undrained events)
+    key = (ResourceType.NODES.value, "", "")
+    selector, _ = client._watchers[key]
+    small = WatchBuffer(maxsize=2, resource=ResourceType.NODES.value)
+    client._watchers[key] = (selector, small)
+    reflector._buf = small
+
+    # a cordon/uncordon/cordon burst: three Modified fan-outs against a
+    # two-slot buffer — the third trips the overflow, which drops ALL
+    # pending frames (lossy) and closes the stream with the 410 analog
+    name = snap.nodes[0].name
+    for unsched in (True, False, True):
+        obj, ok = store.get(ResourceType.NODES, name)
+        assert ok
+        flapped = obj.copy()
+        flapped.spec.unschedulable = unsched
+        store.update(ResourceType.NODES, flapped)
+    assert small.closed
+
+    # the reflector reconverges: relist diffs authoritative vs known into
+    # one synthetic Modified (the net cordon), and the session's on_relist
+    # hook forces a classified device restage
+    applied = session.sync()
+    assert reflector.relists == 1
+    assert applied == 1
+    cordoned = {n.name: n.spec.unschedulable for n in session.inc.nodes}
+    assert cordoned[name] is True
+
+    # post-recovery parity: identical batch through a fresh full compile on
+    # the session's reconverged picture vs the session's restage cycle
+    expected = get_backend("jax").schedule(batch("post"),
+                                           session.inc.to_snapshot())
+    got = session.schedule(batch("post"))
+    assert placement_hash(got) == placement_hash(expected)
+    assert session.restage_counts.get("watch_expired") == 1
+    assert all(pl.node_name != name for pl in got)
+    client.close()

@@ -194,7 +194,7 @@ if smoke_done; then
 else
     # one tiny batch per kernel-variant class (base/most-requested/ports/
     # disk/spread/vol-zone/interpod/maxpd + the preempt-victim kernel +
-    # the scenario-fleet serve path),
+    # the scenario-fleet serve path + the streaming churn runtime),
     # each hash-checked against the XLA scan in-process: even a ~2-minute
     # healthy window certifies Mosaic lowering of the whole surface
     if ! python tools/tpu_smoke.py \
@@ -260,6 +260,12 @@ echo "== stage 3b: scenario-fleet serving (config 8: scenarios/s, warm-cache + m
 run_stage serve configs:8 bench_results/r5_tpu_serve.jsonl \
     bench_results/r5_tpu_serve.log \
     env TPUSIM_BENCH_LADDER_CONFIGS=8 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+
+echo "== stage 3c: streaming runtime (config 9: O(delta) churn, stream-vs-restage) =="
+run_stage stream configs:9 bench_results/r5_tpu_stream.jsonl \
+    bench_results/r5_tpu_stream.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=9 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
     python bench.py --ladder
 
 echo "== stage 4: full XLA ladder (configs 1-5; fresh same-round parity anchors) =="

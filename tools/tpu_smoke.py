@@ -12,7 +12,9 @@ rows + NodeLabel preference, ServiceAffinity first-pod locks,
 ImageLocality, NoExecute-taint predicate, alwaysCheckAllPredicates
 count-mode) — actually lowers through Mosaic and agrees with
 the XLA scan bit-for-bit, plus that the preemption victim-selection
-kernel (jaxe/preempt.py) byte-matches the host oracle. Shapes are tiny
+kernel (jaxe/preempt.py) byte-matches the host oracle and that the
+streaming runtime's scatter-committed fast path (tpusim/stream)
+byte-matches a fresh-compile reference without retracing once warm. Shapes are tiny
 (<=8 nodes, <=24 pods) so the whole sweep compiles and runs in well
 under a minute on a healthy TPU; off-TPU the Pallas kernels auto-select
 interpreter mode, so the same script validates on CPU (slower).
@@ -522,6 +524,54 @@ def run_serve_fleet_variant():
     return h, len(responses), dict(fleet.executor.stats)
 
 
+def run_stream_churn_variant():
+    """Streaming runtime (tpusim/stream) under seeded churn: every cycle's
+    placements — scatter-committed device-resident fast path and classified
+    restages alike — must byte-match a fresh-compile reference arm, and a
+    second warm session over the same shapes must dispatch without tracing
+    a single fresh scan or scatter program (the pow2-bucket zero-retrace
+    contract)."""
+    from tpusim.jaxe.kernels import apply_delta_donated, schedule_scan_donated
+    from tpusim.simulator import run_stream_simulation
+
+    def cache_sizes():
+        try:
+            return (schedule_scan_donated._cache_size(),
+                    apply_delta_donated._cache_size())
+        except AttributeError:  # private jit API moved: skip the check
+            return None
+
+    out = run_stream_simulation(num_nodes=16, cycles=10, arrivals=16,
+                                evict_fraction=0.25, node_flap_every=4,
+                                seed=7, verify=True)
+    if not out["verified"]:
+        raise AssertionError(
+            f"stream placements diverge from the full-restage reference on "
+            f"{out['mismatched_cycles']} of {out['cycles']} cycles")
+    stream_cycles = out["paths"].get("stream_scan", 0)
+    if not stream_cycles:
+        raise AssertionError(
+            f"churn never took the O(delta) stream path: {out['paths']}")
+    if not out["commits"]:
+        raise AssertionError("no scatter commits dispatched")
+    before = cache_sizes()
+    warm = run_stream_simulation(num_nodes=16, cycles=4, arrivals=16,
+                                 evict_fraction=0.25, seed=8)
+    traced = None
+    if before is not None:
+        after = cache_sizes()
+        traced = (after[0] - before[0], after[1] - before[1])
+        if any(traced):
+            raise AssertionError(
+                f"warm session retraced (scan +{traced[0]}, scatter "
+                f"+{traced[1]}); pow2 bucketing is broken")
+    if warm["paths"].get("stream_scan", 0) != warm["cycles"] - 1:
+        raise AssertionError(
+            f"warm session left the stream path: {warm['paths']}")
+    h = out["placement_chain"][:16]
+    return h, out["scheduled"], out["decisions"], stream_cycles, traced
+
+
 def _write_smoke_trace(recorder):
     """Persist the sweep's flight-recorder trace; never fail the smoke."""
     path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
@@ -629,6 +679,30 @@ def main() -> int:
             ran += 1
             print(f"SMOKE chaos_breaker: OK hash={h} "
                   f"transitions={'->'.join(transitions)} "
+                  f"({time.time() - t:.1f}s)", flush=True)
+        if not only or "stream_churn" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "stream_churn")
+            try:
+                h, scheduled, total, stream_cycles, traced = \
+                    run_stream_churn_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: stream_churn: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("stream_cycles", stream_cycles)
+            vsp.end()
+            ran += 1
+            retrace = ("skipped" if traced is None
+                       else f"+{traced[0]}/+{traced[1]}")
+            print(f"SMOKE stream_churn: OK hash={h} "
+                  f"scheduled={scheduled}/{total} "
+                  f"stream_cycles={stream_cycles} retrace={retrace} "
                   f"({time.time() - t:.1f}s)", flush=True)
     finally:
         flight.uninstall()

@@ -540,11 +540,164 @@ def _write_metrics(path: str) -> None:
         f.write(register().expose())
 
 
+def build_stream_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusim stream",
+        description="Streaming runtime: hold the compiled cluster resident "
+                    "on device and drive it with seeded churn — arrivals, "
+                    "evictions, node flaps (tpusim/stream). Warm cycles "
+                    "scatter-commit the watch delta instead of re-staging "
+                    "the cluster")
+    parser.add_argument("--snapshot", default="",
+                        help="Combined ClusterSnapshot JSON ({nodes, pods})")
+    parser.add_argument("--synthetic-nodes", type=int, default=64,
+                        help="Generate N homogeneous synthetic nodes "
+                             "(ignored with --snapshot)")
+    parser.add_argument("--synthetic-milli-cpu", type=int, default=4000)
+    parser.add_argument("--synthetic-memory", type=int, default=16 * 1024**3)
+    parser.add_argument("--cycles", type=int, default=50,
+                        help="Scheduling cycles to run")
+    parser.add_argument("--arrivals", type=int, default=32,
+                        help="Fresh pod arrivals per cycle")
+    parser.add_argument("--evict-fraction", type=float, default=0.25,
+                        help="Fraction of the arrival batch size evicted "
+                             "from the bound population per cycle (the "
+                             "O(delta) scatter load)")
+    parser.add_argument("--flap-every", type=int, default=0,
+                        help="Cordon+restore a random node every k-th cycle "
+                             "(structural events: forces classified "
+                             "restages; 0 = never)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="Load-generator seed")
+    parser.add_argument("--algorithmprovider", default="DefaultProvider")
+    parser.add_argument("--always-restage", action="store_true",
+                        help="Disable the O(delta) fast path: full compile + "
+                             "re-stage every cycle (the comparison arm; "
+                             "placements are identical)")
+    parser.add_argument("--verify", action="store_true",
+                        help="Cross-check every cycle against a fresh-"
+                             "compile JaxBackend dispatch (placement_hash "
+                             "byte-parity)")
+    parser.add_argument("--chaos-plan", default="",
+                        help="Fault-plan JSON, device section only (churn/"
+                             "fabric faults are the load generator's job)")
+    parser.add_argument("--platform",
+                        default=os.environ.get("TPUSIM_PLATFORM", ""))
+    parser.add_argument("--json", action="store_true",
+                        help="Print the full summary dict as JSON")
+    parser.add_argument("--metrics-out", default="",
+                        help="Write the metric families (Prometheus text "
+                             "format) after the run — includes "
+                             "tpusim_stream_restage_total{reason} and "
+                             "tpusim_stream_cycles_total{path}")
+    parser.add_argument("--trace-out", default="",
+                        help="Write the stream span timeline (Chrome trace "
+                             "JSON, or .jsonl for raw spans)")
+    return parser
+
+
+def stream_cli(argv) -> int:
+    """`tpusim stream`: churn load against the device-resident runtime."""
+    args = build_stream_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        os.environ["TPUSIM_PROBE"] = "0"
+
+    snapshot = None
+    chaos_plan = None
+    try:
+        if args.snapshot:
+            snapshot = ClusterSnapshot.load(args.snapshot)
+        if args.chaos_plan:
+            from tpusim.chaos import load_plan
+            from tpusim.chaos.plan import PlanError
+
+            try:
+                chaos_plan = load_plan(args.chaos_plan)
+            except PlanError as exc:
+                print(f"error: --chaos-plan: {exc}", file=sys.stderr)
+                return 2
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    recorder = None
+    if args.trace_out:
+        from tpusim.obs import recorder as flight
+
+        recorder = flight.install(flight.FlightRecorder())
+
+    from tpusim.simulator import run_stream_simulation
+
+    try:
+        out = run_stream_simulation(
+            snapshot, num_nodes=args.synthetic_nodes, cycles=args.cycles,
+            arrivals=args.arrivals, evict_fraction=args.evict_fraction,
+            node_flap_every=args.flap_every, seed=args.seed,
+            provider=args.algorithmprovider,
+            always_restage=args.always_restage, verify=args.verify,
+            chaos_plan=chaos_plan)
+    except (KeyError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    exit_code = 0
+    if args.json:
+        import json
+
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        paths = ", ".join(f"{k} x{v}" for k, v in sorted(out["paths"].items()))
+        restages = ", ".join(f"{k} x{v}"
+                             for k, v in sorted(out["restages"].items()))
+        print(f"{out['cycles']} cycles over {out['nodes']} nodes: "
+              f"{out['scheduled']}/{out['decisions']} scheduled, "
+              f"{out['decisions_per_s']:.0f} decisions/s, cycle p50/p99 "
+              f"{out['p50_cycle_ms']:.1f}/{out['p99_cycle_ms']:.1f} ms")
+        print(f"paths: {paths or 'none'}; restages: {restages or 'none'}; "
+              f"{out['commits']} scatter commits")
+        print(f"load: {out['load']['arrivals']} arrivals, "
+              f"{out['load']['evictions']} evictions, "
+              f"{out['load']['flaps']} flaps; "
+              f"placement chain {out['placement_chain'][:16]}")
+    if args.verify:
+        if out["verified"]:
+            print("verify: every cycle placement_hash-identical to the "
+                  "full-restage backend")
+        else:
+            print(f"verify: FAILED — {out['mismatched_cycles']} cycles "
+                  "diverged from the full-restage backend", file=sys.stderr)
+            exit_code = 1
+
+    if recorder is not None:
+        from tpusim.obs import recorder as flight
+
+        flight.uninstall()
+        try:
+            recorder.write(args.trace_out)
+        except OSError as exc:
+            print(f"error: failed to write trace: {exc}", file=sys.stderr)
+            return 2
+        print(f"trace: {args.trace_out} ({len(recorder.events)} events)",
+              file=sys.stderr)
+    if args.metrics_out:
+        try:
+            _write_metrics(args.metrics_out)
+        except OSError as exc:
+            print(f"error: failed to write metrics: {exc}", file=sys.stderr)
+            return 2
+    return exit_code
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "serve":
         return serve_cli(argv[1:])
+    if argv and argv[0] == "stream":
+        return stream_cli(argv[1:])
     args = build_parser().parse_args(argv)
     feature_gates = None
     if args.feature_gates:

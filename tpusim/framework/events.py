@@ -125,6 +125,25 @@ class WatchBuffer:
                 return
             yield ev
 
+    def drain(self, limit: Optional[int] = None) -> list:
+        """Non-blocking batch read: every currently queued frame (up to
+        `limit`) as a list. An expired stream raises WatchExpiredError like
+        read(), but never swallows frames: any surviving frames read before
+        the error sentinel are returned and the NEXT drain() raises (the
+        sentinel is re-queued by read())."""
+        out: list = []
+        while limit is None or len(out) < limit:
+            try:
+                ev = self.read(timeout=0)
+            except WatchExpiredError:
+                if out:
+                    return out
+                raise
+            if ev is None:
+                break
+            out.append(ev)
+        return out
+
 
 def watch_resource(store: ResourceStore, resource: ResourceType) -> WatchBuffer:
     """Subscribe to a resource: current objects replay as ADDED, then live

@@ -240,6 +240,17 @@ class SchedulerMetrics:
         self.serve_dispatch = self._reg(LabeledCounter(
             "tpusim_serve_dispatch_total",
             "Bucket dispatches by warm-executable-cache outcome", "path"))
+        # streaming-runtime telemetry (ISSUE 7): the device-resident cluster
+        # path — every residency miss routed through a full restage is
+        # classified by cause, and cycles split by execution path so an
+        # O(delta) steady state is visible as stream_scan dominating
+        self.stream_restage = self._reg(LabeledCounter(
+            "tpusim_stream_restage_total",
+            "Stream-runtime full restages of device-resident state, by cause",
+            "reason"))
+        self.stream_cycles = self._reg(LabeledCounter(
+            "tpusim_stream_cycles_total",
+            "Stream-runtime scheduling cycles, by execution path", "path"))
 
     def _reg(self, metric):
         self._registry.append(metric)

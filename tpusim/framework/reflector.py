@@ -36,12 +36,19 @@ class Reflector:
 
     def __init__(self, client: FakeRESTClient, resource: ResourceType,
                  handler: Optional[EventHandler] = None, namespace: str = "",
-                 field_selector: str = ""):
+                 field_selector: str = "",
+                 on_relist: Optional[Callable[[int], None]] = None):
+        """on_relist: called with the relist ordinal after every recovery
+        relist completes. The stream runtime (tpusim.stream) hooks this to
+        invalidate its device-resident state — a relist means the event
+        stream lost frames, so the synthetic diff it replayed may not be
+        O(delta)-expressible against the resident arrays."""
         self.client = client
         self.resource = resource
         self.handler = handler
         self.namespace = namespace
         self.field_selector = field_selector
+        self.on_relist = on_relist
         self.known: Dict[str, object] = {}
         self.relists = 0
         self._buf: Optional[WatchBuffer] = None
@@ -98,6 +105,8 @@ class Reflector:
                     break
             except WatchExpiredError:
                 break
+        if self.on_relist is not None:
+            self.on_relist(self.relists)
         return applied
 
     def sync(self, max_relists: int = 8) -> int:

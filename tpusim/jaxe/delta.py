@@ -147,6 +147,23 @@ class IncrementalCluster:
         self._groups_active = False               # any feature flag set
         self._presence: Optional[np.ndarray] = None
 
+        # delta journal (ISSUE 7): node indices / presence cells touched by
+        # _apply_dynamic since the last drain — the stream runtime
+        # (tpusim.stream) turns these into scatter-commit tensors, so a
+        # cycle's device update is O(touched), not O(nodes). Entries are
+        # only meaningful while the structure is stable (any node/scalar/
+        # group change forces a restage, which drops the journal).
+        self._journal_nodes: set = set()
+        self._journal_presence: set = set()
+        # monotone count of signature-row memo evictions (_evict_sig_rows):
+        # lets the stream runtime classify a residency miss caused by memo
+        # pressure ("sig_evict") apart from genuinely new signatures
+        self.sig_evictions = 0
+        # the most recent _batch_columns interning (per-kind key lists): a
+        # restage records this as the resident row order, against which
+        # later batches' ids are remapped (tpusim.stream)
+        self.last_batch_key_lists: Optional[Dict[str, List]] = None
+
         self._rebuild_nodes()
         for pod in self._pods.values():
             self._note_pod_scalars(pod)
@@ -269,6 +286,7 @@ class IncrementalCluster:
         dyn.nonzero_cpu[i] += sign * nz.milli_cpu
         dyn.nonzero_mem[i] += sign * nz.memory
         dyn.pod_count[i] += sign
+        self._journal_nodes.add(i)
 
         # group presence fast path: known signature -> scatter, else rebuild
         if self._groups_active and not self._groups_dirty \
@@ -278,6 +296,7 @@ class IncrementalCluster:
                 self._groups_dirty = True
             else:
                 self._presence[gid, i] += sign
+                self._journal_presence.add((gid, i))
         elif not self._groups_active and _needs_groups(pod):
             # a ports/affinity pod arriving in a feature-free cluster
             self._groups_dirty = True
@@ -500,24 +519,22 @@ class IncrementalCluster:
         if len(self._sig_rows) <= MAX_SIG_ROWS:
             return
         overflow = len(self._sig_rows) - MAX_SIG_ROWS
+        self.sig_evictions += overflow
         for cache_key in list(self._sig_rows)[:overflow]:
             del self._sig_rows[cache_key]
         live = {sig for (_, sig) in self._sig_rows}
         self._sig_reps = {k: v for k, v in self._sig_reps.items() if k in live}
 
-    def compile(self, pods: List[Pod], need_noexec: bool = False
-                ) -> Tuple[CompiledCluster, PodColumns]:
-        """Compile a new-pod batch against the current cluster picture.
-        Returns fresh array copies (later events do not mutate the result).
-        need_noexec: compute the policy-only NoExecute taint table (the
-        default ships an all-pass dummy; see state.compile_cluster)."""
+    def _batch_columns(self, pods: List[Pod]
+                       ) -> Tuple[PodColumns, Dict[str, List]]:
+        """Pod request columns + batch-local signature interning over the
+        memoized rows — the batch-shaped half of compile(), shared with the
+        stream fast path (which needs columns WITHOUT the O(nodes) table
+        stacking). Returns (cols, per-kind interned key lists); group_id is
+        left zero for the caller to assign."""
         for pod in pods:
             self._note_pod_scalars(pod)
-        statics = self._ensure_statics()
-        dyn = self._ensure_dyn()
         s = len(self._scalar_names)
-
-        # --- pod columns + batch-local interning over memoized signatures ---
         p = len(pods)
         cols = PodColumns(
             req_cpu=np.zeros(p, np.int64), req_mem=np.zeros(p, np.int64),
@@ -549,6 +566,53 @@ class IncrementalCluster:
                     key_lists[name].append(sig_key)
                     self._sig_reps.setdefault(sig_key, pod)
                 getattr(cols, name)[j] = ids[sig_key]
+        self.last_batch_key_lists = key_lists
+        return cols, key_lists
+
+    def batch_group_keys(self, pods: List[Pod]) -> tuple:
+        """The batch's deduped canonical group-signature keys — compile()'s
+        group-table reuse test, exposed so the stream fast path can prove
+        the cached tables would be reused verbatim."""
+        return tuple(dict.fromkeys(
+            _key(_group_signature(pod)) for pod in pods))
+
+    def assign_group_ids(self, cols: PodColumns, pods: List[Pod]) -> bool:
+        """Fill cols.group_id from the cached signature->merged-group map.
+        Only valid while the cached group tables are clean AND the batch's
+        group keys match the tables' batch (batch_group_keys ==
+        _groups_batch_keys); returns False when that doesn't hold and a
+        compile() is required."""
+        if self._groups_dirty or self._groups is None:
+            return False
+        (_hp, _hs, _hi, _nt, _nz, unsupported, _vm) = self._groups_meta
+        if self._groups_active and not unsupported:
+            try:
+                cols.group_id[:] = np.fromiter(
+                    (self._groups_sig_keys[_key(_group_signature(pod))]
+                     for pod in pods), dtype=np.int32, count=len(pods))
+            except KeyError:
+                return False
+        # else: trivial tables — group_id stays all zero
+        return True
+
+    def drain_journal(self) -> Tuple[set, set]:
+        """Hand over (touched node indices, touched presence cells) since
+        the last drain and reset both. Meaningless after a structural event
+        (node indices may have shifted) — callers restage there instead."""
+        nodes, cells = self._journal_nodes, self._journal_presence
+        self._journal_nodes, self._journal_presence = set(), set()
+        return nodes, cells
+
+    def compile(self, pods: List[Pod], need_noexec: bool = False
+                ) -> Tuple[CompiledCluster, PodColumns]:
+        """Compile a new-pod batch against the current cluster picture.
+        Returns fresh array copies (later events do not mutate the result).
+        need_noexec: compute the policy-only NoExecute taint table (the
+        default ships an all-pass dummy; see state.compile_cluster)."""
+        cols, key_lists = self._batch_columns(pods)
+        statics = self._ensure_statics()
+        dyn = self._ensure_dyn()
+        p = len(pods)
 
         tables = SignatureTables(
             selector_ok=self._sig_table("selector_ok", key_lists["sel_id"]),
@@ -566,10 +630,9 @@ class IncrementalCluster:
         self._evict_sig_rows()
 
         # --- group tables: rebuild only on structural change ---
-        batch_group_keys = tuple(dict.fromkeys(
-            _key(_group_signature(pod)) for pod in pods))
+        group_keys = self.batch_group_keys(pods)
         if (self._groups_dirty or self._groups is None
-                or batch_group_keys != self._groups_batch_keys):
+                or group_keys != self._groups_batch_keys):
             snapshot = self.to_snapshot()
             (groups, has_ports, has_services, has_interpod, n_topo, n_zone,
              unsupported, sig_to_gid, vol_meta) = _compile_groups(
@@ -577,7 +640,7 @@ class IncrementalCluster:
             self._groups = groups
             self._groups_meta = (has_ports, has_services, has_interpod,
                                  n_topo, n_zone, unsupported, vol_meta)
-            self._groups_batch_keys = batch_group_keys
+            self._groups_batch_keys = group_keys
             # volume flags count: disk_sig[G]/vol_mask[G, V] key off group
             # ids, so volume-only workloads still need real group_id columns
             self._groups_active = (has_ports or has_services or has_interpod

@@ -1233,6 +1233,70 @@ def pad_infeasible_rows(xs, pad: int):
 
 
 # --------------------------------------------------------------------------
+# Streaming delta commit (ISSUE 7): O(delta) scatter updates into the
+# device-resident carry instead of a full carry_init + device_put restage.
+#
+# The host (jaxe.delta.IncrementalCluster) stays the source of truth: after
+# folding a cycle's watch events it gathers the AUTHORITATIVE post-event
+# values of every touched node row / presence cell, and the donated kernel
+# scatter-`set`s them into the resident carry. Set-from-authoritative (not
+# add-a-delta) makes the commit idempotent and self-healing — the device can
+# never drift from the host columns on the fields it syncs.
+#
+# Per-batch lanes are re-armed here too: sa_lock resets to -1 and rr to 0,
+# exactly what carry_init_host hands a fresh restage, so a stream cycle and
+# a restage cycle run the scan from byte-identical carries. presence_dom and
+# used_vols have no scatter path (their host mirrors live in the group
+# tables, which rebuild on any structural event) — the stream layer
+# (tpusim.stream) restages whenever a config with has_interpod/has_maxpd
+# sees presence/volume churn, so their stale values are never read.
+#
+# Shapes are the caller's retrace contract: tpusim.stream pads node_idx /
+# presence cells to pow2 buckets, so a warm steady-state churn rate reuses
+# one compiled commit program (the zero-retrace warm cycle).
+# --------------------------------------------------------------------------
+
+
+class DeltaRows(NamedTuple):
+    """Authoritative post-event dynamic values for `node_idx` rows, gathered
+    from the host columns (DynamicInit dtypes: int64 throughout)."""
+
+    used_cpu: jnp.ndarray      # [U]
+    used_mem: jnp.ndarray      # [U]
+    used_gpu: jnp.ndarray      # [U]
+    used_eph: jnp.ndarray      # [U]
+    used_scalar: jnp.ndarray   # [U, S]
+    nonzero_cpu: jnp.ndarray   # [U]
+    nonzero_mem: jnp.ndarray   # [U]
+    pod_count: jnp.ndarray     # [U]
+
+
+def _apply_delta_impl(carry: Carry, node_idx, rows: DeltaRows,
+                      pres_gid, pres_nid, pres_val) -> Carry:
+    # duplicate indices (bucket padding repeats a real row) are safe under
+    # `set` scatter semantics only because every duplicate carries the same
+    # authoritative value — any winner writes the same bytes
+    return carry._replace(
+        used_cpu=carry.used_cpu.at[node_idx].set(rows.used_cpu),
+        used_mem=carry.used_mem.at[node_idx].set(rows.used_mem),
+        used_gpu=carry.used_gpu.at[node_idx].set(rows.used_gpu),
+        used_eph=carry.used_eph.at[node_idx].set(rows.used_eph),
+        used_scalar=carry.used_scalar.at[node_idx].set(rows.used_scalar),
+        nonzero_cpu=carry.nonzero_cpu.at[node_idx].set(rows.nonzero_cpu),
+        nonzero_mem=carry.nonzero_mem.at[node_idx].set(rows.nonzero_mem),
+        pod_count=carry.pod_count.at[node_idx].set(rows.pod_count),
+        presence=carry.presence.at[pres_gid, pres_nid].set(pres_val),
+        sa_lock=jnp.full_like(carry.sa_lock, -1),
+        rr=jnp.zeros_like(carry.rr))
+
+
+# Donating the carry makes the commit a true in-place HBM update: the
+# resident buffers are patched, not reallocated, mirroring
+# schedule_scan_donated's chunk-loop contract above.
+apply_delta_donated = jax.jit(_apply_delta_impl, donate_argnums=(0,))
+
+
+# --------------------------------------------------------------------------
 # Device-side preemption victim selection — the arithmetic-reprieve class.
 #
 # Reference mapping (all in core/generic_scheduler.go):

@@ -486,12 +486,11 @@ def _mesh_place(mesh, carry, statics=None):
     sharded over "node", pad rows permanently infeasible (sharding.py sentinel
     bit). statics=None is the post-preemption re-arm — a fresh carry padded to
     match the already-placed statics."""
-    import jax
-
     from tpusim.jaxe.sharding import (
         node_shardings,
         pad_carry_node_axis,
         pad_node_axis,
+        stage_tree,
     )
 
     st_spec, ca_spec = node_shardings(mesh)
@@ -499,8 +498,8 @@ def _mesh_place(mesh, carry, statics=None):
         carry = pad_carry_node_axis(carry, mesh.shape["node"])
     else:
         statics, carry, _ = pad_node_axis(statics, carry, mesh.shape["node"])
-        statics = jax.tree.map(jax.device_put, statics, st_spec)
-    return statics, jax.tree.map(jax.device_put, carry, ca_spec)
+        statics = stage_tree(statics, st_spec)
+    return statics, stage_tree(carry, ca_spec)
 
 
 def run_with_preemption(pods: List[Pod], snapshot: ClusterSnapshot,

@@ -47,6 +47,23 @@ def _infeasible_sentinel():
     return jnp.int64(1) << 62
 
 
+def stage_tree(tree, sharding=None):
+    """Stage a host-numpy pytree onto device — THE device_put shape shared
+    by every staging site (mesh placement here, the what-if batcher, the
+    preemption hybrid's re-arm, the serve executor, and the stream runtime's
+    restage path):
+
+      sharding=None         -> default-device commit (jnp.asarray per leaf)
+      a single Sharding     -> that placement applied to every leaf
+      a pytree of shardings -> leafwise jax.device_put (tree must match)
+    """
+    if sharding is None:
+        return jax.tree.map(jnp.asarray, tree)
+    if isinstance(sharding, jax.sharding.Sharding):
+        return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+    return jax.tree.map(jax.device_put, tree, sharding)
+
+
 def make_mesh(n_devices: Optional[int] = None, snap: int = 1,
               devices: Optional[list] = None) -> Mesh:
     """A ("snap", "node") mesh over the first n_devices devices."""
@@ -222,10 +239,9 @@ def shard_for_mesh(mesh: Mesh, statics: Statics, carry: Carry, xs: PodX
     n_node_shards = mesh.shape["node"]
     statics, carry, _ = pad_node_axis(statics, carry, n_node_shards)
     st_spec, ca_spec = node_shardings(mesh)
-    statics = jax.tree.map(jax.device_put, statics, st_spec)
-    carry = jax.tree.map(jax.device_put, carry, ca_spec)
-    replicated = NamedSharding(mesh, P())
-    xs = jax.tree.map(lambda a: jax.device_put(a, replicated), xs)
+    statics = stage_tree(statics, st_spec)
+    carry = stage_tree(carry, ca_spec)
+    xs = stage_tree(xs, NamedSharding(mesh, P()))
     return statics, carry, xs
 
 

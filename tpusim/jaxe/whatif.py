@@ -59,6 +59,7 @@ from tpusim.jaxe.sharding import (
     scenario_shardings,
     scenario_specs,
     snap_shardings,
+    stage_tree,
 )
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
 
@@ -512,16 +513,15 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
         # default device first would double the transfer and peak memory
         if kind == "snap":
             st_spec, ca_spec, xs_spec = snap_shardings(mesh)
-            xs_b = jax.tree.map(lambda a: jax.device_put(a, xs_spec), host_xs)
         else:
-            ca_spec, st_spec, xs_sh = scenario_shardings(mesh)
-            xs_b = jax.tree.map(jax.device_put, host_xs, xs_sh)
-        carries = jax.tree.map(jax.device_put, host_carries, ca_spec)
-        statics_b = jax.tree.map(jax.device_put, host_statics, st_spec)
+            ca_spec, st_spec, xs_spec = scenario_shardings(mesh)
+        xs_b = stage_tree(host_xs, xs_spec)
+        carries = stage_tree(host_carries, ca_spec)
+        statics_b = stage_tree(host_statics, st_spec)
     else:
-        to_dev = lambda tree: jax.tree.map(jnp.asarray, tree)  # noqa: E731
-        carries, statics_b, xs_b = (to_dev(host_carries),
-                                    to_dev(host_statics), to_dev(host_xs))
+        carries, statics_b, xs_b = (stage_tree(host_carries),
+                                    stage_tree(host_statics),
+                                    stage_tree(host_xs))
 
     if kind == "scenario":
         choices_b, counts_b = _scenario_program(config, mesh)(

@@ -303,6 +303,30 @@ def note_serve(event: str, args: Optional[Dict[str, Any]] = None) -> None:
         rec.instant("serve:" + event, "host", args)
 
 
+def note_stream_restage(reason: str, detail: Optional[str] = None) -> None:
+    """The stream runtime invalidated its device-resident state and paid a
+    full restage: `reason` is the low-cardinality residency-miss class
+    (cold_start/node_set/groups_dirty/scalar_set/new_signature/sig_evict/
+    group_shape/interpod_delta/watch_expired/breaker_open/device_fault/
+    verify_divergence/unsupported), `detail` trace-only context."""
+    _metrics.register().stream_restage.inc(reason)
+    rec = _active
+    if rec is not None:
+        rec.instant("restage:" + reason, "device",
+                    {"why": detail} if detail is not None else None)
+
+
+def note_stream_cycle(path: str, pods: Optional[int] = None) -> None:
+    """One StreamSession scheduling cycle: stream_scan (O(delta) resident
+    dispatch), restage_scan (full re-stage + dispatch), or host (reference
+    fallback under chaos/unsupported features)."""
+    _metrics.register().stream_cycles.inc(path)
+    rec = _active
+    if rec is not None:
+        rec.instant("stream:" + path, "device",
+                    {"pods": pods} if pods is not None else None)
+
+
 def note_watch_overflow(resource: str) -> None:
     """A watch stream died on buffer overflow (the "410 Gone" analog):
     the consumer must relist to resync."""

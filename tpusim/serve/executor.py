@@ -48,7 +48,12 @@ from tpusim.jaxe.whatif import (
     compile_count,
     decode_one,
 )
-from tpusim.jaxe.sharding import mesh_kind, pad_node_axis, scenario_shardings
+from tpusim.jaxe.sharding import (
+    mesh_kind,
+    pad_node_axis,
+    scenario_shardings,
+    stage_tree,
+)
 from tpusim.obs.recorder import note_serve, span
 from tpusim.serve.batcher import Bucket
 from tpusim.serve.request import (
@@ -181,13 +186,13 @@ class ServeExecutor:
         host_carries, host_statics, host_xs = _stack_host(per_scenario)
         if self.mesh is not None:
             ca_sh, st_sh, xs_sh = scenario_shardings(self.mesh)
-            carries = jax.tree.map(jax.device_put, host_carries, ca_sh)
-            statics_b = jax.tree.map(jax.device_put, host_statics, st_sh)
-            xs_b = jax.tree.map(jax.device_put, host_xs, xs_sh)
+            carries = stage_tree(host_carries, ca_sh)
+            statics_b = stage_tree(host_statics, st_sh)
+            xs_b = stage_tree(host_xs, xs_sh)
         else:
-            to_dev = lambda tree: jax.tree.map(jnp.asarray, tree)  # noqa: E731
-            carries, statics_b, xs_b = (to_dev(host_carries),
-                                        to_dev(host_statics), to_dev(host_xs))
+            carries, statics_b, xs_b = (stage_tree(host_carries),
+                                        stage_tree(host_statics),
+                                        stage_tree(host_xs))
         return config, carries, statics_b, xs_b
 
     def _device_batch(self, bucket: Bucket):

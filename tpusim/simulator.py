@@ -774,3 +774,119 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
             status.chaos_violations = []
         return status
     raise ValueError(f"unknown backend {backend!r}")
+
+
+def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
+                          num_nodes: int = 64, cycles: int = 50,
+                          arrivals: int = 32, evict_fraction: float = 0.25,
+                          node_flap_every: int = 0, seed: int = 0,
+                          provider: str = DEFAULT_PROVIDER,
+                          always_restage: bool = False, verify: bool = False,
+                          chaos_plan: Optional[object] = None) -> dict:
+    """Drive a StreamSession through seeded churn (tpusim.stream.ChurnLoadGen)
+    and return a summary dict — the `tpusim stream` CLI, the bench's config 9,
+    and the smoke variant all sit on this loop.
+
+    Unlike run_simulation (one batch, one decision), this is the steady-state
+    shape the streaming runtime exists for: per cycle, watch events fold into
+    the host picture, the delta scatter-commits onto the device-resident
+    carry, and a fresh arrival batch schedules against it — O(delta) per warm
+    cycle instead of O(cluster).
+
+    always_restage: disable the fast path (the restage-comparison arm).
+    verify: additionally run every cycle through a fresh-compile
+        JaxBackend.schedule and assert byte-identical placement hashes.
+    chaos_plan: device-fault section only — churn/fabric faults are what the
+        load generator already produces, event-shaped.
+    """
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.backends import get_backend, placement_hash
+    from tpusim.jaxe.delta import IncrementalCluster
+    from tpusim.stream import ChurnLoadGen, StreamSession
+
+    if snapshot is None:
+        snapshot = synthetic_cluster(num_nodes)
+    breaker = None
+    if chaos_plan is not None:
+        chaos_plan.validate()
+        if not chaos_plan.host_sections_empty():
+            raise ValueError(
+                "run_stream_simulation takes device fault sections only: "
+                "churn/fabric faults arrive through the load generator as "
+                "watch events")
+        if not chaos_plan.device.empty():
+            from tpusim.jaxe.backend import install_chaos
+
+            breaker = install_chaos(chaos_plan.device)
+    session = StreamSession(snapshot, provider=provider,
+                            always_restage=always_restage)
+    gen = ChurnLoadGen(snapshot, seed=seed, arrivals=arrivals,
+                       evict_fraction=evict_fraction,
+                       node_flap_every=node_flap_every)
+    ref_inc = ref_backend = ref_gen = None
+    if verify:
+        ref_inc = IncrementalCluster(snapshot)
+        ref_backend = get_backend("jax", provider=provider)
+        ref_gen = ChurnLoadGen(snapshot, seed=seed, arrivals=arrivals,
+                               evict_fraction=evict_fraction,
+                               node_flap_every=node_flap_every)
+    import hashlib
+
+    chain = hashlib.sha256()
+    latencies: List[float] = []
+    scheduled = decisions = mismatches = 0
+    t_start = perf_counter()
+    try:
+        for cycle in range(cycles):
+            session.apply_events(gen.events(cycle))
+            batch = gen.batch()
+            t0 = perf_counter()
+            placements = session.schedule(batch)
+            latencies.append(perf_counter() - t0)
+            gen.note_bound(placements)
+            decisions += len(placements)
+            scheduled += sum(1 for p in placements if p.node_name)
+            h = placement_hash(placements)
+            chain.update(h.encode())
+            if verify:
+                ref_inc.apply_events(ref_gen.events(cycle))
+                ref_batch = ref_gen.batch()
+                expected = ref_backend.schedule(ref_batch,
+                                                ref_inc.to_snapshot())
+                for pl in expected:
+                    if pl.node_name:
+                        ref_inc.apply(MODIFIED, pl.pod)
+                ref_gen.note_bound(expected)
+                if placement_hash(expected) != h:
+                    mismatches += 1
+    finally:
+        if breaker is not None:
+            from tpusim.jaxe.backend import uninstall_chaos
+
+            uninstall_chaos()
+    elapsed = perf_counter() - t_start
+    latencies.sort()
+
+    def pct(q: float) -> float:
+        i = min(len(latencies) - 1, int(round(q * (len(latencies) - 1))))
+        return latencies[i] if latencies else 0.0
+
+    out = {
+        "cycles": cycles, "nodes": len(session.inc.nodes),
+        "decisions": decisions, "scheduled": scheduled,
+        "unschedulable": decisions - scheduled,
+        "elapsed_s": elapsed,
+        "decisions_per_s": decisions / elapsed if elapsed > 0 else 0.0,
+        "p50_cycle_ms": pct(0.5) * 1e3, "p99_cycle_ms": pct(0.99) * 1e3,
+        "paths": dict(session.path_counts),
+        "restages": dict(session.restage_counts),
+        "commits": session.device.commits,
+        "placement_chain": chain.hexdigest(),
+        "load": dict(gen.stats),
+    }
+    if verify:
+        out["verified"] = mismatches == 0
+        out["mismatched_cycles"] = mismatches
+    if breaker is not None:
+        out["breaker_transitions"] = list(breaker.transitions)
+    return out

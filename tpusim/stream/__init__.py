@@ -1,0 +1,18 @@
+"""Streaming scheduler runtime (ISSUE 7): device-resident cluster state,
+O(delta) scatter updates, classified restage fallbacks."""
+
+from tpusim.stream.loadgen import ChurnLoadGen
+from tpusim.stream.runtime import (
+    MIN_BUCKET,
+    DeviceResidentCluster,
+    StreamSession,
+    bucket_size,
+)
+
+__all__ = [
+    "MIN_BUCKET",
+    "ChurnLoadGen",
+    "DeviceResidentCluster",
+    "StreamSession",
+    "bucket_size",
+]

@@ -1,0 +1,101 @@
+"""Seeded churn load generator for the streaming runtime.
+
+Produces the workload shape the stream fast path is built for — and the
+failure shapes it must classify: per cycle, a batch of fresh pod arrivals
+(scatter-friendly steady state) interleaved with watch-fabric events
+(evictions of previously bound pods → O(delta) scatter commits; periodic
+node flaps → structural restages). Fully deterministic under a seed, so the
+bench, the smoke variant, and the churn-parity fuzz replay identical
+sequences.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from tpusim.api.snapshot import ClusterSnapshot, make_pod
+from tpusim.api.types import Node, Pod
+from tpusim.backends import Placement
+from tpusim.framework.store import DELETED, MODIFIED
+
+# (milli_cpu, memory) request shapes; a mixed-shape run exercises the
+# signature remap (every shape still hits the same interned signatures after
+# the first restage — requests don't enter the sig keys, selectors do)
+DEFAULT_SHAPES: Tuple[Tuple[int, int], ...] = (
+    (100, 256 << 20),
+    (250, 512 << 20),
+    (500, 1 << 30),
+)
+
+
+class ChurnLoadGen:
+    """Deterministic churn: arrivals + evictions (+ optional node flaps).
+
+    evict_fraction: per cycle, this fraction of the arrival batch size is
+        drawn from the currently-bound population and DELETED (the watch
+        fabric's pod-evict shape — lands in the stream runtime as journal
+        rows, not a restage).
+    node_flap_every: every k-th cycle cordons one node (MODIFIED,
+        unschedulable=True) and restores it the next cycle — each flap is a
+        structural event the device cannot scatter, forcing a classified
+        restage pair.
+    """
+
+    def __init__(self, snapshot: ClusterSnapshot, *, seed: int = 0,
+                 arrivals: int = 32, evict_fraction: float = 0.25,
+                 node_flap_every: int = 0,
+                 shapes: Tuple[Tuple[int, int], ...] = DEFAULT_SHAPES,
+                 name_prefix: str = "churn"):
+        self.rng = random.Random(seed)
+        self.nodes: List[Node] = list(snapshot.nodes)
+        self.arrivals = arrivals
+        self.evict_fraction = evict_fraction
+        self.node_flap_every = node_flap_every
+        self.shapes = shapes
+        self.name_prefix = name_prefix
+        self.serial = 0
+        self.bound: Dict[str, Pod] = {}     # pod name -> bound copy
+        self._flapped: Optional[Node] = None  # cordoned node awaiting restore
+        self.stats = {"arrivals": 0, "evictions": 0, "flaps": 0}
+
+    def batch(self) -> List[Pod]:
+        """The cycle's fresh arrivals (Pending pods, no node)."""
+        out = []
+        for _ in range(self.arrivals):
+            cpu, mem = self.shapes[self.serial % len(self.shapes)]
+            out.append(make_pod(f"{self.name_prefix}-{self.serial}",
+                                milli_cpu=cpu, memory=mem))
+            self.serial += 1
+        self.stats["arrivals"] += len(out)
+        return out
+
+    def events(self, cycle: int) -> List[Tuple[str, object]]:
+        """Watch-fabric events preceding this cycle's batch."""
+        out: List[Tuple[str, object]] = []
+        if self._flapped is not None:
+            restored = self._flapped.copy()
+            restored.spec.unschedulable = False
+            out.append((MODIFIED, restored))
+            self._flapped = None
+        n_evict = int(self.arrivals * self.evict_fraction)
+        if n_evict and self.bound:
+            names = self.rng.sample(sorted(self.bound),
+                                    min(n_evict, len(self.bound)))
+            for name in names:
+                out.append((DELETED, self.bound.pop(name)))
+            self.stats["evictions"] += len(names)
+        if self.node_flap_every and cycle and self.nodes \
+                and cycle % self.node_flap_every == 0:
+            node = self.nodes[self.rng.randrange(len(self.nodes))].copy()
+            node.spec.unschedulable = True
+            out.append((MODIFIED, node))
+            self._flapped = node
+            self.stats["flaps"] += 1
+        return out
+
+    def note_bound(self, placements: List[Placement]) -> None:
+        """Record this cycle's binds as future eviction candidates."""
+        for pl in placements:
+            if pl.node_name:
+                self.bound[pl.pod.name] = pl.pod

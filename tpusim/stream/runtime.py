@@ -1,0 +1,524 @@
+"""Streaming scheduler runtime: device-resident cluster state, O(delta)
+scatter commits (ISSUE 7).
+
+Every other execution path in this repo re-stages the full compiled cluster
+(statics + dynamic carry) onto device per scheduling attempt, so churn-heavy
+steady state pays O(cluster) host→HBM traffic per cycle — BASELINE.md's
+r02→r05 warm-CPU slide (11,410 → 6,232 pods/s on an unchanged placement
+hash) is that staging contention. The reference simulator never re-lists the
+world per decision: its reflector→informer fabric mutates a persistent cache
+in place. This module is the device-side analog:
+
+  DeviceResidentCluster — the compiled arrays stay in HBM across decisions;
+      watch-fabric deltas land as donated scatter updates
+      (kernels.apply_delta_donated) gathered from the IncrementalCluster's
+      journal, so a warm cycle's update cost is O(touched rows), not
+      O(nodes).
+  StreamSession — drives ingest → scatter-commit → schedule → fold-back.
+      Binds from the fused scan update the resident carry directly (the
+      scan's final carry IS the post-bind state — zero host round-trip);
+      host fold-back journal entries are therefore discarded, not
+      re-committed. Structural events the scatter path can't express (node
+      churn, group-table invalidation, signature-memo eviction, scalar
+      universe growth, watch 410-relists) fall back to a full restage,
+      classified in tpusim_stream_restage_total{reason}.
+
+Exactness contract (tested by the churn-parity fuzz): stream-path placements
+are byte-identical (placement_hash) to scheduling every batch through the
+full-restage path (JaxBackend.schedule on a fresh compile) over any event
+sequence. The parity argument: the host IncrementalCluster stays the source
+of truth; commits scatter-`set` AUTHORITATIVE host values (idempotent,
+self-healing), the commit re-arms the per-batch lanes (sa_lock/rr) exactly
+like carry_init_host, and every field without a scatter path (presence_dom,
+used_vols, statics columns) only changes under events that force a restage.
+
+Chaos composition mirrors jaxe.backend.JaxBackend.schedule: host faults
+(node flap, pod evict, watch drop) arrive as ordinary deltas; device faults
+flow through the same circuit breaker + injector seam, and any chaos
+intervention (fault, corruption, verify divergence, open breaker)
+invalidates residency so the next cycle re-arms from host truth.
+"""
+
+from __future__ import annotations
+
+import logging
+from time import perf_counter
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.api.types import Pod, ResourceType
+from tpusim.backends import (
+    Placement,
+    ReferenceBackend,
+    mark_unschedulable,
+    placement_hash,
+)
+from tpusim.engine.providers import DEFAULT_PROVIDER
+from tpusim.framework.events import WatchExpiredError
+from tpusim.framework.metrics import register, since_in_microseconds
+from tpusim.framework.reflector import Reflector
+from tpusim.framework.store import MODIFIED
+from tpusim.jaxe import backend as _backend
+from tpusim.jaxe import ensure_responsive_platform, ensure_x64
+from tpusim.jaxe.delta import IncrementalCluster
+from tpusim.jaxe.kernels import (
+    DeltaRows,
+    apply_delta_donated,
+    carry_init_host,
+    config_for,
+    pad_infeasible_rows,
+    pod_columns_to_host,
+    schedule_scan_donated,
+    statics_to_host,
+)
+from tpusim.jaxe.sharding import stage_tree
+from tpusim.jaxe.state import NUM_FIXED_BITS, reason_strings
+from tpusim.obs import recorder as flight
+
+log = logging.getLogger(__name__)
+
+# Scatter-commit and pod-batch axes are padded up to pow2 buckets (floor 8):
+# the warm steady state cycles through a handful of compiled programs instead
+# of one per delta count — the zero-retrace contract kernels.py documents.
+MIN_BUCKET = 8
+
+
+def bucket_size(n: int) -> int:
+    """Smallest pow2 >= n, floored at MIN_BUCKET."""
+    return max(MIN_BUCKET, 1 << max(0, n - 1).bit_length())
+
+
+def _pad_index(idx: np.ndarray, size: int) -> np.ndarray:
+    """Pad an index vector to `size` by repeating its first entry (index 0
+    when empty): duplicates are safe under the commit's `set` semantics
+    because every duplicate carries the same authoritative value."""
+    if len(idx) >= size:
+        return idx
+    fill = idx[0] if len(idx) else np.int32(0)
+    return np.concatenate([idx, np.full(size - len(idx), fill, np.int32)])
+
+
+class DeviceResidentCluster:
+    """The device half of the stream runtime: compiled statics + carry held
+    in HBM across decisions, plus the host-side metadata needed to prove a
+    new batch can reuse them (resident signature-row interning, group batch
+    keys, node/scalar shape)."""
+
+    def __init__(self):
+        self.compiled = None          # host CompiledCluster of the restage
+        self.config = None            # EngineConfig (jit-static)
+        self.statics = None           # device Statics
+        self.carry = None             # device Carry — THE resident state
+        self.sig_rows: Optional[Dict[str, Dict[object, int]]] = None
+        self.n_nodes = 0
+        self.scalar_width = 0
+        self.evictions_mark = 0       # inc.sig_evictions at adopt time
+        self.commits = 0              # scatter commits since construction
+        self.restages = 0
+
+    @property
+    def valid(self) -> bool:
+        return self.carry is not None
+
+    def invalidate(self) -> None:
+        self.compiled = self.config = self.statics = self.carry = None
+        self.sig_rows = None
+
+    def adopt(self, inc: IncrementalCluster, compiled, config, statics,
+              carry) -> None:
+        """Install a freshly restaged state as resident."""
+        self.compiled = compiled
+        self.config = config
+        self.statics = statics
+        self.carry = carry
+        # resident signature-row order per kind: later batches' batch-local
+        # ids are remapped through these dicts onto the resident table rows
+        self.sig_rows = {kind: {key: row for row, key in enumerate(keys)}
+                         for kind, keys in inc.last_batch_key_lists.items()}
+        self.n_nodes = len(compiled.statics.names)
+        self.scalar_width = len(compiled.scalar_names)
+        self.evictions_mark = inc.sig_evictions
+        self.restages += 1
+
+    def residency_miss(self, inc: IncrementalCluster) -> Optional[str]:
+        """A structural reason the resident arrays cannot serve the next
+        cycle, or None. Ordering matters for the classifier: node events
+        also dirty the group tables, so the node-set check runs first."""
+        if self.carry is None:
+            return "cold_start"
+        if len(inc.nodes) != self.n_nodes:
+            return "node_set"
+        if inc._groups_dirty:
+            return "groups_dirty"
+        if len(inc._scalar_names) != self.scalar_width:
+            return "scalar_set"
+        return None
+
+    def remap_signatures(self, inc: IncrementalCluster, cols,
+                         key_lists: Dict[str, List]) -> Optional[str]:
+        """Rewrite the batch's batch-local signature ids into resident table
+        row ids in place. Returns None on success, or the restage reason for
+        a signature the resident tables have no row for ("sig_evict" when
+        the memo has evicted rows since the restage — the miss may be cache
+        pressure, not novelty)."""
+        luts = {}
+        for kind, keys in key_lists.items():
+            resident = self.sig_rows[kind]
+            try:
+                luts[kind] = np.fromiter((resident[k] for k in keys),
+                                         dtype=np.int32, count=len(keys))
+            except KeyError:
+                return ("sig_evict"
+                        if inc.sig_evictions > self.evictions_mark
+                        else "new_signature")
+        for kind, lut in luts.items():
+            col = getattr(cols, kind)
+            col[:] = lut[col]
+        return None
+
+    def commit(self, inc: IncrementalCluster) -> None:
+        """Drain the IncrementalCluster's delta journal and scatter-commit
+        the AUTHORITATIVE post-event values of every touched node row /
+        presence cell into the resident carry (donated: the HBM buffers are
+        patched in place). Always dispatches — even with an empty journal —
+        because the commit also re-arms the per-batch lanes (sa_lock/rr) to
+        carry_init_host's values, keeping stream and restage cycles
+        byte-identical."""
+        nodes, cells = inc.drain_journal()
+        dyn = inc._ensure_dyn()
+        idx = np.fromiter(sorted(nodes), dtype=np.int32, count=len(nodes))
+        idx = _pad_index(idx, bucket_size(max(len(idx), 1)))
+        rows = DeltaRows(
+            used_cpu=dyn.used_cpu[idx], used_mem=dyn.used_mem[idx],
+            used_gpu=dyn.used_gpu[idx], used_eph=dyn.used_eph[idx],
+            used_scalar=dyn.used_scalar[idx],
+            nonzero_cpu=dyn.nonzero_cpu[idx],
+            nonzero_mem=dyn.nonzero_mem[idx],
+            pod_count=dyn.pod_count[idx])
+        cell_list = sorted(cells)
+        gid = np.fromiter((g for g, _ in cell_list), dtype=np.int32,
+                          count=len(cell_list))
+        nid = np.fromiter((n for _, n in cell_list), dtype=np.int32,
+                          count=len(cell_list))
+        size = bucket_size(max(len(gid), 1))
+        gid, nid = _pad_index(gid, size), _pad_index(nid, size)
+        if inc._presence is not None:
+            val = inc._presence[gid, nid].astype(np.int32)
+        else:
+            # trivial [1, N] dummy presence: the padded (0, 0) cells are
+            # untouched zeros on both sides
+            val = np.zeros(size, np.int32)
+        sp = flight.span("stream_commit", "device")
+        self.carry = apply_delta_donated(self.carry, idx, rows, gid, nid, val)
+        if sp:
+            sp.set("rows", int(len(nodes)))
+            sp.set("cells", int(len(cells)))
+            sp.end()
+        self.commits += 1
+
+
+class StreamSession:
+    """Drives the streaming loop: ingest watch deltas → scatter-commit →
+    schedule on the resident state → fold placements back.
+
+    v1 scope: providers only (no compiled policy — policy'd workloads keep
+    the per-batch JaxBackend path). Unsupported feature combinations route
+    whole batches through the reference backend, classified like every
+    other fallback.
+    """
+
+    def __init__(self, snapshot: Optional[ClusterSnapshot] = None, *,
+                 incremental: Optional[IncrementalCluster] = None,
+                 provider: str = DEFAULT_PROVIDER,
+                 hard_pod_affinity_symmetric_weight: int = 10,
+                 always_restage: bool = False):
+        """always_restage: disable the O(delta) fast path — every cycle pays
+        the full compile + device staging. The bench's restage-vs-stream
+        comparison arm; placements are identical either way."""
+        if provider not in _backend._KNOWN_PROVIDERS:
+            raise KeyError(f"plugin {provider!r} has not been registered")
+        ensure_x64()
+        ensure_responsive_platform()
+        self.inc = (incremental if incremental is not None
+                    else IncrementalCluster(snapshot))
+        self.provider = provider
+        self.hard_weight = hard_pod_affinity_symmetric_weight
+        self.always_restage = always_restage
+        self.device = DeviceResidentCluster()
+        self.cycles = 0
+        self.restage_counts: Dict[str, int] = {}
+        self.path_counts: Dict[str, int] = {}
+        self._forced: Optional[str] = None
+        self._reflectors: List[Reflector] = []
+
+    # -- ingest -----------------------------------------------------------
+
+    def apply(self, event_type: str, obj) -> None:
+        self.inc.apply(event_type, obj)
+
+    def apply_events(self, events) -> None:
+        self.inc.apply_events(events)
+
+    def ingest(self, watch_buffer) -> int:
+        """Drain a WatchBuffer into the host picture. A torn stream (410
+        Gone analog) forces a restage on the next cycle and re-raises so
+        the caller can relist (or use watch()/sync(), which do)."""
+        try:
+            return self.inc.ingest(watch_buffer)
+        except WatchExpiredError:
+            self.force_restage("watch_expired")
+            raise
+
+    def watch(self, client, resource: ResourceType, **kwargs) -> Reflector:
+        """Attach a Reflector stream feeding this session; its 410-Gone
+        recovery relists force a device restage (the synthetic diff may not
+        be O(delta)-expressible)."""
+        r = Reflector(client, resource, handler=self.inc.apply,
+                      on_relist=lambda _n: self.force_restage("watch_expired"),
+                      **kwargs)
+        self._reflectors.append(r)
+        return r
+
+    def sync(self) -> int:
+        """Drain every attached Reflector; returns events applied."""
+        return sum(r.sync() for r in self._reflectors)
+
+    def force_restage(self, reason: str) -> None:
+        """Invalidate residency before the next cycle (first reason wins)."""
+        if self._forced is None:
+            self._forced = reason
+
+    # -- the cycle --------------------------------------------------------
+
+    def schedule(self, pods: List[Pod]) -> List[Placement]:
+        """One decision cycle: route the batch through the resident fast
+        path when residency holds, else a classified restage; fold scheduled
+        placements back into the host picture (and, on the fast path, rely
+        on the scan having already bound them on device)."""
+        if not pods:
+            return []
+        self.cycles += 1
+        inc = self.inc
+        if not inc.nodes:
+            msg = "no nodes available to schedule pods"
+            return [Placement(pod=mark_unschedulable(p, msg),
+                              reason="Unschedulable", message=msg)
+                    for p in pods]
+        t0 = perf_counter()
+        reason = self._forced
+        self._forced = None
+        if reason is None and self.always_restage:
+            reason = "forced_restage"
+        if reason is None:
+            reason = self.device.residency_miss(inc)
+        cols = None
+        if reason is None:
+            cols, key_lists = inc._batch_columns(pods)
+            if len(inc._scalar_names) != self.device.scalar_width:
+                # the batch itself widened the scalar universe
+                reason = "scalar_set"
+            else:
+                reason = self.device.remap_signatures(inc, cols, key_lists)
+            if reason is None and not inc.assign_group_ids(cols, pods):
+                reason = "group_shape"
+            if reason is None and self.device.config.has_interpod \
+                    and inc._journal_presence:
+                # presence_dom has no scatter path: external presence churn
+                # under inter-pod affinity must rebuild it host-side
+                reason = "interpod_delta"
+        if reason is None:
+            placements = self._stream_cycle(pods, cols)
+        else:
+            placements = self._restage_cycle(pods, reason)
+        for pl in placements:
+            if pl.node_name:
+                inc.apply(MODIFIED, pl.pod)
+        if self.device.valid:
+            # the scan already applied these binds to the resident carry
+            # with identical integer arithmetic — replaying the fold-back
+            # journal next cycle would be a byte-for-byte no-op
+            inc.drain_journal()
+        register().e2e_scheduling_latency.observe(since_in_microseconds(t0))
+        return placements
+
+    # -- paths ------------------------------------------------------------
+
+    def _stream_cycle(self, pods: List[Pod], cols) -> List[Placement]:
+        dev = self.device
+
+        def dispatch():
+            dev.commit(self.inc)
+            p = len(pods)
+            xs_host = pad_infeasible_rows(pod_columns_to_host(cols),
+                                          bucket_size(p) - p)
+            carry, placements, intervened = self._dispatch(
+                dev.config, dev.carry, dev.statics, stage_tree(xs_host),
+                pods, dev.compiled)
+            # the donated input buffer is gone either way; the scan's final
+            # carry IS the post-bind resident state
+            dev.carry = carry
+            return placements, intervened
+
+        return self._run_guarded(pods, "stream_scan", dispatch)
+
+    def _restage_cycle(self, pods: List[Pod], reason: str) -> List[Placement]:
+        inc = self.inc
+        dev = self.device
+        dev.invalidate()
+        inc.drain_journal()  # structural restage: indices may have shifted
+        t0 = perf_counter()
+        with flight.span("compile_cluster") as csp:
+            compiled, cols = inc.compile(pods)
+            if csp:
+                csp.set("pods", len(pods))
+                csp.set("nodes", len(inc.nodes))
+        register().backend_compile_latency.observe(since_in_microseconds(t0))
+        if compiled.unsupported:
+            detail = "; ".join(sorted(set(compiled.unsupported))[:5])
+            log.warning("stream runtime falling back to reference for: %s",
+                        detail)
+            return self._host_cycle(pods, "reference_fallback")
+        config = config_for(
+            [compiled],
+            most_requested=self.provider in _backend._MOST_REQUESTED_PROVIDERS,
+            num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names),
+            hard_weight=self.hard_weight)
+        statics = stage_tree(statics_to_host(compiled))
+        carry0 = stage_tree(carry_init_host(compiled))
+        p = len(pods)
+        xs_host = pad_infeasible_rows(pod_columns_to_host(cols),
+                                      bucket_size(p) - p)
+        xs = stage_tree(xs_host)
+
+        def dispatch():
+            carry, placements, intervened = self._dispatch(
+                config, carry0, statics, xs, pods, compiled)
+            if not intervened:
+                dev.adopt(inc, compiled, config, statics, carry)
+            return placements, intervened
+
+        return self._run_guarded(pods, "restage_scan", dispatch, reason)
+
+    def _run_guarded(self, pods: List[Pod], path: str,
+                     dispatch: Callable[[], Tuple[List[Placement], bool]],
+                     restage_reason: Optional[str] = None) -> List[Placement]:
+        """The chaos seam, mirroring JaxBackend.schedule: breaker-denied or
+        faulted dispatches route to the host pipeline, probes and
+        verify="all" dispatches are host-verified before placements are
+        emitted, and ANY intervention invalidates residency (the next cycle
+        re-arms from host truth).
+
+        Classification is deferred to here so each off-stream cycle carries
+        exactly ONE label — its final disposition: a restage cycle that the
+        breaker denies counts as breaker_open, not as its structural reason
+        plus breaker_open."""
+        breaker = _backend._CHAOS["breaker"]
+        if breaker is None:
+            placements, intervened = dispatch()
+            if restage_reason is not None:
+                self._classify(restage_reason)
+            if intervened:
+                self.device.invalidate()
+            self._note_path(path, len(pods))
+            return placements
+        from tpusim.chaos.engine import DeviceFault
+
+        if not breaker.allow():
+            flight.note_route("breaker_fallback", len(pods))
+            return self._host_cycle(pods, "breaker_open")
+        probing = breaker.probing
+        try:
+            placements, intervened = dispatch()
+        except DeviceFault as exc:
+            breaker.record_failure(f"{type(exc).__name__}: {exc}")
+            flight.note_route("breaker_fallback", len(pods))
+            return self._host_cycle(pods, "device_fault")
+        if probing or _backend._CHAOS["verify"] == "all":
+            expected = self._reference(pods)
+            if placement_hash(placements) != placement_hash(expected):
+                breaker.record_failure("device/host placement divergence")
+                flight.note_route("breaker_fallback", len(pods))
+                self.device.invalidate()
+                self._classify("verify_divergence")
+                self._note_path("host", len(pods))
+                return expected
+        breaker.record_success()
+        if restage_reason is not None:
+            self._classify(restage_reason)
+        if intervened:
+            self.device.invalidate()
+        self._note_path(path, len(pods))
+        return placements
+
+    def _dispatch(self, config, carry, statics, xs, pods: List[Pod],
+                  compiled) -> Tuple[object, List[Placement], bool]:
+        """Run the donated scan under the chaos injector seam. Returns
+        (final_carry, placements, intervened) — `intervened` flags a
+        scripted corruption this dispatch (the emitted results may not
+        match the device's true decisions, so residency must drop)."""
+        metrics = register()
+        injector = _backend._CHAOS["injector"]
+        corrupt_kind = None
+        if injector is not None:
+            corrupt_kind = injector.begin_dispatch()  # may raise DeviceFault
+        t0 = perf_counter()
+        dsp = flight.span("device_dispatch", "device")
+        with flight.profiled("tpusim:stream_scan"):
+            final_carry, choices, counts, _adv = schedule_scan_donated(
+                config, carry, statics, xs)
+        p = len(pods)
+        choices = np.asarray(choices)[:p]
+        counts = np.asarray(counts)[:p]
+        if injector is not None:
+            if corrupt_kind is not None:
+                from tpusim.chaos.engine import DeviceInjector
+
+                choices, counts = DeviceInjector.corrupt(corrupt_kind,
+                                                         choices, counts)
+            from tpusim.chaos.engine import DeviceOutputError
+
+            n_nodes = len(compiled.statics.names)
+            if choices.size and (int(choices.max()) >= n_nodes
+                                 or int(choices.min()) < -1):
+                raise DeviceOutputError(
+                    f"device choice out of range [-1, {n_nodes})")
+            if np.isnan(np.asarray(counts, dtype=np.float64)).any():
+                raise DeviceOutputError("NaN in device reason counts")
+        if dsp:
+            dsp.set("pods", p)
+            dsp.end()
+        metrics.backend_dispatch_latency.observe(since_in_microseconds(t0))
+        metrics.scheduling_algorithm_latency.observe(
+            since_in_microseconds(t0))
+        strings = reason_strings(compiled.scalar_names)
+        with flight.span("decode_placements"):
+            placements, _ = _backend.decode_placements(
+                pods, choices, counts, compiled.statics.names, strings)
+        return final_carry, placements, corrupt_kind is not None
+
+    def _host_cycle(self, pods: List[Pod], reason: str) -> List[Placement]:
+        """Reference-backend cycle (chaos fallback or unsupported features):
+        residency drops — the device never saw these binds."""
+        self._classify(reason)
+        self.device.invalidate()
+        placements = self._reference(pods)
+        self._note_path("host", len(pods))
+        return placements
+
+    def _reference(self, pods: List[Pod]) -> List[Placement]:
+        return ReferenceBackend(
+            provider=self.provider,
+            hard_pod_affinity_symmetric_weight=self.hard_weight,
+        ).schedule(pods, self.inc.to_snapshot())
+
+    # -- accounting -------------------------------------------------------
+
+    def _classify(self, reason: str, detail: Optional[str] = None) -> None:
+        self.restage_counts[reason] = self.restage_counts.get(reason, 0) + 1
+        flight.note_stream_restage(reason, detail)
+
+    def _note_path(self, path: str, pods: int) -> None:
+        self.path_counts[path] = self.path_counts.get(path, 0) + 1
+        flight.note_stream_cycle(path, pods)

@@ -1,26 +1,36 @@
-"""Churn-parity fuzz for the streaming runtime (ISSUE 7 tentpole test).
+"""Churn-parity fuzz for the streaming runtime (ISSUE 7 tentpole test,
+ISSUE 9 policy residency + pipelining).
 
 The exactness contract under test: placements emitted from the
 device-resident O(delta) fast path are byte-identical (placement_hash) to
 scheduling every batch through a full re-stage — over seeded random event
-sequences mixing pod arrivals, evictions of bound pods, node flaps, and
-scripted device faults. run_stream_simulation(verify=True) runs the
-comparison arm per cycle: a fresh-compile JaxBackend.schedule against a
-parallel IncrementalCluster fed the identical event stream.
+sequences mixing pod arrivals, evictions of bound pods, node flaps,
+label/taint churn, and scripted device faults, with or without a compiled
+scheduler policy resident on device, synchronously or pipelined.
+run_stream_simulation(verify=True) runs the comparison arm per cycle: a
+fresh-compile JaxBackend.schedule against a parallel IncrementalCluster fed
+the identical event stream.
 
-A fast matrix rides tier-1; the wide sweep is marked ``slow``. The
+A fast matrix rides tier-1; the wide sweeps are marked ``slow``. The
 classification contract is asserted alongside: every cycle not served by
 the stream scan carries exactly one tpusim_stream_restage_total reason.
 """
 
+import json
+import pathlib
+
 import pytest
 
 from tpusim.chaos import DeviceFaultPlan, FaultPlan
+from tpusim.engine.policy import decode_policy
 from tpusim.simulator import run_stream_simulation
 from tpusim.stream import MIN_BUCKET, bucket_size
 
 NODES = 8
 ARRIVALS = 8
+
+POLICIES = json.loads(
+    (pathlib.Path(__file__).parent / "compat_policies.json").read_text())
 
 
 def _run(**kw):
@@ -30,10 +40,13 @@ def _run(**kw):
 
 
 def _assert_accounted(out):
-    """Every cycle took exactly one path, and every non-stream cycle was
-    classified with exactly one restage reason."""
+    """Every cycle took exactly one path, and every cycle not served by the
+    resident fast path (stream_scan / pipelined) or trivially disposed
+    (no_nodes) was classified with exactly one restage reason."""
     assert sum(out["paths"].values()) == out["cycles"]
-    off_stream = out["cycles"] - out["paths"].get("stream_scan", 0)
+    off_stream = (out["cycles"] - out["paths"].get("stream_scan", 0)
+                  - out["paths"].get("pipelined", 0)
+                  - out["paths"].get("no_nodes", 0))
     assert sum(out["restages"].values()) == off_stream
 
 
@@ -117,6 +130,154 @@ def test_chaos_breaker_open_classified():
     assert any(t[0] == "open" for t in transitions), transitions
 
 
+def test_no_nodes_cycle_classified():
+    """ISSUE 9 satellite: an empty cluster's cycle still carries exactly
+    one path label (no_nodes) — the accounting identity holds with no
+    restage reason and no silent cycles."""
+    from tpusim.api.snapshot import ClusterSnapshot, make_pod
+    from tpusim.framework.metrics import register
+    from tpusim.stream import StreamSession
+
+    session = StreamSession(ClusterSnapshot(nodes=[], pods=[]))
+    placements = session.schedule([make_pod("orphan", milli_cpu=100,
+                                            memory=1 << 20)])
+    assert [pl.node_name for pl in placements] == [""]
+    assert placements[0].reason == "Unschedulable"
+    assert session.cycles == 1
+    assert session.path_counts == {"no_nodes": 1}
+    assert session.restage_counts == {}
+    child = register().stream_cycle_latency.get("no_nodes")
+    assert child is not None and child.count >= 1
+
+
+@pytest.mark.parametrize("version", ["1.0", "1.3", "1.9"])
+def test_policy_churn_parity_fast(version):
+    """ISSUE 9 tentpole (fast matrix): a compiled policy stays resident
+    through pure label/taint churn — zero restages after cold start, every
+    cycle byte-identical to a fresh-compile policy'd JaxBackend."""
+    pol = decode_policy(POLICIES[version])
+    out = _run(cycles=8, seed=9, label_churn=2, taint_churn=1,
+               policy=pol, verify=True)
+    assert out["verified"], out
+    assert out["mismatched_cycles"] == 0
+    _assert_accounted(out)
+    assert out["restages"] == {"cold_start": 1}
+    assert out["paths"] == {"restage_scan": 1, "stream_scan": 7}
+
+
+def test_policy_churn_fifty_cycles_only_cold_start():
+    """The acceptance workload: >= 50 cycles of pure label/taint churn
+    under a fixed plan signature restage exactly once (cold start)."""
+    pol = decode_policy(POLICIES["1.0"])
+    out = _run(cycles=50, seed=11, label_churn=2, taint_churn=1, policy=pol)
+    _assert_accounted(out)
+    assert out["restages"] == {"cold_start": 1}
+    assert out["paths"] == {"restage_scan": 1, "stream_scan": 49}
+    assert out["load"]["label_churns"] == 100
+    assert out["load"]["taint_churns"] == 50
+
+
+def test_pipelined_matches_synchronous_chain():
+    """Pipelined execution emits byte-identical placements in the same
+    order as the synchronous path — with and without a resident policy."""
+    sync = _run(cycles=8, seed=12, label_churn=2)
+    pipe = _run(cycles=8, seed=12, label_churn=2, pipeline=True)
+    assert pipe["placement_chain"] == sync["placement_chain"]
+    assert pipe["paths"].get("pipelined", 0) >= 6
+    _assert_accounted(pipe)
+
+    pol = decode_policy(POLICIES["1.3"])
+    sync = _run(cycles=8, seed=13, label_churn=2, taint_churn=1, policy=pol)
+    pipe = _run(cycles=8, seed=13, label_churn=2, taint_churn=1, policy=pol,
+                pipeline=True)
+    assert pipe["placement_chain"] == sync["placement_chain"]
+    assert pipe["restages"] == {"cold_start": 1}
+
+
+def test_policy_plan_change_classified():
+    """Swapping the session policy restages exactly once, classified as
+    policy_plan_change; an identical-plan swap does not."""
+    from tpusim.api.snapshot import make_pod, synthetic_cluster
+    from tpusim.stream import StreamSession
+    from tpusim.stream.loadgen import DEFAULT_LABEL_UNIVERSE
+
+    snap = synthetic_cluster(NODES)
+    for i, node in enumerate(snap.nodes):
+        node.metadata.labels.update(
+            {k: vals[i % len(vals)]
+             for k, vals in DEFAULT_LABEL_UNIVERSE.items()})
+    session = StreamSession(snap, policy=decode_policy(POLICIES["1.0"]))
+
+    def batch(c):
+        return [make_pod(f"swap-{c}-{i}", milli_cpu=50, memory=1 << 20)
+                for i in range(4)]
+
+    session.schedule(batch(0))
+    session.schedule(batch(1))
+    assert session.restage_counts == {"cold_start": 1}
+    session.set_policy(decode_policy(POLICIES["1.9"]))
+    session.schedule(batch(2))
+    session.schedule(batch(3))
+    assert session.restage_counts == {"cold_start": 1,
+                                      "policy_plan_change": 1}
+    # same-plan swap: the resident tables still serve the new object
+    session.set_policy(decode_policy(POLICIES["1.9"]))
+    session.schedule(batch(4))
+    assert session.restage_counts == {"cold_start": 1,
+                                      "policy_plan_change": 1}
+
+
+def test_pipeline_chaos_mid_run_drains_cleanly():
+    """A breaker armed mid-pipeline drains the in-flight cycle, drops
+    residency on the fault, and every emitted placement stays identical to
+    a synchronous fault-free run."""
+    from tpusim.api.snapshot import make_pod, synthetic_cluster
+    from tpusim.backends import placement_hash
+    from tpusim.jaxe.backend import install_chaos, uninstall_chaos
+    from tpusim.stream import StreamSession
+
+    def batches():
+        return [[make_pod(f"mid-{c}-{i}", milli_cpu=100, memory=1 << 28)
+                 for i in range(4)] for c in range(6)]
+
+    ref = StreamSession(synthetic_cluster(NODES))
+    want = [placement_hash(ref.schedule(b)) for b in batches()]
+
+    session = StreamSession(synthetic_cluster(NODES))
+    got = []
+    try:
+        for c, b in enumerate(batches()):
+            if c == 3:
+                # cycle 2 is still in flight on the pipeline when the
+                # chaos seam arms; its drain must precede the sync cycle
+                install_chaos(DeviceFaultPlan(faults={0: "exception"},
+                                              failure_threshold=1,
+                                              cooldown=1))
+            out = session.schedule_pipelined(b)
+            if out is not None:
+                got.append(placement_hash(out))
+        got.append(placement_hash(session.flush()))
+    finally:
+        uninstall_chaos()
+    assert got == want
+    assert session.path_counts.get("pipelined", 0) >= 2
+    assert session.restage_counts.get("device_fault") == 1
+    # the fault dropped residency: a later restage re-armed the device
+    assert session.device.restages >= 2
+
+
+def test_pipeline_chaos_plan_chain_equality():
+    """run_stream_simulation's pipelined arm under a device fault plan
+    still matches the fault-free synchronous chain (cycles degrade to
+    buffered-synchronous while the seam is armed)."""
+    plan = FaultPlan(seed=0, device=DeviceFaultPlan(
+        faults={1: "exception", 3: "corrupt_silent"}))
+    clean = _run(cycles=6, seed=5)
+    chaotic = _run(cycles=6, seed=5, chaos_plan=plan, pipeline=True)
+    assert chaotic["placement_chain"] == clean["placement_chain"]
+    _assert_accounted(chaotic)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", range(4))
 @pytest.mark.parametrize("flap_every,evict", [
@@ -147,3 +308,25 @@ def test_churn_parity_sweep_chaos(seed):
                                     evict_fraction=0.3, chaos_plan=plan)
     assert chaotic["placement_chain"] == clean["placement_chain"]
     _assert_accounted(chaotic)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("version", sorted(POLICIES))
+def test_policy_churn_parity_sweep(version):
+    """Every compat policy rides out label/taint churn with only a cold
+    start, verified per cycle against the reference-in-the-loop arm, and
+    the pipelined and always-restage arms reproduce the same chain."""
+    pol = decode_policy(POLICIES[version])
+    kw = dict(num_nodes=16, cycles=12, arrivals=16, seed=7,
+              label_churn=3, taint_churn=2)
+    out = run_stream_simulation(policy=pol, verify=True, **kw)
+    assert out["verified"], out
+    _assert_accounted(out)
+    assert out["restages"] == {"cold_start": 1}
+    pipe = run_stream_simulation(policy=decode_policy(POLICIES[version]),
+                                 pipeline=True, **kw)
+    assert pipe["placement_chain"] == out["placement_chain"]
+    assert pipe["restages"] == {"cold_start": 1}
+    restage = run_stream_simulation(policy=decode_policy(POLICIES[version]),
+                                    always_restage=True, **kw)
+    assert restage["placement_chain"] == out["placement_chain"]

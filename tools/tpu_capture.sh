@@ -268,6 +268,12 @@ run_stage stream configs:9 bench_results/r5_tpu_stream.jsonl \
     env TPUSIM_BENCH_LADDER_CONFIGS=9 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
     python bench.py --ladder
 
+echo "== stage 3d: policy stream (config 10: residency churn + pipelined-vs-sync A/B) =="
+run_stage policy_stream configs:10 bench_results/r5_tpu_policy_stream.jsonl \
+    bench_results/r5_tpu_policy_stream.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=10 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+
 echo "== stage 4: full XLA ladder (configs 1-5; fresh same-round parity anchors) =="
 run_stage ladder configs:1,2,3,4,5 bench_results/r5_tpu_ladder.jsonl \
     bench_results/r5_tpu_ladder.log \

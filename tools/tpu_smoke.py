@@ -572,6 +572,91 @@ def run_stream_churn_variant():
     return h, out["scheduled"], out["decisions"], stream_cycles, traced
 
 
+def run_stream_policy_variant():
+    """Stream v2 (compiled-policy residency + pipelined dispatch) stage-0:
+    a policy-built streaming session under node label/taint churn must (a)
+    byte-match the fresh-compile reference every cycle while classifying
+    only the cold start as a restage — churn lands as the O(delta) statics
+    scatter, not a re-stage; (b) emit an identical placement chain from the
+    pipelined double-buffered path; (c) leave every donated program cache
+    untouched on a warm re-run (scan, delta scatter AND the policy-aware
+    statics scatter)."""
+    from tpusim.engine.policy import decode_policy
+    from tpusim.jaxe.kernels import (
+        apply_delta_donated,
+        apply_statics_delta_donated,
+        schedule_scan_donated,
+    )
+    from tpusim.simulator import run_stream_simulation
+
+    policy = decode_policy(_pol(
+        [{"name": "PodFitsResources"},
+         {"name": "MatchNodeSelector"},
+         {"name": "PodToleratesNodeTaints"},
+         {"name": "TestServiceAffinity",
+          "argument": {"serviceAffinity": {"labels": ["region"]}}},
+         {"name": "TestLabelsPresence",
+          "argument": {"labelsPresence": {"labels": ["foo"],
+                                          "presence": True}}}],
+        [{"name": "LeastRequestedPriority", "weight": 1},
+         {"name": "zone-spread", "weight": 2,
+          "argument": {"serviceAntiAffinity": {"label": "zone"}}},
+         {"name": "bar-pref", "weight": 1,
+          "argument": {"labelPreference": {"label": "bar",
+                                           "presence": True}}}]))
+
+    def cache_sizes():
+        try:
+            return (schedule_scan_donated._cache_size(),
+                    apply_delta_donated._cache_size(),
+                    apply_statics_delta_donated._cache_size())
+        except AttributeError:  # private jit API moved: skip the check
+            return None
+
+    def run(**kw):
+        return run_stream_simulation(num_nodes=16, cycles=10, arrivals=16,
+                                     evict_fraction=0.25, label_churn=2,
+                                     taint_churn=1, seed=7, policy=policy,
+                                     **kw)
+
+    out = run(verify=True)
+    if not out["verified"]:
+        raise AssertionError(
+            f"policy-stream placements diverge from the full-restage "
+            f"reference on {out['mismatched_cycles']} of "
+            f"{out['cycles']} cycles")
+    if out["restages"] != {"cold_start": 1}:
+        raise AssertionError(
+            f"label/taint churn restaged beyond the cold start: "
+            f"{out['restages']} (paths {out['paths']}) — policy-table "
+            f"residency is broken")
+    piped = run(pipeline=True)
+    if piped["placement_chain"] != out["placement_chain"]:
+        raise AssertionError(
+            "pipelined placement chain diverges from synchronous "
+            f"({piped['placement_chain'][:16]} != "
+            f"{out['placement_chain'][:16]})")
+    pipelined_cycles = piped["paths"].get("pipelined", 0)
+    if not pipelined_cycles:
+        raise AssertionError(
+            f"pipeline never engaged the async path: {piped['paths']}")
+    before = cache_sizes()
+    warm = run(pipeline=True)
+    traced = None
+    if before is not None:
+        after = cache_sizes()
+        traced = tuple(a - b for a, b in zip(after, before))
+        if any(traced):
+            raise AssertionError(
+                f"warm policy session retraced (scan +{traced[0]}, delta "
+                f"+{traced[1]}, statics +{traced[2]}); residency or "
+                f"bucketing is broken")
+    if warm["placement_chain"] != out["placement_chain"]:
+        raise AssertionError("warm re-run chain diverges")
+    h = out["placement_chain"][:16]
+    return h, out["scheduled"], out["decisions"], pipelined_cycles, traced
+
+
 def _write_smoke_trace(recorder):
     """Persist the sweep's flight-recorder trace; never fail the smoke."""
     path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
@@ -703,6 +788,30 @@ def main() -> int:
             print(f"SMOKE stream_churn: OK hash={h} "
                   f"scheduled={scheduled}/{total} "
                   f"stream_cycles={stream_cycles} retrace={retrace} "
+                  f"({time.time() - t:.1f}s)", flush=True)
+        if not only or "stream_policy" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "stream_policy")
+            try:
+                h, scheduled, total, pipelined_cycles, traced = \
+                    run_stream_policy_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: stream_policy: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("pipelined_cycles", pipelined_cycles)
+            vsp.end()
+            ran += 1
+            retrace = ("skipped" if traced is None
+                       else f"+{traced[0]}/+{traced[1]}/+{traced[2]}")
+            print(f"SMOKE stream_policy: OK hash={h} "
+                  f"scheduled={scheduled}/{total} "
+                  f"pipelined_cycles={pipelined_cycles} retrace={retrace} "
                   f"({time.time() - t:.1f}s)", flush=True)
     finally:
         flight.uninstall()

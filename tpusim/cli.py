@@ -567,9 +567,27 @@ def build_stream_parser() -> argparse.ArgumentParser:
                         help="Cordon+restore a random node every k-th cycle "
                              "(structural events: forces classified "
                              "restages; 0 = never)")
+    parser.add_argument("--label-churn", type=int, default=0,
+                        help="Rewrite N random nodes' labels per cycle "
+                             "(label-only churn: absorbed by the statics "
+                             "scatter, zero restages under a fixed policy "
+                             "plan)")
+    parser.add_argument("--taint-churn", type=int, default=0,
+                        help="Toggle a NoSchedule taint on N random nodes "
+                             "per cycle (taint-only churn: scatter path, "
+                             "no restage)")
     parser.add_argument("--seed", type=int, default=0,
                         help="Load-generator seed")
     parser.add_argument("--algorithmprovider", default="DefaultProvider")
+    parser.add_argument("--policy-file", default="",
+                        help="Scheduler policy JSON (kube-scheduler "
+                             "--policy-config-file shape); the compiled "
+                             "plan stays device-resident across cycles "
+                             "(stream v2)")
+    parser.add_argument("--pipeline", action="store_true",
+                        help="Pipelined cycles: dispatch cycle N on device, "
+                             "decode cycle N-1's placements while it runs "
+                             "(identical placements, one cycle of latency)")
     parser.add_argument("--always-restage", action="store_true",
                         help="Disable the O(delta) fast path: full compile + "
                              "re-stage every cycle (the comparison arm; "
@@ -607,9 +625,14 @@ def stream_cli(argv) -> int:
 
     snapshot = None
     chaos_plan = None
+    policy = None
     try:
         if args.snapshot:
             snapshot = ClusterSnapshot.load(args.snapshot)
+        if args.policy_file:
+            from tpusim.engine.policy import load_policy_file
+
+            policy = load_policy_file(args.policy_file)
         if args.chaos_plan:
             from tpusim.chaos import load_plan
             from tpusim.chaos.plan import PlanError
@@ -636,7 +659,9 @@ def stream_cli(argv) -> int:
             snapshot, num_nodes=args.synthetic_nodes, cycles=args.cycles,
             arrivals=args.arrivals, evict_fraction=args.evict_fraction,
             node_flap_every=args.flap_every, seed=args.seed,
+            label_churn=args.label_churn, taint_churn=args.taint_churn,
             provider=args.algorithmprovider,
+            policy=policy, pipeline=args.pipeline,
             always_restage=args.always_restage, verify=args.verify,
             chaos_plan=chaos_plan)
     except (KeyError, ValueError) as exc:

@@ -146,6 +146,56 @@ class LabeledCounter:
         return lines
 
 
+class LabeledHistogram:
+    """A histogram family with one label dimension (prometheus
+    HistogramVec). Child histograms are created lazily on first observe —
+    the stream runtime's per-path cycle latency is the first user."""
+
+    def __init__(self, name: str, help_text: str, label: str,
+                 buckets: List[float]):
+        self.name = name
+        self.help = help_text
+        self.label = label
+        self.buckets = sorted(buckets)
+        self.children: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, label_value: str, value: float) -> None:
+        with self._lock:
+            child = self.children.get(label_value)
+            if child is None:
+                child = Histogram(
+                    f'{self.name}{{{self.label}="{label_value}"}}',
+                    self.help, self.buckets)
+                self.children[label_value] = child
+        child.observe(value)
+
+    def get(self, label_value: str) -> Optional[Histogram]:
+        with self._lock:
+            return self.children.get(label_value)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.children.clear()
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = sorted(self.children.items())
+        for label_value, child in items:
+            pair = f'{self.label}="{label_value}"'
+            for bound, bucket_count in zip(child.buckets,
+                                           child.bucket_counts):
+                lines.append(f'{self.name}_bucket{{{pair},le="{bound:g}"}} '
+                             f'{bucket_count}')
+            lines.append(f'{self.name}_bucket{{{pair},le="+Inf"}} '
+                         f'{child.count}')
+            lines.append(f'{self.name}_sum{{{pair}}} {child.total:g}')
+            lines.append(f'{self.name}_count{{{pair}}} {child.count}')
+        return lines
+
+
 _LATENCY_BUCKETS = exponential_buckets(1000, 2, 15)
 
 
@@ -251,6 +301,21 @@ class SchedulerMetrics:
         self.stream_cycles = self._reg(LabeledCounter(
             "tpusim_stream_cycles_total",
             "Stream-runtime scheduling cycles, by execution path", "path"))
+        # stream v2 telemetry (ISSUE 9): per-path cycle latency plus the
+        # pipelining health gauges — depth (0 sync, 1 one cycle in flight)
+        # and the fraction of a cycle's host decode that overlapped device
+        # execution instead of blocking on it
+        self.stream_cycle_latency = self._reg(LabeledHistogram(
+            "tpusim_stream_cycle_latency_us",
+            "Stream-runtime cycle walltime by execution path",
+            "path", _LATENCY_BUCKETS))
+        self.stream_pipeline_depth = self._reg(Gauge(
+            "tpusim_stream_pipeline_depth",
+            "Cycles in flight on the stream pipeline (0 = synchronous)"))
+        self.stream_overlap_fraction = self._reg(Gauge(
+            "tpusim_stream_overlap_fraction",
+            "Fraction of the last pipelined fold that did not block on the "
+            "device (1.0 = decode fully hidden behind device execution)"))
 
     def _reg(self, metric):
         self._registry.append(metric)
@@ -282,6 +347,12 @@ class SchedulerMetrics:
                 if metric.count:
                     out[metric.name] = {"count": metric.count,
                                         "sum": round(metric.total, 3)}
+            elif isinstance(metric, LabeledHistogram):
+                if metric.children:
+                    out[metric.name] = {
+                        label: {"count": child.count,
+                                "sum": round(child.total, 3)}
+                        for label, child in sorted(metric.children.items())}
             elif isinstance(metric, LabeledCounter):
                 if metric.values:
                     out[metric.name] = dict(sorted(metric.values.items()))

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
@@ -337,17 +337,25 @@ def format_fit_error(num_nodes: int, counts: np.ndarray, strings: List[str]) -> 
 
 
 def decode_placements(pods: List[Pod], choices: np.ndarray, counts: np.ndarray,
-                      names: List[str], strings: List[str]
+                      names: List[str], strings: List[str],
+                      prebound: Optional[List[Placement]] = None
                       ) -> tuple[List[Placement], int]:
-    """Device results -> Placement list (shared by JaxBackend and run_what_if)."""
+    """Device results -> Placement list (shared by JaxBackend and run_what_if).
+
+    prebound: already-constructed Placements for the scheduled pods, in pod
+    order — the pipelined fold-back (stream/runtime._fold_binds) binds each
+    placed pod once to feed the host IncrementalCluster, and handing those
+    objects in here avoids a second bind_pod copy per placement."""
     placements: List[Placement] = []
+    bound_iter = iter(prebound) if prebound is not None else None
     scheduled = 0
     for j, pod in enumerate(pods):
         c = int(choices[j])
         if c >= 0:
             scheduled += 1
-            placements.append(Placement(pod=bind_pod(pod, names[c]),
-                                        node_name=names[c]))
+            placements.append(next(bound_iter) if bound_iter is not None
+                              else Placement(pod=bind_pod(pod, names[c]),
+                                             node_name=names[c]))
         else:
             msg = format_fit_error(len(names), counts[j], strings)
             placements.append(Placement(pod=mark_unschedulable(pod, msg),

@@ -145,6 +145,7 @@ class IncrementalCluster:
         self._groups_batch_keys: Optional[tuple] = None
         self._groups_dirty = True
         self._groups_active = False               # any feature flag set
+        self._groups_need_saa = False             # ServiceAffinity defs baked in
         self._presence: Optional[np.ndarray] = None
 
         # delta journal (ISSUE 7): node indices / presence cells touched by
@@ -155,6 +156,13 @@ class IncrementalCluster:
         # group change forces a restage, which drops the journal).
         self._journal_nodes: set = set()
         self._journal_presence: set = set()
+        # label/taint-churned node indices (ISSUE 9): a MODIFIED node whose
+        # ONLY delta is metadata.labels / spec.taints leaves the structural
+        # caches intact (when no group feature is active) but moves
+        # per-(signature, node) and per-(policy-row, node) statics cells; the
+        # stream runtime gathers these columns into a statics scatter instead
+        # of restaging. Dropped with the journal on drain/restage.
+        self._journal_node_columns: set = set()
         # monotone count of signature-row memo evictions (_evict_sig_rows):
         # lets the stream runtime classify a residency miss caused by memo
         # pressure ("sig_evict") apart from genuinely new signatures
@@ -361,8 +369,22 @@ class IncrementalCluster:
                 self._groups_dirty = True
 
     def _apply_node(self, event_type: str, node: Node) -> None:
-        self._groups_dirty = True  # topology/zone domains follow the node set
         i = self._node_index.get(node.name)
+        if (event_type in (ADDED, MODIFIED) and i is not None
+                and not self._groups_active
+                and self._column_only_change(self.nodes[i], node)):
+            # label/taint-only churn (ISSUE 9): node statics/aggregates and
+            # the memoized signature rows are patched in place by
+            # _update_node; with no group feature active the cached (trivial)
+            # group tables never read node labels, so the structural caches
+            # stay valid and the stream runtime can scatter just this node's
+            # statics columns. When a group feature IS active, topology/zone
+            # domains may consume these labels — fall through to the
+            # conservative rebuild below.
+            self._update_node(i, node)
+            self._journal_node_columns.add(i)
+            return
+        self._groups_dirty = True  # topology/zone domains follow the node set
         if event_type == ADDED and i is None:
             self._append_node(node)
         elif event_type in (ADDED, MODIFIED) and i is not None:
@@ -374,6 +396,23 @@ class IncrementalCluster:
                 self._delete_node(i)
         else:
             raise ValueError(f"unknown event type {event_type!r}")
+
+    @staticmethod
+    def _column_only_change(old: Node, node: Node) -> bool:
+        """True when the event's entire delta is metadata.labels and/or
+        spec.taints — the two inputs that move only per-(signature, node)
+        statics cells — INCLUDING the empty delta (a no-op resync MODIFIED,
+        which the column path absorbs for free instead of restaging).
+        Everything structural (unschedulable, allocatable, conditions,
+        images, annotations...) must be byte-identical; compared on the
+        to_obj() wire form, the same canonicalization Node.copy()
+        round-trips through."""
+        a, b = old.to_obj(), node.to_obj()
+        a["metadata"].pop("labels", None)
+        b["metadata"].pop("labels", None)
+        a["spec"].pop("taints", None)
+        b["spec"].pop("taints", None)
+        return a == b
 
     def _apply_service(self, event_type: str, svc: Service) -> None:
         self._groups_dirty = True
@@ -601,14 +640,42 @@ class IncrementalCluster:
         (node indices may have shifted) — callers restage there instead."""
         nodes, cells = self._journal_nodes, self._journal_presence
         self._journal_nodes, self._journal_presence = set(), set()
+        self._journal_node_columns = set()
         return nodes, cells
 
-    def compile(self, pods: List[Pod], need_noexec: bool = False
+    def journal_mark(self) -> Tuple[set, set]:
+        """Snapshot the pod-delta journal. Paired with journal_rollback by
+        the pipelined fold-back (stream/runtime._fold_binds): the scan
+        already applied that cycle's binds to the resident carry with
+        identical integer arithmetic, so the fold's MODIFIED replays are
+        journal noise — rolling back to the mark keeps the next commit's
+        scatter O(watch delta) instead of O(delta + binds), which also
+        keeps the commit bucket sizes inside the warmed jit cache."""
+        return set(self._journal_nodes), set(self._journal_presence)
+
+    def journal_rollback(self, mark: Tuple[set, set]) -> None:
+        """Discard journal entries added since journal_mark (safe only when
+        every interim apply targeted state the resident carry already
+        holds, i.e. the pipelined bind fold-back)."""
+        self._journal_nodes, self._journal_presence = mark
+
+    def drain_column_journal(self) -> set:
+        """Hand over the label/taint-churned node indices since the last
+        drain and reset (ISSUE 9). Same stability contract as drain_journal:
+        indices are meaningful only while the node set is unchanged."""
+        cols = self._journal_node_columns
+        self._journal_node_columns = set()
+        return cols
+
+    def compile(self, pods: List[Pod], need_noexec: bool = False,
+                need_saa: bool = False
                 ) -> Tuple[CompiledCluster, PodColumns]:
         """Compile a new-pod batch against the current cluster picture.
         Returns fresh array copies (later events do not mutate the result).
         need_noexec: compute the policy-only NoExecute taint table (the
-        default ships an all-pass dummy; see state.compile_cluster)."""
+        default ships an all-pass dummy; see state.compile_cluster).
+        need_saa: bake Service(Anti)Affinity defs/rows into the group tables
+        (compiled-policy stream staging, ISSUE 9)."""
         cols, key_lists = self._batch_columns(pods)
         statics = self._ensure_statics()
         dyn = self._ensure_dyn()
@@ -632,12 +699,15 @@ class IncrementalCluster:
         # --- group tables: rebuild only on structural change ---
         group_keys = self.batch_group_keys(pods)
         if (self._groups_dirty or self._groups is None
-                or group_keys != self._groups_batch_keys):
+                or group_keys != self._groups_batch_keys
+                or need_saa != self._groups_need_saa):
             snapshot = self.to_snapshot()
             (groups, has_ports, has_services, has_interpod, n_topo, n_zone,
              unsupported, sig_to_gid, vol_meta) = _compile_groups(
-                 snapshot, pods, self.nodes, self._node_index)
+                 snapshot, pods, self.nodes, self._node_index,
+                 need_saa=need_saa)
             self._groups = groups
+            self._groups_need_saa = need_saa
             self._groups_meta = (has_ports, has_services, has_interpod,
                                  n_topo, n_zone, unsupported, vol_meta)
             self._groups_batch_keys = group_keys
@@ -688,6 +758,7 @@ class IncrementalCluster:
             node_index=dict(self._node_index),
             has_ports=has_ports, has_services=has_services,
             has_interpod=has_interpod, has_noexec_table=need_noexec,
+            has_saa_table=need_saa,
             has_disk_conflict=has_disk_conflict, has_maxpd=has_maxpd,
             has_vol_zone=has_vol_zone, maxpd_limits=maxpd_limits,
             n_topo_doms=n_topo, n_zone_doms=n_zone,

@@ -1272,7 +1272,7 @@ class DeltaRows(NamedTuple):
 
 
 def _apply_delta_impl(carry: Carry, node_idx, rows: DeltaRows,
-                      pres_gid, pres_nid, pres_val) -> Carry:
+                      pres_gid, pres_nid, pres_val, sa_lock_init) -> Carry:
     # duplicate indices (bucket padding repeats a real row) are safe under
     # `set` scatter semantics only because every duplicate carries the same
     # authoritative value — any winner writes the same bytes
@@ -1286,14 +1286,71 @@ def _apply_delta_impl(carry: Carry, node_idx, rows: DeltaRows,
         nonzero_mem=carry.nonzero_mem.at[node_idx].set(rows.nonzero_mem),
         pod_count=carry.pod_count.at[node_idx].set(rows.pod_count),
         presence=carry.presence.at[pres_gid, pres_nid].set(pres_val),
-        sa_lock=jnp.full_like(carry.sa_lock, -1),
+        sa_lock=jnp.asarray(sa_lock_init, carry.sa_lock.dtype),
         rr=jnp.zeros_like(carry.rr))
 
 
 # Donating the carry makes the commit a true in-place HBM update: the
 # resident buffers are patched, not reallocated, mirroring
 # schedule_scan_donated's chunk-loop contract above.
+#
+# sa_lock_init re-arms the ServiceAffinity segment-lock lanes exactly the way
+# carry_init does on a restage: providers pass the all-unlocked fill(-1),
+# compiled policies with ServiceAffinity pass policyc.sa_lock_init_rows
+# recomputed from the live snapshot, so the resident plan sees the same
+# first-assigned-pod pins a fresh restage would (ISSUE 9).
 apply_delta_donated = jax.jit(_apply_delta_impl, donate_argnums=(0,))
+
+
+class StaticsDelta(NamedTuple):
+    """Authoritative post-churn statics columns for `node_idx`, one column
+    slice per table whose cells depend on node labels/taints. The leading
+    (signature/policy-row) dims match the resident tables; the trailing dim
+    is the padded churn-node bucket U."""
+
+    selector_ok: jnp.ndarray       # [Ksel, U] bool
+    taint_ok: jnp.ndarray          # [Ktol, U] bool
+    taint_ok_noexec: jnp.ndarray   # [Ktol, U] bool
+    intolerable: jnp.ndarray       # [Ktol, U] int32
+    affinity_count: jnp.ndarray    # [Kaff, U] int64
+    avoid_score: jnp.ndarray       # [Kav, U] int64
+    host_ok: jnp.ndarray           # [Khost, U] bool
+    label_ok: jnp.ndarray          # [L, U] bool
+    label_prio: jnp.ndarray        # [U] int64
+    image_score: jnp.ndarray       # [Si, U] int64
+    saa_dom: jnp.ndarray           # [E, U] int32
+    sa_val: jnp.ndarray            # [La, U] int32
+
+
+def _apply_statics_delta_impl(statics: Statics, node_idx,
+                              d: StaticsDelta) -> Statics:
+    # Label/taint churn only moves per-(signature, node) and per-(policy-row,
+    # node) cells; every other statics table is either node-structural
+    # (alloc_*, cond_fail_bits — those churn classes restage via node_set /
+    # scalar_set) or group-derived (rebuilt behind groups_dirty).
+    return statics._replace(
+        selector_ok=statics.selector_ok.at[:, node_idx].set(d.selector_ok),
+        taint_ok=statics.taint_ok.at[:, node_idx].set(d.taint_ok),
+        taint_ok_noexec=statics.taint_ok_noexec.at[:, node_idx].set(
+            d.taint_ok_noexec),
+        intolerable=statics.intolerable.at[:, node_idx].set(d.intolerable),
+        affinity_count=statics.affinity_count.at[:, node_idx].set(
+            d.affinity_count),
+        avoid_score=statics.avoid_score.at[:, node_idx].set(d.avoid_score),
+        host_ok=statics.host_ok.at[:, node_idx].set(d.host_ok),
+        label_ok=statics.label_ok.at[:, node_idx].set(d.label_ok),
+        label_prio=statics.label_prio.at[node_idx].set(d.label_prio),
+        image_score=statics.image_score.at[:, node_idx].set(d.image_score),
+        saa_dom=statics.saa_dom.at[:, node_idx].set(d.saa_dom),
+        sa_val=statics.sa_val.at[:, node_idx].set(d.sa_val))
+
+
+# Same donation contract as apply_delta_donated: the resident statics
+# buffers are patched in HBM, not reallocated. XLA refcounts device buffers,
+# so donating while a previously dispatched scan still reads the old statics
+# is safe — the old buffers live until that computation retires.
+apply_statics_delta_donated = jax.jit(_apply_statics_delta_impl,
+                                      donate_argnums=(0,))
 
 
 # --------------------------------------------------------------------------

@@ -35,7 +35,7 @@ exactly. Unknown names raise the host registry's KeyError byte-for-byte."""
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -540,12 +540,22 @@ def service_affinity_columns(cp: CompiledPolicy, pods, snapshot,
             if label in pinned:
                 sa_pin[c, li] = value_maps[li][pinned[label]]
 
+    lock_init = sa_lock_init_rows(saa_defs, snapshot.pods, node_index)
+    return sa_self_id, sa_pin, sa_val, lock_init
+
+
+def sa_lock_init_rows(saa_defs: list, pods, node_index: Dict[str, int]):
+    """sa_lock_init[Fd] int32: per ServiceAffinity-signature first-matching-
+    assigned-pod locks (see service_affinity_columns' lister contract).
+    `pods` is the snapshot pod iterable in cache order. Split out so the
+    stream runtime can re-arm the segment-lock lanes per commit without
+    rebuilding the rest of the SA tables (ISSUE 9)."""
     fd = max(len(saa_defs), 1)
     lock_init = np.full(fd, -1, dtype=np.int32)
     for f in range(1, len(saa_defs)):
         ns, sel = saa_defs[f]
         first = next(
-            (p for p in snapshot.pods
+            (p for p in pods
              if p.spec.node_name and p.namespace == ns
              and all(p.metadata.labels.get(k) == v for k, v in sel.items())),
             None)
@@ -556,7 +566,7 @@ def service_affinity_columns(cp: CompiledPolicy, pods, snapshot,
                 # assigned to an unknowable node: it stays service_pods[0]
                 # forever (assigned order), so nothing ever pins
                 lock_init[f] = -2
-    return sa_self_id, sa_pin, sa_val, lock_init
+    return lock_init
 
 
 def policy_static_rows(cp: CompiledPolicy, nodes,
@@ -633,3 +643,196 @@ def build_policy_tables(cp: CompiledPolicy, snapshot, pods,
                         saa_dom=saa_dom, n_saa_doms=n_saa_doms,
                         sa_pin=sa_pin, sa_val=sa_val,
                         sa_lock_init=sa_lock_init)
+
+
+# --------------------------------------------------------------------------
+# Policy residency (ISSUE 9): the interning state a resident policy-table
+# set was built with, so the stream runtime can (a) remap a new batch's
+# per-pod signature columns against the RESIDENT id spaces and (b) recompute
+# only the churned nodes' policy columns — both without restaging. Any
+# signature or label value outside the resident spaces means the id space
+# must grow, which is a table-shape change: the caller restages.
+# --------------------------------------------------------------------------
+
+
+def policy_plan_key(cp: Optional[CompiledPolicy]):
+    """Hashable identity of the compiled plan a policy'd session stages.
+
+    PolicySpec alone under-determines the tables (label_rows holds slot
+    names, not the label entries; two policies can share a spec yet mask
+    different labels), so the key freezes every table-defining input. Two
+    equal keys stage byte-identical policy statics for the same cluster;
+    a key change is the `policy_plan_change` restage class."""
+    if cp is None:
+        return None
+    return (cp.spec, cp.hard_weight,
+            tuple((slot, tuple((tuple(labels), presence)
+                               for labels, presence in entries))
+                  for slot, entries in cp.label_rows),
+            tuple((label, presence, weight)
+                  for label, presence, weight in cp.label_prios),
+            tuple((label, weight) for label, weight in cp.saa_entries),
+            tuple(tuple(entry) for entry in cp.sa_entries))
+
+
+@dataclass
+class PolicyResidency:
+    """Interning state captured at restage time (build_policy_residency).
+
+    img_rows/img_reps: container-image multiset signature -> image_score row,
+    with the representative pod per row (image_locality_columns first-seen
+    order). sa_rows: pod pin signature -> sa_pin row. sa_value_maps /
+    saa_value_maps: per-label value -> id interning for sa_val / saa_dom
+    (re-derived deterministically from the snapshot, identical to what the
+    table builders interned)."""
+
+    img_rows: Dict[tuple, int] = field(default_factory=dict)
+    img_reps: List = field(default_factory=list)
+    sa_labels: tuple = ()
+    sa_rows: Dict[tuple, int] = field(default_factory=dict)
+    sa_value_maps: List[Dict[str, int]] = field(default_factory=list)
+    saa_value_maps: List[Dict[str, int]] = field(default_factory=list)
+
+
+def build_policy_residency(cp: CompiledPolicy, snapshot, pods,
+                           compiled, ptabs: PolicyTables) -> PolicyResidency:
+    """Rebuild the interning maps the ptabs tables were built with.
+
+    Must walk pods/nodes in exactly the order the table builders did so the
+    ids line up; the value maps come from calling _label_value_row again
+    (deterministic: same snapshot, same extra_values)."""
+    node_index = compiled.node_index
+    by_idx = _nodes_by_index(snapshot.nodes, node_index)
+    res = PolicyResidency()
+
+    if ptabs.has_image:
+        for pod in pods:
+            sig = tuple(sorted(c.image for c in pod.spec.containers))
+            if sig not in res.img_rows:
+                res.img_rows[sig] = len(res.img_reps)
+                res.img_reps.append(pod)
+
+    ps = cp.spec
+    if ps.sa_enabled or ps.sa_slots:
+        labels = [label for entry in cp.sa_entries for label in entry]
+        res.sa_labels = tuple(labels)
+        pinned_values: List[set] = [set() for _ in labels]
+        for pod in pods:
+            selector = pod.spec.node_selector or {}
+            for li, label in enumerate(labels):
+                if label in selector:
+                    pinned_values[li].add(selector[label])
+        res.sa_value_maps = [{} for _ in range(max(len(labels), 1))]
+        for li, label in enumerate(labels):
+            _, _, res.sa_value_maps[li] = _label_value_row(
+                by_idx, label, extra_values=sorted(pinned_values[li]))
+        label_set = set(labels)
+        for pod in pods:
+            selector = pod.spec.node_selector or {}
+            pins = tuple(sorted((label, selector[label])
+                                for label in label_set if label in selector))
+            if pins not in res.sa_rows:
+                res.sa_rows[pins] = len(res.sa_rows)
+
+    for label, _w in cp.saa_entries:
+        _, _, vmap = _label_value_row(by_idx, label)
+        res.saa_value_maps.append(vmap)
+    return res
+
+
+def remap_policy_columns(cp: CompiledPolicy, res: PolicyResidency,
+                         pods, cols) -> Optional[str]:
+    """Fill cols.img_id / cols.sa_self_id for a NEW batch against the
+    RESIDENT id spaces. Returns None on success or a restage-reason string
+    when a pod carries a signature the resident tables never interned
+    (the table shapes would have to grow)."""
+    ps = cp.spec
+    if ps.w_image:
+        for j, pod in enumerate(pods):
+            sig = tuple(sorted(c.image for c in pod.spec.containers))
+            row = res.img_rows.get(sig)
+            if row is None:
+                return "new_signature"
+            cols.img_id[j] = row
+    if ps.sa_enabled or ps.sa_slots:
+        label_set = set(res.sa_labels)
+        for j, pod in enumerate(pods):
+            selector = pod.spec.node_selector or {}
+            pins = tuple(sorted((label, selector[label])
+                                for label in label_set if label in selector))
+            row = res.sa_rows.get(pins)
+            if row is None:
+                return "new_signature"
+            cols.sa_self_id[j] = row
+    return None
+
+
+def policy_delta_columns(cp: Optional[CompiledPolicy],
+                         res: Optional[PolicyResidency],
+                         ptabs: Optional[PolicyTables],
+                         by_idx: list, idxs, shapes):
+    """Recompute the policy statics columns for the churned node indices.
+
+    `by_idx` is the compiled-order node list (post-churn host truth), `idxs`
+    the churned indices, `shapes` the resident (L, Si, E, La) leading dims.
+    Returns (label_ok[L,U], label_prio[U], image_score[Si,U], saa_dom[E,U],
+    sa_val[La,U]) or a restage-reason string when a churned node carries a
+    label value outside the resident interning (the domain id space must
+    grow, which is a staged-shape property)."""
+    from types import SimpleNamespace
+
+    from tpusim.engine.priorities import image_locality_priority_map
+
+    n_l, n_si, n_e, n_la = shapes
+    u = len(idxs)
+    label_ok = np.ones((n_l, u), dtype=bool)
+    label_prio = np.zeros(u, dtype=np.int64)
+    image_score = np.zeros((n_si, u), dtype=np.int64)
+    saa_dom = np.zeros((n_e, u), dtype=np.int32)
+    sa_val = np.zeros((n_la, u), dtype=np.int32)
+    if cp is None:
+        return label_ok, label_prio, image_score, saa_dom, sa_val
+
+    for r, (_slot, entries) in enumerate(cp.label_rows):
+        for k, i in enumerate(idxs):
+            node_labels = by_idx[i].metadata.labels
+            ok = True
+            for labels, presence in entries:
+                for label in labels:
+                    if (label in node_labels) != presence:
+                        ok = False
+                        break
+                if not ok:
+                    break
+            label_ok[r, k] = ok
+    for label, presence, weight in cp.label_prios:
+        for k, i in enumerate(idxs):
+            if (label in by_idx[i].metadata.labels) == presence:
+                label_prio[k] += weight * MAX_PRIORITY
+    if ptabs is not None and ptabs.has_image:
+        for s, rep in enumerate(res.img_reps):
+            for k, i in enumerate(idxs):
+                info = SimpleNamespace(node=by_idx[i])
+                image_score[s, k] = image_locality_priority_map(
+                    rep, None, info).score
+    for e, (label, _w) in enumerate(cp.saa_entries):
+        vmap = res.saa_value_maps[e]
+        for k, i in enumerate(idxs):
+            value = by_idx[i].metadata.labels.get(label)
+            if value is None:
+                continue
+            vid = vmap.get(value)
+            if vid is None:
+                return "new_signature"
+            saa_dom[e, k] = vid
+    for li, label in enumerate(res.sa_labels):
+        vmap = res.sa_value_maps[li]
+        for k, i in enumerate(idxs):
+            value = by_idx[i].metadata.labels.get(label)
+            if value is None:
+                continue
+            vid = vmap.get(value)
+            if vid is None:
+                return "new_signature"
+            sa_val[li, k] = vid
+    return label_ok, label_prio, image_score, saa_dom, sa_val

@@ -306,9 +306,10 @@ def note_serve(event: str, args: Optional[Dict[str, Any]] = None) -> None:
 def note_stream_restage(reason: str, detail: Optional[str] = None) -> None:
     """The stream runtime invalidated its device-resident state and paid a
     full restage: `reason` is the low-cardinality residency-miss class
-    (cold_start/node_set/groups_dirty/scalar_set/new_signature/sig_evict/
-    group_shape/interpod_delta/watch_expired/breaker_open/device_fault/
-    verify_divergence/unsupported), `detail` trace-only context."""
+    (cold_start/policy_plan_change/node_set/groups_dirty/scalar_set/
+    new_signature/sig_evict/group_shape/interpod_delta/watch_expired/
+    breaker_open/device_fault/verify_divergence/unsupported), `detail`
+    trace-only context."""
     _metrics.register().stream_restage.inc(reason)
     rec = _active
     if rec is not None:
@@ -318,8 +319,10 @@ def note_stream_restage(reason: str, detail: Optional[str] = None) -> None:
 
 def note_stream_cycle(path: str, pods: Optional[int] = None) -> None:
     """One StreamSession scheduling cycle: stream_scan (O(delta) resident
-    dispatch), restage_scan (full re-stage + dispatch), or host (reference
-    fallback under chaos/unsupported features)."""
+    dispatch), pipelined (resident dispatch with deferred decode),
+    restage_scan (full re-stage + dispatch), host (reference fallback under
+    chaos/unsupported features), or no_nodes (empty cluster — nothing to
+    dispatch)."""
     _metrics.register().stream_cycles.inc(path)
     rec = _active
     if rec is not None:

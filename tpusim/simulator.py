@@ -779,13 +779,16 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
 def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                           num_nodes: int = 64, cycles: int = 50,
                           arrivals: int = 32, evict_fraction: float = 0.25,
-                          node_flap_every: int = 0, seed: int = 0,
+                          node_flap_every: int = 0,
+                          label_churn: int = 0, taint_churn: int = 0,
+                          seed: int = 0,
                           provider: str = DEFAULT_PROVIDER,
+                          policy=None, pipeline: bool = False,
                           always_restage: bool = False, verify: bool = False,
                           chaos_plan: Optional[object] = None) -> dict:
     """Drive a StreamSession through seeded churn (tpusim.stream.ChurnLoadGen)
-    and return a summary dict — the `tpusim stream` CLI, the bench's config 9,
-    and the smoke variant all sit on this loop.
+    and return a summary dict — the `tpusim stream` CLI, the bench's configs
+    9/10, and the smoke variants all sit on this loop.
 
     Unlike run_simulation (one batch, one decision), this is the steady-state
     shape the streaming runtime exists for: per cycle, watch events fold into
@@ -794,8 +797,19 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
     cycle instead of O(cluster).
 
     always_restage: disable the fast path (the restage-comparison arm).
+    policy: an engine.policy.Policy compiled for device residency (ISSUE 9);
+        synthetic clusters get their node labels seeded from the churn
+        universe so every label value interns at cold start — pure
+        label/taint churn then rides the statics scatter with zero restages.
+    pipeline: overlap host decode of cycle N-1 with cycle N's device
+        execution (StreamSession.schedule_pipelined); placements and the
+        placement chain are byte-identical to the synchronous path.
+    label_churn / taint_churn: per-cycle label rewrites / taint toggles fed
+        through the load generator (the scatter-absorbable churn class).
     verify: additionally run every cycle through a fresh-compile
-        JaxBackend.schedule and assert byte-identical placement hashes.
+        JaxBackend.schedule and assert byte-identical placement hashes
+        (pipelined cycles compare when their placements emerge, one cycle
+        later).
     chaos_plan: device-fault section only — churn/fabric faults are what the
         load generator already produces, event-shaped.
     """
@@ -803,9 +817,18 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
     from tpusim.backends import get_backend, placement_hash
     from tpusim.jaxe.delta import IncrementalCluster
     from tpusim.stream import ChurnLoadGen, StreamSession
+    from tpusim.stream.loadgen import DEFAULT_LABEL_UNIVERSE
 
     if snapshot is None:
         snapshot = synthetic_cluster(num_nodes)
+        if policy is not None or label_churn or taint_churn:
+            # seed every churn-universe value across the synthetic nodes so
+            # the cold-start compile interns the full label domain — churn
+            # then never needs a new domain id (a staged-shape property)
+            for i, node in enumerate(snapshot.nodes):
+                node.metadata.labels.update(
+                    {k: vals[i % len(vals)]
+                     for k, vals in DEFAULT_LABEL_UNIVERSE.items()})
     breaker = None
     if chaos_plan is not None:
         chaos_plan.validate()
@@ -818,37 +841,56 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
             from tpusim.jaxe.backend import install_chaos
 
             breaker = install_chaos(chaos_plan.device)
-    session = StreamSession(snapshot, provider=provider,
+    session = StreamSession(snapshot, provider=provider, policy=policy,
                             always_restage=always_restage)
     gen = ChurnLoadGen(snapshot, seed=seed, arrivals=arrivals,
                        evict_fraction=evict_fraction,
-                       node_flap_every=node_flap_every)
+                       node_flap_every=node_flap_every,
+                       label_churn=label_churn, taint_churn=taint_churn)
     ref_inc = ref_backend = ref_gen = None
     if verify:
         ref_inc = IncrementalCluster(snapshot)
-        ref_backend = get_backend("jax", provider=provider)
+        ref_backend = get_backend("jax", provider=provider, policy=policy)
         ref_gen = ChurnLoadGen(snapshot, seed=seed, arrivals=arrivals,
                                evict_fraction=evict_fraction,
-                               node_flap_every=node_flap_every)
+                               node_flap_every=node_flap_every,
+                               label_churn=label_churn,
+                               taint_churn=taint_churn)
     import hashlib
 
     chain = hashlib.sha256()
     latencies: List[float] = []
+    expected_hashes: List[str] = []   # verify arm FIFO (pipeline lags 1)
     scheduled = decisions = mismatches = 0
+
+    def account(placements) -> None:
+        nonlocal decisions, scheduled, mismatches
+        decisions += len(placements)
+        scheduled += sum(1 for p in placements if p.node_name)
+        h = placement_hash(placements)
+        chain.update(h.encode())
+        if verify and expected_hashes.pop(0) != h:
+            mismatches += 1
+
     t_start = perf_counter()
     try:
         for cycle in range(cycles):
+            if pipeline:
+                # fold cycle N-1's binds BEFORE drawing cycle N's events:
+                # the host picture evolves in exactly the synchronous order
+                gen.note_bound(session.poll_placed())
             session.apply_events(gen.events(cycle))
             batch = gen.batch()
             t0 = perf_counter()
-            placements = session.schedule(batch)
+            if pipeline:
+                prev = session.schedule_pipelined(batch)
+            else:
+                prev = session.schedule(batch)
             latencies.append(perf_counter() - t0)
-            gen.note_bound(placements)
-            decisions += len(placements)
-            scheduled += sum(1 for p in placements if p.node_name)
-            h = placement_hash(placements)
-            chain.update(h.encode())
             if verify:
+                # the reference pictures advance at dispatch time (their
+                # state matches the session's host picture NOW); the
+                # comparison happens whenever the placements emerge
                 ref_inc.apply_events(ref_gen.events(cycle))
                 ref_batch = ref_gen.batch()
                 expected = ref_backend.schedule(ref_batch,
@@ -857,8 +899,17 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                     if pl.node_name:
                         ref_inc.apply(MODIFIED, pl.pod)
                 ref_gen.note_bound(expected)
-                if placement_hash(expected) != h:
-                    mismatches += 1
+                expected_hashes.append(placement_hash(expected))
+            if pipeline:
+                if prev is not None:
+                    account(prev)
+            else:
+                gen.note_bound(prev)
+                account(prev)
+        if pipeline:
+            tail = session.flush()
+            if tail:
+                account(tail)
     finally:
         if breaker is not None:
             from tpusim.jaxe.backend import uninstall_chaos

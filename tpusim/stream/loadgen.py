@@ -15,7 +15,7 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 from tpusim.api.snapshot import ClusterSnapshot, make_pod
-from tpusim.api.types import Node, Pod
+from tpusim.api.types import Node, Pod, Taint
 from tpusim.backends import Placement
 from tpusim.framework.store import DELETED, MODIFIED
 
@@ -27,6 +27,22 @@ DEFAULT_SHAPES: Tuple[Tuple[int, int], ...] = (
     (250, 512 << 20),
     (500, 1 << 30),
 )
+
+# Label churn universe: the keys the compat policy corpus gates on (region /
+# zone for ServiceAffinity+AntiAffinity, foo for LabelsPresence, bar for
+# LabelPreference) with small closed value sets — closed so that a seeded
+# cluster interns every value at cold start and pure churn never grows a
+# domain-id space (the zero-restage property ISSUE 9's acceptance asserts).
+DEFAULT_LABEL_UNIVERSE: Dict[str, Tuple[str, ...]] = {
+    "zone": ("z0", "z1", "z2"),
+    "region": ("r0", "r1"),
+    "bar": ("on", "off"),
+    "foo": ("present",),
+}
+
+# Taint churn toggles this taint on and off — a NoSchedule key the compat
+# policies' tolerations don't cover, so it flips taint_ok columns.
+CHURN_TAINT = Taint(key="dedicated", value="batch", effect="NoSchedule")
 
 
 class ChurnLoadGen:
@@ -40,11 +56,18 @@ class ChurnLoadGen:
         unschedulable=True) and restores it the next cycle — each flap is a
         structural event the device cannot scatter, forcing a classified
         restage pair.
+    label_churn / taint_churn: per cycle, rewrite this many nodes' labels
+        (values drawn from label_universe, keys possibly removed) / toggle
+        CHURN_TAINT on this many nodes — label/taint-ONLY modifications,
+        the exact churn class the v2 statics scatter path absorbs without
+        a restage (ISSUE 9).
     """
 
     def __init__(self, snapshot: ClusterSnapshot, *, seed: int = 0,
                  arrivals: int = 32, evict_fraction: float = 0.25,
                  node_flap_every: int = 0,
+                 label_churn: int = 0, taint_churn: int = 0,
+                 label_universe: Optional[Dict[str, Tuple[str, ...]]] = None,
                  shapes: Tuple[Tuple[int, int], ...] = DEFAULT_SHAPES,
                  name_prefix: str = "churn"):
         self.rng = random.Random(seed)
@@ -52,12 +75,17 @@ class ChurnLoadGen:
         self.arrivals = arrivals
         self.evict_fraction = evict_fraction
         self.node_flap_every = node_flap_every
+        self.label_churn = label_churn
+        self.taint_churn = taint_churn
+        self.label_universe = (DEFAULT_LABEL_UNIVERSE
+                               if label_universe is None else label_universe)
         self.shapes = shapes
         self.name_prefix = name_prefix
         self.serial = 0
         self.bound: Dict[str, Pod] = {}     # pod name -> bound copy
         self._flapped: Optional[Node] = None  # cordoned node awaiting restore
-        self.stats = {"arrivals": 0, "evictions": 0, "flaps": 0}
+        self.stats = {"arrivals": 0, "evictions": 0, "flaps": 0,
+                      "label_churns": 0, "taint_churns": 0}
 
     def batch(self) -> List[Pod]:
         """The cycle's fresh arrivals (Pending pods, no node)."""
@@ -92,6 +120,38 @@ class ChurnLoadGen:
             out.append((MODIFIED, node))
             self._flapped = node
             self.stats["flaps"] += 1
+        # churn blocks come last so runs with churn disabled draw the same
+        # rng sequence (and hence the same chains) as before ISSUE 9
+        if self.label_churn and self.nodes:
+            for _ in range(self.label_churn):
+                i = self.rng.randrange(len(self.nodes))
+                node = self.nodes[i].copy()
+                labels = dict(node.metadata.labels)
+                for key, values in self.label_universe.items():
+                    choice = self.rng.randrange(len(values) + 1)
+                    if choice == len(values):
+                        labels.pop(key, None)
+                    else:
+                        labels[key] = values[choice]
+                node.metadata.labels = labels
+                # store back: later events must diff against CURRENT truth
+                # for the runtime to see a labels/taints-only modification
+                self.nodes[i] = node
+                out.append((MODIFIED, node))
+                self.stats["label_churns"] += 1
+        if self.taint_churn and self.nodes:
+            for _ in range(self.taint_churn):
+                i = self.rng.randrange(len(self.nodes))
+                node = self.nodes[i].copy()
+                if node.spec.taints:
+                    node.spec.taints = []
+                else:
+                    node.spec.taints = [Taint(key=CHURN_TAINT.key,
+                                              value=CHURN_TAINT.value,
+                                              effect=CHURN_TAINT.effect)]
+                self.nodes[i] = node
+                out.append((MODIFIED, node))
+                self.stats["taint_churns"] += 1
         return out
 
     def note_bound(self, placements: List[Placement]) -> None:

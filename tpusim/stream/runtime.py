@@ -1,5 +1,6 @@
 """Streaming scheduler runtime: device-resident cluster state, O(delta)
-scatter commits (ISSUE 7).
+scatter commits (ISSUE 7), compiled-policy residency and pipelined cycle
+execution (ISSUE 9).
 
 Every other execution path in this repo re-stages the full compiled cluster
 (statics + dynamic carry) onto device per scheduling attempt, so churn-heavy
@@ -29,8 +30,24 @@ full-restage path (JaxBackend.schedule on a fresh compile) over any event
 sequence. The parity argument: the host IncrementalCluster stays the source
 of truth; commits scatter-`set` AUTHORITATIVE host values (idempotent,
 self-healing), the commit re-arms the per-batch lanes (sa_lock/rr) exactly
-like carry_init_host, and every field without a scatter path (presence_dom,
-used_vols, statics columns) only changes under events that force a restage.
+like carry_init_host — including the policy ServiceAffinity segment locks,
+recomputed per commit from the live pod set the way a restage would — and
+every field without a scatter path (presence_dom, used_vols, group tables)
+only changes under events that force a restage. Statics columns gained a
+scatter path in v2: label/taint-only node churn lands as
+kernels.apply_statics_delta_donated over the churned columns (signature
+rows re-gathered from the host memo, policy rows recomputed against the
+RESIDENT interning), so a fixed plan signature rides out arbitrary
+label/taint churn with zero restages; only a genuine plan change restages,
+classified as policy_plan_change.
+
+Pipelined execution (schedule_pipelined/poll_placed/flush) keeps the same
+contract: dispatch cycle N's device program without blocking, decode cycle
+N-1's placements while N runs, and fold N-1's binds back BEFORE the driver
+draws N's events — the host picture evolves in exactly the synchronous
+order, so emitted placements and placement_hash are byte-identical to the
+synchronous path. Any off-stream condition (chaos installed, restage
+reason, no nodes) drains the in-flight cycle and runs synchronously.
 
 Chaos composition mirrors jaxe.backend.JaxBackend.schedule: host faults
 (node flap, pod evict, watch drop) arrive as ordinary deltas; device faults
@@ -52,6 +69,7 @@ from tpusim.api.types import Pod, ResourceType
 from tpusim.backends import (
     Placement,
     ReferenceBackend,
+    bind_pod,
     mark_unschedulable,
     placement_hash,
 )
@@ -62,16 +80,27 @@ from tpusim.framework.reflector import Reflector
 from tpusim.framework.store import MODIFIED
 from tpusim.jaxe import backend as _backend
 from tpusim.jaxe import ensure_responsive_platform, ensure_x64
-from tpusim.jaxe.delta import IncrementalCluster
+from tpusim.jaxe.delta import _SIG_KINDS, IncrementalCluster
 from tpusim.jaxe.kernels import (
     DeltaRows,
+    StaticsDelta,
     apply_delta_donated,
+    apply_statics_delta_donated,
     carry_init_host,
     config_for,
     pad_infeasible_rows,
     pod_columns_to_host,
     schedule_scan_donated,
     statics_to_host,
+)
+from tpusim.jaxe.policyc import (
+    build_policy_residency,
+    build_policy_tables,
+    compile_policy,
+    policy_delta_columns,
+    policy_plan_key,
+    remap_policy_columns,
+    sa_lock_init_rows,
 )
 from tpusim.jaxe.sharding import stage_tree
 from tpusim.jaxe.state import NUM_FIXED_BITS, reason_strings
@@ -112,6 +141,9 @@ class DeviceResidentCluster:
         self.statics = None           # device Statics
         self.carry = None             # device Carry — THE resident state
         self.sig_rows: Optional[Dict[str, Dict[object, int]]] = None
+        self.plan_key = None          # policyc.policy_plan_key of the restage
+        self.ptabs = None             # host PolicyTables of the restage
+        self.pol_res = None           # policyc.PolicyResidency interning
         self.n_nodes = 0
         self.scalar_width = 0
         self.evictions_mark = 0       # inc.sig_evictions at adopt time
@@ -125,9 +157,10 @@ class DeviceResidentCluster:
     def invalidate(self) -> None:
         self.compiled = self.config = self.statics = self.carry = None
         self.sig_rows = None
+        self.plan_key = self.ptabs = self.pol_res = None
 
     def adopt(self, inc: IncrementalCluster, compiled, config, statics,
-              carry) -> None:
+              carry, plan_key=None, ptabs=None, pol_res=None) -> None:
         """Install a freshly restaged state as resident."""
         self.compiled = compiled
         self.config = config
@@ -137,17 +170,25 @@ class DeviceResidentCluster:
         # ids are remapped through these dicts onto the resident table rows
         self.sig_rows = {kind: {key: row for row, key in enumerate(keys)}
                          for kind, keys in inc.last_batch_key_lists.items()}
+        self.plan_key = plan_key
+        self.ptabs = ptabs
+        self.pol_res = pol_res
         self.n_nodes = len(compiled.statics.names)
         self.scalar_width = len(compiled.scalar_names)
         self.evictions_mark = inc.sig_evictions
         self.restages += 1
 
-    def residency_miss(self, inc: IncrementalCluster) -> Optional[str]:
+    def residency_miss(self, inc: IncrementalCluster,
+                       plan_key=None) -> Optional[str]:
         """A structural reason the resident arrays cannot serve the next
         cycle, or None. Ordering matters for the classifier: node events
-        also dirty the group tables, so the node-set check runs first."""
+        also dirty the group tables, so the node-set check runs first; a
+        plan-signature change outranks everything but a cold start (the
+        resident policy tables serve the OLD plan, whatever else holds)."""
         if self.carry is None:
             return "cold_start"
+        if plan_key != self.plan_key:
+            return "policy_plan_change"
         if len(inc.nodes) != self.n_nodes:
             return "node_set"
         if inc._groups_dirty:
@@ -178,14 +219,15 @@ class DeviceResidentCluster:
             col[:] = lut[col]
         return None
 
-    def commit(self, inc: IncrementalCluster) -> None:
+    def commit(self, inc: IncrementalCluster, sa_lock_init) -> None:
         """Drain the IncrementalCluster's delta journal and scatter-commit
         the AUTHORITATIVE post-event values of every touched node row /
         presence cell into the resident carry (donated: the HBM buffers are
         patched in place). Always dispatches — even with an empty journal —
         because the commit also re-arms the per-batch lanes (sa_lock/rr) to
-        carry_init_host's values, keeping stream and restage cycles
-        byte-identical."""
+        the values a fresh restage would stage (`sa_lock_init`: all-unlocked
+        for providers, the live first-matching-pod pins for ServiceAffinity
+        policies), keeping stream and restage cycles byte-identical."""
         nodes, cells = inc.drain_journal()
         dyn = inc._ensure_dyn()
         idx = np.fromiter(sorted(nodes), dtype=np.int32, count=len(nodes))
@@ -211,7 +253,8 @@ class DeviceResidentCluster:
             # untouched zeros on both sides
             val = np.zeros(size, np.int32)
         sp = flight.span("stream_commit", "device")
-        self.carry = apply_delta_donated(self.carry, idx, rows, gid, nid, val)
+        self.carry = apply_delta_donated(self.carry, idx, rows, gid, nid, val,
+                                         sa_lock_init)
         if sp:
             sp.set("rows", int(len(nodes)))
             sp.set("cells", int(len(cells)))
@@ -219,26 +262,53 @@ class DeviceResidentCluster:
         self.commits += 1
 
 
+class _PendingCycle:
+    """One in-flight (or sync-buffered) pipelined cycle: the donated scan's
+    un-forced device outputs plus everything the deferred decode needs."""
+
+    __slots__ = ("pods", "choices", "counts", "compiled", "t0",
+                 "dispatched_at", "folded", "bound", "placements")
+
+    def __init__(self, pods, choices=None, counts=None, compiled=None,
+                 t0=0.0, dispatched_at=0.0, placements=None):
+        self.pods = pods
+        self.choices = choices
+        self.counts = counts
+        self.compiled = compiled
+        self.t0 = t0
+        self.dispatched_at = dispatched_at
+        self.folded = placements is not None
+        self.bound: List[Placement] = []
+        self.placements = placements
+
+
 class StreamSession:
     """Drives the streaming loop: ingest watch deltas → scatter-commit →
     schedule on the resident state → fold placements back.
 
-    v1 scope: providers only (no compiled policy — policy'd workloads keep
-    the per-batch JaxBackend path). Unsupported feature combinations route
-    whole batches through the reference backend, classified like every
-    other fallback.
+    v2 scope (ISSUE 9): providers AND compiled policies — every policy the
+    Pallas fused scan can express stays device-resident, keyed on its plan
+    signature. Unsupported feature combinations (extenders, unsupported
+    predicates) route whole batches through the reference backend,
+    classified like every other fallback.
     """
 
     def __init__(self, snapshot: Optional[ClusterSnapshot] = None, *,
                  incremental: Optional[IncrementalCluster] = None,
                  provider: str = DEFAULT_PROVIDER,
                  hard_pod_affinity_symmetric_weight: int = 10,
-                 always_restage: bool = False):
+                 always_restage: bool = False,
+                 policy=None, compiled_policy=None):
         """always_restage: disable the O(delta) fast path — every cycle pays
         the full compile + device staging. The bench's restage-vs-stream
-        comparison arm; placements are identical either way."""
+        comparison arm; placements are identical either way.
+        policy/compiled_policy: a scheduler Policy compiled for residency
+        (compile-time validation mirrors JaxBackend); swap mid-session via
+        set_policy (a plan-signature change restages once)."""
         if provider not in _backend._KNOWN_PROVIDERS:
             raise KeyError(f"plugin {provider!r} has not been registered")
+        if policy is not None and compiled_policy is None:
+            compiled_policy = compile_policy(policy)
         ensure_x64()
         ensure_responsive_platform()
         self.inc = (incremental if incremental is not None
@@ -246,12 +316,28 @@ class StreamSession:
         self.provider = provider
         self.hard_weight = hard_pod_affinity_symmetric_weight
         self.always_restage = always_restage
+        self.policy = policy
+        self.cp = compiled_policy
+        self._plan_key = policy_plan_key(compiled_policy)
         self.device = DeviceResidentCluster()
         self.cycles = 0
         self.restage_counts: Dict[str, int] = {}
         self.path_counts: Dict[str, int] = {}
         self._forced: Optional[str] = None
         self._reflectors: List[Reflector] = []
+        self._statics_patch = None    # (padded idx, StaticsDelta) or None
+        self._pending: Optional[_PendingCycle] = None
+        self._last_path: Optional[str] = None
+
+    def set_policy(self, policy=None, compiled_policy=None) -> None:
+        """Swap the session's scheduling policy. The next cycle restages
+        exactly once, classified policy_plan_change, unless the new plan
+        signature matches the resident one."""
+        if policy is not None and compiled_policy is None:
+            compiled_policy = compile_policy(policy)
+        self.policy = policy
+        self.cp = compiled_policy
+        self._plan_key = policy_plan_key(compiled_policy)
 
     # -- ingest -----------------------------------------------------------
 
@@ -292,27 +378,58 @@ class StreamSession:
 
     # -- the cycle --------------------------------------------------------
 
-    def schedule(self, pods: List[Pod]) -> List[Placement]:
+    def schedule(self, pods: List[Pod],
+                 _routed=None) -> List[Placement]:
         """One decision cycle: route the batch through the resident fast
         path when residency holds, else a classified restage; fold scheduled
         placements back into the host picture (and, on the fast path, rely
-        on the scan having already bound them on device)."""
+        on the scan having already bound them on device). `_routed`: a
+        (reason, cols) pair from a _route call this cycle already made
+        (schedule_pipelined's off-stream degrade) — routing is not
+        re-entrant across the forced latch and the column journal."""
         if not pods:
             return []
         self.cycles += 1
         inc = self.inc
-        if not inc.nodes:
-            msg = "no nodes available to schedule pods"
-            return [Placement(pod=mark_unschedulable(p, msg),
-                              reason="Unschedulable", message=msg)
-                    for p in pods]
         t0 = perf_counter()
+        if not inc.nodes:
+            # final disposition like any other cycle: one path label plus
+            # the latency observations (the accounting-identity contract)
+            msg = "no nodes available to schedule pods"
+            placements = [Placement(pod=mark_unschedulable(p, msg),
+                                    reason="Unschedulable", message=msg)
+                          for p in pods]
+            self._note_path("no_nodes", len(pods))
+            self._observe_cycle("no_nodes", t0)
+            return placements
+        reason, cols = _routed if _routed is not None else self._route(pods)
+        if reason is None:
+            placements = self._stream_cycle(pods, cols)
+        else:
+            placements = self._restage_cycle(pods, reason)
+        for pl in placements:
+            if pl.node_name:
+                inc.apply(MODIFIED, pl.pod)
+        if self.device.valid:
+            # the scan already applied these binds to the resident carry
+            # with identical integer arithmetic — replaying the fold-back
+            # journal next cycle would be a byte-for-byte no-op
+            inc.drain_journal()
+        self._observe_cycle(self._last_path, t0)
+        return placements
+
+    def _route(self, pods: List[Pod]):
+        """Decide stream-vs-restage for a batch: returns (None, cols) when
+        the resident state can serve it, else (reason, cols-or-None).
+        Consumes the forced-restage latch and the column journal (a restage
+        rebuilds everything, so a lost patch is harmless)."""
+        inc = self.inc
         reason = self._forced
         self._forced = None
         if reason is None and self.always_restage:
             reason = "forced_restage"
         if reason is None:
-            reason = self.device.residency_miss(inc)
+            reason = self.device.residency_miss(inc, self._plan_key)
         cols = None
         if reason is None:
             cols, key_lists = inc._batch_columns(pods)
@@ -328,28 +445,112 @@ class StreamSession:
                 # presence_dom has no scatter path: external presence churn
                 # under inter-pod affinity must rebuild it host-side
                 reason = "interpod_delta"
-        if reason is None:
-            placements = self._stream_cycle(pods, cols)
-        else:
-            placements = self._restage_cycle(pods, reason)
-        for pl in placements:
-            if pl.node_name:
-                inc.apply(MODIFIED, pl.pod)
-        if self.device.valid:
-            # the scan already applied these binds to the resident carry
-            # with identical integer arithmetic — replaying the fold-back
-            # journal next cycle would be a byte-for-byte no-op
-            inc.drain_journal()
-        register().e2e_scheduling_latency.observe(since_in_microseconds(t0))
-        return placements
+            if reason is None and self.cp is not None:
+                # per-pod policy signature columns against the RESIDENT
+                # interning (image multisets, ServiceAffinity pins)
+                reason = remap_policy_columns(self.cp, self.device.pol_res,
+                                              pods, cols)
+            if reason is None:
+                reason = self._prepare_statics_delta()
+        return reason, cols
 
     # -- paths ------------------------------------------------------------
+
+    def _prepare_statics_delta(self) -> Optional[str]:
+        """Turn the column journal (label/taint-only node churn) into a
+        pending StaticsDelta scatter: authoritative post-churn columns for
+        every churned node, gathered from the host signature-row memo
+        (patched in place by _update_node, so it IS current) and recomputed
+        against the RESIDENT policy interning. Returns a restage reason when
+        the resident tables cannot express the new columns (evicted
+        signature row with no representative, label value outside the
+        resident domain space), else None with the patch staged for the
+        next dispatch."""
+        inc = self.inc
+        dev = self.device
+        touched = inc.drain_column_journal()
+        if not touched:
+            return None
+        n = len(touched)
+        idx = _pad_index(np.fromiter(sorted(touched), np.int32, count=n),
+                         bucket_size(n))
+        u = len(idx)
+        cols: Dict[str, np.ndarray] = {}
+        for col_kind, _fn, table_kinds in _SIG_KINDS:
+            keys_by_row = sorted(dev.sig_rows[col_kind].items(),
+                                 key=lambda kv: kv[1])
+            for tk in table_kinds:
+                if tk == "taint_ok_noexec" \
+                        and not dev.compiled.has_noexec_table:
+                    # the resident table is the all-pass dummy compile()
+                    # stages when no pod tolerates NoExecute predicates
+                    cols[tk] = np.ones((max(len(keys_by_row), 1), u),
+                                       dtype=bool)
+                    continue
+                fn, dtype = inc._row_fns[tk]
+                out = np.zeros((max(len(keys_by_row), 1), u), dtype=dtype)
+                for sig_key, row in keys_by_row:
+                    memo = inc._sig_rows.get((tk, sig_key))
+                    if memo is not None:
+                        out[row] = memo[idx]
+                        continue
+                    rep = inc._sig_reps.get(sig_key)
+                    if rep is None:
+                        return "sig_evict"
+                    out[row] = np.fromiter((fn(rep, int(i)) for i in idx),
+                                           dtype=dtype, count=u)
+                cols[tk] = out
+        st = dev.statics
+        shapes = (st.label_ok.shape[0], st.image_score.shape[0],
+                  st.saa_dom.shape[0], st.sa_val.shape[0])
+        pol = policy_delta_columns(self.cp, dev.pol_res, dev.ptabs,
+                                   inc.nodes, idx, shapes)
+        if isinstance(pol, str):
+            return pol
+        label_ok, label_prio, image_score, saa_dom, sa_val = pol
+        self._statics_patch = (idx, StaticsDelta(
+            selector_ok=cols["selector_ok"], taint_ok=cols["taint_ok"],
+            taint_ok_noexec=cols["taint_ok_noexec"],
+            intolerable=cols["intolerable"],
+            affinity_count=cols["affinity_count"],
+            avoid_score=cols["avoid_score"], host_ok=cols["host_ok"],
+            label_ok=label_ok, label_prio=label_prio,
+            image_score=image_score, saa_dom=saa_dom, sa_val=sa_val))
+        return None
+
+    def _commit_sa_lock(self) -> np.ndarray:
+        """The sa_lock re-arm values a restage would stage RIGHT NOW: the
+        live first-matching-pod pins for ServiceAffinity policies (snapshot
+        pod order — inc._pods preserves insertion order like the reference
+        cache), all-unlocked otherwise."""
+        dev = self.device
+        if self.cp is not None and self.cp.spec.sa_enabled:
+            return sa_lock_init_rows(dev.compiled.groups.saa_defs,
+                                     list(self.inc._pods.values()),
+                                     dev.compiled.node_index)
+        return np.full(dev.compiled.groups.saa_rows.shape[0], -1,
+                       dtype=np.int32)
+
+    def _apply_statics_patch(self) -> None:
+        """Scatter the pending label/taint-churn statics columns into the
+        resident tables (donated in-place HBM patch)."""
+        if self._statics_patch is None:
+            return
+        idx, delta = self._statics_patch
+        self._statics_patch = None
+        dev = self.device
+        sp = flight.span("statics_commit", "device")
+        dev.statics = apply_statics_delta_donated(dev.statics, idx, delta)
+        if sp:
+            sp.set("cols", int(len(idx)))
+            sp.end()
 
     def _stream_cycle(self, pods: List[Pod], cols) -> List[Placement]:
         dev = self.device
 
         def dispatch():
-            dev.commit(self.inc)
+            self._apply_statics_patch()
+            dev.commit(self.inc, self._commit_sa_lock())
             p = len(pods)
             xs_host = pad_infeasible_rows(pod_columns_to_host(cols),
                                           bucket_size(p) - p)
@@ -366,27 +567,69 @@ class StreamSession:
     def _restage_cycle(self, pods: List[Pod], reason: str) -> List[Placement]:
         inc = self.inc
         dev = self.device
+        cp = self.cp
         dev.invalidate()
         inc.drain_journal()  # structural restage: indices may have shifted
+        self._statics_patch = None
+        from tpusim.engine.predicates import (
+            POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
+        )
+
+        need_noexec = (cp is not None and cp.spec.pred_keys is not None
+                       and POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED
+                       in cp.spec.pred_keys)
+        need_saa = cp is not None and (bool(cp.spec.saa_weights)
+                                       or cp.spec.sa_enabled)
         t0 = perf_counter()
         with flight.span("compile_cluster") as csp:
-            compiled, cols = inc.compile(pods)
+            compiled, cols = inc.compile(pods, need_noexec=need_noexec,
+                                         need_saa=need_saa)
             if csp:
                 csp.set("pods", len(pods))
                 csp.set("nodes", len(inc.nodes))
         register().backend_compile_latency.observe(since_in_microseconds(t0))
-        if compiled.unsupported:
-            detail = "; ".join(sorted(set(compiled.unsupported))[:5])
+        unsupported = list(compiled.unsupported)
+        if cp is not None:
+            unsupported.extend(cp.unsupported)
+        if unsupported:
+            detail = "; ".join(sorted(set(unsupported))[:5])
             log.warning("stream runtime falling back to reference for: %s",
                         detail)
             return self._host_cycle(pods, "reference_fallback")
+        hard_weight = self.hard_weight
+        if cp is not None and cp.hard_weight is not None:
+            hard_weight = cp.hard_weight
         config = config_for(
             [compiled],
             most_requested=self.provider in _backend._MOST_REQUESTED_PROVIDERS,
             num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names),
-            hard_weight=self.hard_weight)
-        statics = stage_tree(statics_to_host(compiled))
-        carry0 = stage_tree(carry_init_host(compiled))
+            hard_weight=hard_weight)
+        statics_host = statics_to_host(compiled)
+        carry_host = carry_init_host(compiled)
+        ptabs = pol_res = None
+        if cp is not None:
+            # mirror JaxBackend._schedule_on_device's staging recipe: the
+            # policy tables overwrite the trivial custom-plugin rows (shapes
+            # match exactly, so the replace is byte-identical for policies
+            # without the corresponding feature), and the residency capture
+            # records the interning those tables were built with
+            from dataclasses import replace as _dc_replace
+
+            config = _dc_replace(config, policy=cp.spec)
+            snapshot = inc.to_snapshot()
+            ptabs = build_policy_tables(cp, snapshot, pods, compiled, cols)
+            if cp.saa_entries:
+                config = _dc_replace(config, n_saa_doms=ptabs.n_saa_doms)
+            pol_res = build_policy_residency(cp, snapshot, pods, compiled,
+                                             ptabs)
+            statics_host = statics_host._replace(
+                label_ok=ptabs.label_ok, label_prio=ptabs.label_prio,
+                image_score=ptabs.image_score, saa_dom=ptabs.saa_dom,
+                sa_pin=ptabs.sa_pin, sa_val=ptabs.sa_val)
+            if cp.spec.sa_enabled:
+                carry_host = carry_host._replace(sa_lock=ptabs.sa_lock_init)
+        statics = stage_tree(statics_host)
+        carry0 = stage_tree(carry_host)
         p = len(pods)
         xs_host = pad_infeasible_rows(pod_columns_to_host(cols),
                                       bucket_size(p) - p)
@@ -396,7 +639,9 @@ class StreamSession:
             carry, placements, intervened = self._dispatch(
                 config, carry0, statics, xs, pods, compiled)
             if not intervened:
-                dev.adopt(inc, compiled, config, statics, carry)
+                dev.adopt(inc, compiled, config, statics, carry,
+                          plan_key=self._plan_key, ptabs=ptabs,
+                          pol_res=pol_res)
             return placements, intervened
 
         return self._run_guarded(pods, "restage_scan", dispatch, reason)
@@ -511,7 +756,148 @@ class StreamSession:
         return ReferenceBackend(
             provider=self.provider,
             hard_pod_affinity_symmetric_weight=self.hard_weight,
+            policy=self.policy,
         ).schedule(pods, self.inc.to_snapshot())
+
+    # -- pipelined execution ----------------------------------------------
+
+    def poll_placed(self) -> List[Placement]:
+        """Block on the in-flight pipelined cycle's device choices (if
+        any), fold its binds into the host picture, and return the
+        placements that BOUND — the note_bound feed for a pipelined
+        driver. MUST be called before the driver applies the next cycle's
+        watch events, so the host picture evolves in the synchronous
+        order. Full decode (fit errors, reason strings) stays deferred to
+        the next schedule_pipelined/flush."""
+        p = self._pending
+        if p is None:
+            return []
+        if p.placements is not None:
+            return [pl for pl in p.placements if pl.node_name]
+        self._fold_binds(p)
+        return p.bound
+
+    def schedule_pipelined(self, pods: List[Pod]) -> Optional[List[Placement]]:
+        """One pipelined decision cycle: dispatch THIS batch's device
+        program without blocking on its result and return the PREVIOUS
+        cycle's placements (None before any cycle completes). The decode
+        of cycle N-1 overlaps cycle N's device execution. Emitted
+        placements are byte-identical to schedule(): any off-stream
+        condition (chaos seam armed, restage reason, no nodes) runs that
+        cycle synchronously, buffered one cycle so emission order is
+        preserved. Call flush() for the tail."""
+        if not pods:
+            return self.flush()
+        prev_p, self._pending = self._pending, None
+        if prev_p is not None and prev_p.placements is None:
+            # defensive: a poll_placed-first driver has already folded
+            self._fold_binds(prev_p)
+        # the verify mode alone is inert (it only gates behavior once a
+        # breaker is installed), so only live seams force the sync path
+        chaos = (_backend._CHAOS["breaker"] is not None
+                 or _backend._CHAOS["injector"] is not None)
+        routed = None
+        if not chaos and self.inc.nodes:
+            routed = self._route(pods)
+        if routed is not None and routed[0] is None:
+            self.cycles += 1
+            t0 = perf_counter()
+            self._dispatch_async(pods, routed[1], t0)
+            register().stream_pipeline_depth.set(1.0)
+            osp = flight.span("stream_overlap")
+            prev = self._finalize(prev_p)
+            if osp:
+                osp.end()
+            return prev
+        # off-stream: drain the pipeline, then run this cycle through the
+        # full synchronous path (chaos seam, restage classification)
+        prev = self._finalize(prev_p)
+        placements = self.schedule(pods, _routed=routed)
+        self._pending = _PendingCycle(pods, placements=placements)
+        register().stream_pipeline_depth.set(0.0)
+        return prev
+
+    def flush(self) -> List[Placement]:
+        """Drain the in-flight (or sync-buffered) pipelined cycle and
+        return its placements ([] when none): the tail of a pipelined run
+        and the drain point for mid-run mode switches."""
+        p, self._pending = self._pending, None
+        out = self._finalize(p)
+        register().stream_pipeline_depth.set(0.0)
+        return out if out is not None else []
+
+    def _fold_binds(self, p: _PendingCycle) -> None:
+        """Synchronize on the pending cycle's choices and apply its binds
+        to the host IncrementalCluster. The journal entries the fold-back
+        creates are rolled back to the pre-fold mark: the scan already
+        applied these binds to the resident carry with identical integer
+        arithmetic (the same invariant the synchronous path relies on when
+        it drains after _stream_cycle), and re-scattering them would both
+        waste commit bandwidth and push the journal into bucket sizes the
+        warmed jit cache has never traced. Interleaved watch deltas
+        journaled BEFORE the fold sit inside the mark and survive."""
+        if p.folded:
+            return
+        waited0 = perf_counter()
+        choices = np.asarray(p.choices)[:len(p.pods)]
+        waited = perf_counter() - waited0
+        elapsed = max(waited0 - p.dispatched_at + waited, 1e-9)
+        register().stream_overlap_fraction.set(max(0.0, 1.0 - waited / elapsed))
+        p.choices = choices
+        names = p.compiled.statics.names
+        mark = self.inc.journal_mark()
+        for pod, c in zip(p.pods, choices):
+            c = int(c)
+            if c >= 0:
+                bound = bind_pod(pod, names[c])
+                self.inc.apply(MODIFIED, bound)
+                p.bound.append(Placement(pod=bound, node_name=names[c]))
+        self.inc.journal_rollback(mark)
+        p.folded = True
+
+    def _finalize(self, p: Optional[_PendingCycle]
+                  ) -> Optional[List[Placement]]:
+        """Decode a pending cycle into its placement list (None for None):
+        the deferred host half of a pipelined cycle, overlapping the next
+        cycle's device execution when called from schedule_pipelined."""
+        if p is None:
+            return None
+        if p.placements is not None:
+            return p.placements
+        self._fold_binds(p)
+        counts = np.asarray(p.counts)[:len(p.pods)]
+        strings = reason_strings(p.compiled.scalar_names)
+        with flight.span("stream_decode"):
+            placements, _ = _backend.decode_placements(
+                p.pods, p.choices, counts, p.compiled.statics.names, strings,
+                prebound=p.bound)
+        p.placements = placements
+        self._note_path("pipelined", len(p.pods))
+        self._observe_cycle("pipelined", p.t0)
+        return placements
+
+    def _dispatch_async(self, pods: List[Pod], cols, t0: float) -> None:
+        """Commit pending deltas and launch the donated scan WITHOUT
+        forcing its outputs — JAX's async dispatch returns futures, so the
+        host is free to decode the previous cycle while the device runs.
+        The scan's final carry is adopted immediately (a device-side
+        future too)."""
+        dev = self.device
+        self._apply_statics_patch()
+        dev.commit(self.inc, self._commit_sa_lock())
+        p = len(pods)
+        xs_host = pad_infeasible_rows(pod_columns_to_host(cols),
+                                      bucket_size(p) - p)
+        dsp = flight.span("device_dispatch", "device")
+        with flight.profiled("tpusim:stream_scan"):
+            final_carry, choices, counts, _adv = schedule_scan_donated(
+                dev.config, dev.carry, dev.statics, stage_tree(xs_host))
+        if dsp:
+            dsp.set("pods", p)
+            dsp.end()
+        dev.carry = final_carry
+        self._pending = _PendingCycle(pods, choices, counts, dev.compiled,
+                                      t0, perf_counter())
 
     # -- accounting -------------------------------------------------------
 
@@ -521,4 +907,13 @@ class StreamSession:
 
     def _note_path(self, path: str, pods: int) -> None:
         self.path_counts[path] = self.path_counts.get(path, 0) + 1
+        self._last_path = path
         flight.note_stream_cycle(path, pods)
+
+    def _observe_cycle(self, path: str, t0: float) -> None:
+        """Per-cycle latency, twice: the legacy e2e histogram (unchanged
+        semantics) and the per-path stream histogram (ISSUE 9)."""
+        us = since_in_microseconds(t0)
+        m = register()
+        m.e2e_scheduling_latency.observe(us)
+        m.stream_cycle_latency.observe(path, us)

@@ -925,6 +925,8 @@ LADDER_CONFIGS = {
                     autoladder=True),
     10: LadderConfig(lambda p, b, c: measure_policy_stream(p),
                      autoladder=True),
+    11: LadderConfig(lambda p, b, c: measure_recovery(p),
+                     autoladder=True),
 }
 
 
@@ -1435,6 +1437,131 @@ def measure_policy_stream(platform: str) -> dict:
         "chains_equal": all(row["chains_equal"] for row in size_curve),
         "churn_curve": churn_curve,
         "size_curve": size_curve,
+        "metrics": _metrics_snapshot(reset=True),
+    }
+
+
+def measure_recovery(platform: str) -> dict:
+    """Config 11: crash recovery + degraded serving (ISSUE 12). Two parts:
+
+    - recovery-time vs checkpoint-interval curve: a WAL-journaled stream
+      run is killed by a scripted process crash at 3/4 of its cycles, then
+      recovered; replay time and the recomputed-cycle count fall as the
+      checkpoint interval tightens, while the recovered fold chain must
+      stay byte-identical to the uninterrupted run's (the durability
+      claim has a correctness bar, not just a latency one).
+    - degraded-mode serve throughput: the scenario fleet under a
+      permanent device-fault storm (breaker open, every bucket answered
+      by the host reference fallback) vs the fault-free device path. The
+      ratio is the cost of serving through an outage — the availability
+      claim is that it degrades, not fails.
+    """
+    import shutil
+    import tempfile
+
+    from tpusim.chaos.engine import ChaosClock, ProcessCrash
+    from tpusim.chaos.plan import ChurnEvent, DeviceFaultPlan, FaultPlan
+    from tpusim.jaxe.backend import install_chaos, uninstall_chaos
+    from tpusim.simulator import run_stream_simulation
+
+    nodes, cycles, arrivals = ((2_000, 32, 64) if platform != "cpu"
+                               else (400, 16, 32))
+    crash_at = (cycles * 3) // 4
+
+    def stream(ckdir, every, plan=None, recover=False):
+        return run_stream_simulation(
+            num_nodes=nodes, cycles=cycles, arrivals=arrivals,
+            evict_fraction=0.25, seed=11, checkpoint_dir=ckdir,
+            checkpoint_every=every, chaos_plan=plan, recover=recover)
+
+    # the parity oracle: the same run, uninterrupted
+    base_dir = tempfile.mkdtemp(prefix="tpusim-bench-ck-")
+    try:
+        base_chain = stream(base_dir, cycles + 1)["fold_chain"]
+    finally:
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    crash_plan = FaultPlan(seed=11, churn=[
+        ChurnEvent(at=crash_at, action="process_crash", target="emit")])
+    recovery_curve = []
+    for every in (1, 5, 20):
+        ckdir = tempfile.mkdtemp(prefix="tpusim-bench-ck-")
+        try:
+            try:
+                stream(ckdir, every, plan=crash_plan)
+                raise RuntimeError("scripted crash did not fire")
+            except ProcessCrash:
+                pass
+            t0 = time.perf_counter()
+            out = stream(ckdir, every, recover=True)
+            recover_s = time.perf_counter() - t0
+            recovery_curve.append({
+                "checkpoint_every": every,
+                "replay_ms": round(out["replay_ms"], 2),
+                "recover_total_s": round(recover_s, 3),
+                "recomputed_cycles": len(out["recomputed_cycles"]),
+                "resume_cycle": out["resume_cycle"],
+                "wal_records": out["wal_records"],
+                "violations": out["recovery_violations"],
+                "chain_identical": out["fold_chain"] == base_chain})
+            log(f"[config 11] checkpoint_every={every}: replay "
+                f"{out['replay_ms']:.1f} ms, "
+                f"{len(out['recomputed_cycles'])} cycles recomputed, "
+                f"chain_identical={recovery_curve[-1]['chain_identical']}")
+        finally:
+            shutil.rmtree(ckdir, ignore_errors=True)
+
+    # -- degraded-mode serving throughput --------------------------------
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.serve import ScenarioFleet, WhatIfRequest
+
+    serve_nodes, requests = (64, 48) if platform != "cpu" else (16, 24)
+    snapshot = synthetic_cluster(serve_nodes)
+    pods = build_workload(8, serve_nodes)[1]
+
+    def serve_pass():
+        fleet = ScenarioFleet(bucket_size=4, clock=ChaosClock())
+        fleet.register_snapshot("base", snapshot)
+        reqs = [WhatIfRequest(pods=pods[:1 + i % 4], snapshot_ref="base",
+                              cache_key=f"r{i}")
+                for i in range(requests)]
+        fleet.run(reqs)  # warm: absorb traces before timing
+        t0 = time.perf_counter()
+        responses = fleet.run([WhatIfRequest(
+            pods=pods[:1 + i % 4], snapshot_ref="base", cache_key=f"r{i}")
+            for i in range(requests)])
+        elapsed = time.perf_counter() - t0
+        fleet.stop()
+        return responses, elapsed
+
+    clean_responses, clean_s = serve_pass()
+    install_chaos(DeviceFaultPlan(
+        faults={i: "exception" for i in range(10_000)},
+        failure_threshold=1, cooldown=1_000_000))
+    try:
+        storm_responses, storm_s = serve_pass()
+    finally:
+        uninstall_chaos()
+    clean_rate = len(clean_responses) / max(clean_s, 1e-9)
+    storm_rate = len(storm_responses) / max(storm_s, 1e-9)
+    degraded = sum(1 for r in storm_responses if r.degraded)
+    headline = recovery_curve[0]
+    return {
+        "metric": f"crash-recovery replay latency (config 11: WAL + "
+                  f"checkpoint restore at checkpoint_every=1, {nodes} "
+                  f"nodes, crash at cycle {crash_at}/{cycles}, "
+                  f"platform={platform})",
+        "value": headline["replay_ms"], "unit": "ms",
+        "vs_baseline": 0,
+        "recovery_curve": recovery_curve,
+        "chains_identical": all(r["chain_identical"]
+                                for r in recovery_curve),
+        "serve_clean_rps": round(clean_rate, 1),
+        "serve_degraded_rps": round(storm_rate, 1),
+        "serve_degraded_vs_clean": round(
+            storm_rate / max(clean_rate, 1e-9), 3),
+        "serve_degraded_responses": degraded,
+        "serve_all_answered": all(r.ok for r in storm_responses),
         "metrics": _metrics_snapshot(reset=True),
     }
 

@@ -1,0 +1,431 @@
+"""Chaos-hardened serve fleet coverage (ISSUE 12).
+
+The resilience bar: under injected device faults, an open breaker, lossy
+fabric, worker death, deadlines, and priority shedding, the fleet must
+still answer EVERY submitted future exactly once — with placements
+byte-identical (placement hash) to the fault-free run whenever an answer
+is produced at all, and with each degraded/retried/rejected path visible
+in its metric family and the response's `degraded`/`rejected` fields.
+
+Satellites covered here: the AdmissionQueue.pop timed-wait regression
+(racing consumer), the stop() sweep that strands no future behind a dead
+worker, and the lossy-fabric serving parity matrix (fast tier-1; the
+seeded sweep is slow-marked).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod
+from tpusim.api.types import ResourceType
+from tpusim.backends import placement_hash
+from tpusim.chaos import ChaosClock, DeviceFaultPlan, FabricInjector
+from tpusim.framework.metrics import register
+from tpusim.framework.reflector import Reflector
+from tpusim.framework.restclient import FakeRESTClient
+from tpusim.framework.store import ResourceStore
+from tpusim.jaxe.backend import install_chaos, uninstall_chaos
+from tpusim.serve import AdmissionQueue, ScenarioFleet, WhatIfRequest
+from tpusim.serve.request import (
+    REJECT_DEADLINE,
+    REJECT_QUEUE_FULL,
+    REJECT_SHED,
+    REJECT_SHUTDOWN,
+)
+
+
+def scenario(seed: int, num_nodes: int = 4, num_pods: int = 3):
+    rng = np.random.RandomState(seed)
+    nodes = [make_node(f"c{seed}-n{i}",
+                       milli_cpu=int(rng.choice([2000, 4000, 8000])),
+                       memory=int(rng.choice([4, 8])) * 1024**3)
+             for i in range(num_nodes)]
+    pods = [make_pod(f"c{seed}-p{i}",
+                     milli_cpu=int(rng.randint(100, 1500)),
+                     memory=int(rng.randint(2**20, 2**30)))
+            for i in range(num_pods)]
+    return ClusterSnapshot(nodes=nodes), pods
+
+
+def divergent_scenario(tag: str):
+    """A workload whose every placement lands on a node index > 0 (node 0
+    is too small for any pod), so corrupt_silent's in-range rotation is
+    GUARANTEED to change the answer — the divergence only host
+    verification can catch."""
+    nodes = [make_node(f"{tag}-n0", milli_cpu=500, memory=1024**3)]
+    nodes += [make_node(f"{tag}-n{i}", milli_cpu=4000 * i,
+                        memory=8 * 1024**3) for i in (1, 2, 3)]
+    pods = [make_pod(f"{tag}-p{i}", milli_cpu=800 + i * 100,
+                     memory=1024**3) for i in range(3)]
+    return ClusterSnapshot(nodes=nodes), pods
+
+
+def requests_for(seeds):
+    return [WhatIfRequest(pods=pods, snapshot=snap)
+            for snap, pods in (scenario(s) for s in seeds)]
+
+
+def hashes(responses):
+    return [placement_hash(r.result.placements) for r in responses]
+
+
+def fault_free_hashes(requests):
+    fleet = ScenarioFleet(bucket_size=2)
+    fresh = [WhatIfRequest(pods=r.pods, snapshot=r.snapshot,
+                           policy=r.policy) for r in requests]
+    responses = fleet.run(fresh)
+    assert all(r.ok for r in responses)
+    return hashes(responses)
+
+
+# ---------------------------------------------------------------------------
+# admission queue: the timed-wait regression + shedding semantics
+# ---------------------------------------------------------------------------
+
+
+def test_pop_timed_wait_survives_racing_consumer():
+    """Regression: pop(timeout) used a single Condition.wait, so a notify
+    stolen by a racing popper surfaced as a premature None with time left
+    on the clock. The fixed wait loops on a monotonic deadline."""
+    q = AdmissionQueue(8)
+    got = []
+    waiter = threading.Thread(target=lambda: got.append(q.pop(timeout=5.0)))
+    waiter.start()
+    time.sleep(0.05)
+    for i in range(20):
+        # put-then-immediately-pop from this thread steals the notify the
+        # waiter was sleeping on whenever we win the lock race
+        q.put(i)
+        if q.pop(timeout=0.01) is None:
+            break  # the waiter won one: it has its item
+        time.sleep(0.002)
+    if not got:
+        q.put("final")  # uncontended: only the waiter can take this
+    waiter.join(timeout=10)
+    assert got and got[0] is not None
+
+
+def test_pop_timeout_expires_only_at_the_deadline():
+    q = AdmissionQueue(4)
+    start = time.monotonic()
+    assert q.pop(timeout=0.2) is None
+    assert time.monotonic() - start >= 0.19
+    # no-wait pop on empty returns immediately
+    assert q.pop() is None
+
+
+def test_offer_sheds_strictly_lower_priority_only():
+    q = AdmissionQueue(2)
+    q.put("a", priority=1)
+    q.put("b", priority=0)
+    # same rank as the lowest waiter: plain rejection, no churn
+    assert q.offer("c", priority=0) == (False, None)
+    # strictly higher: the lowest-priority earliest waiter is evicted
+    admitted, victim = q.offer("d", priority=1)
+    assert admitted and victim == "b"
+    # saturated same-priority traffic cannot churn the queue
+    assert q.offer("e", priority=1) == (False, None)
+    assert q.pop() == "a" and q.pop() == "d"
+
+
+# ---------------------------------------------------------------------------
+# fleet admission: priority shedding + deadlines under the injected clock
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_sheds_lowest_priority_on_saturation():
+    snap, pods = scenario(0)
+    fleet = ScenarioFleet(bucket_size=2, max_queue=2)
+    low = [fleet.submit(WhatIfRequest(pods=pods, snapshot=snap, priority=0))
+           for _ in range(2)]
+    # same priority on a full queue: queue_full, nobody is churned out
+    flat = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap, priority=0))
+    assert flat.result(timeout=5).rejected == REJECT_QUEUE_FULL
+    # higher priority: the earliest low-priority waiter is shed NOW
+    high = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap, priority=1))
+    assert low[0].result(timeout=5).rejected == REJECT_SHED
+    fleet.drain()
+    assert low[1].result(timeout=5).ok
+    assert high.result(timeout=5).ok
+
+
+def test_deadline_expires_in_queue_before_staging():
+    clock = ChaosClock()
+    snap, pods = scenario(1)
+    fleet = ScenarioFleet(bucket_size=2, clock=clock, deadline_s=5.0)
+    before = register().serve_rejected.get(REJECT_DEADLINE)
+    aged = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+    # per-request override outlives the fleet default
+    patient = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap,
+                                         deadline_s=100.0))
+    clock.advance(10.0)
+    fleet.drain()
+    assert aged.result(timeout=5).rejected == REJECT_DEADLINE
+    assert patient.result(timeout=5).ok
+    assert register().serve_rejected.get(REJECT_DEADLINE) == before + 1
+
+
+def test_deadline_expires_waiting_for_bucket_siblings():
+    """An entry that ages out INSIDE a partial bucket is rejected at
+    dispatch; the bucket shrinks and the survivors still run."""
+    clock = ChaosClock()
+    snap, pods = scenario(2)
+    fleet = ScenarioFleet(bucket_size=2, clock=clock, deadline_s=5.0,
+                          flush_after_s=60.0)
+    f1 = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+    fleet.pump()          # staged + filed; bucket stays open for a sibling
+    clock.advance(10.0)   # f1 ages out while it waits
+    f2 = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+    fleet.pump()          # bucket fills -> dispatch filters the expired one
+    assert f1.result(timeout=5).rejected == REJECT_DEADLINE
+    r2 = f2.result(timeout=5)
+    assert r2.ok and r2.result is not None
+
+
+# ---------------------------------------------------------------------------
+# stop(): no future left behind
+# ---------------------------------------------------------------------------
+
+
+def test_stop_sweeps_dead_worker_leftovers():
+    """A worker that dies leaves items in the queue and entries in open
+    buckets; stop() must resolve every one REJECT_SHUTDOWN — exactly once
+    (a double set_result would raise InvalidStateError right here)."""
+    snap, pods = scenario(3)
+    fleet = ScenarioFleet(bucket_size=4, flush_after_s=60.0)
+    futures = [fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+               for _ in range(3)]
+    # strand one entry in an open bucket, leave the rest queued
+    fleet._process_guarded(fleet.queue.pop())
+    dead = threading.Thread(target=lambda: None)
+    dead.start()
+    dead.join()
+    fleet._thread = dead  # the worker died without draining
+    fleet.stop()
+    for f in futures:
+        assert f.done()
+        assert f.result().rejected == REJECT_SHUTDOWN
+    # post-stop submits reject immediately
+    late = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+    assert late.result(timeout=5).rejected == REJECT_SHUTDOWN
+
+
+def test_stop_after_clean_run_leaves_no_future_unresolved():
+    snap, pods = scenario(3)
+    fleet = ScenarioFleet(bucket_size=2).start()
+    futures = [fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+               for _ in range(5)]
+    fleet.stop()
+    results = [f.result(timeout=5) for f in futures]
+    assert all(f.done() for f in futures)
+    # each resolved exactly once: answered or explicitly shut down
+    assert all(r.ok or r.rejected == REJECT_SHUTDOWN for r in results)
+
+
+# ---------------------------------------------------------------------------
+# worker-death containment: at-most-once requeue
+# ---------------------------------------------------------------------------
+
+
+def test_worker_death_requeues_at_most_once(monkeypatch):
+    snap, pods = scenario(6)
+    fleet = ScenarioFleet(bucket_size=1)
+    calls = {"n": 0}
+    orig = fleet.executor.stage
+
+    def flaky(request):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("worker died mid-stage")
+        return orig(request)
+
+    monkeypatch.setattr(fleet.executor, "stage", flaky)
+    before = register().serve_retry.get("worker_death")
+    f = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+    fleet.drain()
+    r = f.result(timeout=5)
+    assert r.ok and calls["n"] == 2
+    assert register().serve_retry.get("worker_death") == before + 1
+
+
+def test_worker_death_twice_resolves_with_error(monkeypatch):
+    snap, pods = scenario(6)
+    fleet = ScenarioFleet(bucket_size=1)
+    monkeypatch.setattr(
+        fleet.executor, "stage",
+        lambda request: (_ for _ in ()).throw(RuntimeError("boom")))
+    f = fleet.submit(WhatIfRequest(pods=pods, snapshot=snap))
+    fleet.drain()
+    r = f.result(timeout=5)
+    assert f.done() and r.error is not None and "boom" in r.error
+
+
+# ---------------------------------------------------------------------------
+# chaos dispatch: retry / breaker / verify paths, all parity-checked
+# ---------------------------------------------------------------------------
+
+
+def test_injected_fault_retries_to_clean_parity():
+    requests = requests_for((10, 11))
+    expected = fault_free_hashes(requests)
+    clock = ChaosClock()
+    install_chaos(DeviceFaultPlan(faults={0: "exception"},
+                                  failure_threshold=3, cooldown=2))
+    try:
+        before = register().serve_retry.get("device_fault")
+        fleet = ScenarioFleet(bucket_size=2, clock=clock)
+        responses = fleet.run(requests)
+        assert all(r.ok and r.degraded is None for r in responses)
+        assert hashes(responses) == expected
+        assert register().serve_retry.get("device_fault") == before + 1
+        assert clock.now > 0  # the retry backed off under the clock
+    finally:
+        uninstall_chaos()
+
+
+def test_corrupt_invalid_detected_structurally_then_retried():
+    requests = requests_for((12, 13))
+    expected = fault_free_hashes(requests)
+    install_chaos(DeviceFaultPlan(faults={0: "corrupt_invalid"},
+                                  failure_threshold=3, cooldown=2))
+    try:
+        fleet = ScenarioFleet(bucket_size=2, clock=ChaosClock())
+        responses = fleet.run(requests)
+        assert all(r.ok and r.degraded is None for r in responses)
+        assert hashes(responses) == expected
+    finally:
+        uninstall_chaos()
+
+
+def test_corrupt_silent_caught_by_host_verification():
+    snap, pods = divergent_scenario("vd")
+    requests = [WhatIfRequest(pods=pods, snapshot=snap) for _ in range(2)]
+    expected = fault_free_hashes(requests)
+    install_chaos(DeviceFaultPlan(faults={0: "corrupt_silent"},
+                                  failure_threshold=3, cooldown=2))
+    try:
+        before = register().serve_degraded.get("verify_divergence")
+        fleet = ScenarioFleet(bucket_size=2, clock=ChaosClock())
+        responses = fleet.run(requests)
+        # the host oracle replaced the suspect device answer: parity holds
+        assert all(r.ok for r in responses)
+        assert all(r.degraded == "verify_divergence" for r in responses)
+        assert hashes(responses) == expected
+        assert register().serve_degraded.get("verify_divergence") > before
+    finally:
+        uninstall_chaos()
+
+
+def test_breaker_storm_degrades_to_host_answers():
+    requests = requests_for((14, 15, 16, 17))
+    expected = fault_free_hashes(requests)
+    breaker = install_chaos(DeviceFaultPlan(
+        faults={i: "exception" for i in range(1000)},
+        failure_threshold=1, cooldown=1_000_000))
+    try:
+        before = register().serve_degraded.get("breaker_open")
+        fleet = ScenarioFleet(bucket_size=2, clock=ChaosClock())
+        responses = fleet.run(requests)
+        assert all(r.ok for r in responses)
+        assert all(r.degraded == "breaker_open" for r in responses)
+        assert hashes(responses) == expected
+        assert not breaker.allow()
+        assert register().serve_degraded.get("breaker_open") > before
+    finally:
+        uninstall_chaos()
+
+
+@pytest.mark.chaos_fuzz
+def test_serve_chaos_fuzz_every_future_resolved():
+    """The acceptance bar: seeded fault storms mixed with deadlines and
+    priorities — every submitted future resolves exactly once, every
+    produced answer matches the fault-free hash."""
+    kinds = ["exception", "corrupt_invalid", "corrupt_silent"]
+    for seed in range(4):
+        rng = np.random.RandomState(seed)
+        faults = {int(i): kinds[int(rng.randint(len(kinds)))]
+                  for i in range(12) if rng.rand() < 0.5}
+        install_chaos(DeviceFaultPlan(faults=faults, failure_threshold=2,
+                                      cooldown=2))
+        try:
+            clock = ChaosClock()
+            fleet = ScenarioFleet(bucket_size=2, clock=clock,
+                                  deadline_s=500.0, max_queue=8)
+            requests = requests_for(range(8))
+            expected = dict(zip(
+                (r.request_id for r in requests),
+                fault_free_hashes(requests)))
+            futures = [fleet.submit(r) for r in requests]
+            fleet.drain()
+            fleet.stop()
+            for request, future in zip(requests, futures):
+                assert future.done(), (seed, request.request_id)
+                r = future.result()
+                assert r.ok or r.rejected is not None \
+                    or r.error is not None, (seed, r)
+                if r.ok:
+                    assert placement_hash(r.result.placements) == \
+                        expected[request.request_id], (seed, r.degraded)
+        finally:
+            uninstall_chaos()
+
+
+# ---------------------------------------------------------------------------
+# lossy fabric: serving from a reconverged mirror (satellite c)
+# ---------------------------------------------------------------------------
+
+
+def _fabric_serve(drop, dup, disconnect, tag):
+    """Build the serving snapshot THROUGH the watch fabric: a reflector
+    mirrors node churn behind a FabricInjector, reconverges (relist on
+    disconnect), and the fleet serves against the recovered mirror.
+    Returns (placement hashes, relists)."""
+    store = ResourceStore()
+    client = FakeRESTClient(store)
+    refl = Reflector(client, ResourceType.NODES)
+    nodes = [make_node(f"{tag}-n{i}", milli_cpu=2000 * (i + 1),
+                       memory=8 * 1024**3) for i in range(4)]
+    store.add(ResourceType.NODES, nodes[0])
+    refl.sync()
+    client.fault_injector = FabricInjector(drop=drop, dup=dup,
+                                           disconnect=disconnect)
+    store.add(ResourceType.NODES, nodes[1])    # event 0
+    store.add(ResourceType.NODES, nodes[2])    # event 1
+    store.add(ResourceType.NODES, nodes[3])    # event 2
+    store.delete(ResourceType.NODES, nodes[1])  # event 3
+    refl.sync()
+    assert {n.key() for n in refl.known.values()} == \
+        {n.key() for n in store.list(ResourceType.NODES)}
+    snap = ClusterSnapshot(nodes=sorted(refl.known.values(),
+                                        key=lambda n: n.name))
+    pods = [make_pod(f"{tag}-p{i}", milli_cpu=700 + 200 * i,
+                     memory=1024**3) for i in range(3)]
+    fleet = ScenarioFleet(bucket_size=2)
+    responses = fleet.run(
+        [WhatIfRequest(pods=pods, snapshot=snap) for _ in range(2)])
+    assert all(r.ok for r in responses)
+    return hashes(responses), refl.relists
+
+
+def test_lossy_fabric_serving_placement_parity():
+    clean, _ = _fabric_serve(set(), set(), set(), tag="fx")
+    # the final event disconnects, so the relist heals whatever the drops
+    # diverged — the serving answer must not know the fabric was lossy
+    lossy, relists = _fabric_serve({0, 2}, {1}, {3}, tag="fx")
+    assert relists >= 1
+    assert lossy == clean
+
+
+@pytest.mark.slow
+@pytest.mark.chaos_fuzz
+def test_lossy_fabric_serving_seeded_sweep():
+    clean, _ = _fabric_serve(set(), set(), set(), tag="fs")
+    for seed in range(8):
+        rng = np.random.RandomState(seed)
+        drop = {i for i in range(3) if rng.rand() < 0.4}
+        dup = {i for i in range(3) if i not in drop and rng.rand() < 0.4}
+        lossy, _ = _fabric_serve(drop, dup, {3}, tag="fs")
+        assert lossy == clean, (seed, drop, dup)

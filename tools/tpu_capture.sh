@@ -274,6 +274,12 @@ run_stage policy_stream configs:10 bench_results/r5_tpu_policy_stream.jsonl \
     env TPUSIM_BENCH_LADDER_CONFIGS=10 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
     python bench.py --ladder
 
+echo "== stage 3e: crash recovery (config 11: replay-vs-interval curve + degraded serving) =="
+run_stage recovery configs:11 bench_results/r5_tpu_recovery.jsonl \
+    bench_results/r5_tpu_recovery.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=11 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+
 echo "== stage 4: full XLA ladder (configs 1-5; fresh same-round parity anchors) =="
 run_stage ladder configs:1,2,3,4,5 bench_results/r5_tpu_ladder.jsonl \
     bench_results/r5_tpu_ladder.log \

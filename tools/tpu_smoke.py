@@ -657,6 +657,77 @@ def run_stream_policy_variant():
     return h, out["scheduled"], out["decisions"], pipelined_cycles, traced
 
 
+def run_stream_recover_variant():
+    """Crash recovery (tpusim/stream/persist) stage-0: a WAL-journaled
+    streaming session killed mid-run by a scripted process crash must (a)
+    recover to a fold chain byte-identical to the uninterrupted run's, (b)
+    classify the recovery restage exactly once as "recovered", with zero
+    replay invariant violations, and (c) resume WITHOUT retracing a single
+    scan or scatter program — the recovered device picture re-enters the
+    same pow2-bucketed executables the crashed run compiled."""
+    import shutil
+    import tempfile
+
+    from tpusim.chaos.engine import ProcessCrash
+    from tpusim.chaos.plan import ChurnEvent, FaultPlan
+    from tpusim.jaxe.kernels import apply_delta_donated, schedule_scan_donated
+    from tpusim.simulator import run_stream_simulation
+
+    def cache_sizes():
+        try:
+            return (schedule_scan_donated._cache_size(),
+                    apply_delta_donated._cache_size())
+        except AttributeError:  # private jit API moved: skip the check
+            return None
+
+    def run(ckdir, **kw):
+        return run_stream_simulation(num_nodes=16, cycles=10, arrivals=16,
+                                     evict_fraction=0.25, node_flap_every=4,
+                                     seed=7, checkpoint_dir=ckdir,
+                                     checkpoint_every=2, **kw)
+
+    base_dir = tempfile.mkdtemp(prefix="tpusim-smoke-ck-")
+    ck_dir = tempfile.mkdtemp(prefix="tpusim-smoke-ck-")
+    try:
+        base = run(base_dir)
+        plan = FaultPlan(seed=7, churn=[
+            ChurnEvent(at=6, action="process_crash", target="emit")])
+        try:
+            run(ck_dir, chaos_plan=plan)
+            raise AssertionError("scripted process crash never fired")
+        except ProcessCrash:
+            pass
+        before = cache_sizes()
+        out = run(ck_dir, recover=True)
+        traced = None
+        if before is not None:
+            after = cache_sizes()
+            traced = (after[0] - before[0], after[1] - before[1])
+            if any(traced):
+                raise AssertionError(
+                    f"recovery retraced (scan +{traced[0]}, scatter "
+                    f"+{traced[1]}); the restored device picture missed "
+                    f"the warm executables")
+        if out["fold_chain"] != base["fold_chain"]:
+            raise AssertionError(
+                f"recovered fold chain diverges from the uninterrupted "
+                f"run ({out['fold_chain'][:16]} != "
+                f"{base['fold_chain'][:16]})")
+        if out["recovery_violations"]:
+            raise AssertionError(
+                f"WAL replay invariant violations: "
+                f"{out['recovery_violations']}")
+        if out["restages"].get("recovered") != 1:
+            raise AssertionError(
+                f"recovery restage misclassified: {out['restages']} "
+                f"(want exactly one 'recovered')")
+        h = out["fold_chain"][:16]
+        return h, out["resume_cycle"], out["wal_records"], traced
+    finally:
+        shutil.rmtree(base_dir, ignore_errors=True)
+        shutil.rmtree(ck_dir, ignore_errors=True)
+
+
 def _write_smoke_trace(recorder):
     """Persist the sweep's flight-recorder trace; never fail the smoke."""
     path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
@@ -813,6 +884,29 @@ def main() -> int:
                   f"scheduled={scheduled}/{total} "
                   f"pipelined_cycles={pipelined_cycles} retrace={retrace} "
                   f"({time.time() - t:.1f}s)", flush=True)
+        if not only or "stream_recover" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "stream_recover")
+            try:
+                h, resume_cycle, wal_records, traced = \
+                    run_stream_recover_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: stream_recover: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("resume_cycle", resume_cycle)
+            vsp.end()
+            ran += 1
+            retrace = ("skipped" if traced is None
+                       else f"+{traced[0]}/+{traced[1]}")
+            print(f"SMOKE stream_recover: OK hash={h} "
+                  f"resume_cycle={resume_cycle} wal_records={wal_records} "
+                  f"retrace={retrace} ({time.time() - t:.1f}s)", flush=True)
     finally:
         flight.uninstall()
         _write_smoke_trace(recorder)

@@ -70,6 +70,16 @@ class DeviceOutputError(DeviceFault):
     regardless of verification mode."""
 
 
+class ProcessCrash(RuntimeError):
+    """A scripted whole-process death (the ``process_crash`` churn
+    action). Deliberately NOT a DeviceFault: no breaker absorbs it and no
+    retry survives it — it propagates out of the run, and the test
+    harness "reboots" by recovering from the WAL + checkpoint pair
+    (stream.persist). Raised immediately AFTER the targeted WAL record is
+    durably written, the strictest crash model a journal can be fuzzed
+    under."""
+
+
 class DeviceInjector:
     """Scripted per-dispatch device faults, keyed by dispatch index."""
 
@@ -177,6 +187,10 @@ class ChaosEngine:
         self.device_injector = (
             None if self.plan.device.empty() else DeviceInjector(
                 self.plan.device.faults))
+        # process_crash handler: the persistence layer (stream.persist)
+        # registers one to arm itself; without a handler the event is
+        # skipped like churn on a vanished target
+        self.on_process_crash = None
         # fabric mirror: a FakeRESTClient + Reflector pair consuming the
         # run's store mutations THROUGH the fault injector — built lazily
         # at the first boundary (the store exists by then), audited at the
@@ -257,7 +271,8 @@ class ChaosEngine:
         action = {"node_delete": self._node_delete,
                   "node_cordon": self._node_cordon,
                   "node_flap": self._node_flap,
-                  "pod_evict": self._pod_evict}[ev.action]
+                  "pod_evict": self._pod_evict,
+                  "process_crash": self._process_crash}[ev.action]
         if action(ev):
             self.fired.append((self.boundary, ev.action, ev.target))
             note_fault(ev.action,
@@ -266,6 +281,17 @@ class ChaosEngine:
             self.skipped.append((self.boundary, ev.action, ev.target))
             log.info("chaos: %s %s skipped at boundary %d (target gone)",
                      ev.action, ev.target, self.boundary)
+
+    def _process_crash(self, ev: ChurnEvent) -> bool:
+        """Hand a scripted crash to the installed handler — the stream
+        persistence layer arms itself to raise ProcessCrash at the
+        targeted WAL record (``ev.target`` names the record kind,
+        ``ev.at`` the cycle). Skipped, like churn on a vanished target,
+        when nothing in this run handles crashes."""
+        if self.on_process_crash is None:
+            return False
+        self.on_process_crash(ev)
+        return True
 
     def _find_node(self, name: str):
         from tpusim.api.types import ResourceType

@@ -8,7 +8,11 @@ one per injection layer:
 ``churn``   scripted cluster events fired through the store at pod-attempt
             boundaries: ``node_delete``, ``node_cordon``, ``node_flap``
             (delete + re-add ``restore_after`` boundaries later), and
-            ``pod_evict``.
+            ``pod_evict``. ``process_crash`` rides in this section too but
+            fires from the persistence layer, not the attempt loop: the
+            process dies right after the targeted WAL record (``target``
+            names a record kind, one of CRASH_POINTS) of cycle ``at`` is
+            durably written.
 ``fabric``  watch-stream faults keyed by the global fan-out event index:
             ``drop`` (the frame never reaches the watcher), ``dup`` (the
             frame is delivered twice), ``disconnect`` (the stream closes
@@ -44,9 +48,14 @@ import random
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-CHURN_ACTIONS = ("node_delete", "node_cordon", "node_flap", "pod_evict")
+CHURN_ACTIONS = ("node_delete", "node_cordon", "node_flap", "pod_evict",
+                 "process_crash")
 DEVICE_FAULTS = ("exception", "corrupt_invalid", "corrupt_silent")
 DEVICE_VERIFY_MODES = ("all", "probe")
+# process_crash targets: the WAL record kind of cycle ``at`` the process
+# dies immediately after (stream.persist writes the record, then raises) —
+# together they cover every commit boundary a streaming cycle has
+CRASH_POINTS = ("events", "batch", "bind", "emit")
 
 
 class PlanError(ValueError):
@@ -71,6 +80,9 @@ class ChurnEvent:
         if self.action == "node_flap" and self.restore_after < 1:
             raise PlanError(f"node_flap {self.target!r}: restore_after "
                             "must be >= 1")
+        if self.action == "process_crash" and self.target not in CRASH_POINTS:
+            raise PlanError(f"process_crash target must be a WAL record "
+                            f"kind {CRASH_POINTS}, got {self.target!r}")
 
 
 @dataclass
@@ -146,10 +158,18 @@ class FaultPlan:
         return self
 
     def host_sections_empty(self) -> bool:
-        """True when only device faults are planned (the jax batch path has
-        no per-attempt boundary, so churn/fabric are host-orchestrator
-        sections)."""
-        return not self.churn and self.fabric.empty()
+        """True when only device faults and/or process crashes are planned
+        (the jax batch path has no per-attempt boundary, so node/pod churn
+        and fabric faults are host-orchestrator sections; a process_crash
+        is fired by the persistence layer, not the attempt loop)."""
+        return (self.fabric.empty()
+                and all(ev.action == "process_crash" for ev in self.churn))
+
+    def crash_events(self) -> List[ChurnEvent]:
+        """The plan's scripted process deaths, in firing order."""
+        return sorted((ev for ev in self.churn
+                       if ev.action == "process_crash"),
+                      key=lambda ev: (ev.at, ev.target))
 
     # -- (de)serialization -------------------------------------------------
 
@@ -229,6 +249,20 @@ def load_plan(path: str) -> FaultPlan:
         except json.JSONDecodeError as exc:
             raise PlanError(f"{path}: not JSON: {exc}") from exc
     return FaultPlan.from_obj(obj)
+
+
+def random_crash_plan(seed: int, cycles: int,
+                      points=CRASH_POINTS) -> FaultPlan:
+    """One seeded process_crash at a random (cycle, WAL-record) boundary —
+    the crash-recovery fuzz unit. Deterministic in ``seed``; the cycle is
+    drawn from [0, cycles) and the record kind from ``points``, so a seed
+    sweep covers every commit boundary class the streaming cycle has."""
+    if cycles < 1:
+        raise PlanError("random_crash_plan needs cycles >= 1")
+    rng = random.Random(seed)
+    ev = ChurnEvent(at=rng.randrange(cycles), action="process_crash",
+                    target=rng.choice(tuple(points)))
+    return FaultPlan(seed=seed, churn=[ev]).validate()
 
 
 def random_plan(seed: int, node_names: List[str], pod_keys: List[str],

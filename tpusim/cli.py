@@ -337,6 +337,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
     parser.add_argument("--flush-after-ms", type=float, default=50.0,
                         help="Deadline before a partial bucket dispatches "
                              "ghost-padded")
+    parser.add_argument("--deadline-ms", type=float, default=0.0,
+                        help="Fleet-wide request deadline: a request older "
+                             "than this at staging or bucket time is "
+                             "rejected REJECT_DEADLINE instead of run "
+                             "(0: no deadline)")
+    parser.add_argument("--chaos-plan", default="",
+                        help="Fault-plan JSON, device section only: scripted "
+                             "dispatch faults behind the serve retry + "
+                             "circuit-breaker + host-fallback path")
     parser.add_argument("--max-queue", type=int, default=256,
                         help="Admission queue bound (backpressure)")
     parser.add_argument("--warm-repeats", type=int, default=1,
@@ -440,6 +449,19 @@ def serve_cli(argv) -> int:
             return 2
         mesh = make_scenario_mesh(scen * node, scenario=scen)
 
+    breaker = None
+    if args.chaos_plan:
+        from tpusim.chaos import load_plan
+        from tpusim.chaos.plan import PlanError
+        from tpusim.jaxe.backend import install_chaos
+
+        try:
+            chaos_plan = load_plan(args.chaos_plan)
+        except (OSError, PlanError, ValueError) as exc:
+            print(f"error: --chaos-plan: {exc}", file=sys.stderr)
+            return 2
+        breaker = install_chaos(chaos_plan.device)
+
     recorder = None
     if args.trace_out:
         from tpusim.obs import recorder as flight
@@ -452,7 +474,9 @@ def serve_cli(argv) -> int:
         fleet = ScenarioFleet(provider=args.algorithmprovider,
                               bucket_size=args.bucket_size,
                               flush_after_s=args.flush_after_ms / 1000.0,
-                              max_queue=args.max_queue, mesh=mesh)
+                              max_queue=args.max_queue, mesh=mesh,
+                              deadline_s=(args.deadline_ms / 1000.0
+                                          if args.deadline_ms > 0 else None))
     except (KeyError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -478,6 +502,10 @@ def serve_cli(argv) -> int:
             passes.append((label, time.perf_counter() - start, responses))
     finally:
         fleet.stop()
+        if breaker is not None:
+            from tpusim.jaxe.backend import uninstall_chaos
+
+            uninstall_chaos()
 
     stats = fleet.executor.stats
     exit_code = 0
@@ -488,8 +516,10 @@ def serve_cli(argv) -> int:
         lat = sorted(r.latency_s for r in ok)
         rate = len(responses) / elapsed if elapsed > 0 else 0.0
         hits = sum(1 for r in ok if r.compile_cache_hit)
+        degraded = sum(1 for r in ok if r.degraded)
         print(f"{label}: {len(ok)}/{len(responses)} ok "
-              f"({len(rejected)} rejected, {len(errors)} failed), "
+              f"({len(rejected)} rejected, {len(errors)} failed"
+              + (f", {degraded} degraded" if degraded else "") + "), "
               f"{rate:.1f} scenarios/s, latency p50/p90/max "
               f"{_percentile(lat, 0.5) * 1e3:.1f}/"
               f"{_percentile(lat, 0.9) * 1e3:.1f}/"
@@ -597,8 +627,22 @@ def build_stream_parser() -> argparse.ArgumentParser:
                              "compile JaxBackend dispatch (placement_hash "
                              "byte-parity)")
     parser.add_argument("--chaos-plan", default="",
-                        help="Fault-plan JSON, device section only (churn/"
+                        help="Fault-plan JSON: device section plus "
+                             "process_crash churn events (other churn/"
                              "fabric faults are the load generator's job)")
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="Durability directory: every committed watch "
+                             "delta and placement appends to a WAL here, "
+                             "with periodic device-state checkpoints "
+                             "(stream.persist)")
+    parser.add_argument("--checkpoint-every", type=int, default=10,
+                        help="Cycles between checkpoints (0: genesis "
+                             "checkpoint only, WAL replay covers the rest)")
+    parser.add_argument("--recover", action="store_true",
+                        help="Recover from --checkpoint-dir (checkpoint + "
+                             "WAL tail replay) and resume the interrupted "
+                             "run; the fold chain proves placement parity "
+                             "with the uninterrupted run")
     parser.add_argument("--platform",
                         default=os.environ.get("TPUSIM_PLATFORM", ""))
     parser.add_argument("--json", action="store_true",
@@ -652,6 +696,7 @@ def stream_cli(argv) -> int:
 
         recorder = flight.install(flight.FlightRecorder())
 
+    from tpusim.chaos.engine import ProcessCrash
     from tpusim.simulator import run_stream_simulation
 
     try:
@@ -663,7 +708,17 @@ def stream_cli(argv) -> int:
             provider=args.algorithmprovider,
             policy=policy, pipeline=args.pipeline,
             always_restage=args.always_restage, verify=args.verify,
-            chaos_plan=chaos_plan)
+            chaos_plan=chaos_plan,
+            checkpoint_dir=args.checkpoint_dir or None,
+            checkpoint_every=args.checkpoint_every,
+            recover=args.recover)
+    except ProcessCrash as exc:
+        # the scripted kill: state up to the crash is durable in the WAL;
+        # rerun with --recover to resume from it
+        print(f"crashed: {exc}", file=sys.stderr)
+        print(f"recover with: tpusim stream --checkpoint-dir "
+              f"{args.checkpoint_dir} --recover ...", file=sys.stderr)
+        return 3
     except (KeyError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -687,6 +742,15 @@ def stream_cli(argv) -> int:
               f"{out['load']['evictions']} evictions, "
               f"{out['load']['flaps']} flaps; "
               f"placement chain {out['placement_chain'][:16]}")
+        if out.get("recovered"):
+            print(f"recovered: resumed at cycle {out['resume_cycle']} "
+                  f"({len(out['recomputed_cycles'])} cycles recomputed, replay "
+                  f"{out['replay_ms']:.1f} ms); fold chain "
+                  f"{out['fold_chain'][:16]}")
+        elif "wal_records" in out:
+            print(f"durability: {out['wal_records']} WAL records, "
+                  f"{out['checkpoints']} checkpoints; fold chain "
+                  f"{out['fold_chain'][:16]}")
     if args.verify:
         if out["verified"]:
             print("verify: every cycle placement_hash-identical to the "

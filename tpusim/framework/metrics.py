@@ -316,6 +316,26 @@ class SchedulerMetrics:
             "tpusim_stream_overlap_fraction",
             "Fraction of the last pipelined fold that did not block on the "
             "device (1.0 = decode fully hidden behind device execution)"))
+        # crash-recovery telemetry (ISSUE 12): the WAL + checkpoint layer
+        # for the device-resident twin, and the serve fleet's degraded
+        # modes under chaos
+        self.recovery_checkpoint_latency = self._reg(Histogram(
+            "tpusim_recovery_checkpoint_latency_microseconds",
+            "Host-snapshot checkpoint walltime (device_get + atomic write)",
+            _LATENCY_BUCKETS))
+        self.recovery_replay_latency = self._reg(Histogram(
+            "tpusim_recovery_replay_latency_microseconds",
+            "Crash-recovery walltime: checkpoint load + WAL tail replay",
+            _LATENCY_BUCKETS))
+        self.recovery_wal_records = self._reg(Gauge(
+            "tpusim_recovery_wal_records",
+            "Records in the stream write-ahead journal"))
+        self.serve_retry = self._reg(LabeledCounter(
+            "tpusim_serve_retry_total",
+            "Serve-fleet dispatch retries, by fault reason", "reason"))
+        self.serve_degraded = self._reg(LabeledCounter(
+            "tpusim_serve_degraded_total",
+            "Serve-fleet requests answered via a degraded path", "path"))
 
     def _reg(self, metric):
         self._registry.append(metric)

@@ -171,6 +171,11 @@ class IncrementalCluster:
         # restage records this as the resident row order, against which
         # later batches' ids are remapped (tpusim.stream)
         self.last_batch_key_lists: Optional[Dict[str, List]] = None
+        # committed-delta hook (tpusim.stream.persist): called as
+        # on_event(event_type, obj) AFTER each apply() dispatches, so a
+        # WAL sees every delta exactly when it commits — regardless of
+        # whether it arrived via apply/apply_events/ingest/Reflector
+        self.on_event = None
 
         self._rebuild_nodes()
         for pod in self._pods.values():
@@ -324,6 +329,8 @@ class IncrementalCluster:
             self._apply_pvc(event_type, obj)
         else:
             raise TypeError(f"unsupported event object: {type(obj).__name__}")
+        if self.on_event is not None:
+            self.on_event(event_type, obj)
 
     def apply_events(self, events: Iterable[Tuple[str, object]]) -> None:
         for event_type, obj in events:

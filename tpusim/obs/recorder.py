@@ -303,13 +303,46 @@ def note_serve(event: str, args: Optional[Dict[str, Any]] = None) -> None:
         rec.instant("serve:" + event, "host", args)
 
 
+def note_recovery(event: str, args: Optional[Dict[str, Any]] = None) -> None:
+    """A crash-recovery lifecycle point (stream.persist): checkpoint /
+    replay. Latencies land in the tpusim_recovery_* histograms at the
+    call sites; these instants mark the transitions, and the replay
+    itself additionally runs under a `recover:replay` span."""
+    rec = _active
+    if rec is not None:
+        rec.instant("recover:" + event, "host", args)
+
+
+def note_serve_retry(reason: str,
+                     args: Optional[Dict[str, Any]] = None) -> None:
+    """The serve fleet retried work after a fault: device_fault (injected
+    dispatch death), worker_death (the processing thread died mid-request
+    and the request was requeued at most once)."""
+    _metrics.register().serve_retry.inc(reason)
+    rec = _active
+    if rec is not None:
+        rec.instant("serve_retry:" + reason, "host", args)
+
+
+def note_serve_degraded(path: str,
+                        args: Optional[Dict[str, Any]] = None) -> None:
+    """A serve bucket was answered via a degraded path: breaker_open /
+    retry_exhausted (host reference fallback) or verify_divergence (host
+    results replaced suspect device output)."""
+    _metrics.register().serve_degraded.inc(path)
+    rec = _active
+    if rec is not None:
+        rec.instant("serve_degraded:" + path, "host", args)
+
+
 def note_stream_restage(reason: str, detail: Optional[str] = None) -> None:
     """The stream runtime invalidated its device-resident state and paid a
     full restage: `reason` is the low-cardinality residency-miss class
     (cold_start/policy_plan_change/node_set/groups_dirty/scalar_set/
     new_signature/sig_evict/group_shape/interpod_delta/watch_expired/
-    breaker_open/device_fault/verify_divergence/unsupported), `detail`
-    trace-only context."""
+    breaker_open/device_fault/verify_divergence/unsupported/recovered —
+    the last classifying a crash-recovered session's first restage),
+    `detail` trace-only context."""
     _metrics.register().stream_restage.inc(reason)
     rec = _active
     if rec is not None:

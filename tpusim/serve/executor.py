@@ -24,6 +24,7 @@ real entries, so ghosts cannot leak into responses.
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -32,7 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.backends import ReferenceBackend, placement_hash
 from tpusim.framework.metrics import register
+from tpusim.jaxe import backend as _backend
 from tpusim.jaxe import ensure_x64
 from tpusim.jaxe.backend import _KNOWN_PROVIDERS
 from tpusim.jaxe.whatif import (
@@ -54,7 +57,12 @@ from tpusim.jaxe.sharding import (
     scenario_shardings,
     stage_tree,
 )
-from tpusim.obs.recorder import note_serve, span
+from tpusim.obs.recorder import (
+    note_serve,
+    note_serve_degraded,
+    note_serve_retry,
+    span,
+)
 from tpusim.serve.batcher import Bucket
 from tpusim.serve.request import (
     REJECT_INVALID,
@@ -70,7 +78,9 @@ from tpusim.serve.request import (
 class ServeExecutor:
     def __init__(self, provider: str = "DefaultProvider",
                  mesh: Optional[object] = None,
-                 max_staged: int = 128, max_device_batches: int = 8):
+                 max_staged: int = 128, max_device_batches: int = 8,
+                 max_retries: int = 2, backoff_base_s: float = 0.05,
+                 clock=None):
         if provider not in _KNOWN_PROVIDERS:
             raise KeyError(f"plugin {provider!r} has not been registered")
         if mesh is not None and mesh_kind(mesh) != "scenario":
@@ -81,6 +91,12 @@ class ServeExecutor:
         ensure_x64()  # sentinel bits (62) and CPU nanos need int64 lanes
         self.provider = provider
         self.mesh = mesh
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.clock = clock  # a ChaosClock makes backoff deterministic
+        # degraded path the LAST dispatch took (None: clean device answer);
+        # the fleet copies this into each response's `degraded` field
+        self.last_path: Optional[str] = None
         self._snapshots: Dict[str, ClusterSnapshot] = {}
         # id(policy) -> (policy, prep): the policy ref keeps the id stable
         self._policies: Dict[int, Tuple[Any, tuple]] = {}
@@ -118,24 +134,29 @@ class ServeExecutor:
         self._policies[id(policy)] = (policy, prep)
         return prep
 
-    def stage(self, request: WhatIfRequest):
-        """Resolve + host-stage one request: (staged, shape_class, plan_sig,
-        cp, hard_weight). Raises ServeRejected with a metric-ready reason."""
-        if not request.pods:
-            raise ServeRejected(REJECT_INVALID,
-                                "request carries an empty pod list")
+    def _resolve_snapshot(self, request: WhatIfRequest) -> ClusterSnapshot:
+        """The base cluster a request runs against — inline snapshot or a
+        registered ref. Raises ServeRejected when neither resolves."""
         if request.snapshot is not None:
-            snapshot = request.snapshot
-        elif request.snapshot_ref is not None:
+            return request.snapshot
+        if request.snapshot_ref is not None:
             snapshot = self._snapshots.get(request.snapshot_ref)
             if snapshot is None:
                 raise ServeRejected(
                     REJECT_UNKNOWN_SNAPSHOT,
                     f"snapshot ref {request.snapshot_ref!r} is not "
                     f"registered (known: {sorted(self._snapshots)})")
-        else:
+            return snapshot
+        raise ServeRejected(REJECT_INVALID,
+                            "request needs a snapshot or a snapshot_ref")
+
+    def stage(self, request: WhatIfRequest):
+        """Resolve + host-stage one request: (staged, shape_class, plan_sig,
+        cp, hard_weight). Raises ServeRejected with a metric-ready reason."""
+        if not request.pods:
             raise ServeRejected(REJECT_INVALID,
-                                "request needs a snapshot or a snapshot_ref")
+                                "request carries an empty pod list")
+        snapshot = self._resolve_snapshot(request)
         cp, need_noexec, need_saa, hard_weight = self._policy(request.policy)
         # the what-if analog of the fast path's plan_signature: the policy
         # spec is the part of the compiled program identity requests choose
@@ -214,10 +235,17 @@ class ServeExecutor:
                 self._device_batches.popitem(last=False)
         return built, False
 
-    def dispatch(self, bucket: Bucket) -> Tuple[List[WhatIfResult], bool]:
+    def _dispatch_once(self, bucket: Bucket,
+                       injector=None) -> Tuple[List[WhatIfResult], bool]:
         """Run one bucket as one device program; returns (results aligned
         with bucket.entries, compile_cache_hit). Ghost scenarios and padded
-        pods are dropped here — decode walks only the real entries."""
+        pods are dropped here — decode walks only the real entries.
+
+        injector: an armed chaos DeviceInjector. Scripted exceptions raise
+        before the program runs; scripted corruptions mangle the device
+        output, and the structural validation below (active only under an
+        injector, mirroring JaxBackend's post-dispatch check) converts the
+        detectable kind into a DeviceOutputError the breaker absorbs."""
         program_key = bucket.key
         self.stats["dispatches"] += 1
         sp = span("serve:dispatch")
@@ -226,6 +254,8 @@ class ServeExecutor:
                 sp.set("real", len(bucket.entries))
                 sp.set("ghosts", bucket.ghosts)
                 sp.set("shape", program_key[0].describe())
+            corrupt_kind = (injector.begin_dispatch()
+                            if injector is not None else None)
             (config, carries, statics_b, xs_b), resident = \
                 self._device_batch(bucket)
             seen = program_key in self._warm
@@ -238,6 +268,23 @@ class ServeExecutor:
                                                xs_b)
             choices_b = np.asarray(choices_b)
             counts_b = np.asarray(counts_b)
+            if corrupt_kind is not None:
+                choices_b, counts_b = injector.corrupt(
+                    corrupt_kind, choices_b, counts_b)
+            if injector is not None:
+                # structural validation: padded node axis bounds the valid
+                # choice range; NaN reason counts are never legitimate
+                from tpusim.chaos.engine import DeviceOutputError
+
+                if choices_b.size and int(choices_b.max()) >= \
+                        program_key[0].n_nodes:
+                    raise DeviceOutputError(
+                        f"device returned node choice {int(choices_b.max())}"
+                        f" >= padded node count {program_key[0].n_nodes}")
+                if counts_b.size and np.isnan(
+                        np.asarray(counts_b, dtype=float)).any():
+                    raise DeviceOutputError(
+                        "device returned NaN unschedulability counts")
             traced = compile_count() - before
             warm = seen and traced == 0
             stats = self._warm.setdefault(program_key,
@@ -258,3 +305,89 @@ class ServeExecutor:
                                   choices_b[i], counts_b[i])
                        for i, e in enumerate(bucket.entries)]
         return results, warm
+
+    # -- chaos-hardened dispatch ------------------------------------------
+
+    def _host_results(self, bucket: Bucket) -> List[WhatIfResult]:
+        """The host-reference answer for every real entry of a bucket — the
+        degraded path (open breaker, exhausted retries) and the verification
+        oracle. Byte-identical placement semantics to the device program."""
+        results = []
+        for e in bucket.entries:
+            snapshot = self._resolve_snapshot(e.request)
+            placements = ReferenceBackend(
+                provider=self.provider,
+                policy=e.request.policy).schedule(e.request.pods, snapshot)
+            scheduled = sum(1 for p in placements if p.node_name)
+            results.append(WhatIfResult(
+                placements=placements, scheduled=scheduled,
+                unschedulable=len(placements) - scheduled))
+        return results
+
+    def _degraded(self, bucket: Bucket,
+                  path: str) -> Tuple[List[WhatIfResult], bool]:
+        self.last_path = path
+        note_serve_degraded(path, {"real": len(bucket.entries),
+                                   "shape": bucket.key[0].describe()})
+        return self._host_results(bucket), False
+
+    def _backoff(self, attempts: int) -> None:
+        """Exponential backoff between retries: base * 2^(attempt-1). Under
+        an injected clock the delay advances simulated time (deterministic
+        tests); a wall clock sleeps, capped so chaos fuzz stays fast."""
+        delay = self.backoff_base_s * (2 ** (attempts - 1))
+        if self.clock is not None and hasattr(self.clock, "advance"):
+            self.clock.advance(delay)
+        else:
+            time.sleep(min(delay, 0.2))
+
+    def dispatch(self, bucket: Bucket) -> Tuple[List[WhatIfResult], bool]:
+        """_dispatch_once behind the process-wide chaos seam (jaxe.backend
+        install_chaos; a transparent pass-through when unarmed). The
+        contract mirrors JaxBackend.schedule: a denied or repeatedly
+        faulted bucket degrades to the host reference pipeline (at-least-an
+        -answer, never a hang), a half-open probe — and every dispatch
+        under verify="all" — is host-verified before results are emitted,
+        and each retry backs off exponentially under the injected clock."""
+        self.last_path = None
+        injector = _backend._CHAOS["injector"]
+        breaker = _backend._CHAOS["breaker"]
+        if injector is None and breaker is None:
+            return self._dispatch_once(bucket, None)
+        from tpusim.chaos.engine import DeviceFault
+
+        attempts = 0
+        while True:
+            if breaker is not None and not breaker.allow():
+                return self._degraded(bucket, "breaker_open")
+            probing = breaker.probing if breaker is not None else False
+            try:
+                results, warm = self._dispatch_once(bucket, injector)
+            except DeviceFault as exc:
+                if breaker is not None:
+                    breaker.record_failure(f"{type(exc).__name__}: {exc}")
+                attempts += 1
+                if attempts > self.max_retries:
+                    return self._degraded(bucket, "retry_exhausted")
+                note_serve_retry("device_fault",
+                                 {"attempt": attempts,
+                                  "real": len(bucket.entries),
+                                  "error": str(exc)})
+                self._backoff(attempts)
+                continue
+            if breaker is not None and (
+                    probing or _backend._CHAOS["verify"] == "all"):
+                expected = self._host_results(bucket)
+                got = tuple(placement_hash(r.placements) for r in results)
+                want = tuple(placement_hash(r.placements) for r in expected)
+                if got != want:
+                    # silent corruption: in-range but wrong — only the
+                    # host parity digest catches it
+                    breaker.record_failure("device/host what-if divergence")
+                    self.last_path = "verify_divergence"
+                    note_serve_degraded("verify_divergence",
+                                        {"real": len(bucket.entries)})
+                    return expected, warm
+            if breaker is not None:
+                breaker.record_success()
+            return results, warm

@@ -2,29 +2,33 @@
 
 Backpressure lives HERE, not in the batcher: a full queue rejects at submit
 time (`tpusim_serve_rejected_total{reason="queue_full"}`) so callers see
-overload immediately instead of watching latency grow without bound. Depth is
-mirrored into the `tpusim_serve_queue_depth` gauge on every transition.
+overload immediately instead of watching latency grow without bound — or,
+when the newcomer outranks a waiter, sheds the lowest-priority earliest
+entry instead (`offer`; the fleet resolves the victim's future with
+REJECT_SHED). Depth is mirrored into the `tpusim_serve_queue_depth` gauge
+on every transition.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 from tpusim.framework.metrics import register
 
 
 class AdmissionQueue:
-    """Thread-safe bounded FIFO. `put` never blocks (False on full/closed);
-    `pop` optionally waits. Closing wakes every waiter; a closed queue still
-    drains what it holds."""
+    """Thread-safe bounded FIFO with priority-aware shedding. `put`/`offer`
+    never block; `pop` optionally waits. Closing wakes every waiter; a
+    closed queue still drains what it holds."""
 
     def __init__(self, maxsize: int = 256):
         if maxsize < 1:
             raise ValueError(f"maxsize={maxsize}: need at least 1")
         self.maxsize = maxsize
-        self._items: deque = deque()
+        self._items: deque = deque()   # (item, priority)
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._closed = False
@@ -38,23 +42,56 @@ class AdmissionQueue:
         with self._lock:
             return self._closed
 
-    def put(self, item: Any) -> bool:
+    def put(self, item: Any, priority: int = 0) -> bool:
+        admitted, _ = self.offer(item, priority=priority, shed=False)
+        return admitted
+
+    def offer(self, item: Any, priority: int = 0,
+              shed: bool = True) -> Tuple[bool, Optional[Any]]:
+        """Admit `item`, returning (admitted, shed_victim). On a full
+        queue with `shed`, the lowest-priority earliest waiter is evicted
+        — but only when it ranks strictly BELOW the newcomer, so saturated
+        same-priority traffic degrades to plain queue_full rejection
+        instead of churning the queue."""
         with self._lock:
-            if self._closed or len(self._items) >= self.maxsize:
-                return False
-            self._items.append(item)
+            if self._closed:
+                return False, None
+            if len(self._items) < self.maxsize:
+                self._items.append((item, priority))
+                register().serve_queue_depth.set(len(self._items))
+                self._nonempty.notify()
+                return True, None
+            if not shed:
+                return False, None
+            # min() is stable: earliest entry among the lowest priority
+            vi = min(range(len(self._items)),
+                     key=lambda i: self._items[i][1])
+            victim, victim_priority = self._items[vi]
+            if victim_priority >= priority:
+                return False, None
+            del self._items[vi]
+            self._items.append((item, priority))
             register().serve_queue_depth.set(len(self._items))
             self._nonempty.notify()
-            return True
+            return True, victim
 
     def pop(self, timeout: Optional[float] = None) -> Optional[Any]:
-        """Next item, or None when empty after `timeout` (0/None: no wait)."""
+        """Next item, or None when empty after `timeout` (0/None: no wait).
+
+        The wait loops on a monotonic deadline: a single Condition.wait
+        would surface spurious wakeups — and notifies stolen by a racing
+        popper — as premature None returns, starving consumers that still
+        had time left on the clock."""
+        deadline = (time.monotonic() + timeout) if timeout else None
         with self._lock:
-            if not self._items and timeout and not self._closed:
-                self._nonempty.wait(timeout)
-            if not self._items:
-                return None
-            item = self._items.popleft()
+            while not self._items:
+                if deadline is None or self._closed:
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._nonempty.wait(remaining)
+            item, _priority = self._items.popleft()
             register().serve_queue_depth.set(len(self._items))
             return item
 

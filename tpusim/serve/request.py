@@ -25,6 +25,8 @@ REJECT_INVALID = "invalid"
 REJECT_UNKNOWN_SNAPSHOT = "unknown_snapshot"
 REJECT_UNSUPPORTED = "unsupported"
 REJECT_SHUTDOWN = "shutdown"
+REJECT_DEADLINE = "deadline"   # per-request deadline expired pre-dispatch
+REJECT_SHED = "shed"           # evicted by a higher-priority newcomer
 
 
 class ServeRejected(Exception):
@@ -52,6 +54,13 @@ class WhatIfRequest:
     snapshot_ref: Optional[str] = None
     policy: Any = None
     cache_key: Optional[str] = None
+    # deadline_s: max admission->dispatch age before the request is
+    # rejected REJECT_DEADLINE instead of running (None: fleet default).
+    # priority: higher outranks lower when the admission queue saturates —
+    # a full queue sheds its lowest-priority earliest waiter (REJECT_SHED)
+    # to admit a strictly higher-priority newcomer.
+    deadline_s: Optional[float] = None
+    priority: int = 0
     request_id: str = field(default_factory=lambda: f"req-{next(_ids)}")
 
 
@@ -65,6 +74,10 @@ class WhatIfResponse:
     bucket_ghosts: int = 0  # ghost-scenario padding the bucket carried
     compile_cache_hit: bool = False
     latency_s: float = 0.0  # admission -> decoded result
+    # non-None when the bucket was answered via a degraded path under
+    # chaos: breaker_open / retry_exhausted (host reference fallback) or
+    # verify_divergence (host results replaced suspect device output)
+    degraded: Optional[str] = None
 
     @property
     def ok(self) -> bool:

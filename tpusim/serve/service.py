@@ -28,12 +28,14 @@ from typing import Callable, List, Optional, Sequence
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.framework.metrics import register
-from tpusim.obs.recorder import note_serve, span
+from tpusim.obs.recorder import note_serve, note_serve_retry, span
 from tpusim.serve.batcher import Bucket, PendingEntry, ShapeClassBatcher
 from tpusim.serve.executor import ServeExecutor
 from tpusim.serve.queue import AdmissionQueue
 from tpusim.serve.request import (
+    REJECT_DEADLINE,
     REJECT_QUEUE_FULL,
+    REJECT_SHED,
     REJECT_SHUTDOWN,
     ServeRejected,
     WhatIfRequest,
@@ -45,8 +47,10 @@ class ScenarioFleet:
     def __init__(self, provider: str = "DefaultProvider",
                  bucket_size: int = 4, flush_after_s: float = 0.05,
                  max_queue: int = 256, mesh: Optional[object] = None,
-                 clock: Callable[[], float] = time.monotonic):
-        self.executor = ServeExecutor(provider=provider, mesh=mesh)
+                 clock: Callable[[], float] = time.monotonic,
+                 deadline_s: Optional[float] = None, max_retries: int = 2):
+        self.executor = ServeExecutor(provider=provider, mesh=mesh,
+                                      max_retries=max_retries, clock=clock)
         if mesh is not None and bucket_size % mesh.shape["scenario"] != 0:
             raise ValueError(
                 f"bucket_size={bucket_size} does not divide over the "
@@ -56,7 +60,9 @@ class ScenarioFleet:
                                          flush_after_s=flush_after_s,
                                          clock=clock)
         self._clock = clock
-        self._thread: Optional[threading.Thread] = None
+        self.deadline_s = deadline_s  # fleet-wide default request deadline
+        self._requeued: set = set()   # request_ids requeued after a worker
+        self._thread: Optional[threading.Thread] = None  # death (at most 1x)
         self._stopping = threading.Event()
 
     def register_snapshot(self, ref: str, snapshot: ClusterSnapshot) -> str:
@@ -79,7 +85,20 @@ class ScenarioFleet:
         with span("serve:admit") as sp:
             if sp:
                 sp.set("id", request.request_id)
-            if not self.queue.put((request, future, self._clock())):
+            admitted, victim = self.queue.offer(
+                (request, future, self._clock()),
+                priority=request.priority)
+            if victim is not None:
+                # a saturated queue shed its lowest-priority earliest
+                # waiter to make room for this higher-priority newcomer
+                v_request, v_future, _ = victim
+                if not v_future.done():
+                    v_future.set_result(self._reject(
+                        v_request, REJECT_SHED,
+                        f"shed by higher-priority {request.request_id} "
+                        f"(priority {request.priority} > "
+                        f"{v_request.priority}) on a full queue"))
+            if not admitted:
                 reason = (REJECT_SHUTDOWN if self.queue.closed
                           else REJECT_QUEUE_FULL)
                 future.set_result(self._reject(
@@ -92,8 +111,24 @@ class ScenarioFleet:
 
     # -- pipeline ----------------------------------------------------------
 
+    def _deadline_for(self, request: WhatIfRequest) -> Optional[float]:
+        return (request.deadline_s if request.deadline_s is not None
+                else self.deadline_s)
+
+    def _expired(self, request: WhatIfRequest, admitted_at: float) -> bool:
+        limit = self._deadline_for(request)
+        return limit is not None and self._clock() - admitted_at > limit
+
     def _process(self, request: WhatIfRequest, future: Future,
                  admitted_at: float) -> None:
+        if self._expired(request, admitted_at):
+            # the request aged out waiting in the admission queue: reject
+            # before paying for host staging
+            future.set_result(self._reject(
+                request, REJECT_DEADLINE,
+                f"deadline {self._deadline_for(request)}s expired before "
+                "staging"))
+            return
         try:
             with span("serve:stage") as sp:
                 if sp:
@@ -115,25 +150,69 @@ class ScenarioFleet:
             self._dispatch(full)
 
     def _dispatch(self, bucket: Bucket) -> None:
+        # entries whose deadline lapsed waiting for bucket siblings are
+        # rejected here, not run: the bucket shrinks (ghosts grow) so the
+        # survivors still dispatch through the same warm executable
+        live = []
+        for entry in bucket.entries:
+            if self._expired(entry.request, entry.admitted_at):
+                if not entry.future.done():
+                    entry.future.set_result(self._reject(
+                        entry.request, REJECT_DEADLINE,
+                        f"deadline {self._deadline_for(entry.request)}s "
+                        "expired waiting for a bucket"))
+            else:
+                live.append(entry)
+        if not live:
+            return
+        if len(live) < len(bucket.entries):
+            bucket = Bucket(key=bucket.key, size=bucket.size, entries=live)
         reg = register()
         reg.serve_batch_occupancy.observe(len(bucket.entries))
         try:
             results, warm = self.executor.dispatch(bucket)
         except Exception as exc:  # a bucket failure fails its members only
             for entry in bucket.entries:
-                entry.future.set_result(WhatIfResponse(
-                    request_id=entry.request.request_id,
-                    error=f"{type(exc).__name__}: {exc}"))
+                if not entry.future.done():
+                    entry.future.set_result(WhatIfResponse(
+                        request_id=entry.request.request_id,
+                        error=f"{type(exc).__name__}: {exc}"))
             return
         now = self._clock()
+        degraded = self.executor.last_path
         for entry, result in zip(bucket.entries, results):
             latency = now - entry.admitted_at
             reg.serve_request_latency.observe(latency * 1e6)
-            entry.future.set_result(WhatIfResponse(
-                request_id=entry.request.request_id, result=result,
-                bucket_real=len(bucket.entries),
-                bucket_ghosts=bucket.ghosts, compile_cache_hit=warm,
-                latency_s=latency))
+            if not entry.future.done():
+                entry.future.set_result(WhatIfResponse(
+                    request_id=entry.request.request_id, result=result,
+                    bucket_real=len(bucket.entries),
+                    bucket_ghosts=bucket.ghosts, compile_cache_hit=warm,
+                    latency_s=latency, degraded=degraded))
+
+    def _process_guarded(self, item) -> None:
+        """_process with worker-death containment: an unexpected exception
+        (a crashed worker, not a per-request rejection — _process resolves
+        those itself) requeues the item AT MOST ONCE
+        (`tpusim_serve_retry_total{reason="worker_death"}`); a second death
+        resolves the future with the error, so no future is ever resolved
+        twice and none is lost."""
+        request, future, admitted_at = item
+        try:
+            self._process(request, future, admitted_at)
+        except Exception as exc:
+            if future.done():
+                return
+            if request.request_id not in self._requeued:
+                self._requeued.add(request.request_id)
+                note_serve_retry("worker_death",
+                                 {"id": request.request_id,
+                                  "error": f"{type(exc).__name__}: {exc}"})
+                if self.queue.put(item, priority=request.priority):
+                    return
+            future.set_result(WhatIfResponse(
+                request_id=request.request_id,
+                error=f"{type(exc).__name__}: {exc}"))
 
     def _flush_due(self) -> None:
         for bucket in self.batcher.due():
@@ -149,7 +228,7 @@ class ScenarioFleet:
             item = self.queue.pop()
             if item is None:
                 break
-            self._process(*item)
+            self._process_guarded(item)
         self._flush_due()
 
     def drain(self) -> None:
@@ -183,12 +262,15 @@ class ScenarioFleet:
                        if deadline is not None else 0.05)
             item = self.queue.pop(timeout=timeout)
             if item is not None:
-                self._process(*item)
+                self._process_guarded(item)
             self._flush_due()
         self.drain()
 
     def stop(self) -> None:
-        """Stop admitting, finish what's queued (incl. partial buckets)."""
+        """Stop admitting, finish what's queued (incl. partial buckets) —
+        then sweep: whatever is STILL pending (a dead worker's leftovers,
+        items the join timeout stranded) resolves REJECT_SHUTDOWN, so no
+        submitted future is ever left unresolved."""
         self.queue.close()
         self._stopping.set()
         if self._thread is not None:
@@ -196,3 +278,17 @@ class ScenarioFleet:
             self._thread = None
         else:
             self.drain()
+        leftovers = []
+        while True:
+            item = self.queue.pop()
+            if item is None:
+                break
+            leftovers.append(item[:2])  # (request, future)
+        leftovers.extend((e.request, e.future)
+                         for b in self.batcher.flush_all()
+                         for e in b.entries)
+        for request, future in leftovers:
+            if not future.done():
+                future.set_result(self._reject(
+                    request, REJECT_SHUTDOWN,
+                    "fleet stopped before this request dispatched"))

@@ -785,7 +785,10 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                           provider: str = DEFAULT_PROVIDER,
                           policy=None, pipeline: bool = False,
                           always_restage: bool = False, verify: bool = False,
-                          chaos_plan: Optional[object] = None) -> dict:
+                          chaos_plan: Optional[object] = None,
+                          checkpoint_dir: Optional[str] = None,
+                          checkpoint_every: int = 0,
+                          recover: bool = False) -> dict:
     """Drive a StreamSession through seeded churn (tpusim.stream.ChurnLoadGen)
     and return a summary dict — the `tpusim stream` CLI, the bench's configs
     9/10, and the smoke variants all sit on this loop.
@@ -810,14 +813,31 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         JaxBackend.schedule and assert byte-identical placement hashes
         (pipelined cycles compare when their placements emerge, one cycle
         later).
-    chaos_plan: device-fault section only — churn/fabric faults are what the
-        load generator already produces, event-shaped.
+    chaos_plan: device-fault and process_crash sections only — churn/fabric
+        faults are what the load generator already produces, event-shaped.
+        A process_crash event arms the WAL writer (requires
+        checkpoint_dir) and the run dies with chaos.engine.ProcessCrash at
+        the targeted record; a follow-up call with recover=True and the
+        SAME workload arguments resumes it.
+    checkpoint_dir / checkpoint_every: journal every cycle to a WAL and
+        checkpoint the host+device picture every that-many emitted cycles
+        (stream.persist); 0 = genesis checkpoint only.
+    recover: load checkpoint_dir, replay the WAL tail, fast-forward the
+        load generator over the committed prefix, and run the REMAINING
+        cycles. The summary's fold_chain is then byte-identical to an
+        uninterrupted run's.
     """
     from tpusim.api.snapshot import synthetic_cluster
-    from tpusim.backends import get_backend, placement_hash
+    from tpusim.backends import Placement, bind_pod, get_backend, \
+        placement_hash
     from tpusim.jaxe.delta import IncrementalCluster
     from tpusim.stream import ChurnLoadGen, StreamSession
     from tpusim.stream.loadgen import DEFAULT_LABEL_UNIVERSE
+    from tpusim.stream.persist import (
+        StreamPersistence,
+        chain_fold,
+        recover_stream_session,
+    )
 
     if snapshot is None:
         snapshot = synthetic_cluster(num_nodes)
@@ -830,23 +850,67 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                     {k: vals[i % len(vals)]
                      for k, vals in DEFAULT_LABEL_UNIVERSE.items()})
     breaker = None
+    crash_events = []
     if chaos_plan is not None:
         chaos_plan.validate()
         if not chaos_plan.host_sections_empty():
             raise ValueError(
-                "run_stream_simulation takes device fault sections only: "
-                "churn/fabric faults arrive through the load generator as "
-                "watch events")
+                "run_stream_simulation takes device fault and process_crash "
+                "sections only: churn/fabric faults arrive through the load "
+                "generator as watch events")
+        crash_events = chaos_plan.crash_events()
+        if crash_events and checkpoint_dir is None:
+            raise ValueError(
+                "process_crash faults fire from the WAL writer: pass "
+                "checkpoint_dir (--checkpoint-dir)")
         if not chaos_plan.device.empty():
             from tpusim.jaxe.backend import install_chaos
 
             breaker = install_chaos(chaos_plan.device)
-    session = StreamSession(snapshot, provider=provider, policy=policy,
-                            always_restage=always_restage)
+    if recover and checkpoint_dir is None:
+        raise ValueError("recover=True needs checkpoint_dir")
+    if recover and verify:
+        raise ValueError(
+            "verify and recover are mutually exclusive: the verify arm "
+            "replays the reference picture from cycle 0")
+    persist = report = None
+    start_cycle = 0
+    if recover:
+        session, report, persist = recover_stream_session(
+            checkpoint_dir, provider=provider, policy=policy,
+            always_restage=always_restage,
+            checkpoint_every=checkpoint_every)
+        start_cycle = report.resume_cycle
+    else:
+        session = StreamSession(snapshot, provider=provider, policy=policy,
+                                always_restage=always_restage)
+        if checkpoint_dir is not None:
+            persist = StreamPersistence(checkpoint_dir,
+                                        checkpoint_every=checkpoint_every)
+            session.attach_persistence(persist)
+    if crash_events and persist is not None:
+        ev = crash_events[0]
+        persist.arm_crash(ev.at, ev.target)
     gen = ChurnLoadGen(snapshot, seed=seed, arrivals=arrivals,
                        evict_fraction=evict_fraction,
                        node_flap_every=node_flap_every,
                        label_churn=label_churn, taint_churn=taint_churn)
+    skip_events = 0
+    if recover:
+        # deterministic fast-forward: the generator draws NO rng in batch()
+        # or note_bound(), so replaying events()/batch() for the committed
+        # prefix — with binds fed back from the WAL — leaves the rng and
+        # the bound population exactly where the crashed run had them
+        for c in range(start_cycle):
+            gen.events(c)
+            by_key = {p.key(): p for p in gen.batch()}
+            gen.note_bound([
+                Placement(pod=bind_pod(by_key[k], node), node_name=node)
+                for k, node in report.bound_by_cycle.get(c, [])
+                if k in by_key])
+        # a crash mid-events left a partially-applied cycle: the replayed
+        # picture already holds its first events_applied deltas
+        skip_events = report.events_applied.get(start_cycle, 0)
     ref_inc = ref_backend = ref_gen = None
     if verify:
         ref_inc = IncrementalCluster(snapshot)
@@ -859,27 +923,36 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
     import hashlib
 
     chain = hashlib.sha256()
+    # the resumable fold over per-cycle placement hashes (persist.chain's
+    # twin): seeded from the recovered prefix, so a recovered run's final
+    # fold is comparable byte-for-byte with an uninterrupted run's
+    fold_chain = report.chain if recover else ""
     latencies: List[float] = []
     expected_hashes: List[str] = []   # verify arm FIFO (pipeline lags 1)
     scheduled = decisions = mismatches = 0
 
     def account(placements) -> None:
-        nonlocal decisions, scheduled, mismatches
+        nonlocal decisions, scheduled, mismatches, fold_chain
         decisions += len(placements)
         scheduled += sum(1 for p in placements if p.node_name)
         h = placement_hash(placements)
         chain.update(h.encode())
+        fold_chain = chain_fold(fold_chain, h)
         if verify and expected_hashes.pop(0) != h:
             mismatches += 1
 
     t_start = perf_counter()
     try:
-        for cycle in range(cycles):
+        for cycle in range(start_cycle, cycles):
             if pipeline:
                 # fold cycle N-1's binds BEFORE drawing cycle N's events:
                 # the host picture evolves in exactly the synchronous order
                 gen.note_bound(session.poll_placed())
-            session.apply_events(gen.events(cycle))
+            evs = gen.events(cycle)
+            if skip_events:
+                evs = evs[skip_events:]
+                skip_events = 0
+            session.apply_events(evs)
             batch = gen.batch()
             t0 = perf_counter()
             if pipeline:
@@ -911,6 +984,8 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
             if tail:
                 account(tail)
     finally:
+        if persist is not None:
+            persist.close()
         if breaker is not None:
             from tpusim.jaxe.backend import uninstall_chaos
 
@@ -933,6 +1008,7 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         "restages": dict(session.restage_counts),
         "commits": session.device.commits,
         "placement_chain": chain.hexdigest(),
+        "fold_chain": fold_chain,
         "load": dict(gen.stats),
     }
     if verify:
@@ -940,4 +1016,14 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         out["mismatched_cycles"] = mismatches
     if breaker is not None:
         out["breaker_transitions"] = list(breaker.transitions)
+    if persist is not None:
+        out["wal_records"] = persist.wal_records
+        out["checkpoints"] = persist.checkpoints
+        out["wal_chain"] = persist.chain
+    if recover:
+        out["recovered"] = True
+        out["resume_cycle"] = start_cycle
+        out["replay_ms"] = report.replay_s * 1e3
+        out["recomputed_cycles"] = list(report.recomputed)
+        out["recovery_violations"] = list(report.violations)
     return out

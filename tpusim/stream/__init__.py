@@ -1,7 +1,16 @@
 """Streaming scheduler runtime (ISSUE 7): device-resident cluster state,
-O(delta) scatter updates, classified restage fallbacks."""
+O(delta) scatter updates, classified restage fallbacks; crash recovery
+via WAL + checkpoints (ISSUE 12)."""
 
 from tpusim.stream.loadgen import ChurnLoadGen
+from tpusim.stream.persist import (
+    CRASH_POINTS,
+    PersistError,
+    RecoveryReport,
+    StreamPersistence,
+    chain_fold,
+    recover_stream_session,
+)
 from tpusim.stream.runtime import (
     MIN_BUCKET,
     DeviceResidentCluster,
@@ -10,9 +19,15 @@ from tpusim.stream.runtime import (
 )
 
 __all__ = [
+    "CRASH_POINTS",
     "MIN_BUCKET",
     "ChurnLoadGen",
     "DeviceResidentCluster",
+    "PersistError",
+    "RecoveryReport",
+    "StreamPersistence",
     "StreamSession",
     "bucket_size",
+    "chain_fold",
+    "recover_stream_session",
 ]

@@ -59,6 +59,7 @@ invalidates residency so the next cycle re-arms from host truth.
 from __future__ import annotations
 
 import logging
+from contextlib import nullcontext
 from time import perf_counter
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -267,10 +268,12 @@ class _PendingCycle:
     un-forced device outputs plus everything the deferred decode needs."""
 
     __slots__ = ("pods", "choices", "counts", "compiled", "t0",
-                 "dispatched_at", "folded", "bound", "placements")
+                 "dispatched_at", "folded", "bound", "placements",
+                 "wal_cycle")
 
     def __init__(self, pods, choices=None, counts=None, compiled=None,
-                 t0=0.0, dispatched_at=0.0, placements=None):
+                 t0=0.0, dispatched_at=0.0, placements=None,
+                 wal_cycle=None):
         self.pods = pods
         self.choices = choices
         self.counts = counts
@@ -280,6 +283,9 @@ class _PendingCycle:
         self.folded = placements is not None
         self.bound: List[Placement] = []
         self.placements = placements
+        # WAL cycle id when a persistence layer is attached; None for a
+        # sync-buffered cycle (schedule() already journaled its commit)
+        self.wal_cycle = wal_cycle
 
 
 class StreamSession:
@@ -328,6 +334,7 @@ class StreamSession:
         self._statics_patch = None    # (padded idx, StaticsDelta) or None
         self._pending: Optional[_PendingCycle] = None
         self._last_path: Optional[str] = None
+        self.persist = None           # stream.persist.StreamPersistence
 
     def set_policy(self, policy=None, compiled_policy=None) -> None:
         """Swap the session's scheduling policy. The next cycle restages
@@ -376,6 +383,19 @@ class StreamSession:
         if self._forced is None:
             self._forced = reason
 
+    # -- persistence (stream.persist) -------------------------------------
+
+    def attach_persistence(self, persistence) -> None:
+        """Journal this session's committed deltas, batches, binds, and
+        emissions through a StreamPersistence (WAL + checkpoints)."""
+        persistence.attach(self)
+
+    def _persist_suppressed(self):
+        """Gate the WAL's watch-delta hook around fold-back binds: binds
+        are journaled as bind records, not as synthetic MODIFIED events."""
+        return (self.persist.suppress_events() if self.persist is not None
+                else nullcontext())
+
     # -- the cycle --------------------------------------------------------
 
     def schedule(self, pods: List[Pod],
@@ -392,6 +412,8 @@ class StreamSession:
         self.cycles += 1
         inc = self.inc
         t0 = perf_counter()
+        cid = (self.persist.begin_cycle(pods)
+               if self.persist is not None else None)
         if not inc.nodes:
             # final disposition like any other cycle: one path label plus
             # the latency observations (the accounting-identity contract)
@@ -400,6 +422,9 @@ class StreamSession:
                                     reason="Unschedulable", message=msg)
                           for p in pods]
             self._note_path("no_nodes", len(pods))
+            if cid is not None:
+                self.persist.log_bind(cid, [])
+                self.persist.log_emit(cid, placements)
             self._observe_cycle("no_nodes", t0)
             return placements
         reason, cols = _routed if _routed is not None else self._route(pods)
@@ -407,14 +432,18 @@ class StreamSession:
             placements = self._stream_cycle(pods, cols)
         else:
             placements = self._restage_cycle(pods, reason)
-        for pl in placements:
-            if pl.node_name:
+        bound = [pl for pl in placements if pl.node_name]
+        with self._persist_suppressed():
+            for pl in bound:
                 inc.apply(MODIFIED, pl.pod)
         if self.device.valid:
             # the scan already applied these binds to the resident carry
             # with identical integer arithmetic — replaying the fold-back
             # journal next cycle would be a byte-for-byte no-op
             inc.drain_journal()
+        if cid is not None:
+            self.persist.log_bind(cid, bound)
+            self.persist.log_emit(cid, placements)
         self._observe_cycle(self._last_path, t0)
         return placements
 
@@ -802,7 +831,9 @@ class StreamSession:
         if routed is not None and routed[0] is None:
             self.cycles += 1
             t0 = perf_counter()
-            self._dispatch_async(pods, routed[1], t0)
+            cid = (self.persist.begin_cycle(pods)
+                   if self.persist is not None else None)
+            self._dispatch_async(pods, routed[1], t0, cid)
             register().stream_pipeline_depth.set(1.0)
             osp = flight.span("stream_overlap")
             prev = self._finalize(prev_p)
@@ -846,14 +877,19 @@ class StreamSession:
         p.choices = choices
         names = p.compiled.statics.names
         mark = self.inc.journal_mark()
-        for pod, c in zip(p.pods, choices):
-            c = int(c)
-            if c >= 0:
-                bound = bind_pod(pod, names[c])
-                self.inc.apply(MODIFIED, bound)
-                p.bound.append(Placement(pod=bound, node_name=names[c]))
+        with self._persist_suppressed():
+            for pod, c in zip(p.pods, choices):
+                c = int(c)
+                if c >= 0:
+                    bound = bind_pod(pod, names[c])
+                    self.inc.apply(MODIFIED, bound)
+                    p.bound.append(Placement(pod=bound, node_name=names[c]))
         self.inc.journal_rollback(mark)
         p.folded = True
+        if self.persist is not None and p.wal_cycle is not None:
+            # journaled at fold time: cycle N's binds land BEFORE cycle
+            # N+1's watch events, the order the host picture mutates
+            self.persist.log_bind(p.wal_cycle, p.bound)
 
     def _finalize(self, p: Optional[_PendingCycle]
                   ) -> Optional[List[Placement]]:
@@ -873,10 +909,13 @@ class StreamSession:
                 prebound=p.bound)
         p.placements = placements
         self._note_path("pipelined", len(p.pods))
+        if self.persist is not None and p.wal_cycle is not None:
+            self.persist.log_emit(p.wal_cycle, placements)
         self._observe_cycle("pipelined", p.t0)
         return placements
 
-    def _dispatch_async(self, pods: List[Pod], cols, t0: float) -> None:
+    def _dispatch_async(self, pods: List[Pod], cols, t0: float,
+                        wal_cycle: Optional[int] = None) -> None:
         """Commit pending deltas and launch the donated scan WITHOUT
         forcing its outputs — JAX's async dispatch returns futures, so the
         host is free to decode the previous cycle while the device runs.
@@ -897,7 +936,8 @@ class StreamSession:
             dsp.end()
         dev.carry = final_carry
         self._pending = _PendingCycle(pods, choices, counts, dev.compiled,
-                                      t0, perf_counter())
+                                      t0, perf_counter(),
+                                      wal_cycle=wal_cycle)
 
     # -- accounting -------------------------------------------------------
 

@@ -1197,6 +1197,30 @@ def measure_preemption(platform: str, baseline_pods: int) -> dict:
     })
 
 
+def _provenance_overhead(run_fn) -> dict:
+    """A/B the decision-provenance capture cost at one representative
+    shape (ISSUE 13 budgets <2%): the identical workload with and without
+    an installed ProvenanceLog. The in-memory ring is the hot-path cost
+    every capture site pays — one lock + one reference append per decoded
+    batch; JSONL formatting is deferred to flush, outside the cycle loop."""
+    from tpusim.obs import provenance
+
+    off = run_fn()
+    provenance.install(provenance.ProvenanceLog(capacity=4096))
+    try:
+        on = run_fn()
+    finally:
+        provenance.uninstall()
+    delta = (off["decisions_per_s"] - on["decisions_per_s"]) \
+        / max(off["decisions_per_s"], 1e-9)
+    return {
+        "off_decisions_per_s": round(off["decisions_per_s"], 1),
+        "on_decisions_per_s": round(on["decisions_per_s"], 1),
+        "overhead_fraction": round(delta, 4),
+        "within_budget": delta < 0.02,
+    }
+
+
 def measure_stream_churn(platform: str) -> dict:
     """Config 9: streaming-runtime churn (tpusim/stream). Three sweeps:
 
@@ -1271,6 +1295,15 @@ def measure_stream_churn(platform: str) -> dict:
             f"{restage['p50_cycle_ms']:.1f} ms "
             f"({size_curve[-1]['stream_vs_restage']}x)")
 
+    warm_up(mid)
+    provenance_overhead = _provenance_overhead(
+        lambda: run_stream_simulation(num_nodes=mid, cycles=cycles,
+                                      arrivals=arrivals, evict_fraction=0.25,
+                                      seed=9))
+    log(f"[config 9] provenance capture overhead: "
+        f"{provenance_overhead['overhead_fraction'] * 100:.2f}% "
+        f"(within_budget={provenance_overhead['within_budget']})")
+
     headline = size_curve[sizes.index(mid)]
     return {
         "metric": f"churn decisions/sec (config 9: streaming runtime, "
@@ -1293,6 +1326,7 @@ def measure_stream_churn(platform: str) -> dict:
         "staging_overhead_flatness": round(
             size_curve[-1]["staging_overhead_ms"]
             / max(size_curve[0]["staging_overhead_ms"], 1e-9), 2),
+        "provenance_overhead": provenance_overhead,
         "metrics": _metrics_snapshot(reset=True),
     }
 
@@ -1421,6 +1455,12 @@ def measure_policy_stream(platform: str) -> dict:
             f"({size_curve[-1]['pipelined_vs_sync']}x, chains_equal="
             f"{size_curve[-1]['chains_equal']})")
 
+    warm_up(mid)
+    provenance_overhead = _provenance_overhead(lambda: run(mid))
+    log(f"[config 10] provenance capture overhead: "
+        f"{provenance_overhead['overhead_fraction'] * 100:.2f}% "
+        f"(within_budget={provenance_overhead['within_budget']})")
+
     headline = size_curve[sizes.index(mid)]
     return {
         "metric": f"pipelined policy-stream decisions/sec (config 10: "
@@ -1437,6 +1477,7 @@ def measure_policy_stream(platform: str) -> dict:
         "chains_equal": all(row["chains_equal"] for row in size_curve),
         "churn_curve": churn_curve,
         "size_curve": size_curve,
+        "provenance_overhead": provenance_overhead,
         "metrics": _metrics_snapshot(reset=True),
     }
 

@@ -178,6 +178,290 @@ class TestExposition:
         assert "scheduler_pod_preemption_victims" not in snap
 
 
+class TestExpositionConformance:
+    """ISSUE 13 satellite: the /metrics payload must be a conformant
+    Prometheus/OpenMetrics text exposition — a real scraper parses it."""
+
+    def _populated(self):
+        m = SchedulerMetrics()
+        m.binding_latency.observe(1500)
+        m.backend_route.inc("xla_scan", 2)
+        m.stream_cycle_latency.observe("stream_scan", 900)
+        m.slo_cycles.inc("ok")
+        m.slo_burn_rate.set(0.25)
+        m.stream_chain_head.set_info(head="abc123", cycle="7")
+        m.obs_dropped_events.inc(3)
+        return m
+
+    def test_every_family_has_help_and_type(self):
+        m = self._populated()
+        text = m.expose()
+        for metric in m._all():
+            assert f"# HELP {metric.name} " in text, metric.name
+            assert f"# TYPE {metric.name} " in text, metric.name
+
+    def test_no_duplicate_families(self):
+        m = SchedulerMetrics()
+        names = [metric.name for metric in m._all()]
+        assert len(names) == len(set(names))
+        typed = [line.split()[2] for line in m.expose().splitlines()
+                 if line.startswith("# TYPE ")]
+        assert len(typed) == len(set(typed))
+
+    def test_histograms_emit_cumulative_inf_bucket(self):
+        m = self._populated()
+        text = m.expose()
+        # plain histogram: +Inf bucket present and equals _count
+        assert ('scheduler_binding_latency_microseconds_bucket'
+                '{le="+Inf"} 1') in text
+        assert "scheduler_binding_latency_microseconds_count 1" in text
+        # labeled histogram child too
+        assert ('tpusim_stream_cycle_latency_us_bucket'
+                '{path="stream_scan",le="+Inf"} 1') in text
+        # cumulativity: counts never decrease along the bucket ladder
+        h = m.binding_latency
+        assert h.bucket_counts == sorted(h.bucket_counts)
+
+    def test_label_value_escaping(self):
+        from tpusim.framework.metrics import escape_label_value
+
+        assert escape_label_value('a"b') == 'a\\"b'
+        assert escape_label_value("a\\b") == "a\\\\b"
+        assert escape_label_value("a\nb") == "a\\nb"
+        c = LabeledCounter("x_total", "h", "reason")
+        c.inc('quo"te\nnl\\bs')
+        sample = c.expose()[2]
+        assert sample == 'x_total{reason="quo\\"te\\nnl\\\\bs"} 1'
+        assert "\n" not in sample  # one physical exposition line
+
+    def test_snapshot_matches_expose_values(self):
+        """snapshot() and expose() are two renderings of one truth: every
+        snapshot entry's value must appear verbatim in the exposition."""
+        m = self._populated()
+        text = m.expose()
+        for name, value in m.snapshot().items():
+            if isinstance(value, dict) and "count" in value:
+                assert f"{name}_count {value['count']}" in text
+            elif isinstance(value, dict):
+                for label, child in value.items():
+                    if isinstance(child, dict):  # labeled histogram
+                        assert (f'{name}_count{{'
+                                in text and f"}} {child['count']}" in text)
+                    elif isinstance(child, str):  # info gauge labels
+                        assert f'{label}="{child}"' in text
+                    else:  # labeled counter
+                        assert f'"{label}"}} {child:g}' in text
+            else:
+                assert f"{name} {value:g}" in text
+
+    def test_info_gauge(self):
+        from tpusim.framework.metrics import InfoGauge
+
+        g = InfoGauge("y_info", "h")
+        assert g.expose() == ["# HELP y_info h", "# TYPE y_info gauge"]
+        g.set_info(head="aa", cycle="3")
+        assert g.expose()[2] == 'y_info{cycle="3",head="aa"} 1'
+        g.set_info(head="bb", cycle="4")  # replaces, never accumulates
+        lines = g.expose()
+        assert len(lines) == 3
+        assert lines[2] == 'y_info{cycle="4",head="bb"} 1'
+
+    def test_metrics_lint_clean(self):
+        """tools/metrics_lint.py (standalone + here in tier-1): the live
+        registry obeys the tpusim_* naming conventions."""
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "metrics_lint", os.path.join(os.path.dirname(__file__),
+                                         os.pardir, "tools",
+                                         "metrics_lint.py"))
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+        assert lint.lint_registry(SchedulerMetrics()) == []
+        # and the linter actually bites: a misnamed counter is flagged
+        bad = SchedulerMetrics()
+        bad._reg(Gauge("tpusim_bad_total", "gauge posing as a counter"))
+        assert lint.lint_registry(bad)
+
+
+class TestFlightRecorderRing:
+    def test_ring_bounds_events_and_counts_drops(self):
+        from tpusim.obs.recorder import FlightRecorder
+
+        register().reset()
+        rec = FlightRecorder(max_events=4)
+        for i in range(10):
+            rec.instant(f"e{i}", "host")
+        assert len(rec.events) == 4
+        assert rec.dropped == 6
+        assert [e["name"] for e in rec.events] == ["e6", "e7", "e8", "e9"]
+        assert register().obs_dropped_events.value == 6
+
+    def test_default_capacity_is_large(self):
+        from tpusim.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder()
+        assert rec.max_events == FlightRecorder.DEFAULT_MAX_EVENTS
+        rec.instant("e", "host")
+        assert rec.dropped == 0
+
+
+class TestSloTracker:
+    def test_verdicts_and_burn_rate(self):
+        from tpusim.obs import slo
+
+        register().reset()
+        t = slo.SloTracker(target_us=1000.0, objective=0.9, window=10)
+        for _ in range(8):
+            t.observe("stream_scan", 500.0)   # ok
+        for _ in range(2):
+            t.observe("stream_scan", 5000.0)  # breach
+        m = register()
+        assert m.slo_cycles.get("ok") == 8
+        assert m.slo_cycles.get("breach") == 2
+        # 2/10 breaches against a 10% budget = burning at exactly 2x
+        assert abs(t.burn_rate - 2.0) < 1e-9
+        assert abs(m.slo_burn_rate.value - 2.0) < 1e-9
+        assert m.slo_target.value == 1000.0
+
+    def test_burn_crossings_hit_flight_recorder(self):
+        from tpusim.obs import recorder as flight
+        from tpusim.obs import slo
+
+        register().reset()
+        rec = flight.install(flight.FlightRecorder())
+        try:
+            t = slo.SloTracker(target_us=1000.0, objective=0.5, window=4,
+                               burn_alert=1.0)
+            t.observe("p", 2000.0)  # 1/1 breach → burn 2.0 → burn_start
+            for _ in range(8):
+                t.observe("p", 10.0)  # burn decays → burn_end
+        finally:
+            flight.uninstall()
+        names = [e["name"] for e in rec.events]
+        assert "slo:burn_start" in names
+        assert "slo:burn_end" in names
+        assert names.index("slo:burn_start") < names.index("slo:burn_end")
+
+    def test_observe_cycle_noop_when_disarmed(self):
+        from tpusim.obs import slo
+
+        slo.uninstall()
+        register().reset()
+        slo.observe_cycle("p", 1e9)  # must not touch the registry
+        assert register().slo_cycles.get("breach") == 0
+
+    def test_invalid_config_rejected(self):
+        import pytest
+
+        from tpusim.obs.slo import SloTracker
+
+        with pytest.raises(ValueError):
+            SloTracker(target_us=0)
+        with pytest.raises(ValueError):
+            SloTracker(target_us=10, objective=1.0)
+
+
+class TestObsServer:
+    def _get(self, url):
+        import urllib.error
+        import urllib.request
+
+        try:
+            resp = urllib.request.urlopen(url, timeout=5)
+            return resp.status, dict(resp.headers), resp.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, dict(err.headers), err.read().decode()
+
+    def test_endpoints(self):
+        import json
+
+        from tpusim.obs import provenance
+        from tpusim.obs.server import METRICS_CONTENT_TYPE, ObsServer
+
+        register().reset()
+        register().backend_route.inc("xla_scan")
+        provenance.uninstall()
+        server = ObsServer(port=0).start()
+        try:
+            status, headers, body = self._get(server.url + "/metrics")
+            assert status == 200
+            assert headers["Content-Type"] == METRICS_CONTENT_TYPE
+            assert 'tpusim_backend_route_total{route="xla_scan"} 1' in body
+            for line in body.rstrip("\n").splitlines():
+                assert _PROM_LINE.match(line), f"malformed: {line!r}"
+
+            status, _, body = self._get(server.url + "/healthz")
+            assert status == 200
+            health = json.loads(body)
+            assert health["status"] == "ok"
+
+            status, _, body = self._get(server.url + "/debug/provenance")
+            assert status == 200
+            assert json.loads(body) == []  # no log installed → empty ring
+
+            status, _, _ = self._get(server.url + "/nope")
+            assert status == 404
+        finally:
+            server.stop()
+            register().reset()
+
+    def test_healthz_flips_on_breaker_open(self):
+        import json
+
+        from tpusim.obs.server import ObsServer
+
+        register().reset()
+        server = ObsServer(port=0).start()
+        try:
+            register().breaker_state.set(1.0)  # OPEN
+            status, _, body = self._get(server.url + "/healthz")
+            assert status == 503
+            assert json.loads(body)["status"] == "breaker_open"
+            register().breaker_state.set(0.0)
+            status, _, body = self._get(server.url + "/healthz")
+            assert status == 200
+        finally:
+            server.stop()
+            register().reset()
+
+    def test_provenance_ring_served(self):
+        import json
+
+        from tpusim.api.snapshot import make_pod
+        from tpusim.backends import Placement
+        from tpusim.obs import provenance
+        from tpusim.obs.server import ObsServer
+
+        register().reset()
+        provenance.install(provenance.ProvenanceLog())
+        server = ObsServer(port=0).start()
+        try:
+            pod = make_pod("p0", milli_cpu=1, memory=1)
+            provenance.capture(
+                [Placement(pod=pod, node_name="n1")], "test", cycle=2)
+            status, _, body = self._get(
+                server.url + "/debug/provenance?limit=10")
+            assert status == 200
+            (rec,) = json.loads(body)
+            assert rec["pod"] == "default/p0"
+            assert rec["node"] == "n1"
+            assert rec["cycle"] == 2
+        finally:
+            server.stop()
+            provenance.uninstall()
+            register().reset()
+
+    def test_parse_listen(self):
+        from tpusim.obs.server import parse_listen
+
+        assert parse_listen("127.0.0.1:9090") == ("127.0.0.1", 9090)
+        assert parse_listen(":8080") == ("127.0.0.1", 8080)
+        assert parse_listen("9100") == ("127.0.0.1", 9100)
+        assert parse_listen("0.0.0.0:80") == ("0.0.0.0", 80)
+
+
 class TestTrace:
     def test_log_if_long_under_threshold_silent(self):
         t = Trace("Scheduling default/p")

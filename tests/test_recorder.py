@@ -42,7 +42,7 @@ class TestFlightRecorder:
         rec = FlightRecorder(clock=_clock())
         with rec.span("predicates") as sp:
             sp.set("nodes", 3)
-        assert rec.events == [{
+        assert list(rec.events) == [{
             "name": "predicates", "cat": "host", "ph": "X",
             "ts": 1000.0, "dur": 1000.0, "pid": 1, "tid": 1,
             "args": {"nodes": 3},

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Metrics-registry lint (ISSUE 13): naming + registration conventions.
+
+Checks the in-process SchedulerMetrics registry, not grep output, so a
+family only reachable through a helper still gets linted. Rules apply to
+the `tpusim_*` namespace we own; the `scheduler_*` families reproduce the
+reference's metric names verbatim and are grandfathered.
+
+  - every family name registered exactly once
+  - names are lowercase [a-z0-9_], no leading/trailing/double underscore
+  - counter families end in `_total`
+  - non-counter families do NOT end in `_total`
+  - histogram families end in a unit suffix (_microseconds / _us /
+    _seconds / _bytes) unless explicitly allowlisted as unitless
+  - info-style gauges end in `_info`, and only they do
+
+Run standalone (`python tools/metrics_lint.py`; exit 1 on findings) or
+through tests/test_metrics.py (tier-1).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*[a-z0-9]$")
+_HIST_UNIT_SUFFIXES = ("_microseconds", "_us", "_seconds", "_bytes")
+# unitless-by-design histograms (counts per bucket, not a measured unit)
+_UNITLESS_HISTOGRAMS = {"tpusim_serve_batch_occupancy"}
+
+
+def lint_registry(registry) -> List[str]:
+    """All convention violations in a SchedulerMetrics instance."""
+    from tpusim.framework.metrics import (
+        Counter,
+        Histogram,
+        InfoGauge,
+        LabeledCounter,
+        LabeledHistogram,
+    )
+
+    problems: List[str] = []
+    seen = {}
+    for metric in registry._all():
+        name = metric.name
+        if name in seen:
+            problems.append(
+                f"{name}: registered more than once "
+                f"({type(seen[name]).__name__} and {type(metric).__name__})")
+            continue
+        seen[name] = metric
+        if not name.startswith("tpusim_"):
+            continue  # scheduler_* keeps the reference's verbatim names
+        if not _NAME_RE.match(name) or "__" in name:
+            problems.append(f"{name}: not lowercase [a-z0-9_] "
+                            "(or has doubled/edge underscores)")
+        is_counter = isinstance(metric, (Counter, LabeledCounter))
+        if is_counter and not name.endswith("_total"):
+            problems.append(f"{name}: counter families must end in _total")
+        if not is_counter and name.endswith("_total"):
+            problems.append(f"{name}: only counter families may end in "
+                            "_total")
+        if isinstance(metric, (Histogram, LabeledHistogram)) \
+                and name not in _UNITLESS_HISTOGRAMS \
+                and not name.endswith(_HIST_UNIT_SUFFIXES):
+            problems.append(
+                f"{name}: histogram families need a unit suffix "
+                f"({'/'.join(_HIST_UNIT_SUFFIXES)}) or an allowlist entry "
+                "in tools/metrics_lint.py")
+        if isinstance(metric, InfoGauge) != name.endswith("_info"):
+            problems.append(f"{name}: the _info suffix is reserved for "
+                            "info-style gauges (and required on them)")
+    return problems
+
+
+def main() -> int:
+    from tpusim.framework.metrics import SchedulerMetrics
+
+    problems = lint_registry(SchedulerMetrics())
+    for problem in problems:
+        print(f"metrics-lint: {problem}", file=sys.stderr)
+    if problems:
+        return 1
+    print("metrics-lint: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

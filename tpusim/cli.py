@@ -148,7 +148,78 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Write the scheduler metrics registry in "
                              "Prometheus text exposition format after the "
                              "run")
+    add_explain_flags(parser)
     return parser
+
+
+def add_explain_flags(parser: argparse.ArgumentParser) -> None:
+    """The decision-provenance flag pair, shared by the one-shot, serve,
+    and stream entrypoints."""
+    parser.add_argument("--explain-out", default="",
+                        help="Append decision-provenance records (one JSON "
+                             "object per pod decision: why placed / why "
+                             "not, with failure text byte-identical to the "
+                             "host FitError) to this JSONL file; query it "
+                             "with `tpusim explain FILE`")
+    parser.add_argument("--explain-top-k", type=int, default=0,
+                        help="Also record the top-K candidate nodes per "
+                             "placed pod with each one's per-priority score "
+                             "breakdown (jax backend one-shot runs; routes "
+                             "through the XLA scan). 0 = failures-only "
+                             "provenance")
+
+
+def add_obs_flags(parser: argparse.ArgumentParser) -> None:
+    """The live-telemetry flag pair, shared by serve and stream."""
+    parser.add_argument("--listen", default="",
+                        help="Serve the live telemetry plane on HOST:PORT "
+                             "(also ':PORT' or 'PORT'): GET /metrics "
+                             "(Prometheus/OpenMetrics text), /healthz "
+                             "(JSON liveness; 503 while the dispatch "
+                             "breaker is open), /debug/provenance (recent "
+                             "decision records)")
+    parser.add_argument("--slo-target-ms", type=float, default=0.0,
+                        help="Arm the per-cycle latency SLO at this target: "
+                             "publishes tpusim_slo_cycles_total{verdict} "
+                             "and tpusim_slo_burn_rate, and drops "
+                             "slo:burn_start/_end instants on the flight "
+                             "recorder at burn-rate crossings (0: off)")
+
+
+def _arm_observability(args):
+    """Install the provenance log, SLO tracker, and telemetry endpoint the
+    flags ask for; returns a teardown callable (flushes --explain-out)."""
+    from tpusim.obs import provenance, slo
+
+    server = None
+    listen = getattr(args, "listen", "")
+    explain_out = getattr(args, "explain_out", "")
+    explain_top_k = max(0, getattr(args, "explain_top_k", 0))
+    slo_target_ms = getattr(args, "slo_target_ms", 0.0)
+    # --listen without --explain-out still arms an in-memory ring so
+    # /debug/provenance serves the recent decisions
+    if explain_out or explain_top_k or listen:
+        provenance.install(provenance.ProvenanceLog(
+            top_k=explain_top_k, path=explain_out or None))
+    if slo_target_ms and slo_target_ms > 0:
+        slo.install(slo.SloTracker(slo_target_ms * 1000.0))
+    if listen:
+        from tpusim.obs.server import start_server
+
+        server = start_server(listen)
+        host, port = server.address
+        print(f"telemetry: listening on http://{host}:{port} "
+              "(/metrics /healthz /debug/provenance)", file=sys.stderr)
+
+    def teardown() -> None:
+        if provenance.get_log() is not None:
+            provenance.uninstall()   # close() flushes --explain-out
+        if slo.get_tracker() is not None:
+            slo.uninstall()
+        if server is not None:
+            server.stop()
+
+    return teardown
 
 
 def load_snapshot(args) -> ClusterSnapshot:
@@ -368,6 +439,8 @@ def build_serve_parser() -> argparse.ArgumentParser:
     parser.add_argument("--trace-out", default="",
                         help="Write the serve: span timeline (Chrome trace "
                              "JSON, or .jsonl for raw spans)")
+    add_obs_flags(parser)
+    add_explain_flags(parser)
     return parser
 
 
@@ -491,6 +564,7 @@ def serve_cli(argv) -> int:
                       cache_key=f"load-{i}-{n}")
         for i, n in enumerate(sizes)]
 
+    obs_teardown = _arm_observability(args)
     fleet.start()
     try:
         passes = []  # (label, elapsed, responses)
@@ -502,6 +576,7 @@ def serve_cli(argv) -> int:
             passes.append((label, time.perf_counter() - start, responses))
     finally:
         fleet.stop()
+        obs_teardown()
         if breaker is not None:
             from tpusim.jaxe.backend import uninstall_chaos
 
@@ -655,6 +730,8 @@ def build_stream_parser() -> argparse.ArgumentParser:
     parser.add_argument("--trace-out", default="",
                         help="Write the stream span timeline (Chrome trace "
                              "JSON, or .jsonl for raw spans)")
+    add_obs_flags(parser)
+    add_explain_flags(parser)
     return parser
 
 
@@ -699,6 +776,7 @@ def stream_cli(argv) -> int:
     from tpusim.chaos.engine import ProcessCrash
     from tpusim.simulator import run_stream_simulation
 
+    obs_teardown = _arm_observability(args)
     try:
         out = run_stream_simulation(
             snapshot, num_nodes=args.synthetic_nodes, cycles=args.cycles,
@@ -722,6 +800,8 @@ def stream_cli(argv) -> int:
     except (KeyError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
+    finally:
+        obs_teardown()
 
     exit_code = 0
     if args.json:
@@ -780,6 +860,124 @@ def stream_cli(argv) -> int:
     return exit_code
 
 
+def build_explain_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusim explain",
+        description="Query a decision-provenance file (--explain-out "
+                    "JSONL): why each pod placed where it did, or the "
+                    "exact per-predicate failure text when it didn't")
+    parser.add_argument("file", help="JSONL file written by --explain-out")
+    parser.add_argument("--pod", default="",
+                        help="Only records whose pod name contains this "
+                             "substring ('ns/name' matches exactly)")
+    parser.add_argument("--source", default="",
+                        help="Only records from this capture source "
+                             "(backend, stream, serve, ...)")
+    parser.add_argument("--failed", action="store_true",
+                        help="Only unschedulable decisions")
+    parser.add_argument("--placed", action="store_true",
+                        help="Only placed decisions")
+    parser.add_argument("--limit", type=int, default=0,
+                        help="Print at most the LAST N matching records "
+                             "(0: all)")
+    parser.add_argument("--summary", action="store_true",
+                        help="Aggregate counts instead of per-record lines: "
+                             "placed/failed by source, failure messages by "
+                             "frequency")
+    parser.add_argument("--json", action="store_true",
+                        help="Emit matching records as JSON lines instead "
+                             "of the human-readable rendering")
+    return parser
+
+
+def _format_explain_record(rec: dict) -> str:
+    where = rec.get("source", "?")
+    if rec.get("cycle") is not None:
+        where += f" c{rec['cycle']}"
+    head = f"#{rec.get('seq', '?')} [{where}] {rec.get('pod', '?')}"
+    if rec.get("placed"):
+        line = f"{head} -> {rec.get('node')}"
+        top = rec.get("top_k") or []
+        if top:
+            best = top[0]
+            parts = best.get("parts") or {}
+            breakdown = ", ".join(f"{k}={v}" for k, v in parts.items() if v)
+            line += (f"  (score {best.get('score')}"
+                     + (f": {breakdown}" if breakdown else "") + ")")
+            for alt in top[1:]:
+                line += f"\n    runner-up {alt['node']} score {alt['score']}"
+        return line
+    return (f"{head} UNSCHEDULABLE [{rec.get('reason', '?')}]\n"
+            f"    {rec.get('message', '')}")
+
+
+def explain_cli(argv) -> int:
+    """`tpusim explain`: offline queries over an --explain-out file."""
+    import json
+    from collections import Counter
+
+    from tpusim.obs.provenance import read_jsonl
+
+    args = build_explain_parser().parse_args(argv)
+    if args.failed and args.placed:
+        print("error: --failed and --placed are mutually exclusive",
+              file=sys.stderr)
+        return 2
+
+    def matches(rec: dict) -> bool:
+        if args.pod:
+            pod = rec.get("pod", "")
+            if args.pod != pod and args.pod not in pod:
+                return False
+        if args.source and rec.get("source") != args.source:
+            return False
+        if args.failed and rec.get("placed"):
+            return False
+        if args.placed and not rec.get("placed"):
+            return False
+        return True
+
+    try:
+        records = [r for r in read_jsonl(args.file) if matches(r)]
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.summary:
+        by_source: Counter = Counter()
+        placed = failed = 0
+        messages: Counter = Counter()
+        nodes: Counter = Counter()
+        for rec in records:
+            by_source[rec.get("source", "?")] += 1
+            if rec.get("placed"):
+                placed += 1
+                nodes[rec.get("node", "?")] += 1
+            else:
+                failed += 1
+                messages[rec.get("message", "")] += 1
+        print(f"{len(records)} decision(s): {placed} placed, "
+              f"{failed} unschedulable")
+        for source, n in by_source.most_common():
+            print(f"  source {source}: {n}")
+        if nodes:
+            print("top nodes:")
+            for node, n in nodes.most_common(10):
+                print(f"  {n:6d}  {node}")
+        if messages:
+            print("failure messages:")
+            for message, n in messages.most_common(10):
+                print(f"  {n:6d}  {message}")
+        return 0
+
+    if args.limit > 0:
+        records = records[-args.limit:]
+    for rec in records:
+        print(json.dumps(rec, sort_keys=True) if args.json
+              else _format_explain_record(rec))
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -787,6 +985,8 @@ def main(argv=None) -> int:
         return serve_cli(argv[1:])
     if argv and argv[0] == "stream":
         return stream_cli(argv[1:])
+    if argv and argv[0] == "explain":
+        return explain_cli(argv[1:])
     args = build_parser().parse_args(argv)
     feature_gates = None
     if args.feature_gates:
@@ -937,6 +1137,7 @@ def main(argv=None) -> int:
 
         recorder = flight.install(flight.FlightRecorder())
 
+    obs_teardown = _arm_observability(args)
     start = time.perf_counter()
     try:
         status = run_simulation(pods, snapshot, provider=args.algorithmprovider,
@@ -951,6 +1152,8 @@ def main(argv=None) -> int:
         # (PolicyError is a ValueError; the registry raises KeyError)
         print(f"error: {exc}", file=sys.stderr)
         return 2
+    finally:
+        obs_teardown()
     elapsed = time.perf_counter() - start
 
     if recorder is not None:

@@ -31,6 +31,14 @@ def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
     return [start * factor**i for i in range(count)]
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-exposition label-value escaping (exposition format
+    spec): backslash, double-quote, and line-feed must be escaped or a
+    scraper mis-parses the sample line."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 class Histogram:
     def __init__(self, name: str, help_text: str, buckets: List[float]):
         self.name = name
@@ -142,7 +150,8 @@ class LabeledCounter:
         with self._lock:
             items = sorted(self.values.items())
         for label_value, value in items:
-            lines.append(f'{self.name}{{{self.label}="{label_value}"}} {value:g}')
+            lines.append(f'{self.name}{{{self.label}='
+                         f'"{escape_label_value(label_value)}"}} {value:g}')
         return lines
 
 
@@ -184,7 +193,7 @@ class LabeledHistogram:
         with self._lock:
             items = sorted(self.children.items())
         for label_value, child in items:
-            pair = f'{self.label}="{label_value}"'
+            pair = f'{self.label}="{escape_label_value(label_value)}"'
             for bound, bucket_count in zip(child.buckets,
                                            child.bucket_counts):
                 lines.append(f'{self.name}_bucket{{{pair},le="{bound:g}"}} '
@@ -193,6 +202,41 @@ class LabeledHistogram:
                          f'{child.count}')
             lines.append(f'{self.name}_sum{{{pair}}} {child.total:g}')
             lines.append(f'{self.name}_count{{{pair}}} {child.count}')
+        return lines
+
+
+class InfoGauge:
+    """An info-style gauge (prometheus *_info convention): constant value 1
+    with the interesting facts carried as label values. Setting it replaces
+    the label set, so exactly one sample is exposed at a time — scrapes see
+    the CURRENT chain head / build info, never a history."""
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self.labels: Dict[str, str] = {}
+        self.value = 0.0  # 1.0 once set; 0 families are skipped by snapshot
+        self._lock = threading.Lock()
+
+    def set_info(self, **labels: str) -> None:
+        with self._lock:
+            self.labels = {k: str(v) for k, v in labels.items()}
+            self.value = 1.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self.labels = {}
+            self.value = 0.0
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            if self.value:
+                pairs = ",".join(
+                    f'{k}="{escape_label_value(v)}"'
+                    for k, v in sorted(self.labels.items()))
+                lines.append(f"{self.name}{{{pairs}}} {self.value:g}")
         return lines
 
 
@@ -336,6 +380,38 @@ class SchedulerMetrics:
         self.serve_degraded = self._reg(LabeledCounter(
             "tpusim_serve_degraded_total",
             "Serve-fleet requests answered via a degraded path", "path"))
+        # observability plane (ISSUE 13): bounded flight recorder, SLO
+        # tracking against a configurable per-cycle latency target, and the
+        # recovery chain head published for /healthz continuity checks
+        self.obs_dropped_events = self._reg(Counter(
+            "tpusim_obs_dropped_events_total",
+            "Flight-recorder events dropped by the bounded ring buffer"))
+        self.slo_target = self._reg(Gauge(
+            "tpusim_slo_cycle_latency_target_microseconds",
+            "Configured per-cycle latency SLO target (0 = no SLO armed)"))
+        self.slo_cycles = self._reg(LabeledCounter(
+            "tpusim_slo_cycles_total",
+            "Scheduling cycles judged against the latency SLO target",
+            "verdict"))
+        self.slo_burn_rate = self._reg(Gauge(
+            "tpusim_slo_burn_rate",
+            "Windowed error-budget burn rate (breach fraction over the "
+            "window divided by the SLO's error budget; 1.0 = burning "
+            "exactly at budget)"))
+        self.stream_chain_head = self._reg(InfoGauge(
+            "tpusim_stream_chain_head_info",
+            "Current placement-chain head of the stream WAL (labels: head, "
+            "cycle) — proves WAL/chain continuity without reading the "
+            "checkpoint dir"))
+        self.recovery_last_checkpoint_timestamp = self._reg(Gauge(
+            "tpusim_recovery_last_checkpoint_timestamp_seconds",
+            "Unix time of the last completed stream checkpoint"))
+        self.provenance_records = self._reg(Counter(
+            "tpusim_provenance_records_total",
+            "Decision-provenance records captured into the explanation ring"))
+        # one lock for whole-registry reads: /metrics and snapshot() see a
+        # single consistent exposition even while runtime threads observe
+        self._read_lock = threading.Lock()
 
     def _reg(self, metric):
         self._registry.append(metric)
@@ -351,10 +427,12 @@ class SchedulerMetrics:
     def expose(self) -> str:
         """Prometheus text exposition format (the scrape body the reference
         would have served had it started its metrics server). Families are
-        emitted in registration order."""
+        emitted in registration order; the registry-level lock makes one
+        scrape a consistent snapshot relative to another reader."""
         lines: List[str] = []
-        for metric in self._all():
-            lines.extend(metric.expose())
+        with self._read_lock:
+            for metric in self._all():
+                lines.extend(metric.expose())
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> Dict[str, object]:
@@ -362,23 +440,28 @@ class SchedulerMetrics:
         in BENCH records so trajectory files say which path produced each
         number."""
         out: Dict[str, object] = {}
-        for metric in self._all():
-            if isinstance(metric, Histogram):
-                if metric.count:
-                    out[metric.name] = {"count": metric.count,
-                                        "sum": round(metric.total, 3)}
-            elif isinstance(metric, LabeledHistogram):
-                if metric.children:
-                    out[metric.name] = {
-                        label: {"count": child.count,
-                                "sum": round(child.total, 3)}
-                        for label, child in sorted(metric.children.items())}
-            elif isinstance(metric, LabeledCounter):
-                if metric.values:
-                    out[metric.name] = dict(sorted(metric.values.items()))
-            else:
-                if metric.value:
-                    out[metric.name] = metric.value
+        with self._read_lock:
+            for metric in self._all():
+                if isinstance(metric, Histogram):
+                    if metric.count:
+                        out[metric.name] = {"count": metric.count,
+                                            "sum": round(metric.total, 3)}
+                elif isinstance(metric, LabeledHistogram):
+                    if metric.children:
+                        out[metric.name] = {
+                            label: {"count": child.count,
+                                    "sum": round(child.total, 3)}
+                            for label, child in sorted(
+                                metric.children.items())}
+                elif isinstance(metric, LabeledCounter):
+                    if metric.values:
+                        out[metric.name] = dict(sorted(metric.values.items()))
+                elif isinstance(metric, InfoGauge):
+                    if metric.value:
+                        out[metric.name] = dict(sorted(metric.labels.items()))
+                else:
+                    if metric.value:
+                        out[metric.name] = metric.value
         return out
 
 

@@ -44,8 +44,10 @@ from tpusim.engine.providers import (
 )
 from tpusim.jaxe import ensure_x64
 from tpusim.jaxe.kernels import (
+    EXPLAIN_SENTINEL,
     carry_init,
     config_for,
+    explain_part_names,
     pod_columns_to_device,
     pod_columns_to_host,
     schedule_scan,
@@ -53,6 +55,7 @@ from tpusim.jaxe.kernels import (
     statics_to_device,
 )
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
+from tpusim.obs import provenance
 from tpusim.obs import recorder as flight
 
 log = logging.getLogger(__name__)
@@ -113,6 +116,7 @@ _FALLBACK_KEYS = (
     ("scalar resource kinds", "reason_bits_budget"),
     ("priority weights exceed", "score_int32"),
     ("int32", "int32_overflow"),
+    ("explain lanes", "explain"),
 )
 
 
@@ -400,11 +404,16 @@ class JaxBackend:
 
     def _reference(self, pods: List[Pod],
                    snapshot: ClusterSnapshot) -> List[Placement]:
-        return ReferenceBackend(
+        placements = ReferenceBackend(
             provider=self.provider, policy=self.policy,
             extender_transport=self.extender_transport,
             hard_pod_affinity_symmetric_weight=self.hard_pod_affinity_symmetric_weight,
         ).schedule(pods, snapshot)
+        # host-route decisions carry provenance too (failures-only: the
+        # reference path computes no per-part score lanes); FitError text
+        # is the host original by construction
+        provenance.capture(placements, "reference")
+        return placements
 
     def schedule(self, pods: List[Pod], snapshot: ClusterSnapshot,
                  precompiled=None) -> List[Placement]:
@@ -454,8 +463,11 @@ class JaxBackend:
             return []
         if not snapshot.nodes:
             msg = "no nodes available to schedule pods"
-            return [Placement(pod=mark_unschedulable(p, msg),
-                              reason="Unschedulable", message=msg) for p in pods]
+            placements = [Placement(pod=mark_unschedulable(p, msg),
+                                    reason="Unschedulable", message=msg)
+                          for p in pods]
+            provenance.capture(placements, "backend")
+            return placements
         # a wedged accelerator tunnel must degrade to CPU, not hang the
         # first device op (or the AUTO fast-path gate's default_backend())
         from time import perf_counter
@@ -504,11 +516,7 @@ class JaxBackend:
                     f"jax backend does not yet carry state for: {detail}")
             log.warning("jax backend falling back to reference for: %s", detail)
             flight.note_route("reference_fallback", len(pods))
-            return ReferenceBackend(
-                provider=self.provider, policy=self.policy,
-                extender_transport=self.extender_transport,
-                hard_pod_affinity_symmetric_weight=self.hard_pod_affinity_symmetric_weight,
-            ).schedule(pods, snapshot)
+            return self._reference(pods, snapshot)
 
         hard_weight = self.hard_pod_affinity_symmetric_weight
         if cp is not None and cp.hard_weight is not None:
@@ -535,6 +543,18 @@ class JaxBackend:
             if cp.saa_entries:
                 config = _dc_replace(config, n_saa_doms=ptabs.n_saa_doms)
 
+        # decision provenance (ISSUE 13): an installed explain log that
+        # asked for a score breakdown compiles the top-k lanes into the
+        # scan. explain_k is a jit static, so provenance-off programs (the
+        # default) are byte-identical to pre-provenance ones.
+        explain_k = provenance.requested_top_k()
+        if explain_k > 0:
+            from dataclasses import replace as _explain_replace
+
+            config = _explain_replace(
+                config,
+                explain_k=min(explain_k, len(compiled.statics.names)))
+
         ensure_x64()
         # fast-path decision BEFORE any device upload: when the Pallas plan
         # engages, the statics/carry/pod-column HBM transfers below would be
@@ -543,6 +563,14 @@ class JaxBackend:
         fast_verify = False
         fast_sig = None
         fast_on, auto_mode = _fast_path_enabled()
+        if fast_on and config.explain_k > 0:
+            # the score-breakdown lanes are an XLA-scan feature: the Pallas
+            # kernel carries failure provenance natively (reason counts) but
+            # not per-part top-k scores (DEVIATIONS.md)
+            fast_on = False
+            _note_fast_fallback(
+                metrics, "explain lanes (top-k score breakdown) route "
+                "through the XLA scan")
         if (fast_on and auto_mode and not _FAST_AUTO["verified_sigs"]
                 and len(pods) < int(os.environ.get(
                     "TPUSIM_FAST_VERIFY_MIN", 64))):
@@ -606,7 +634,7 @@ class JaxBackend:
         # to HBM chunk by chunk, bit-identical to the single dispatch
         # (SURVEY.md §7 hard part 6 — 1M-pod batches).
         scan_chunk = int(os.environ.get("TPUSIM_SCAN_CHUNK", 131072))
-        use_chunks = (fplan is None
+        use_chunks = (fplan is None and config.explain_k == 0
                       and scan_chunk > 0 and len(pods) > scan_chunk)
         if fplan is None:
             carry = _xla_carry()
@@ -635,7 +663,8 @@ class JaxBackend:
             fplan = None
             statics = _xla_statics()
             carry = _xla_carry()
-            use_chunks = scan_chunk > 0 and len(pods) > scan_chunk
+            use_chunks = (config.explain_k == 0
+                          and scan_chunk > 0 and len(pods) > scan_chunk)
             xs = (pod_columns_to_host(cols) if use_chunks
                   else pod_columns_to_device(cols))
             dispatch_start = perf_counter()
@@ -669,11 +698,16 @@ class JaxBackend:
                 elif auto_mode and not fast_verify:
                     # already-pinned variant ran without re-verification
                     flight.note_auto_transition("trust", str(fast_sig))
+        explain_lanes = None
         if fplan is None:  # fast path off, ineligible, or discarded above
             with flight.profiled("tpusim:schedule_scan"):
                 if use_chunks:
                     _, choices, counts, _ = schedule_scan_chunked(
                         config, carry, statics, xs, scan_chunk)
+                elif config.explain_k > 0:
+                    (_, choices, counts, _,
+                     explain_lanes) = schedule_scan(config, carry,
+                                                    statics, xs)
                 else:
                     _, choices, counts, _ = schedule_scan(config, carry,
                                                           statics, xs)
@@ -721,6 +755,19 @@ class JaxBackend:
         with flight.span("decode_placements"):
             placements, _ = decode_placements(pods, choices, counts,
                                               compiled.statics.names, strings)
+        prov = provenance.get_log()
+        if prov is not None:
+            topk = None
+            if explain_lanes is not None:
+                top_idx, top_scores, top_parts = explain_lanes
+                p = len(pods)
+                topk = {"idx": np.asarray(top_idx)[:p],
+                        "scores": np.asarray(top_scores)[:p],
+                        "parts": np.asarray(top_parts)[:p],
+                        "names": compiled.statics.names,
+                        "part_names": explain_part_names(config),
+                        "sentinel": EXPLAIN_SENTINEL}
+            prov.capture_batch(placements, "backend", topk=topk)
         # e2e additionally covers host-side result materialization
         metrics.e2e_scheduling_latency.observe(
             since_in_microseconds(dispatch_start))

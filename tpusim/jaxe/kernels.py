@@ -271,6 +271,12 @@ class EngineConfig:
     # segment count for the ServiceAntiAffinity label domains (incl. the
     # invalid-0 bucket); set by the backend from the compiled node labels
     n_saa_doms: int = 1
+    # decision provenance (ISSUE 13): when > 0 the scan additionally emits,
+    # per pod, the top-k candidate nodes by final score with each node's
+    # per-priority score contributions (explain_part_names order). Static,
+    # so explain_k=0 traces are byte-identical to pre-provenance programs —
+    # zero cost when disabled.
+    explain_k: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -562,6 +568,47 @@ def policy_weights(ps, most_requested: bool) -> tuple:
         return (w_least, w_most, 1, 1, 1, AVOID_PODS_WEIGHT, 1, 1)
     return (ps.w_least, ps.w_most, ps.w_balanced, ps.w_node_aff,
             ps.w_taint, ps.w_avoid, ps.w_spread, ps.w_interpod)
+
+
+# Any real node score is a small weighted sum of 0..10*weight components;
+# masking infeasible nodes to -(1<<62) before the explain top_k leaves a
+# comfortable decode threshold at -(1<<61): a top-k row scoring at or below
+# it is padding from fewer-than-k feasible nodes, not a candidate.
+EXPLAIN_SENTINEL = -(1 << 61)
+
+
+def explain_part_names(config: EngineConfig) -> list:
+    """Provider-priority names for the explain lanes' part columns, in the
+    exact order _evaluate's score section appends them. Must mirror that
+    section's static gating — tests/test_provenance.py locks the two
+    together by summing parts back to the emitted top-k scores."""
+    ps = config.policy
+    (w_least, w_most, w_balanced, w_node_aff, w_taint, w_avoid, w_spread,
+     w_interpod) = policy_weights(ps, config.most_requested)
+    names = []
+    if w_least:
+        names.append("LeastRequestedPriority")
+    if w_most:
+        names.append("MostRequestedPriority")
+    if w_balanced:
+        names.append("BalancedResourceAllocation")
+    if w_node_aff:
+        names.append("NodeAffinityPriority")
+    if w_taint:
+        names.append("TaintTolerationPriority")
+    if w_avoid:
+        names.append("NodePreferAvoidPodsPriority")
+    if ps is not None and ps.has_label_prio:
+        names.append("NodeLabelPriority")
+    if ps is not None and ps.w_image:
+        names.append("ImageLocalityPriority")
+    if ps is not None and ps.saa_weights:
+        names.append("ServiceAntiAffinityPriority")
+    if config.has_services and w_spread:
+        names.append("SelectorSpreadPriority")
+    if config.has_interpod and w_interpod:
+        names.append("InterPodAffinityPriority")
+    return names
 
 
 def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
@@ -895,22 +942,35 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     label_prio_on = ps is not None and ps.has_label_prio
 
     score = jnp.zeros_like(st.alloc_cpu)
+    # explain lanes (ISSUE 13): each weighted component lands in `parts`
+    # alongside its addition into score, in explain_part_names order. The
+    # list stays empty when explain_k == 0 (static), so the disabled trace
+    # is unchanged.
+    explain = config.explain_k > 0
+    parts: list = []
+
+    def add(term):
+        nonlocal score
+        score = score + term
+        if explain:
+            parts.append(jnp.broadcast_to(term, score.shape))
+
     if w_least or w_most or w_balanced:
         total_cpu = x.nz_cpu + carry.nonzero_cpu
         total_mem = x.nz_mem + carry.nonzero_mem
     if w_least:
         # least_requested.go:41-52
-        score = score + w_least * (
+        add(w_least * (
             (_ratio_score(total_cpu, st.alloc_cpu, False)
-             + _ratio_score(total_mem, st.alloc_mem, False)) // 2)
+             + _ratio_score(total_mem, st.alloc_mem, False)) // 2))
     if w_most:
         # most_requested.go:44-55
-        score = score + w_most * (
+        add(w_most * (
             (_ratio_score(total_cpu, st.alloc_cpu, True)
-             + _ratio_score(total_mem, st.alloc_mem, True)) // 2)
+             + _ratio_score(total_mem, st.alloc_mem, True)) // 2))
     if w_balanced:
-        score = score + w_balanced * _balanced_score(
-            total_cpu, total_mem, st.alloc_cpu, st.alloc_mem)
+        add(w_balanced * _balanced_score(
+            total_cpu, total_mem, st.alloc_cpu, st.alloc_mem))
 
     if w_node_aff:
         # NodeAffinityPriority: NormalizeReduce(10, False) over feasible nodes
@@ -918,7 +978,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         aff_max = jnp.max(jnp.where(feasible, aff, 0))
         aff_norm = jnp.where(
             aff_max > 0, MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
-        score = score + w_node_aff * aff_norm
+        add(w_node_aff * aff_norm)
 
     if w_taint:
         # TaintTolerationPriority: NormalizeReduce(10, True) over feasible nodes
@@ -928,19 +988,19 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
             intol_max > 0,
             MAX_PRIORITY - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
             MAX_PRIORITY)
-        score = score + w_taint * taint_norm
+        add(w_taint * taint_norm)
 
     if w_avoid:
-        score = score + st.avoid_score[x.avoid_id] * w_avoid
+        add(st.avoid_score[x.avoid_id] * w_avoid)
 
     if label_prio_on:
         # NodeLabel/LabelPreference priorities: static pre-weighted rows
-        score = score + st.label_prio
+        add(st.label_prio)
 
     if ps is not None and ps.w_image:
         # ImageLocalityPriority (image_locality.go): static per
         # (pod-image-set, node) score row
-        score = score + st.image_score[x.img_id] * ps.w_image
+        add(st.image_score[x.img_id] * ps.w_image)
 
     if ps is not None and ps.saa_weights:
         # ServiceAntiAffinity (selector_spreading.go:176-280): spread the
@@ -954,6 +1014,9 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
                    carry.presence.astype(jnp.float64)).astype(jnp.int64)  # [N]
         saa_fcnt = jnp.where(feasible, saa_cnt, 0)
         saa_total = jnp.sum(saa_fcnt)
+        # entries accumulate into ONE explain part (integer adds: regrouping
+        # the per-entry additions into a single term is exact)
+        saa_term = jnp.zeros_like(score)
         for e, w_saa in enumerate(ps.saa_weights):
             dom = st.saa_dom[e]
             labeled = dom > 0
@@ -965,7 +1028,8 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
                 (MAX_PRIORITY * (saa_total - grp[dom]))
                 // jnp.maximum(saa_total, 1),
                 MAX_PRIORITY)
-            score = score + jnp.where(labeled, f_score, 0) * w_saa
+            saa_term = saa_term + jnp.where(labeled, f_score, 0) * w_saa
+        add(saa_term)
 
     if config.has_services and w_spread:
         # SelectorSpreadPriority (selector_spreading.go:66-175): per-node count
@@ -994,7 +1058,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         blend = (MAX_PRIORITY
                  * (node_num * zone_den + 2 * zone_num * node_den)
                  ) // (3 * node_den * zone_den)
-        score = score + jnp.where(have_zones & zvalid, blend, plain) * w_spread
+        add(jnp.where(have_zones & zvalid, blend, plain) * w_spread)
 
     if config.has_interpod and w_interpod:
         # InterPodAffinityPriority (interpod_affinity.go:118+): float64 counts
@@ -1032,9 +1096,9 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         ip = jnp.where(rng > 0,
                        (MAX_PRIORITY * (counts_i - minc)) // jnp.maximum(rng, 1),
                        0)
-        score = score + ip * w_interpod
+        add(ip * w_interpod)
 
-    return feasible, reason_bits, score, n_feasible, aca_counts
+    return feasible, reason_bits, score, n_feasible, aca_counts, parts
 
 
 def _select(feasible, score, n_feasible, rr):
@@ -1076,8 +1140,8 @@ def make_step(config: EngineConfig):
 
     def step(state: tuple, x: PodX):
         carry, st = state
-        feasible, reason_bits, score, n_feasible, aca_counts = _evaluate(
-            config, carry, st, x)
+        feasible, reason_bits, score, n_feasible, aca_counts, parts = \
+            _evaluate(config, carry, st, x)
         choice, found = _select(feasible, score, n_feasible, carry.rr)
         rr_next = carry.rr + jnp.where(n_feasible > 1, 1, 0)
 
@@ -1138,6 +1202,22 @@ def make_step(config: EngineConfig):
             (lambda: _reason_histogram(reason_bits, config.num_reason_bits)))
         # advanced: selectHost consumed the rr counter for this pod — lets the
         # preemption hybrid (jaxe/preempt.py) resume rr mid-batch on re-dispatch
+        if config.explain_k > 0:
+            # explain lanes: top-k candidates by final score with per-part
+            # contributions; infeasible nodes masked far below any real
+            # score so padding rows decode as EXPLAIN_SENTINEL
+            k = min(config.explain_k, score.shape[0])
+            masked_sc = jnp.where(feasible, score,
+                                  jnp.asarray(2 * EXPLAIN_SENTINEL,
+                                              dtype=score.dtype))
+            top_scores, top_idx = jax.lax.top_k(masked_sc, k)
+            if parts:
+                parts_mat = jnp.stack(parts)              # [C, N]
+                top_parts = parts_mat[:, top_idx].T       # [k, C]
+            else:
+                top_parts = jnp.zeros((k, 0), dtype=score.dtype)
+            return (new_carry, st), (choice, counts, n_feasible > 1,
+                                     top_idx, top_scores, top_parts)
         return (new_carry, st), (choice, counts, n_feasible > 1)
 
     return step
@@ -1146,6 +1226,12 @@ def make_step(config: EngineConfig):
 def _schedule_scan_impl(config: EngineConfig, carry: Carry, statics: Statics,
                         xs: PodX):
     step = make_step(config)
+    if config.explain_k > 0:
+        (final_carry, _), (choices, counts, advanced, top_idx, top_scores,
+                           top_parts) = jax.lax.scan(
+            step, (carry, statics), xs, unroll=config.scan_unroll)
+        return (final_carry, choices, counts, advanced,
+                (top_idx, top_scores, top_parts))
     (final_carry, _), (choices, counts, advanced) = jax.lax.scan(
         step, (carry, statics), xs, unroll=config.scan_unroll)
     return final_carry, choices, counts, advanced

@@ -1,0 +1,231 @@
+"""Decision provenance: structured why/why-not records per pod (ISSUE 13).
+
+The reference simulator's product is an *explained* placement report —
+per-pod success/failure with per-predicate reasons. The device path
+already computes everything needed: the fused scan emits a per-pod
+reason-bit histogram for failures, and `decode_placements` renders it
+into `Placement.message` with text byte-identical to the host path's
+`FitError.Error()`. This module captures those decoded decisions —
+optionally enriched with the top-k score breakdown lanes the scan emits
+under `EngineConfig.explain_k` — into:
+
+- a bounded in-memory ring (`/debug/provenance` on the obs server), and
+- an append-only JSONL file (`--explain-out`), queryable offline with
+  `tpusim explain`.
+
+Record schema (one JSON object per line; see DEVIATIONS.md):
+
+    {"seq": 17, "source": "stream", "cycle": 3,
+     "pod": "default/pod-41", "placed": false,
+     "reason": "Unschedulable",
+     "message": "0/9 nodes are available: 3 Insufficient cpu, ..."}
+
+    {"seq": 18, "source": "backend", "pod": "default/pod-42",
+     "placed": true, "node": "node-7",
+     "top_k": [{"node": "node-7", "score": 13,
+                "parts": {"LeastRequestedPriority": 6, ...}}, ...]}
+
+Capture is deliberately lazy: `capture_batch` stores REFERENCES to the
+already-built Placement list (and the device top-k arrays, when
+present) and defers all string/dict assembly to export/query time, so
+the hot scheduling loop pays one lock + one append per batch — the <2%
+overhead budget bench configs 9/10 stamp. Zero-cost when disabled: call
+sites hold a module-level None-check, exactly like the flight recorder.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from tpusim.framework.metrics import register
+
+
+class _Batch:
+    __slots__ = ("placements", "source", "cycle", "ts", "seq0", "topk")
+
+    def __init__(self, placements, source, cycle, ts, seq0, topk):
+        self.placements = placements
+        self.source = source
+        self.cycle = cycle
+        self.ts = ts
+        self.seq0 = seq0
+        self.topk = topk
+
+
+def _pod_name(pod) -> str:
+    ns = pod.metadata.namespace or "default"
+    return f"{ns}/{pod.metadata.name}"
+
+
+def _decode_topk(topk: Dict[str, Any], i: int) -> List[Dict[str, Any]]:
+    """Render pod i's top-k candidate rows; rows at the sentinel score are
+    padding from fewer-than-k feasible nodes and are dropped."""
+    names = topk["names"]
+    part_names = topk["part_names"]
+    sentinel = topk["sentinel"]
+    out: List[Dict[str, Any]] = []
+    idx = np.asarray(topk["idx"][i])
+    scores = np.asarray(topk["scores"][i])
+    parts = np.asarray(topk["parts"][i]) if topk["parts"] is not None else None
+    for r in range(idx.shape[0]):
+        score = int(scores[r])
+        if score <= sentinel:
+            continue
+        row: Dict[str, Any] = {"node": names[int(idx[r])], "score": score}
+        if parts is not None and part_names:
+            row["parts"] = {part_names[j]: int(parts[r][j])
+                            for j in range(len(part_names))}
+        out.append(row)
+    return out
+
+
+def decode_batch(batch: _Batch) -> List[Dict[str, Any]]:
+    """One Placement list -> provenance record dicts (the lazy half)."""
+    records: List[Dict[str, Any]] = []
+    for i, pl in enumerate(batch.placements):
+        rec: Dict[str, Any] = {"seq": batch.seq0 + i, "ts": batch.ts,
+                               "source": batch.source}
+        if batch.cycle is not None:
+            rec["cycle"] = batch.cycle
+        rec["pod"] = _pod_name(pl.pod)
+        if pl.node_name:
+            rec["placed"] = True
+            rec["node"] = pl.node_name
+            if batch.topk is not None:
+                rec["top_k"] = _decode_topk(batch.topk, i)
+        else:
+            rec["placed"] = False
+            rec["reason"] = pl.reason or "Unschedulable"
+            rec["message"] = pl.message
+        records.append(rec)
+    return records
+
+
+class ProvenanceLog:
+    """Bounded ring of recent decision batches + optional JSONL sink.
+
+    capacity: max PODS (records) retained in the ring; oldest batches
+        fall off whole. path: append-target for `--explain-out` (written
+        on flush()/close(), formatted lazily). top_k: the score-breakdown
+        depth the caller asked the engine for (advertised so backends can
+        read one place; 0 = failures-only provenance).
+    """
+
+    def __init__(self, capacity: int = 4096, top_k: int = 0,
+                 path: Optional[str] = None):
+        self.capacity = max(1, int(capacity))
+        self.top_k = int(top_k)
+        self.path = path
+        self._ring: Deque[_Batch] = deque()
+        self._ring_pods = 0
+        self._pending: List[_Batch] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._file = open(path, "a") if path is not None else None
+
+    # -- capture (hot path) ------------------------------------------------
+
+    def capture_batch(self, placements, source: str,
+                      cycle: Optional[int] = None,
+                      topk: Optional[Dict[str, Any]] = None) -> None:
+        if not placements:
+            return
+        batch = _Batch(placements, source, cycle, round(time.time(), 3),
+                       0, topk)
+        with self._lock:
+            batch.seq0 = self._seq
+            self._seq += len(placements)
+            self._ring.append(batch)
+            self._ring_pods += len(placements)
+            while self._ring_pods > self.capacity and len(self._ring) > 1:
+                self._ring_pods -= len(self._ring.popleft().placements)
+            if self._file is not None:
+                self._pending.append(batch)
+        register().provenance_records.inc(len(placements))
+
+    # -- query / export (cold path) ----------------------------------------
+
+    def tail(self, limit: int = 100) -> List[Dict[str, Any]]:
+        """Most recent `limit` records, decoded (the /debug/provenance
+        body), oldest first."""
+        with self._lock:
+            batches = list(self._ring)
+        records: List[Dict[str, Any]] = []
+        for batch in reversed(batches):
+            records[:0] = decode_batch(batch)
+            if len(records) >= limit:
+                break
+        return records[-limit:]
+
+    def flush(self) -> None:
+        """Format + append pending batches to the JSONL sink."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if self._file is None or not pending:
+            return
+        lines = []
+        for batch in pending:
+            for rec in decode_batch(batch):
+                lines.append(json.dumps(rec, sort_keys=True,
+                                        separators=(",", ":")))
+        self._file.write("\n".join(lines) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        self.flush()
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+# -- module-level active log (mirrors recorder.install) -------------------
+
+_active: Optional[ProvenanceLog] = None
+
+
+def install(log: ProvenanceLog) -> ProvenanceLog:
+    global _active
+    _active = log
+    return log
+
+
+def uninstall() -> None:
+    global _active
+    if _active is not None:
+        _active.close()
+    _active = None
+
+
+def get_log() -> Optional[ProvenanceLog]:
+    return _active
+
+
+def capture(placements, source: str, cycle: Optional[int] = None,
+            topk: Optional[Dict[str, Any]] = None) -> None:
+    """Capture one decoded batch; no-op (one None-check) when disabled."""
+    log = _active
+    if log is not None:
+        log.capture_batch(placements, source, cycle=cycle, topk=topk)
+
+
+def requested_top_k() -> int:
+    """The explain depth the active log asked for (0 when disabled or
+    failures-only) — backends read this to decide whether to pay for the
+    score-breakdown lanes."""
+    log = _active
+    return log.top_k if log is not None else 0
+
+
+def read_jsonl(path: str) -> Iterator[Dict[str, Any]]:
+    """Stream records back from an --explain-out file (tpusim explain)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield json.loads(line)

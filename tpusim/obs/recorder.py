@@ -28,7 +28,8 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from tpusim.framework import metrics as _metrics
 
@@ -91,13 +92,33 @@ class Span:
 
 
 class FlightRecorder:
-    """Collects complete ('X') and instant ('i') trace events in memory."""
+    """Collects complete ('X') and instant ('i') trace events in memory.
 
-    def __init__(self, clock: Optional[Callable[[], float]] = None):
+    The timeline is a bounded ring (``max_events``): an always-on serve or
+    stream process can run for days without growing host memory without
+    bound. When the ring is full the OLDEST event is dropped and
+    ``tpusim_obs_dropped_events_total`` increments, so an exported trace
+    that lost its head says so on the scrape."""
+
+    DEFAULT_MAX_EVENTS = 262_144
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 max_events: Optional[int] = None):
         self.clock: Callable[[], float] = clock or time.perf_counter
         self._epoch = self.clock()
-        self.events: List[Dict[str, Any]] = []
+        self.max_events = (self.DEFAULT_MAX_EVENTS if max_events is None
+                           else max(1, int(max_events)))
+        self.events: Deque[Dict[str, Any]] = deque(maxlen=self.max_events)
+        self.dropped = 0
         self._lock = threading.Lock()
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        # caller holds no lock; the ring drop + counter stay consistent
+        with self._lock:
+            if len(self.events) == self.max_events:
+                self.dropped += 1
+                _metrics.register().obs_dropped_events.inc()
+            self.events.append(ev)
 
     # -- timestamps -------------------------------------------------------
     def _ts(self, t: float) -> float:
@@ -120,8 +141,7 @@ class FlightRecorder:
         }
         if span.args:
             ev["args"] = span.args
-        with self._lock:
-            self.events.append(ev)
+        self._append(ev)
 
     def add_span(self, name: str, cat: str, t0: float, t1: float,
                  args: Optional[Dict[str, Any]] = None) -> None:
@@ -137,8 +157,7 @@ class FlightRecorder:
         }
         if args:
             ev["args"] = args
-        with self._lock:
-            self.events.append(ev)
+        self._append(ev)
 
     def instant(self, name: str, cat: str = "host",
                 args: Optional[Dict[str, Any]] = None) -> None:
@@ -153,8 +172,7 @@ class FlightRecorder:
         }
         if args:
             ev["args"] = args
-        with self._lock:
-            self.events.append(ev)
+        self._append(ev)
 
     # -- export -----------------------------------------------------------
     def to_chrome(self) -> Dict[str, Any]:
@@ -361,6 +379,17 @@ def note_stream_cycle(path: str, pods: Optional[int] = None) -> None:
     if rec is not None:
         rec.instant("stream:" + path, "device",
                     {"pods": pods} if pods is not None else None)
+
+
+def note_slo(event: str, args: Optional[Dict[str, Any]] = None) -> None:
+    """An SLO burn-rate threshold crossing (obs.slo): burn_start when the
+    windowed burn rate rises to/above the alerting threshold, burn_end when
+    it falls back under. The live burn rate itself is the
+    tpusim_slo_burn_rate gauge; these instants put the crossings on the
+    trace timeline."""
+    rec = _active
+    if rec is not None:
+        rec.instant("slo:" + event, "host", args)
 
 
 def note_watch_overflow(resource: str) -> None:

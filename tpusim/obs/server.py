@@ -1,0 +1,133 @@
+"""Live telemetry plane: the in-process HTTP endpoint (ISSUE 13).
+
+`tpusim serve --listen HOST:PORT` and `tpusim stream --listen HOST:PORT`
+start one of these on a daemon thread next to the runtime:
+
+- `GET /metrics`  — the metrics registry in Prometheus/OpenMetrics text
+  exposition format, rendered under the registry-level read lock so one
+  scrape is a consistent snapshot.
+- `GET /healthz`  — JSON liveness: breaker state (HTTP 503 while the
+  device-dispatch breaker is OPEN), WAL record count, checkpoint
+  freshness, admission-queue depth, SLO burn rate.
+- `GET /debug/provenance` — the ring of recent decision-provenance
+  records (`?limit=N`, default 100), JSON.
+
+Stdlib-only (http.server): the container bakes no HTTP framework, and a
+scrape endpoint needs none. The handler reads shared state exclusively
+through the metrics registry and the provenance ring — it holds no
+reference to the runtime, so serve/stream/tests all wire it identically.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from tpusim.framework.metrics import register
+from tpusim.obs import provenance
+
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def health_payload() -> Tuple[int, dict]:
+    """(http_status, body) for /healthz — 503 while the breaker is open."""
+    reg = register()
+    breaker = reg.breaker_state.value
+    body = {
+        "status": "breaker_open" if breaker >= 1.0 else "ok",
+        "breaker_state": breaker,
+        "wal_records": reg.recovery_wal_records.value,
+        "queue_depth": reg.serve_queue_depth.value,
+        "slo_burn_rate": reg.slo_burn_rate.value,
+    }
+    ckpt_ts = reg.recovery_last_checkpoint_timestamp.value
+    body["checkpoint_age_s"] = (round(max(0.0, time.time() - ckpt_ts), 3)
+                                if ckpt_ts else None)
+    chain = reg.stream_chain_head
+    if chain.value:
+        body["chain_head"] = dict(chain.labels)
+    return (503 if breaker >= 1.0 else 200), body
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def _send(self, status: int, content_type: str, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        parsed = urlparse(self.path)
+        if parsed.path == "/metrics":
+            text = register().expose()
+            self._send(200, METRICS_CONTENT_TYPE, text.encode())
+        elif parsed.path == "/healthz":
+            status, body = health_payload()
+            self._send(status, "application/json",
+                       (json.dumps(body, sort_keys=True) + "\n").encode())
+        elif parsed.path == "/debug/provenance":
+            try:
+                limit = int(parse_qs(parsed.query).get("limit", ["100"])[0])
+            except ValueError:
+                limit = 100
+            log = provenance.get_log()
+            records = log.tail(limit) if log is not None else []
+            self._send(200, "application/json",
+                       (json.dumps(records) + "\n").encode())
+        else:
+            self._send(404, "text/plain; charset=utf-8", b"not found\n")
+
+    def log_message(self, fmt: str, *args: object) -> None:
+        # scrapes every few seconds would flood stderr; stay quiet
+        pass
+
+
+class ObsServer:
+    """The telemetry endpoint on a daemon thread; `address` is the bound
+    (host, port) — pass port 0 to let the OS pick (tests do)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ObsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="tpusim-obs", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def parse_listen(spec: str) -> Tuple[str, int]:
+    """'HOST:PORT' | ':PORT' | 'PORT' -> (host, port) for --listen."""
+    spec = spec.strip()
+    if ":" in spec:
+        host, _, port = spec.rpartition(":")
+        return host or "127.0.0.1", int(port)
+    return "127.0.0.1", int(spec)
+
+
+def start_server(listen: str) -> ObsServer:
+    host, port = parse_listen(listen)
+    return ObsServer(host, port).start()

@@ -57,6 +57,7 @@ from tpusim.jaxe.sharding import (
     scenario_shardings,
     stage_tree,
 )
+from tpusim.obs import provenance
 from tpusim.obs.recorder import (
     note_serve,
     note_serve_degraded,
@@ -304,6 +305,9 @@ class ServeExecutor:
             results = [decode_one(e.request.pods, e.staged.compiled,
                                   choices_b[i], counts_b[i])
                        for i, e in enumerate(bucket.entries)]
+        if provenance.get_log() is not None:
+            for r in results:
+                provenance.capture(r.placements, "serve")
         return results, warm
 
     # -- chaos-hardened dispatch ------------------------------------------
@@ -322,6 +326,7 @@ class ServeExecutor:
             results.append(WhatIfResult(
                 placements=placements, scheduled=scheduled,
                 unschedulable=len(placements) - scheduled))
+            provenance.capture(placements, "serve_host")
         return results
 
     def _degraded(self, bucket: Bucket,

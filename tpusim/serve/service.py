@@ -28,6 +28,7 @@ from typing import Callable, List, Optional, Sequence
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.framework.metrics import register
+from tpusim.obs import slo
 from tpusim.obs.recorder import note_serve, note_serve_retry, span
 from tpusim.serve.batcher import Bucket, PendingEntry, ShapeClassBatcher
 from tpusim.serve.executor import ServeExecutor
@@ -183,6 +184,7 @@ class ScenarioFleet:
         for entry, result in zip(bucket.entries, results):
             latency = now - entry.admitted_at
             reg.serve_request_latency.observe(latency * 1e6)
+            slo.observe_cycle("serve", latency * 1e6)
             if not entry.future.done():
                 entry.future.set_result(WhatIfResponse(
                     request_id=entry.request.request_id, result=result,

@@ -938,6 +938,11 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         h = placement_hash(placements)
         chain.update(h.encode())
         fold_chain = chain_fold(fold_chain, h)
+        if persist is None:
+            # with persistence attached log_emit publishes the WAL chain
+            # head instead; don't fight it with the in-memory fold
+            register_metrics().stream_chain_head.set_info(
+                head=fold_chain, cycle=str(session.cycles))
         if verify and expected_hashes.pop(0) != h:
             mismatches += 1
 

@@ -62,7 +62,7 @@ import json
 import os
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from time import perf_counter
+from time import perf_counter, time as wall_time
 from typing import Dict, List, Optional, Tuple
 
 from tpusim.api.snapshot import ClusterSnapshot
@@ -274,6 +274,8 @@ class StreamPersistence:
         self.decisions += len(placements)
         self.scheduled += s
         self.cycles_emitted += 1
+        register().stream_chain_head.set_info(head=self.chain,
+                                              cycle=str(cid))
         self._append({"k": "emit", "c": cid, "h": h,
                       "n": len(placements), "s": s}, "emit", cid)
         if self.checkpoint_every \
@@ -344,6 +346,7 @@ class StreamPersistence:
                 sp.set("wal_records", self.wal_records)
         register().recovery_checkpoint_latency.observe(
             since_in_microseconds(t0))
+        register().recovery_last_checkpoint_timestamp.set(wall_time())
         self.checkpoints += 1
         flight.note_recovery("checkpoint", {"cycle": self.cycles_emitted,
                                             "wal_records": self.wal_records})

@@ -105,7 +105,9 @@ from tpusim.jaxe.policyc import (
 )
 from tpusim.jaxe.sharding import stage_tree
 from tpusim.jaxe.state import NUM_FIXED_BITS, reason_strings
+from tpusim.obs import provenance
 from tpusim.obs import recorder as flight
+from tpusim.obs import slo
 
 log = logging.getLogger(__name__)
 
@@ -770,6 +772,11 @@ class StreamSession:
         with flight.span("decode_placements"):
             placements, _ = _backend.decode_placements(
                 pods, choices, counts, compiled.statics.names, strings)
+        # decision provenance (ISSUE 13): capture the decoded batch only —
+        # no EngineConfig change, so residency/donation and the restage
+        # classification are untouched (failure text is already the
+        # byte-identical FitError rendering from decode_placements)
+        provenance.capture(placements, "stream", cycle=self.cycles)
         return final_carry, placements, corrupt_kind is not None
 
     def _host_cycle(self, pods: List[Pod], reason: str) -> List[Placement]:
@@ -782,11 +789,13 @@ class StreamSession:
         return placements
 
     def _reference(self, pods: List[Pod]) -> List[Placement]:
-        return ReferenceBackend(
+        placements = ReferenceBackend(
             provider=self.provider,
             hard_pod_affinity_symmetric_weight=self.hard_weight,
             policy=self.policy,
         ).schedule(pods, self.inc.to_snapshot())
+        provenance.capture(placements, "stream_host", cycle=self.cycles)
+        return placements
 
     # -- pipelined execution ----------------------------------------------
 
@@ -908,6 +917,9 @@ class StreamSession:
                 p.pods, p.choices, counts, p.compiled.statics.names, strings,
                 prebound=p.bound)
         p.placements = placements
+        provenance.capture(placements, "stream",
+                           cycle=p.wal_cycle if p.wal_cycle is not None
+                           else self.cycles)
         self._note_path("pipelined", len(p.pods))
         if self.persist is not None and p.wal_cycle is not None:
             self.persist.log_emit(p.wal_cycle, placements)
@@ -957,3 +969,4 @@ class StreamSession:
         m = register()
         m.e2e_scheduling_latency.observe(us)
         m.stream_cycle_latency.observe(path, us)
+        slo.observe_cycle(path, us)

@@ -927,6 +927,8 @@ LADDER_CONFIGS = {
                      autoladder=True),
     11: LadderConfig(lambda p, b, c: measure_recovery(p),
                      autoladder=True),
+    12: LadderConfig(lambda p, b, c: measure_analytics_overhead(p),
+                     autoladder=True),
 }
 
 
@@ -1603,6 +1605,82 @@ def measure_recovery(platform: str) -> dict:
             storm_rate / max(clean_rate, 1e-9), 3),
         "serve_degraded_responses": degraded,
         "serve_all_answered": all(r.ok for r in storm_responses),
+        "metrics": _metrics_snapshot(reset=True),
+    }
+
+
+def _analytics_overhead(run_fn) -> dict:
+    """A/B the cluster-analytics capture cost at one representative shape
+    (ISSUE 14 budgets <2%): the identical workload with and without an
+    installed ClusterAnalytics. The hot-path cost every dispatch pays is
+    one extra jitted reduction launch over the scan's final carry plus a
+    lock + reference append; decode, ratio math, and JSONL formatting are
+    all deferred off the cycle loop (scrape/snapshot time)."""
+    from tpusim.obs import analytics
+
+    # best-of-3 per arm: the workload's run-to-run jitter on a contended
+    # CPU host is ~10%, an order of magnitude above the budget under test
+    off = max(run_fn()["decisions_per_s"] for _ in range(3))
+    analytics.install(analytics.ClusterAnalytics(capacity=512))
+    try:
+        run_fn()  # absorb the reduction's one-time trace+compile
+        on = max(run_fn()["decisions_per_s"] for _ in range(3))
+        sample = analytics.get().latest()
+    finally:
+        analytics.uninstall()
+    delta = (off - on) / max(off, 1e-9)
+    return {
+        "off_decisions_per_s": round(off, 1),
+        "on_decisions_per_s": round(on, 1),
+        "overhead_fraction": round(delta, 4),
+        "within_budget": delta < 0.02,
+        "sample": sample,
+    }
+
+
+def measure_analytics_overhead(platform: str) -> dict:
+    """Config 12: analytics-plane overhead on the config-9 stream churn
+    workload. The contract under test is 'zero cost when disabled, <2%
+    when enabled': the off arm is plain config-9 steady state, the on arm
+    runs the identical seeded churn with the post-scan reduction capturing
+    every cycle. Placement chains must match between the arms — the
+    reduction never touches the scan program."""
+    from tpusim.simulator import run_stream_simulation
+
+    cycles, arrivals = (40, 64) if platform != "cpu" else (24, 64)
+    nodes = 4_000 if platform != "cpu" else 800
+
+    def run():
+        return run_stream_simulation(num_nodes=nodes, cycles=cycles,
+                                     arrivals=arrivals, evict_fraction=0.25,
+                                     seed=9)
+
+    run_stream_simulation(num_nodes=nodes, cycles=3, arrivals=arrivals,
+                          evict_fraction=0.25, seed=9)  # absorb tracing
+    overhead = _analytics_overhead(run)
+    log(f"[config 12] analytics capture overhead: "
+        f"{overhead['overhead_fraction'] * 100:.2f}% "
+        f"(within_budget={overhead['within_budget']})")
+
+    off_chain = run()["placement_chain"]
+    from tpusim.obs import analytics
+    analytics.install(analytics.ClusterAnalytics(capacity=512))
+    try:
+        on_chain = run()["placement_chain"]
+    finally:
+        analytics.uninstall()
+
+    return {
+        "metric": f"analytics-on churn decisions/sec (config 12: cluster "
+                  f"analytics A/B on the config-9 stream workload, {nodes} "
+                  f"nodes, {arrivals} arrivals + 25% evictions per cycle, "
+                  f"platform={platform})",
+        "value": overhead["on_decisions_per_s"], "unit": "decisions/s",
+        "vs_baseline": 0,
+        "analytics_overhead": {k: v for k, v in overhead.items()
+                               if k != "sample"},
+        "sample": overhead["sample"],
+        "chains_identical": on_chain == off_chain,
         "metrics": _metrics_snapshot(reset=True),
     }
 

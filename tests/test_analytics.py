@@ -1,0 +1,402 @@
+"""Cluster analytics plane (ISSUE 14).
+
+The tentpole's correctness bar: the on-device post-scan reduction
+(jaxe/kernels.analytics_reduce) must agree BIT-FOR-BIT with a host-side
+numpy recomputation (obs/analytics.host_reduce) on every captured sample,
+and enabling it must change NOTHING about scheduling — placement hashes,
+stream placement chains, and the cold_start-only restage classification
+are pinned with analytics off and on, across the jax backend, the
+streaming runtime (sync and pipelined), and the serve fleet.
+
+Also pinned: the disabled path costs one None-check (no sample, no
+counter movement); the ring stays bounded and the /analytics +
+/debug/provenance endpoints always serve parseable JSON under concurrent
+readers while a stream session cycles; JSONL export round-trips; the
+`tpusim top` renderer and --json mode work against a live endpoint; and
+the metrics_lint gauge-unit/label-cardinality rules actually fire.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpusim.jaxe import ensure_x64
+
+ensure_x64()
+
+from tpusim.api.snapshot import ClusterSnapshot, make_node, make_pod  # noqa: E402
+from tpusim.backends import placement_hash  # noqa: E402
+from tpusim.jaxe.kernels import AnalyticsIn, analytics_reduce  # noqa: E402
+from tpusim.obs import analytics  # noqa: E402
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_analytics():
+    analytics.uninstall()
+    analytics.reset_compile_costs()
+    yield
+    analytics.uninstall()
+
+
+def _snapshot(n=6):
+    nodes = [make_node(f"n{i}", milli_cpu=4000 + 500 * i,
+                       memory=2**33 + i * 2**30) for i in range(n)]
+    return ClusterSnapshot(nodes=nodes)
+
+
+def _pods(k=12):
+    return [make_pod(f"p{i}", milli_cpu=100 * (1 + i % 5),
+                     memory=(1 + i % 3) * 2**27) for i in range(k)]
+
+
+# -- kernel vs numpy mirror -------------------------------------------------
+
+def _random_inp(rng, n):
+    def col(lo, hi):
+        return rng.integers(lo, hi, size=n).astype(np.int64)
+
+    alloc_cpu = col(0, 8000)          # includes zero-allocatable nodes
+    alloc_mem = col(0, 2**34)
+    used = rng.integers(0, 12000, size=n).astype(np.int64)  # oversubscribed
+    return AnalyticsIn(
+        alloc_cpu=alloc_cpu, alloc_mem=alloc_mem, alloc_gpu=col(0, 8),
+        alloc_eph=col(0, 2**30), allowed_pods=col(0, 110),
+        used_cpu=used, used_mem=col(0, 2**34), used_gpu=col(0, 8),
+        used_eph=col(0, 2**30), pod_count=col(0, 120))
+
+
+@pytest.mark.parametrize("n,n_valid,k", [
+    (1, 1, 1), (4, 4, 2), (16, 16, 8), (16, 9, 8), (32, 32, 40),
+    (8, 0, 3),   # fully padded axis: every key invalid
+])
+def test_reduce_matches_numpy(n, n_valid, k):
+    rng = np.random.default_rng(n * 1000 + n_valid * 10 + k)
+    inp = _random_inp(rng, n)
+    kk = max(1, min(k, n))
+    stats = analytics_reduce(inp, np.int64(n_valid), k=kk)
+    want = analytics.host_reduce(inp, n_valid, kk)
+    for field, expect in want.items():
+        got = np.asarray(getattr(stats, field))
+        assert np.array_equal(got, expect), (
+            f"{field}: device {got.tolist()} != host {expect.tolist()}")
+
+
+def test_reduce_matches_numpy_on_ties():
+    # identical utilization on every node: ordering falls to the tie-break
+    # index term, which must make device top_k and numpy sort agree exactly
+    n = 12
+    same = np.full(n, 4000, dtype=np.int64)
+    inp = AnalyticsIn(
+        alloc_cpu=same.copy(), alloc_mem=same * 2**20,
+        alloc_gpu=np.zeros(n, np.int64), alloc_eph=same.copy(),
+        allowed_pods=np.full(n, 110, np.int64),
+        used_cpu=same // 2, used_mem=same * 2**19,
+        used_gpu=np.zeros(n, np.int64), used_eph=same // 4,
+        pod_count=np.full(n, 7, np.int64))
+    stats = analytics_reduce(inp, np.int64(n), k=5)
+    want = analytics.host_reduce(inp, n, 5)
+    for field, expect in want.items():
+        assert np.array_equal(np.asarray(getattr(stats, field)), expect)
+    decoded = analytics.decode_stats(stats)
+    # tie-break is index-ascending: node 0 ranks first in both directions
+    assert decoded["hot_nodes"][0]["node"] == 0
+    assert decoded["cold_nodes"][0]["node"] == 0
+
+
+def test_decode_stats_shapes():
+    rng = np.random.default_rng(3)
+    inp = _random_inp(rng, 10)
+    stats = analytics_reduce(inp, np.int64(10), k=4)
+    names = [f"n{i}" for i in range(10)]
+    decoded = analytics.decode_stats(stats, names)
+    assert decoded["nodes"]["valid"] == 10
+    assert set(decoded["resources"]) == set(analytics.RESOURCES)
+    for res in decoded["resources"].values():
+        assert res["free"] >= 0 and res["largest_free"] >= 0
+        assert res["fragmentation"] is None or 0.0 <= res["fragmentation"] <= 1.0
+    assert len(decoded["hot_nodes"]) <= 4
+    for entry in decoded["hot_nodes"]:
+        assert entry["node"] in names
+
+
+# -- zero cost when disabled + hash invariance ------------------------------
+
+def test_disabled_is_noop():
+    from tpusim.framework.metrics import register
+
+    assert analytics.get() is None
+    before = register().analytics_samples.value
+    # the production call site: one None-check, nothing else
+    analytics.capture(None, None, 0, "test")
+    assert register().analytics_samples.value == before
+
+
+def test_backend_hash_invariance_and_parity():
+    from tpusim.jaxe.backend import JaxBackend
+
+    snapshot, pods = _snapshot(), _pods()
+    off = placement_hash(JaxBackend().schedule(
+        [p.copy() for p in pods], snapshot))
+    log = analytics.install(analytics.ClusterAnalytics(
+        keep_inputs=True, sample_interval_s=0.0))
+    on = placement_hash(JaxBackend().schedule(
+        [p.copy() for p in pods], snapshot))
+    assert on == off
+    assert log.verify_against_host() == []
+    samples = log.samples()
+    assert samples and all(s.source == "backend" for s in samples)
+
+
+def test_backend_policy_route_parity():
+    from tpusim.backends import get_backend
+    from tpusim.engine.policy import decode_policy
+
+    policy = decode_policy({
+        "apiVersion": "v1", "kind": "Policy",
+        "predicates": [{"name": "PodFitsResources"}],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+    })
+    snapshot, pods = _snapshot(), _pods()
+    off = placement_hash(get_backend("jax", policy=policy).schedule(
+        [p.copy() for p in pods], snapshot))
+    log = analytics.install(analytics.ClusterAnalytics(
+        keep_inputs=True, sample_interval_s=0.0))
+    on = placement_hash(get_backend("jax", policy=policy).schedule(
+        [p.copy() for p in pods], snapshot))
+    assert on == off
+    assert log.verify_against_host() == []
+
+
+def _stream(**kw):
+    from tpusim.simulator import run_stream_simulation
+
+    return run_stream_simulation(num_nodes=16, cycles=6, arrivals=16,
+                                 evict_fraction=0.25, seed=7, **kw)
+
+
+def test_stream_hash_invariance_sync_and_pipelined():
+    off = _stream()
+    assert off["restages"] == {"cold_start": 1}
+    log = analytics.install(analytics.ClusterAnalytics(
+        keep_inputs=True, sample_interval_s=0.0))
+    on = _stream()
+    piped = _stream(pipeline=True)
+    assert on["placement_chain"] == off["placement_chain"]
+    assert piped["placement_chain"] == off["placement_chain"]
+    # analytics rides the final carry: pure churn still restages only once
+    assert on["restages"] == {"cold_start": 1}
+    assert piped["restages"] == {"cold_start": 1}
+    assert log.verify_against_host() == []
+    assert {s.source for s in log.samples()} == {"stream"}
+    # run_stream_simulation folds the snapshot into its summary
+    assert on["analytics"]["enabled"] and on["analytics"]["latest"]
+
+
+def test_serve_capture_parity():
+    from tpusim.serve import ScenarioFleet, WhatIfRequest
+
+    snapshot = _snapshot()
+    log = analytics.install(analytics.ClusterAnalytics(
+        keep_inputs=True, sample_interval_s=0.0))
+    fleet = ScenarioFleet(bucket_size=2, flush_after_s=60.0)
+    [resp] = fleet.run([WhatIfRequest(pods=_pods(5), snapshot=snapshot,
+                                      cache_key="t-analytics")])
+    assert resp.ok
+    assert log.verify_against_host() == []
+    assert {s.source for s in log.samples()} == {"serve"}
+
+
+def test_sample_throttle():
+    from tpusim.jaxe.kernels import analytics_in  # noqa: F401
+
+    log = analytics.install(analytics.ClusterAnalytics(
+        keep_inputs=True, sample_interval_s=3600.0))
+    _stream()
+    # a whole session under a 1h interval lands exactly the first capture
+    assert len(log.samples()) == 1
+
+
+# -- ring bound + endpoints under concurrent readers ------------------------
+
+def test_ring_bounded_and_endpoints_concurrent():
+    from tpusim.obs import provenance
+    from tpusim.obs.server import ObsServer
+
+    provenance.install(provenance.ProvenanceLog(capacity=256))
+    log = analytics.install(analytics.ClusterAnalytics(
+        capacity=8, sample_interval_s=0.0))
+    server = ObsServer().start()
+    failures = []
+    stop = threading.Event()
+
+    def hammer(path, is_json):
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                        f"{server.url}{path}", timeout=5) as resp:
+                    payload = resp.read().decode()
+                if is_json:
+                    json.loads(payload)
+                elif "tpusim_analytics_samples_total" not in payload:
+                    raise AssertionError("scrape missing analytics family")
+            except Exception as exc:  # noqa: BLE001
+                failures.append(f"{path}: {exc!r}")
+                return
+
+    readers = [threading.Thread(target=hammer, args=(p, j), daemon=True)
+               for p, j in (("/analytics?limit=5", True),
+                            ("/debug/provenance?limit=10", True),
+                            ("/metrics", False))]
+    try:
+        for t in readers:
+            t.start()
+        for seed in (7, 8):  # writers: stream cycles racing the readers
+            _stream()
+        assert not failures, failures
+    finally:
+        stop.set()
+        for t in readers:
+            t.join(timeout=5)
+        server.stop()
+        provenance.uninstall()
+    assert len(log.samples()) <= 8          # ring bounded at capacity
+    assert log.snapshot()["samples"] > 8    # ...though more were captured
+    body = log.snapshot()
+    assert body["enabled"] and body["latest"]["source"] == "stream"
+    assert len(log.series(limit=3)) == 3
+
+
+def test_analytics_endpoint_disabled_body():
+    from tpusim.obs.server import ObsServer
+
+    server = ObsServer().start()
+    try:
+        with urllib.request.urlopen(f"{server.url}/analytics",
+                                    timeout=5) as resp:
+            body = json.loads(resp.read().decode())
+    finally:
+        server.stop()
+    assert body["enabled"] is False
+    assert "hbm" in body and "compile" in body
+
+
+# -- JSONL export -----------------------------------------------------------
+
+def test_jsonl_export_roundtrip(tmp_path):
+    path = str(tmp_path / "analytics.jsonl")
+    analytics.install(analytics.ClusterAnalytics(
+        path=path, sample_interval_s=0.0))
+    _stream()
+    analytics.uninstall()  # close() flushes
+    records = analytics.read_jsonl(path)
+    assert records, "no JSONL records written"
+    for rec in records:
+        assert rec["source"] == "stream"
+        assert set(rec["resources"]) == set(analytics.RESOURCES)
+    assert [r["seq"] for r in records] == sorted(r["seq"] for r in records)
+
+
+# -- HBM + compile accounting ----------------------------------------------
+
+def test_hbm_sources_and_compile_counters():
+    class Owner:
+        pass
+
+    owner = Owner()
+    analytics.register_hbm_source("test_component", owner,
+                                  lambda o: (1234, 2))
+    snap = analytics.hbm_snapshot()
+    assert snap["test_component"]["bytes"] >= 1234
+    assert "compiled_executables" in snap
+    del owner  # weakref: the source must drop out, not raise
+    snap = analytics.hbm_snapshot()
+    assert "test_component" not in snap
+
+    analytics.note_compile("testsite", "sig-a", 1500.0)
+    analytics.note_compile("testsite", "sig-a", 500.0)
+    analytics.note_compile("testsite", "sig-b", 100.0)
+    comp = analytics.compile_snapshot()["testsite"]
+    assert comp["traces"] == 3
+    assert comp["total_us"] == pytest.approx(2100.0)
+    assert comp["signatures"]["sig-a"]["traces"] == 2
+
+
+def test_tree_nbytes_never_forces():
+    arr = np.zeros((4, 4), dtype=np.int64)
+    assert analytics.tree_nbytes((arr, [arr], {"x": arr})) == 3 * 128
+    assert analytics.tree_nbytes(None) == 0
+
+
+# -- lint rules (satellite 2) ----------------------------------------------
+
+def _lint(*metrics):
+    import tools.metrics_lint as lint
+
+    class FakeRegistry:
+        def _all(self):
+            return list(metrics)
+
+    return lint.lint_registry(FakeRegistry())
+
+
+def test_lint_flags_unitless_gauge():
+    from tpusim.framework.metrics import Gauge
+
+    problems = _lint(Gauge("tpusim_mystery_level", "h"))
+    assert any("unit suffix" in p for p in problems)
+    assert not _lint(Gauge("tpusim_widget_bytes", "h"))
+
+
+def test_lint_flags_ratio_counter():
+    from tpusim.framework.metrics import Counter
+
+    problems = _lint(Counter("tpusim_fill_ratio", "h"))
+    assert any("_ratio families must be gauges" in p for p in problems)
+
+
+def test_lint_flags_unbounded_label():
+    from tpusim.framework.metrics import LabeledCounter, LabeledGauge
+
+    problems = _lint(LabeledCounter("tpusim_per_node_total", "h", "node"))
+    assert any("unbounded" in p or "bounded-label" in p for p in problems)
+    assert not _lint(LabeledGauge("tpusim_thing_bytes", "h", "component"))
+
+
+def test_lint_registry_clean():
+    import tools.metrics_lint as lint
+    from tpusim.framework.metrics import SchedulerMetrics
+
+    assert lint.lint_registry(SchedulerMetrics()) == []
+
+
+# -- tpusim top -------------------------------------------------------------
+
+def test_top_render_and_json_mode(capsys):
+    from tpusim.cli import _render_top, top_cli
+    from tpusim.obs.server import ObsServer
+
+    analytics.install(analytics.ClusterAnalytics(sample_interval_s=0.0))
+    _stream()
+    server = ObsServer().start()
+    try:
+        assert top_cli([server.url, "--json"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert body["enabled"] is True
+        frame = _render_top(body, server.url)
+        assert "RESOURCE" in frame and "cpu" in frame
+        assert top_cli([server.url, "--once"]) == 0
+        assert "UTIL" in capsys.readouterr().out
+    finally:
+        server.stop()
+
+
+def test_top_unreachable_endpoint():
+    from tpusim.cli import top_cli
+
+    # nothing listens on the discard port: first fetch fails -> exit 2
+    assert top_cli(["127.0.0.1:9", "--json"]) == 2

@@ -13,6 +13,15 @@ reference's metric names verbatim and are grandfathered.
   - histogram families end in a unit suffix (_microseconds / _us /
     _seconds / _bytes) unless explicitly allowlisted as unitless
   - info-style gauges end in `_info`, and only they do
+  - gauge families end in a unit suffix (_bytes / _ratio / _seconds /
+    _microseconds / _us) unless allowlisted as a unitless count/level
+    (ISSUE 14)
+  - `_ratio`- and (non-histogram) `_bytes`-suffixed families must be
+    gauges — a `_ratio` counter or `_bytes` counter is a modelling bug
+  - labeled families may only use label names with a known-finite value
+    set (_BOUNDED_LABELS); per-node/per-pod/per-signature labels on
+    aggregate families are unbounded-cardinality and belong in the
+    /analytics JSON body, not the exposition
 
 Run standalone (`python tools/metrics_lint.py`; exit 1 on findings) or
 through tests/test_metrics.py (tier-1).
@@ -31,15 +40,35 @@ _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*[a-z0-9]$")
 _HIST_UNIT_SUFFIXES = ("_microseconds", "_us", "_seconds", "_bytes")
 # unitless-by-design histograms (counts per bucket, not a measured unit)
 _UNITLESS_HISTOGRAMS = {"tpusim_serve_batch_occupancy"}
+_GAUGE_UNIT_SUFFIXES = ("_bytes", "_ratio", "_seconds", "_microseconds",
+                        "_us")
+# unitless-by-design gauges: dimensionless levels, counts, and rates
+_UNITLESS_GAUGES = {
+    "tpusim_breaker_state",
+    "tpusim_serve_queue_depth",
+    "tpusim_stream_pipeline_depth",
+    "tpusim_stream_overlap_fraction",
+    "tpusim_recovery_wal_records",
+    "tpusim_slo_burn_rate",
+    "tpusim_cluster_feasible_nodes",
+    "tpusim_cluster_nodes",
+    "tpusim_hbm_cache_entries",
+}
+# label names whose value sets are finite by construction; anything else
+# (node names, pod names, plan signatures) is unbounded cardinality
+_BOUNDED_LABELS = {"route", "transition", "path", "reason", "kind",
+                   "resource", "verdict", "component", "site", "tenant"}
 
 
 def lint_registry(registry) -> List[str]:
     """All convention violations in a SchedulerMetrics instance."""
     from tpusim.framework.metrics import (
         Counter,
+        Gauge,
         Histogram,
         InfoGauge,
         LabeledCounter,
+        LabeledGauge,
         LabeledHistogram,
     )
 
@@ -74,6 +103,26 @@ def lint_registry(registry) -> List[str]:
         if isinstance(metric, InfoGauge) != name.endswith("_info"):
             problems.append(f"{name}: the _info suffix is reserved for "
                             "info-style gauges (and required on them)")
+        is_gauge = isinstance(metric, (Gauge, LabeledGauge))
+        if is_gauge and name not in _UNITLESS_GAUGES \
+                and not name.endswith(_GAUGE_UNIT_SUFFIXES):
+            problems.append(
+                f"{name}: gauge families need a unit suffix "
+                f"({'/'.join(_GAUGE_UNIT_SUFFIXES)}) or an allowlist "
+                "entry in tools/metrics_lint.py")
+        if name.endswith("_ratio") and not is_gauge:
+            problems.append(f"{name}: _ratio families must be gauges")
+        if name.endswith("_bytes") and not is_gauge \
+                and not isinstance(metric, (Histogram, LabeledHistogram)):
+            problems.append(f"{name}: _bytes families must be gauges "
+                            "(or histograms)")
+        label = getattr(metric, "label", None)
+        if label is not None and label not in _BOUNDED_LABELS:
+            problems.append(
+                f"{name}: label {label!r} is not in the bounded-label "
+                "allowlist — per-node/per-pod/per-signature breakdowns "
+                "belong in the /analytics JSON body, not the metrics "
+                "exposition (add finite-valued labels to _BOUNDED_LABELS)")
     return problems
 
 

@@ -728,6 +728,77 @@ def run_stream_recover_variant():
         shutil.rmtree(ck_dir, ignore_errors=True)
 
 
+def run_analytics_variant():
+    """Cluster analytics plane (tpusim/obs/analytics) stage-0: with the
+    post-scan reduction riding every dispatch, (a) on-device aggregates
+    must equal a host-side numpy recomputation bit-for-bit for every
+    captured sample across the jax-backend one-shot, the streaming runtime
+    (sync AND pipelined), and the serve fleet; (b) placement hashes /
+    chains must be byte-identical to an analytics-off run — the reduction
+    is a separate dispatch over the scan's final carry, never a change to
+    the scan program; (c) a pure-churn stream session must still classify
+    only the cold start as a restage."""
+    from tpusim.backends import placement_hash
+    from tpusim.jaxe.backend import JaxBackend
+    from tpusim.obs import analytics
+    from tpusim.serve import ScenarioFleet, WhatIfRequest
+    from tpusim.simulator import run_stream_simulation
+
+    def stream(**kw):
+        return run_stream_simulation(num_nodes=16, cycles=6, arrivals=16,
+                                     evict_fraction=0.25, seed=7, **kw)
+
+    snapshot, pods = _base()
+    off_hash = placement_hash(JaxBackend().schedule(
+        [p.copy() for p in pods], snapshot))
+    off_stream = stream()
+
+    # keep_inputs host-copies each reduction's input columns at capture
+    # time (the carry buffers are donated into the next cycle), enabling
+    # the device-vs-numpy replay below
+    log = analytics.install(analytics.ClusterAnalytics(
+        keep_inputs=True, sample_interval_s=0.0))
+    try:
+        on_hash = placement_hash(JaxBackend().schedule(
+            [p.copy() for p in pods], snapshot))
+        on_stream = stream()
+        piped = stream(pipeline=True)
+        serve_pods = [make_pod(f"an-p{i}", milli_cpu=200 * (1 + i % 3),
+                               memory=(1 + i % 2) * 2**27)
+                      for i in range(5)]
+        fleet = ScenarioFleet(bucket_size=2, flush_after_s=60.0)
+        [resp] = fleet.run([WhatIfRequest(pods=serve_pods, snapshot=snapshot,
+                                          cache_key="analytics-smoke")])
+        if not resp.ok:
+            raise AssertionError(f"serve request failed: {resp.error}")
+        mismatches = log.verify_against_host()
+        if mismatches:
+            raise AssertionError(
+                "device aggregates diverge from the numpy recomputation: "
+                + "; ".join(mismatches[:3]))
+        sources = {s.source for s in log.samples()}
+        if not {"backend", "stream", "serve"} <= sources:
+            raise AssertionError(f"missing capture sources: {sorted(sources)}")
+        n_samples = len(log.samples())
+    finally:
+        analytics.uninstall()
+
+    if on_hash != off_hash:
+        raise AssertionError(
+            f"backend placement hash moved with analytics on "
+            f"({on_hash[:16]} != {off_hash[:16]})")
+    if on_stream["placement_chain"] != off_stream["placement_chain"]:
+        raise AssertionError("stream placement chain moved with analytics on")
+    if piped["placement_chain"] != off_stream["placement_chain"]:
+        raise AssertionError(
+            "pipelined placement chain moved with analytics on")
+    if on_stream["restages"] != {"cold_start": 1}:
+        raise AssertionError(
+            f"analytics run restaged beyond the cold start: "
+            f"{on_stream['restages']}")
+    return off_hash[:16], n_samples, sorted(sources)
+
+
 def _write_smoke_trace(recorder):
     """Persist the sweep's flight-recorder trace; never fail the smoke."""
     path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
@@ -907,6 +978,26 @@ def main() -> int:
             print(f"SMOKE stream_recover: OK hash={h} "
                   f"resume_cycle={resume_cycle} wal_records={wal_records} "
                   f"retrace={retrace} ({time.time() - t:.1f}s)", flush=True)
+        if not only or "analytics" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "analytics")
+            try:
+                h, n_samples, sources = run_analytics_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: analytics: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("samples", n_samples)
+            vsp.end()
+            ran += 1
+            print(f"SMOKE analytics: OK hash={h} samples={n_samples} "
+                  f"sources={'+'.join(sources)} "
+                  f"({time.time() - t:.1f}s)", flush=True)
     finally:
         flight.uninstall()
         _write_smoke_trace(recorder)

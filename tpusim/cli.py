@@ -149,6 +149,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "Prometheus text exposition format after the "
                              "run")
     add_explain_flags(parser)
+    parser.add_argument("--analytics-out", default="",
+                        help="Append cluster-analytics samples (reduced "
+                             "on-device from the final scan carry) to this "
+                             "JSONL file")
     return parser
 
 
@@ -184,23 +188,35 @@ def add_obs_flags(parser: argparse.ArgumentParser) -> None:
                              "and tpusim_slo_burn_rate, and drops "
                              "slo:burn_start/_end instants on the flight "
                              "recorder at burn-rate crossings (0: off)")
+    parser.add_argument("--analytics-out", default="",
+                        help="Append cluster-analytics samples (one JSON "
+                             "object per cycle/dispatch: per-resource "
+                             "utilization/fragmentation, feasible-node "
+                             "count, top-k hot/cold nodes, reduced "
+                             "on-device) to this JSONL file")
 
 
 def _arm_observability(args):
     """Install the provenance log, SLO tracker, and telemetry endpoint the
     flags ask for; returns a teardown callable (flushes --explain-out)."""
-    from tpusim.obs import provenance, slo
+    from tpusim.obs import analytics, provenance, slo
 
     server = None
     listen = getattr(args, "listen", "")
     explain_out = getattr(args, "explain_out", "")
     explain_top_k = max(0, getattr(args, "explain_top_k", 0))
     slo_target_ms = getattr(args, "slo_target_ms", 0.0)
+    analytics_out = getattr(args, "analytics_out", "")
     # --listen without --explain-out still arms an in-memory ring so
     # /debug/provenance serves the recent decisions
     if explain_out or explain_top_k or listen:
         provenance.install(provenance.ProvenanceLog(
             top_k=explain_top_k, path=explain_out or None))
+    # likewise --listen alone arms the analytics ring so /analytics (and
+    # `tpusim top` against this endpoint) serves live samples
+    if analytics_out or listen:
+        analytics.install(analytics.ClusterAnalytics(
+            path=analytics_out or None))
     if slo_target_ms and slo_target_ms > 0:
         slo.install(slo.SloTracker(slo_target_ms * 1000.0))
     if listen:
@@ -209,11 +225,17 @@ def _arm_observability(args):
         server = start_server(listen)
         host, port = server.address
         print(f"telemetry: listening on http://{host}:{port} "
-              "(/metrics /healthz /debug/provenance)", file=sys.stderr)
+              "(/metrics /healthz /debug/provenance /analytics)",
+              file=sys.stderr)
 
     def teardown() -> None:
         if provenance.get_log() is not None:
             provenance.uninstall()   # close() flushes --explain-out
+        if analytics.get() is not None:
+            # pin the final sample into the tpusim_cluster_* gauges so a
+            # post-teardown --metrics-out dump carries it
+            analytics.refresh_gauges()
+            analytics.uninstall()    # close() flushes --analytics-out
         if slo.get_tracker() is not None:
             slo.uninstall()
         if server is not None:
@@ -640,7 +662,11 @@ def _write_metrics(path: str) -> None:
     """Dump the registry in Prometheus text exposition format (the scrape
     body the reference never served; framework/metrics.py docstring)."""
     from tpusim.framework.metrics import register
+    from tpusim.obs import analytics
 
+    # fold the latest analytics sample + HBM sources into the gauges,
+    # exactly like a live /metrics scrape does
+    analytics.refresh_gauges()
     with open(path, "w") as f:
         f.write(register().expose())
 
@@ -978,6 +1004,141 @@ def explain_cli(argv) -> int:
     return 0
 
 
+def build_top_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusim top",
+        description="Live cluster view against a running --listen "
+                    "endpoint: per-resource utilization/fragmentation, "
+                    "feasible nodes, hottest/coldest nodes, HBM residency "
+                    "and compile cost (rendered from GET /analytics)")
+    parser.add_argument("endpoint",
+                        help="A --listen endpoint: http://HOST:PORT, "
+                             "HOST:PORT, ':PORT', or 'PORT'")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="Seconds between refreshes (default 2)")
+    parser.add_argument("--iterations", type=int, default=0,
+                        help="Render this many frames then exit "
+                             "(0: until interrupted)")
+    parser.add_argument("--once", action="store_true",
+                        help="Render a single frame without clearing the "
+                             "screen and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="Print one raw /analytics JSON body and exit")
+    return parser
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _render_top(body: dict, url: str) -> str:
+    """One `tpusim top` frame from a /analytics body."""
+    lines = [f"tpusim top — {url}   samples={body.get('samples', 0)}"]
+    latest = body.get("latest")
+    if not body.get("enabled"):
+        lines.append("analytics plane not armed on this endpoint "
+                     "(start the session with --listen or --analytics-out)")
+    elif latest is None:
+        lines.append("no samples yet (waiting for the first cycle)")
+    else:
+        where = latest.get("source", "?")
+        if latest.get("cycle") is not None:
+            where += f" c{latest['cycle']}"
+        nodes = latest.get("nodes", {})
+        lines.append(f"nodes: {nodes.get('valid', '?')} valid, "
+                     f"{nodes.get('feasible', '?')} feasible "
+                     f"(cpu+mem+pod headroom)   [latest: {where}]")
+        lines.append(f"{'RESOURCE':<10} {'UTIL':>7} {'FRAG':>7} "
+                     f"{'REQUESTED':>14} {'ALLOCATABLE':>14} "
+                     f"{'LARGEST-FREE':>13}")
+        for name, row in latest.get("resources", {}).items():
+            util = row.get("utilization")
+            util_s = f"{util * 100:.1f}%" if util is not None else "-"
+            frag_s = f"{row.get('fragmentation', 0.0) * 100:.1f}%"
+            lines.append(f"{name:<10} {util_s:>7} {frag_s:>7} "
+                         f"{row.get('requested', 0):>14} "
+                         f"{row.get('allocatable', 0):>14} "
+                         f"{row.get('largest_free', 0):>13}")
+        for label, key in (("hottest", "hot_nodes"),
+                           ("coldest", "cold_nodes")):
+            entries = latest.get(key) or []
+            if entries:
+                lines.append(f"{label}: " + "  ".join(
+                    f"{e['node']} {e['utilization_ppm'] / 10_000:.1f}%"
+                    for e in entries[:5]))
+    hbm = body.get("hbm") or {}
+    if hbm:
+        lines.append("hbm: " + "  ".join(
+            f"{comp} {_fmt_bytes(slot.get('bytes', 0))}"
+            f"/{slot.get('entries', 0)} entries"
+            for comp, slot in sorted(hbm.items())))
+    comp = body.get("compile") or {}
+    if comp:
+        lines.append("compile: " + "  ".join(
+            f"{site} {slot.get('traces', 0)} traces "
+            f"{slot.get('total_us', 0.0) / 1e6:.2f}s"
+            for site, slot in sorted(comp.items())))
+    return "\n".join(lines)
+
+
+def top_cli(argv) -> int:
+    """`tpusim top`: live analytics view against a --listen endpoint."""
+    import json
+    import time as _time
+    from urllib.error import URLError
+    from urllib.request import urlopen
+
+    args = build_top_parser().parse_args(argv)
+    endpoint = args.endpoint.strip()
+    if endpoint.startswith("http://") or endpoint.startswith("https://"):
+        url = endpoint.rstrip("/")
+    else:
+        from tpusim.obs.server import parse_listen
+
+        try:
+            host, port = parse_listen(endpoint)
+        except ValueError:
+            print(f"error: bad endpoint {endpoint!r}", file=sys.stderr)
+            return 2
+        url = f"http://{host}:{port}"
+
+    def fetch() -> dict:
+        with urlopen(f"{url}/analytics?limit=1", timeout=5) as resp:
+            return json.loads(resp.read().decode())
+
+    frames = 0
+    try:
+        while True:
+            try:
+                body = fetch()
+            except (URLError, OSError, ValueError) as exc:
+                if frames:
+                    print(f"endpoint gone ({exc}); exiting", file=sys.stderr)
+                    return 0
+                print(f"error: cannot reach {url}/analytics: {exc}",
+                      file=sys.stderr)
+                return 2
+            if args.json:
+                print(json.dumps(body, sort_keys=True))
+                return 0
+            frame = _render_top(body, url)
+            if not args.once and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H" + frame, flush=True)
+            else:
+                print(frame, flush=True)
+            frames += 1
+            if args.once or (args.iterations and frames >= args.iterations):
+                return 0
+            _time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -987,6 +1148,8 @@ def main(argv=None) -> int:
         return stream_cli(argv[1:])
     if argv and argv[0] == "explain":
         return explain_cli(argv[1:])
+    if argv and argv[0] == "top":
+        return top_cli(argv[1:])
     args = build_parser().parse_args(argv)
     feature_gates = None
     if args.feature_gates:

@@ -205,6 +205,44 @@ class LabeledHistogram:
         return lines
 
 
+class LabeledGauge:
+    """A gauge family with one label dimension (prometheus GaugeVec).
+
+    First users: the cluster analytics plane's per-resource
+    utilization/fragmentation ratios and per-component HBM residency
+    (ISSUE 14). The label must stay bounded — tools/metrics_lint.py
+    enforces an allowlist of label names with finite value sets."""
+
+    def __init__(self, name: str, help_text: str, label: str):
+        self.name = name
+        self.help = help_text
+        self.label = label
+        self.values: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, label_value: str, value: float) -> None:
+        with self._lock:
+            self.values[label_value] = value
+
+    def get(self, label_value: str) -> float:
+        with self._lock:
+            return self.values.get(label_value, 0.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.values.clear()
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = sorted(self.values.items())
+        for label_value, value in items:
+            lines.append(f'{self.name}{{{self.label}='
+                         f'"{escape_label_value(label_value)}"}} {value:g}')
+        return lines
+
+
 class InfoGauge:
     """An info-style gauge (prometheus *_info convention): constant value 1
     with the interesting facts carried as label values. Setting it replaces
@@ -409,6 +447,42 @@ class SchedulerMetrics:
         self.provenance_records = self._reg(Counter(
             "tpusim_provenance_records_total",
             "Decision-provenance records captured into the explanation ring"))
+        # cluster analytics plane (ISSUE 14): fleet-level aggregates reduced
+        # on-device from the resident twin, plus HBM residency and
+        # compile-cost accounting — refreshed at scrape time by
+        # tpusim.obs.analytics.refresh_gauges()
+        self.cluster_utilization = self._reg(LabeledGauge(
+            "tpusim_cluster_utilization_ratio",
+            "Requested / allocatable per resource across valid nodes "
+            "(latest analytics sample)", "resource"))
+        self.cluster_fragmentation = self._reg(LabeledGauge(
+            "tpusim_cluster_fragmentation_ratio",
+            "1 - largest-free-slot / total-free per resource (0 = all free "
+            "capacity on one node, 1 = fully shredded)", "resource"))
+        self.cluster_feasible_nodes = self._reg(Gauge(
+            "tpusim_cluster_feasible_nodes",
+            "Nodes with free cpu AND memory AND pod slots in the latest "
+            "analytics sample"))
+        self.cluster_nodes = self._reg(Gauge(
+            "tpusim_cluster_nodes",
+            "Valid nodes covered by the latest analytics sample"))
+        self.analytics_samples = self._reg(Counter(
+            "tpusim_analytics_samples_total",
+            "On-device analytics reductions captured into the ring"))
+        self.hbm_resident_bytes = self._reg(LabeledGauge(
+            "tpusim_hbm_resident_bytes",
+            "Bytes held resident per component (device twin, staged LRU, "
+            "batched device trees)", "component"))
+        self.hbm_cache_entries = self._reg(LabeledGauge(
+            "tpusim_hbm_cache_entries",
+            "Entries held per cache component (staged scenarios, device "
+            "batches, compiled executables)", "component"))
+        self.compile_traces = self._reg(LabeledCounter(
+            "tpusim_compile_traces_total",
+            "Cumulative compiles/retraces by observation site", "site"))
+        self.compile_cost = self._reg(LabeledCounter(
+            "tpusim_compile_cost_us_total",
+            "Cumulative compile walltime by observation site", "site"))
         # one lock for whole-registry reads: /metrics and snapshot() see a
         # single consistent exposition even while runtime threads observe
         self._read_lock = threading.Lock()
@@ -453,7 +527,7 @@ class SchedulerMetrics:
                                     "sum": round(child.total, 3)}
                             for label, child in sorted(
                                 metric.children.items())}
-                elif isinstance(metric, LabeledCounter):
+                elif isinstance(metric, (LabeledCounter, LabeledGauge)):
                     if metric.values:
                         out[metric.name] = dict(sorted(metric.values.items()))
                 elif isinstance(metric, InfoGauge):

@@ -55,6 +55,7 @@ from tpusim.jaxe.kernels import (
     statics_to_device,
 )
 from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
+from tpusim.obs import analytics
 from tpusim.obs import provenance
 from tpusim.obs import recorder as flight
 
@@ -496,8 +497,10 @@ class JaxBackend:
                 if csp:
                     csp.set("pods", len(pods))
                     csp.set("nodes", len(snapshot.nodes))
-            metrics.backend_compile_latency.observe(
-                since_in_microseconds(compile_start))
+            compile_us = since_in_microseconds(compile_start)
+            metrics.backend_compile_latency.observe(compile_us)
+            analytics.note_compile(
+                "backend", f"nodes={len(snapshot.nodes)}", compile_us)
             return out
 
         compiled, cols = precompiled or _timed_compile()
@@ -571,6 +574,14 @@ class JaxBackend:
             _note_fast_fallback(
                 metrics, "explain lanes (top-k score breakdown) route "
                 "through the XLA scan")
+        if fast_on and analytics.get() is not None:
+            # the analytics reduction folds the scan's final carry, which
+            # the Pallas kernel never materializes (it emits choices/counts
+            # only) — same precedent as the explain lanes above
+            fast_on = False
+            _note_fast_fallback(
+                metrics, "cluster analytics rides the XLA scan's final "
+                "carry")
         if (fast_on and auto_mode and not _FAST_AUTO["verified_sigs"]
                 and len(pods) < int(os.environ.get(
                     "TPUSIM_FAST_VERIFY_MIN", 64))):
@@ -699,18 +710,19 @@ class JaxBackend:
                     # already-pinned variant ran without re-verification
                     flight.note_auto_transition("trust", str(fast_sig))
         explain_lanes = None
+        final_carry = None  # bound-and-dropped unless analytics reads it
         if fplan is None:  # fast path off, ineligible, or discarded above
             with flight.profiled("tpusim:schedule_scan"):
                 if use_chunks:
-                    _, choices, counts, _ = schedule_scan_chunked(
+                    final_carry, choices, counts, _ = schedule_scan_chunked(
                         config, carry, statics, xs, scan_chunk)
                 elif config.explain_k > 0:
-                    (_, choices, counts, _,
+                    (final_carry, choices, counts, _,
                      explain_lanes) = schedule_scan(config, carry,
                                                     statics, xs)
                 else:
-                    _, choices, counts, _ = schedule_scan(config, carry,
-                                                          statics, xs)
+                    (final_carry, choices, counts,
+                     _) = schedule_scan(config, carry, statics, xs)
         choices = np.asarray(choices)
         counts = np.asarray(counts)
         if _CHAOS["injector"] is not None:
@@ -768,6 +780,12 @@ class JaxBackend:
                         "part_names": explain_part_names(config),
                         "sentinel": EXPLAIN_SENTINEL}
             prov.capture_batch(placements, "backend", topk=topk)
+        if final_carry is not None:
+            # one None-check inside; the reduction folds the POST-bind
+            # carry this batch produced against the staged statics
+            analytics.capture(statics, final_carry,
+                              len(compiled.statics.names), "backend",
+                              names=compiled.statics.names)
         # e2e additionally covers host-side result materialization
         metrics.e2e_scheduling_latency.observe(
             since_in_microseconds(dispatch_start))

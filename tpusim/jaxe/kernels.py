@@ -1533,3 +1533,121 @@ def _preempt_select_impl(zero_req: bool, lane_valid, node_idx,
 
 
 preempt_select = partial(jax.jit, static_argnums=(0,))(_preempt_select_impl)
+
+
+# --------------------------------------------------------------------------
+# Cluster analytics reduction (ISSUE 14).
+#
+# A post-scan fold over the resident twin's per-node columns: the ten
+# allocatable/requested arrays below are plain field references into a
+# (Statics, Carry) pair, so building AnalyticsIn costs a tuple pack and the
+# reduction is one extra O(N) dispatch per cycle that never touches the
+# scheduling scan itself (placement hashes stay pinned by construction).
+#
+# The kernel is integer-only: sums, maxes, counts, and encoded top-k keys.
+# Ratios (utilization, fragmentation) are derived at host decode time in
+# tpusim/obs/analytics.py, whose numpy mirror recomputes these same integer
+# ops so device-vs-host comparison is bit-exact, not within-epsilon.
+
+ANALYTICS_RESOURCES = ("cpu", "memory", "gpu", "ephemeral", "pods")
+ANALYTICS_UTIL_SCALE = 1_000_000  # utilization in ppm (integer floor-div)
+_ANALYTICS_TIE_BITS = 32  # low bits of a top-k key hold the index tiebreak
+
+
+class AnalyticsIn(NamedTuple):
+    """Per-node columns the analytics reduction folds ([N] each).
+
+    Allocatables come from Statics, requested totals from the scan's final
+    Carry; `analytics_in` builds one by reference (no copies, no tracing of
+    the full trees — serve slices exactly these ten fields per entry)."""
+    alloc_cpu: jnp.ndarray
+    alloc_mem: jnp.ndarray
+    alloc_gpu: jnp.ndarray
+    alloc_eph: jnp.ndarray
+    allowed_pods: jnp.ndarray
+    used_cpu: jnp.ndarray
+    used_mem: jnp.ndarray
+    used_gpu: jnp.ndarray
+    used_eph: jnp.ndarray
+    pod_count: jnp.ndarray
+
+
+class AnalyticsStats(NamedTuple):
+    """Integer aggregates, resource axis ordered as ANALYTICS_RESOURCES.
+
+    hot_keys / cold_keys encode `score * 2^32 + (2^32 - 1 - node_index)`
+    (score = dominant cpu/mem utilization in ppm, clipped to [0, 1e6]);
+    the index term makes every key unique, so lax.top_k and a host-side
+    descending sort agree exactly. Nodes outside n_valid carry key -1 and
+    are dropped at decode."""
+    alloc: jnp.ndarray           # [R] int64 — allocatable totals
+    used: jnp.ndarray            # [R] int64 — requested totals
+    free_sum: jnp.ndarray        # [R] int64 — sum of per-node free (>= 0)
+    free_max: jnp.ndarray        # [R] int64 — largest single free slot
+    headroom_nodes: jnp.ndarray  # [R] int64 — nodes with free > 0
+    feasible_nodes: jnp.ndarray  # int64 — free cpu AND mem AND pod slots
+    valid_nodes: jnp.ndarray     # int64 — nodes inside n_valid
+    hot_keys: jnp.ndarray        # [k] int64 — hottest-first encoded keys
+    cold_keys: jnp.ndarray       # [k] int64 — coldest-first encoded keys
+
+
+def analytics_in(statics, carry) -> AnalyticsIn:
+    """The ten-column analytics view of a (Statics, Carry) pair."""
+    return AnalyticsIn(
+        alloc_cpu=statics.alloc_cpu, alloc_mem=statics.alloc_mem,
+        alloc_gpu=statics.alloc_gpu, alloc_eph=statics.alloc_eph,
+        allowed_pods=statics.allowed_pods,
+        used_cpu=carry.used_cpu, used_mem=carry.used_mem,
+        used_gpu=carry.used_gpu, used_eph=carry.used_eph,
+        pod_count=carry.pod_count)
+
+
+def _analytics_reduce_impl(inp: AnalyticsIn, n_valid, *, k: int):
+    n = inp.alloc_cpu.shape[0]
+    mask = jnp.arange(n) < n_valid
+    alloc = jnp.stack([inp.alloc_cpu.astype(jnp.int64),
+                       inp.alloc_mem.astype(jnp.int64),
+                       inp.alloc_gpu.astype(jnp.int64),
+                       inp.alloc_eph.astype(jnp.int64),
+                       inp.allowed_pods.astype(jnp.int64)])
+    used = jnp.stack([inp.used_cpu.astype(jnp.int64),
+                      inp.used_mem.astype(jnp.int64),
+                      inp.used_gpu.astype(jnp.int64),
+                      inp.used_eph.astype(jnp.int64),
+                      inp.pod_count.astype(jnp.int64)])
+    alloc = jnp.where(mask[None, :], alloc, 0)  # [R, N]
+    used = jnp.where(mask[None, :], used, 0)
+    free = jnp.maximum(alloc - used, 0)
+
+    # dominant-share hotness in ppm; padded/invalid nodes encode key -1
+    util = jnp.where(alloc[:2] > 0,
+                     (used[:2] * ANALYTICS_UTIL_SCALE)
+                     // jnp.maximum(alloc[:2], 1), 0)
+    score = jnp.clip(jnp.maximum(util[0], util[1]),
+                     0, ANALYTICS_UTIL_SCALE)
+    tie = ((jnp.int64(1) << _ANALYTICS_TIE_BITS) - 1
+           - jnp.arange(n, dtype=jnp.int64))
+    hot = jnp.where(mask, (score << _ANALYTICS_TIE_BITS) | tie,
+                    jnp.int64(-1))
+    cold = jnp.where(
+        mask,
+        ((ANALYTICS_UTIL_SCALE - score) << _ANALYTICS_TIE_BITS) | tie,
+        jnp.int64(-1))
+    hot_keys, _ = jax.lax.top_k(hot, k)
+    cold_keys, _ = jax.lax.top_k(cold, k)
+
+    return AnalyticsStats(
+        alloc=alloc.sum(axis=1),
+        used=used.sum(axis=1),
+        free_sum=free.sum(axis=1),
+        free_max=free.max(axis=1),
+        headroom_nodes=(free > 0).sum(axis=1).astype(jnp.int64),
+        feasible_nodes=((free[0] > 0) & (free[1] > 0)
+                        & (free[4] > 0)).sum().astype(jnp.int64),
+        valid_nodes=mask.sum().astype(jnp.int64),
+        hot_keys=hot_keys,
+        cold_keys=cold_keys)
+
+
+analytics_reduce = partial(jax.jit, static_argnames=("k",))(
+    _analytics_reduce_impl)

@@ -1,0 +1,503 @@
+"""Cluster analytics plane: fleet-level telemetry from the twin (ISSUE 14).
+
+PR 13's provenance made individual *decisions* observable; this module is
+the fleet-state half: per-resource allocatable vs requested totals, a
+fragmentation score (largest-free-slot vs total-free), feasible-node
+counts, and top-k hottest/coldest nodes — all reduced **on-device** from
+the resident twin's (Statics, Carry) columns by `kernels.analytics_reduce`
+and decoded lazily here. The reduction is a separate post-scan dispatch
+over arrays the scan already owns, so placement hashes are pinned by
+construction and stream cycles pay O(1) extra dispatches.
+
+The device kernel returns integers only (sums, maxes, counts, encoded
+top-k keys); ratios are derived at decode time. `host_reduce` recomputes
+the same integer ops in numpy so device-vs-host comparison is bit-exact —
+`ClusterAnalytics.verify_against_host` is the contract the smoke variant
+and tier-1 tests assert across backend/stream/serve routes.
+
+Capture mirrors the provenance pattern exactly: a module-level active
+instance behind one None-check on the hot path, lazy decode, a bounded
+in-memory ring (`/analytics` on the obs server), and an append-only JSONL
+sink (`--analytics-out`). With no instance installed the only cost at a
+call site is the None-check.
+
+Two always-on accounting registries ride along (they need no install,
+because compiles and residency changes are cold-path by definition):
+
+- HBM residency: components register a weakref'd byte/entry source
+  (`register_hbm_source`) polled only at scrape/snapshot time; the
+  `tenant` field is the attribution hook for ROADMAP item 2.
+- Compile cost: `note_compile(site, signature, latency_us)` accumulates
+  cumulative trace count x compile latency per plan signature; the
+  per-signature table is surfaced in `/analytics` JSON (deliberately NOT
+  as metric labels — signatures are unbounded, which the metrics lint
+  now forbids), with bounded per-site counters on `/metrics`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpusim.framework.metrics import register
+
+UTIL_SCALE = 1_000_000
+_TIE_BITS = 32
+_TIE_MASK = (1 << _TIE_BITS) - 1
+RESOURCES = ("cpu", "memory", "gpu", "ephemeral", "pods")
+
+
+# -- numpy mirror of kernels._analytics_reduce_impl ------------------------
+
+def host_reduce(inp, n_valid: int, k: int) -> Dict[str, np.ndarray]:
+    """Recompute the device reduction with numpy, integer-for-integer.
+
+    `inp` is an AnalyticsIn of host arrays (np.asarray'd leaves). Returns
+    a dict keyed like AnalyticsStats fields; every value must equal the
+    device output exactly, including top-k key order (keys are unique by
+    construction, so a descending sort is deterministic)."""
+    alloc = np.stack([np.asarray(inp.alloc_cpu, dtype=np.int64),
+                      np.asarray(inp.alloc_mem, dtype=np.int64),
+                      np.asarray(inp.alloc_gpu, dtype=np.int64),
+                      np.asarray(inp.alloc_eph, dtype=np.int64),
+                      np.asarray(inp.allowed_pods, dtype=np.int64)])
+    used = np.stack([np.asarray(inp.used_cpu, dtype=np.int64),
+                     np.asarray(inp.used_mem, dtype=np.int64),
+                     np.asarray(inp.used_gpu, dtype=np.int64),
+                     np.asarray(inp.used_eph, dtype=np.int64),
+                     np.asarray(inp.pod_count, dtype=np.int64)])
+    n = alloc.shape[1]
+    mask = np.arange(n) < n_valid
+    alloc = np.where(mask[None, :], alloc, 0)
+    used = np.where(mask[None, :], used, 0)
+    free = np.maximum(alloc - used, 0)
+
+    util = np.where(alloc[:2] > 0,
+                    (used[:2] * UTIL_SCALE) // np.maximum(alloc[:2], 1), 0)
+    score = np.clip(np.maximum(util[0], util[1]), 0, UTIL_SCALE)
+    tie = (np.int64(_TIE_MASK) - np.arange(n, dtype=np.int64))
+    hot = np.where(mask, (score << _TIE_BITS) | tie, np.int64(-1))
+    cold = np.where(mask,
+                    ((UTIL_SCALE - score) << _TIE_BITS) | tie, np.int64(-1))
+    return {
+        "alloc": alloc.sum(axis=1),
+        "used": used.sum(axis=1),
+        "free_sum": free.sum(axis=1),
+        "free_max": free.max(axis=1),
+        "headroom_nodes": (free > 0).sum(axis=1).astype(np.int64),
+        "feasible_nodes": np.int64(((free[0] > 0) & (free[1] > 0)
+                                    & (free[4] > 0)).sum()),
+        "valid_nodes": np.int64(mask.sum()),
+        "hot_keys": np.sort(hot)[::-1][:k],
+        "cold_keys": np.sort(cold)[::-1][:k],
+    }
+
+
+def _decode_keys(keys: np.ndarray, names, hot: bool) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for key in keys.tolist():
+        if key < 0:
+            continue  # padding past n_valid
+        score = key >> _TIE_BITS
+        idx = _TIE_MASK - (key & _TIE_MASK)
+        ppm = score if hot else UTIL_SCALE - score
+        out.append({"node": names[idx] if names else idx,
+                    "utilization_ppm": int(ppm)})
+    return out
+
+
+def decode_stats(stats, names=None) -> Dict[str, Any]:
+    """One AnalyticsStats -> the JSON body (ratios derived here, from the
+    kernel's integers, so the device never computes a float)."""
+    alloc = np.asarray(stats.alloc).tolist()
+    used = np.asarray(stats.used).tolist()
+    free_sum = np.asarray(stats.free_sum).tolist()
+    free_max = np.asarray(stats.free_max).tolist()
+    headroom = np.asarray(stats.headroom_nodes).tolist()
+    resources: Dict[str, Any] = {}
+    for r, name in enumerate(RESOURCES):
+        a, u, fs, fm = alloc[r], used[r], free_sum[r], free_max[r]
+        resources[name] = {
+            "allocatable": a, "requested": u,
+            "free": fs, "largest_free": fm,
+            "nodes_with_headroom": headroom[r],
+            "utilization": (u / a) if a > 0 else None,
+            "fragmentation": (1.0 - fm / fs) if fs > 0 else 0.0,
+        }
+    return {
+        "nodes": {"valid": int(np.asarray(stats.valid_nodes)),
+                  "feasible": int(np.asarray(stats.feasible_nodes))},
+        "resources": resources,
+        "hot_nodes": _decode_keys(np.asarray(stats.hot_keys), names, True),
+        "cold_nodes": _decode_keys(np.asarray(stats.cold_keys), names, False),
+    }
+
+
+class _Sample:
+    __slots__ = ("stats", "source", "cycle", "ts", "seq", "names",
+                 "n_valid", "k", "inputs", "decoded")
+
+    def __init__(self, stats, source, cycle, ts, seq, names, n_valid, k,
+                 inputs):
+        self.stats = stats
+        self.source = source
+        self.cycle = cycle
+        self.ts = ts
+        self.seq = seq
+        self.names = names
+        self.n_valid = n_valid
+        self.k = k
+        self.inputs = inputs
+        self.decoded = None
+
+
+def _decode_sample(sample: _Sample) -> Dict[str, Any]:
+    if sample.decoded is None:  # idempotent; benign under racing readers
+        rec = {"seq": sample.seq, "ts": sample.ts, "source": sample.source}
+        if sample.cycle is not None:
+            rec["cycle"] = sample.cycle
+        rec.update(decode_stats(sample.stats, sample.names))
+        sample.decoded = rec
+    return sample.decoded
+
+
+class ClusterAnalytics:
+    """Bounded ring of on-device aggregate samples + optional JSONL sink.
+
+    capacity: samples retained in the ring (whole samples, one per
+        cycle/dispatch). top_k: hottest/coldest depth requested from the
+        kernel (clamped to the node count per shape). path: append target
+        for `--analytics-out`. keep_inputs: retain the AnalyticsIn and
+        n_valid per sample so `verify_against_host` can replay the
+        reduction in numpy (tests/smoke only — it pins device arrays).
+    sample_interval_s: minimum wall-clock gap between device captures
+        (default 4 Hz). Telemetry consumers scrape at seconds granularity,
+        but a tight CPU stream loop can run cycles every few ms — without
+        the throttle the per-cycle jit-dispatch overhead alone busts the
+        <2% budget. Throttled calls cost one clock read + compare. Set
+        0.0 to capture every dispatch (the parity tests/smoke do).
+    """
+
+    def __init__(self, capacity: int = 512, top_k: int = 8,
+                 path: Optional[str] = None, keep_inputs: bool = False,
+                 sample_interval_s: float = 0.25):
+        self.capacity = max(1, int(capacity))
+        self.top_k = max(1, int(top_k))
+        self.path = path
+        self.keep_inputs = keep_inputs
+        self.sample_interval_s = float(sample_interval_s)
+        self._last_capture = float("-inf")  # first capture always fires
+        self._ring: Deque[_Sample] = deque(maxlen=self.capacity)
+        self._pending: List[_Sample] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._file = open(path, "a") if path is not None else None
+
+    # -- capture (hot path) ------------------------------------------------
+
+    def want_sample(self) -> bool:
+        """Throttle gate, checked BEFORE any column gathering or dispatch.
+        Unsynchronized read: a racing duplicate sample is harmless and
+        cheaper than locking the cycle loop."""
+        if self.sample_interval_s <= 0.0:
+            return True
+        return time.monotonic() - self._last_capture >= self.sample_interval_s
+
+    def capture_device(self, inp, n_valid: int, source: str,
+                       cycle: Optional[int] = None, names=None) -> None:
+        """Dispatch the reduction on device columns and ring the result.
+
+        The jit call is asynchronous — the returned stats are un-forced
+        futures and decode happens at query/flush time, so the pipelined
+        stream's overlap is preserved. Cost when enabled: one O(N)
+        dispatch + a lock'd append."""
+        from tpusim.jaxe.kernels import analytics_reduce
+
+        if not self.want_sample():
+            return
+        self._last_capture = time.monotonic()
+        n = int(inp.alloc_cpu.shape[0])
+        k = max(1, min(self.top_k, n))
+        stats = analytics_reduce(inp, np.int64(n_valid), k=k)
+        inputs = None
+        if self.keep_inputs:
+            # host-copy NOW, and force a REAL copy: the carry columns are
+            # donated into the next cycle's scan, and on the CPU backend
+            # np.asarray can hand back a zero-copy view of the device
+            # buffer — which the donated dispatch then scribbles over
+            # (keep_inputs is a test/smoke mode; the production path
+            # retains nothing and stays fully async)
+            inputs = type(inp)(*(np.array(leaf, copy=True) for leaf in inp))
+        sample = _Sample(stats, source, cycle, round(time.time(), 3), 0,
+                         names, int(n_valid), k, inputs)
+        with self._lock:
+            sample.seq = self._seq
+            self._seq += 1
+            self._ring.append(sample)
+            if self._file is not None:
+                self._pending.append(sample)
+        register().analytics_samples.inc()
+
+    # -- query / export (cold path) ----------------------------------------
+
+    def samples(self) -> List[_Sample]:
+        with self._lock:
+            return list(self._ring)
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            sample = self._ring[-1] if self._ring else None
+        return _decode_sample(sample) if sample is not None else None
+
+    def series(self, limit: int = 60) -> List[Dict[str, Any]]:
+        """Most recent `limit` samples, decoded, oldest first."""
+        with self._lock:
+            tail = list(self._ring)[-max(0, limit):]
+        return [_decode_sample(s) for s in tail]
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"enabled": True, "samples": self._seq,
+                "capacity": self.capacity, "latest": self.latest(),
+                "hbm": hbm_snapshot(), "compile": compile_snapshot()}
+
+    def verify_against_host(self) -> List[str]:
+        """Replay every retained reduction in numpy; return mismatch
+        descriptions (empty = bit-exact). Requires keep_inputs=True."""
+        problems: List[str] = []
+        for sample in self.samples():
+            if sample.inputs is None:
+                problems.append(f"seq {sample.seq}: no inputs retained "
+                                "(keep_inputs=False)")
+                continue
+            want = host_reduce(sample.inputs, sample.n_valid, sample.k)
+            for field, expect in want.items():
+                got = np.asarray(getattr(sample.stats, field))
+                if not np.array_equal(got, expect):
+                    problems.append(
+                        f"seq {sample.seq} [{sample.source}] {field}: "
+                        f"device {got.tolist()} != host {expect.tolist()}")
+        return problems
+
+    def flush(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if self._file is None or not pending:
+            return
+        lines = [json.dumps(_decode_sample(s), sort_keys=True,
+                            separators=(",", ":")) for s in pending]
+        self._file.write("\n".join(lines) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        self.flush()
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+# -- module-level active instance (mirrors provenance.install) -------------
+
+_active: Optional[ClusterAnalytics] = None
+
+
+def install(log: ClusterAnalytics) -> ClusterAnalytics:
+    global _active
+    _active = log
+    return log
+
+
+def uninstall() -> None:
+    global _active
+    if _active is not None:
+        _active.close()
+    _active = None
+
+
+def get() -> Optional[ClusterAnalytics]:
+    return _active
+
+
+def capture(statics, carry, n_valid: int, source: str,
+            cycle: Optional[int] = None, names=None) -> None:
+    """Reduce one (Statics, final Carry) pair; no-op (one None-check)
+    when disabled."""
+    log = _active
+    if log is None or not log.want_sample():
+        return
+    from tpusim.jaxe.kernels import analytics_in
+
+    log.capture_device(analytics_in(statics, carry), n_valid, source,
+                       cycle=cycle, names=names)
+
+
+# -- HBM residency accounting (always on, polled at scrape time) -----------
+
+def tree_nbytes(tree) -> int:
+    """Total bytes of every array leaf in a nested tuple/list/dict —
+    computed from shape x itemsize, so device futures are never forced."""
+    if tree is None:
+        return 0
+    if isinstance(tree, (tuple, list)):
+        return sum(tree_nbytes(leaf) for leaf in tree)
+    if isinstance(tree, dict):
+        return sum(tree_nbytes(leaf) for leaf in tree.values())
+    shape = getattr(tree, "shape", None)
+    dtype = getattr(tree, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    size = 1
+    for dim in shape:
+        size *= int(dim)
+    return size * np.dtype(dtype).itemsize
+
+
+_hbm_lock = threading.Lock()
+_hbm_sources: List[Dict[str, Any]] = []
+
+
+def register_hbm_source(component: str, owner, fn,
+                        tenant: str = "default") -> None:
+    """Register a residency source polled at snapshot time.
+
+    `fn(owner) -> (bytes, entries)` (or `fn() -> ...` when owner is None
+    for process-wide sources). Owners are weakref'd: a collected owner
+    silently drops its source, so sessions/executors need no teardown
+    hook. `tenant` attributes the bytes for ROADMAP item 2."""
+    entry = {"component": component, "tenant": tenant, "fn": fn,
+             "ref": weakref.ref(owner) if owner is not None else None}
+    with _hbm_lock:
+        _hbm_sources.append(entry)
+
+
+def hbm_snapshot() -> Dict[str, Any]:
+    """component -> {bytes, entries, tenants:{tenant: bytes}}; aggregates
+    across live sources, pruning dead weakrefs as it goes."""
+    with _hbm_lock:
+        sources = list(_hbm_sources)
+    out: Dict[str, Any] = {}
+    dead: List[Dict[str, Any]] = []
+    for entry in sources:
+        owner = None
+        if entry["ref"] is not None:
+            owner = entry["ref"]()
+            if owner is None:
+                dead.append(entry)
+                continue
+        try:
+            nbytes, entries = (entry["fn"](owner) if entry["ref"] is not None
+                               else entry["fn"]())
+        except Exception:
+            continue  # a mid-teardown source must not break a scrape
+        slot = out.setdefault(entry["component"],
+                              {"bytes": 0, "entries": 0, "tenants": {}})
+        slot["bytes"] += int(nbytes)
+        slot["entries"] += int(entries)
+        tenants = slot["tenants"]
+        tenants[entry["tenant"]] = (tenants.get(entry["tenant"], 0)
+                                    + int(nbytes))
+    if dead:
+        with _hbm_lock:
+            for entry in dead:
+                if entry in _hbm_sources:
+                    _hbm_sources.remove(entry)
+    return out
+
+
+def _jit_cache_source() -> Tuple[int, int]:
+    # executable sizes aren't exposed by jax, so bytes stay 0; entry
+    # counts still bound the warm-retrace contract tests
+    if "tpusim.jaxe.kernels" not in sys.modules:
+        return (0, 0)  # jax never imported: nothing compiled, don't force it
+    kernels = sys.modules["tpusim.jaxe.kernels"]
+    entries = 0
+    for name in ("schedule_scan", "schedule_scan_donated",
+                 "schedule_scan_chunked", "apply_delta_donated",
+                 "apply_statics_delta_donated", "analytics_reduce"):
+        fn = getattr(kernels, name, None)
+        try:
+            entries += fn._cache_size()
+        except (AttributeError, TypeError):
+            pass
+    return (0, entries)
+
+
+register_hbm_source("compiled_executables", None, _jit_cache_source)
+
+
+# -- compile-cost accounting (always on; compiles are cold by definition) --
+
+_compile_lock = threading.Lock()
+_compile_costs: Dict[Tuple[str, str], Dict[str, float]] = {}
+
+
+def note_compile(site: str, signature, latency_us: float,
+                 traces: int = 1) -> None:
+    """Accumulate trace count x compile latency per (site, signature)."""
+    key = (site, str(signature))
+    with _compile_lock:
+        slot = _compile_costs.setdefault(key, {"traces": 0, "total_us": 0.0})
+        slot["traces"] += traces
+        slot["total_us"] += float(latency_us)
+    reg = register()
+    reg.compile_traces.inc(site, traces)
+    reg.compile_cost.inc(site, float(latency_us))
+
+
+def compile_snapshot() -> Dict[str, Any]:
+    """site -> {traces, total_us, signatures:{sig: {traces, total_us}}}."""
+    with _compile_lock:
+        items = [(key, dict(slot)) for key, slot in _compile_costs.items()]
+    out: Dict[str, Any] = {}
+    for (site, sig), slot in items:
+        site_slot = out.setdefault(site, {"traces": 0, "total_us": 0.0,
+                                          "signatures": {}})
+        site_slot["traces"] += slot["traces"]
+        site_slot["total_us"] += slot["total_us"]
+        site_slot["signatures"][sig] = slot
+    return out
+
+
+def reset_compile_costs() -> None:
+    """Tests/bench isolation only."""
+    with _compile_lock:
+        _compile_costs.clear()
+
+
+# -- gauge refresh (scrape-time; zero hot-path cost) -----------------------
+
+def refresh_gauges() -> None:
+    """Fold the latest sample + HBM sources into the tpusim_cluster_* /
+    tpusim_hbm_* gauge families. Called by the obs server before
+    exposition; cheap enough for every scrape."""
+    reg = register()
+    for component, slot in hbm_snapshot().items():
+        reg.hbm_resident_bytes.set(component, slot["bytes"])
+        reg.hbm_cache_entries.set(component, slot["entries"])
+    log = _active
+    if log is None:
+        return
+    latest = log.latest()
+    if latest is None:
+        return
+    for name, row in latest["resources"].items():
+        if row["utilization"] is not None:
+            reg.cluster_utilization.set(name, row["utilization"])
+        reg.cluster_fragmentation.set(name, row["fragmentation"])
+    reg.cluster_feasible_nodes.set(latest["nodes"]["feasible"])
+    reg.cluster_nodes.set(latest["nodes"]["valid"])
+
+
+def read_jsonl(path: str):
+    """Stream records back from an --analytics-out file."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield json.loads(line)

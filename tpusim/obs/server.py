@@ -11,6 +11,10 @@ start one of these on a daemon thread next to the runtime:
   freshness, admission-queue depth, SLO burn rate.
 - `GET /debug/provenance` — the ring of recent decision-provenance
   records (`?limit=N`, default 100), JSON.
+- `GET /analytics` — the cluster analytics plane (ISSUE 14): latest
+  on-device utilization/fragmentation sample, HBM residency, compile
+  costs, plus a bounded time-series ring (`?limit=N`, default 60).
+  `tpusim top` renders this body live.
 
 Stdlib-only (http.server): the container bakes no HTTP framework, and a
 scrape endpoint needs none. The handler reads shared state exclusively
@@ -28,6 +32,7 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from tpusim.framework.metrics import register
+from tpusim.obs import analytics
 from tpusim.obs import provenance
 
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -64,6 +69,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
         parsed = urlparse(self.path)
         if parsed.path == "/metrics":
+            # fold the latest analytics sample + HBM sources into the
+            # tpusim_cluster_*/tpusim_hbm_* gauges so every scrape is live
+            analytics.refresh_gauges()
             text = register().expose()
             self._send(200, METRICS_CONTENT_TYPE, text.encode())
         elif parsed.path == "/healthz":
@@ -79,6 +87,22 @@ class _Handler(BaseHTTPRequestHandler):
             records = log.tail(limit) if log is not None else []
             self._send(200, "application/json",
                        (json.dumps(records) + "\n").encode())
+        elif parsed.path == "/analytics":
+            try:
+                limit = int(parse_qs(parsed.query).get("limit", ["60"])[0])
+            except ValueError:
+                limit = 60
+            log = analytics.get()
+            if log is None:
+                body = {"enabled": False,
+                        "hbm": analytics.hbm_snapshot(),
+                        "compile": analytics.compile_snapshot()}
+            else:
+                analytics.refresh_gauges()
+                body = log.snapshot()
+                body["series"] = log.series(limit)
+            self._send(200, "application/json",
+                       (json.dumps(body, sort_keys=True) + "\n").encode())
         else:
             self._send(404, "text/plain; charset=utf-8", b"not found\n")
 

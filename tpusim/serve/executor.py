@@ -57,6 +57,7 @@ from tpusim.jaxe.sharding import (
     scenario_shardings,
     stage_tree,
 )
+from tpusim.obs import analytics
 from tpusim.obs import provenance
 from tpusim.obs.recorder import (
     note_serve,
@@ -108,6 +109,18 @@ class ServeExecutor:
         self._warm: Dict[Tuple[ShapeClass, Any], Dict[str, int]] = {}
         self.stats = {"dispatches": 0, "warm_hits": 0, "traces": 0,
                       "staged_hits": 0, "device_batch_hits": 0}
+        # HBM residency accounting (ISSUE 14): byte/entry sources polled
+        # only at scrape/snapshot time; weakref'd to this executor
+        analytics.register_hbm_source(
+            "serve_staged", self,
+            lambda ex: (sum(analytics.tree_nbytes(
+                (s.statics, s.carry, s.xs))
+                for s, _sc in ex._staged.values()), len(ex._staged)))
+        analytics.register_hbm_source(
+            "serve_device_batches", self,
+            lambda ex: (sum(analytics.tree_nbytes(built[1:])
+                            for built in ex._device_batches.values()),
+                        len(ex._device_batches)))
 
     # -- snapshot registry (the base clusters requests reference) ---------
 
@@ -261,6 +274,7 @@ class ServeExecutor:
                 self._device_batch(bucket)
             seen = program_key in self._warm
             before = compile_count()
+            program_start = time.perf_counter()
             if self.mesh is not None:
                 choices_b, counts_b = _scenario_program(config, self.mesh)(
                     carries, statics_b, xs_b)
@@ -287,6 +301,15 @@ class ServeExecutor:
                     raise DeviceOutputError(
                         "device returned NaN unschedulability counts")
             traced = compile_count() - before
+            if traced:
+                # compile-cost accounting (ISSUE 14): the traced program's
+                # walltime upper-bounds its compile cost (execution rides
+                # along, but cold dispatches are compile-dominated)
+                analytics.note_compile(
+                    "serve",
+                    f"{program_key[0].describe()}/plan={program_key[1]}",
+                    (time.perf_counter() - program_start) * 1e6,
+                    traces=traced)
             warm = seen and traced == 0
             stats = self._warm.setdefault(program_key,
                                           {"dispatches": 0, "traces": 0})
@@ -308,6 +331,21 @@ class ServeExecutor:
         if provenance.get_log() is not None:
             for r in results:
                 provenance.capture(r.placements, "serve")
+        alog = analytics.get()
+        if alog is not None:
+            # serve analytics are PRE-bind: the vmapped program discards
+            # per-scenario final carries, so each sample reduces the
+            # scenario's staged base state (DEVIATIONS.md). Slices of the
+            # batched device trees stay lazy; padded nodes past n_valid
+            # are masked inside the kernel.
+            from tpusim.jaxe.kernels import analytics_in
+
+            a_in = analytics_in(statics_b, carries)
+            for i, e in enumerate(bucket.entries):
+                names = e.staged.compiled.statics.names
+                alog.capture_device(
+                    type(a_in)(*(leaf[i] for leaf in a_in)),
+                    len(names), "serve", names=names)
         return results, warm
 
     # -- chaos-hardened dispatch ------------------------------------------

@@ -1031,4 +1031,12 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         out["replay_ms"] = report.replay_s * 1e3
         out["recomputed_cycles"] = list(report.recomputed)
         out["recovery_violations"] = list(report.violations)
+    # cluster analytics (ISSUE 14): fold the run's fleet-state snapshot
+    # into the report when the plane is armed (one None-check otherwise)
+    from tpusim.obs import analytics as _analytics
+
+    alog = _analytics.get()
+    if alog is not None:
+        alog.flush()
+        out["analytics"] = alog.snapshot()
     return out

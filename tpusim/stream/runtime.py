@@ -105,6 +105,7 @@ from tpusim.jaxe.policyc import (
 )
 from tpusim.jaxe.sharding import stage_tree
 from tpusim.jaxe.state import NUM_FIXED_BITS, reason_strings
+from tpusim.obs import analytics
 from tpusim.obs import provenance
 from tpusim.obs import recorder as flight
 from tpusim.obs import slo
@@ -337,6 +338,12 @@ class StreamSession:
         self._pending: Optional[_PendingCycle] = None
         self._last_path: Optional[str] = None
         self.persist = None           # stream.persist.StreamPersistence
+        # HBM residency accounting (ISSUE 14): polled at scrape/snapshot
+        # time only; the weakref drops the source with the session
+        analytics.register_hbm_source(
+            "stream_twin", self.device,
+            lambda dev: (analytics.tree_nbytes((dev.statics, dev.carry)),
+                         1 if dev.valid else 0))
 
     def set_policy(self, policy=None, compiled_policy=None) -> None:
         """Swap the session's scheduling policy. The next cycle restages
@@ -618,7 +625,11 @@ class StreamSession:
             if csp:
                 csp.set("pods", len(pods))
                 csp.set("nodes", len(inc.nodes))
-        register().backend_compile_latency.observe(since_in_microseconds(t0))
+        compile_us = since_in_microseconds(t0)
+        register().backend_compile_latency.observe(compile_us)
+        analytics.note_compile(
+            "stream_restage",
+            f"plan={self._plan_key}/nodes={len(inc.nodes)}", compile_us)
         unsupported = list(compiled.unsupported)
         if cp is not None:
             unsupported.extend(cp.unsupported)
@@ -777,6 +788,13 @@ class StreamSession:
         # classification are untouched (failure text is already the
         # byte-identical FitError rendering from decode_placements)
         provenance.capture(placements, "stream", cycle=self.cycles)
+        # cluster analytics (ISSUE 14): one extra O(N) reduction dispatch
+        # over columns the scan already owns — the scan program itself is
+        # untouched, so placement hashes and restage classification are
+        # pinned; one None-check when disabled
+        analytics.capture(statics, final_carry,
+                          len(compiled.statics.names), "stream",
+                          cycle=self.cycles, names=compiled.statics.names)
         return final_carry, placements, corrupt_kind is not None
 
     def _host_cycle(self, pods: List[Pod], reason: str) -> List[Placement]:
@@ -947,6 +965,13 @@ class StreamSession:
             dsp.set("pods", p)
             dsp.end()
         dev.carry = final_carry
+        # analytics rides the un-forced final carry: the reduction is
+        # itself an async dispatch, so the pipeline's decode/device overlap
+        # is preserved (nothing here blocks)
+        analytics.capture(dev.statics, final_carry,
+                          len(dev.compiled.statics.names), "stream",
+                          cycle=self.cycles,
+                          names=dev.compiled.statics.names)
         self._pending = _PendingCycle(pods, choices, counts, dev.compiled,
                                       t0, perf_counter(),
                                       wal_cycle=wal_cycle)

@@ -929,6 +929,8 @@ LADDER_CONFIGS = {
                      autoladder=True),
     12: LadderConfig(lambda p, b, c: measure_analytics_overhead(p),
                      autoladder=True),
+    13: LadderConfig(lambda p, b, c: measure_gang_ladder(p),
+                     autoladder=True),
 }
 
 
@@ -1681,6 +1683,95 @@ def measure_analytics_overhead(platform: str) -> dict:
                                if k != "sample"},
         "sample": overhead["sample"],
         "chains_identical": on_chain == off_chain,
+        "metrics": _metrics_snapshot(reset=True),
+    }
+
+
+def measure_gang_ladder(platform: str) -> dict:
+    """Config 13: gang admission (tpusim/gang). Two arms over one
+    rack-labeled cluster: (a) steady-state throughput of the stream gang
+    route (every cycle carries pod groups, so each decision pays the joint
+    host-oracle/kernel solve); (b) a packing-quality A/B — the same gang
+    feed placed by the group driver vs stripped of its annotations and
+    placed per-pod, comparing racks-touched-per-gang (the cross-rack
+    spread the rank-aware packer exists to minimize) and node packing."""
+    from tpusim.api.snapshot import make_pod, synthetic_cluster
+    from tpusim.gang.group import (
+        GANG_MIN_AVAILABLE_ANNOTATION,
+        GANG_NAME_ANNOTATION,
+        gang_name,
+        mark_gang,
+    )
+    from tpusim.simulator import run_simulation, run_stream_simulation
+
+    nodes, cycles, arrivals = ((2_000, 30, 32) if platform != "cpu"
+                               else (400, 16, 16))
+    gang_size, gang_count = 8, 2
+
+    def racked(n):
+        snap = synthetic_cluster(n)
+        for i, node in enumerate(snap.nodes):
+            node.metadata.labels["topology.kubernetes.io/rack"] = \
+                f"rack-{i // 16}"
+        return snap
+
+    # arm (a): stream throughput with gangs riding every cycle
+    snap = racked(nodes)
+    run_stream_simulation(snap, cycles=3, arrivals=arrivals,
+                          gang_size=gang_size, gang_count=gang_count,
+                          seed=13)  # absorb tracing
+    out = run_stream_simulation(racked(nodes), cycles=cycles,
+                                arrivals=arrivals, evict_fraction=0.25,
+                                gang_size=gang_size, gang_count=gang_count,
+                                seed=13)
+
+    # arm (b): packing quality A/B on a one-shot multi-gang batch
+    def gang_feed():
+        pods = []
+        for g in range(8):
+            pods += [mark_gang(make_pod(f"b13-g{g}-{j}", milli_cpu=500),
+                               f"b13-g{g}") for j in range(gang_size)]
+        return pods
+
+    def spread(status, by):
+        groups = {}
+        for p in status.successful_pods:
+            name = by(p)
+            if not name:
+                continue
+            idx = int(p.spec.node_name.split("-")[-1])
+            groups.setdefault(name, set()).add(idx // 16)
+        if not groups:
+            return 0.0
+        return sum(len(r) for r in groups.values()) / len(groups)
+
+    ab_snap = racked(256 if platform != "cpu" else 128)
+    grouped = run_simulation(gang_feed(), ab_snap, backend="jax")
+    stripped = gang_feed()
+    for p in stripped:
+        p.metadata.annotations.pop(GANG_NAME_ANNOTATION, None)
+        p.metadata.annotations.pop(GANG_MIN_AVAILABLE_ANNOTATION, None)
+    solo = run_simulation(stripped, ab_snap, backend="jax")
+    gang_spread = spread(grouped, gang_name)
+    solo_spread = spread(solo, lambda p: p.metadata.name.rsplit("-", 1)[0])
+    log(f"[config 13] racks/gang: grouped={gang_spread:.2f} "
+        f"per-pod={solo_spread:.2f} "
+        f"(stream {out['decisions_per_s']:.0f} dec/s, "
+        f"paths={out['paths']})")
+
+    return {
+        "metric": f"gang-cycle churn decisions/sec (config 13: "
+                  f"{gang_count}x{gang_size}-member pod groups + {arrivals} "
+                  f"solo arrivals per cycle, {nodes} rack-labeled nodes, "
+                  f"platform={platform})",
+        "value": out["decisions_per_s"], "unit": "decisions/s",
+        "vs_baseline": 0,
+        "p50_cycle_ms": out["p50_cycle_ms"],
+        "p99_cycle_ms": out["p99_cycle_ms"],
+        "paths": out["paths"],
+        "gangs_fed": out["load"]["gangs"],
+        "racks_per_gang_grouped": gang_spread,
+        "racks_per_gang_per_pod": solo_spread,
         "metrics": _metrics_snapshot(reset=True),
     }
 

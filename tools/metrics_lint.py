@@ -39,7 +39,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*[a-z0-9]$")
 _HIST_UNIT_SUFFIXES = ("_microseconds", "_us", "_seconds", "_bytes")
 # unitless-by-design histograms (counts per bucket, not a measured unit)
-_UNITLESS_HISTOGRAMS = {"tpusim_serve_batch_occupancy"}
+_UNITLESS_HISTOGRAMS = {"tpusim_serve_batch_occupancy", "tpusim_gang_size"}
 _GAUGE_UNIT_SUFFIXES = ("_bytes", "_ratio", "_seconds", "_microseconds",
                         "_us")
 # unitless-by-design gauges: dimensionless levels, counts, and rates

@@ -280,6 +280,12 @@ run_stage recovery configs:11 bench_results/r5_tpu_recovery.jsonl \
     env TPUSIM_BENCH_LADDER_CONFIGS=11 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
     python bench.py --ladder
 
+echo "== stage 3f: gang admission (config 13: gang-cycle throughput + rack-spread A/B) =="
+run_stage gang configs:13 bench_results/r5_tpu_gang.jsonl \
+    bench_results/r5_tpu_gang.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=13 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+
 echo "== stage 4: full XLA ladder (configs 1-5; fresh same-round parity anchors) =="
 run_stage ladder configs:1,2,3,4,5 bench_results/r5_tpu_ladder.jsonl \
     bench_results/r5_tpu_ladder.log \

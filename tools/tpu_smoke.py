@@ -799,6 +799,84 @@ def run_analytics_variant():
     return off_hash[:16], n_samples, sorted(sources)
 
 
+def run_gang_variant():
+    """Gang admission (tpusim/gang) stage-0: (a) the host oracle and the
+    batched kernel route must produce byte-identical placements for the
+    same gang feed (TPUSIM_GANG_KERNEL=0 vs =1); (b) all-or-nothing — an
+    oversized gang binds ZERO members and every member carries the SAME
+    FitError message; (c) a gang-free feed's placement hash is untouched
+    by the group driver's presence (annotation is the only trigger)."""
+    from tpusim.backends import Placement, placement_hash
+    from tpusim.gang.group import mark_gang
+    from tpusim.simulator import run_simulation
+
+    def cluster():
+        nodes = [make_node(f"gn{i}", milli_cpu=4000,
+                           labels={"topology.kubernetes.io/rack":
+                                   f"rack-{i // 2}"})
+                 for i in range(6)]
+        return ClusterSnapshot(nodes=nodes, pods=[])
+
+    def feed(gang=True):
+        pods = [make_pod(f"gs{i}", milli_cpu=200) for i in range(4)]
+        if gang:
+            pods += [mark_gang(make_pod(f"gg-{j}", milli_cpu=800), "gg")
+                     for j in range(4)]
+        return pods
+
+    def run_route(kernel_env):
+        prev = os.environ.get("TPUSIM_GANG_KERNEL")
+        os.environ["TPUSIM_GANG_KERNEL"] = kernel_env
+        try:
+            from tpusim.jaxe.backend import reset_fast_auto
+
+            reset_fast_auto()
+            st = run_simulation(feed(), cluster(), backend="jax")
+        finally:
+            if prev is None:
+                os.environ.pop("TPUSIM_GANG_KERNEL", None)
+            else:
+                os.environ["TPUSIM_GANG_KERNEL"] = prev
+        return placement_hash(
+            [Placement(pod=p, node_name=p.spec.node_name)
+             for p in sorted(st.successful_pods,
+                             key=lambda p: p.metadata.name)]
+            + [Placement(pod=p, reason="Unschedulable")
+               for p in sorted(st.failed_pods,
+                               key=lambda p: p.metadata.name)])
+
+    host_hash = run_route("0")
+    kernel_hash = run_route("1")
+    if host_hash != kernel_hash:
+        raise AssertionError(
+            f"gang kernel route diverges from the host oracle "
+            f"({kernel_hash[:16]} != {host_hash[:16]})")
+
+    # all-or-nothing: 8 x 3900m on 6 x 4000m nodes cannot all fit
+    big = [mark_gang(make_pod(f"big-{j}", milli_cpu=3900), "big")
+           for j in range(8)]
+    st = run_simulation(big, cluster(), backend="jax")
+    if st.successful_pods:
+        raise AssertionError(
+            f"rejected gang left {len(st.successful_pods)} members bound")
+    msgs = {p.status.conditions[-1].message for p in st.failed_pods}
+    if len(st.failed_pods) != 8 or len(msgs) != 1:
+        raise AssertionError(
+            f"expected 8 members sharing one FitError, got "
+            f"{len(st.failed_pods)} members / {len(msgs)} messages")
+
+    # gang-free identity across backends (the annotation is the trigger)
+    ref = run_simulation(feed(gang=False), cluster(), backend="reference")
+    jx = run_simulation(feed(gang=False), cluster(), backend="jax")
+    ref_bind = sorted((p.metadata.name, p.spec.node_name)
+                      for p in ref.successful_pods)
+    jx_bind = sorted((p.metadata.name, p.spec.node_name)
+                     for p in jx.successful_pods)
+    if ref_bind != jx_bind:
+        raise AssertionError("gang-free feed diverges between backends")
+    return host_hash[:16], len(feed()), len(msgs)
+
+
 def _write_smoke_trace(recorder):
     """Persist the sweep's flight-recorder trace; never fail the smoke."""
     path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
@@ -997,6 +1075,25 @@ def main() -> int:
             ran += 1
             print(f"SMOKE analytics: OK hash={h} samples={n_samples} "
                   f"sources={'+'.join(sources)} "
+                  f"({time.time() - t:.1f}s)", flush=True)
+        if not only or "gang" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "gang")
+            try:
+                h, n_pods, n_msgs = run_gang_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: gang: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.end()
+            ran += 1
+            print(f"SMOKE gang: OK hash={h} pods={n_pods} "
+                  f"shared_fit_msgs={n_msgs} "
                   f"({time.time() - t:.1f}s)", flush=True)
     finally:
         flight.uninstall()

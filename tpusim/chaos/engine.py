@@ -317,10 +317,54 @@ class ChaosEngine:
         cleared = queue.clear_nominations_for_node(node.name)
         for pod in cleared:
             pod.status.nominated_node_name = ""
+        self._release_gang_members(node.name)
         if flap:
             self._pending_restores.append(
                 (self.boundary + ev.restore_after, node))
         return True
+
+    def _release_gang_members(self, node_name: str) -> None:
+        """No partial gang bound: losing a node releases every gang with a
+        member bound on it — all still-bound mates (on ANY node) are
+        evicted through the same store mechanics as _pod_evict, so a
+        surviving fraction can never masquerade as an admitted group. Fed
+        members are re-fed for a fresh all-or-nothing attempt against the
+        shrunken cluster."""
+        from tpusim.api.types import ResourceType
+        from tpusim.gang.group import gang_name
+
+        store = self.cc.resource_store
+        doomed = {gang_name(p) for p in store.list(ResourceType.PODS)
+                  if gang_name(p) and p.spec.node_name == node_name}
+        if not doomed:
+            return
+        released = 0
+        for pod in list(store.list(ResourceType.PODS)):
+            if gang_name(pod) not in doomed or not pod.spec.node_name:
+                continue
+            store.delete(ResourceType.PODS, pod)
+            key = pod.key()
+            self.evicted_keys.add(key)
+            st = self.cc.status
+            st.successful_pods = [p for p in st.successful_pods
+                                  if p.key() != key]
+            st.scheduled_pods = [p for p in st.scheduled_pods
+                                 if p.key() != key]
+            if key in self.fed_keys:
+                fresh = pod.copy()
+                fresh.spec.node_name = ""
+                fresh.status.phase = ""
+                fresh.status.conditions = []
+                fresh.status.reason = ""
+                self.cc.pod_queue.push(fresh)
+                self.requeued_keys.add(key)
+            released += 1
+        self.cc.metrics.gang_partial_rollback.inc()
+        cleared = self.cc.scheduling_queue.clear_nominations_for_gangs(doomed)
+        for pod in cleared:
+            pod.status.nominated_node_name = ""
+        note_fault("gang_release", {"groups": sorted(doomed),
+                                    "released": released})
 
     def _restore_node(self, node) -> None:
         from tpusim.api.types import ResourceType
@@ -515,6 +559,27 @@ def check_invariants(cc, engine: ChaosEngine) -> List[str]:
         node = p.spec.node_name
         if node not in live_nodes and node not in engine.deleted_nodes:
             violations.append(f"{p.key()} bound to unknown node {node}")
+
+    # no partial gang bound (tpusim/gang): a pod group either holds at
+    # least its min-available members or none at all — chaos that breaks a
+    # gang mid-flight must have released every member
+    from tpusim.gang.group import PodGroup, gang_name
+
+    members: Dict[str, Dict[str, object]] = {}
+    for p in (list(cc.resource_store.list(ResourceType.PODS))
+              + st.successful_pods + st.failed_pods):
+        name = gang_name(p)
+        if name:
+            members.setdefault(name, {})[p.key()] = p
+    for name, by_key in sorted(members.items()):
+        group = PodGroup(name=name, pods=list(by_key.values()))
+        bound = sum(1 for p in cc.resource_store.list(ResourceType.PODS)
+                    if gang_name(p) == name and p.spec.node_name)
+        if 0 < bound < group.min_available:
+            violations.append(
+                f"partial gang bound: group {name} holds {bound}/"
+                f"{len(group.pods)} members (min-available "
+                f"{group.min_available})")
 
     # cache/store coherence: every store-bound pod the cache still tracks
     # must agree on its node (the informer seam never diverged)

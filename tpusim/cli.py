@@ -707,6 +707,13 @@ def build_stream_parser() -> argparse.ArgumentParser:
                         help="Toggle a NoSchedule taint on N random nodes "
                              "per cycle (taint-only churn: scatter path, "
                              "no restage)")
+    parser.add_argument("--gang-size", type=int, default=0,
+                        help="Members per generated pod group (tpusim/gang: "
+                             "all-or-nothing admission with rank-aware "
+                             "packing; 0 = no gangs)")
+    parser.add_argument("--gang-count", type=int, default=0,
+                        help="Pod groups appended to each cycle's arrivals "
+                             "(requires --gang-size)")
     parser.add_argument("--seed", type=int, default=0,
                         help="Load-generator seed")
     parser.add_argument("--algorithmprovider", default="DefaultProvider")
@@ -809,6 +816,7 @@ def stream_cli(argv) -> int:
             arrivals=args.arrivals, evict_fraction=args.evict_fraction,
             node_flap_every=args.flap_every, seed=args.seed,
             label_churn=args.label_churn, taint_churn=args.taint_churn,
+            gang_size=args.gang_size, gang_count=args.gang_count,
             provider=args.algorithmprovider,
             policy=policy, pipeline=args.pipeline,
             always_restage=args.always_restage, verify=args.verify,

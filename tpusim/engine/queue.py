@@ -106,6 +106,20 @@ class SchedulingQueue:
         return the affected pods so the caller can clear their status."""
         return []
 
+    def take_matching(self, pred) -> List[Pod]:
+        """Remove and return every queued pod satisfying `pred` — the gang
+        gather on retry: a popped group member pulls its queued mates
+        forward so the group re-decides as one unit. Implementations
+        without queued state hold nothing to gather."""
+        return []
+
+    def clear_nominations_for_gangs(self, names) -> List[Pod]:
+        """Drop every nomination held by a member of the named pod groups
+        (the gang released — e.g. one member was preempted, so its mates'
+        nominations are promises for a group that no longer stands) and
+        return the affected pods."""
+        return []
+
 
 class FIFO(SchedulingQueue):
     """Reference: scheduling_queue.go:73-139 — wrapper over cache.FIFO."""
@@ -154,6 +168,12 @@ class FIFO(SchedulingQueue):
 
     def waiting_pods_for_node(self, node_name: str) -> List[Pod]:
         return []
+
+    def take_matching(self, pred) -> List[Pod]:
+        taken = [p for p in self._items.values() if pred(p)]
+        for pod in taken:
+            self.delete(pod)
+        return taken
 
     def __len__(self) -> int:
         return len(self._items)
@@ -342,6 +362,36 @@ class PriorityQueue(SchedulingQueue):
             self._move_pods_to_active_queue(
                 [p for p in cleared if p.key() in self._unschedulable])
         return list(cleared)
+
+    def take_matching(self, pred) -> List[Pod]:
+        taken = [p for p in self._active_items.values() if pred(p)]
+        taken += [p for p in self._unschedulable.values() if pred(p)]
+        for pod in taken:
+            self.delete(pod)
+        return taken
+
+    def clear_nominations_for_gangs(self, names) -> List[Pod]:
+        from tpusim.gang.group import gang_name
+
+        names = set(names)
+        cleared: List[Pod] = []
+        for node in list(self._nominated):
+            stale = [p for p in self._nominated[node]
+                     if gang_name(p) in names]
+            if not stale:
+                continue
+            remaining = [p for p in self._nominated[node]
+                         if gang_name(p) not in names]
+            if remaining:
+                self._nominated[node] = remaining
+            else:
+                del self._nominated[node]
+            cleared.extend(stale)
+        if cleared:
+            # released members re-attempt with the rest of their gang
+            self._move_pods_to_active_queue(
+                [p for p in cleared if p.key() in self._unschedulable])
+        return cleared
 
     def __len__(self) -> int:
         return len(self._active_items) + len(self._unschedulable)

@@ -483,6 +483,20 @@ class SchedulerMetrics:
         self.compile_cost = self._reg(LabeledCounter(
             "tpusim_compile_cost_us_total",
             "Cumulative compile walltime by observation site", "site"))
+        self.gang_admitted = self._reg(Counter(
+            "tpusim_gang_admitted_total",
+            "Pod groups admitted all-or-nothing (>= min-available placed)"))
+        self.gang_rejected = self._reg(LabeledCounter(
+            "tpusim_gang_rejected_total",
+            "Pod groups rejected whole with one shared FitError", "reason"))
+        self.gang_partial_rollback = self._reg(Counter(
+            "tpusim_gang_partial_rollback_total",
+            "Partially-bound gangs rolled back to zero members (commit "
+            "failure, preemption release, or chaos node loss)"))
+        self.gang_size = self._reg(Histogram(
+            "tpusim_gang_size",
+            "Members per admitted-or-rejected pod group",
+            [1, 2, 4, 8, 16, 32, 64]))
         # one lock for whole-registry reads: /metrics and snapshot() see a
         # single consistent exposition even while runtime threads observe
         self._read_lock = threading.Lock()

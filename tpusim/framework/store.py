@@ -96,5 +96,14 @@ class PodQueue:
             return None
         return self._pods.pop()
 
+    def take_matching(self, pred) -> list:
+        """Remove and return every queued pod satisfying `pred`, in pop
+        (LIFO) order — the gang gather: when a group member pops, its mates
+        are pulled forward so the group decides as one unit."""
+        taken = [p for p in reversed(self._pods) if pred(p)]
+        if taken:
+            self._pods = [p for p in self._pods if not pred(p)]
+        return taken
+
     def __len__(self) -> int:
         return len(self._pods)

@@ -1,0 +1,35 @@
+"""Gang admission: all-or-nothing pod-group scheduling (ISSUE 15).
+
+Pods carrying the ``pod-group.tpusim.io/name`` annotation are admitted as a
+group: either at least ``min-available`` members place together against one
+consistent snapshot, or the whole gang is rejected with a single shared
+FitError and zero binds. Placement is rank-aware — members pack toward
+zone/rack domains already holding their mates — solved jointly on host from
+the fused scan's per-member feasibility/score lanes, with a batched device
+kernel promoted behind the usual AUTO verify-then-trust seam.
+
+Explicitly NOT wavefront speculation (DEVIATIONS.md #8): the member lanes
+are evaluated against one frozen snapshot and the joint solve re-checks
+capacity arithmetically as members stack, so the decision is a consistent
+group admission, not optimistic multi-pod placement against stale state.
+"""
+
+from tpusim.gang.group import (
+    GANG_MIN_AVAILABLE_ANNOTATION,
+    GANG_NAME_ANNOTATION,
+    PodGroup,
+    gang_min_available,
+    gang_name,
+    has_gangs,
+    split_feed,
+)
+
+__all__ = [
+    "GANG_NAME_ANNOTATION",
+    "GANG_MIN_AVAILABLE_ANNOTATION",
+    "PodGroup",
+    "gang_name",
+    "gang_min_available",
+    "has_gangs",
+    "split_feed",
+]

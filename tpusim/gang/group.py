@@ -1,0 +1,108 @@
+"""Pod-group annotations and feed planning.
+
+The annotation schema (DEVIATIONS.md, gang entry) follows the
+kube-batch/coscheduling lineage: a group name plus an optional
+``min-available`` floor, carried as pod annotations so podspecs, the load
+generators, and watch events all transport gangs with zero new types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from tpusim.api.types import Pod
+
+GANG_NAME_ANNOTATION = "pod-group.tpusim.io/name"
+GANG_MIN_AVAILABLE_ANNOTATION = "pod-group.tpusim.io/min-available"
+
+
+def gang_name(pod: Pod) -> str:
+    """The pod's group name, or "" for an ungrouped pod."""
+    annotations = pod.metadata.annotations
+    if not annotations:
+        return ""
+    return str(annotations.get(GANG_NAME_ANNOTATION, "") or "")
+
+
+def gang_min_available(pod: Pod) -> int:
+    """The pod's declared min-available floor; 0 = "all members"."""
+    annotations = pod.metadata.annotations
+    if not annotations:
+        return 0
+    raw = annotations.get(GANG_MIN_AVAILABLE_ANNOTATION, "")
+    try:
+        return max(0, int(raw))
+    except (TypeError, ValueError):
+        return 0
+
+
+def mark_gang(pod: Pod, name: str, min_available: int = 0) -> Pod:
+    """Stamp the group annotations onto `pod` (in place) and return it."""
+    pod.metadata.annotations[GANG_NAME_ANNOTATION] = name
+    if min_available:
+        pod.metadata.annotations[GANG_MIN_AVAILABLE_ANNOTATION] = \
+            str(min_available)
+    return pod
+
+
+def has_gangs(pods: Sequence[Pod]) -> bool:
+    """True when any pod in the batch carries a group annotation. The ONLY
+    routing trigger for the gang paths: gang-free feeds take the exact
+    pre-existing code, so their placement hashes are byte-identical by
+    construction."""
+    return any(gang_name(p) for p in pods)
+
+
+@dataclass
+class PodGroup:
+    """One gang, in feed order."""
+
+    name: str
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def min_available(self) -> int:
+        """The group's admission floor: the max declared min-available
+        across members (they should agree), defaulting to the full group
+        size — plain gangs are strictly all-or-nothing."""
+        declared = max((gang_min_available(p) for p in self.pods), default=0)
+        if declared <= 0:
+            return len(self.pods)
+        return min(declared, len(self.pods))
+
+
+@dataclass
+class FeedSegment:
+    """A contiguous run of the feed: either ungrouped pods (scheduled through
+    the unchanged per-pod path) or one complete gang."""
+
+    pods: Optional[List[Pod]] = None
+    group: Optional[PodGroup] = None
+
+
+def split_feed(pods: Sequence[Pod]) -> List[FeedSegment]:
+    """Partition a feed into ordered segments: maximal runs of ungrouped pods
+    and complete gangs. A gang's decision point is its FIRST member's feed
+    position; members arriving later in the feed are pulled forward into the
+    group (the queue analog gathers them from the pending queue)."""
+    segments: List[FeedSegment] = []
+    groups: dict = {}
+    run: List[Pod] = []
+    for pod in pods:
+        name = gang_name(pod)
+        if not name:
+            run.append(pod)
+            continue
+        group = groups.get(name)
+        if group is None:
+            if run:
+                segments.append(FeedSegment(pods=run))
+                run = []
+            group = PodGroup(name=name)
+            groups[name] = group
+            segments.append(FeedSegment(group=group))
+        group.pods.append(pod)
+    if run:
+        segments.append(FeedSegment(pods=run))
+    return segments

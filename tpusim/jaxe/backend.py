@@ -91,6 +91,9 @@ def reset_fast_auto() -> None:
     _FAST_AUTO["transient"] = 0
     _VICTIM_AUTO["disabled"] = False
     _VICTIM_AUTO["verified_sigs"] = set()
+    from tpusim.gang.kernel import _GANG_AUTO  # lazy: gang imports backend
+    _GANG_AUTO["disabled"] = False
+    _GANG_AUTO["verified_sigs"] = set()
     # disarm any leftover chaos seam (breaker + injector) the same way
     uninstall_chaos()
 

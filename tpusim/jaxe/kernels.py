@@ -1651,3 +1651,134 @@ def _analytics_reduce_impl(inp: AnalyticsIn, n_valid, *, k: int):
 
 analytics_reduce = partial(jax.jit, static_argnames=("k",))(
     _analytics_reduce_impl)
+
+
+# --------------------------------------------------------------------------
+# Gang admission (ISSUE 15): member lanes + joint rank-aware packing.
+#
+# A gang decision is two programs. First, `gang_lanes` vmaps the fused
+# scan's filter/score stage over the member rows against ONE frozen carry —
+# every member sees the identical snapshot, so the lanes are a consistent
+# (member, node) feasibility/score matrix, not a sequence of stale reads.
+# Second, `gang_select` solves the joint placement: a fori_loop over members
+# in feed order that packs each one onto the highest-ranked node, where the
+# rank key prefers zone domains and then rack domains already holding
+# placed mates and breaks ties by the scan's own score. Capacity is
+# re-checked arithmetically as members stack (the same resource-arithmetic
+# reprieve `_preempt_select_impl` applies to victims: cpu/mem/gpu/eph +
+# pod count; presence-dependent predicates are frozen at lane time — a
+# documented gang deviation). The host oracle in tpusim/gang/oracle.py
+# mirrors this loop in numpy with identical int64 arithmetic, so
+# device-vs-host choices are bit-exact, not within-epsilon.
+
+# Rank-key layout (int64): zone-mate count, then rack-mate count, then the
+# clipped scan score; -1 marks an infeasible/over-capacity node. First-
+# occurrence argmax resolves ties identically in numpy and XLA.
+GANG_ZONE_SHIFT = 52
+GANG_RACK_SHIFT = 32
+GANG_SCORE_MASK = (1 << 32) - 1
+
+
+class GangIn(NamedTuple):
+    """Per-node columns the packing solve reads ([N] each)."""
+
+    alloc_cpu: jnp.ndarray
+    alloc_mem: jnp.ndarray
+    alloc_gpu: jnp.ndarray
+    alloc_eph: jnp.ndarray
+    allowed_pods: jnp.ndarray
+    used_cpu: jnp.ndarray
+    used_mem: jnp.ndarray
+    used_gpu: jnp.ndarray
+    used_eph: jnp.ndarray
+    pod_count: jnp.ndarray
+    zone_dom: jnp.ndarray   # int32, 0 = no zone domain
+    rack_dom: jnp.ndarray   # int32, 0 = no rack domain
+
+
+def gang_columns(statics: Statics, carry: Carry, zone_dom, rack_dom) -> GangIn:
+    """Pack a GangIn from an engine (Statics, Carry) pair plus the packing
+    domain ids computed by the gang driver (plain field references)."""
+    return GangIn(
+        alloc_cpu=statics.alloc_cpu, alloc_mem=statics.alloc_mem,
+        alloc_gpu=statics.alloc_gpu, alloc_eph=statics.alloc_eph,
+        allowed_pods=statics.allowed_pods,
+        used_cpu=carry.used_cpu, used_mem=carry.used_mem,
+        used_gpu=carry.used_gpu, used_eph=carry.used_eph,
+        pod_count=carry.pod_count,
+        zone_dom=zone_dom, rack_dom=rack_dom)
+
+
+def _gang_lanes_impl(config: EngineConfig, carry: Carry, statics: Statics,
+                     xs: PodX):
+    """(feasible[M, N], score[M, N]): the fused scan's filter/score stage for
+    each member against the SAME carry. Only the two lanes the packing solve
+    consumes are returned — reason decoding for a rejected gang is the
+    driver's single shared FitError, not a per-member histogram."""
+
+    def lanes(x: PodX):
+        feasible, _bits, score, _n, _aca, _parts = _evaluate(
+            config, carry, statics, x)
+        return feasible, score
+
+    return jax.vmap(lanes)(xs)
+
+
+gang_lanes = partial(jax.jit, static_argnames=("config",))(_gang_lanes_impl)
+
+
+def _gang_select_impl(feasible, score, req_cpu, req_mem, req_gpu, req_eph,
+                      zero_request, gi: GangIn, n_zone: int, n_rack: int):
+    """Joint greedy packing over the (member, node) lanes. Returns
+    choices[M] (node index or -1). Members are visited in feed order; each
+    placement feeds the next member's domain bonuses and capacity stack."""
+    m, n = feasible.shape
+    del n  # shapes are static under jit; n documents the lane width
+
+    def body(i, state):
+        (gang_cpu, gang_mem, gang_gpu, gang_eph, gang_pods,
+         zone_cnt, rack_cnt, choices) = state
+        fits = (gi.pod_count + gang_pods + 1) <= gi.allowed_pods
+        check = ~zero_request[i]
+        fits &= ~check | (gi.alloc_cpu >= gi.used_cpu + gang_cpu + req_cpu[i])
+        fits &= ~check | (gi.alloc_mem >= gi.used_mem + gang_mem + req_mem[i])
+        fits &= ~check | (gi.alloc_gpu >= gi.used_gpu + gang_gpu + req_gpu[i])
+        fits &= ~check | (gi.alloc_eph >= gi.used_eph + gang_eph + req_eph[i])
+        ok = feasible[i] & fits
+        zone_bonus = jnp.where(gi.zone_dom > 0, zone_cnt[gi.zone_dom], 0)
+        rack_bonus = jnp.where(gi.rack_dom > 0, rack_cnt[gi.rack_dom], 0)
+        rank = ((zone_bonus.astype(jnp.int64) << GANG_ZONE_SHIFT)
+                + (rack_bonus.astype(jnp.int64) << GANG_RACK_SHIFT)
+                + jnp.clip(score[i], 0, GANG_SCORE_MASK))
+        rank = jnp.where(ok, rank, jnp.int64(-1))
+        choice = jnp.argmax(rank).astype(jnp.int32)
+        found = rank[choice] >= 0
+        idx = jnp.maximum(choice, 0)
+        gate = found.astype(jnp.int64)
+        gate32 = found.astype(jnp.int32)
+        # domain slot 0 is the "no domain" bucket: incrementing it is
+        # harmless because the bonus reads above gate on dom > 0
+        return (gang_cpu.at[idx].add(gate * req_cpu[i]),
+                gang_mem.at[idx].add(gate * req_mem[i]),
+                gang_gpu.at[idx].add(gate * req_gpu[i]),
+                gang_eph.at[idx].add(gate * req_eph[i]),
+                gang_pods.at[idx].add(gate),
+                zone_cnt.at[gi.zone_dom[idx]].add(gate32),
+                rack_cnt.at[gi.rack_dom[idx]].add(gate32),
+                choices.at[i].set(jnp.where(found, choice, -1)))
+
+    n_nodes = gi.alloc_cpu.shape[0]
+    init = (jnp.zeros(n_nodes, dtype=jnp.int64),
+            jnp.zeros(n_nodes, dtype=jnp.int64),
+            jnp.zeros(n_nodes, dtype=jnp.int64),
+            jnp.zeros(n_nodes, dtype=jnp.int64),
+            jnp.zeros(n_nodes, dtype=jnp.int64),
+            jnp.zeros(n_zone, dtype=jnp.int32),
+            jnp.zeros(n_rack, dtype=jnp.int32),
+            jnp.full(m, -1, dtype=jnp.int32))
+    state = jax.lax.fori_loop(0, m, body, init)
+    return state[-1]
+
+
+gang_select = partial(jax.jit, static_argnames=("n_zone", "n_rack"))(
+    _gang_select_impl)

@@ -285,6 +285,16 @@ def note_victim_path(path: str) -> None:
         rec.instant("victim:" + path, "device")
 
 
+def note_gang(event: str, args: Optional[Dict[str, Any]] = None) -> None:
+    """A gang admission decision (tpusim/gang): admit/reject/rollback/
+    release. The matching counters (gang_admitted/gang_rejected/
+    gang_partial_rollback) are incremented by the caller, which knows the
+    reason label; this bridge only emits the flight-recorder instant."""
+    rec = _active
+    if rec is not None:
+        rec.instant("gang:" + event, "host", args)
+
+
 def note_fault(kind: str, args: Optional[Dict[str, Any]] = None) -> None:
     """A chaos-injected fault: node_delete/node_cordon/node_flap/
     node_restore/pod_evict, watch_drop/watch_dup/watch_disconnect,
@@ -372,7 +382,8 @@ def note_stream_cycle(path: str, pods: Optional[int] = None) -> None:
     """One StreamSession scheduling cycle: stream_scan (O(delta) resident
     dispatch), pipelined (resident dispatch with deferred decode),
     restage_scan (full re-stage + dispatch), host (reference fallback under
-    chaos/unsupported features), or no_nodes (empty cluster — nothing to
+    chaos/unsupported features), gang (multi-pod all-or-nothing group
+    cycle via tpusim/gang), or no_nodes (empty cluster — nothing to
     dispatch)."""
     _metrics.register().stream_cycles.inc(path)
     rec = _active

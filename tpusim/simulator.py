@@ -416,6 +416,165 @@ class ClusterCapacity:
         metrics.e2e_scheduling_latency.observe(since_in_microseconds(e2e_start))
         return "bound"
 
+    # --- gang admission (tpusim/gang): all-or-nothing group scheduling ---
+
+    def _schedule_or_admit(self, pod: Pod) -> str:
+        """Per-pod dispatch: a pod carrying a group annotation routes its
+        whole gang through all-or-nothing admission; everything else takes
+        the unchanged scheduleOne path."""
+        from tpusim.gang.group import gang_name
+
+        if gang_name(pod):
+            return self._admit_gang(pod)
+        return self._schedule_one(pod)
+
+    def _gather_gang(self, pod: Pod):
+        """Pull `pod`'s mates forward — from the LIFO feed and, on retries,
+        from the scheduling queue — so the group decides as one unit at the
+        first member's feed position."""
+        from tpusim.gang.group import PodGroup, gang_name
+
+        name = gang_name(pod)
+        members = [pod]
+        seen = {pod.key()}
+        for mate in (self.pod_queue.take_matching(
+                lambda p: gang_name(p) == name)
+                + self.scheduling_queue.take_matching(
+                    lambda p: gang_name(p) == name)):
+            if mate.key() not in seen:
+                seen.add(mate.key())
+                members.append(mate)
+        return PodGroup(name=name, pods=members)
+
+    def _trial_member(self, pod: Pod) -> Optional[str]:
+        """One member's trial: schedule + assume + bind (so the next member
+        sees the placement), WITHOUT the unschedulable intercept — failure
+        attribution belongs to the group decision, not the member. Returns
+        the host or None."""
+        node_infos = self.refresh_node_info_snapshot()
+        try:
+            host = self.scheduler.schedule(pod, self.nodes, node_infos)
+        except SchedulingError:
+            return None
+        assumed = pod.copy()
+        assumed.spec.node_name = host
+        try:
+            self.cache.assume_pod(assumed)
+        except CacheError:
+            return None
+        try:
+            self.bind(pod, host)
+        except SchedulingError:
+            self.cache.forget_pod(assumed)
+            return None
+        self.cache.finish_binding(assumed)
+        return host
+
+    def _admit_gang(self, pod: Pod) -> str:
+        """All-or-nothing admission of `pod`'s group: gather the mates,
+        trial-bind members sequentially (intra-gang binds visible), then
+        either keep the binds (>= min-available placed) or roll every one
+        back through the store — the cache sees the deletes — and park the
+        whole gang with ONE shared FitError. Gang admission does not
+        attempt preemption (documented in DEVIATIONS.md)."""
+        from tpusim.gang.driver import gang_fit_message
+
+        group = self._gather_gang(pod)
+        m = self.metrics
+        m.gang_size.observe(len(group.pods))
+        bound: List[Pod] = []
+        overflow: List[Pod] = []
+        with flight.span("gang:admit") as sp:
+            if sp:
+                sp.set("group", group.name)
+                sp.set("members", len(group.pods))
+            for member in group.pods:
+                _stored, exists = self.resource_store.get(
+                    ResourceType.PODS, member.key())
+                if not exists:
+                    self.resource_store.add(ResourceType.PODS, member)
+                if self.chaos is not None:
+                    # mates pulled forward never went through _next_pod:
+                    # they are fed HERE, so the no-pod-lost audit and the
+                    # eviction re-feed mechanics cover them too
+                    self.chaos.note_fed(member)
+                if self._trial_member(member) is not None:
+                    bound.append(member)
+                else:
+                    overflow.append(member)
+
+        if len(bound) >= group.min_available:
+            # admitted: the gang stands; overflow members failed
+            # individually, not the gang
+            keys = {p.key() for p in bound}
+            self.status.failed_pods = [
+                p for p in self.status.failed_pods if p.key() not in keys]
+            for member in overflow:
+                msg = (f"pod group \"{group.name}\" admitted at "
+                       f"{len(bound)}/{len(group.pods)}; this member did "
+                       f"not fit.")
+                self.update(member, PodCondition(
+                    type="PodScheduled", status="False",
+                    reason="Unschedulable", message=msg))
+            m.gang_admitted.inc()
+            flight.note_gang("admit", {"group": group.name,
+                                       "placed": len(bound),
+                                       "members": len(group.pods)})
+            return "bound"
+
+        # rejected: roll back every trial bind so no partial gang survives
+        msg = gang_fit_message(group, len(self.nodes), len(bound))
+        for member in bound:
+            current, exists = self.resource_store.get(
+                ResourceType.PODS, member.key())
+            if exists and current.spec.node_name:
+                self.resource_store.delete(ResourceType.PODS, current)
+            key = member.key()
+            self.status.successful_pods = [
+                p for p in self.status.successful_pods if p.key() != key]
+            # the pristine pending member goes back to the store, exactly
+            # like a pod that never trial-bound
+            self.resource_store.add(ResourceType.PODS, member)
+        if bound:
+            m.gang_partial_rollback.inc()
+            flight.note_gang("rollback", {"group": group.name,
+                                          "unbound": len(bound)})
+        m.gang_rejected.inc("min_available" if self.nodes else "no_nodes")
+        flight.note_gang("reject", {"group": group.name,
+                                    "placed": len(bound)})
+        for member in group.pods:
+            self.update(member, PodCondition(
+                type="PodScheduled", status="False",
+                reason="Unschedulable", message=msg))
+        return "failed"
+
+    def _release_gangs(self, names, preemptor: Pod, node) -> None:
+        """A preempted member releases its whole gang: every still-bound
+        mate is deleted from the store (the cache sees the deletes), moved
+        to the preempted bucket, and the group's queued nominations are
+        cleared so parked members re-attempt as a unit."""
+        from tpusim.gang.group import gang_name
+
+        m = self.metrics
+        for mate in list(self.resource_store.list(ResourceType.PODS)):
+            if gang_name(mate) not in names or not mate.spec.node_name:
+                continue
+            self.resource_store.delete(ResourceType.PODS, mate)
+            key = mate.key()
+            self.status.successful_pods = [
+                p for p in self.status.successful_pods if p.key() != key]
+            self.status.scheduled_pods = [
+                p for p in self.status.scheduled_pods if p.key() != key]
+            self.status.preempted_pods.append(mate)
+            self.recorder.eventf(mate, "Normal", "Preempted",
+                                 "gang released by %s on node %s",
+                                 preemptor.name, node.name)
+            m.gang_partial_rollback.inc()
+        cleared = self.scheduling_queue.clear_nominations_for_gangs(names)
+        for p in cleared:
+            p.status.nominated_node_name = ""
+        flight.note_gang("release", {"groups": sorted(names)})
+
     def attempt_preemption(self, pod: Pod, fit_err: FitError,
                            candidate_filter=None):
         """The preemption arm of scheduleOne (scheduler.go:449-455 → the full
@@ -476,6 +635,13 @@ class ClusterCapacity:
                 p for p in self.status.scheduled_pods if p.key() != key]
             self.recorder.eventf(victim, "Normal", "Preempted",
                                  "by %s on node %s", pod.name, node.name)
+        from tpusim.gang.group import gang_name
+
+        gang_names = {gang_name(v) for v in victims if gang_name(v)}
+        if gang_names:
+            # preempting one member releases the whole gang — an
+            # all-or-nothing admission cannot survive partially
+            self._release_gangs(gang_names, pod, node)
         return node, victims
 
     STOP_REASONS = {
@@ -503,7 +669,7 @@ class ClusterCapacity:
                 # last went idle (the reference's scheduling-queue wait)
                 rec.add_span("queue_wait", "host", idle_since, rec.clock(),
                              {"pod": pod.key()})
-            outcome = self._schedule_one(pod)
+            outcome = self._schedule_or_admit(pod)
             if rec is not None:
                 idle_since = rec.clock()
             next_pod = self._next_pod()
@@ -536,7 +702,7 @@ class ClusterCapacity:
             pod = self._next_pod()
             if pod is not None:
                 chaos.note_fed(pod)
-                outcome = self._schedule_one(pod)
+                outcome = self._schedule_or_admit(pod)
                 continue
             if chaos.has_pending_churn():
                 # churn scheduled past the attempt horizon may still evict
@@ -546,7 +712,7 @@ class ClusterCapacity:
             if retry is None:
                 break
             if chaos.allow_retry(retry):
-                outcome = self._schedule_one(retry)
+                outcome = self._schedule_or_admit(retry)
         if spent >= budget:
             chaos.record_violation(
                 f"attempt budget exhausted ({budget}): the run did not "
@@ -730,7 +896,23 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
             raise ValueError("--enable-volume-scheduling requires --backend "
                              "reference (delayed PV binding is stateful "
                              "host-side matching)")
+        from tpusim.gang.group import has_gangs
+
         if enable_pod_priority:
+            if has_gangs(pods):
+                # preemption interplay (gang release, nomination cleanup)
+                # lives in the host orchestrator's queue/store machinery;
+                # the device hybrid has no group-aware retry loop
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pod groups with PodPriority are host-bound: running "
+                    "the reference orchestrator instead of the jax backend")
+                return run_simulation(
+                    pods, snapshot, provider=provider, backend="reference",
+                    scheduler_name=scheduler_name, enable_pod_priority=True,
+                    policy=policy, events=events,
+                    feature_gates=feature_gates, chaos_plan=chaos_plan)
             # host-device hybrid: device scan schedules, the exact host
             # Preempt pipeline fires on failures (jaxe/preempt.py)
             from tpusim.jaxe.preempt import run_with_preemption
@@ -740,8 +922,9 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
         jax_backend = get_backend("jax", provider=provider, policy=policy,
                                   compiled_policy=compiled_policy)
         feed = list(reversed(pods))  # the LIFO queue pops the last element first
+        gangs = has_gangs(feed)
         precompiled = (incremental.compile(feed) if incremental is not None
-                       and feed and snapshot.nodes else None)
+                       and feed and snapshot.nodes and not gangs else None)
         breaker = None
         if chaos_plan is not None and not chaos_plan.device.empty():
             from tpusim.jaxe.backend import install_chaos
@@ -752,8 +935,18 @@ def run_simulation(pods: List[Pod], snapshot: ClusterSnapshot,
                 if bsp:
                     bsp.set("backend", "jax")
                     bsp.set("pods", len(feed))
-                placements = jax_backend.schedule(feed, snapshot,
-                                                  precompiled=precompiled)
+                if gangs:
+                    # gang feeds route through the group driver: ungrouped
+                    # runs use the unchanged per-pod path against the live
+                    # incremental cluster, gangs are admitted all-or-nothing
+                    from tpusim.gang.driver import schedule_with_gangs
+                    from tpusim.jaxe.delta import IncrementalCluster
+
+                    inc = incremental or IncrementalCluster(snapshot)
+                    placements = schedule_with_gangs(jax_backend, inc, feed)
+                else:
+                    placements = jax_backend.schedule(
+                        feed, snapshot, precompiled=precompiled)
         finally:
             if breaker is not None:
                 from tpusim.jaxe.backend import uninstall_chaos
@@ -781,6 +974,7 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                           arrivals: int = 32, evict_fraction: float = 0.25,
                           node_flap_every: int = 0,
                           label_churn: int = 0, taint_churn: int = 0,
+                          gang_size: int = 0, gang_count: int = 0,
                           seed: int = 0,
                           provider: str = DEFAULT_PROVIDER,
                           policy=None, pipeline: bool = False,
@@ -809,6 +1003,10 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         placement chain are byte-identical to the synchronous path.
     label_churn / taint_churn: per-cycle label rewrites / taint toggles fed
         through the load generator (the scatter-absorbable churn class).
+    gang_size / gang_count: per-cycle pod-group arrivals (tpusim/gang):
+        each cycle carrying gangs runs as a multi-pod gang cycle —
+        all-or-nothing admission with rank-aware packing; fold-back stays
+        O(delta) through the journal's next-cycle scatter-commit.
     verify: additionally run every cycle through a fresh-compile
         JaxBackend.schedule and assert byte-identical placement hashes
         (pipelined cycles compare when their placements emerge, one cycle
@@ -894,7 +1092,8 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
     gen = ChurnLoadGen(snapshot, seed=seed, arrivals=arrivals,
                        evict_fraction=evict_fraction,
                        node_flap_every=node_flap_every,
-                       label_churn=label_churn, taint_churn=taint_churn)
+                       label_churn=label_churn, taint_churn=taint_churn,
+                       gang_size=gang_size, gang_count=gang_count)
     skip_events = 0
     if recover:
         # deterministic fast-forward: the generator draws NO rng in batch()
@@ -919,7 +1118,8 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                                evict_fraction=evict_fraction,
                                node_flap_every=node_flap_every,
                                label_churn=label_churn,
-                               taint_churn=taint_churn)
+                               taint_churn=taint_churn,
+                               gang_size=gang_size, gang_count=gang_count)
     import hashlib
 
     chain = hashlib.sha256()
@@ -971,11 +1171,21 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                 # comparison happens whenever the placements emerge
                 ref_inc.apply_events(ref_gen.events(cycle))
                 ref_batch = ref_gen.batch()
-                expected = ref_backend.schedule(ref_batch,
-                                                ref_inc.to_snapshot())
-                for pl in expected:
-                    if pl.node_name:
-                        ref_inc.apply(MODIFIED, pl.pod)
+                from tpusim.gang.group import has_gangs as _has_gangs
+
+                if _has_gangs(ref_batch):
+                    # the group driver applies its binds to ref_inc
+                    # internally — folding them again would double-apply
+                    from tpusim.gang.driver import schedule_with_gangs
+
+                    expected = schedule_with_gangs(ref_backend, ref_inc,
+                                                   ref_batch)
+                else:
+                    expected = ref_backend.schedule(ref_batch,
+                                                    ref_inc.to_snapshot())
+                    for pl in expected:
+                        if pl.node_name:
+                            ref_inc.apply(MODIFIED, pl.pod)
                 ref_gen.note_bound(expected)
                 expected_hashes.append(placement_hash(expected))
             if pipeline:

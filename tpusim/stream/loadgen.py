@@ -61,6 +61,10 @@ class ChurnLoadGen:
         CHURN_TAINT on this many nodes — label/taint-ONLY modifications,
         the exact churn class the v2 statics scatter path absorbs without
         a restage (ISSUE 9).
+    gang_size / gang_count: per cycle, append gang_count complete pod
+        groups of gang_size members each after the normal arrivals (the
+        gang delta class, ISSUE 15). Appended WITHOUT rng draws, so a
+        seeded run's churn chain is unchanged when gangs are off.
     """
 
     def __init__(self, snapshot: ClusterSnapshot, *, seed: int = 0,
@@ -69,7 +73,8 @@ class ChurnLoadGen:
                  label_churn: int = 0, taint_churn: int = 0,
                  label_universe: Optional[Dict[str, Tuple[str, ...]]] = None,
                  shapes: Tuple[Tuple[int, int], ...] = DEFAULT_SHAPES,
-                 name_prefix: str = "churn"):
+                 name_prefix: str = "churn",
+                 gang_size: int = 0, gang_count: int = 0):
         self.rng = random.Random(seed)
         self.nodes: List[Node] = list(snapshot.nodes)
         self.arrivals = arrivals
@@ -81,14 +86,19 @@ class ChurnLoadGen:
                                if label_universe is None else label_universe)
         self.shapes = shapes
         self.name_prefix = name_prefix
+        self.gang_size = gang_size
+        self.gang_count = gang_count
         self.serial = 0
+        self.gang_serial = 0
         self.bound: Dict[str, Pod] = {}     # pod name -> bound copy
         self._flapped: Optional[Node] = None  # cordoned node awaiting restore
         self.stats = {"arrivals": 0, "evictions": 0, "flaps": 0,
-                      "label_churns": 0, "taint_churns": 0}
+                      "label_churns": 0, "taint_churns": 0,
+                      "gang_arrivals": 0, "gangs": 0}
 
     def batch(self) -> List[Pod]:
-        """The cycle's fresh arrivals (Pending pods, no node)."""
+        """The cycle's fresh arrivals (Pending pods, no node); gang
+        arrivals, when configured, follow the normal ones."""
         out = []
         for _ in range(self.arrivals):
             cpu, mem = self.shapes[self.serial % len(self.shapes)]
@@ -96,6 +106,20 @@ class ChurnLoadGen:
                                 milli_cpu=cpu, memory=mem))
             self.serial += 1
         self.stats["arrivals"] += len(out)
+        if self.gang_size > 0 and self.gang_count > 0:
+            from tpusim.gang.group import mark_gang
+
+            for _ in range(self.gang_count):
+                name = f"{self.name_prefix}-gang-{self.gang_serial}"
+                self.gang_serial += 1
+                for j in range(self.gang_size):
+                    cpu, mem = self.shapes[self.serial % len(self.shapes)]
+                    out.append(mark_gang(
+                        make_pod(f"{name}-{j}", milli_cpu=cpu, memory=mem),
+                        name))
+                    self.serial += 1
+                self.stats["gangs"] += 1
+                self.stats["gang_arrivals"] += self.gang_size
         return out
 
     def events(self, cycle: int) -> List[Tuple[str, object]]:

@@ -79,6 +79,8 @@ from tpusim.framework.events import WatchExpiredError
 from tpusim.framework.metrics import register, since_in_microseconds
 from tpusim.framework.reflector import Reflector
 from tpusim.framework.store import MODIFIED
+from tpusim.gang.driver import schedule_with_gangs
+from tpusim.gang.group import has_gangs
 from tpusim.jaxe import backend as _backend
 from tpusim.jaxe import ensure_responsive_platform, ensure_x64
 from tpusim.jaxe.delta import _SIG_KINDS, IncrementalCluster
@@ -337,6 +339,7 @@ class StreamSession:
         self._statics_patch = None    # (padded idx, StaticsDelta) or None
         self._pending: Optional[_PendingCycle] = None
         self._last_path: Optional[str] = None
+        self._gang_jax = None         # lazy JaxBackend for gang cycles
         self.persist = None           # stream.persist.StreamPersistence
         # HBM residency accounting (ISSUE 14): polled at scrape/snapshot
         # time only; the weakref drops the source with the session
@@ -436,6 +439,8 @@ class StreamSession:
                 self.persist.log_emit(cid, placements)
             self._observe_cycle("no_nodes", t0)
             return placements
+        if has_gangs(pods):
+            return self._gang_cycle(pods, t0, cid)
         reason, cols = _routed if _routed is not None else self._route(pods)
         if reason is None:
             placements = self._stream_cycle(pods, cols)
@@ -455,6 +460,39 @@ class StreamSession:
             self.persist.log_emit(cid, placements)
         self._observe_cycle(self._last_path, t0)
         return placements
+
+    def _gang_cycle(self, pods: List[Pod], t0: float,
+                    cid) -> List[Placement]:
+        """A gang decision is a multi-pod cycle solved through the group
+        driver (tpusim/gang) against the live host picture: member lanes +
+        rank-aware joint packing, committed all-or-nothing. The driver
+        applies binds to `inc` directly, so the rows sit in the fold-back
+        journal and the NEXT cycle's scatter-commit carries them onto the
+        resident twin exactly like external churn — O(delta), residency
+        stays valid, nothing restages. The WAL hook is suppressed around
+        the driver (binds are journaled as bind records below, not as
+        synthetic watch events)."""
+        with self._persist_suppressed():
+            placements = schedule_with_gangs(
+                self._gang_backend(), self.inc, pods, source="stream-gang")
+        bound = [pl for pl in placements if pl.node_name]
+        self._note_path("gang", len(pods))
+        if cid is not None:
+            self.persist.log_bind(cid, bound)
+            self.persist.log_emit(cid, placements)
+        self._observe_cycle("gang", t0)
+        return placements
+
+    def _gang_backend(self):
+        """Lazy JaxBackend for gang cycles: the group driver's per-pod
+        segments and member lanes run through the batch backend, not the
+        resident twin (a gang decision re-snapshots by design)."""
+        if self._gang_jax is None:
+            self._gang_jax = _backend.JaxBackend(
+                provider=self.provider,
+                hard_pod_affinity_symmetric_weight=self.hard_weight,
+                policy=self.policy, compiled_policy=self.cp)
+        return self._gang_jax
 
     def _route(self, pods: List[Pod]):
         """Decide stream-vs-restage for a batch: returns (None, cols) when
@@ -853,7 +891,9 @@ class StreamSession:
         chaos = (_backend._CHAOS["breaker"] is not None
                  or _backend._CHAOS["injector"] is not None)
         routed = None
-        if not chaos and self.inc.nodes:
+        if not chaos and self.inc.nodes and not has_gangs(pods):
+            # gang batches run off-stream: schedule() routes them through
+            # the group driver's multi-pod cycle
             routed = self._route(pods)
         if routed is not None and routed[0] is None:
             self.cycles += 1

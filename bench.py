@@ -931,6 +931,8 @@ LADDER_CONFIGS = {
                      autoladder=True),
     13: LadderConfig(lambda p, b, c: measure_gang_ladder(p),
                      autoladder=True),
+    14: LadderConfig(lambda p, b, c: measure_shard_scaling(p),
+                     autoladder=True),
 }
 
 
@@ -1772,6 +1774,83 @@ def measure_gang_ladder(platform: str) -> dict:
         "gangs_fed": out["load"]["gangs"],
         "racks_per_gang_grouped": gang_spread,
         "racks_per_gang_per_pod": solo_spread,
+        "metrics": _metrics_snapshot(reset=True),
+    }
+
+
+def measure_shard_scaling(platform: str) -> dict:
+    """Config 14 (ISSUE 16): pods/s vs shard count for the node-sharded
+    backend route. One uniform batch through the FULL JaxBackend dispatch
+    (compile → pad → stage → shard_map scan) at TPUSIM_SHARDS ∈ {1, 2, 4},
+    each point stamped with its staging overhead (the shard:stage span:
+    pad + NamedSharding placement, paid per batch). TPUSIM_SHARD_VERIFY=0
+    for the curve — the verify replay runs the single-device scan beside
+    every first sharded batch, which is the seam's cost, not the route's.
+    TPUSIM_FAST=0 keeps the Pallas plan from absorbing the batch before
+    the shard decision. On the CPU host the mesh is virtual devices
+    sharing one socket, so the curve here measures partition overhead
+    (expect <= 1.0x); the TPU capture stages the same curve on a real
+    mesh where the per-shard O(N/k) evaluate actually parallelizes."""
+    import jax
+
+    from tpusim.backends import placement_hash
+    from tpusim.jaxe.backend import JaxBackend, reset_fast_auto
+    from tpusim.obs import recorder as flight
+
+    num_nodes = 8_192 if platform != "cpu" else 512
+    num_pods = num_nodes * 4  # exactly capacity: every pod places
+    shard_counts = [k for k in (1, 2, 4) if k <= len(jax.devices())]
+    timed_runs = 3
+    overrides = {"TPUSIM_FAST": "0", "TPUSIM_SHARD_VERIFY": "0"}
+    saved = {k: os.environ.get(k) for k in (*overrides, "TPUSIM_SHARDS")}
+    os.environ.update(overrides)
+    curve, hashes = [], set()
+    try:
+        for k in shard_counts:
+            os.environ["TPUSIM_SHARDS"] = str(k)
+            reset_fast_auto()
+            snapshot, pods = uniform_workload(num_pods, num_nodes)
+            backend = JaxBackend()
+            hashes.add(placement_hash(backend.schedule(pods, snapshot)))
+            samples, stage_us = [], []
+            for _ in range(timed_runs):
+                rec = flight.install(flight.FlightRecorder())
+                t0 = time.perf_counter()
+                backend.schedule(pods, snapshot)
+                samples.append(time.perf_counter() - t0)
+                flight.uninstall()
+                stage_us.append(sum(ev["dur"] for ev in rec.events
+                                    if ev["name"] == "shard:stage"))
+            med = float(np.median(samples))
+            curve.append({
+                "shards": k,
+                "pods_per_s": round(num_pods / med, 1),
+                "median_s": round(med, 4),
+                "stage_ms": round(float(np.median(stage_us)) / 1000, 3),
+            })
+            log(f"[config 14] shards={k}: "
+                f"{curve[-1]['pods_per_s']:.0f} pods/s "
+                f"(stage {curve[-1]['stage_ms']:.1f} ms)")
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        reset_fast_auto()
+    if len(hashes) != 1:
+        raise AssertionError(
+            f"shard ladder produced {len(hashes)} distinct placement "
+            "hashes; the route is not byte-stable across shard counts")
+    base = curve[0]["pods_per_s"]
+    return {
+        "metric": f"sharded-twin throughput curve (config 14: {num_pods}"
+                  f" uniform pods, {num_nodes} nodes, shards="
+                  f"{shard_counts}, platform={platform})",
+        "value": curve[-1]["pods_per_s"], "unit": "pods/s",
+        "vs_baseline": 0,
+        "shard_curve": curve,
+        "speedup_vs_one_shard": round(curve[-1]["pods_per_s"] / base, 3),
         "metrics": _metrics_snapshot(reset=True),
     }
 

@@ -1,0 +1,141 @@
+"""Property tests for the shared packed-key module (jaxe/packing.py).
+
+These lock the tie-break contract the cross-shard top-k merge depends on
+(ISSUE 16): a HIGHER encoded key means (better score, then LOWER index),
+so argmax over keys reproduces numpy/XLA first-occurrence argmax and a
+descending top-k equals a stable descending sort — on every shard AND
+across the shard merge, because the encoding is total over (score, index).
+The same properties back the analytics top-k and the gang rank key; one
+drifted shift constant here breaks device-vs-host bit parity everywhere.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusim.jaxe import ensure_x64
+from tpusim.jaxe.packing import (
+    GANG_SCORE_MASK,
+    TIE_BITS,
+    TIE_MASK,
+    decode_topk_key,
+    encode_gang_rank,
+    encode_topk_keys,
+)
+
+ensure_x64()
+
+
+def _random_case(rng, n):
+    """Scores drawn from a tiny alphabet so duplicates are guaranteed."""
+    score = rng.randint(0, 5, size=n).astype(np.int64)
+    index = np.arange(n, dtype=np.int64)
+    valid = rng.rand(n) < 0.8
+    if not valid.any():
+        valid[rng.randint(n)] = True
+    return score, index, valid
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 7, 13, 42])
+def test_argmax_over_keys_is_first_occurrence(seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(50):
+        n = rng.randint(2, 65)
+        score, index, valid = _random_case(rng, n)
+        keys = encode_topk_keys(score, index, valid)
+        best_score, best_idx = decode_topk_key(keys.max())
+        masked = np.where(valid, score, np.int64(-1))
+        want_idx = int(np.argmax(masked))  # numpy = first occurrence
+        assert int(best_idx) == want_idx
+        assert int(best_score) == int(score[want_idx])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 7, 13, 42])
+def test_topk_over_keys_is_stable_descending_sort(seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(20):
+        n = rng.randint(4, 65)
+        score, index, valid = _random_case(rng, n)
+        keys = encode_topk_keys(score, index, valid)
+        order = np.argsort(-keys, kind="stable")
+        got = [decode_topk_key(keys[i])[1] for i in order if valid[i]]
+        want = sorted(np.flatnonzero(valid),
+                      key=lambda i: (-score[i], i))
+        assert [int(i) for i in got] == [int(i) for i in want]
+
+
+def test_invalid_lanes_sort_strictly_last():
+    score = np.array([0, 7, 0], dtype=np.int64)
+    index = np.arange(3, dtype=np.int64)
+    keys = encode_topk_keys(score, index,
+                            np.array([True, False, True]))
+    assert keys[1] == -1
+    # even a zero-score valid lane beats every invalid lane
+    assert keys[0] > keys[1] and keys[2] > keys[1]
+    assert (keys[[0, 2]] >= 0).all()
+
+
+def test_round_trip_at_layout_extremes():
+    score = np.array([0, 1, (1 << (63 - TIE_BITS)) - 1], dtype=np.int64)
+    index = np.array([0, TIE_MASK, 12345], dtype=np.int64)
+    valid = np.ones(3, dtype=bool)
+    s, i = decode_topk_key(encode_topk_keys(score, index, valid))
+    np.testing.assert_array_equal(s, score)
+    np.testing.assert_array_equal(i, index)
+
+
+def test_keys_are_unique_per_index():
+    # score ties cannot collide: the index term makes every key distinct
+    score = np.zeros(1000, dtype=np.int64) + 3
+    index = np.arange(1000, dtype=np.int64)
+    keys = encode_topk_keys(score, index, np.ones(1000, dtype=bool))
+    assert len(np.unique(keys)) == 1000
+
+
+def test_same_bits_under_numpy_and_jax():
+    """The module's arithmetic-only contract: the same source line must
+    produce identical bits over numpy arrays and jax tracers (this is what
+    makes the host mirrors bit-exact by construction)."""
+    rng = np.random.RandomState(3)
+    score, index, valid = _random_case(rng, 64)
+    host = encode_topk_keys(score, index, valid)
+    dev = encode_topk_keys(jnp.asarray(score), jnp.asarray(index),
+                           jnp.asarray(valid))
+    np.testing.assert_array_equal(host, np.asarray(dev))
+
+    zb = rng.randint(0, 2**11, size=64).astype(np.int64)
+    rb = rng.randint(0, 2**20, size=64).astype(np.int64)
+    ok = rng.rand(64) < 0.7
+    host_rank = encode_gang_rank(zb, rb, score, ok)
+    dev_rank = encode_gang_rank(jnp.asarray(zb), jnp.asarray(rb),
+                                jnp.asarray(score), jnp.asarray(ok))
+    np.testing.assert_array_equal(host_rank, np.asarray(dev_rank))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7])
+def test_gang_rank_ordering_zone_then_rack_then_score(seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(50):
+        n = rng.randint(2, 33)
+        zb = rng.randint(0, 4, size=n).astype(np.int64)
+        rb = rng.randint(0, 4, size=n).astype(np.int64)
+        score = rng.randint(0, 100, size=n).astype(np.int64)
+        ok = rng.rand(n) < 0.8
+        if not ok.any():
+            ok[rng.randint(n)] = True
+        rank = encode_gang_rank(zb, rb, score, ok)
+        got = int(np.argmax(rank))
+        # reference: lexicographic (zone, rack, score), first occurrence
+        want = min(np.flatnonzero(ok),
+                   key=lambda i: (-zb[i], -rb[i], -score[i], i))
+        assert got == int(want)
+        assert (rank[~ok] == -1).all()
+
+
+def test_gang_rank_clips_oversized_scores():
+    # a score beyond 32 bits must not bleed into the rack field
+    zb = np.zeros(2, dtype=np.int64)
+    rb = np.array([0, 1], dtype=np.int64)
+    score = np.array([GANG_SCORE_MASK + 5, 0], dtype=np.int64)
+    rank = encode_gang_rank(zb, rb, score, np.ones(2, dtype=bool))
+    assert int(np.argmax(rank)) == 1  # one rack mate beats any score

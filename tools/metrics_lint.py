@@ -53,11 +53,16 @@ _UNITLESS_GAUGES = {
     "tpusim_cluster_feasible_nodes",
     "tpusim_cluster_nodes",
     "tpusim_hbm_cache_entries",
+    # ISSUE 16: mesh shape + per-shard node counts are dimensionless
+    "tpusim_shard_count",
+    "tpusim_shard_node_occupancy",
 }
 # label names whose value sets are finite by construction; anything else
 # (node names, pod names, plan signatures) is unbounded cardinality
+# ("shard" is bounded by TPUSIM_SHARDS <= the device count)
 _BOUNDED_LABELS = {"route", "transition", "path", "reason", "kind",
-                   "resource", "verdict", "component", "site", "tenant"}
+                   "resource", "verdict", "component", "site", "tenant",
+                   "shard"}
 
 
 def lint_registry(registry) -> List[str]:

@@ -286,6 +286,12 @@ run_stage gang configs:13 bench_results/r5_tpu_gang.jsonl \
     env TPUSIM_BENCH_LADDER_CONFIGS=13 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
     python bench.py --ladder
 
+echo "== stage 3g: sharded twin (config 14: pods/s vs shard count on the device mesh) =="
+run_stage sharded configs:14 bench_results/r5_tpu_sharded.jsonl \
+    bench_results/r5_tpu_sharded.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=14 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+
 echo "== stage 4: full XLA ladder (configs 1-5; fresh same-round parity anchors) =="
 run_stage ladder configs:1,2,3,4,5 bench_results/r5_tpu_ladder.jsonl \
     bench_results/r5_tpu_ladder.log \

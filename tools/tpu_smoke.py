@@ -35,6 +35,15 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# The sharded variant needs a >= 2 device mesh; a CPU host exposes one
+# device unless told otherwise, and the flag only takes effect before
+# jax initializes. Real accelerator hosts enumerate hardware devices and
+# ignore it. Mirrors tests/conftest.py (which forces 8 for the suite).
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=2")
+
 import numpy as np  # noqa: E402
 
 from tpusim.jaxe import ensure_x64  # noqa: E402
@@ -877,6 +886,97 @@ def run_gang_variant():
     return host_hash[:16], len(feed()), len(msgs)
 
 
+def run_sharded_variant():
+    """Node-sharded twin (ISSUE 16) stage-0: the TPUSIM_SHARDS=2 mesh
+    route must (a) byte-match the single-device placement hash for the
+    same seeded feed — the verify-then-trust seam pins the (shards,
+    config) signature on the first batch; (b) serve a warm second batch
+    from the already-compiled shard_map program without tracing a fresh
+    one (zero-retrace across batches). Returns None (skip) on hosts
+    exposing a single device."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        return None
+    from tpusim.backends import Placement, placement_hash
+    from tpusim.jaxe.backend import _SHARD_AUTO, reset_fast_auto
+    from tpusim.jaxe.kernels import _SHARDED_SCAN_PROGRAMS
+    from tpusim.simulator import run_simulation
+
+    def cluster():
+        nodes = [make_node(f"sn{i}", milli_cpu=(1500, 2500, 4000)[i % 3],
+                           memory=(2 << 30) + (i % 4) * (1 << 30),
+                           labels={"zone": f"z{i % 2}",
+                                   "topology.kubernetes.io/rack":
+                                   f"rack-{i // 4}"})
+                 for i in range(14)]
+        return ClusterSnapshot(nodes=nodes, pods=[])
+
+    def feed(tag="a"):
+        pods = [make_pod(f"sp-{tag}-{i}", milli_cpu=150 + 70 * (i % 9),
+                         memory=(192 << 20) * (1 + i % 3))
+                for i in range(28)]
+        # oversized tail: FitError text must survive the shard merge
+        pods += [make_pod(f"sp-{tag}-big{j}", milli_cpu=9000)
+                 for j in range(2)]
+        return pods
+
+    def run(shards, tag="a", reset=True):
+        prev = os.environ.get("TPUSIM_SHARDS")
+        os.environ["TPUSIM_SHARDS"] = str(shards)
+        try:
+            if reset:
+                reset_fast_auto()
+            st = run_simulation(feed(tag), cluster(), backend="jax")
+        finally:
+            if prev is None:
+                os.environ.pop("TPUSIM_SHARDS", None)
+            else:
+                os.environ["TPUSIM_SHARDS"] = prev
+        return placement_hash(
+            [Placement(pod=p, node_name=p.spec.node_name)
+             for p in sorted(st.successful_pods,
+                             key=lambda p: p.metadata.name)]
+            + [Placement(pod=p, reason="Unschedulable")
+               for p in sorted(st.failed_pods,
+                               key=lambda p: p.metadata.name)])
+
+    base_hash = run(1)
+    shard_hash = run(2)
+    if shard_hash != base_hash:
+        raise AssertionError(
+            f"sharded route diverges from single-device "
+            f"({shard_hash[:16]} != {base_hash[:16]})")
+    if _SHARD_AUTO["disabled"] or not _SHARD_AUTO["verified_sigs"]:
+        raise AssertionError(
+            "sharded run never pinned a verified signature "
+            f"(disabled={_SHARD_AUTO['disabled']})")
+
+    # zero-retrace: a warm batch over the same shapes must reuse the
+    # compiled shard_map program (the pinned sig skips re-verification)
+    def program_traces():
+        try:
+            return sum(fn._cache_size()
+                       for fn in _SHARDED_SCAN_PROGRAMS.values())
+        except AttributeError:  # private jit API moved: skip the check
+            return None
+
+    before = program_traces()
+    warm_hash = run(2, tag="b", reset=False)
+    traced = None
+    if before is not None:
+        traced = program_traces() - before
+        if traced:
+            raise AssertionError(
+                f"warm sharded batch retraced ({traced:+d} shard_map "
+                "programs); the per-(config, mesh) cache is broken")
+    warm_base = run(1, tag="b")
+    if warm_hash != warm_base:
+        raise AssertionError("warm sharded batch diverges from "
+                             "single-device")
+    return base_hash[:16], 2, traced
+
+
 def _write_smoke_trace(recorder):
     """Persist the sweep's flight-recorder trace; never fail the smoke."""
     path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
@@ -1095,6 +1195,34 @@ def main() -> int:
             print(f"SMOKE gang: OK hash={h} pods={n_pods} "
                   f"shared_fit_msgs={n_msgs} "
                   f"({time.time() - t:.1f}s)", flush=True)
+        if not only or "sharded" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "sharded")
+            try:
+                out = run_sharded_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: sharded: {exc}", flush=True)
+                return 1
+            if out is None:
+                vsp.set("parity", "skipped")
+                vsp.end()
+                print("SMOKE sharded: SKIPPED (needs >= 2 devices)",
+                      flush=True)
+            else:
+                h, n_shards, traced = out
+                vsp.set("parity", "ok")
+                vsp.set("hash", h)
+                vsp.set("shards", n_shards)
+                vsp.end()
+                ran += 1
+                retrace = "skipped" if traced is None else f"+{traced}"
+                print(f"SMOKE sharded: OK hash={h} shards={n_shards} "
+                      f"retrace={retrace} ({time.time() - t:.1f}s)",
+                      flush=True)
     finally:
         flight.uninstall()
         _write_smoke_trace(recorder)

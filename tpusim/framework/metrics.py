@@ -338,6 +338,25 @@ class SchedulerMetrics:
         self.fast_fallback = self._reg(LabeledCounter(
             "tpusim_fast_fallback_total",
             "Pallas fast-path plan rejections by blocker class", "reason"))
+        # node-sharded route telemetry (ISSUE 16): the TPUSIM_SHARDS mesh
+        # shape, real (non-padding) nodes owned per shard, the estimated
+        # cross-shard collective payload of the last sharded dispatch, and
+        # batches the route declined, by blocker class
+        self.shard_count = self._reg(Gauge(
+            "tpusim_shard_count",
+            "Node-mesh shards in the active sharded scan route (0 = off)"))
+        self.shard_node_occupancy = self._reg(LabeledGauge(
+            "tpusim_shard_node_occupancy",
+            "Real (non-padding) nodes owned by each node-mesh shard",
+            "shard"))
+        self.shard_collective_bytes = self._reg(Gauge(
+            "tpusim_shard_collective_bytes",
+            "Estimated cross-shard collective payload of the last sharded "
+            "dispatch"))
+        self.shard_fallback = self._reg(LabeledCounter(
+            "tpusim_shard_fallback_total",
+            "TPUSIM_SHARDS batches the sharded route declined, by blocker "
+            "class", "reason"))
         # chaos-engine telemetry (ISSUE 3): injected faults by kind, watch
         # buffer overflows by resource, and the dispatch circuit breaker
         self.fault_injected = self._reg(LabeledCounter(

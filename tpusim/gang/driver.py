@@ -147,11 +147,41 @@ def _joint_solve(backend, inc, members: List[Pod], compiled, cols
         num_reason_bits=NUM_FIXED_BITS + len(compiled.scalar_names),
         hard_weight=getattr(backend,
                             "hard_pod_affinity_symmetric_weight", 10))
-    feasible, score = gang_lanes(config, carry_init(compiled),
-                                 statics_to_device(compiled),
-                                 pod_columns_to_device(cols))
-    feasible = np.asarray(feasible)
-    score = np.asarray(score)
+    statics = statics_to_device(compiled)
+    carry = carry_init(compiled)
+    xs = pod_columns_to_device(cols)
+    n_nodes = len(compiled.statics.names)
+    lanes = None
+    from tpusim.jaxe.backend import _SHARD_AUTO, _shard_count
+
+    n_shards = _shard_count()
+    if n_shards > 1 and not _SHARD_AUTO["disabled"]:
+        import jax
+
+        if len(jax.devices()) >= n_shards:
+            # cross-shard gang lanes (ISSUE 16 sub-problem b): per-member
+            # filter/score runs per node shard with collective reductions,
+            # the stitched output re-gathers the full (member, node) matrix
+            # — padded columns come back all-infeasible, so slicing to the
+            # real node count feeds gang_choices byte-identical inputs
+            from dataclasses import replace as _dc_replace
+
+            from tpusim.jaxe.kernels import gang_lanes_sharded
+            from tpusim.jaxe.sharding import make_mesh, shard_for_mesh
+
+            mesh = make_mesh(n_shards, snap=1)
+            st, ca, xs_r = shard_for_mesh(mesh, statics, carry, xs)
+            with flight.span("shard:gang_lanes", "device") as sp:
+                lanes = gang_lanes_sharded(
+                    _dc_replace(config, shard_axis="node"), mesh, ca, st,
+                    xs_r)
+                if sp:
+                    sp.set("shards", n_shards)
+                    sp.set("members", len(members))
+    if lanes is None:
+        lanes = gang_lanes(config, carry, statics, xs)
+    feasible = np.asarray(lanes[0])[:, :n_nodes]
+    score = np.asarray(lanes[1])[:, :n_nodes]
 
     names = list(compiled.statics.names)
     by_name = {n.metadata.name: n for n in inc.nodes}

@@ -16,11 +16,7 @@ import numpy as np
 
 from tpusim.api.types import Node
 from tpusim.engine.priorities import get_zone_key
-from tpusim.jaxe.kernels import (
-    GANG_RACK_SHIFT,
-    GANG_SCORE_MASK,
-    GANG_ZONE_SHIFT,
-)
+from tpusim.jaxe.packing import encode_gang_rank
 
 # Rack topology labels, checked in order. The upstream scheduler has no
 # canonical rack label; we accept the common community spelling first and a
@@ -90,10 +86,9 @@ def select_oracle(feasible: np.ndarray, score: np.ndarray,
         ok = feasible[i] & fits
         zone_bonus = np.where(zone_dom > 0, zone_cnt[zone_dom], 0)
         rack_bonus = np.where(rack_dom > 0, rack_cnt[rack_dom], 0)
-        rank = ((zone_bonus.astype(np.int64) << GANG_ZONE_SHIFT)
-                + (rack_bonus.astype(np.int64) << GANG_RACK_SHIFT)
-                + np.clip(score[i].astype(np.int64), 0, GANG_SCORE_MASK))
-        rank = np.where(ok, rank, np.int64(-1))
+        # the SAME encode the device kernel runs (jaxe/packing.py)
+        rank = encode_gang_rank(zone_bonus, rack_bonus,
+                                score[i].astype(np.int64), ok)
         choice = int(np.argmax(rank))
         if rank[choice] < 0:
             choices.append(-1)

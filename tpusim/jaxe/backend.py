@@ -91,6 +91,8 @@ def reset_fast_auto() -> None:
     _FAST_AUTO["transient"] = 0
     _VICTIM_AUTO["disabled"] = False
     _VICTIM_AUTO["verified_sigs"] = set()
+    _SHARD_AUTO["disabled"] = False
+    _SHARD_AUTO["verified_sigs"] = set()
     from tpusim.gang.kernel import _GANG_AUTO  # lazy: gang imports backend
     _GANG_AUTO["disabled"] = False
     _GANG_AUTO["verified_sigs"] = set()
@@ -300,6 +302,81 @@ def victim_kernel_enabled() -> tuple[bool, bool]:
     if env == "1":
         return True, False
     return True, True
+
+
+# process-wide trust state for the node-sharded scan route (ISSUE 16),
+# mirroring _FAST_AUTO: `disabled` flips the first time a sharded dispatch's
+# choices/counts disagree with the single-device replay (never re-enabled);
+# `verified_sigs` holds (shard_count, config) pairs whose first batch
+# verified — a different shard count or engine config compiles a different
+# collective program and earns trust separately.
+_SHARD_AUTO = {"disabled": False, "verified_sigs": set()}
+
+
+def _shard_count() -> int:
+    """TPUSIM_SHARDS=k (k > 1) opts the XLA scan into the node-sharded
+    shard_map route over a k-device mesh. Unset, 1, 0, or garbage selects
+    the single-device route — k=1 must not even build a mesh, so those
+    placement chains stay byte-identical to pre-shard builds."""
+    try:
+        k = int(os.environ.get("TPUSIM_SHARDS", "1"))
+    except ValueError:
+        return 1
+    return k if k > 1 else 1
+
+
+def _dispatch_sharded(config, mesh, n_shards, statics, carry, xs,
+                      use_chunks, scan_chunk, metrics):
+    """One node-sharded dispatch: pad the node axis shard-even, place the
+    trees per the mesh's node shardings, run the shard_map scan (chunked
+    or single), and stamp the tpusim_shard_* telemetry. Returns
+    (final_carry, choices, counts, sharded_statics) — the carry/statics
+    come back padded + sharded for the analytics reduction to fold."""
+    from dataclasses import replace as _dc_replace
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpusim.jaxe.kernels import sharded_scan_fn
+    from tpusim.jaxe.sharding import (
+        node_shardings,
+        pad_node_axis,
+        stage_tree,
+    )
+
+    sconfig = _dc_replace(config, shard_axis="node")
+    with flight.span("shard:stage") as ssp:
+        st, ca, n_real = pad_node_axis(statics, carry, n_shards)
+        st_sh, ca_sh = node_shardings(mesh)
+        st = stage_tree(st, st_sh)
+        ca = stage_tree(ca, ca_sh)
+        if ssp:
+            ssp.set("shards", n_shards)
+            ssp.set("nodes", n_real)
+    per = st.alloc_cpu.shape[0] // n_shards
+    metrics.shard_count.set(n_shards)
+    for s in range(n_shards):
+        metrics.shard_node_occupancy.set(
+            str(s), max(0, min(n_real - s * per, per)))
+    # estimated collective payload: each pod step moves ~12 psum/pmax/pmin
+    # scalars plus an n_shards-wide tie-count all_gather, 8 bytes each, on
+    # every shard (the analytics/gang collectives are separate dispatches)
+    n_pods = int(np.asarray(xs.req_cpu).shape[0])
+    metrics.shard_collective_bytes.set(
+        float(n_pods) * (12 + n_shards) * 8 * n_shards)
+    rep = NamedSharding(mesh, P())
+    with flight.span("shard:scan", "device") as sp:
+        if use_chunks:
+            final_carry, choices, counts, _ = schedule_scan_chunked(
+                config, ca, st, xs, scan_chunk,
+                scan_donated=sharded_scan_fn(sconfig, mesh, donate=True),
+                put=lambda rows: stage_tree(rows, rep))
+        else:
+            final_carry, choices, counts, _ = sharded_scan_fn(
+                sconfig, mesh)(ca, st, stage_tree(xs, rep))
+        if sp:
+            sp.set("shards", n_shards)
+            sp.set("pods", n_pods)
+    return final_carry, choices, counts, st
 
 
 _MOST_REQUESTED_PROVIDERS = {CLUSTER_AUTOSCALER_PROVIDER, TD_PROVIDER}
@@ -712,11 +789,43 @@ class JaxBackend:
                 elif auto_mode and not fast_verify:
                     # already-pinned variant ran without re-verification
                     flight.note_auto_transition("trust", str(fast_sig))
+        # node-sharded route decision (ISSUE 16): TPUSIM_SHARDS=k > 1 runs
+        # the same fused scan as a shard_map over a k-device node mesh —
+        # bit-identical placements via cross-shard collectives, so every
+        # ineligibility is a classified fallback to the single-device scan,
+        # never a behavior change
+        n_shards = _shard_count()
+        shard_mesh = None
+        shard_statics = None
+        if fplan is None and n_shards > 1 and not _SHARD_AUTO["disabled"]:
+            import jax
+
+            from tpusim.jaxe.kernels import shard_route_eligible
+
+            ok, why = shard_route_eligible(config)
+            if ok and len(jax.devices()) < n_shards:
+                ok, why = False, "device_count"
+            if not ok:
+                metrics.shard_fallback.inc(why)
+                flight.note_fast_fallback(
+                    "shard_" + why,
+                    f"TPUSIM_SHARDS={n_shards} batch routed single-device")
+                log.info("sharded route ineligible (%s); using the "
+                         "single-device scan", why)
+            else:
+                from tpusim.jaxe.sharding import make_mesh
+
+                shard_mesh = make_mesh(n_shards, snap=1)
         explain_lanes = None
         final_carry = None  # bound-and-dropped unless analytics reads it
         if fplan is None:  # fast path off, ineligible, or discarded above
             with flight.profiled("tpusim:schedule_scan"):
-                if use_chunks:
+                if shard_mesh is not None:
+                    (final_carry, choices, counts,
+                     shard_statics) = _dispatch_sharded(
+                         config, shard_mesh, n_shards, statics, carry,
+                         xs, use_chunks, scan_chunk, metrics)
+                elif use_chunks:
                     final_carry, choices, counts, _ = schedule_scan_chunked(
                         config, carry, statics, xs, scan_chunk)
                 elif config.explain_k > 0:
@@ -728,6 +837,49 @@ class JaxBackend:
                      _) = schedule_scan(config, carry, statics, xs)
         choices = np.asarray(choices)
         counts = np.asarray(counts)
+        if shard_mesh is not None:
+            # verify-then-trust, the same seam as the fast path: the first
+            # batch per (shard count, config) replays its leading pods
+            # through the single-device scan bit-for-bit; a disagreement
+            # disables the sharded route for the process and this batch
+            # reruns single-device (TPUSIM_SHARD_VERIFY=0 skips, bench only)
+            shard_sig = (n_shards, config)
+            if os.environ.get("TPUSIM_SHARD_VERIFY") == "0":
+                pass
+            elif shard_sig in _SHARD_AUTO["verified_sigs"]:
+                flight.note_auto_transition("shard_trust", str(n_shards))
+            else:
+                from tpusim.jaxe.fastscan import verify_against_xla
+
+                if verify_against_xla(config, compiled, cols, choices,
+                                      counts, statics=statics,
+                                      carry=_xla_carry()):
+                    _SHARD_AUTO["verified_sigs"].add(shard_sig)
+                    flight.note_auto_transition("shard_pin", str(n_shards))
+                else:
+                    _SHARD_AUTO["disabled"] = True
+                    metrics.shard_count.set(0)
+                    flight.note_auto_transition("shard_verify_fail",
+                                                str(n_shards))
+                    log.warning(
+                        "sharded scan DISAGREES with the single-device "
+                        "scan on the leading pods (shards=%d); disabling "
+                        "the sharded route for this process and re-running "
+                        "single-device", n_shards)
+                    shard_mesh = None
+                    shard_statics = None
+                    with flight.profiled("tpusim:schedule_scan"):
+                        if use_chunks:
+                            (final_carry, choices, counts,
+                             _) = schedule_scan_chunked(
+                                 config, _xla_carry(), statics, xs,
+                                 scan_chunk)
+                        else:
+                            (final_carry, choices, counts,
+                             _) = schedule_scan(config, _xla_carry(),
+                                                statics, xs)
+                    choices = np.asarray(choices)
+                    counts = np.asarray(counts)
         if _CHAOS["injector"] is not None:
             if _corrupt_kind is not None:
                 from tpusim.chaos.engine import DeviceInjector
@@ -752,12 +904,16 @@ class JaxBackend:
                      if os.environ.get("TPUSIM_FAST") == "1"
                      and os.environ.get("TPUSIM_FAST_INTERPRET") == "1"
                      else "fastscan")
+        elif shard_mesh is not None:
+            route = "xla_sharded_chunked" if use_chunks else "xla_sharded"
         else:
             route = "xla_chunked" if use_chunks else "xla_scan"
         flight.note_route(route, len(pods))
         if dsp:
             dsp.set("route", route)
             dsp.set("pods", len(pods))
+            if shard_mesh is not None:
+                dsp.set("shards", n_shards)
             if fast_sig is not None:
                 dsp.set("sig", str(fast_sig))
             dsp.end()
@@ -785,10 +941,13 @@ class JaxBackend:
             prov.capture_batch(placements, "backend", topk=topk)
         if final_carry is not None:
             # one None-check inside; the reduction folds the POST-bind
-            # carry this batch produced against the staged statics
-            analytics.capture(statics, final_carry,
-                              len(compiled.statics.names), "backend",
-                              names=compiled.statics.names)
+            # carry this batch produced against the staged statics — on the
+            # sharded route both trees are padded + node-sharded, so the
+            # reduction runs the two-level cross-shard merge
+            analytics.capture(
+                shard_statics if shard_mesh is not None else statics,
+                final_carry, len(compiled.statics.names), "backend",
+                names=compiled.statics.names, mesh=shard_mesh)
         # e2e additionally covers host-side result materialization
         metrics.e2e_scheduling_latency.observe(
             since_in_microseconds(dispatch_start))

@@ -48,6 +48,14 @@ from tpusim.engine.predicates import (
     POD_TOLERATES_NODE_NO_EXECUTE_TAINTS_PRED,
     POD_TOLERATES_NODE_TAINTS_PRED,
 )
+from tpusim.jaxe.packing import (
+    GANG_RACK_SHIFT,
+    GANG_SCORE_MASK,
+    GANG_ZONE_SHIFT,
+    TIE_BITS as _ANALYTICS_TIE_BITS,
+    encode_gang_rank,
+    encode_topk_keys,
+)
 from tpusim.jaxe.state import (
     BIT_AFFINITY_NOT_MATCH,
     BIT_AFFINITY_RULES,
@@ -277,6 +285,13 @@ class EngineConfig:
     # so explain_k=0 traces are byte-identical to pre-provenance programs —
     # zero cost when disabled.
     explain_k: int = 0
+    # node-axis sharding (ISSUE 16): when set, the fused step runs inside
+    # shard_map over a mesh axis of this name — every global node reduction
+    # becomes a collective and selection merges across shards bit-identically
+    # (integer arithmetic only, so the collective sums/maxes are exact and
+    # order-independent). None (the default) emits NO collectives: the trace
+    # is byte-identical to the single-device engine.
+    shard_axis: str = None
 
 
 # ---------------------------------------------------------------------------
@@ -611,6 +626,32 @@ def explain_part_names(config: EngineConfig) -> list:
     return names
 
 
+# --- node-axis collectives (ISSUE 16) --------------------------------------
+# Every cross-node reduction in _evaluate/_select funnels through these four
+# helpers. With axis=None they are identity wrappers (the single-device trace
+# is untouched); with a mesh axis name they append the matching collective.
+# All reduced quantities are integers (or integer-valued f64 counts below
+# 2^53), so psum/pmax/pmin across shards are exact and order-independent —
+# the basis for the bit-identical cross-shard claim.
+
+def _ax_sum(v, axis):
+    return v if axis is None else jax.lax.psum(v, axis)
+
+
+def _ax_max(v, axis):
+    return v if axis is None else jax.lax.pmax(v, axis)
+
+
+def _ax_min(v, axis):
+    return v if axis is None else jax.lax.pmin(v, axis)
+
+
+def _ax_any(v, axis):
+    if axis is None:
+        return v
+    return jax.lax.pmax(v.astype(jnp.int32), axis) != 0
+
+
 def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     """Filter + score one pod against the carried aggregates.
 
@@ -622,6 +663,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     selection matches the host engine's short-circuit."""
     ps = config.policy
     en = ps.pred_keys if ps is not None else None
+    ax = config.shard_axis
 
     def on(name):
         # None = the provider's default predicate set (the full pipeline)
@@ -842,7 +884,8 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         dom_rows = st.topo_dom[st.aff_key[g]]                       # [Ta, N]
         valid_dom = dom_rows > 0
         dc_at = jnp.take_along_axis(
-            _seg_rows(mcount, dom_rows, config.n_topo_doms), dom_rows, axis=1)
+            _ax_sum(_seg_rows(mcount, dom_rows, config.n_topo_doms), ax),
+            dom_rows, axis=1)
         is_host = st.aff_hostname[g][:, None]
         on_node = mcount > 0.5
         term_matches = jnp.where(is_host, valid_dom & on_node,
@@ -852,7 +895,8 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         # per-node there and global (incl. unplaced snapshot pods) otherwise
         exists = jnp.where(
             is_host, on_node,
-            ((jnp.sum(mcount, axis=1) > 0.5) | st.aff_unplaced[g])[:, None])
+            ((_ax_sum(jnp.sum(mcount, axis=1), ax) > 0.5)
+             | st.aff_unplaced[g])[:, None])
         term_ok = term_matches | ((~exists) & st.aff_self[g][:, None])
         aff_fail = jnp.any(st.aff_valid[g][:, None] & ~term_ok,
                            axis=0) | st.aff_err[g]
@@ -862,7 +906,8 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         bdom_rows = st.topo_dom[st.anti_key[g]]
         bvalid = bdom_rows > 0
         bdc_at = jnp.take_along_axis(
-            _seg_rows(bmcount, bdom_rows, config.n_topo_doms), bdom_rows, axis=1)
+            _ax_sum(_seg_rows(bmcount, bdom_rows, config.n_topo_doms), ax),
+            bdom_rows, axis=1)
         b_is_host = st.anti_hostname[g][:, None]
         b_matches = jnp.where(b_is_host, bvalid & (bmcount > 0.5),
                               bvalid & (bdc_at > 0.5))
@@ -871,7 +916,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
 
         # existing pods' anti-affinity vs me (symmetric check; runs first)
         w = st.anti_valid & st.term_match[st.anti_term, g]          # [G, Tb]
-        grp_present = jnp.sum(carry.presence, axis=1) > 0           # [G]
+        grp_present = _ax_sum(jnp.sum(carry.presence, axis=1), ax) > 0  # [G]
         fail_all = jnp.any(w & st.anti_empty & grp_present[:, None])
         key_oh = jax.nn.one_hot(st.anti_key, k_count, dtype=jnp.float64)
         bad_dom = jnp.einsum("gtk,gt,gkd->kd", key_oh,
@@ -934,7 +979,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         # nodes fail at the cond stage, whose sentinel bit is never decoded)
         for fail, bits in reversed(stages):
             reason_bits = jnp.where(fail, bits, reason_bits)
-    n_feasible = jnp.sum(feasible)
+    n_feasible = _ax_sum(jnp.sum(feasible), ax)
 
     # ---- score (weighted sum, generic_scheduler.go:631-639) ----
     (w_least, w_most, w_balanced, w_node_aff, w_taint, w_avoid, w_spread,
@@ -975,7 +1020,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     if w_node_aff:
         # NodeAffinityPriority: NormalizeReduce(10, False) over feasible nodes
         aff = st.affinity_count[x.aff_id]
-        aff_max = jnp.max(jnp.where(feasible, aff, 0))
+        aff_max = _ax_max(jnp.max(jnp.where(feasible, aff, 0)), ax)
         aff_norm = jnp.where(
             aff_max > 0, MAX_PRIORITY * aff // jnp.maximum(aff_max, 1), 0)
         add(w_node_aff * aff_norm)
@@ -983,7 +1028,7 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     if w_taint:
         # TaintTolerationPriority: NormalizeReduce(10, True) over feasible nodes
         intol = st.intolerable[x.tol_id]
-        intol_max = jnp.max(jnp.where(feasible, intol, 0))
+        intol_max = _ax_max(jnp.max(jnp.where(feasible, intol, 0)), ax)
         taint_norm = jnp.where(
             intol_max > 0,
             MAX_PRIORITY - MAX_PRIORITY * intol // jnp.maximum(intol_max, 1),
@@ -1013,16 +1058,16 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         saa_cnt = (st.saa_rows[st.saa_sig[x.group_id]].astype(jnp.float64) @
                    carry.presence.astype(jnp.float64)).astype(jnp.int64)  # [N]
         saa_fcnt = jnp.where(feasible, saa_cnt, 0)
-        saa_total = jnp.sum(saa_fcnt)
+        saa_total = _ax_sum(jnp.sum(saa_fcnt), ax)
         # entries accumulate into ONE explain part (integer adds: regrouping
         # the per-entry additions into a single term is exact)
         saa_term = jnp.zeros_like(score)
         for e, w_saa in enumerate(ps.saa_weights):
             dom = st.saa_dom[e]
             labeled = dom > 0
-            grp = jax.ops.segment_sum(
+            grp = _ax_sum(jax.ops.segment_sum(
                 jnp.where(labeled, saa_fcnt, 0), dom,
-                num_segments=config.n_saa_doms).at[0].set(0)
+                num_segments=config.n_saa_doms), ax).at[0].set(0)
             f_score = jnp.where(
                 saa_total > 0,
                 (MAX_PRIORITY * (saa_total - grp[dom]))
@@ -1043,12 +1088,12 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         cnt = (st.ss_rows[st.ss_sig[x.group_id]].astype(jnp.float64) @
                carry.presence.astype(jnp.float64)).astype(jnp.int64)  # [N]
         fcnt = jnp.where(feasible, cnt, 0)
-        max_node = jnp.max(fcnt)
+        max_node = _ax_max(jnp.max(fcnt), ax)
         zdom = st.zone_dom
         zvalid = zdom > 0
-        zcnt = jax.ops.segment_sum(fcnt, zdom,
-                                   num_segments=config.n_zone_doms).at[0].set(0)
-        have_zones = jnp.any(feasible & zvalid)
+        zcnt = _ax_sum(jax.ops.segment_sum(
+            fcnt, zdom, num_segments=config.n_zone_doms), ax).at[0].set(0)
+        have_zones = _ax_any(jnp.any(feasible & zvalid), ax)
         max_zone = jnp.max(zcnt)
         node_num = jnp.where(max_node > 0, max_node - cnt, 1)
         node_den = jnp.maximum(max_node, 1)
@@ -1069,7 +1114,8 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         pcount = st.term_match[st.pref_term[g]].astype(jnp.float64) @ presence_f  # [Tp, N]
         pdom = st.topo_dom[st.pref_key[g]]                          # [Tp, N]
         pdc_at = jnp.take_along_axis(
-            _seg_rows(pcount, pdom, config.n_topo_doms), pdom, axis=1)
+            _ax_sum(_seg_rows(pcount, pdom, config.n_topo_doms), ax),
+            pdom, axis=1)
         counts = jnp.sum(p_w[:, None] * jnp.where(pdom > 0, pdc_at, 0.0), axis=0)
 
         wb = st.pref_w * st.term_match[st.pref_term, g]             # [G, Tp]
@@ -1090,8 +1136,10 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
         # toward-zero int() conversion (DEVIATIONS.md #16)
         counts_i = counts.astype(jnp.int64)
         big = jnp.int64(1) << 62
-        maxc = jnp.maximum(jnp.max(jnp.where(feasible, counts_i, -big)), 0)
-        minc = jnp.minimum(jnp.min(jnp.where(feasible, counts_i, big)), 0)
+        maxc = jnp.maximum(
+            _ax_max(jnp.max(jnp.where(feasible, counts_i, -big)), ax), 0)
+        minc = jnp.minimum(
+            _ax_min(jnp.min(jnp.where(feasible, counts_i, big)), ax), 0)
         rng = maxc - minc
         ip = jnp.where(rng > 0,
                        (MAX_PRIORITY * (counts_i - minc)) // jnp.maximum(rng, 1),
@@ -1101,18 +1149,38 @@ def _evaluate(config: EngineConfig, carry: Carry, st: Statics, x: PodX):
     return feasible, reason_bits, score, n_feasible, aca_counts, parts
 
 
-def _select(feasible, score, n_feasible, rr):
+def _select(feasible, score, n_feasible, rr, axis=None):
     """selectHost (generic_scheduler.go:183-198): stable-desc + round-robin
     among max-score ties; rr is consumed only when >1 node passed the filter
-    (with one feasible node scheduleOne returns it directly, :176-180)."""
+    (with one feasible node scheduleOne returns it directly, :176-180).
+
+    With `axis` set (the shard_map route) each shard holds a contiguous
+    block of the node axis and the same selection runs globally: the tie
+    threshold is a pmax, the tie COUNT a psum, and each shard ranks its
+    ties at a global offset (the all-gathered tie counts of earlier
+    shards) — so `rank == k` fires on exactly one node cluster-wide, at
+    the same position the single-device cumsum would pick. The winning
+    shard publishes its global index through a pmin (losers contribute
+    int32-max), making `choice` replicated and bit-identical to the
+    unsharded route, round-robin tie-break included."""
     masked = jnp.where(feasible, score, jnp.int64(-1))
-    max_score = jnp.max(masked)
+    max_score = _ax_max(jnp.max(masked), axis)
     tie = feasible & (masked == max_score)
-    ties = jnp.maximum(jnp.sum(tie), 1)
+    local_ties = jnp.sum(tie)
+    ties = jnp.maximum(_ax_sum(local_ties, axis), 1)
     k = jnp.where(n_feasible > 1, rr % ties, 0)
     rank = jnp.cumsum(tie.astype(jnp.int64)) - 1
+    if axis is not None:
+        per_shard = jax.lax.all_gather(local_ties, axis)        # [S]
+        me = jax.lax.axis_index(axis)
+        rank = rank + jnp.sum(jnp.where(
+            jnp.arange(per_shard.shape[0]) < me, per_shard, 0))
     pick = tie & (rank == k)
     choice = jnp.argmax(pick).astype(jnp.int32)
+    if axis is not None:
+        base = (jax.lax.axis_index(axis) * feasible.shape[0]).astype(jnp.int32)
+        choice = _ax_min(jnp.where(jnp.any(pick), base + choice,
+                                   jnp.iinfo(jnp.int32).max), axis)
     found = n_feasible > 0
     return jnp.where(found, choice, -1), found
 
@@ -1142,19 +1210,33 @@ def make_step(config: EngineConfig):
         carry, st = state
         feasible, reason_bits, score, n_feasible, aca_counts, parts = \
             _evaluate(config, carry, st, x)
-        choice, found = _select(feasible, score, n_feasible, carry.rr)
+        choice, found = _select(feasible, score, n_feasible, carry.rr,
+                                config.shard_axis)
         rr_next = carry.rr + jnp.where(n_feasible > 1, 1, 0)
 
-        idx = jnp.maximum(choice, 0)
-        gate = found.astype(jnp.int64)
-        gate32 = found.astype(jnp.int32)
+        if config.shard_axis is None:
+            bind = found
+            idx = jnp.maximum(choice, 0)
+        else:
+            # sharded route: `choice` is a GLOBAL node index (replicated by
+            # _select's pmin); only the owner shard scatters into its
+            # node-sharded columns. Replicated fields (presence_dom, rr)
+            # update identically on every shard further down.
+            n_local = feasible.shape[0]
+            base = (jax.lax.axis_index(config.shard_axis)
+                    * n_local).astype(jnp.int32)
+            local = choice - base
+            bind = found & (local >= 0) & (local < n_local)
+            idx = jnp.clip(local, 0, n_local - 1)
+        gate = bind.astype(jnp.int64)
+        gate32 = bind.astype(jnp.int32)
         if (config.has_ports or config.has_services or config.has_interpod
                 or config.has_disk_conflict):
             presence = carry.presence.at[x.group_id, idx].add(gate32)
         else:
             presence = carry.presence
         if config.has_maxpd:
-            row = jnp.where(found,
+            row = jnp.where(bind,
                             carry.used_vols[idx] | st.vol_mask[x.group_id],
                             carry.used_vols[idx])
             used_vols = carry.used_vols.at[idx].set(row)
@@ -1163,8 +1245,15 @@ def make_step(config: EngineConfig):
         if config.has_interpod:
             k_count = st.topo_dom.shape[0]
             dom_at = st.topo_dom[:, idx]                    # [K]
+            if config.shard_axis is not None:
+                # presence_dom is replicated: every shard applies the same
+                # update, so the owner broadcasts its topo_dom column (the
+                # psum has one nonzero contributor)
+                dom_at = jax.lax.psum(jnp.where(bind, dom_at, 0),
+                                      config.shard_axis)
             presence_dom = carry.presence_dom.at[
-                x.group_id, jnp.arange(k_count), dom_at].add(gate32)
+                x.group_id, jnp.arange(k_count), dom_at].add(
+                    found.astype(jnp.int32))
         else:
             presence_dom = carry.presence_dom
         if config.policy is not None and config.policy.sa_enabled:
@@ -1200,6 +1289,11 @@ def make_step(config: EngineConfig):
             (lambda: _aca_histogram(aca_counts, config.num_reason_bits))
             if aca_counts is not None else
             (lambda: _reason_histogram(reason_bits, config.num_reason_bits)))
+        if config.shard_axis is not None:
+            # per-shard histograms merge OUTSIDE the cond (found is
+            # replicated, so every shard takes the same branch and the
+            # psum stays uniform; a bound pod psums zeros)
+            counts = jax.lax.psum(counts, config.shard_axis)
         # advanced: selectHost consumed the rr counter for this pod — lets the
         # preemption hybrid (jaxe/preempt.py) resume rr mid-batch on re-dispatch
         if config.explain_k > 0:
@@ -1250,10 +1344,88 @@ schedule_scan_donated = jax.jit(_schedule_scan_impl,
                                 donate_argnums=(1,))
 
 
+# --------------------------------------------------------------------------
+# Node-axis sharded route (ISSUE 16): the SAME fused step, wrapped in
+# shard_map over a "node" mesh axis. Each shard owns a contiguous block of
+# the (shard-even padded) node axis; per-step reductions and host selection
+# merge through the collectives threaded above, so placements are
+# bit-identical to the single-device scan — the backend's verify-then-trust
+# seam (_SHARD_AUTO) replays the first batch per signature to prove it.
+
+def node_partition_specs(axis: str = "node"):
+    """(Statics, Carry, PodX) PartitionSpec trees for the node-sharded route,
+    derived from the axis registries: "node" axes map to the mesh axis,
+    everything else (group tables, presence_dom, pod columns) replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    def tree(cls, registry):
+        return cls(*(P(*(axis if a == "node" else None
+                         for a in registry[f])) for f in cls._fields))
+
+    # PodX leaves carry a leading pod axis ahead of their registry axes;
+    # every pod column is replicated, so P() covers them regardless of rank
+    return (tree(Statics, STATICS_AXES), tree(Carry, CARRY_AXES),
+            PodX(*(P() for _ in PodX._fields)))
+
+
+def shard_route_eligible(config: EngineConfig):
+    """(ok, reason) — static feature gates the sharded route cannot serve.
+    ServiceAffinity reads node columns by a GLOBAL locked index (sa_val
+    gathers cross shards) and explain lanes emit a per-node top-k that has
+    no associative merge wired yet; both fall back, classified."""
+    ps = config.policy
+    if ps is not None and (ps.sa_enabled or ps.sa_slots):
+        return False, "service_affinity"
+    if config.explain_k > 0:
+        return False, "explain_lanes"
+    return True, ""
+
+
+_SHARDED_SCAN_PROGRAMS: dict = {}
+
+
+def sharded_scan_fn(config: EngineConfig, mesh, donate: bool = False):
+    """The jitted shard_map program for the node-sharded fused scan,
+    cached per (config, mesh, donate). `config.shard_axis` must name a
+    mesh axis; inputs must be shard-even padded (sharding.pad_node_axis)
+    and placed/placeable per `node_partition_specs`. Signature matches
+    schedule_scan minus the leading config: fn(carry, statics, xs) ->
+    (final_carry, choices, counts, advanced)."""
+    if config.shard_axis is None:
+        raise ValueError("sharded_scan_fn requires config.shard_axis")
+    ok, why = shard_route_eligible(config)
+    if not ok:
+        raise ValueError(f"sharded route cannot serve this config: {why}")
+    key = (config, mesh, donate)
+    fn = _SHARDED_SCAN_PROGRAMS.get(key)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        st_specs, ca_specs, xs_specs = node_partition_specs(config.shard_axis)
+        sm = shard_map(
+            partial(_schedule_scan_impl, config), mesh=mesh,
+            in_specs=(ca_specs, st_specs, xs_specs),
+            # final carry keeps its node-sharded layout; choices/counts/
+            # advanced are replicated by construction (pmin/psum merges)
+            out_specs=(ca_specs, P(), P(), P()),
+            check_rep=False)
+        fn = jax.jit(sm, donate_argnums=(0,) if donate else ())
+        _SHARDED_SCAN_PROGRAMS[key] = fn
+    return fn
+
+
 def schedule_scan_chunked(config: EngineConfig, carry: Carry, statics: Statics,
-                          xs_host: PodX, chunk: int, progress=None):
+                          xs_host: PodX, chunk: int, progress=None,
+                          scan_donated=None, put=None):
     """Exact sequential scan over a pod batch too large for one dispatch,
     with double-buffered transfers (SURVEY.md §7 hard part 6).
+
+    `scan_donated` swaps the per-chunk program — the sharded route passes
+    its shard_map fn (signature (carry, statics, xs), config already
+    bound) — and `put` overrides the chunk upload (e.g. a device_put onto
+    the mesh's replicated sharding). Defaults reproduce the single-device
+    donated scan exactly.
 
     `xs_host` holds host-numpy pod columns; the full [P]-row PodX never lands
     in HBM at once. Per iteration the host loop (a) dispatches chunk t on the
@@ -1274,15 +1446,19 @@ def schedule_scan_chunked(config: EngineConfig, carry: Carry, statics: Statics,
 
     def upload(ci):
         sl = slice(ci * chunk, (ci + 1) * chunk)
-        return jax.device_put(PodX(*(a[sl] for a in xs_host)))
+        rows = PodX(*(a[sl] for a in xs_host))
+        return jax.device_put(rows) if put is None else put(rows)
 
     choice_parts, count_parts, adv_parts = [], [], []
     pending = None
     nxt = upload(0)
     for ci in range(num_chunks):
         xs_c = nxt
-        carry, ch, cnt, adv = schedule_scan_donated(config, carry, statics,
-                                                    xs_c)
+        if scan_donated is None:
+            carry, ch, cnt, adv = schedule_scan_donated(config, carry,
+                                                        statics, xs_c)
+        else:
+            carry, ch, cnt, adv = scan_donated(carry, statics, xs_c)
         if ci + 1 < num_chunks:
             nxt = upload(ci + 1)
         count_parts.append(cnt)
@@ -1551,7 +1727,8 @@ preempt_select = partial(jax.jit, static_argnums=(0,))(_preempt_select_impl)
 
 ANALYTICS_RESOURCES = ("cpu", "memory", "gpu", "ephemeral", "pods")
 ANALYTICS_UTIL_SCALE = 1_000_000  # utilization in ppm (integer floor-div)
-_ANALYTICS_TIE_BITS = 32  # low bits of a top-k key hold the index tiebreak
+# _ANALYTICS_TIE_BITS (the key layout) now lives in jaxe/packing.py and is
+# re-exported above for the host mirror in obs/analytics.py
 
 
 class AnalyticsIn(NamedTuple):
@@ -1602,9 +1779,30 @@ def analytics_in(statics, carry) -> AnalyticsIn:
         pod_count=carry.pod_count)
 
 
-def _analytics_reduce_impl(inp: AnalyticsIn, n_valid, *, k: int):
+def _merged_top_k(keys, k: int, axis):
+    """Descending top-k over (possibly node-sharded) packed keys. Sharded,
+    each shard takes its local top-k and an all_gather + re-top-k merges —
+    associative and exact because keys are unique (the index tiebreak), so
+    any global top-k key is necessarily within its own shard's top-k."""
+    if axis is None:
+        vals, _ = jax.lax.top_k(keys, k)
+        return vals
+    local, _ = jax.lax.top_k(keys, min(k, keys.shape[0]))
+    gathered = jax.lax.all_gather(local, axis).reshape(-1)
+    vals, _ = jax.lax.top_k(gathered, k)
+    return vals
+
+
+def _analytics_reduce_impl(inp: AnalyticsIn, n_valid, *, k: int, axis=None):
     n = inp.alloc_cpu.shape[0]
-    mask = jnp.arange(n) < n_valid
+    if axis is None:
+        gidx = jnp.arange(n, dtype=jnp.int64)
+    else:
+        # inside shard_map `n` is the local block; keys carry GLOBAL node
+        # indices so the merged top-k decodes identically to single-device
+        gidx = (jax.lax.axis_index(axis).astype(jnp.int64) * n
+                + jnp.arange(n, dtype=jnp.int64))
+    mask = gidx < n_valid
     alloc = jnp.stack([inp.alloc_cpu.astype(jnp.int64),
                        inp.alloc_mem.astype(jnp.int64),
                        inp.alloc_gpu.astype(jnp.int64),
@@ -1625,32 +1823,55 @@ def _analytics_reduce_impl(inp: AnalyticsIn, n_valid, *, k: int):
                      // jnp.maximum(alloc[:2], 1), 0)
     score = jnp.clip(jnp.maximum(util[0], util[1]),
                      0, ANALYTICS_UTIL_SCALE)
-    tie = ((jnp.int64(1) << _ANALYTICS_TIE_BITS) - 1
-           - jnp.arange(n, dtype=jnp.int64))
-    hot = jnp.where(mask, (score << _ANALYTICS_TIE_BITS) | tie,
-                    jnp.int64(-1))
-    cold = jnp.where(
-        mask,
-        ((ANALYTICS_UTIL_SCALE - score) << _ANALYTICS_TIE_BITS) | tie,
-        jnp.int64(-1))
-    hot_keys, _ = jax.lax.top_k(hot, k)
-    cold_keys, _ = jax.lax.top_k(cold, k)
+    hot = encode_topk_keys(score, gidx, mask)
+    cold = encode_topk_keys(ANALYTICS_UTIL_SCALE - score, gidx, mask)
+    hot_keys = _merged_top_k(hot, k, axis)
+    cold_keys = _merged_top_k(cold, k, axis)
 
     return AnalyticsStats(
-        alloc=alloc.sum(axis=1),
-        used=used.sum(axis=1),
-        free_sum=free.sum(axis=1),
-        free_max=free.max(axis=1),
-        headroom_nodes=(free > 0).sum(axis=1).astype(jnp.int64),
-        feasible_nodes=((free[0] > 0) & (free[1] > 0)
-                        & (free[4] > 0)).sum().astype(jnp.int64),
-        valid_nodes=mask.sum().astype(jnp.int64),
+        alloc=_ax_sum(alloc.sum(axis=1), axis),
+        used=_ax_sum(used.sum(axis=1), axis),
+        free_sum=_ax_sum(free.sum(axis=1), axis),
+        free_max=_ax_max(free.max(axis=1), axis),
+        headroom_nodes=_ax_sum(
+            (free > 0).sum(axis=1).astype(jnp.int64), axis),
+        feasible_nodes=_ax_sum(((free[0] > 0) & (free[1] > 0)
+                                & (free[4] > 0)).sum().astype(jnp.int64),
+                               axis),
+        valid_nodes=_ax_sum(mask.sum().astype(jnp.int64), axis),
         hot_keys=hot_keys,
         cold_keys=cold_keys)
 
 
 analytics_reduce = partial(jax.jit, static_argnames=("k",))(
     _analytics_reduce_impl)
+
+
+_ANALYTICS_SHARDED_PROGRAMS: dict = {}
+
+
+def analytics_reduce_sharded(mesh, inp: AnalyticsIn, n_valid, *, k: int,
+                             axis: str = "node"):
+    """Two-level analytics reduction over a node-sharded AnalyticsIn: each
+    shard folds its block (sums/maxes/counts + a local top-k of packed keys
+    carrying GLOBAL node indices), then psum/pmax/all_gather-merge — the
+    result is bit-identical to `analytics_reduce` on the unsharded columns,
+    so obs/analytics.py's host mirror verifies it unchanged."""
+    key = (mesh, k, axis)
+    fn = _ANALYTICS_SHARDED_PROGRAMS.get(key)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        sm = shard_map(
+            partial(_analytics_reduce_impl, k=k, axis=axis), mesh=mesh,
+            in_specs=(AnalyticsIn(*(P(axis) for _ in AnalyticsIn._fields)),
+                      P()),
+            out_specs=AnalyticsStats(*(P() for _ in AnalyticsStats._fields)),
+            check_rep=False)
+        fn = jax.jit(sm)
+        _ANALYTICS_SHARDED_PROGRAMS[key] = fn
+    return fn(inp, n_valid)
 
 
 # --------------------------------------------------------------------------
@@ -1673,10 +1894,9 @@ analytics_reduce = partial(jax.jit, static_argnames=("k",))(
 
 # Rank-key layout (int64): zone-mate count, then rack-mate count, then the
 # clipped scan score; -1 marks an infeasible/over-capacity node. First-
-# occurrence argmax resolves ties identically in numpy and XLA.
-GANG_ZONE_SHIFT = 52
-GANG_RACK_SHIFT = 32
-GANG_SCORE_MASK = (1 << 32) - 1
+# occurrence argmax resolves ties identically in numpy and XLA. The
+# encode (and the GANG_* constants re-exported above for gang/oracle.py)
+# lives in jaxe/packing.py, shared with the numpy mirror.
 
 
 class GangIn(NamedTuple):
@@ -1727,6 +1947,37 @@ def _gang_lanes_impl(config: EngineConfig, carry: Carry, statics: Statics,
 gang_lanes = partial(jax.jit, static_argnames=("config",))(_gang_lanes_impl)
 
 
+_GANG_LANES_SHARDED_PROGRAMS: dict = {}
+
+
+def gang_lanes_sharded(config: EngineConfig, mesh, carry: Carry,
+                       statics: Statics, xs: PodX):
+    """Cross-shard gang lanes (ISSUE 16 sub-problem b): the member vmap
+    runs per shard over its node block (with config.shard_axis collectives
+    globalizing the filter/score reductions), and the stitched out_specs
+    all_gather the node axis — every host then holds the full (member,
+    node) feasible/score matrix and ONE `gang_select` packer pass decides
+    jointly, bit-identical to single-device lanes."""
+    if config.shard_axis is None:
+        raise ValueError("gang_lanes_sharded requires config.shard_axis")
+    key = (config, mesh)
+    fn = _GANG_LANES_SHARDED_PROGRAMS.get(key)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        st_specs, ca_specs, xs_specs = node_partition_specs(config.shard_axis)
+        sm = shard_map(
+            partial(_gang_lanes_impl, config), mesh=mesh,
+            in_specs=(ca_specs, st_specs, xs_specs),
+            out_specs=(P(None, config.shard_axis),
+                       P(None, config.shard_axis)),
+            check_rep=False)
+        fn = jax.jit(sm)
+        _GANG_LANES_SHARDED_PROGRAMS[key] = fn
+    return fn(carry, statics, xs)
+
+
 def _gang_select_impl(feasible, score, req_cpu, req_mem, req_gpu, req_eph,
                       zero_request, gi: GangIn, n_zone: int, n_rack: int):
     """Joint greedy packing over the (member, node) lanes. Returns
@@ -1747,10 +1998,7 @@ def _gang_select_impl(feasible, score, req_cpu, req_mem, req_gpu, req_eph,
         ok = feasible[i] & fits
         zone_bonus = jnp.where(gi.zone_dom > 0, zone_cnt[gi.zone_dom], 0)
         rack_bonus = jnp.where(gi.rack_dom > 0, rack_cnt[gi.rack_dom], 0)
-        rank = ((zone_bonus.astype(jnp.int64) << GANG_ZONE_SHIFT)
-                + (rack_bonus.astype(jnp.int64) << GANG_RACK_SHIFT)
-                + jnp.clip(score[i], 0, GANG_SCORE_MASK))
-        rank = jnp.where(ok, rank, jnp.int64(-1))
+        rank = encode_gang_rank(zone_bonus, rack_bonus, score[i], ok)
         choice = jnp.argmax(rank).astype(jnp.int32)
         found = rank[choice] >= 0
         idx = jnp.maximum(choice, 0)

@@ -1,0 +1,64 @@
+"""Packed int64 ordering keys shared by the selection/top-k kernels.
+
+Three call sites historically re-implemented the same encoding — the
+analytics top-k (`kernels._analytics_reduce_impl` + its numpy mirror in
+obs/analytics.py), the gang rank key (`kernels._gang_select_impl` + the
+numpy oracle in gang/oracle.py), and now the cross-shard top-k merge.
+One drifted shift constant would silently break device-vs-host bit parity,
+so the encode/decode lives here once and every mirror imports it.
+
+All helpers are arithmetic-only (shifts, masks, method-form `astype`/
+`clip`) so the SAME source line evaluates identically over numpy arrays
+and jax tracers — the host mirrors are bit-exact by construction, not by
+careful duplication. Invalid lanes encode as -1, strictly below every
+valid key (valid keys are nonnegative), so masked argmax/top_k never
+selects one.
+
+Tie-break contract (property-locked by tests/test_packing.py): keys are
+unique per index, and a HIGHER key means (better score, then LOWER index).
+Descending top-k of encoded keys therefore equals a stable descending
+sort over (score, first-occurrence), and argmax picks the first index
+among score ties — matching numpy's and XLA's first-occurrence argmax.
+"""
+
+from __future__ import annotations
+
+# score occupies the high bits; the low TIE_BITS hold the inverted index
+# tiebreak. Node/index counts must stay below 2**TIE_BITS (4.3B — far above
+# the 100k-node north star).
+TIE_BITS = 32
+TIE_MASK = (1 << TIE_BITS) - 1
+
+# Gang rank-key layout: zone-mate count, then rack-mate count, then the
+# clipped scan score; first-occurrence argmax resolves remaining ties.
+GANG_ZONE_SHIFT = 52
+GANG_RACK_SHIFT = 32
+GANG_SCORE_MASK = (1 << 32) - 1
+
+
+def encode_topk_keys(score, index, valid):
+    """``(score << TIE_BITS) | (TIE_MASK - index)`` where valid, else -1.
+
+    `score` int64 in [0, 2**(63-TIE_BITS)); `index` int64 in [0, TIE_MASK];
+    `valid` bool. Works elementwise on numpy arrays and jax tracers alike.
+    Every valid key is unique (the index term) and nonnegative, so top-k
+    over keys is a total order and -1 sentinels sort last."""
+    key = (score << TIE_BITS) | (TIE_MASK - index)
+    v = valid.astype(key.dtype)
+    return v * key - (1 - v)
+
+
+def decode_topk_key(key):
+    """Inverse of `encode_topk_keys` for valid keys: (score, index)."""
+    return key >> TIE_BITS, TIE_MASK - (key & TIE_MASK)
+
+
+def encode_gang_rank(zone_bonus, rack_bonus, score, ok):
+    """The gang packer's int64 rank key: zone mates, then rack mates, then
+    the clipped score; -1 where `ok` is false. `score` must be int64; the
+    bonuses are small nonnegative counts (< 2**11 zone, < 2**20 rack)."""
+    rank = ((zone_bonus.astype(score.dtype) << GANG_ZONE_SHIFT)
+            + (rack_bonus.astype(score.dtype) << GANG_RACK_SHIFT)
+            + score.clip(0, GANG_SCORE_MASK))
+    v = ok.astype(score.dtype)
+    return v * rank - (1 - v)

@@ -64,6 +64,23 @@ def stage_tree(tree, sharding=None):
     return jax.tree.map(jax.device_put, tree, sharding)
 
 
+def _valid_shard_counts(n: int) -> list:
+    """Divisors of n: the shard counts a leading mesh axis can take."""
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def _factoring_error(n: int, first_axis: str, first: int,
+                     second_axis: str) -> ValueError:
+    """Non-factoring mesh request: name BOTH axes the grid would have had
+    and list the shard counts that do divide the device count — the error
+    used to name only one axis and leave the caller to factor by hand."""
+    return ValueError(
+        f"{n} devices do not factor into a ({first_axis!r}, {second_axis!r}) "
+        f"mesh with {first_axis}={first} ({second_axis} would not get a "
+        f"whole number of devices); valid {first_axis} counts for "
+        f"{n} devices: {_valid_shard_counts(n)}")
+
+
 def make_mesh(n_devices: Optional[int] = None, snap: int = 1,
               devices: Optional[list] = None) -> Mesh:
     """A ("snap", "node") mesh over the first n_devices devices."""
@@ -71,8 +88,8 @@ def make_mesh(n_devices: Optional[int] = None, snap: int = 1,
         devices = jax.devices()
     devices = devices[: (n_devices or len(devices))]
     n = len(devices)
-    if n % snap != 0:
-        raise ValueError(f"{n} devices do not factor into snap={snap}")
+    if snap < 1 or n % snap != 0:
+        raise _factoring_error(n, "snap", snap, "node")
     grid = np.array(devices).reshape(snap, n // snap)
     return Mesh(grid, ("snap", "node"))
 
@@ -91,8 +108,8 @@ def make_scenario_mesh(n_devices: Optional[int] = None,
     devices = devices[: (n_devices or len(devices))]
     n = len(devices)
     scenario = scenario or n
-    if n % scenario != 0:
-        raise ValueError(f"{n} devices do not factor into scenario={scenario}")
+    if scenario < 1 or n % scenario != 0:
+        raise _factoring_error(n, "scenario", scenario, "node")
     grid = np.array(devices).reshape(scenario, n // scenario)
     return Mesh(grid, ("scenario", "node"))
 

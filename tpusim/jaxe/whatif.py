@@ -485,7 +485,19 @@ def run_what_if(scenarios: Sequence[Tuple[ClusterSnapshot, List[Pod]]],
     Raises ValueError for inputs that cannot batch — empty scenario list,
     zero-node snapshots, unknown mesh axes — before anything reaches jit.
     """
+    # mesh validation runs BEFORE any host prep or staging: axis names via
+    # mesh_kind, then device membership — a mesh built over devices this
+    # process can't see used to surface as an opaque device_put failure
+    # after the whole batch was already unified and stacked
     kind = mesh_kind(mesh) if mesh is not None else None
+    if mesh is not None:
+        visible = set(jax.devices())
+        missing = [d for d in mesh.devices.flat if d not in visible]
+        if missing:
+            raise ValueError(
+                f"what-if mesh spans {len(missing)} device(s) not visible "
+                f"to this process (e.g. {missing[0]}); rebuild the mesh "
+                "from jax.devices()")
     n_snap_shards = 1 if mesh is None else (
         mesh.shape["snap"] if kind == "snap" else mesh.shape["scenario"])
     # the shard_map route keeps node columns whole per shard: no node pad

@@ -47,10 +47,9 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from tpusim.framework.metrics import register
+from tpusim.jaxe.packing import decode_topk_key, encode_topk_keys
 
 UTIL_SCALE = 1_000_000
-_TIE_BITS = 32
-_TIE_MASK = (1 << _TIE_BITS) - 1
 RESOURCES = ("cpu", "memory", "gpu", "ephemeral", "pods")
 
 
@@ -82,10 +81,11 @@ def host_reduce(inp, n_valid: int, k: int) -> Dict[str, np.ndarray]:
     util = np.where(alloc[:2] > 0,
                     (used[:2] * UTIL_SCALE) // np.maximum(alloc[:2], 1), 0)
     score = np.clip(np.maximum(util[0], util[1]), 0, UTIL_SCALE)
-    tie = (np.int64(_TIE_MASK) - np.arange(n, dtype=np.int64))
-    hot = np.where(mask, (score << _TIE_BITS) | tie, np.int64(-1))
-    cold = np.where(mask,
-                    ((UTIL_SCALE - score) << _TIE_BITS) | tie, np.int64(-1))
+    # the SAME encode the device kernel runs (jaxe/packing.py) — parity by
+    # shared source, not by duplicated shift constants
+    idx = np.arange(n, dtype=np.int64)
+    hot = encode_topk_keys(score, idx, mask)
+    cold = encode_topk_keys(UTIL_SCALE - score, idx, mask)
     return {
         "alloc": alloc.sum(axis=1),
         "used": used.sum(axis=1),
@@ -105,8 +105,7 @@ def _decode_keys(keys: np.ndarray, names, hot: bool) -> List[Dict[str, Any]]:
     for key in keys.tolist():
         if key < 0:
             continue  # padding past n_valid
-        score = key >> _TIE_BITS
-        idx = _TIE_MASK - (key & _TIE_MASK)
+        score, idx = decode_topk_key(key)
         ppm = score if hot else UTIL_SCALE - score
         out.append({"node": names[idx] if names else idx,
                     "utilization_ppm": int(ppm)})
@@ -211,13 +210,19 @@ class ClusterAnalytics:
         return time.monotonic() - self._last_capture >= self.sample_interval_s
 
     def capture_device(self, inp, n_valid: int, source: str,
-                       cycle: Optional[int] = None, names=None) -> None:
+                       cycle: Optional[int] = None, names=None,
+                       mesh=None) -> None:
         """Dispatch the reduction on device columns and ring the result.
 
         The jit call is asynchronous — the returned stats are un-forced
         futures and decode happens at query/flush time, so the pipelined
         stream's overlap is preserved. Cost when enabled: one O(N)
-        dispatch + a lock'd append."""
+        dispatch + a lock'd append.
+
+        mesh: a node-sharded mesh when `inp` holds shard-even padded,
+        node-sharded columns (the TPUSIM_SHARDS route) — the reduction then
+        runs the two-level merge (per-shard fold + psum/pmax/all_gather of
+        packed top-k keys), bit-identical to the single-device reduce."""
         from tpusim.jaxe.kernels import analytics_reduce
 
         if not self.want_sample():
@@ -225,7 +230,15 @@ class ClusterAnalytics:
         self._last_capture = time.monotonic()
         n = int(inp.alloc_cpu.shape[0])
         k = max(1, min(self.top_k, n))
-        stats = analytics_reduce(inp, np.int64(n_valid), k=k)
+        if mesh is None:
+            stats = analytics_reduce(inp, np.int64(n_valid), k=k)
+        else:
+            from tpusim.jaxe.kernels import analytics_reduce_sharded
+            from tpusim.obs import recorder as flight
+
+            with flight.span("shard:topk_collective", "device"):
+                stats = analytics_reduce_sharded(mesh, inp,
+                                                 np.int64(n_valid), k=k)
         inputs = None
         if self.keep_inputs:
             # host-copy NOW, and force a REAL copy: the carry columns are
@@ -325,16 +338,17 @@ def get() -> Optional[ClusterAnalytics]:
 
 
 def capture(statics, carry, n_valid: int, source: str,
-            cycle: Optional[int] = None, names=None) -> None:
+            cycle: Optional[int] = None, names=None, mesh=None) -> None:
     """Reduce one (Statics, final Carry) pair; no-op (one None-check)
-    when disabled."""
+    when disabled. mesh routes node-sharded trees through the cross-shard
+    two-level reduction (see capture_device)."""
     log = _active
     if log is None or not log.want_sample():
         return
     from tpusim.jaxe.kernels import analytics_in
 
     log.capture_device(analytics_in(statics, carry), n_valid, source,
-                       cycle=cycle, names=names)
+                       cycle=cycle, names=names, mesh=mesh)
 
 
 # -- HBM residency accounting (always on, polled at scrape time) -----------

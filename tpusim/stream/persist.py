@@ -335,6 +335,13 @@ class StreamPersistence:
                 "device_bound": device_bound,
                 "plan_sig": repr(session._plan_key),
                 "device_npz": device_npz,
+                # node-sharded residency layout (ISSUE 16): which shard
+                # owns which node block. Recovery replays the WAL tail
+                # once (host picture), then the recovered session's first
+                # restage re-stages the twin per-owner from this layout's
+                # TPUSIM_SHARDS — tail work and restage cost stay
+                # O(delta-per-shard) instead of O(cluster)
+                "shard_layout": session._shard_layout,
                 "snapshot": inc.to_snapshot().to_obj(),
             }
             tmp = self.checkpoint_path + ".tmp"
@@ -371,6 +378,7 @@ class RecoveryReport:
         field(default_factory=dict)
     violations: List[str] = field(default_factory=list)
     device_arrays: Optional[dict] = None
+    shard_layout: Optional[dict] = None   # node-mesh layout at checkpoint
 
 
 def read_wal(wal_path: str) -> Tuple[List[Tuple[int, dict]], List[str]]:
@@ -428,7 +436,8 @@ def recover_stream_session(directory: str, *,
         ck = json.load(f)
     records, torn = read_wal(wal_path)
     report = RecoveryReport(checkpoint_cycle=int(ck["cycle"]),
-                            violations=list(torn))
+                            violations=list(torn),
+                            shard_layout=ck.get("shard_layout"))
 
     snapshot = ClusterSnapshot.from_obj(ck["snapshot"])
     inc = IncrementalCluster(snapshot)

@@ -341,6 +341,13 @@ class StreamSession:
         self._last_path: Optional[str] = None
         self._gang_jax = None         # lazy JaxBackend for gang cycles
         self.persist = None           # stream.persist.StreamPersistence
+        # node-sharded residency (ISSUE 16 sub-problem c): set by the
+        # restage when TPUSIM_SHARDS engages — the resident twin then lives
+        # shard-even padded over the mesh's "node" axis, stream cycles run
+        # the shard_map scan, and delta scatter-commits touch only the
+        # owner shard's block (O(delta-per-shard))
+        self._shard_mesh = None
+        self._shard_layout: Optional[Dict[str, int]] = None
         # HBM residency accounting (ISSUE 14): polled at scrape/snapshot
         # time only; the weakref drops the source with the session
         analytics.register_hbm_source(
@@ -631,8 +638,8 @@ class StreamSession:
             xs_host = pad_infeasible_rows(pod_columns_to_host(cols),
                                           bucket_size(p) - p)
             carry, placements, intervened = self._dispatch(
-                dev.config, dev.carry, dev.statics, stage_tree(xs_host),
-                pods, dev.compiled)
+                dev.config, dev.carry, dev.statics,
+                self._stage_xs(xs_host), pods, dev.compiled)
             # the donated input buffer is gone either way; the scan's final
             # carry IS the post-bind resident state
             dev.carry = carry
@@ -708,12 +715,20 @@ class StreamSession:
                 sa_pin=ptabs.sa_pin, sa_val=ptabs.sa_val)
             if cp.spec.sa_enabled:
                 carry_host = carry_host._replace(sa_lock=ptabs.sa_lock_init)
-        statics = stage_tree(statics_host)
-        carry0 = stage_tree(carry_host)
+        self._decide_shard_layout(config)
+        statics, carry0 = self._stage_resident(statics_host, carry_host)
         p = len(pods)
         xs_host = pad_infeasible_rows(pod_columns_to_host(cols),
                                       bucket_size(p) - p)
-        xs = stage_tree(xs_host)
+        xs = self._stage_xs(xs_host)
+        if self._shard_mesh is not None and not self._shard_verified(
+                config, statics, carry0, xs,
+                stage_tree(statics_host), stage_tree(carry_host),
+                stage_tree(xs_host)):
+            # first-use verify disagreed: residency drops back to the
+            # single-device layout for the process (_SHARD_AUTO.disabled)
+            statics, carry0 = self._stage_resident(statics_host, carry_host)
+            xs = stage_tree(xs_host)
 
         def dispatch():
             carry, placements, intervened = self._dispatch(
@@ -777,6 +792,126 @@ class StreamSession:
         self._note_path(path, len(pods))
         return placements
 
+    def _decide_shard_layout(self, config) -> None:
+        """Re-decide the residency layout at restage time: TPUSIM_SHARDS>1
+        (eligible, enough devices, not process-disabled) shards the twin's
+        node axis; anything else is a classified fallback to the
+        single-device layout. Restage is the only place the layout can
+        change — stream cycles inherit whatever the twin was staged as."""
+        self._shard_mesh = None
+        self._shard_layout = None
+        n_shards = _backend._shard_count()
+        if n_shards <= 1 or _backend._SHARD_AUTO["disabled"]:
+            return
+        import jax
+
+        from tpusim.jaxe.kernels import shard_route_eligible
+
+        ok, why = shard_route_eligible(config)
+        if ok and len(jax.devices()) < n_shards:
+            ok, why = False, "device_count"
+        if not ok:
+            register().shard_fallback.inc(why)
+            flight.note_fast_fallback(
+                "shard_" + why, "stream residency staying single-device")
+            log.info("stream residency staying single-device (%s)", why)
+            return
+        from tpusim.jaxe.sharding import make_mesh
+
+        self._shard_mesh = make_mesh(n_shards, snap=1)
+
+    def _stage_resident(self, statics_host, carry_host):
+        """Stage the restage's host trees as the resident twin: default
+        placement, or shard-even padded + node-sharded over the mesh."""
+        mesh = self._shard_mesh
+        if mesh is None:
+            return stage_tree(statics_host), stage_tree(carry_host)
+        from tpusim.jaxe.sharding import node_shardings, pad_node_axis
+
+        n_shards = mesh.shape["node"]
+        with flight.span("shard:stage") as ssp:
+            st_h, ca_h, n_real = pad_node_axis(statics_host, carry_host,
+                                               n_shards)
+            st_sh, ca_sh = node_shardings(mesh)
+            statics = stage_tree(st_h, st_sh)
+            carry = stage_tree(ca_h, ca_sh)
+            if ssp:
+                ssp.set("shards", n_shards)
+                ssp.set("nodes", n_real)
+        per = st_h.alloc_cpu.shape[0] // n_shards
+        self._shard_layout = {"shards": n_shards, "nodes": n_real,
+                              "nodes_per_shard": per}
+        m = register()
+        m.shard_count.set(n_shards)
+        for s in range(n_shards):
+            m.shard_node_occupancy.set(
+                str(s), max(0, min(n_real - s * per, per)))
+        return statics, carry
+
+    def _stage_xs(self, xs_host):
+        """Pod columns are replicated on the sharded residency (every shard
+        reduces every pod over its node block)."""
+        mesh = self._shard_mesh
+        if mesh is None:
+            return stage_tree(xs_host)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return stage_tree(xs_host, NamedSharding(mesh, P()))
+
+    def _shard_verified(self, config, statics, carry, xs,
+                        statics_1d, carry_1d, xs_1d) -> bool:
+        """First-use verify for the sharded residency, the same seam as the
+        backend route: run the restage batch through BOTH programs on the
+        fresh restage trees and compare choices/counts bit-for-bit. A
+        match pins (shards, config) in _SHARD_AUTO (later restages and
+        every stream cycle trust); a mismatch disables the sharded route
+        process-wide and the caller re-stages single-device."""
+        import os as _os
+
+        mesh = self._shard_mesh
+        n_shards = mesh.shape["node"]
+        sig = (n_shards, config)
+        if _os.environ.get("TPUSIM_SHARD_VERIFY") == "0" \
+                or sig in _backend._SHARD_AUTO["verified_sigs"]:
+            return True
+        from dataclasses import replace as _dc_replace
+
+        from tpusim.jaxe.kernels import schedule_scan, sharded_scan_fn
+
+        _, sch, scnt, _ = sharded_scan_fn(
+            _dc_replace(config, shard_axis="node"), mesh)(carry, statics,
+                                                          xs)
+        _, ch, cnt, _ = schedule_scan(config, carry_1d, statics_1d, xs_1d)
+        if np.array_equal(np.asarray(sch), np.asarray(ch)) \
+                and np.array_equal(np.asarray(scnt), np.asarray(cnt)):
+            _backend._SHARD_AUTO["verified_sigs"].add(sig)
+            flight.note_auto_transition("shard_pin", str(n_shards))
+            return True
+        _backend._SHARD_AUTO["disabled"] = True
+        register().shard_count.set(0)
+        flight.note_auto_transition("shard_verify_fail", str(n_shards))
+        log.warning("sharded stream residency DISAGREES with the "
+                    "single-device scan (shards=%d); disabling the sharded "
+                    "route for this process", n_shards)
+        self._shard_mesh = None
+        self._shard_layout = None
+        return False
+
+    def _scan(self, config, carry, statics, xs):
+        """The per-cycle donated scan: single-device, or the shard_map
+        program when the twin is node-sharded (choices come back as GLOBAL
+        node indices either way, bit-identical by the verify seam)."""
+        mesh = self._shard_mesh
+        if mesh is None:
+            return schedule_scan_donated(config, carry, statics, xs)
+        from dataclasses import replace as _dc_replace
+
+        from tpusim.jaxe.kernels import sharded_scan_fn
+
+        with flight.span("shard:scan", "device"):
+            return sharded_scan_fn(_dc_replace(config, shard_axis="node"),
+                                   mesh, donate=True)(carry, statics, xs)
+
     def _dispatch(self, config, carry, statics, xs, pods: List[Pod],
                   compiled) -> Tuple[object, List[Placement], bool]:
         """Run the donated scan under the chaos injector seam. Returns
@@ -791,7 +926,7 @@ class StreamSession:
         t0 = perf_counter()
         dsp = flight.span("device_dispatch", "device")
         with flight.profiled("tpusim:stream_scan"):
-            final_carry, choices, counts, _adv = schedule_scan_donated(
+            final_carry, choices, counts, _adv = self._scan(
                 config, carry, statics, xs)
         p = len(pods)
         choices = np.asarray(choices)[:p]
@@ -832,7 +967,8 @@ class StreamSession:
         # pinned; one None-check when disabled
         analytics.capture(statics, final_carry,
                           len(compiled.statics.names), "stream",
-                          cycle=self.cycles, names=compiled.statics.names)
+                          cycle=self.cycles, names=compiled.statics.names,
+                          mesh=self._shard_mesh)
         return final_carry, placements, corrupt_kind is not None
 
     def _host_cycle(self, pods: List[Pod], reason: str) -> List[Placement]:
@@ -999,8 +1135,9 @@ class StreamSession:
                                       bucket_size(p) - p)
         dsp = flight.span("device_dispatch", "device")
         with flight.profiled("tpusim:stream_scan"):
-            final_carry, choices, counts, _adv = schedule_scan_donated(
-                dev.config, dev.carry, dev.statics, stage_tree(xs_host))
+            final_carry, choices, counts, _adv = self._scan(
+                dev.config, dev.carry, dev.statics,
+                self._stage_xs(xs_host))
         if dsp:
             dsp.set("pods", p)
             dsp.end()
@@ -1011,7 +1148,8 @@ class StreamSession:
         analytics.capture(dev.statics, final_carry,
                           len(dev.compiled.statics.names), "stream",
                           cycle=self.cycles,
-                          names=dev.compiled.statics.names)
+                          names=dev.compiled.statics.names,
+                          mesh=self._shard_mesh)
         self._pending = _PendingCycle(pods, choices, counts, dev.compiled,
                                       t0, perf_counter(),
                                       wal_cycle=wal_cycle)

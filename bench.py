@@ -933,6 +933,8 @@ LADDER_CONFIGS = {
                      autoladder=True),
     14: LadderConfig(lambda p, b, c: measure_shard_scaling(p),
                      autoladder=True),
+    15: LadderConfig(lambda p, b, c: measure_replication(p),
+                     autoladder=True),
 }
 
 
@@ -1851,6 +1853,117 @@ def measure_shard_scaling(platform: str) -> dict:
         "vs_baseline": 0,
         "shard_curve": curve,
         "speedup_vs_one_shard": round(curve[-1]["pods_per_s"] / base, 3),
+        "metrics": _metrics_snapshot(reset=True),
+    }
+
+
+def measure_replication(platform: str) -> dict:
+    """Config 15 (ISSUE 18): hot-standby failover economics. Two curves:
+
+    - RTO vs checkpoint cadence: a replicated pair (leader + live
+      FollowerTwin over the WAL-shipping socket) is killed at the emit
+      boundary of a seeded mid-run cycle; the FailoverController
+      promotes the follower and the churn load resumes on the twin.
+      Promotion replays ONLY the unshipped tail, so the end-to-end RTO
+      should stay flat as checkpoints thin out — cold recovery's replay
+      (config 11) grows with the same interval, which is the standby's
+      economic claim. Every point must land on the crash-free fold
+      chain (the correctness bar rides along with the latency one).
+    - replication lag vs churn: the shipping backlog the pair sustains
+      (records unacked the instant the producer stops) and the shipped
+      rate as the arrival rate doubles, on crash-free replicated runs
+      whose drained chains must match on both sides.
+    """
+    import shutil
+    import tempfile
+
+    from tpusim.chaos.plan import CRASH_POINTS, kill_leader_campaign
+    from tpusim.simulator import run_replicated_stream, run_stream_simulation
+
+    nodes, cycles, arrivals = ((2_000, 32, 64) if platform != "cpu"
+                               else (400, 16, 32))
+
+    # the parity oracle: the same workload, uninterrupted + unreplicated
+    base_dir = tempfile.mkdtemp(prefix="tpusim-bench-rep-")
+    try:
+        base_chain = run_stream_simulation(
+            num_nodes=nodes, cycles=cycles, arrivals=arrivals,
+            evict_fraction=0.25, seed=11, checkpoint_dir=base_dir,
+            checkpoint_every=cycles + 1)["fold_chain"]
+    finally:
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    campaign = kill_leader_campaign(seed=11, cycles=cycles)
+    crash_plan = campaign[CRASH_POINTS.index("emit")]
+    rto_curve = []
+    for every in (1, 5, 20):
+        ckdir = tempfile.mkdtemp(prefix="tpusim-bench-rep-")
+        try:
+            out = run_replicated_stream(
+                num_nodes=nodes, cycles=cycles, arrivals=arrivals,
+                evict_fraction=0.25, seed=11, chaos_plan=crash_plan,
+                checkpoint_dir=ckdir, checkpoint_every=every)
+            if not (out["crashed"] and out["promoted"]):
+                raise RuntimeError(
+                    f"config 15: scripted leader kill did not promote "
+                    f"(crashed={out['crashed']} promoted={out['promoted']})")
+            rto_curve.append({
+                "checkpoint_every": every,
+                "rto_ms": round(out["rto_s"] * 1e3, 2),
+                "replayed_records": out["replayed_records"],
+                "wal_records": out["wal_records"],
+                "tail_fraction": round(
+                    out["replayed_records"] / max(out["wal_records"], 1), 4),
+                "resume_cycle": out["resume_cycle"],
+                "lag_at_crash": out["lag_at_crash"],
+                "violations": out["promotion_violations"],
+                "chain_identical": out["fold_chain"] == base_chain})
+            log(f"[config 15] checkpoint_every={every}: rto "
+                f"{rto_curve[-1]['rto_ms']:.1f} ms, replayed "
+                f"{out['replayed_records']}/{out['wal_records']} records, "
+                f"chain_identical={rto_curve[-1]['chain_identical']}")
+        finally:
+            shutil.rmtree(ckdir, ignore_errors=True)
+
+    lag_curve = []
+    for arr in (arrivals // 2, arrivals, arrivals * 2):
+        ckdir = tempfile.mkdtemp(prefix="tpusim-bench-rep-")
+        try:
+            t0 = time.perf_counter()
+            out = run_replicated_stream(
+                num_nodes=nodes, cycles=cycles, arrivals=arr,
+                evict_fraction=0.25, seed=11,
+                checkpoint_dir=ckdir, checkpoint_every=5)
+            elapsed = time.perf_counter() - t0
+            lag_curve.append({
+                "arrivals_per_cycle": arr,
+                "wal_records": out["wal_records"],
+                "applied_records": out["applied_records"],
+                "lag_at_loop_end": out["lag_at_loop_end"],
+                "ship_records_per_s": round(
+                    out["wal_records"] / max(elapsed, 1e-9), 1),
+                "drained": out["drained"],
+                "chain_identical": out["follower_chain_matches"]})
+            log(f"[config 15] arrivals={arr}: lag_at_loop_end="
+                f"{out['lag_at_loop_end']} of {out['wal_records']} records, "
+                f"chain_match={out['follower_chain_matches']}")
+        finally:
+            shutil.rmtree(ckdir, ignore_errors=True)
+
+    return {
+        "metric": f"hot-standby failover RTO (config 15: leader killed at "
+                  f"the emit boundary with a live follower attached, "
+                  f"checkpoint_every=1, {nodes} nodes, {cycles} cycles, "
+                  f"platform={platform})",
+        "value": rto_curve[0]["rto_ms"], "unit": "ms",
+        "vs_baseline": 0,
+        "rto_curve": rto_curve,
+        "lag_curve": lag_curve,
+        "chains_identical": (
+            all(r["chain_identical"] for r in rto_curve)
+            and all(r["chain_identical"] for r in lag_curve)),
+        "tail_only_replay": all(
+            r["replayed_records"] < r["wal_records"] for r in rto_curve),
         "metrics": _metrics_snapshot(reset=True),
     }
 

@@ -246,6 +246,39 @@ def test_fuzz_sweep_churn(seed):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(8)))
+def test_fuzz_sweep_kill_leader(seed, tmp_path_factory):
+    """Seeded kill-the-leader campaigns (ISSUE 18): every crash point of
+    every seed must promote the standby to the crash-free chain with a
+    clean failover audit."""
+    import os
+
+    from tpusim.chaos.engine import audit_failover
+    from tpusim.chaos.plan import kill_leader_campaign
+    from tpusim.simulator import run_replicated_stream, run_stream_simulation
+    from tpusim.stream.persist import StreamPersistence, read_wal
+
+    kw = dict(num_nodes=16, cycles=10, arrivals=16, evict_fraction=0.25,
+              node_flap_every=4, seed=seed)
+    base_dir = tmp_path_factory.mktemp(f"kl-base-{seed}")
+    base = run_stream_simulation(**kw, checkpoint_dir=str(base_dir),
+                                 checkpoint_every=3)
+    for plan in kill_leader_campaign(seed=seed, cycles=10):
+        d = tmp_path_factory.mktemp(
+            f"kl-{seed}-{plan.churn[0].target}")
+        out = run_replicated_stream(**kw, checkpoint_dir=str(d),
+                                    checkpoint_every=3, chaos_plan=plan)
+        assert out["promoted"], f"seed {seed} {plan.churn[0].target}"
+        assert out["promotion_violations"] == []
+        assert out["fold_chain"] == base["fold_chain"], (
+            f"seed {seed} point {plan.churn[0].target}: promoted chain "
+            "diverged from the crash-free run")
+        records, torn = read_wal(os.path.join(str(d),
+                                              StreamPersistence.WAL))
+        assert torn == [] and audit_failover(records) == []
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [5, 17, 23])
 def test_fuzz_sweep_device(seed):
     snap, pods = _workload(num_nodes=4, num_pods=8)

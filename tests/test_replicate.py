@@ -1,0 +1,417 @@
+"""Hot-standby replication + kill-the-leader failover (ISSUE 18).
+
+The replication contract under test: a leader's WAL ships live to a
+FollowerTwin that REPLAYS every cycle through its own scheduler and
+cross-checks the placement-hash chain; killing the leader at any WAL
+record boundary promotes the follower with a chain head BYTE-IDENTICAL
+to the crash-free run's, replaying only the unshipped tail (not the
+whole journal), with zero failover-audit violations (no pod lost, no
+double-bind) — and the churn load resumed on the promoted twin finishes
+at the crash-free fold chain. A diverged follower must REFUSE promotion.
+
+The fast matrix (every crash point x checkpoint cadence {1, 5}) runs in
+tier-1; cadence 20 and the sharded-twin variant are marked slow.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpusim.chaos.engine import audit_failover
+from tpusim.chaos.plan import PlanError, kill_leader_campaign
+from tpusim.simulator import run_replicated_stream, run_stream_simulation
+from tpusim.stream import CRASH_POINTS, tail_wal
+from tpusim.stream.persist import StreamPersistence, read_wal
+
+CYCLES = 8
+WORKLOAD = dict(num_nodes=16, cycles=CYCLES, arrivals=16,
+                evict_fraction=0.25, node_flap_every=3, seed=5)
+
+
+def crash_plan(point):
+    """The campaign plan targeting one WAL record kind."""
+    return kill_leader_campaign(seed=5, cycles=CYCLES)[
+        CRASH_POINTS.index(point)]
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    """The crash-free fold chain — the failover parity oracle."""
+    d = tmp_path_factory.mktemp("repl-base")
+    return run_stream_simulation(**WORKLOAD, checkpoint_dir=str(d),
+                                 checkpoint_every=2)
+
+
+# ---------------------------------------------------------------------------
+# kill-the-leader matrix: every crash point x checkpoint cadence
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos_fuzz
+@pytest.mark.parametrize("cadence", [1, 5])
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_kill_leader_promotes_chain_identical(tmp_path, baseline, point,
+                                              cadence):
+    out = run_replicated_stream(**WORKLOAD, checkpoint_dir=str(tmp_path),
+                                checkpoint_every=cadence,
+                                chaos_plan=crash_plan(point))
+    assert out["crashed"] and out["promoted"]
+    assert out["divergence"] is None
+    assert out["promotion_violations"] == []
+    # the headline invariant: the promoted twin's resumed run ends at the
+    # crash-free chain, byte for byte
+    assert out["fold_chain"] == baseline["fold_chain"]
+    # tail-only replay: promotion replayed the unshipped lag, not the
+    # journal (a cold recovery at cadence 5 would replay >= 5 cycles)
+    assert out["replayed_records"] < out["wal_records"]
+    assert 0.0 < out["rto_s"] < 30.0
+    # failover audit over the full durable journal: no pod lost across
+    # the promotion boundary, no key bound twice, binds all provenanced
+    records, torn = read_wal(os.path.join(str(tmp_path),
+                                          StreamPersistence.WAL))
+    assert torn == []
+    assert audit_failover(records) == []
+
+
+@pytest.mark.chaos_fuzz
+def test_kill_leader_pipelined_driver(tmp_path, tmp_path_factory):
+    """The pipelined driver's WAL ordering (bind N before ev N+1) must
+    give the follower the same exact replay alignment."""
+    d = tmp_path_factory.mktemp("repl-pipe-base")
+    base = run_stream_simulation(**WORKLOAD, pipeline=True,
+                                 checkpoint_dir=str(d), checkpoint_every=2)
+    out = run_replicated_stream(**WORKLOAD, pipeline=True,
+                                checkpoint_dir=str(tmp_path),
+                                checkpoint_every=2,
+                                chaos_plan=crash_plan("bind"))
+    assert out["promoted"] and out["promotion_violations"] == []
+    assert out["fold_chain"] == base["fold_chain"]
+
+
+@pytest.mark.chaos_fuzz
+@pytest.mark.slow
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_kill_leader_sparse_checkpoints(tmp_path, baseline, point):
+    """Cadence 20 > cycles: genesis checkpoint only. Promotion must still
+    be tail-only — the WARM TWIN, not the checkpoint, is the anchor."""
+    out = run_replicated_stream(**WORKLOAD, checkpoint_dir=str(tmp_path),
+                                checkpoint_every=20,
+                                chaos_plan=crash_plan(point))
+    assert out["promoted"]
+    assert out["fold_chain"] == baseline["fold_chain"]
+    assert out["replayed_records"] < out["wal_records"]
+
+
+@pytest.mark.chaos_fuzz
+@pytest.mark.slow
+def test_kill_leader_sharded_twin(tmp_path, tmp_path_factory, monkeypatch):
+    """Node axis partitioned over the virtual mesh (ISSUE 16): the twin
+    replays shard-identically and promotes to the same chain."""
+    monkeypatch.setenv("TPUSIM_SHARDS", "2")
+    d = tmp_path_factory.mktemp("repl-shard-base")
+    base = run_stream_simulation(**WORKLOAD, checkpoint_dir=str(d),
+                                 checkpoint_every=2)
+    out = run_replicated_stream(**WORKLOAD, checkpoint_dir=str(tmp_path),
+                                checkpoint_every=2,
+                                chaos_plan=crash_plan("emit"))
+    assert out["promoted"]
+    assert out["fold_chain"] == base["fold_chain"]
+
+
+# ---------------------------------------------------------------------------
+# steady-state replication (no crash)
+# ---------------------------------------------------------------------------
+
+
+def test_replicated_run_drains_to_identical_chain(tmp_path, baseline):
+    out = run_replicated_stream(**WORKLOAD, checkpoint_dir=str(tmp_path),
+                                checkpoint_every=2)
+    assert not out["crashed"]
+    assert out["drained"]
+    assert out["divergence"] is None
+    assert out["follower_chain_matches"]
+    assert out["fold_chain"] == baseline["fold_chain"]
+    # the follower applied every durable record
+    assert out["applied_records"] == out["wal_records"]
+
+
+def test_stream_simulation_ships_to_follower(tmp_path):
+    """run_stream_simulation's replicate_to arm: the production driver
+    ships to an externally-constructed twin and drains its acks."""
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.stream.replicate import FollowerTwin
+
+    follower = FollowerTwin(synthetic_cluster(WORKLOAD["num_nodes"]))
+    try:
+        out = run_stream_simulation(**WORKLOAD, checkpoint_dir=str(tmp_path),
+                                    checkpoint_every=2,
+                                    replicate_to=follower.address)
+        assert out["replication_lag_at_close"] == 0
+        assert out["replication_acked_chain"] == out["fold_chain"]
+        assert follower.chain == out["fold_chain"]
+        assert follower.diverged is None
+    finally:
+        follower.stop()
+
+
+def test_replicate_to_requires_checkpoint_dir():
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        run_stream_simulation(**WORKLOAD,
+                              replicate_to=("127.0.0.1", 1))
+
+
+# ---------------------------------------------------------------------------
+# divergence: a twin that disagrees must refuse promotion
+# ---------------------------------------------------------------------------
+
+
+def _mini_twin():
+    from tpusim.api.snapshot import make_pod, synthetic_cluster
+    from tpusim.stream.replicate import FollowerTwin
+
+    twin = FollowerTwin(synthetic_cluster(4))
+    pod = make_pod("diverge-0", milli_cpu=100, memory=1 << 20)
+    twin._apply_record({"k": "batch", "c": 0, "pods": [pod.to_obj()]}, 64)
+    return twin
+
+
+def test_bind_divergence_latches_and_refuses_promotion(tmp_path):
+    from tpusim.stream.replicate import PromotionRefused
+
+    twin = _mini_twin()
+    try:
+        # the leader claims a bind our scheduler cannot reproduce
+        twin._apply_record({"k": "bind", "c": 0,
+                            "b": [["default/diverge-0", "no-such-node"]]},
+                           128)
+        assert twin.diverged is not None
+        with pytest.raises(PromotionRefused, match="diverged"):
+            twin.promote(str(tmp_path))
+        # a diverged twin keeps accounting applied records (it still
+        # acks) but stops mutating its scheduler
+        emitted_before = twin.cycles_emitted
+        twin._apply_record({"k": "emit", "c": 0, "h": "00", "n": 1,
+                            "s": 1}, 160)
+        assert twin.cycles_emitted == emitted_before
+        assert twin.wal_records_applied == 3
+    finally:
+        twin.stop()
+
+
+def test_emit_divergence_via_wrong_hash():
+    from tpusim.api.snapshot import make_pod, synthetic_cluster
+    from tpusim.backends import placement_hash
+    from tpusim.stream.replicate import FollowerTwin
+
+    twin = FollowerTwin(synthetic_cluster(4))
+    try:
+        pod = make_pod("diverge-1", milli_cpu=100, memory=1 << 20)
+        twin._apply_record({"k": "batch", "c": 0, "pods": [pod.to_obj()]},
+                           64)
+        # schedule through the twin so bind matches...
+        placements = twin.session.schedule([pod])
+        twin.batches[0] = [pod]
+        twin._live_pending[0] = placements
+        real = placement_hash(placements)
+        twin._apply_record({"k": "emit", "c": 0,
+                            "h": "f" * len(real), "n": 1, "s": 1}, 128)
+        assert twin.diverged is not None
+        assert "placement hash diverges" in twin.diverged
+    finally:
+        twin.stop()
+
+
+def test_failover_controller_skips_diverged_candidate(tmp_path, baseline):
+    """The freshest candidate refusing promotion must fall through to the
+    next-freshest, not fail the failover."""
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.stream.replicate import (
+        FailoverController,
+        FollowerTwin,
+        PromotionRefused,
+    )
+
+    healthy = FollowerTwin(synthetic_cluster(4))
+    poisoned = FollowerTwin(synthetic_cluster(4))
+    poisoned.applied_seq = 10 ** 6      # "freshest" on paper
+    poisoned._diverge("poisoned for the test")
+    # an empty WAL dir: the healthy twin promotes over nothing
+    os.makedirs(str(tmp_path), exist_ok=True)
+    open(os.path.join(str(tmp_path), StreamPersistence.WAL), "w").close()
+    controller = FailoverController(lambda: False, [healthy, poisoned],
+                                    str(tmp_path), interval_s=0.001,
+                                    misses=1, leader_was_alive=True)
+    try:
+        promoted, report = controller.run(timeout=5.0)
+        assert promoted is healthy
+        assert report.violations == []
+    finally:
+        if healthy.persist is not None:
+            healthy.persist.close()
+        healthy.stop()
+        poisoned.stop()
+    with pytest.raises(PromotionRefused):
+        poisoned.promote(str(tmp_path))
+
+
+def test_controller_waits_for_first_contact(tmp_path):
+    """A follower started BEFORE its leader must wait for first contact,
+    not declare death and promote over a WAL that does not exist yet."""
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.stream.replicate import FailoverController, FollowerTwin
+
+    follower = FollowerTwin(synthetic_cluster(4))
+    try:
+        controller = FailoverController(
+            lambda: False, [follower], str(tmp_path),
+            interval_s=0.001, misses=1)
+        with pytest.raises(TimeoutError, match="never observed alive"):
+            controller.wait_for_death(timeout=0.05)
+        assert follower.promoted is False
+        # one successful probe arms the death watch
+        pulse = [True, True, False, False]
+        controller.probe = lambda: pulse.pop(0) if pulse else False
+        controller.wait_for_death(timeout=5.0)
+    finally:
+        follower.stop()
+
+
+def test_promote_refuses_on_missing_wal(tmp_path):
+    """Promotion against a durability directory with no WAL is a clean
+    refusal, not a traceback (e.g. an unmounted shared volume)."""
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.stream.replicate import FollowerTwin, PromotionRefused
+
+    follower = FollowerTwin(synthetic_cluster(4))
+    try:
+        with pytest.raises(PromotionRefused, match="no durable WAL"):
+            follower.promote(str(tmp_path))
+        assert follower.promoted is False   # still a standby, not wedged
+    finally:
+        follower.stop()
+
+
+# ---------------------------------------------------------------------------
+# tail_wal: the incremental reader (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def _write_wal(path, lines, torn_tail=""):
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+        if torn_tail:
+            f.write(torn_tail)
+
+
+def test_tail_wal_resume_offset_follows_live_tail(tmp_path):
+    p = str(tmp_path / "wal.jsonl")
+    _write_wal(p, [{"k": "ev", "c": 0}, {"k": "batch", "c": 0}])
+    records, violations, resume = tail_wal(p, 0)
+    assert [r["k"] for _, r in records] == ["ev", "batch"]
+    assert violations == []
+    assert resume == os.path.getsize(p)
+    # append two more records; resume from the cursor sees ONLY them
+    with open(p, "a", encoding="utf-8") as f:
+        f.write(json.dumps({"k": "bind", "c": 0}) + "\n")
+        f.write(json.dumps({"k": "emit", "c": 0}) + "\n")
+    more, violations, resume2 = tail_wal(p, resume)
+    assert [r["k"] for _, r in more] == ["bind", "emit"]
+    assert violations == []
+    assert resume2 == os.path.getsize(p)
+
+
+def test_tail_wal_torn_final_line_is_not_a_violation(tmp_path):
+    p = str(tmp_path / "wal.jsonl")
+    _write_wal(p, [{"k": "ev", "c": 0}], torn_tail='{"k": "ba')
+    records, violations, resume = tail_wal(p, 0)
+    assert len(records) == 1 and violations == []
+    # the cursor stops BEFORE the torn line: once the writer completes
+    # it, the next call picks it up whole
+    with open(p, "a", encoding="utf-8") as f:
+        f.write('tch", "c": 0}\n')
+    more, violations, _ = tail_wal(p, resume)
+    assert violations == []
+    assert [r["k"] for _, r in more] == ["batch"]
+
+
+def test_tail_wal_torn_interior_is_a_violation(tmp_path):
+    p = str(tmp_path / "wal.jsonl")
+    with open(p, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"k": "ev", "c": 0}) + "\n")
+        f.write('{"k": "torn interior\n')
+        f.write(json.dumps({"k": "emit", "c": 0}) + "\n")
+    records, violations, _ = tail_wal(p, 0)
+    assert [r["k"] for _, r in records] == ["ev", "emit"]
+    assert len(violations) == 1 and "torn interior" in violations[0]
+
+
+def test_kill_leader_campaign_covers_every_point():
+    plans = kill_leader_campaign(seed=3, cycles=12)
+    assert [pl.churn[0].target for pl in plans] == list(CRASH_POINTS)
+    for pl in plans:
+        assert pl.churn[0].action == "process_crash"
+        assert 3 <= pl.churn[0].at < 12
+    with pytest.raises(PlanError):
+        kill_leader_campaign(seed=3, cycles=2)
+
+
+# ---------------------------------------------------------------------------
+# durability dial (satellite 2) + /healthz role fields (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def test_fsync_mode_stamped_into_checkpoint_manifest(tmp_path):
+    out = run_stream_simulation(num_nodes=8, cycles=3, arrivals=8,
+                                seed=1, checkpoint_dir=str(tmp_path),
+                                checkpoint_every=1, fsync_every=4)
+    assert out["checkpoints"] >= 1
+    with open(os.path.join(str(tmp_path),
+                           StreamPersistence.CHECKPOINT)) as f:
+        meta = json.load(f)
+    assert meta["durability"] == {"mode": "fsync", "fsync_every": 4}
+
+
+def test_flush_mode_is_the_default_stamp(tmp_path):
+    run_stream_simulation(num_nodes=8, cycles=3, arrivals=8, seed=1,
+                          checkpoint_dir=str(tmp_path), checkpoint_every=1)
+    with open(os.path.join(str(tmp_path),
+                           StreamPersistence.CHECKPOINT)) as f:
+        meta = json.load(f)
+    assert meta["durability"] == {"mode": "flush", "fsync_every": 0}
+
+
+def test_fsync_every_validated(tmp_path):
+    with pytest.raises(ValueError, match="fsync_every"):
+        run_stream_simulation(num_nodes=8, cycles=1, arrivals=4, seed=1,
+                              checkpoint_dir=str(tmp_path), fsync_every=-1)
+
+
+def test_healthz_reports_replication_role():
+    from tpusim.obs.server import health_payload
+    from tpusim.stream import replicate
+
+    replicate.set_role("candidate")
+    replicate._set_state(replication_lag_records=7, last_shipped_seq=41)
+    try:
+        _, body = health_payload()
+        assert body["role"] == "candidate"
+        assert body["replication_lag_records"] == 7
+        assert body["last_shipped_seq"] == 41
+    finally:
+        replicate.set_role("none")
+        replicate._set_state(replication_lag_records=0,
+                             last_shipped_seq=-1)
+
+
+def test_replication_metrics_registered():
+    from tpusim.framework.metrics import register
+
+    reg = register()
+    for name in ("replication_lag_records", "replication_lag_bytes",
+                 "replication_lag_seconds", "replication_last_shipped_seq",
+                 "replication_ship_latency", "replication_apply_latency",
+                 "replication_promotions", "replication_divergence",
+                 "replication_rto_seconds", "replication_role"):
+        assert hasattr(reg, name), name

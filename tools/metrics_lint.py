@@ -56,6 +56,10 @@ _UNITLESS_GAUGES = {
     # ISSUE 16: mesh shape + per-shard node counts are dimensionless
     "tpusim_shard_count",
     "tpusim_shard_node_occupancy",
+    # ISSUE 18: replication lag in records and the shipped sequence
+    # cursor are dimensionless counts (the byte/time lags carry units)
+    "tpusim_replication_lag_records",
+    "tpusim_replication_last_shipped_seq",
 }
 # label names whose value sets are finite by construction; anything else
 # (node names, pod names, plan signatures) is unbounded cardinality

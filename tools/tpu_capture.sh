@@ -292,6 +292,12 @@ run_stage sharded configs:14 bench_results/r5_tpu_sharded.jsonl \
     env TPUSIM_BENCH_LADDER_CONFIGS=14 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
     python bench.py --ladder
 
+echo "== stage 3h: hot-standby failover (config 15: RTO-vs-cadence + replication-lag-vs-churn) =="
+run_stage replication configs:15 bench_results/r5_tpu_replication.jsonl \
+    bench_results/r5_tpu_replication.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=15 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+
 echo "== stage 4: full XLA ladder (configs 1-5; fresh same-round parity anchors) =="
 run_stage ladder configs:1,2,3,4,5 bench_results/r5_tpu_ladder.jsonl \
     bench_results/r5_tpu_ladder.log \

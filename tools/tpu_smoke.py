@@ -737,6 +737,78 @@ def run_stream_recover_variant():
         shutil.rmtree(ck_dir, ignore_errors=True)
 
 
+def run_standby_variant():
+    """Hot standby + failover (tpusim/stream/replicate) stage-0: a leader
+    shipping its WAL live to an in-process FollowerTwin, killed mid-run by
+    a scripted crash, must (a) promote the standby to a fold chain
+    byte-identical to the crash-free run's, (b) replay only the unshipped
+    tail (the replication lag), not the journal, with zero promotion
+    violations, and (c) resume on the promoted twin WITHOUT retracing a
+    single scan or scatter program — the follower's replayed device
+    picture re-enters the same pow2-bucketed executables the leader (and
+    the warm-up baseline) compiled."""
+    import shutil
+    import tempfile
+
+    from tpusim.chaos.plan import kill_leader_campaign
+    from tpusim.jaxe.kernels import apply_delta_donated, schedule_scan_donated
+    from tpusim.simulator import run_replicated_stream, run_stream_simulation
+    from tpusim.stream import CRASH_POINTS
+
+    def cache_sizes():
+        try:
+            return (schedule_scan_donated._cache_size(),
+                    apply_delta_donated._cache_size())
+        except AttributeError:  # private jit API moved: skip the check
+            return None
+
+    kw = dict(num_nodes=16, cycles=10, arrivals=16, evict_fraction=0.25,
+              node_flap_every=4, seed=7, checkpoint_every=2)
+    base_dir = tempfile.mkdtemp(prefix="tpusim-smoke-repl-")
+    rep_dir = tempfile.mkdtemp(prefix="tpusim-smoke-repl-")
+    try:
+        base = run_stream_simulation(checkpoint_dir=base_dir, **kw)
+        plan = kill_leader_campaign(seed=7, cycles=kw["cycles"])[
+            CRASH_POINTS.index("emit")]
+        before = cache_sizes()
+        out = run_replicated_stream(checkpoint_dir=rep_dir,
+                                    chaos_plan=plan, **kw)
+        traced = None
+        if before is not None:
+            after = cache_sizes()
+            traced = (after[0] - before[0], after[1] - before[1])
+            if any(traced):
+                raise AssertionError(
+                    f"promotion retraced (scan +{traced[0]}, scatter "
+                    f"+{traced[1]}); the standby's replayed device "
+                    f"picture missed the warm executables")
+        if not out["crashed"] or not out["promoted"]:
+            raise AssertionError(
+                f"kill-the-leader never promoted: {out['crash_detail']}")
+        if out["fold_chain"] != base["fold_chain"]:
+            raise AssertionError(
+                f"promoted chain diverges from the crash-free run "
+                f"({out['fold_chain'][:16]} != {base['fold_chain'][:16]})")
+        if out["promotion_violations"]:
+            raise AssertionError(
+                f"promotion violations: {out['promotion_violations']}")
+        if out["divergence"]:
+            raise AssertionError(
+                f"follower diverged during replication: "
+                f"{out['divergence']}")
+        if not out["replayed_records"] < out["wal_records"]:
+            raise AssertionError(
+                f"promotion replayed the whole journal "
+                f"({out['replayed_records']}/{out['wal_records']} "
+                f"records) — the warm twin bought nothing")
+        h = out["fold_chain"][:16]
+        return (h, out["rto_s"] * 1e3, out["replayed_records"],
+                out["wal_records"], traced)
+    finally:
+        shutil.rmtree(base_dir, ignore_errors=True)
+        shutil.rmtree(rep_dir, ignore_errors=True)
+
+
 def run_analytics_variant():
     """Cluster analytics plane (tpusim/obs/analytics) stage-0: with the
     post-scan reduction riding every dispatch, (a) on-device aggregates
@@ -1156,6 +1228,29 @@ def main() -> int:
             print(f"SMOKE stream_recover: OK hash={h} "
                   f"resume_cycle={resume_cycle} wal_records={wal_records} "
                   f"retrace={retrace} ({time.time() - t:.1f}s)", flush=True)
+        if not only or "standby" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "standby")
+            try:
+                h, rto_ms, replayed, wal_records, traced = \
+                    run_standby_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: standby: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("rto_ms", round(rto_ms, 2))
+            vsp.end()
+            ran += 1
+            retrace = ("skipped" if traced is None
+                       else f"+{traced[0]}/+{traced[1]}")
+            print(f"SMOKE standby: OK hash={h} rto_ms={rto_ms:.1f} "
+                  f"replayed={replayed}/{wal_records} retrace={retrace} "
+                  f"({time.time() - t:.1f}s)", flush=True)
         if not only or "analytics" in only:
             t = time.time()
             vsp = flight.span("smoke_variant")

@@ -592,3 +592,70 @@ def check_invariants(cc, engine: ChaosEngine) -> List[str]:
                 f"{state.pod.spec.node_name}, store on "
                 f"{stored.spec.node_name}")
     return violations
+
+
+def audit_failover(records) -> List[str]:
+    """Journal-level invariant audit across a leader failover (ISSUE 18).
+
+    Input is the [(offset, record)] list of the FULL post-failover WAL —
+    leader prefix, recomputed crash tail, and promoted-leader
+    continuation all in one journal. Returns violation strings:
+
+    - **no pod lost** — every emit record's scheduled count ``s`` is
+      matched by exactly that many bind entries for its cycle, and every
+      emit accounts for its whole batch (``n`` == the batch size);
+    - **no double-bind** — no pod key is bound to two different nodes
+      without an intervening DELETED event for it (a rebind of a live
+      pod across the failover boundary would mean the promoted twin
+      re-decided a cycle the dead leader had already committed);
+    - **bind provenance** — every bind entry's pod key belongs to its
+      cycle's batch record.
+    """
+    violations: List[str] = []
+    batch_keys: Dict[int, Set[str]] = {}
+    batch_sizes: Dict[int, int] = {}
+    binds_by_cycle: Dict[int, List[Tuple[str, str]]] = {}
+    bound_to: Dict[str, str] = {}
+    for _ofs, rec in records:
+        k, c = rec.get("k"), int(rec.get("c", -1))
+        if k == "batch":
+            keys = set()
+            for obj in rec.get("pods", []):
+                md = obj.get("metadata", obj)
+                ns = md.get("namespace") or "default"
+                keys.add(f"{ns}/{md.get('name')}")
+            batch_keys[c] = keys
+            batch_sizes[c] = len(rec.get("pods", []))
+        elif k == "ev" and rec.get("t") == "DELETED" \
+                and rec.get("r") == "pod":
+            obj = rec.get("o", {})
+            md = obj.get("metadata", obj)
+            ns = md.get("namespace") or "default"
+            bound_to.pop(f"{ns}/{md.get('name')}", None)
+        elif k == "bind":
+            entries = [(key, node) for key, node in rec.get("b", [])]
+            binds_by_cycle.setdefault(c, []).extend(entries)
+            for key, node in entries:
+                prev = bound_to.get(key)
+                if prev is not None and prev != node:
+                    violations.append(
+                        f"double-bind across failover: {key} bound to "
+                        f"{prev} then {node} (cycle {c})")
+                bound_to[key] = node
+                keys = batch_keys.get(c)
+                if keys is not None and key not in keys:
+                    violations.append(
+                        f"bind without batch: {key} in cycle {c}")
+        elif k == "emit":
+            n, s = int(rec.get("n", 0)), int(rec.get("s", 0))
+            got = len(binds_by_cycle.get(c, []))
+            if got != s:
+                violations.append(
+                    f"pod lost in cycle {c}: emit says {s} scheduled "
+                    f"but the journal holds {got} bind entries")
+            size = batch_sizes.get(c)
+            if size is not None and n != size:
+                violations.append(
+                    f"pod lost in cycle {c}: emit covers {n} decisions "
+                    f"for a batch of {size}")
+    return violations

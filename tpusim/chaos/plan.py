@@ -265,6 +265,29 @@ def random_crash_plan(seed: int, cycles: int,
     return FaultPlan(seed=seed, churn=[ev]).validate()
 
 
+def kill_leader_campaign(seed: int, cycles: int,
+                         points=CRASH_POINTS) -> List[FaultPlan]:
+    """A kill-the-leader campaign (ISSUE 18): one FaultPlan per WAL
+    record boundary class, each crashing the LEADER of a replicated pair
+    at a seeded mid-run cycle. The failover matrix runs every plan
+    against the same workload and asserts that a follower promotes with
+    a byte-identical placement-hash chain head at all four boundaries.
+
+    Crash cycles are drawn from the middle half of the run ([cycles/4,
+    3*cycles/4)) so every campaign leaves both a replicated prefix to
+    promote FROM and a post-failover tail to keep scheduling INTO —
+    a crash at cycle 0 or the final cycle would test recovery, not
+    continuity. Deterministic in ``seed``."""
+    if cycles < 4:
+        raise PlanError("kill_leader_campaign needs cycles >= 4")
+    rng = random.Random(seed)
+    lo, hi = cycles // 4, max(cycles // 4 + 1, (3 * cycles) // 4)
+    return [FaultPlan(seed=seed, churn=[
+        ChurnEvent(at=rng.randrange(lo, hi), action="process_crash",
+                   target=point)]).validate()
+        for point in points]
+
+
 def random_plan(seed: int, node_names: List[str], pod_keys: List[str],
                 attempts: int, device_dispatches: int = 0,
                 max_retries: int = 3,

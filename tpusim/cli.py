@@ -751,6 +751,16 @@ def build_stream_parser() -> argparse.ArgumentParser:
                              "WAL tail replay) and resume the interrupted "
                              "run; the fold chain proves placement parity "
                              "with the uninterrupted run")
+    parser.add_argument("--fsync-every", type=int, default=0,
+                        help="fsync the WAL every N appends (0: flush-only "
+                             "durability); the mode is stamped into every "
+                             "checkpoint manifest")
+    parser.add_argument("--replicate-to", default="",
+                        help="HOST:PORT of a listening `tpusim follow` "
+                             "standby: ship every WAL record + checkpoint "
+                             "manifest over the replication protocol "
+                             "(stream.replicate) and drain the acks before "
+                             "exiting; requires --checkpoint-dir")
     parser.add_argument("--platform",
                         default=os.environ.get("TPUSIM_PLATFORM", ""))
     parser.add_argument("--json", action="store_true",
@@ -806,6 +816,21 @@ def stream_cli(argv) -> int:
 
         recorder = flight.install(flight.FlightRecorder())
 
+    replicate_to = None
+    if args.replicate_to:
+        from tpusim.obs.server import parse_listen
+
+        if not args.checkpoint_dir:
+            print("error: --replicate-to ships the WAL; pass "
+                  "--checkpoint-dir", file=sys.stderr)
+            return 2
+        try:
+            replicate_to = parse_listen(args.replicate_to)
+        except ValueError:
+            print(f"error: --replicate-to {args.replicate_to!r}: want "
+                  "HOST:PORT", file=sys.stderr)
+            return 2
+
     from tpusim.chaos.engine import ProcessCrash
     from tpusim.simulator import run_stream_simulation
 
@@ -823,6 +848,8 @@ def stream_cli(argv) -> int:
             chaos_plan=chaos_plan,
             checkpoint_dir=args.checkpoint_dir or None,
             checkpoint_every=args.checkpoint_every,
+            fsync_every=args.fsync_every,
+            replicate_to=replicate_to,
             recover=args.recover)
     except ProcessCrash as exc:
         # the scripted kill: state up to the crash is durable in the WAL;
@@ -865,6 +892,13 @@ def stream_cli(argv) -> int:
             print(f"durability: {out['wal_records']} WAL records, "
                   f"{out['checkpoints']} checkpoints; fold chain "
                   f"{out['fold_chain'][:16]}")
+        if "replication_acked_seq" in out:
+            parity = (out["replication_acked_chain"] == out["fold_chain"])
+            print(f"replication: acked through seq "
+                  f"{out['replication_acked_seq']}, lag "
+                  f"{out['replication_lag_at_close']} record(s) at close; "
+                  f"follower chain "
+                  f"{'matches' if parity else 'DIVERGED from'} the leader")
     if args.verify:
         if out["verified"]:
             print("verify: every cycle placement_hash-identical to the "
@@ -892,6 +926,304 @@ def stream_cli(argv) -> int:
             print(f"error: failed to write metrics: {exc}", file=sys.stderr)
             return 2
     return exit_code
+
+
+def _add_follow_snapshot_flags(parser: argparse.ArgumentParser) -> None:
+    """The twin's snapshot source: MUST reproduce the leader's cycle-0
+    picture (same --snapshot file or same synthetic parameters) — the
+    shipper replays the journal from its first record."""
+    parser.add_argument("--snapshot", default="",
+                        help="Combined ClusterSnapshot JSON — the leader's "
+                             "cycle-0 snapshot source")
+    parser.add_argument("--synthetic-nodes", type=int, default=64,
+                        help="Generate N homogeneous synthetic nodes "
+                             "(must match the leader's)")
+    parser.add_argument("--synthetic-milli-cpu", type=int, default=4000)
+    parser.add_argument("--synthetic-memory", type=int, default=16 * 1024**3)
+    parser.add_argument("--seed-label-universe", action="store_true",
+                        help="Seed the churn label universe across the "
+                             "synthetic nodes (required when the leader "
+                             "runs --policy-file or label/taint churn)")
+    parser.add_argument("--algorithmprovider", default="DefaultProvider")
+    parser.add_argument("--policy-file", default="",
+                        help="Scheduler policy JSON — must match the "
+                             "leader's (the twin re-decides every cycle)")
+    parser.add_argument("--always-restage", action="store_true")
+    parser.add_argument("--platform",
+                        default=os.environ.get("TPUSIM_PLATFORM", ""))
+    parser.add_argument("--json", action="store_true",
+                        help="Print the summary dict as JSON")
+
+
+def _load_follow_snapshot(args):
+    snapshot = None
+    policy = None
+    if args.snapshot:
+        snapshot = ClusterSnapshot.load(args.snapshot)
+    else:
+        snapshot = synthetic_cluster(
+            args.synthetic_nodes, milli_cpu=args.synthetic_milli_cpu,
+            memory=args.synthetic_memory)
+    if args.policy_file:
+        from tpusim.engine.policy import load_policy_file
+
+        policy = load_policy_file(args.policy_file)
+    if not args.snapshot and (policy is not None
+                              or args.seed_label_universe):
+        from tpusim.stream.loadgen import DEFAULT_LABEL_UNIVERSE
+
+        # the leader's run_stream_simulation seeds synthetic nodes the
+        # same way — the twins' cold-start compiles must intern the same
+        # label domains
+        for i, node in enumerate(snapshot.nodes):
+            node.metadata.labels.update(
+                {k: vals[i % len(vals)]
+                 for k, vals in DEFAULT_LABEL_UNIVERSE.items()})
+    return snapshot, policy
+
+
+def build_follow_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusim follow",
+        description="Hot standby: listen for a leader's WAL-shipping "
+                    "stream (tpusim stream --replicate-to) and replay "
+                    "every shipped cycle through a live scheduler twin, "
+                    "cross-checking the placement-hash chain per cycle "
+                    "(stream.replicate). With --watch-leader, promote "
+                    "automatically when the leader's /healthz dies")
+    parser.add_argument("--bind", default="127.0.0.1:0",
+                        help="HOST:PORT the replication listener binds "
+                             "(':0' picks a free port, printed on start)")
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="The LEADER's durability directory (shared "
+                             "storage): promotion replays its WAL tail "
+                             "and journals onward into it; required with "
+                             "--watch-leader")
+    parser.add_argument("--watch-leader", default="",
+                        help="Leader /healthz URL (http://HOST:PORT): "
+                             "probe it and promote this twin when it "
+                             "misses --misses probes in a row")
+    parser.add_argument("--watch-interval", type=float, default=0.25,
+                        help="Seconds between leader probes")
+    parser.add_argument("--misses", type=int, default=2,
+                        help="Consecutive probe misses declaring death")
+    parser.add_argument("--watch-timeout", type=float, default=0.0,
+                        help="Give up watching after this many seconds "
+                             "(0: watch forever)")
+    parser.add_argument("--checkpoint-every", type=int, default=10,
+                        help="Post-promotion checkpoint cadence")
+    parser.add_argument("--fsync-every", type=int, default=0,
+                        help="Post-promotion WAL fsync cadence")
+    _add_follow_snapshot_flags(parser)
+    add_obs_flags(parser)
+    add_explain_flags(parser)
+    return parser
+
+
+def follow_cli(argv) -> int:
+    """`tpusim follow`: a live standby twin (ISSUE 18)."""
+    import json
+
+    args = build_follow_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        os.environ["TPUSIM_PROBE"] = "0"
+    if args.watch_leader and not args.checkpoint_dir:
+        print("error: --watch-leader promotes from the leader's WAL; pass "
+              "--checkpoint-dir (the shared durability directory)",
+              file=sys.stderr)
+        return 2
+
+    from tpusim.obs.server import parse_listen
+
+    try:
+        bind = parse_listen(args.bind)
+        snapshot, policy = _load_follow_snapshot(args)
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    from tpusim.stream.replicate import (
+        FailoverController,
+        FollowerTwin,
+        PromotionRefused,
+        http_probe,
+    )
+
+    obs_teardown = _arm_observability(args)
+    try:
+        try:
+            follower = FollowerTwin(snapshot,
+                                    provider=args.algorithmprovider,
+                                    policy=policy,
+                                    always_restage=args.always_restage,
+                                    listen=bind)
+        except (KeyError, ValueError, OSError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        host, port = follower.address
+        print(f"follower: replication listener on {host}:{port} "
+              f"(leader side: tpusim stream --replicate-to {host}:{port})",
+              file=sys.stderr)
+
+        def summary(extra=None) -> dict:
+            body = {"applied_records": follower.wal_records_applied,
+                    "cycles_emitted": follower.cycles_emitted,
+                    "chain": follower.chain,
+                    "scheduled": follower.scheduled,
+                    "decisions": follower.decisions,
+                    "divergence": follower.diverged}
+            body.update(extra or {})
+            return body
+
+        if args.watch_leader:
+            url = args.watch_leader.rstrip("/")
+            if "://" not in url:   # bare HOST:PORT (the --listen spelling)
+                url = "http://" + url
+            if not url.endswith("/healthz"):
+                url += "/healthz"
+            controller = FailoverController(
+                http_probe(url), [follower], args.checkpoint_dir,
+                interval_s=max(0.01, args.watch_interval),
+                misses=max(1, args.misses),
+                checkpoint_every=args.checkpoint_every,
+                fsync_every=args.fsync_every)
+            timeout = args.watch_timeout if args.watch_timeout > 0 else 1e9
+            try:
+                _, report = controller.run(timeout=timeout)
+            except TimeoutError as exc:
+                print(f"{exc}; exiting without promotion", file=sys.stderr)
+                follower.stop()
+                out = summary({"promoted": False})
+                print(json.dumps(out, sort_keys=True) if args.json
+                      else f"follower: applied {out['applied_records']} "
+                           f"records, chain {out['chain'][:16]}")
+                return 0
+            except PromotionRefused as exc:
+                print(f"error: promotion refused: {exc}", file=sys.stderr)
+                return 1
+            out = summary({
+                "promoted": True, "rto_s": report.rto_s,
+                "resume_cycle": report.resume_cycle,
+                "replayed_records": report.tail_records,
+                "recomputed_cycles": list(report.recomputed),
+                "settled_live_cycles": list(report.settled_live),
+                "promotion_violations": list(report.violations)})
+            follower.persist.close()
+            if args.json:
+                print(json.dumps(out, sort_keys=True))
+            else:
+                print(f"promoted: resumed at cycle {out['resume_cycle']} "
+                      f"(replayed {out['replayed_records']} tail records, "
+                      f"RTO {out['rto_s'] * 1e3:.1f} ms); chain "
+                      f"{out['chain'][:16]}")
+                print(f"resume the churn load with: tpusim stream "
+                      f"--checkpoint-dir {args.checkpoint_dir} --recover ...")
+            return 1 if out["promotion_violations"] else 0
+
+        try:
+            while True:
+                time.sleep(1.0)
+        except KeyboardInterrupt:
+            pass
+        follower.stop()
+        out = summary()
+        print(json.dumps(out, sort_keys=True) if args.json
+              else f"follower: applied {out['applied_records']} records "
+                   f"over {out['cycles_emitted']} cycles, chain "
+                   f"{out['chain'][:16]}"
+                   + (f"; DIVERGED: {out['divergence']}"
+                      if out["divergence"] else ""))
+        return 1 if out["divergence"] else 0
+    finally:
+        obs_teardown()
+
+
+def build_promote_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusim promote",
+        description="Durable-state promotion: replay a dead leader's "
+                    "entire WAL (checkpoint dir) through a fresh twin "
+                    "via the promotion path — chain cross-checked "
+                    "against the durable checkpoint manifest, crash-tail "
+                    "cycles re-decided, a fresh checkpoint written. "
+                    "Resume the run afterwards with `tpusim stream "
+                    "--recover`")
+    parser.add_argument("--checkpoint-dir", required=True,
+                        help="The dead leader's durability directory")
+    parser.add_argument("--checkpoint-every", type=int, default=10)
+    parser.add_argument("--fsync-every", type=int, default=0)
+    parser.add_argument("--metrics-out", default="",
+                        help="Write the metric families (including "
+                             "tpusim_replication_*) after promotion")
+    _add_follow_snapshot_flags(parser)
+    return parser
+
+
+def promote_cli(argv) -> int:
+    """`tpusim promote`: one-shot durable promotion (ISSUE 18)."""
+    import json
+
+    args = build_promote_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        os.environ["TPUSIM_PROBE"] = "0"
+    try:
+        snapshot, policy = _load_follow_snapshot(args)
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    from tpusim.stream.replicate import FollowerTwin, PromotionRefused
+
+    try:
+        follower = FollowerTwin(snapshot, provider=args.algorithmprovider,
+                                policy=policy,
+                                always_restage=args.always_restage)
+    except (KeyError, ValueError, OSError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    try:
+        report = follower.promote(args.checkpoint_dir,
+                                  checkpoint_every=args.checkpoint_every,
+                                  fsync_every=args.fsync_every)
+    except PromotionRefused as exc:
+        follower.stop()
+        print(f"error: promotion refused: {exc}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError, KeyError) as exc:
+        follower.stop()
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    follower.persist.close()
+    out = {"promoted": True, "chain": report.chain,
+           "resume_cycle": report.resume_cycle,
+           "replayed_records": report.tail_records,
+           "recomputed_cycles": list(report.recomputed),
+           "wal_records": report.wal_records,
+           "replay_s": report.replay_s,
+           "violations": list(report.violations)}
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+    else:
+        print(f"promoted: {out['replayed_records']} WAL records replayed "
+              f"({len(out['recomputed_cycles'])} cycles re-decided) in "
+              f"{out['replay_s'] * 1e3:.1f} ms; chain {out['chain'][:16]}")
+        print(f"resume with: tpusim stream --checkpoint-dir "
+              f"{args.checkpoint_dir} --recover ...")
+        for violation in out["violations"]:
+            print(f"promotion violation: {violation}", file=sys.stderr)
+    if args.metrics_out:
+        try:
+            _write_metrics(args.metrics_out)
+        except OSError as exc:
+            print(f"error: failed to write metrics: {exc}", file=sys.stderr)
+            return 2
+    return 1 if out["violations"] else 0
 
 
 def build_explain_parser() -> argparse.ArgumentParser:
@@ -1154,6 +1486,10 @@ def main(argv=None) -> int:
         return serve_cli(argv[1:])
     if argv and argv[0] == "stream":
         return stream_cli(argv[1:])
+    if argv and argv[0] == "follow":
+        return follow_cli(argv[1:])
+    if argv and argv[0] == "promote":
+        return promote_cli(argv[1:])
     if argv and argv[0] == "explain":
         return explain_cli(argv[1:])
     if argv and argv[0] == "top":

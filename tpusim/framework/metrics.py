@@ -516,6 +516,48 @@ class SchedulerMetrics:
             "tpusim_gang_size",
             "Members per admitted-or-rejected pod group",
             [1, 2, 4, 8, 16, 32, 64]))
+        # replicated control plane (ISSUE 18): WAL shipping to a hot
+        # standby, per-cycle chain cross-checks, and chaos-driven leader
+        # failover with an end-to-end RTO
+        self.replication_lag_records = self._reg(Gauge(
+            "tpusim_replication_lag_records",
+            "WAL records appended on the leader but not yet acked by the "
+            "follower"))
+        self.replication_lag_bytes = self._reg(Gauge(
+            "tpusim_replication_lag_bytes",
+            "WAL bytes durable on the leader but not yet acked by the "
+            "follower"))
+        self.replication_lag_seconds = self._reg(Gauge(
+            "tpusim_replication_lag_seconds",
+            "Age of the oldest unacked WAL record on the ship queue "
+            "(0 = follower fully caught up)"))
+        self.replication_last_shipped_seq = self._reg(Gauge(
+            "tpusim_replication_last_shipped_seq",
+            "Highest replication sequence number handed to the wire "
+            "(-1 = nothing shipped yet)"))
+        self.replication_ship_latency = self._reg(Histogram(
+            "tpusim_replication_ship_latency_microseconds",
+            "Append-to-ack walltime per shipped WAL record",
+            _LATENCY_BUCKETS))
+        self.replication_apply_latency = self._reg(Histogram(
+            "tpusim_replication_apply_latency_microseconds",
+            "Receive-to-applied walltime per record on the follower twin",
+            _LATENCY_BUCKETS))
+        self.replication_promotions = self._reg(Counter(
+            "tpusim_replication_promotions_total",
+            "Followers promoted to leader (successful failovers)"))
+        self.replication_divergence = self._reg(Counter(
+            "tpusim_replication_divergence_total",
+            "Per-cycle placement-hash chain cross-check failures on a "
+            "follower (any value > 0 latches promotion refusal)"))
+        self.replication_rto_seconds = self._reg(Gauge(
+            "tpusim_replication_rto_seconds",
+            "End-to-end recovery time objective of the last failover: "
+            "leader-death detection to promoted-and-serving"))
+        self.replication_role = self._reg(InfoGauge(
+            "tpusim_replication_role_info",
+            "Replication role of this process (labels: role = "
+            "leader|follower|candidate|none)"))
         # one lock for whole-registry reads: /metrics and snapshot() see a
         # single consistent exposition even while runtime threads observe
         self._read_lock = threading.Lock()

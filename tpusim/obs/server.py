@@ -55,6 +55,19 @@ def health_payload() -> Tuple[int, dict]:
     chain = reg.stream_chain_head
     if chain.value:
         body["chain_head"] = dict(chain.labels)
+    # replication fields (ISSUE 18): role + lag so a probe of either side
+    # of a leader/follower pair is self-describing. sys.modules lookup,
+    # not an import — a process that never replicated must not pay the
+    # stream package's import cost to report role "none".
+    import sys
+
+    _replicate = sys.modules.get("tpusim.stream.replicate")
+    repl = (_replicate.get_status() if _replicate is not None
+            else {"role": "none", "replication_lag_records": 0,
+                  "last_shipped_seq": -1})
+    body["role"] = repl.get("role", "none")
+    body["replication_lag_records"] = repl.get("replication_lag_records", 0)
+    body["last_shipped_seq"] = repl.get("last_shipped_seq", -1)
     return (503 if breaker >= 1.0 else 200), body
 
 

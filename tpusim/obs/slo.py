@@ -54,6 +54,16 @@ class SloTracker:
         self._lock = threading.Lock()
         register().slo_target.set(self.target_us)
 
+    def reset(self) -> None:
+        """Clear the breach window and burn state — a role transition
+        (follower promoted to leader, ISSUE 18) starts a clean error
+        budget: the replayed cycles were never served to anyone, so
+        counting them against the new leader's SLO would be noise."""
+        with self._lock:
+            self._breaches.clear()
+            self._burning = False
+        register().slo_burn_rate.set(0.0)
+
     @property
     def burn_rate(self) -> float:
         with self._lock:

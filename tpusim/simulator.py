@@ -982,6 +982,8 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                           chaos_plan: Optional[object] = None,
                           checkpoint_dir: Optional[str] = None,
                           checkpoint_every: int = 0,
+                          fsync_every: int = 0,
+                          replicate_to: Optional[tuple] = None,
                           recover: bool = False) -> dict:
     """Drive a StreamSession through seeded churn (tpusim.stream.ChurnLoadGen)
     and return a summary dict — the `tpusim stream` CLI, the bench's configs
@@ -1024,6 +1026,12 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         load generator over the committed prefix, and run the REMAINING
         cycles. The summary's fold_chain is then byte-identical to an
         uninterrupted run's.
+    fsync_every: fsync the WAL file every N appends (stream.persist's
+        durability dial; the mode is stamped into checkpoint manifests).
+    replicate_to: (host, port) of a listening FollowerTwin — attach a
+        WalShipper to the journal (requires checkpoint_dir) and drain it
+        before returning; the summary grows replication_{drained,
+        acked_seq, lag_at_close} (ISSUE 18).
     """
     from tpusim.api.snapshot import synthetic_cluster
     from tpusim.backends import Placement, bind_pod, get_backend, \
@@ -1067,11 +1075,17 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
             breaker = install_chaos(chaos_plan.device)
     if recover and checkpoint_dir is None:
         raise ValueError("recover=True needs checkpoint_dir")
+    if replicate_to is not None and checkpoint_dir is None:
+        raise ValueError("replicate_to ships the WAL: pass checkpoint_dir "
+                         "(--checkpoint-dir)")
+    if replicate_to is not None and recover:
+        raise ValueError("replicate_to cannot resume a recovery replay; "
+                         "recover first, then re-attach the shipper")
     if recover and verify:
         raise ValueError(
             "verify and recover are mutually exclusive: the verify arm "
             "replays the reference picture from cycle 0")
-    persist = report = None
+    persist = report = shipper = None
     start_cycle = 0
     if recover:
         session, report, persist = recover_stream_session(
@@ -1084,7 +1098,14 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                                 always_restage=always_restage)
         if checkpoint_dir is not None:
             persist = StreamPersistence(checkpoint_dir,
-                                        checkpoint_every=checkpoint_every)
+                                        checkpoint_every=checkpoint_every,
+                                        fsync_every=fsync_every)
+            if replicate_to is not None:
+                # hook the journal BEFORE attach so the genesis
+                # checkpoint manifest is the first shipped frame
+                from tpusim.stream.replicate import WalShipper
+
+                shipper = WalShipper(persist, tuple(replicate_to))
             session.attach_persistence(persist)
     if crash_events and persist is not None:
         ev = crash_events[0]
@@ -1147,6 +1168,7 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
             mismatches += 1
 
     t_start = perf_counter()
+    clean_exit = False
     try:
         for cycle in range(start_cycle, cycles):
             if pipeline:
@@ -1198,7 +1220,14 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
             tail = session.flush()
             if tail:
                 account(tail)
+        clean_exit = True
     finally:
+        if shipper is not None:
+            # a graceful end waits for the follower's cumulative ack; a
+            # ProcessCrash propagating through here deliberately does NOT
+            # (drain=False is the death model — the unshipped tail lives
+            # only in the durable WAL)
+            shipper.close(drain=clean_exit, timeout=30.0)
         if persist is not None:
             persist.close()
         if breaker is not None:
@@ -1235,6 +1264,10 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         out["wal_records"] = persist.wal_records
         out["checkpoints"] = persist.checkpoints
         out["wal_chain"] = persist.chain
+    if shipper is not None:
+        out["replication_acked_seq"] = shipper.acked_seq
+        out["replication_acked_chain"] = shipper.acked_chain
+        out["replication_lag_at_close"] = shipper.lag_records()
     if recover:
         out["recovered"] = True
         out["resume_cycle"] = start_cycle
@@ -1249,4 +1282,218 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
     if alog is not None:
         alog.flush()
         out["analytics"] = alog.snapshot()
+    return out
+
+
+def run_replicated_stream(snapshot: Optional[ClusterSnapshot] = None, *,
+                          num_nodes: int = 64, cycles: int = 50,
+                          arrivals: int = 32, evict_fraction: float = 0.25,
+                          node_flap_every: int = 0,
+                          label_churn: int = 0, taint_churn: int = 0,
+                          gang_size: int = 0, gang_count: int = 0,
+                          seed: int = 0,
+                          provider: str = DEFAULT_PROVIDER,
+                          policy=None, pipeline: bool = False,
+                          always_restage: bool = False,
+                          chaos_plan: Optional[object] = None,
+                          checkpoint_dir: Optional[str] = None,
+                          checkpoint_every: int = 1,
+                          fsync_every: int = 0,
+                          drain_timeout: float = 30.0) -> dict:
+    """Drive a LEADER StreamSession with a live FollowerTwin attached over
+    the WAL-shipping socket protocol (stream.replicate, ISSUE 18).
+
+    Without a chaos plan this is a replicated steady-state run: the
+    summary reports the follower's chain head next to the leader's (they
+    must be byte-identical after a drain) plus the shipping lag the run
+    sustained.
+
+    With a process_crash plan (chaos.plan.kill_leader_campaign) the
+    leader dies at the targeted WAL record; a FailoverController detects
+    the death, promotes the follower (byte-identical chain head is the
+    promotion invariant — replaying ONLY the unshipped WAL tail), and
+    the churn load generator resumes from the WAL position on the
+    promoted twin for the remaining cycles. The summary's fold_chain is
+    then byte-identical to an uninterrupted run's, and rto_s measures
+    death-detection to promoted end-to-end.
+    """
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.backends import Placement, bind_pod
+    from tpusim.chaos.engine import ProcessCrash
+    from tpusim.stream import ChurnLoadGen, StreamPersistence, StreamSession
+    from tpusim.stream.loadgen import DEFAULT_LABEL_UNIVERSE
+    from tpusim.stream.replicate import (
+        FailoverController,
+        FollowerTwin,
+        WalShipper,
+    )
+
+    if checkpoint_dir is None:
+        raise ValueError("run_replicated_stream needs checkpoint_dir: the "
+                         "WAL is the replication substrate")
+
+    def make_snap():
+        # fresh object graphs per consumer: the leader, the follower, and
+        # each load generator must never share mutable node/pod objects
+        if snapshot is not None:
+            return snapshot
+        snap = synthetic_cluster(num_nodes)
+        if policy is not None or label_churn or taint_churn:
+            for i, node in enumerate(snap.nodes):
+                node.metadata.labels.update(
+                    {k: vals[i % len(vals)]
+                     for k, vals in DEFAULT_LABEL_UNIVERSE.items()})
+        return snap
+
+    crash_events = []
+    if chaos_plan is not None:
+        chaos_plan.validate()
+        if not chaos_plan.host_sections_empty() \
+                or not chaos_plan.device.empty():
+            raise ValueError(
+                "run_replicated_stream takes process_crash sections only "
+                "(kill-the-leader campaigns); churn arrives through the "
+                "load generator and device faults through the breaker arm")
+        crash_events = chaos_plan.crash_events()
+
+    follower = FollowerTwin(make_snap(), provider=provider, policy=policy,
+                            always_restage=always_restage)
+    leader = StreamSession(make_snap(), provider=provider, policy=policy,
+                           always_restage=always_restage)
+    persist = StreamPersistence(checkpoint_dir,
+                                checkpoint_every=checkpoint_every,
+                                fsync_every=fsync_every)
+    shipper = WalShipper(persist, follower.address)
+    leader.attach_persistence(persist)
+    if crash_events:
+        ev = crash_events[0]
+        persist.arm_crash(ev.at, ev.target)
+
+    gen = ChurnLoadGen(make_snap(), seed=seed, arrivals=arrivals,
+                       evict_fraction=evict_fraction,
+                       node_flap_every=node_flap_every,
+                       label_churn=label_churn, taint_churn=taint_churn,
+                       gang_size=gang_size, gang_count=gang_count)
+
+    latencies: List[float] = []
+    crashed: Optional[str] = None
+    lag_at_crash = 0
+    leader_alive = [True]
+
+    def run_cycles(session, g, start: int, skip_events: int) -> None:
+        skip = skip_events
+        for cycle in range(start, cycles):
+            if pipeline:
+                g.note_bound(session.poll_placed())
+            evs = g.events(cycle)
+            if skip:
+                evs = evs[skip:]
+                skip = 0
+            session.apply_events(evs)
+            batch = g.batch()
+            t0 = perf_counter()
+            prev = (session.schedule_pipelined(batch) if pipeline
+                    else session.schedule(batch))
+            latencies.append(perf_counter() - t0)
+            if not pipeline:
+                g.note_bound(prev)
+        if pipeline:
+            session.flush()
+
+    t_start = perf_counter()
+    try:
+        run_cycles(leader, gen, 0, 0)
+    except ProcessCrash as exc:
+        crashed = str(exc)
+        leader_alive[0] = False
+        lag_at_crash = shipper.lag_records()
+        # leader death: nothing drains — the wire keeps only what it
+        # already carried, the durable WAL keeps everything
+        shipper.close(drain=False)
+        persist.close()
+
+    out: dict = {
+        "cycles": cycles, "pipeline": pipeline,
+        "crashed": crashed is not None, "crash_detail": crashed,
+        "promoted": False, "divergence": None,
+    }
+    if crashed is None:
+        # steady-state shipping backlog: records appended but not yet
+        # acked the instant the producer stops (drain clears it, so
+        # sample before)
+        lag_at_loop_end = shipper.lag_records()
+        drained = shipper.drain(drain_timeout)
+        shipper.close(drain=False)
+        out.update({
+            "drained": drained,
+            "lag_at_loop_end": lag_at_loop_end,
+            "fold_chain": persist.chain,
+            "follower_chain": follower.chain,
+            "follower_chain_matches": follower.chain == persist.chain,
+            "wal_records": persist.wal_records,
+            "checkpoints": persist.checkpoints,
+            "decisions": persist.decisions,
+            "scheduled": persist.scheduled,
+            "applied_records": follower.wal_records_applied,
+            "divergence": follower.diverged,
+            "restages": dict(leader.restage_counts),
+            "follower_restages": dict(follower.session.restage_counts),
+        })
+        follower.stop()
+        final_persist = persist
+    else:
+        controller = FailoverController(
+            lambda: leader_alive[0], [follower], checkpoint_dir,
+            interval_s=0.005, misses=2,
+            checkpoint_every=checkpoint_every, fsync_every=fsync_every,
+            leader_was_alive=True)
+        promoted, preport = controller.run(timeout=30.0)
+        resume_cycle = preport.resume_cycle
+        # resume the churn load generator from the WAL position: batch()
+        # and note_bound() draw no rng, so replaying the committed prefix
+        # with binds fed back from the replicated/replayed bind maps
+        # leaves the rng and the bound population exactly where the dead
+        # leader had them (the recover_stream_session fast-forward)
+        gen2 = ChurnLoadGen(make_snap(), seed=seed, arrivals=arrivals,
+                            evict_fraction=evict_fraction,
+                            node_flap_every=node_flap_every,
+                            label_churn=label_churn,
+                            taint_churn=taint_churn,
+                            gang_size=gang_size, gang_count=gang_count)
+        for c in range(resume_cycle):
+            gen2.events(c)
+            by_key = {p.key(): p for p in gen2.batch()}
+            gen2.note_bound([
+                Placement(pod=bind_pod(by_key[k], node), node_name=node)
+                for k, node in promoted.bound_by_cycle.get(c, [])
+                if k in by_key])
+        skip_events = promoted.events_applied.get(resume_cycle, 0)
+        run_cycles(promoted.session, gen2, resume_cycle, skip_events)
+        final_persist = promoted.persist
+        final_persist.close()
+        out.update({
+            "promoted": True,
+            "rto_s": preport.rto_s,
+            "resume_cycle": resume_cycle,
+            "replayed_records": preport.tail_records,
+            "applied_records": preport.applied_records,
+            "recomputed_cycles": list(preport.recomputed),
+            "settled_live_cycles": list(preport.settled_live),
+            "promotion_violations": list(preport.violations),
+            "lag_at_crash": lag_at_crash,
+            "fold_chain": final_persist.chain,
+            "wal_records": final_persist.wal_records,
+            "checkpoints": final_persist.checkpoints,
+            "decisions": final_persist.decisions,
+            "scheduled": final_persist.scheduled,
+            "divergence": promoted.diverged,
+            "restages": dict(leader.restage_counts),
+            "follower_restages": dict(promoted.session.restage_counts),
+        })
+    elapsed = perf_counter() - t_start
+    latencies.sort()
+    out["elapsed_s"] = elapsed
+    out["nodes"] = num_nodes
+    out["p50_cycle_ms"] = (latencies[len(latencies) // 2] * 1e3
+                           if latencies else 0.0)
     return out

@@ -9,7 +9,17 @@ from tpusim.stream.persist import (
     RecoveryReport,
     StreamPersistence,
     chain_fold,
+    read_wal,
     recover_stream_session,
+    tail_wal,
+)
+from tpusim.stream.replicate import (
+    FailoverController,
+    FollowerTwin,
+    PromotionRefused,
+    PromotionReport,
+    ReplicationError,
+    WalShipper,
 )
 from tpusim.stream.runtime import (
     MIN_BUCKET,
@@ -23,11 +33,19 @@ __all__ = [
     "MIN_BUCKET",
     "ChurnLoadGen",
     "DeviceResidentCluster",
+    "FailoverController",
+    "FollowerTwin",
     "PersistError",
+    "PromotionRefused",
+    "PromotionReport",
     "RecoveryReport",
+    "ReplicationError",
     "StreamPersistence",
     "StreamSession",
+    "WalShipper",
     "bucket_size",
     "chain_fold",
+    "read_wal",
     "recover_stream_session",
+    "tail_wal",
 ]

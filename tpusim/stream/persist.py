@@ -144,13 +144,22 @@ class StreamPersistence:
     CHECKPOINT = "checkpoint.json"
     WAL = "wal.jsonl"
 
-    def __init__(self, directory: str, *, checkpoint_every: int = 0):
+    def __init__(self, directory: str, *, checkpoint_every: int = 0,
+                 fsync_every: int = 0):
         if checkpoint_every < 0:
             raise ValueError(f"checkpoint_every={checkpoint_every}: "
                              "need >= 0")
+        if fsync_every < 0:
+            raise ValueError(f"fsync_every={fsync_every}: need >= 0")
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.checkpoint_every = checkpoint_every
+        # 0 = flush-only (OS buffers may lose the newest records on a HOST
+        # crash, though never on a process crash); N = fsync the journal
+        # fd every N appends, trading append latency for host-crash
+        # durability. The chosen mode is stamped into every checkpoint
+        # manifest so recovery/audit can tell what the WAL promises.
+        self.fsync_every = fsync_every
         self.wal_path = os.path.join(directory, self.WAL)
         self.checkpoint_path = os.path.join(directory, self.CHECKPOINT)
         self._wal = None
@@ -166,6 +175,13 @@ class StreamPersistence:
         self._resume_ids: List[int] = []   # recovery recompute cycle ids
         self._crash: Optional[Tuple[int, str]] = None
         self._crashed = False
+        # replication seams (stream.replicate.WalShipper): on_append sees
+        # every durable record with its byte extent, on_checkpoint every
+        # manifest. Both fire AFTER the write is durable and BEFORE any
+        # armed crash — a shipped record is always a durable record, and
+        # the record that kills the leader still reaches the wire.
+        self.on_append = None      # (rec, kind, cycle, start_ofs, end_ofs)
+        self.on_checkpoint = None  # (manifest_dict)
 
     # -- wiring ------------------------------------------------------------
 
@@ -229,10 +245,16 @@ class StreamPersistence:
     # -- record writing ----------------------------------------------------
 
     def _append(self, rec: dict, kind: str, cycle: int) -> None:
+        start = self._wal.tell()
         self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._wal.flush()
+        if self.fsync_every \
+                and (self.wal_records + 1) % self.fsync_every == 0:
+            os.fsync(self._wal.fileno())
         self.wal_records += 1
         register().recovery_wal_records.set(float(self.wal_records))
+        if self.on_append is not None:
+            self.on_append(rec, kind, cycle, start, self._wal.tell())
         self._maybe_crash(kind, cycle)
 
     def on_inc_event(self, event_type: str, obj) -> None:
@@ -342,6 +364,13 @@ class StreamPersistence:
                 # TPUSIM_SHARDS — tail work and restage cost stay
                 # O(delta-per-shard) instead of O(cluster)
                 "shard_layout": session._shard_layout,
+                # the WAL's durability promise at the time this manifest
+                # was cut: flush-only survives process crashes, fsync
+                # additionally survives host crashes (ISSUE 18)
+                "durability": {
+                    "mode": "fsync" if self.fsync_every else "flush",
+                    "fsync_every": self.fsync_every,
+                },
                 "snapshot": inc.to_snapshot().to_obj(),
             }
             tmp = self.checkpoint_path + ".tmp"
@@ -357,6 +386,8 @@ class StreamPersistence:
         self.checkpoints += 1
         flight.note_recovery("checkpoint", {"cycle": self.cycles_emitted,
                                             "wal_records": self.wal_records})
+        if self.on_checkpoint is not None:
+            self.on_checkpoint(meta)
         return meta
 
 
@@ -381,12 +412,25 @@ class RecoveryReport:
     shard_layout: Optional[dict] = None   # node-mesh layout at checkpoint
 
 
-def read_wal(wal_path: str) -> Tuple[List[Tuple[int, dict]], List[str]]:
-    """Parse a WAL into [(byte offset, record)] plus violation strings.
-    A torn FINAL line is an expected crash artifact (dropped); a torn
-    interior line means the journal itself is corrupt."""
+def tail_wal(wal_path: str, offset: int = 0
+             ) -> Tuple[List[Tuple[int, dict]], List[str], int]:
+    """Incremental WAL reader (ISSUE 18): parse complete records from
+    byte ``offset`` to EOF, returning ([(byte offset, record)],
+    violations, resume_offset). ``resume_offset`` is the position after
+    the last COMPLETE record — hand it back to the next call to follow
+    the live tail without re-parsing the prefix. The shipper, the
+    follower's promotion replay, and cold recovery all share this one
+    parser.
+
+    Torn-line policy: unparseable trailing lines are a live-tail
+    artifact (a crash mid-write, or a writer mid-append) — dropped, and
+    ``resume_offset`` stops BEFORE them so a later call retries once the
+    line completes. An unparseable line followed by further complete
+    records is a torn INTERIOR write: the journal itself is corrupt, and
+    each such line is reported as a violation."""
     records: List[Tuple[int, Optional[dict]]] = []
     with open(wal_path, "r", encoding="utf-8") as f:
+        f.seek(offset)
         while True:
             ofs = f.tell()
             line = f.readline()
@@ -394,18 +438,38 @@ def read_wal(wal_path: str) -> Tuple[List[Tuple[int, dict]], List[str]]:
                 break
             if not line.strip():
                 continue
+            if not line.endswith("\n"):
+                # a partial final line with no terminator is still being
+                # written (or was torn by a crash): never a violation
+                records.append((ofs, None))
+                break
             try:
                 records.append((ofs, json.loads(line)))
             except json.JSONDecodeError:
                 records.append((ofs, None))
-    violations: List[str] = []
+    resume_offset = offset
     while records and records[-1][1] is None:
         records.pop()
+    if records:
+        last_ofs = records[-1][0]
+        with open(wal_path, "rb") as f:
+            f.seek(last_ofs)
+            resume_offset = last_ofs + len(f.readline())
+    violations: List[str] = []
     for ofs, rec in records:
         if rec is None:
             violations.append(f"corrupt WAL record at byte {ofs} "
                               "(torn interior write)")
-    return [(o, r) for o, r in records if r is not None], violations
+    return ([(o, r) for o, r in records if r is not None], violations,
+            resume_offset)
+
+
+def read_wal(wal_path: str) -> Tuple[List[Tuple[int, dict]], List[str]]:
+    """Parse a whole WAL into [(byte offset, record)] plus violation
+    strings — ``tail_wal`` from byte 0, keeping the original two-tuple
+    shape recovery and the tests consume."""
+    records, violations, _ = tail_wal(wal_path, 0)
+    return records, violations
 
 
 def recover_stream_session(directory: str, *,

@@ -1,0 +1,829 @@
+"""WAL-shipping hot standby + chaos-driven leader failover (ISSUE 18).
+
+The WAL (stream.persist) made one process recoverable; this module makes
+the control plane replicated. A leader's StreamPersistence grows two
+seams (``on_append`` / ``on_checkpoint``) that a ``WalShipper`` drains
+over a length-prefixed socket protocol to a ``FollowerTwin`` — a live
+standby that does NOT merely store the records: it replays every shipped
+cycle through its OWN scheduler (the same incremental replay discipline
+as ``recover_stream_session``), so at any instant the follower holds a
+warm host picture, a warm device twin, and a placement-hash chain it can
+cross-check byte-for-byte against the leader's emissions. Divergence
+latches: a follower whose own deterministic decisions ever disagree with
+a shipped emission refuses promotion.
+
+Wire protocol — 4-byte big-endian length prefix + one JSON object:
+
+    {"t":"hello","next":N,"chain":H}      follower -> shipper on connect:
+                                          resume from sequence N
+    {"t":"rec","seq":N,"rec":R,"ofs":B}   one WAL record; B = the byte
+                                          offset AFTER it in the leader's
+                                          journal (the follower's applied
+                                          position, and the promotion
+                                          replay's tail_wal resume point)
+    {"t":"ckpt","seq":N,"meta":M}         checkpoint manifest (sans
+                                          snapshot): chain cross-check
+                                          anchor + shard-layout/durability
+                                          announcements
+    {"t":"ack","seq":N,"chain":H}         follower -> shipper: applied
+                                          through N, chain head H
+
+Sequence numbers are assigned by the shipper in append order; acks are
+cumulative. Reconnect-with-resume is the follower's ``hello``: the
+shipper retains its frame log and resends from ``next`` after any
+connection loss, so a flapping link degrades to lag, never to loss.
+
+Failover is chaos-driven: a ``FailoverController`` watches the leader's
+``/healthz`` (or any probe callable), and on death promotes the FRESHEST
+non-diverged follower. Promotion replays only the unshipped tail of the
+leader's durable WAL (``tail_wal`` from the follower's applied offset),
+re-scheduling crash-tail cycles exactly like cold recovery — but from a
+warm twin, so the replayed-record count is the replication lag, not the
+checkpoint interval. The byte-identical chain head is the promotion
+invariant, checked against the leader's last durable checkpoint manifest
+in BOTH directions (follower ahead: chain history; follower behind: the
+fold must pass through the manifest's chain during tail replay).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from time import monotonic, perf_counter, sleep
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from tpusim.api.types import Pod
+from tpusim.backends import Placement, bind_pod, placement_hash
+from tpusim.engine.providers import DEFAULT_PROVIDER
+from tpusim.framework.metrics import register, since_in_microseconds
+from tpusim.framework.store import MODIFIED
+from tpusim.obs import recorder as flight
+from tpusim.stream.persist import (
+    _LOADERS,
+    StreamPersistence,
+    chain_fold,
+    tail_wal,
+)
+
+_FRAME_LIMIT = 64 << 20   # a single frame larger than this is corruption
+_CKPT_FIELDS = ("cycle", "next_cycle", "chain", "wal_offset",
+                "wal_records", "shard_layout", "durability", "plan_sig")
+
+
+class ReplicationError(RuntimeError):
+    """A broken replication stream (oversized frame, protocol garbage)."""
+
+
+class PromotionRefused(RuntimeError):
+    """The candidate follower cannot become leader: its replayed chain
+    diverged from the leader's durable truth (or it never attached)."""
+
+
+# -- module-level replication status (the /healthz seam) -------------------
+#
+# obs.server.health_payload reads this lazily: role transitions and the
+# shipper's live lag land here so a scrape of EITHER side of the pair is
+# self-describing. Process-scoped by design — in-process test pairs share
+# it, which mirrors sharing the metrics registry.
+
+_state_lock = threading.Lock()
+_state: Dict[str, object] = {"role": "none", "replication_lag_records": 0,
+                             "last_shipped_seq": -1}
+
+
+def set_role(role: str) -> None:
+    """leader | follower | candidate | none."""
+    with _state_lock:
+        _state["role"] = role
+    register().replication_role.set_info(role=role)
+
+
+def _set_state(**kw) -> None:
+    with _state_lock:
+        _state.update(kw)
+
+
+def get_status() -> Dict[str, object]:
+    with _state_lock:
+        return dict(_state)
+
+
+# -- framing ---------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _read_frame(reader) -> Optional[dict]:
+    hdr = reader.read(4)
+    if len(hdr) < 4:
+        return None
+    n = struct.unpack(">I", hdr)[0]
+    if n > _FRAME_LIMIT:
+        raise ReplicationError(f"replication frame of {n} bytes exceeds "
+                               f"the {_FRAME_LIMIT}-byte limit")
+    data = reader.read(n)
+    if len(data) < n:
+        return None
+    return json.loads(data)
+
+
+# -- leader side -----------------------------------------------------------
+
+class WalShipper:
+    """Streams a StreamPersistence's WAL records to one follower.
+
+    Hooks ``persist.on_append`` / ``persist.on_checkpoint``: every
+    durable record is framed with a sequence number and enqueued
+    synchronously (the crash model stays exact — the record that kills
+    the leader is enqueued before the crash fires); a sender thread
+    drains the queue to the follower and an ack reader advances the
+    cumulative acked sequence. The frame log is retained for
+    reconnect-with-resume. ``drain()`` blocks until the follower has
+    acked everything — the deterministic barrier the tests and the
+    graceful-shutdown path use; a crashing leader simply never drains.
+    """
+
+    def __init__(self, persist: StreamPersistence,
+                 address: Tuple[str, int], *,
+                 retry_interval: float = 0.02):
+        self.persist = persist
+        self.address = address
+        self.retry_interval = retry_interval
+        self._frames: List[dict] = []        # seq == index
+        self._meta: List[Tuple[float, int, bool]] = []  # (t_enq, ofs, is_rec)
+        self._cond = threading.Condition()
+        self._acked = -1
+        self._acked_ofs = 0
+        self._acked_chain = ""
+        self._end_ofs = 0
+        self._recs = 0
+        self._recs_acked = 0
+        self._stop = False
+        persist.on_append = self._on_append
+        persist.on_checkpoint = self._on_checkpoint
+        set_role("leader")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpusim-wal-shipper")
+        self._thread.start()
+
+    # persistence hooks — called on the scheduling thread, never block
+
+    def _on_append(self, rec: dict, kind: str, cycle: int,
+                   start: int, end: int) -> None:
+        with self._cond:
+            seq = len(self._frames)
+            self._frames.append({"t": "rec", "seq": seq, "rec": rec,
+                                 "ofs": end})
+            self._meta.append((perf_counter(), end, True))
+            self._end_ofs = end
+            self._recs += 1
+            self._cond.notify_all()
+        self._publish_lag()
+
+    def _on_checkpoint(self, meta: dict) -> None:
+        slim = {k: meta.get(k) for k in _CKPT_FIELDS}
+        with self._cond:
+            seq = len(self._frames)
+            self._frames.append({"t": "ckpt", "seq": seq, "meta": slim})
+            self._meta.append((perf_counter(), int(meta.get("wal_offset", 0)),
+                               False))
+            self._cond.notify_all()
+        self._publish_lag()
+
+    def _publish_lag(self) -> None:
+        reg = register()
+        with self._cond:
+            lag_records = self._recs - self._recs_acked
+            lag_bytes = max(0, self._end_ofs - self._acked_ofs)
+            oldest = (self._meta[self._acked + 1][0]
+                      if self._acked + 1 < len(self._meta) else None)
+        reg.replication_lag_records.set(float(lag_records))
+        reg.replication_lag_bytes.set(float(lag_bytes))
+        reg.replication_lag_seconds.set(
+            max(0.0, perf_counter() - oldest) if oldest is not None else 0.0)
+        _set_state(replication_lag_records=lag_records)
+
+    # sender / ack machinery
+
+    def _connect(self) -> Optional[socket.socket]:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return None
+            try:
+                return socket.create_connection(self.address, timeout=5.0)
+            except OSError:
+                with self._cond:
+                    self._cond.wait(self.retry_interval)
+
+    def _run(self) -> None:
+        while True:
+            sock = self._connect()
+            if sock is None:
+                return
+            try:
+                reader = sock.makefile("rb")
+                hello = _read_frame(reader)
+                if hello is None or hello.get("t") != "hello":
+                    continue
+                cursor = int(hello.get("next", 0))
+                ack_thread = threading.Thread(
+                    target=self._ack_loop, args=(reader,), daemon=True)
+                ack_thread.start()
+                while True:
+                    with self._cond:
+                        while cursor >= len(self._frames) and not self._stop:
+                            self._cond.wait(0.1)
+                        if self._stop:
+                            return
+                        batch = self._frames[cursor:]
+                    for fr in batch:
+                        _send_frame(sock, fr)
+                        cursor = fr["seq"] + 1
+                        register().replication_last_shipped_seq.set(
+                            float(fr["seq"]))
+                        _set_state(last_shipped_seq=fr["seq"])
+            except (OSError, ReplicationError):
+                continue   # reconnect; the follower's hello resumes us
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _ack_loop(self, reader) -> None:
+        try:
+            while True:
+                fr = _read_frame(reader)
+                if fr is None:
+                    return
+                if fr.get("t") != "ack":
+                    continue
+                seq = int(fr["seq"])
+                with self._cond:
+                    if seq > self._acked:
+                        for s in range(self._acked + 1, seq + 1):
+                            t_enq, ofs, is_rec = self._meta[s]
+                            if is_rec:
+                                self._recs_acked += 1
+                                register().replication_ship_latency.observe(
+                                    since_in_microseconds(t_enq))
+                        self._acked = seq
+                        self._acked_ofs = self._meta[seq][1]
+                    self._acked_chain = str(fr.get("chain", ""))
+                    self._cond.notify_all()
+                self._publish_lag()
+        except (OSError, ValueError, ReplicationError):
+            return
+
+    # public surface
+
+    @property
+    def acked_seq(self) -> int:
+        with self._cond:
+            return self._acked
+
+    @property
+    def acked_chain(self) -> str:
+        with self._cond:
+            return self._acked_chain
+
+    def lag_records(self) -> int:
+        with self._cond:
+            return self._recs - self._recs_acked
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every enqueued frame is acked (or timeout)."""
+        deadline = monotonic() + timeout
+        with self._cond:
+            while self._acked < len(self._frames) - 1:
+                remaining = deadline - monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(0.1, remaining))
+        self._publish_lag()
+        return True
+
+    def close(self, *, drain: bool = True, timeout: float = 30.0) -> bool:
+        """Detach from the persistence and stop the sender. drain=False
+        models leader death: whatever the wire has not carried yet stays
+        unshipped, and only the durable WAL knows the tail."""
+        drained = self.drain(timeout) if drain else False
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self.persist.on_append == self._on_append:
+            self.persist.on_append = None
+        if self.persist.on_checkpoint == self._on_checkpoint:
+            self.persist.on_checkpoint = None
+        self._thread.join(timeout=5.0)
+        return drained
+
+
+# -- follower side ---------------------------------------------------------
+
+@dataclass
+class PromotionReport:
+    """What a promotion replayed, and what it cost."""
+
+    resume_cycle: int = 0         # first cycle the driver runs post-failover
+    tail_records: int = 0         # WAL records replayed past applied_ofs
+    applied_records: int = 0      # records the follower had applied live
+    recomputed: List[int] = field(default_factory=list)
+    settled_live: List[int] = field(default_factory=list)
+    chain: str = ""
+    wal_records: int = 0
+    replay_s: float = 0.0
+    rto_s: float = 0.0            # stamped by the FailoverController
+    violations: List[str] = field(default_factory=list)
+
+
+class FollowerTwin:
+    """A live standby: applies shipped WAL records by replaying each
+    cycle through its own StreamSession.
+
+    Apply discipline (the WAL's ordering invariants make this exact in
+    both the sync and pipelined drivers):
+
+      ev(c)    -> apply to the host picture immediately (ev records for
+                  cycle c always precede batch(c), and never interleave
+                  into an open cycle)
+      batch(c) -> buffer the arrival pods
+      bind(c)  -> the leader folded cycle c: schedule batch c through
+                  OUR scheduler now (bind(c) precedes any cycle-c+1
+                  record, so the host pictures align), fold our own
+                  binds, remember our placements
+      emit(c)  -> cross-check our placement hash against the shipped
+                  one; fold the chain; divergence latches promotion off
+      ckpt     -> chain-history cross-check + shard-layout restage
+                  announcement
+
+    The twin therefore stays one warm scheduler, not a cold journal:
+    promotion replays only the unshipped tail.
+    """
+
+    def __init__(self, snapshot=None, *, incremental=None,
+                 provider: str = DEFAULT_PROVIDER, policy=None,
+                 always_restage: bool = False,
+                 listen: Tuple[str, int] = ("127.0.0.1", 0)):
+        from tpusim.stream.runtime import StreamSession
+
+        self.session = StreamSession(snapshot, incremental=incremental,
+                                     provider=provider, policy=policy,
+                                     always_restage=always_restage)
+        self.batches: Dict[int, List[Pod]] = {}
+        self.bound_by_cycle: Dict[int, List[Tuple[str, str]]] = {}
+        self.events_applied: Dict[int, int] = {}
+        self.chain = ""
+        self.chain_history: Dict[int, str] = {0: ""}
+        self.cycles_emitted = 0
+        self.decisions = 0
+        self.scheduled = 0
+        self.next_cycle = 0
+        self.applied_seq = -1
+        self.applied_ofs = 0
+        self.wal_records_applied = 0
+        self.diverged: Optional[str] = None
+        self.shard_layout: Optional[dict] = None
+        self.durability: Optional[dict] = None
+        self.promoted = False
+        self.persist: Optional[StreamPersistence] = None
+        self._live_pending: Dict[int, List[Placement]] = {}
+        self._lock = threading.RLock()
+        self._stop = False
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(listen)
+        self._server.listen(1)
+        self._server.settimeout(0.2)
+        self.address = self._server.getsockname()
+        set_role("follower")
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="tpusim-follower")
+        self._thread.start()
+
+    # -- receive loop -----------------------------------------------------
+
+    def _serve(self) -> None:
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                try:
+                    self._pump(conn)
+                except (OSError, ValueError, ReplicationError):
+                    continue   # shipper reconnects and resumes
+
+    def _pump(self, conn: socket.socket) -> None:
+        reader = conn.makefile("rb")
+        with self._lock:
+            _send_frame(conn, {"t": "hello", "next": self.applied_seq + 1,
+                               "chain": self.chain})
+        while True:
+            fr = _read_frame(reader)
+            if fr is None:
+                return
+            t0 = perf_counter()
+            seq = int(fr.get("seq", -1))
+            with self._lock:
+                if self._stop:
+                    return
+                if seq <= self.applied_seq:
+                    continue   # duplicate after a resume race
+                if seq != self.applied_seq + 1:
+                    return     # gap: drop; the next hello renegotiates
+                if fr.get("t") == "rec":
+                    self._apply_record(fr["rec"], int(fr.get("ofs", 0)))
+                elif fr.get("t") == "ckpt":
+                    self._apply_ckpt(fr.get("meta") or {})
+                self.applied_seq = seq
+                chain = self.chain
+            register().replication_apply_latency.observe(
+                since_in_microseconds(t0))
+            _send_frame(conn, {"t": "ack", "seq": seq, "chain": chain})
+
+    def _diverge(self, msg: str) -> None:
+        if self.diverged is None:
+            self.diverged = msg
+            register().replication_divergence.inc()
+            flight.note_fault("replication_divergence", {"detail": msg})
+
+    def _apply_record(self, rec: dict, end_ofs: int) -> None:
+        self.wal_records_applied += 1
+        self.applied_ofs = max(self.applied_ofs, end_ofs)
+        if self.diverged is not None:
+            return   # latched: keep acking so the leader is not wedged,
+            #          but the twin stops mutating (it can never promote)
+        k, c = rec["k"], int(rec["c"])
+        if k == "ev":
+            self.session.apply(rec["t"], _LOADERS[rec["r"]](rec["o"]))
+            self.events_applied[c] = self.events_applied.get(c, 0) + 1
+        elif k == "batch":
+            self.batches[c] = [Pod.from_obj(o) for o in rec["pods"]]
+            self.next_cycle = max(self.next_cycle, c + 1)
+        elif k == "bind":
+            pods = self.batches.get(c)
+            if pods is None:
+                self._diverge(f"bind record for unknown batch {c}")
+                return
+            placements = self.session.schedule(pods)
+            bound = sorted((pl.pod.key(), pl.node_name)
+                           for pl in placements if pl.node_name)
+            theirs = sorted((key, node) for key, node in rec["b"])
+            if bound != theirs:
+                self._diverge(
+                    f"bind divergence at cycle {c}: our scheduler bound "
+                    f"{len(bound)} pods, the leader bound {len(theirs)} "
+                    "(or to different nodes)")
+                return
+            self.bound_by_cycle[c] = list(bound)
+            self._live_pending[c] = placements
+        elif k == "emit":
+            placements = self._live_pending.pop(c, None)
+            if placements is None:
+                self._diverge(f"emit record for cycle {c} the follower "
+                              "never replayed")
+                return
+            mine = placement_hash(placements)
+            if mine != rec["h"]:
+                self._diverge(
+                    f"placement hash diverges at cycle {c}: follower "
+                    f"{mine[:16]} vs leader {rec['h'][:16]}")
+                return
+            self.chain = chain_fold(self.chain, rec["h"])
+            self.decisions += int(rec["n"])
+            self.scheduled += int(rec["s"])
+            self.cycles_emitted += 1
+            self.chain_history[self.cycles_emitted] = self.chain
+
+    def _apply_ckpt(self, meta: dict) -> None:
+        if self.diverged is not None:
+            return
+        layout = meta.get("shard_layout")
+        ours = self.session._shard_layout
+        if layout and ours and \
+                layout.get("shards") != ours.get("shards"):
+            # the leader announced a different node-mesh partitioning:
+            # restage the twin per the announced layout before the next
+            # replayed cycle (classified like any other restage)
+            self.session.force_restage("replicated")
+        self.shard_layout = layout or self.shard_layout
+        self.durability = meta.get("durability") or self.durability
+        ck_cycle = meta.get("cycle")
+        ck_chain = meta.get("chain")
+        if ck_cycle is not None and ck_chain is not None:
+            mine = self.chain_history.get(int(ck_cycle))
+            if mine is not None and mine != ck_chain:
+                self._diverge(
+                    f"checkpoint chain diverges at cycle {ck_cycle}: "
+                    f"follower {mine[:16]} vs manifest {ck_chain[:16]}")
+
+    # -- promotion --------------------------------------------------------
+
+    def promote(self, directory: str, *, checkpoint_every: int = 0,
+                fsync_every: int = 0) -> PromotionReport:
+        """Become leader: replay the unshipped tail of the durable WAL in
+        ``directory`` from our applied byte offset, re-scheduling
+        crash-tail cycles, then attach the journal and keep appending.
+
+        The byte-identical chain head is the invariant: a diverged
+        follower refuses, and the replayed fold must agree with the
+        leader's last durable checkpoint manifest."""
+        with self._lock:
+            if self.diverged is not None:
+                raise PromotionRefused(
+                    f"follower chain diverged; refusing promotion: "
+                    f"{self.diverged}")
+            if self.promoted:
+                raise PromotionRefused("already promoted")
+            set_role("candidate")
+            t0 = perf_counter()
+            report = PromotionReport(
+                applied_records=self.wal_records_applied)
+            wal_path = os.path.join(directory, StreamPersistence.WAL)
+            ck_path = os.path.join(directory, StreamPersistence.CHECKPOINT)
+            if not os.path.exists(wal_path):
+                set_role("follower")
+                raise PromotionRefused(
+                    f"no durable WAL at {wal_path}: nothing to promote "
+                    "from (is the shared durability directory mounted?)")
+            records, torn, _end = tail_wal(wal_path, self.applied_ofs)
+            report.tail_records = len(records)
+            report.violations.extend(torn)
+            ck_cycle = ck_chain = None
+            if os.path.exists(ck_path):
+                with open(ck_path, "r", encoding="utf-8") as f:
+                    ck = json.load(f)
+                ck_cycle, ck_chain = int(ck["cycle"]), ck["chain"]
+                mine = self.chain_history.get(ck_cycle)
+                if mine is not None and mine != ck_chain:
+                    set_role("follower")
+                    raise PromotionRefused(
+                        f"chain head mismatch vs the leader's durable "
+                        f"checkpoint at cycle {ck_cycle}: follower "
+                        f"{mine[:16]} vs manifest {ck_chain[:16]}")
+
+            # tail prepass: batches + which tail cycles reached emit
+            emitted_tail = set()
+            for _, rec in records:
+                c = int(rec["c"])
+                if rec["k"] == "batch":
+                    self.batches[c] = [Pod.from_obj(o)
+                                       for o in rec["pods"]]
+                    self.next_cycle = max(self.next_cycle, c + 1)
+                elif rec["k"] == "emit":
+                    emitted_tail.add(c)
+
+            persist = StreamPersistence(directory, checkpoint_every=0,
+                                        fsync_every=fsync_every)
+            persist.next_cycle = self.next_cycle
+            persist.cycles_emitted = self.cycles_emitted
+            persist.chain = self.chain
+            persist.decisions = self.decisions
+            persist.scheduled = self.scheduled
+            persist.wal_records = self.wal_records_applied + len(records)
+            persist.attach(self.session)
+
+            pending: List[int] = []
+
+            def recompute(cid: int) -> None:
+                persist.queue_resume(cid)
+                placements = self.session.schedule(self.batches[cid])
+                report.recomputed.append(cid)
+                self.bound_by_cycle[cid] = [
+                    (pl.pod.key(), pl.node_name)
+                    for pl in placements if pl.node_name]
+
+            def flush_below(cycle: int) -> None:
+                while pending and pending[0] < cycle:
+                    recompute(pending.pop(0))
+
+            def fold_emit(rec: dict) -> None:
+                persist.chain = chain_fold(persist.chain, rec["h"])
+                persist.decisions += int(rec["n"])
+                persist.scheduled += int(rec["s"])
+                persist.cycles_emitted += 1
+                if ck_cycle is not None \
+                        and persist.cycles_emitted == ck_cycle \
+                        and persist.chain != ck_chain:
+                    report.violations.append(
+                        f"tail-replay chain missed the durable manifest "
+                        f"at cycle {ck_cycle}")
+
+            inc = self.session.inc
+            rsp = flight.span("replicate:promote")
+            with rsp, persist.suppress_events():
+                for _ofs, rec in records:
+                    k, c = rec["k"], int(rec["c"])
+                    if k == "ev":
+                        flush_below(c)
+                        inc.apply(rec["t"], _LOADERS[rec["r"]](rec["o"]))
+                        self.events_applied[c] = \
+                            self.events_applied.get(c, 0) + 1
+                    elif k == "batch":
+                        if c not in emitted_tail:
+                            pending.append(c)
+                    elif k == "bind":
+                        if c in self._live_pending \
+                                or c not in emitted_tail:
+                            continue   # live-folded already, or the
+                            #            crash tail re-decides instead
+                        flush_below(c)
+                        pods_by_key = {p.key(): p
+                                       for p in self.batches.get(c, [])}
+                        for key, node in rec["b"]:
+                            pod = pods_by_key.get(key)
+                            if pod is None:
+                                report.violations.append(
+                                    f"bind without batch: {key} in "
+                                    f"cycle {c}")
+                                continue
+                            inc.apply(MODIFIED, bind_pod(pod, node))
+                        self.bound_by_cycle[c] = [(key, node)
+                                                  for key, node in rec["b"]]
+                    elif k == "emit":
+                        flush_below(c)
+                        live = self._live_pending.pop(c, None)
+                        if live is not None \
+                                and placement_hash(live) != rec["h"]:
+                            report.violations.append(
+                                f"live placements diverge from the "
+                                f"durable emit at cycle {c}")
+                        fold_emit(rec)
+                        self.chain_history[persist.cycles_emitted] = \
+                            persist.chain
+                # settle everything still open, in cycle order: cycles we
+                # scheduled live but whose emit never became durable get
+                # their emit appended now (our placements ARE the leader's
+                # — per-cycle cross-checks proved it); batch-only crash
+                # tails re-decide deterministically
+                for cid in sorted(set(pending) | set(self._live_pending)):
+                    if cid in self._live_pending:
+                        persist.log_emit(cid,
+                                         self._live_pending.pop(cid))
+                        report.settled_live.append(cid)
+                    else:
+                        pending.remove(cid)
+                        recompute(cid)
+                if rsp:
+                    rsp.set("tail_records", report.tail_records)
+                    rsp.set("recomputed", len(report.recomputed))
+            if any("chain missed" in v for v in report.violations):
+                persist.close()
+                set_role("follower")
+                raise PromotionRefused(report.violations[-1])
+
+            persist.checkpoint_every = checkpoint_every
+            persist.checkpoint()
+            self.chain = persist.chain
+            self.cycles_emitted = persist.cycles_emitted
+            self.decisions = persist.decisions
+            self.scheduled = persist.scheduled
+            self.next_cycle = persist.next_cycle
+            self.persist = persist
+            self.promoted = True
+            report.resume_cycle = persist.cycles_emitted
+            report.chain = persist.chain
+            report.wal_records = persist.wal_records
+            report.replay_s = perf_counter() - t0
+            register().replication_promotions.inc()
+            set_role("leader")
+            from tpusim.obs import slo as _slo
+
+            tracker = _slo.get_tracker()
+            if tracker is not None:
+                tracker.reset()   # the promoted twin's error budget
+                #                   starts clean — replay is not serving
+            flight.note_recovery("promotion", {
+                "resume_cycle": report.resume_cycle,
+                "tail_records": report.tail_records,
+                "recomputed": len(report.recomputed),
+                "chain": report.chain[:16]})
+            self.stop(keep_session=True)
+            return report
+
+    def stop(self, *, keep_session: bool = True) -> None:
+        with self._lock:
+            if self._stop:
+                return
+            self._stop = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        if not keep_session and self.persist is not None:
+            self.persist.close()
+
+
+# -- failover --------------------------------------------------------------
+
+def http_probe(url: str, timeout: float = 1.0) -> Callable[[], bool]:
+    """Build a leader-health probe from a /healthz URL."""
+    from urllib.request import urlopen
+
+    def probe() -> bool:
+        with urlopen(url, timeout=timeout) as resp:
+            return resp.status == 200
+    return probe
+
+
+class FailoverController:
+    """Watches the leader's health and promotes the freshest follower.
+
+    ``probe`` is any callable returning truthy while the leader lives
+    (an exception or falsy return counts as a miss); ``misses``
+    consecutive misses declare death. RTO is measured end-to-end: first
+    missed probe to promoted-and-journaling."""
+
+    def __init__(self, probe: Callable[[], bool],
+                 followers: Sequence[FollowerTwin], wal_dir: str, *,
+                 interval_s: float = 0.02, misses: int = 2,
+                 checkpoint_every: int = 0, fsync_every: int = 0,
+                 leader_was_alive: bool = False):
+        self.probe = probe
+        self.followers = list(followers)
+        self.wal_dir = wal_dir
+        self.interval_s = interval_s
+        self.misses = misses
+        self.checkpoint_every = checkpoint_every
+        self.fsync_every = fsync_every
+        # misses only count once the leader has been OBSERVED alive — a
+        # follower started before its leader must wait for first contact,
+        # not fail over onto a WAL that does not exist yet. Callers that
+        # already witnessed the leader run (the in-process driver catches
+        # its ProcessCrash directly) pass leader_was_alive=True.
+        self.leader_was_alive = leader_was_alive
+
+    def leader_alive(self) -> bool:
+        try:
+            return bool(self.probe())
+        except Exception:
+            return False
+
+    def wait_for_death(self, timeout: float = 30.0) -> float:
+        """Poll until ``misses`` consecutive probe failures AFTER the
+        leader has been seen alive at least once; returns the
+        perf_counter timestamp of the FIRST miss of the fatal streak."""
+        deadline = monotonic() + timeout
+        streak, first_miss = 0, 0.0
+        while True:
+            if self.leader_alive():
+                self.leader_was_alive = True
+                streak = 0
+            elif self.leader_was_alive:
+                if streak == 0:
+                    first_miss = perf_counter()
+                streak += 1
+                if streak >= self.misses:
+                    return first_miss
+            if monotonic() >= deadline:
+                raise TimeoutError(
+                    "leader never died within the watch window"
+                    if self.leader_was_alive else
+                    "leader was never observed alive within the watch "
+                    "window")
+            sleep(self.interval_s)
+
+    def failover(self, t_detect: Optional[float] = None
+                 ) -> Tuple[FollowerTwin, PromotionReport]:
+        """Promote the freshest non-diverged follower; refuse when none
+        qualifies. Divergence on the freshest candidate falls through to
+        the next-freshest — degraded, never silently wrong."""
+        if t_detect is None:
+            t_detect = perf_counter()
+        candidates = sorted(self.followers,
+                            key=lambda f: f.applied_seq, reverse=True)
+        last_refusal: Optional[Exception] = None
+        for follower in candidates:
+            try:
+                report = follower.promote(
+                    self.wal_dir, checkpoint_every=self.checkpoint_every,
+                    fsync_every=self.fsync_every)
+            except PromotionRefused as exc:
+                last_refusal = exc
+                continue
+            report.rto_s = perf_counter() - t_detect
+            register().replication_rto_seconds.set(report.rto_s)
+            return follower, report
+        raise PromotionRefused(
+            f"no promotable follower among {len(candidates)} candidates: "
+            f"{last_refusal}")
+
+    def run(self, timeout: float = 30.0
+            ) -> Tuple[FollowerTwin, PromotionReport]:
+        """Watch until the leader dies, then fail over."""
+        t_detect = self.wait_for_death(timeout)
+        return self.failover(t_detect)

@@ -86,7 +86,7 @@ def test_plan_attempts_promotion(monkeypatch):
     monkeypatch.setenv("TPUSIM_BENCH_LADDER_CONFIGS",
                        bench.AUTOLADDER_DEFAULT_CONFIGS)
     assert bench._ladder_configs() == {3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13,
-                                       14, 15}
+                                       14, 15, 16}
 
     # explicit --ladder/--phases: no promotion (caller controls the configs)
     assert bench.plan_attempts("tpu", True, False, 1)[1] is False

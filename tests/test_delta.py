@@ -384,3 +384,79 @@ def test_run_simulation_folds_pv_pvc_events_for_both_backends():
                                 backend=backend, events=list(events))
         assert len(status.successful_pods) == 1, backend
         assert status.successful_pods[0].spec.node_name == "n1", backend
+
+
+# ---------------------------------------------------------------------------
+# journal mark bracket (ISSUE 19: the overlay / fold-back rollback seam)
+# ---------------------------------------------------------------------------
+
+
+def _journal_cluster():
+    inc = IncrementalCluster(ClusterSnapshot(
+        nodes=[make_node(f"jn{i}", milli_cpu=4000) for i in range(3)]))
+    inc.drain_journal()
+    return inc
+
+
+def test_journal_mark_exclusive_nested_rejected():
+    """A second mark before the first resolves must raise — nesting would
+    silently lose the outer bracket's entries on the inner rollback."""
+    import pytest
+
+    inc = _journal_cluster()
+    mark = inc.journal_mark()
+    with pytest.raises(RuntimeError, match="exclusive"):
+        inc.journal_mark()
+    inc.journal_rollback(mark)
+    # resolved: the bracket can open again (rollback half)
+    mark2 = inc.journal_mark()
+    inc.journal_rollback(mark2)
+    # ... and via the success half too
+    inc.journal_mark()
+    inc.journal_release()
+    inc.journal_mark()
+    inc.journal_release()
+
+
+def test_journal_rollback_restores_journal_sets():
+    pod = make_pod("jm-p0", milli_cpu=100)
+    pod.spec.node_name = "jn0"
+    inc = _journal_cluster()
+    inc.apply(ADDED, pod)
+    pre_nodes = set(inc._journal_nodes)
+    pre_cells = set(inc._journal_presence)
+    mark = inc.journal_mark()
+    interim = make_pod("jm-p1", milli_cpu=100)
+    interim.spec.node_name = "jn2"
+    inc.apply(ADDED, interim)
+    assert inc._journal_nodes != pre_nodes   # the interim apply journaled
+    inc.journal_rollback(mark)
+    assert inc._journal_nodes == pre_nodes
+    assert inc._journal_presence == pre_cells
+    # pre-mark entries drain normally after the rollback
+    nodes, _cells = inc.drain_journal()
+    assert nodes == pre_nodes
+
+
+def test_journal_release_keeps_interim_entries():
+    inc = _journal_cluster()
+    inc.journal_mark()
+    interim = make_pod("jr-p0", milli_cpu=100)
+    interim.spec.node_name = "jn1"
+    inc.apply(ADDED, interim)
+    inc.journal_release()
+    nodes, _cells = inc.drain_journal()
+    assert nodes, "release dropped the interim journal entries"
+
+
+def test_journal_mark_on_empty_journal_rolls_back_to_empty():
+    """Overlay-on-empty-journal: a quiet cycle's mark starts from empty
+    sets and rollback returns to exactly that."""
+    inc = _journal_cluster()
+    mark = inc.journal_mark()
+    assert mark == (set(), set())
+    interim = make_pod("je-p0", milli_cpu=100)
+    interim.spec.node_name = "jn0"
+    inc.apply(ADDED, interim)
+    inc.journal_rollback(mark)
+    assert inc.drain_journal() == (set(), set())

@@ -415,3 +415,136 @@ def test_replication_metrics_registered():
                  "replication_promotions", "replication_divergence",
                  "replication_rto_seconds", "replication_role"):
         assert hasattr(reg, name), name
+
+
+# ---------------------------------------------------------------------------
+# replica reads + late-join bootstrap (ISSUE 19 satellites)
+# ---------------------------------------------------------------------------
+
+
+def _drive(session, gen, cycles, start=0):
+    for cycle in range(start, cycles):
+        session.apply_events(gen.events(cycle))
+        gen.note_bound(session.schedule(gen.batch()))
+
+
+def _wait_caught_up(shipper, timeout=15.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if shipper.drain(timeout=1.0):
+            return True
+    return False
+
+
+def _whatif_pods(seed=7, n=4):
+    import numpy as np
+
+    from tpusim.api.snapshot import make_pod
+
+    rng = np.random.RandomState(seed)
+    return [make_pod(f"repl-whatif-{seed}-{i}",
+                     milli_cpu=int(rng.randint(100, 1200)),
+                     memory=int(rng.randint(1 << 20, 1 << 30)))
+            for i in range(n)]
+
+
+def test_replica_overlay_read_then_replay(tmp_path):
+    """A caught-up follower answers overlay what-ifs (placement-hash
+    parity with the staged oracle on ITS state) and keeps replaying the
+    leader's WAL afterwards — reads never perturb the replica chain."""
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.backends import placement_hash
+    from tpusim.jaxe.whatif import run_what_if
+    from tpusim.stream import ChurnLoadGen, StreamPersistence, StreamSession
+    from tpusim.stream.replicate import FollowerTwin, WalShipper
+
+    follower = FollowerTwin(synthetic_cluster(8))
+    leader = StreamSession(synthetic_cluster(8))
+    persist = StreamPersistence(str(tmp_path), checkpoint_every=2)
+    shipper = WalShipper(persist, follower.address)
+    leader.attach_persistence(persist)
+    gen = ChurnLoadGen(synthetic_cluster(8), seed=5, arrivals=8,
+                       evict_fraction=0.25, node_flap_every=3)
+    try:
+        _drive(leader, gen, 4)
+        assert _wait_caught_up(shipper)
+        assert follower.diverged is None
+        assert follower.chain == persist.chain
+        qpods = _whatif_pods()
+        placements = follower.overlay_query(qpods)
+        assert placements is not None, "replica overlay refused"
+        [oracle] = run_what_if(
+            [(follower.session.inc.to_snapshot(), qpods)])
+        assert placement_hash(placements) == \
+            placement_hash(oracle.placements)
+        chain_before = follower.chain
+        _drive(leader, gen, 6, start=4)
+        assert _wait_caught_up(shipper)
+        assert follower.diverged is None
+        assert follower.chain == persist.chain != chain_before
+    finally:
+        shipper.close()
+        persist.close()
+        follower.stop()
+
+
+def test_diverged_replica_refuses_overlay_reads():
+    twin = _mini_twin()
+    try:
+        twin._diverge("poisoned for the read test")
+        assert twin.overlay_query(_whatif_pods()) is None
+    finally:
+        twin.stop()
+
+
+def test_late_join_bootstrap(tmp_path):
+    """A follower that joins AFTER the leader has been running bootstraps
+    from the shipped checkpoint manifest + open batches, lands on the
+    leader's exact chain, then replays live records and serves overlay
+    reads — O(WAL-tail) catch-up, not replay-from-genesis."""
+    import socket
+
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.backends import placement_hash
+    from tpusim.jaxe.whatif import run_what_if
+    from tpusim.stream import ChurnLoadGen, StreamPersistence, StreamSession
+    from tpusim.stream.replicate import FollowerTwin, WalShipper
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    leader = StreamSession(synthetic_cluster(8))
+    persist = StreamPersistence(str(tmp_path), checkpoint_every=2)
+    shipper = WalShipper(persist, ("127.0.0.1", port))
+    leader.attach_persistence(persist)
+    gen = ChurnLoadGen(synthetic_cluster(8), seed=5, arrivals=8,
+                       evict_fraction=0.25, node_flap_every=3)
+    late = None
+    try:
+        _drive(leader, gen, 4)   # nobody listening yet
+        late = FollowerTwin(bootstrap=True, listen=("127.0.0.1", port))
+        assert _wait_caught_up(shipper), "late joiner never caught up"
+        assert late.bootstrapped, "snap frame never applied"
+        assert late.diverged is None
+        assert late.chain == persist.chain
+        # accounting covers the full journal: manifest records are
+        # credited by the snap frame, the tail by live replay
+        assert late.wal_records_applied == persist.wal_records
+        _drive(leader, gen, 6, start=4)
+        assert _wait_caught_up(shipper)
+        assert late.diverged is None
+        assert late.chain == persist.chain
+        qpods = _whatif_pods(seed=9)
+        placements = late.overlay_query(qpods)
+        assert placements is not None
+        [oracle] = run_what_if([(late.session.inc.to_snapshot(), qpods)])
+        assert placement_hash(placements) == \
+            placement_hash(oracle.placements)
+    finally:
+        shipper.close()
+        persist.close()
+        if late is not None:
+            late.stop()

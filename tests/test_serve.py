@@ -285,3 +285,119 @@ class TestFleet:
             assert resp.ok and resp.bucket_ghosts == 5
             assert placement_hash(resp.result.placements) == \
                 singleton_hash(snap, pods)
+
+
+# ---------------------------------------------------------------------------
+# live-twin serving (ISSUE 19): resident-overlay dispatch + staged fallback
+# ---------------------------------------------------------------------------
+
+
+def _warm_twin(num_nodes=8, cycles=3, seed=11):
+    from tpusim.api.snapshot import synthetic_cluster
+    from tpusim.stream import ChurnLoadGen, StreamSession
+
+    session = StreamSession(synthetic_cluster(num_nodes))
+    gen = ChurnLoadGen(synthetic_cluster(num_nodes), seed=seed, arrivals=8,
+                       evict_fraction=0.25)
+    for c in range(cycles):
+        session.apply_events(gen.events(c))
+        gen.note_bound(session.schedule(gen.batch()))
+    return session
+
+
+class TestLiveTwin:
+    def test_overlay_parity_and_warm_second_query(self):
+        session = _warm_twin()
+        fleet = ScenarioFleet(bucket_size=4, flush_after_s=60.0)
+        fleet.attach_stream(session, ref="live")
+        _, pods = scenario(41)
+        want = singleton_hash(session.inc.to_snapshot(), pods)
+        cold = fleet.submit(WhatIfRequest(pods=pods, snapshot_ref="live"))
+        fleet.drain()
+        resp = cold.result()
+        assert resp.ok and not resp.compile_cache_hit
+        assert placement_hash(resp.result.placements) == want
+        warm = fleet.submit(WhatIfRequest(pods=pods, snapshot_ref="live"))
+        fleet.drain()
+        resp2 = warm.result()
+        assert resp2.ok and resp2.compile_cache_hit
+        assert placement_hash(resp2.result.placements) == want
+        assert fleet.executor.stats["overlay_hits"] == 2
+        assert fleet.executor.stats["overlay_fallbacks"] == 0
+
+    def test_forced_restage_falls_back_to_staged_path(self):
+        session = _warm_twin(seed=12)
+        fleet = ScenarioFleet(bucket_size=1, flush_after_s=60.0)
+        fleet.attach_stream(session, ref="live")
+        session.force_restage("test_fallback")
+        _, pods = scenario(42)
+        want = singleton_hash(session.inc.to_snapshot(), pods)
+        fut = fleet.submit(WhatIfRequest(pods=pods, snapshot_ref="live"))
+        fleet.drain()
+        resp = fut.result()
+        # the staged path answered against the twin's SAME live host
+        # picture — degraded service, identical placements
+        assert resp.ok
+        assert placement_hash(resp.result.placements) == want
+        assert fleet.executor.stats["overlay_fallbacks"] >= 1
+        assert fleet.executor.stats["overlay_hits"] == 0
+
+    def test_plan_mismatch_routes_around_overlay(self):
+        import json
+        import pathlib
+
+        from tpusim.engine.policy import decode_policy
+
+        session = _warm_twin(seed=13)
+        fleet = ScenarioFleet(bucket_size=1, flush_after_s=60.0)
+        fleet.attach_stream(session, ref="live")
+        pol = decode_policy(json.loads(
+            (pathlib.Path(__file__).parent /
+             "compat_policies.json").read_text())["1.0"])
+        _, pods = scenario(43)
+        fut = fleet.submit(WhatIfRequest(pods=pods, snapshot_ref="live",
+                                         policy=pol))
+        fleet.drain()
+        resp = fut.result()
+        assert resp.ok  # staged against the live picture, twin untouched
+        assert fleet.executor.stats["overlay_hits"] == 0
+
+    def test_detach_twin_restores_ref_lookup(self):
+        session = _warm_twin(seed=14)
+        fleet = ScenarioFleet(bucket_size=1, flush_after_s=60.0)
+        fleet.attach_stream(session, ref="live")
+        fleet.executor.detach_twin("live")
+        _, pods = scenario(44)
+        fut = fleet.submit(WhatIfRequest(pods=pods, snapshot_ref="live"))
+        fleet.drain()
+        assert fut.result().rejected == REJECT_UNKNOWN_SNAPSHOT
+
+    def test_replica_answers_before_leader(self, tmp_path):
+        from tpusim.api.snapshot import synthetic_cluster
+        from tpusim.simulator import run_stream_simulation
+        from tpusim.stream.replicate import FollowerTwin
+
+        follower = FollowerTwin(synthetic_cluster(8))
+        try:
+            run_stream_simulation(num_nodes=8, cycles=4, arrivals=8,
+                                  seed=15, evict_fraction=0.25,
+                                  checkpoint_dir=str(tmp_path),
+                                  checkpoint_every=2,
+                                  replicate_to=follower.address)
+            assert follower.diverged is None
+            fleet = ScenarioFleet(bucket_size=1, flush_after_s=60.0)
+            fleet.attach_stream(_warm_twin(seed=15), ref="live")
+            fleet.attach_replica(follower, ref="live")
+            _, pods = scenario(45)
+            want = singleton_hash(follower.session.inc.to_snapshot(), pods)
+            before = register().overlay_queries.values.get("follower", 0)
+            fut = fleet.submit(WhatIfRequest(pods=pods,
+                                             snapshot_ref="live"))
+            fleet.drain()
+            resp = fut.result()
+            assert resp.ok
+            assert placement_hash(resp.result.placements) == want
+            assert register().overlay_queries.values.get(
+                "follower", 0) == before + 1
+        finally:
+            follower.stop()

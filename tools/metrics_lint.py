@@ -60,6 +60,9 @@ _UNITLESS_GAUGES = {
     # cursor are dimensionless counts (the byte/time lags carry units)
     "tpusim_replication_lag_records",
     "tpusim_replication_last_shipped_seq",
+    # ISSUE 19: the residency ledger's resident-twin count is dimensionless
+    # (the per-tenant byte footprint carries units)
+    "tpusim_tenant_resident_twins",
 }
 # label names whose value sets are finite by construction; anything else
 # (node names, pod names, plan signatures) is unbounded cardinality

@@ -298,6 +298,12 @@ run_stage replication configs:15 bench_results/r5_tpu_replication.jsonl \
     env TPUSIM_BENCH_LADDER_CONFIGS=15 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
     python bench.py --ladder
 
+echo "== stage 3i: live what-if serving (config 16: overlay-vs-staged curve + tenant round trip) =="
+run_stage live_whatif configs:16 bench_results/r5_tpu_live_whatif.jsonl \
+    bench_results/r5_tpu_live_whatif.log \
+    env TPUSIM_BENCH_LADDER_CONFIGS=16 TPUSIM_BENCH_TPU_AUTOLADDER=0 \
+    python bench.py --ladder
+
 echo "== stage 4: full XLA ladder (configs 1-5; fresh same-round parity anchors) =="
 run_stage ladder configs:1,2,3,4,5 bench_results/r5_tpu_ladder.jsonl \
     bench_results/r5_tpu_ladder.log \

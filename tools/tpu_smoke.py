@@ -809,6 +809,65 @@ def run_standby_variant():
         shutil.rmtree(rep_dir, ignore_errors=True)
 
 
+def run_live_whatif_variant():
+    """Live-twin what-if overlay (ISSUE 19) stage-0: an overlay query on a
+    churn-warm device-resident twin must (a) answer placement-hash
+    identical to the staged run_what_if oracle over the same logical
+    state, (b) trace ZERO fresh programs across warm-shape repeats — the
+    overlay rides the stream's pow2-bucketed scan + scatter programs —
+    and (c) leave the churn run's fold chain byte-unchanged when queries
+    interleave with live cycles (the copy-on-write rollback contract)."""
+    import numpy as np
+
+    from tpusim.api.snapshot import make_pod, synthetic_cluster
+    from tpusim.backends import placement_hash
+    from tpusim.jaxe.whatif import compile_count, run_what_if
+    from tpusim.simulator import run_stream_simulation
+    from tpusim.stream import ChurnLoadGen, StreamSession
+
+    session = StreamSession(synthetic_cluster(16))
+    gen = ChurnLoadGen(synthetic_cluster(16), seed=7, arrivals=16,
+                       evict_fraction=0.25)
+    for c in range(4):
+        session.apply_events(gen.events(c))
+        gen.note_bound(session.schedule(gen.batch()))
+    rng = np.random.RandomState(19)
+    qpods = [make_pod(f"smoke-q{i}",
+                      milli_cpu=int(rng.randint(100, 1500)),
+                      memory=int(rng.randint(2 ** 20, 2 ** 30)))
+             for i in range(6)]
+    placements = session.overlay_query(qpods)
+    if placements is None:
+        raise AssertionError("overlay refused on a warm resident twin")
+    [oracle] = run_what_if([(session.inc.to_snapshot(), qpods)])
+    h = placement_hash(placements)
+    if h != placement_hash(oracle.placements):
+        raise AssertionError(
+            f"overlay hash {h[:16]} != staged run_what_if "
+            f"{placement_hash(oracle.placements)[:16]} on the same state")
+    traced_before = compile_count()
+    for k in (6, 5, 3):   # all land in already-traced pow2 buckets
+        if session.overlay_query(qpods[:k]) is None:
+            raise AssertionError(f"warm overlay refused at {k} pods")
+    retraces = compile_count() - traced_before
+    if retraces:
+        raise AssertionError(
+            f"warm overlay queries traced {retraces} fresh programs; "
+            "pow2 bucket reuse is broken")
+    kw = dict(num_nodes=16, cycles=8, arrivals=16, evict_fraction=0.25,
+              node_flap_every=4, seed=7)
+    base = run_stream_simulation(**kw)
+    live = run_stream_simulation(**kw, whatif_every=2, whatif_pods=6)
+    if live["fold_chain"] != base["fold_chain"]:
+        raise AssertionError(
+            "interleaved overlay queries changed the churn fold chain: "
+            f"{live['fold_chain'][:16]} vs {base['fold_chain'][:16]}")
+    ov = live["overlay"]
+    if ov["answered"] != ov["queries"]:
+        raise AssertionError(f"overlay fell back under churn: {ov}")
+    return h[:16], ov["answered"], retraces
+
+
 def run_analytics_variant():
     """Cluster analytics plane (tpusim/obs/analytics) stage-0: with the
     post-scan reduction riding every dispatch, (a) on-device aggregates
@@ -1251,6 +1310,25 @@ def main() -> int:
             print(f"SMOKE standby: OK hash={h} rto_ms={rto_ms:.1f} "
                   f"replayed={replayed}/{wal_records} retrace={retrace} "
                   f"({time.time() - t:.1f}s)", flush=True)
+        if not only or "live_whatif" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "live_whatif")
+            try:
+                h, answered, retraces = run_live_whatif_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: live_whatif: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("answered", answered)
+            vsp.end()
+            ran += 1
+            print(f"SMOKE live_whatif: OK hash={h} answered={answered} "
+                  f"retrace=+{retraces} ({time.time() - t:.1f}s)", flush=True)
         if not only or "analytics" in only:
             t = time.time()
             vsp = flight.span("smoke_variant")

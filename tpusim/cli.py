@@ -451,6 +451,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                              "mesh's scenario axis with shard_map "
                              "(make_scenario_mesh); bucket size must divide "
                              "over it")
+    parser.add_argument("--attach-stream", action="store_true",
+                        help="Live-twin serving (ISSUE 19): hold the cluster "
+                             "device-resident in a StreamSession, warm it "
+                             "with a few churn cycles, and answer requests "
+                             "through copy-on-write overlay queries on the "
+                             "resident carry — zero per-request staging; "
+                             "the staged pipeline stays armed as fallback")
+    parser.add_argument("--stream-cycles", type=int, default=4,
+                        help="Churn warm-up cycles for --attach-stream")
+    parser.add_argument("--stream-arrivals", type=int, default=16,
+                        help="Arrivals per --attach-stream warm-up cycle")
     parser.add_argument("--platform",
                         default=os.environ.get("TPUSIM_PLATFORM", ""))
     parser.add_argument("--quiet", action="store_true",
@@ -575,14 +586,39 @@ def serve_cli(argv) -> int:
     except (KeyError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
-    fleet.register_snapshot("base", snapshot)
+    ref = "base"
+    if args.attach_stream:
+        # live-twin serving: the fleet answers against a device-resident
+        # StreamSession's carry via overlay queries instead of staging a
+        # fresh device picture per request (ISSUE 19). Fresh object
+        # graphs per consumer — the twin and the churn generator must
+        # never share mutable nodes with each other or the pod pool.
+        from tpusim.stream import ChurnLoadGen, StreamSession
+
+        twin_snap = ClusterSnapshot.from_obj(snapshot.to_obj())
+        session = StreamSession(twin_snap, provider=args.algorithmprovider,
+                                policy=policy)
+        sgen = ChurnLoadGen(ClusterSnapshot.from_obj(snapshot.to_obj()),
+                            seed=args.seed, arrivals=args.stream_arrivals,
+                            evict_fraction=0.25)
+        for c in range(max(1, args.stream_cycles)):
+            session.apply_events(sgen.events(c))
+            sgen.note_bound(session.schedule(sgen.batch()))
+        fleet.attach_stream(session, ref="live")
+        ref = "live"
+        if not args.quiet:
+            print(f"live twin: {max(1, args.stream_cycles)} warm-up churn "
+                  f"cycles over {len(twin_snap.nodes)} nodes; overlay path "
+                  "armed (staged fallback behind it)", file=sys.stderr)
+    else:
+        fleet.register_snapshot("base", snapshot)
 
     # the load: random-size what-if queries drawn from the pod pool, each
     # cache-keyed so warm repeats exercise the staged + device-batch caches
     rng = random.Random(args.seed)
     sizes = [rng.randint(1, len(pool)) for _ in range(args.requests)]
     make_load = lambda: [  # noqa: E731
-        WhatIfRequest(pods=pool[:n], snapshot_ref="base", policy=policy,
+        WhatIfRequest(pods=pool[:n], snapshot_ref=ref, policy=policy,
                       cache_key=f"load-{i}-{n}")
         for i, n in enumerate(sizes)]
 
@@ -636,6 +672,9 @@ def serve_cli(argv) -> int:
           f"{stats['staged_hits']} staged-cache hits"
           + (f", mesh {mesh.shape['scenario']}x{mesh.shape['node']}"
              if mesh is not None else ""))
+    if args.attach_stream:
+        print(f"overlay: {stats['overlay_hits']} served from the resident "
+              f"twin, {stats['overlay_fallbacks']} staged fallbacks")
 
     if recorder is not None:
         from tpusim.obs import recorder as flight
@@ -761,6 +800,14 @@ def build_stream_parser() -> argparse.ArgumentParser:
                              "manifest over the replication protocol "
                              "(stream.replicate) and drain the acks before "
                              "exiting; requires --checkpoint-dir")
+    parser.add_argument("--whatif-every", type=int, default=0,
+                        help="Serve a live what-if query against the "
+                             "device-resident twin every N cycles via a "
+                             "copy-on-write overlay (mark -> scan -> roll "
+                             "back; the churn chain is byte-unchanged); "
+                             "0 = no queries (ISSUE 19)")
+    parser.add_argument("--whatif-pods", type=int, default=4,
+                        help="Scenario pods per live what-if query")
     parser.add_argument("--platform",
                         default=os.environ.get("TPUSIM_PLATFORM", ""))
     parser.add_argument("--json", action="store_true",
@@ -850,7 +897,9 @@ def stream_cli(argv) -> int:
             checkpoint_every=args.checkpoint_every,
             fsync_every=args.fsync_every,
             replicate_to=replicate_to,
-            recover=args.recover)
+            recover=args.recover,
+            whatif_every=args.whatif_every,
+            whatif_pods=args.whatif_pods)
     except ProcessCrash as exc:
         # the scripted kill: state up to the crash is durable in the WAL;
         # rerun with --recover to resume from it
@@ -883,6 +932,12 @@ def stream_cli(argv) -> int:
               f"{out['load']['evictions']} evictions, "
               f"{out['load']['flaps']} flaps; "
               f"placement chain {out['placement_chain'][:16]}")
+        if "overlay" in out:
+            ov = out["overlay"]
+            print(f"live what-if: {ov['answered']}/{ov['queries']} overlay "
+                  f"queries answered ({ov['fallbacks']} fell back), query "
+                  f"p50/p99 {ov['p50_query_ms']:.1f}/"
+                  f"{ov['p99_query_ms']:.1f} ms")
         if out.get("recovered"):
             print(f"recovered: resumed at cycle {out['resume_cycle']} "
                   f"({len(out['recomputed_cycles'])} cycles recomputed, replay "
@@ -1014,6 +1069,13 @@ def build_follow_parser() -> argparse.ArgumentParser:
                         help="Post-promotion checkpoint cadence")
     parser.add_argument("--fsync-every", type=int, default=0,
                         help="Post-promotion WAL fsync cadence")
+    parser.add_argument("--bootstrap", action="store_true",
+                        help="Late join (ISSUE 19): request the leader's "
+                             "latest checkpoint manifest + WAL offset in "
+                             "the hello exchange and rebuild the twin from "
+                             "it, instead of replaying from a cycle-0 "
+                             "snapshot (--snapshot/--synthetic-nodes are "
+                             "then ignored)")
     _add_follow_snapshot_flags(parser)
     add_obs_flags(parser)
     add_explain_flags(parser)
@@ -1041,6 +1103,8 @@ def follow_cli(argv) -> int:
     try:
         bind = parse_listen(args.bind)
         snapshot, policy = _load_follow_snapshot(args)
+        if args.bootstrap:
+            snapshot = None   # the shipped manifest is the twin's source
     except (OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -1059,7 +1123,8 @@ def follow_cli(argv) -> int:
                                     provider=args.algorithmprovider,
                                     policy=policy,
                                     always_restage=args.always_restage,
-                                    listen=bind)
+                                    listen=bind,
+                                    bootstrap=args.bootstrap)
         except (KeyError, ValueError, OSError) as exc:
             print(f"error: {exc}", file=sys.stderr)
             return 2

@@ -558,6 +558,39 @@ class SchedulerMetrics:
             "tpusim_replication_role_info",
             "Replication role of this process (labels: role = "
             "leader|follower|candidate|none)"))
+        # live-twin overlay queries (ISSUE 19): what-if scenarios answered
+        # against the device-resident carry behind a journal mark, plus the
+        # multi-tenant residency ledger that evicts cold twins to their
+        # checkpoints under HBM pressure
+        self.overlay_queries = self._reg(LabeledCounter(
+            "tpusim_overlay_queries_total",
+            "What-if queries answered by a resident twin overlay "
+            "(path = resident|follower)", "path"))
+        self.overlay_fallback = self._reg(LabeledCounter(
+            "tpusim_overlay_fallback_total",
+            "Overlay-ineligible what-if queries routed to the staged path, "
+            "by refusal reason", "reason"))
+        self.overlay_latency = self._reg(Histogram(
+            "tpusim_overlay_latency_microseconds",
+            "Route-to-rollback walltime per resident-twin overlay query",
+            _LATENCY_BUCKETS))
+        self.tenant_evictions = self._reg(LabeledCounter(
+            "tpusim_tenant_evictions_total",
+            "Tenant twins evicted to their checkpoint directory", "reason"))
+        self.tenant_restores = self._reg(Counter(
+            "tpusim_tenant_restores_total",
+            "Tenant twins restored on demand from checkpoint + WAL tail"))
+        self.tenant_resident_bytes = self._reg(LabeledGauge(
+            "tpusim_tenant_resident_bytes",
+            "HBM bytes held by each tenant's resident twin (0 = evicted)",
+            "tenant"))
+        self.tenant_restore_latency = self._reg(Histogram(
+            "tpusim_tenant_restore_latency_microseconds",
+            "Checkpoint-load + WAL-tail-replay walltime per tenant restore",
+            _LATENCY_BUCKETS))
+        self.tenant_resident_twins = self._reg(Gauge(
+            "tpusim_tenant_resident_twins",
+            "Tenant twins currently resident in HBM (admitted - evicted)"))
         # one lock for whole-registry reads: /metrics and snapshot() see a
         # single consistent exposition even while runtime threads observe
         self._read_lock = threading.Lock()

@@ -116,6 +116,7 @@ def admit_gang(backend, inc, group: PodGroup) -> List[Placement]:
         m.gang_partial_rollback.inc()
         flight.note_gang("rollback", {"group": group.name})
         raise
+    inc.journal_release()
     m.gang_admitted.inc()
     flight.note_gang("admit", {"group": group.name, "placed": placed,
                                "members": len(members)})

@@ -156,6 +156,7 @@ class IncrementalCluster:
         # group change forces a restage, which drops the journal).
         self._journal_nodes: set = set()
         self._journal_presence: set = set()
+        self._journal_mark_active = False         # mark-bracket exclusivity
         # label/taint-churned node indices (ISSUE 9): a MODIFIED node whose
         # ONLY delta is metadata.labels / spec.taints leaves the structural
         # caches intact (when no group feature is active) but moves
@@ -652,19 +653,36 @@ class IncrementalCluster:
 
     def journal_mark(self) -> Tuple[set, set]:
         """Snapshot the pod-delta journal. Paired with journal_rollback by
-        the pipelined fold-back (stream/runtime._fold_binds): the scan
-        already applied that cycle's binds to the resident carry with
-        identical integer arithmetic, so the fold's MODIFIED replays are
-        journal noise — rolling back to the mark keeps the next commit's
-        scatter O(watch delta) instead of O(delta + binds), which also
-        keeps the commit bucket sizes inside the warmed jit cache."""
+        the pipelined fold-back (stream/runtime._fold_binds) and by overlay
+        what-if queries (stream/runtime.overlay_query): the scan already
+        applied that cycle's binds to the resident carry with identical
+        integer arithmetic, so the fold's MODIFIED replays are journal
+        noise — rolling back to the mark keeps the next commit's scatter
+        O(watch delta) instead of O(delta + binds), which also keeps the
+        commit bucket sizes inside the warmed jit cache.
+
+        Marks are exclusive: a second mark before the first is resolved
+        (rollback or release) raises — nesting would silently lose the
+        outer bracket's entries on the inner rollback."""
+        if self._journal_mark_active:
+            raise RuntimeError(
+                "journal_mark is exclusive: an unresolved mark is active "
+                "(rollback or release it first)")
+        self._journal_mark_active = True
         return set(self._journal_nodes), set(self._journal_presence)
 
     def journal_rollback(self, mark: Tuple[set, set]) -> None:
         """Discard journal entries added since journal_mark (safe only when
         every interim apply targeted state the resident carry already
-        holds, i.e. the pipelined bind fold-back)."""
+        holds, i.e. the pipelined bind fold-back / overlay rollback)."""
         self._journal_nodes, self._journal_presence = mark
+        self._journal_mark_active = False
+
+    def journal_release(self) -> None:
+        """Resolve an active journal_mark WITHOUT restoring the snapshot —
+        the success half of a mark bracket whose interim applies should
+        stick (gang admission keeps its members' binds journaled)."""
+        self._journal_mark_active = False
 
     def drain_column_journal(self) -> set:
         """Hand over the label/taint-churned node indices since the last

@@ -1564,6 +1564,37 @@ def _apply_delta_impl(carry: Carry, node_idx, rows: DeltaRows,
 apply_delta_donated = jax.jit(_apply_delta_impl, donate_argnums=(0,))
 
 
+def _overlay_restore_impl(carry: Carry, node_idx, rows: DeltaRows,
+                          pres_gid, pres_nid, pres_val, sa_lock_save,
+                          rr_save) -> Carry:
+    # The rollback half of a what-if overlay (tpusim.stream overlay_query):
+    # the same authoritative scatter as _apply_delta_impl over the nodes the
+    # overlay scan BOUND, but the per-batch lanes restore the SAVED pre-mark
+    # arrays instead of re-arming — sa_lock returns to the segment locks the
+    # last real cycle left and rr to its pre-overlay cursor, so the
+    # post-rollback carry is byte-identical to the pre-mark carry (modulo
+    # churn the overlay early-committed, which the restored journal makes
+    # the next real commit's idempotent no-op).
+    return carry._replace(
+        used_cpu=carry.used_cpu.at[node_idx].set(rows.used_cpu),
+        used_mem=carry.used_mem.at[node_idx].set(rows.used_mem),
+        used_gpu=carry.used_gpu.at[node_idx].set(rows.used_gpu),
+        used_eph=carry.used_eph.at[node_idx].set(rows.used_eph),
+        used_scalar=carry.used_scalar.at[node_idx].set(rows.used_scalar),
+        nonzero_cpu=carry.nonzero_cpu.at[node_idx].set(rows.nonzero_cpu),
+        nonzero_mem=carry.nonzero_mem.at[node_idx].set(rows.nonzero_mem),
+        pod_count=carry.pod_count.at[node_idx].set(rows.pod_count),
+        presence=carry.presence.at[pres_gid, pres_nid].set(pres_val),
+        sa_lock=jnp.asarray(sa_lock_save, carry.sa_lock.dtype),
+        rr=jnp.asarray(rr_save, carry.rr.dtype))
+
+
+# Donation contract matches apply_delta_donated: the overlay scan's final
+# carry is patched in place back to host truth. Shapes ride the same pow2
+# bucketing, so warm overlay traffic reuses one compiled restore program.
+overlay_restore_donated = jax.jit(_overlay_restore_impl, donate_argnums=(0,))
+
+
 class StaticsDelta(NamedTuple):
     """Authoritative post-churn statics columns for `node_idx`, one column
     slice per table whose cells depend on node labels/taints. The leading

@@ -73,8 +73,15 @@ from tpusim.serve.request import (
     ServeRejected,
     ShapeClass,
     WhatIfRequest,
+    _budget,
     shape_class_for,
 )
+
+
+def _twin_session(twin):
+    """The StreamSession behind a twin handle (a session itself, or a
+    replicate.FollowerTwin wrapping one)."""
+    return getattr(twin, "session", twin)
 
 
 class ServeExecutor:
@@ -107,8 +114,15 @@ class ServeExecutor:
         self._device_batches: OrderedDict = OrderedDict()
         self._max_device_batches = max_device_batches
         self._warm: Dict[Tuple[ShapeClass, Any], Dict[str, int]] = {}
+        # live twins (ISSUE 19): snapshot_ref -> StreamSession answering
+        # what-if requests as resident-carry overlays, plus optional
+        # FollowerTwin read replicas serving the same ref from standby HBM
+        self._twins: Dict[str, Any] = {}
+        self._replicas: Dict[str, List[Any]] = {}
+        self._overlay_shapes: Dict[str, set] = {}
         self.stats = {"dispatches": 0, "warm_hits": 0, "traces": 0,
-                      "staged_hits": 0, "device_batch_hits": 0}
+                      "staged_hits": 0, "device_batch_hits": 0,
+                      "overlay_hits": 0, "overlay_fallbacks": 0}
         # HBM residency accounting (ISSUE 14): byte/entry sources polled
         # only at scrape/snapshot time; weakref'd to this executor
         analytics.register_hbm_source(
@@ -131,6 +145,85 @@ class ServeExecutor:
     def snapshot_refs(self) -> List[str]:
         return list(self._snapshots)
 
+    # -- live twins (ISSUE 19): resident-overlay dispatch ------------------
+
+    def attach_twin(self, ref: str, session) -> str:
+        """Install a live StreamSession as the resident twin behind `ref`:
+        requests naming the ref are answered by an overlay query against
+        the session's device-resident carry (O(scenario) per request),
+        falling back to staging the session's CURRENT host picture when
+        the overlay refuses. The session stays owned by its driver —
+        queries interleave with its churn cycles without touching its WAL
+        or cycle chain."""
+        self._twins[ref] = session
+        return ref
+
+    def detach_twin(self, ref: str) -> None:
+        self._twins.pop(ref, None)
+        self._replicas.pop(ref, None)
+        self._overlay_shapes.pop(ref, None)
+
+    def attach_replica(self, ref: str, follower) -> None:
+        """Route `ref`'s overlay reads through a FollowerTwin replica
+        (stream/replicate): non-diverged standby HBM answers what-if
+        queries first, the leader twin only when every replica refuses.
+        A replica's answer trails the leader by the un-acked WAL tail —
+        bounded staleness, the read-replica contract."""
+        self._replicas.setdefault(ref, []).append(follower)
+
+    def _overlay_plan_ok(self, session, request: WhatIfRequest) -> bool:
+        # overlay answers ride the twin's compiled plan; a request naming
+        # a different policy (or provider) needs the staged path
+        if session.provider != self.provider:
+            return False
+        if request.policy is None:
+            return session.policy is None
+        return request.policy is session.policy
+
+    def try_overlay(self, request: WhatIfRequest
+                    ) -> Optional[Tuple[WhatIfResult, bool, str]]:
+        """Answer a request against the live twin behind its snapshot_ref:
+        (result, compile_cache_hit, path) with path resident|follower, or
+        None when no twin is installed, the request pins its own plan, or
+        every overlay refuses — the caller falls back to stage()."""
+        ref = request.snapshot_ref
+        if request.snapshot is not None or ref is None:
+            return None
+        twin = self._twins.get(ref)
+        if twin is None:
+            return None
+        if not request.pods:
+            raise ServeRejected(REJECT_INVALID,
+                                "request carries an empty pod list")
+        candidates = [(f, "follower") for f in self._replicas.get(ref, ())]
+        candidates.append((twin, "resident"))
+        eligible = False
+        for target, path in candidates:
+            if not self._overlay_plan_ok(_twin_session(target), request):
+                continue
+            eligible = True
+            placements = target.overlay_query(request.pods)
+            if placements is None:
+                continue
+            scheduled = sum(1 for p in placements if p.node_name)
+            result = WhatIfResult(placements=placements,
+                                  scheduled=scheduled,
+                                  unschedulable=len(placements) - scheduled)
+            shapes = self._overlay_shapes.setdefault(ref, set())
+            shape = (_budget(len(request.pods)), path)
+            warm = shape in shapes
+            shapes.add(shape)
+            self.stats["overlay_hits"] += 1
+            self.last_path = None
+            register().serve_dispatch.inc("overlay")
+            note_serve("overlay", {"path": path, "ref": ref,
+                                   "pods": len(request.pods)})
+            return result, warm, path
+        if not eligible:
+            register().overlay_fallback.inc("plan_mismatch")
+        self.stats["overlay_fallbacks"] += 1
+        return None
+
     # -- staging -----------------------------------------------------------
 
     def _policy(self, policy) -> tuple:
@@ -149,11 +242,18 @@ class ServeExecutor:
         return prep
 
     def _resolve_snapshot(self, request: WhatIfRequest) -> ClusterSnapshot:
-        """The base cluster a request runs against — inline snapshot or a
-        registered ref. Raises ServeRejected when neither resolves."""
+        """The base cluster a request runs against — inline snapshot, a
+        live twin's CURRENT host picture, or a registered ref. Raises
+        ServeRejected when none resolves."""
         if request.snapshot is not None:
             return request.snapshot
         if request.snapshot_ref is not None:
+            twin = self._twins.get(request.snapshot_ref)
+            if twin is not None:
+                # staged fallback for a twin ref answers against the SAME
+                # logical state the overlay would have (live, not the
+                # snapshot the twin was born from)
+                return _twin_session(twin).inc.to_snapshot()
             snapshot = self._snapshots.get(request.snapshot_ref)
             if snapshot is None:
                 raise ServeRejected(
@@ -175,8 +275,12 @@ class ServeExecutor:
         # the what-if analog of the fast path's plan_signature: the policy
         # spec is the part of the compiled program identity requests choose
         plan_sig = (self.provider, cp.spec if cp is not None else None)
+        # twin-backed requests resolve to a LIVE snapshot that changes
+        # every cycle — memoizing the staged trees would serve stale state
+        live = (request.snapshot is None
+                and request.snapshot_ref in self._twins)
         memo_key = ((request.cache_key, plan_sig)
-                    if request.cache_key is not None else None)
+                    if request.cache_key is not None and not live else None)
         if memo_key is not None and memo_key in self._staged:
             staged, shape_class = self._staged[memo_key]
             self._staged.move_to_end(memo_key)

@@ -69,6 +69,17 @@ class ScenarioFleet:
     def register_snapshot(self, ref: str, snapshot: ClusterSnapshot) -> str:
         return self.executor.register_snapshot(ref, snapshot)
 
+    def attach_stream(self, session, ref: str = "live") -> str:
+        """Serve `ref` from a live StreamSession's resident twin (ISSUE
+        19): requests naming the ref ride the overlay fast path and fall
+        back to staging the session's current host picture."""
+        return self.executor.attach_twin(ref, session)
+
+    def attach_replica(self, follower, ref: str = "live") -> None:
+        """Serve `ref`'s overlay reads from a FollowerTwin replica first
+        (standby HBM), the leader twin only when the replica refuses."""
+        self.executor.attach_replica(ref, follower)
+
     # -- admission ---------------------------------------------------------
 
     def _reject(self, request: WhatIfRequest, reason: str,
@@ -131,6 +142,23 @@ class ScenarioFleet:
                 "staging"))
             return
         try:
+            hit = self.executor.try_overlay(request)
+            if hit is not None:
+                # the live twin answered in O(scenario): resolve now —
+                # overlay queries never bucket (nothing to batch; the
+                # resident program already ran)
+                result, warm, path = hit
+                latency = self._clock() - admitted_at
+                reg = register()
+                reg.serve_request_latency.observe(latency * 1e6)
+                slo.observe_cycle("serve", latency * 1e6)
+                note_serve("overlay_resolve", {"id": request.request_id,
+                                               "path": path})
+                future.set_result(WhatIfResponse(
+                    request_id=request.request_id, result=result,
+                    bucket_real=1, bucket_ghosts=0, compile_cache_hit=warm,
+                    latency_s=latency, degraded=None))
+                return
             with span("serve:stage") as sp:
                 if sp:
                     sp.set("id", request.request_id)

@@ -984,7 +984,9 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
                           checkpoint_every: int = 0,
                           fsync_every: int = 0,
                           replicate_to: Optional[tuple] = None,
-                          recover: bool = False) -> dict:
+                          recover: bool = False,
+                          whatif_every: int = 0,
+                          whatif_pods: int = 4) -> dict:
     """Drive a StreamSession through seeded churn (tpusim.stream.ChurnLoadGen)
     and return a summary dict — the `tpusim stream` CLI, the bench's configs
     9/10, and the smoke variants all sit on this loop.
@@ -1032,6 +1034,16 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         WalShipper to the journal (requires checkpoint_dir) and drain it
         before returning; the summary grows replication_{drained,
         acked_seq, lag_at_close} (ISSUE 18).
+    whatif_every: every N cycles, answer a live what-if query against the
+        device-resident twin via StreamSession.overlay_query — a
+        copy-on-write overlay (mark -> scatter scenario pods -> scan ->
+        roll back) that leaves the carry byte-identical, so the run's
+        fold_chain is unchanged by the queries (ISSUE 19). The summary
+        grows an ``overlay`` block: queries/answered/fallbacks and query
+        latency percentiles. 0 disables.
+    whatif_pods: scenario pods per live query (drawn from a dedicated
+        rng stream, deterministic per seed, never entering the churn
+        picture).
     """
     from tpusim.api.snapshot import synthetic_cluster
     from tpusim.backends import Placement, bind_pod, get_backend, \
@@ -1167,6 +1179,29 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         if verify and expected_hashes.pop(0) != h:
             mismatches += 1
 
+    # live what-if arm (ISSUE 19): a dedicated rng stream so the query
+    # pods never perturb the churn draw, and per-query latency tracking
+    from numpy.random import RandomState as _RandomState
+    whatif_rng = _RandomState(seed + 9173) if whatif_every else None
+    whatif_lat: List[float] = []
+    whatif_stats = {"queries": 0, "answered": 0, "fallbacks": 0}
+
+    def live_query(cycle: int) -> None:
+        from tpusim.api.snapshot import make_pod
+
+        qpods = [make_pod(f"whatif-c{cycle}-p{i}",
+                          milli_cpu=int(whatif_rng.randint(100, 1500)),
+                          memory=int(whatif_rng.randint(2 ** 20, 2 ** 30)))
+                 for i in range(whatif_pods)]
+        whatif_stats["queries"] += 1
+        tq = perf_counter()
+        answered = session.overlay_query(qpods)
+        if answered is None:
+            whatif_stats["fallbacks"] += 1
+        else:
+            whatif_stats["answered"] += 1
+            whatif_lat.append(perf_counter() - tq)
+
     t_start = perf_counter()
     clean_exit = False
     try:
@@ -1216,6 +1251,11 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
             else:
                 gen.note_bound(prev)
                 account(prev)
+            if whatif_every and (cycle + 1) % whatif_every == 0:
+                # interleave a live read with the churn: the overlay
+                # rolls back to a byte-identical carry, so fold_chain is
+                # provably unchanged vs the query-free run
+                live_query(cycle)
         if pipeline:
             tail = session.flush()
             if tail:
@@ -1255,6 +1295,21 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
         "fold_chain": fold_chain,
         "load": dict(gen.stats),
     }
+    if whatif_every:
+        whatif_lat.sort()
+
+        def qpct(q: float) -> float:
+            if not whatif_lat:
+                return 0.0
+            i = min(len(whatif_lat) - 1,
+                    int(round(q * (len(whatif_lat) - 1))))
+            return whatif_lat[i]
+
+        out["overlay"] = {
+            **whatif_stats,
+            "p50_query_ms": qpct(0.5) * 1e3,
+            "p99_query_ms": qpct(0.99) * 1e3,
+        }
     if verify:
         out["verified"] = mismatches == 0
         out["mismatched_cycles"] = mismatches

@@ -1,6 +1,7 @@
 """Streaming scheduler runtime (ISSUE 7): device-resident cluster state,
 O(delta) scatter updates, classified restage fallbacks; crash recovery
-via WAL + checkpoints (ISSUE 12)."""
+via WAL + checkpoints (ISSUE 12); live what-if overlays + multi-tenant
+residency (ISSUE 19)."""
 
 from tpusim.stream.loadgen import ChurnLoadGen
 from tpusim.stream.persist import (
@@ -27,6 +28,7 @@ from tpusim.stream.runtime import (
     StreamSession,
     bucket_size,
 )
+from tpusim.stream.tenancy import ResidencyBudget, TenantTwin
 
 __all__ = [
     "CRASH_POINTS",
@@ -40,8 +42,10 @@ __all__ = [
     "PromotionReport",
     "RecoveryReport",
     "ReplicationError",
+    "ResidencyBudget",
     "StreamPersistence",
     "StreamSession",
+    "TenantTwin",
     "WalShipper",
     "bucket_size",
     "chain_fold",

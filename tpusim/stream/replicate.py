@@ -27,6 +27,16 @@ Wire protocol — 4-byte big-endian length prefix + one JSON object:
                                           announcements
     {"t":"ack","seq":N,"chain":H}         follower -> shipper: applied
                                           through N, chain head H
+    {"t":"snap","seq":N,"meta":M,"open":B}  shipper -> follower, only
+                                          when the hello carried
+                                          ``"bootstrap": true`` with
+                                          next=0 (ISSUE 19 late join):
+                                          the FULL checkpoint manifest
+                                          (snapshot included) plus any
+                                          admitted-but-unemitted batches
+                                          below its WAL offset; the
+                                          follower rebuilds from it and
+                                          resumes the stream at N+1
 
 Sequence numbers are assigned by the shipper in append order; acks are
 cumulative. Reconnect-with-resume is the follower's ``hello``: the
@@ -233,6 +243,10 @@ class WalShipper:
                 if hello is None or hello.get("t") != "hello":
                     continue
                 cursor = int(hello.get("next", 0))
+                if hello.get("bootstrap") and cursor == 0:
+                    snap_fr, cursor = self._bootstrap_frame()
+                    if snap_fr is not None:
+                        _send_frame(sock, snap_fr)
                 ack_thread = threading.Thread(
                     target=self._ack_loop, args=(reader,), daemon=True)
                 ack_thread.start()
@@ -256,6 +270,47 @@ class WalShipper:
                     sock.close()
                 except OSError:
                     pass
+
+    def _bootstrap_frame(self) -> Tuple[Optional[dict], int]:
+        """Snapshot bootstrap for a late-joining follower (ISSUE 19).
+
+        A follower that was not up at leader start has no cycle-0
+        snapshot to replay from, and the shipper's frame log only goes
+        back to its own attach. Instead of replaying history, ship the
+        leader's latest durable checkpoint manifest (which carries the
+        full host snapshot) plus the WAL byte offset it is consistent
+        with: the follower rebuilds a warm session from the manifest and
+        resumes the live stream from the first frame PAST that offset.
+        Admitted-but-unemitted batches below the cut (a pipelined
+        in-flight cycle) ride along so the later bind/emit records find
+        their arrivals. Assumes the shipper was attached before any
+        record landed past the manifest's offset — true for the standard
+        wiring where attach() cuts the genesis checkpoint and the
+        shipper is constructed immediately after.
+        """
+        try:
+            with open(self.persist.checkpoint_path, "r",
+                      encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None, 0
+        wal_ofs = int(manifest.get("wal_offset", 0))
+        with self._cond:
+            frames = list(self._frames)
+            meta = list(self._meta)
+        cursor = len(frames)
+        for i, (_t_enq, ofs, is_rec) in enumerate(meta):
+            if is_rec and ofs > wal_ofs:
+                cursor = i
+                break
+        emitted = {int(fr["rec"]["c"]) for fr in frames[:cursor]
+                   if fr["t"] == "rec" and fr["rec"]["k"] == "emit"}
+        open_batches = [[int(fr["rec"]["c"]), fr["rec"]["pods"]]
+                        for fr in frames[:cursor]
+                        if fr["t"] == "rec" and fr["rec"]["k"] == "batch"
+                        and int(fr["rec"]["c"]) not in emitted]
+        return ({"t": "snap", "seq": cursor - 1, "meta": manifest,
+                 "open": open_batches}, cursor)
 
     def _ack_loop(self, reader) -> None:
         try:
@@ -371,9 +426,19 @@ class FollowerTwin:
     def __init__(self, snapshot=None, *, incremental=None,
                  provider: str = DEFAULT_PROVIDER, policy=None,
                  always_restage: bool = False,
-                 listen: Tuple[str, int] = ("127.0.0.1", 0)):
+                 listen: Tuple[str, int] = ("127.0.0.1", 0),
+                 bootstrap: bool = False):
         from tpusim.stream.runtime import StreamSession
 
+        self._provider = provider
+        self._policy = policy
+        self._always_restage = always_restage
+        # late join (ISSUE 19): ask the shipper for the leader's latest
+        # checkpoint manifest + WAL offset in the hello exchange instead
+        # of requiring the leader's cycle-0 snapshot source; the session
+        # below starts empty and is rebuilt from the shipped manifest
+        self._bootstrap = bootstrap
+        self.bootstrapped = False
         self.session = StreamSession(snapshot, incremental=incremental,
                                      provider=provider, policy=policy,
                                      always_restage=always_restage)
@@ -430,13 +495,26 @@ class FollowerTwin:
     def _pump(self, conn: socket.socket) -> None:
         reader = conn.makefile("rb")
         with self._lock:
-            _send_frame(conn, {"t": "hello", "next": self.applied_seq + 1,
-                               "chain": self.chain})
+            hello = {"t": "hello", "next": self.applied_seq + 1,
+                     "chain": self.chain}
+            if self._bootstrap and self.applied_seq < 0:
+                hello["bootstrap"] = True
+            _send_frame(conn, hello)
         while True:
             fr = _read_frame(reader)
             if fr is None:
                 return
             t0 = perf_counter()
+            if fr.get("t") == "snap":
+                with self._lock:
+                    if self._stop:
+                        return
+                    self._apply_bootstrap(fr)
+                    seq, chain = self.applied_seq, self.chain
+                if seq >= 0:
+                    _send_frame(conn, {"t": "ack", "seq": seq,
+                                       "chain": chain})
+                continue
             seq = int(fr.get("seq", -1))
             with self._lock:
                 if self._stop:
@@ -454,6 +532,56 @@ class FollowerTwin:
             register().replication_apply_latency.observe(
                 since_in_microseconds(t0))
             _send_frame(conn, {"t": "ack", "seq": seq, "chain": chain})
+
+    def _apply_bootstrap(self, fr: dict) -> None:
+        """Late join: rebuild the twin from the shipped checkpoint
+        manifest instead of a cycle-0 snapshot, then resume the live
+        stream from the first frame past the manifest's WAL offset."""
+        if not self._bootstrap or self.applied_seq >= 0:
+            return   # unsolicited or duplicate snap frame
+        from tpusim.api.snapshot import ClusterSnapshot
+        from tpusim.stream.runtime import StreamSession
+
+        meta = fr.get("meta") or {}
+        snapshot = ClusterSnapshot.from_obj(meta["snapshot"])
+        self.session = StreamSession(snapshot, provider=self._provider,
+                                     policy=self._policy,
+                                     always_restage=self._always_restage)
+        self.chain = str(meta.get("chain", ""))
+        ck_cycle = int(meta.get("cycle", 0))
+        self.cycles_emitted = ck_cycle
+        self.chain_history = {0: "", ck_cycle: self.chain}
+        self.decisions = int(meta.get("decisions", 0))
+        self.scheduled = int(meta.get("scheduled", 0))
+        self.next_cycle = int(meta.get("next_cycle", 0))
+        self.shard_layout = meta.get("shard_layout") or self.shard_layout
+        self.durability = meta.get("durability") or self.durability
+        self.applied_ofs = int(meta.get("wal_offset", 0))
+        self.wal_records_applied = int(meta.get("wal_records", 0))
+        self.applied_seq = int(fr.get("seq", -1))
+        self.batches = {int(c): [Pod.from_obj(o) for o in pods]
+                        for c, pods in (fr.get("open") or [])}
+        for c in self.batches:
+            self.next_cycle = max(self.next_cycle, c + 1)
+        self.bound_by_cycle = {}
+        self._live_pending = {}
+        self.bootstrapped = True
+        flight.note_route("follower_bootstrap", len(self.batches))
+
+    # -- read replica (ISSUE 19) -------------------------------------------
+
+    def overlay_query(self, pods) -> Optional[List[Placement]]:
+        """Serve a live what-if from the standby's warm twin: overlay
+        queries are read-only (mark -> scan -> rollback leaves the carry
+        byte-identical to pre-mark), so a non-diverged follower answers
+        them without perturbing replay. Serialises with the apply loop
+        under the twin lock; returns None when the replica cannot answer
+        (diverged, stopped, or the overlay itself refused)."""
+        with self._lock:
+            if self._stop or self.diverged is not None:
+                register().overlay_fallback.inc("replica_unavailable")
+                return None
+            return self.session.overlay_query(pods, _path="follower")
 
     def _diverge(self, msg: str) -> None:
         if self.diverged is None:

@@ -91,6 +91,7 @@ from tpusim.jaxe.kernels import (
     apply_statics_delta_donated,
     carry_init_host,
     config_for,
+    overlay_restore_donated,
     pad_infeasible_rows,
     pod_columns_to_host,
     schedule_scan_donated,
@@ -1153,6 +1154,219 @@ class StreamSession:
         self._pending = _PendingCycle(pods, choices, counts, dev.compiled,
                                       t0, perf_counter(),
                                       wal_cycle=wal_cycle)
+
+    # -- overlay what-if queries (ISSUE 19) -------------------------------
+
+    def overlay_query(self, pods: List[Pod],
+                      _path: str = "resident") -> Optional[List[Placement]]:
+        """Answer a what-if query against the LIVE resident twin in
+        O(scenario): fork the donated carry behind a journal mark,
+        scatter-commit pending churn exactly like the next real cycle
+        would (authoritative and idempotent — the restored journal makes
+        that cycle's commit a byte-identical re-scatter), run the fused
+        scan over the query batch, decode placements, and roll the carry
+        back to host truth (kernels.overlay_restore_donated over the nodes
+        the query bound, per-batch lanes restored from pre-mark copies).
+        The query batch is never folded back: WAL, persistence, restage
+        classification and the cycle chain are untouched, and placements
+        are placement-hash-identical to staging inc.to_snapshot() plus the
+        query through whatif.run_what_if (the stream-vs-restage parity
+        contract applied to a batch that never binds).
+
+        Returns None when the query cannot ride the resident twin — no
+        residency, a restage-class change (novel scalar/signature/group/
+        policy columns), gang semantics, or a chaos-seam intervention
+        mid-query — and the caller (serve.ServeExecutor) falls back to
+        the staged path. A restage reason discovered here is latched via
+        force_restage so the next real cycle classifies it exactly as
+        _route would have."""
+        if not pods:
+            return []
+        t0 = perf_counter()
+        routed = self._overlay_route(pods)
+        if isinstance(routed, str):
+            register().overlay_fallback.inc(routed)
+            flight.note_route("overlay_fallback", len(pods))
+            return None
+        placements = self._overlay_dispatch(pods, routed)
+        if placements is None:
+            return None
+        m = register()
+        m.overlay_queries.inc(_path)
+        m.overlay_latency.observe(since_in_microseconds(t0))
+        return placements
+
+    def _overlay_route(self, pods: List[Pod]):
+        """The overlay twin of _route: prove the resident arrays can serve
+        the query batch WITHOUT perturbing the live session. Returns the
+        batch's remapped PodColumns on success, else the fallback reason.
+        Stricter than _route — any condition a real cycle would restage
+        over is a refusal here (the staged path answers instead), plus the
+        configs whose carry fields have no rollback path."""
+        inc = self.inc
+        dev = self.device
+        if self._pending is not None and self._pending.placements is None:
+            # pipelined in-flight cycle: fold its binds into the host
+            # picture first so the mark below brackets the same logical
+            # state the resident carry already holds
+            self._fold_binds(self._pending)
+        if self._forced is not None or self.always_restage:
+            return "forced_restage"
+        if not inc.nodes:
+            return "no_nodes"
+        if has_gangs(pods):
+            return "gang_semantics"
+        breaker = _backend._CHAOS["breaker"]
+        if breaker is not None and (breaker.probing
+                                    or _backend._CHAOS["verify"] == "all"
+                                    or not breaker.allow()):
+            # probe/verify cycles carry a host-parity obligation the
+            # overlay cannot discharge; an open breaker denies dispatch
+            return "breaker_open"
+        reason = dev.residency_miss(inc, self._plan_key)
+        if reason is not None:
+            return reason
+        if dev.config.has_interpod or dev.config.has_maxpd:
+            # presence_dom / used_vols have no overlay rollback path
+            return "no_rollback_path"
+        n_scalars = len(inc._scalar_names)
+        cols, key_lists = inc._batch_columns(pods)
+        if len(inc._scalar_names) != n_scalars:
+            # the QUERY widened the scalar universe: un-note the synthetic
+            # names (no live object references them — _note_scalar only
+            # appends) so the live session keeps its resident width
+            for name in inc._scalar_names[n_scalars:]:
+                del inc._scalar_idx[name]
+            del inc._scalar_names[n_scalars:]
+            if inc._statics is not None:
+                inc._statics.alloc_scalar = \
+                    inc._statics.alloc_scalar[:, :n_scalars]
+            if inc._dyn is not None:
+                inc._dyn.used_scalar = inc._dyn.used_scalar[:, :n_scalars]
+            return "scalar_set"
+        reason = dev.remap_signatures(inc, cols, key_lists)
+        if reason is not None:
+            return reason
+        if not inc.assign_group_ids(cols, pods):
+            return "group_shape"
+        if self.cp is not None:
+            reason = remap_policy_columns(self.cp, dev.pol_res, pods, cols)
+            if reason is not None:
+                return reason
+        reason = self._prepare_statics_delta()
+        if reason is not None:
+            # the column journal cannot land as a scatter: the next REAL
+            # cycle must restage for it, classified exactly as _route
+            # would have classified it
+            self.force_restage(reason)
+            return reason
+        return cols
+
+    def _overlay_dispatch(self, pods: List[Pod],
+                          cols) -> Optional[List[Placement]]:
+        """The mark → commit → scan → decode → rollback bracket. Pending
+        churn is early-committed (the next real commit re-scatters the
+        same authoritative rows, so the cycle chain is byte-unchanged);
+        the per-batch lanes (sa_lock/rr) are saved host-side before the
+        donation destroys them and restored verbatim on rollback, so with
+        an empty journal the post-rollback carry is byte-identical to
+        pre-mark. Chaos interventions (DeviceFault, scripted corruption)
+        drop the overlay: journal rolled back, residency invalidated, None
+        returned — the next real cycle re-arms from host truth."""
+        inc = self.inc
+        dev = self.device
+        injector = _backend._CHAOS["injector"]
+        breaker = _backend._CHAOS["breaker"]
+        mark = inc.journal_mark()
+        rr_save = np.asarray(dev.carry.rr)
+        sa_save = np.asarray(dev.carry.sa_lock)
+        try:
+            self._apply_statics_patch()
+            dev.commit(inc, self._commit_sa_lock())
+            corrupt_kind = (injector.begin_dispatch()
+                            if injector is not None else None)
+            p = len(pods)
+            xs_host = pad_infeasible_rows(pod_columns_to_host(cols),
+                                          bucket_size(p) - p)
+            with flight.span("overlay_scan", "device"):
+                final_carry, choices, counts, _adv = self._scan(
+                    dev.config, dev.carry, dev.statics,
+                    self._stage_xs(xs_host))
+            dev.carry = final_carry
+            choices = np.asarray(choices)[:p]
+            counts = np.asarray(counts)[:p]
+        except Exception as exc:
+            inc.journal_rollback(mark)
+            dev.invalidate()
+            from tpusim.chaos.engine import DeviceFault
+            if isinstance(exc, DeviceFault):
+                if breaker is not None:
+                    breaker.record_failure(f"{type(exc).__name__}: {exc}")
+                register().overlay_fallback.inc("device_fault")
+                flight.note_route("overlay_fallback", len(pods))
+                return None
+            raise
+        if corrupt_kind is not None:
+            # the reported choices may not be the device's true decisions,
+            # so the row-wise restore below cannot be trusted to cover
+            # every bound node — drop residency instead (the next real
+            # cycle restages from host truth, placements unchanged by the
+            # restage-parity contract)
+            inc.journal_rollback(mark)
+            dev.invalidate()
+            register().overlay_fallback.inc("corruption")
+            flight.note_route("overlay_fallback", len(pods))
+            return None
+        self._overlay_rollback(cols, choices, mark, sa_save, rr_save)
+        if breaker is not None:
+            breaker.record_success()
+        strings = reason_strings(dev.compiled.scalar_names)
+        with flight.span("overlay_decode"):
+            placements, _ = _backend.decode_placements(
+                pods, choices, counts, dev.compiled.statics.names, strings)
+        provenance.capture(placements, "overlay", cycle=self.cycles)
+        return placements
+
+    def _overlay_rollback(self, cols, choices: np.ndarray, mark,
+                          sa_save: np.ndarray, rr_save: np.ndarray) -> None:
+        """Scatter the query's bound rows back to host truth — the exact
+        gather commit() performs, restricted to the nodes the query bound
+        (the query never touched inc, so inc._dyn/_presence still hold the
+        pre-query authoritative values) — and restore the journal mark.
+        Rides the same pow2 buckets as commit, so warm query shapes reuse
+        one compiled restore program."""
+        inc = self.inc
+        dev = self.device
+        bound = sorted({int(c) for c in choices if int(c) >= 0})
+        dyn = inc._ensure_dyn()
+        idx = np.fromiter(bound, dtype=np.int32, count=len(bound))
+        idx = _pad_index(idx, bucket_size(max(len(idx), 1)))
+        rows = DeltaRows(
+            used_cpu=dyn.used_cpu[idx], used_mem=dyn.used_mem[idx],
+            used_gpu=dyn.used_gpu[idx], used_eph=dyn.used_eph[idx],
+            used_scalar=dyn.used_scalar[idx],
+            nonzero_cpu=dyn.nonzero_cpu[idx],
+            nonzero_mem=dyn.nonzero_mem[idx],
+            pod_count=dyn.pod_count[idx])
+        cell_list = sorted({(int(cols.group_id[j]), int(c))
+                            for j, c in enumerate(choices) if int(c) >= 0})
+        gid = np.fromiter((g for g, _ in cell_list), dtype=np.int32,
+                          count=len(cell_list))
+        nid = np.fromiter((n for _, n in cell_list), dtype=np.int32,
+                          count=len(cell_list))
+        size = bucket_size(max(len(gid), 1))
+        gid, nid = _pad_index(gid, size), _pad_index(nid, size)
+        if inc._presence is not None:
+            val = inc._presence[gid, nid].astype(np.int32)
+        else:
+            val = np.zeros(size, np.int32)
+        sp = flight.span("overlay_rollback", "device")
+        dev.carry = overlay_restore_donated(dev.carry, idx, rows, gid, nid,
+                                            val, sa_save, rr_save)
+        if sp:
+            sp.set("rows", int(len(bound)))
+            sp.end()
+        inc.journal_rollback(mark)
 
     # -- accounting -------------------------------------------------------
 

@@ -1,0 +1,230 @@
+"""Multi-tenant residency ledger (ISSUE 19): many live twins, one HBM.
+
+A serving deployment keeps one device-resident twin per tenant cluster so
+overlay what-if queries answer in O(scenario), but HBM is finite: the
+ResidencyBudget holds every tenant's footprint under a byte budget by
+evicting the coldest twin to its checkpoint directory (ISSUE 11's
+StreamPersistence) and restoring it on demand in O(WAL-tail) via
+recover_stream_session. Eviction is a clean handoff, not a loss:
+
+  evict    flush() the pipelined tail -> checkpoint() (durable manifest +
+           device arrays' host truth) -> close the WAL -> drop the session.
+           The twin's placement-hash chain head is in the manifest.
+  restore  recover_stream_session on the same directory: checkpoint load +
+           WAL-tail replay rebuilds the host picture; the next cycle
+           restages classified ``recovered``. The chain head folds forward
+           from exactly where eviction cut it.
+
+Footprints ride PR 14's HBM residency fabric: each tenant registers a
+``tenant_twin`` source with per-tenant byte attribution
+(tpusim_hbm_resident_bytes{component="tenant_twin"} +
+analytics.hbm_snapshot()["tenant_twin"]["tenants"]), and the ledger's own
+families (tpusim_tenant_*) expose evictions, restores, restore latency,
+and the per-tenant resident bytes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from tpusim.api.snapshot import ClusterSnapshot
+from tpusim.engine.providers import DEFAULT_PROVIDER
+from tpusim.framework.metrics import register, since_in_microseconds
+from tpusim.obs import analytics
+from tpusim.obs import recorder as flight
+
+
+class TenantTwin:
+    """One tenant's slot in the ledger: the live session + persistence
+    while resident, the checkpoint directory always. The record object is
+    the stable owner of the tenant's HBM source across evict/restore
+    round-trips (analytics weakrefs it, so dropping the ledger drops the
+    source)."""
+
+    def __init__(self, name: str, directory: str, provider: str,
+                 policy, always_restage: bool, checkpoint_every: int,
+                 fsync_every: int):
+        self.name = name
+        self.directory = directory
+        self.provider = provider
+        self.policy = policy
+        self.always_restage = always_restage
+        self.checkpoint_every = checkpoint_every
+        self.fsync_every = fsync_every
+        self.session = None
+        self.persist = None
+        self.last_used = 0.0
+        self.evictions = 0
+        self.restores = 0
+
+    @property
+    def resident(self) -> bool:
+        # ledger residency, not device validity: a freshly restored
+        # session holds host truth but restages its twin lazily on the
+        # first cycle (nbytes() is 0 until then — honest accounting)
+        return self.session is not None
+
+    def nbytes(self) -> int:
+        """Device bytes this tenant holds resident right now."""
+        if self.session is None:
+            return 0
+        dev = self.session.device
+        if not dev.valid:
+            return 0
+        return analytics.tree_nbytes((dev.statics, dev.carry))
+
+    def chain(self) -> str:
+        """The tenant's placement-hash chain head: live from the attached
+        persistence, or the durable manifest's when evicted."""
+        if self.persist is not None:
+            return self.persist.chain
+        import json
+        import os
+
+        from tpusim.stream.persist import StreamPersistence
+
+        path = os.path.join(self.directory, StreamPersistence.CHECKPOINT)
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)["chain"]
+
+
+class ResidencyBudget:
+    """LRU ledger over tenant twins under an HBM byte budget. Touching a
+    tenant (session()/overlay_query()/schedule()) restores it on demand
+    and may evict colder tenants to stay under budget; the toucher itself
+    is never its own victim."""
+
+    def __init__(self, budget_bytes: int, *, clock=time.monotonic):
+        self.budget_bytes = int(budget_bytes)
+        self._clock = clock
+        self._tenants: Dict[str, TenantTwin] = {}
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, name: str, snapshot: Optional[ClusterSnapshot] = None,
+              *, directory: str, provider: str = DEFAULT_PROVIDER,
+              policy=None, always_restage: bool = False,
+              checkpoint_every: int = 0, fsync_every: int = 0):
+        """Bring a tenant under the ledger: a fresh StreamSession over its
+        snapshot, persistence attached in `directory` (the eviction
+        target), and a per-tenant HBM source. Returns the session."""
+        if name in self._tenants:
+            raise KeyError(f"tenant {name!r} already admitted")
+        from tpusim.stream.persist import StreamPersistence
+        from tpusim.stream.runtime import StreamSession
+
+        t = TenantTwin(name, directory, provider, policy, always_restage,
+                       checkpoint_every, fsync_every)
+        t.session = StreamSession(snapshot, provider=provider, policy=policy,
+                                  always_restage=always_restage)
+        t.persist = StreamPersistence(directory,
+                                      checkpoint_every=checkpoint_every,
+                                      fsync_every=fsync_every)
+        t.persist.attach(t.session)
+        t.last_used = self._clock()
+        self._tenants[name] = t
+        analytics.register_hbm_source(
+            "tenant_twin", t, lambda tw: (tw.nbytes(), 1 if tw.resident
+                                          else 0), tenant=name)
+        self._enforce(protect=name)
+        self._observe()
+        return t.session
+
+    def tenants(self) -> List[str]:
+        return list(self._tenants)
+
+    def resident(self, name: str) -> bool:
+        return self._tenants[name].resident
+
+    def chain(self, name: str) -> str:
+        return self._tenants[name].chain()
+
+    def total_bytes(self) -> int:
+        return sum(t.nbytes() for t in self._tenants.values())
+
+    # -- the serving surface ----------------------------------------------
+
+    def session(self, name: str):
+        """The tenant's live session — restored from its checkpoint + WAL
+        tail first if evicted. Touching reorders the LRU and may evict a
+        colder tenant to fund the restore."""
+        t = self._tenants[name]
+        if t.session is None:
+            self.restore(name)
+        t.last_used = self._clock()
+        self._enforce(protect=name)
+        self._observe()
+        return t.session
+
+    def overlay_query(self, name: str, pods):
+        return self.session(name).overlay_query(pods)
+
+    def schedule(self, name: str, pods):
+        return self.session(name).schedule(pods)
+
+    # -- eviction / restore ------------------------------------------------
+
+    def evict(self, name: str, reason: str = "manual") -> None:
+        """Quiesce + checkpoint the tenant's twin and release its HBM: the
+        durable manifest (chain head, WAL offset, host snapshot) is the
+        whole twin — restore() rebuilds byte-equivalent host truth from
+        it."""
+        t = self._tenants[name]
+        if t.session is None:
+            return
+        t.session.flush()          # drain any pipelined in-flight cycle
+        t.persist.checkpoint()
+        t.persist.close()
+        t.session.device.invalidate()
+        t.session = None
+        t.persist = None
+        t.evictions += 1
+        register().tenant_evictions.inc(reason)
+        flight.note_route("tenant_evict", 0)
+        self._observe()
+
+    def restore(self, name: str) -> None:
+        """recover_stream_session over the tenant's directory: checkpoint
+        load + WAL-tail replay, O(tail) not O(history). The session's next
+        cycle restages classified ``recovered``; the chain head continues
+        from the eviction manifest."""
+        t = self._tenants[name]
+        if t.session is not None:
+            return
+        from tpusim.stream.persist import recover_stream_session
+
+        t0 = time.perf_counter()
+        session, _report, persist = recover_stream_session(
+            t.directory, provider=t.provider, policy=t.policy,
+            always_restage=t.always_restage,
+            checkpoint_every=t.checkpoint_every)
+        t.session = session
+        t.persist = persist
+        t.restores += 1
+        m = register()
+        m.tenant_restores.inc()
+        m.tenant_restore_latency.observe(since_in_microseconds(t0))
+        self._observe()
+
+    def _enforce(self, protect: Optional[str] = None) -> None:
+        """Evict coldest-first until the ledger fits the budget. The
+        protected tenant (the one being touched) is exempt — a single
+        over-budget twin stays resident rather than thrashing."""
+        while self.total_bytes() > self.budget_bytes:
+            victims = sorted(
+                (t for t in self._tenants.values()
+                 if t.resident and t.name != protect),
+                key=lambda t: t.last_used)
+            if not victims:
+                return
+            self.evict(victims[0].name, reason="pressure")
+
+    def _observe(self) -> None:
+        m = register()
+        resident = 0
+        for t in self._tenants.values():
+            nbytes = t.nbytes()
+            resident += 1 if t.resident else 0
+            m.tenant_resident_bytes.set(t.name, float(nbytes))
+        m.tenant_resident_twins.set(float(resident))

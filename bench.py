@@ -1231,6 +1231,50 @@ def _provenance_overhead(run_fn) -> dict:
     }
 
 
+def _tracing_overhead(run_fn) -> dict:
+    """A/B the distributed-tracing cost (ISSUE 20 budgets <2%): the
+    identical workload with and without an installed FlightRecorder —
+    with tracing on, every cycle allocates a TraceContext, stamps every
+    span/instant, and the latency observes carry exemplars. Also pins
+    the zero-interference contract: the placement chain must be
+    byte-identical across the two arms.
+
+    The stamp is the MEDIAN delta over adjacent off/on pairs: a traced
+    run records ~1e2 span stamps total, so the real cost is far below a
+    percent, but on a contended host a lone sub-second pair swings by
+    >10% either way. Pairing adjacent runs cancels slow drift, the
+    median rejects excursions, and the artifact keeps every pair delta
+    so a noisy-host stamp is diagnosable as such (the accelerator bench
+    shapes run multi-second arms where the median resolves cleanly)."""
+    from tpusim.obs import recorder as flight
+
+    samples = []
+    chain_identical = True
+    for _ in range(7):
+        off = run_fn()
+        flight.install(flight.FlightRecorder(process_name="tpusim-bench"))
+        try:
+            on = run_fn()
+        finally:
+            flight.uninstall()
+        chain_identical = chain_identical and \
+            on["placement_chain"] == off["placement_chain"]
+        samples.append((
+            (off["decisions_per_s"] - on["decisions_per_s"])
+            / max(off["decisions_per_s"], 1e-9),
+            off["decisions_per_s"], on["decisions_per_s"]))
+    deltas = sorted(s[0] for s in samples)
+    delta, off_rate, on_rate = sorted(samples)[len(samples) // 2]
+    return {
+        "off_decisions_per_s": round(off_rate, 1),
+        "on_decisions_per_s": round(on_rate, 1),
+        "overhead_fraction": round(delta, 4),
+        "pair_deltas": [round(d, 4) for d in deltas],
+        "within_budget": delta < 0.02,
+        "chain_identical": chain_identical,
+    }
+
+
 def measure_stream_churn(platform: str) -> dict:
     """Config 9: streaming-runtime churn (tpusim/stream). Three sweeps:
 
@@ -1314,6 +1358,16 @@ def measure_stream_churn(platform: str) -> dict:
         f"{provenance_overhead['overhead_fraction'] * 100:.2f}% "
         f"(within_budget={provenance_overhead['within_budget']})")
 
+    warm_up(mid)
+    tracing_overhead = _tracing_overhead(
+        lambda: run_stream_simulation(num_nodes=mid, cycles=cycles,
+                                      arrivals=arrivals, evict_fraction=0.25,
+                                      seed=9))
+    log(f"[config 9] tracing overhead: "
+        f"{tracing_overhead['overhead_fraction'] * 100:.2f}% "
+        f"(within_budget={tracing_overhead['within_budget']}, "
+        f"chain_identical={tracing_overhead['chain_identical']})")
+
     headline = size_curve[sizes.index(mid)]
     return {
         "metric": f"churn decisions/sec (config 9: streaming runtime, "
@@ -1337,6 +1391,7 @@ def measure_stream_churn(platform: str) -> dict:
             size_curve[-1]["staging_overhead_ms"]
             / max(size_curve[0]["staging_overhead_ms"], 1e-9), 2),
         "provenance_overhead": provenance_overhead,
+        "tracing_overhead": tracing_overhead,
         "metrics": _metrics_snapshot(reset=True),
     }
 
@@ -1471,6 +1526,13 @@ def measure_policy_stream(platform: str) -> dict:
         f"{provenance_overhead['overhead_fraction'] * 100:.2f}% "
         f"(within_budget={provenance_overhead['within_budget']})")
 
+    warm_up(mid)
+    tracing_overhead = _tracing_overhead(lambda: run(mid))
+    log(f"[config 10] tracing overhead: "
+        f"{tracing_overhead['overhead_fraction'] * 100:.2f}% "
+        f"(within_budget={tracing_overhead['within_budget']}, "
+        f"chain_identical={tracing_overhead['chain_identical']})")
+
     headline = size_curve[sizes.index(mid)]
     return {
         "metric": f"pipelined policy-stream decisions/sec (config 10: "
@@ -1488,6 +1550,7 @@ def measure_policy_stream(platform: str) -> dict:
         "churn_curve": churn_curve,
         "size_curve": size_curve,
         "provenance_overhead": provenance_overhead,
+        "tracing_overhead": tracing_overhead,
         "metrics": _metrics_snapshot(reset=True),
     }
 

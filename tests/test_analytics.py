@@ -225,9 +225,13 @@ def test_sample_throttle():
 
 def test_ring_bounded_and_endpoints_concurrent():
     from tpusim.obs import provenance
+    from tpusim.obs import recorder as flight
     from tpusim.obs.server import ObsServer
 
     provenance.install(provenance.ProvenanceLog(capacity=256))
+    # a deliberately tiny flight-recorder ring (ISSUE 20): the stream
+    # writers overflow it while /debug/trace readers hammer the tail
+    recorder = flight.install(flight.FlightRecorder(max_events=8))
     log = analytics.install(analytics.ClusterAnalytics(
         capacity=8, sample_interval_s=0.0))
     server = ObsServer().start()
@@ -251,6 +255,7 @@ def test_ring_bounded_and_endpoints_concurrent():
     readers = [threading.Thread(target=hammer, args=(p, j), daemon=True)
                for p, j in (("/analytics?limit=5", True),
                             ("/debug/provenance?limit=10", True),
+                            ("/debug/trace?limit=20", True),
                             ("/metrics", False))]
     try:
         for t in readers:
@@ -258,12 +263,22 @@ def test_ring_bounded_and_endpoints_concurrent():
         for seed in (7, 8):  # writers: stream cycles racing the readers
             _stream()
         assert not failures, failures
+        with urllib.request.urlopen(f"{server.url}/debug/trace?limit=20",
+                                    timeout=5) as resp:
+            trace_body = json.loads(resp.read().decode())
     finally:
         stop.set()
         for t in readers:
             t.join(timeout=5)
         server.stop()
         provenance.uninstall()
+        flight.uninstall()
+    # the trace ring stayed bounded under the write load and said so
+    assert trace_body["enabled"]
+    assert len(trace_body["events"]) <= 20
+    assert len(recorder.events) <= 8
+    assert recorder.dropped > 0
+    assert trace_body["dropped_by_category"]
     assert len(log.samples()) <= 8          # ring bounded at capacity
     assert log.snapshot()["samples"] > 8    # ...though more were captured
     body = log.snapshot()
@@ -283,6 +298,22 @@ def test_analytics_endpoint_disabled_body():
         server.stop()
     assert body["enabled"] is False
     assert "hbm" in body and "compile" in body
+
+
+def test_trace_endpoint_disabled_body():
+    from tpusim.obs import recorder as flight
+    from tpusim.obs.server import ObsServer
+
+    flight.uninstall()
+    server = ObsServer().start()
+    try:
+        with urllib.request.urlopen(f"{server.url}/debug/trace",
+                                    timeout=5) as resp:
+            body = json.loads(resp.read().decode())
+    finally:
+        server.stop()
+    assert body == {"enabled": False, "events": [], "dropped": 0,
+                    "dropped_by_category": {}}
 
 
 # -- JSONL export -----------------------------------------------------------

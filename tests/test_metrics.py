@@ -190,7 +190,7 @@ class TestExpositionConformance:
         m.slo_cycles.inc("ok")
         m.slo_burn_rate.set(0.25)
         m.stream_chain_head.set_info(head="abc123", cycle="7")
-        m.obs_dropped_events.inc(3)
+        m.obs_dropped_events.inc("host", 3)
         return m
 
     def test_every_family_has_help_and_type(self):
@@ -295,8 +295,9 @@ class TestFlightRecorderRing:
             rec.instant(f"e{i}", "host")
         assert len(rec.events) == 4
         assert rec.dropped == 6
+        assert rec.dropped_by_category == {"host": 6}
         assert [e["name"] for e in rec.events] == ["e6", "e7", "e8", "e9"]
-        assert register().obs_dropped_events.value == 6
+        assert register().obs_dropped_events.get("host") == 6
 
     def test_default_capacity_is_large(self):
         from tpusim.obs.recorder import FlightRecorder

@@ -55,9 +55,13 @@ class TestFlightRecorder:
         assert [e["tid"] for e in rec.events] == [2, 2]
         inst = rec.events[1]
         assert inst["ph"] == "i" and inst["s"] == "g"
-        # unknown category falls back to the tool track
+        # unknown category gets its own registered track (ISSUE 20), not
+        # the shared tool lane — and the track is named in the export
         rec.span("odd", "mystery").end()
-        assert rec.events[2]["tid"] == 3
+        assert rec.events[2]["tid"] == 4
+        meta = [e for e in rec.to_chrome()["traceEvents"] if e["ph"] == "M"]
+        assert [m["args"]["name"] for m in meta[1:]] == [
+            "host", "device", "tool", "mystery"]
 
     def test_add_span_uses_explicit_readings(self):
         rec = FlightRecorder(clock=_clock())
@@ -190,7 +194,7 @@ class TestChromeValidity:
         doc = json.load(trace.open())
         assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
         for ev in doc["traceEvents"]:
-            assert ev["ph"] in ("X", "i", "M")
+            assert ev["ph"] in ("X", "i", "M", "s", "f")
             assert "ts" in ev and "pid" in ev
             if ev["ph"] == "X":
                 assert ev["dur"] >= 0

@@ -63,13 +63,16 @@ _UNITLESS_GAUGES = {
     # ISSUE 19: the residency ledger's resident-twin count is dimensionless
     # (the per-tenant byte footprint carries units)
     "tpusim_tenant_resident_twins",
+    # ISSUE 20: the /debug/trace ring's event count is dimensionless
+    "tpusim_trace_ring_events",
 }
 # label names whose value sets are finite by construction; anything else
 # (node names, pod names, plan signatures) is unbounded cardinality
 # ("shard" is bounded by TPUSIM_SHARDS <= the device count)
+# ("category" is bounded by the flight recorder's span-category set)
 _BOUNDED_LABELS = {"route", "transition", "path", "reason", "kind",
                    "resource", "verdict", "component", "site", "tenant",
-                   "shard"}
+                   "shard", "category"}
 
 
 def lint_registry(registry) -> List[str]:

@@ -194,7 +194,8 @@ if smoke_done; then
 else
     # one tiny batch per kernel-variant class (base/most-requested/ports/
     # disk/spread/vol-zone/interpod/maxpd + the preempt-victim kernel +
-    # the scenario-fleet serve path + the streaming churn runtime),
+    # the scenario-fleet serve path + the streaming churn runtime + the
+    # traced replicated fleet with its lint-clean trace export),
     # each hash-checked against the XLA scan in-process: even a ~2-minute
     # healthy window certifies Mosaic lowering of the whole surface
     if ! python tools/tpu_smoke.py \

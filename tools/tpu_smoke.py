@@ -14,7 +14,10 @@ count-mode) — actually lowers through Mosaic and agrees with
 the XLA scan bit-for-bit, plus that the preemption victim-selection
 kernel (jaxe/preempt.py) byte-matches the host oracle and that the
 streaming runtime's scatter-committed fast path (tpusim/stream)
-byte-matches a fresh-compile reference without retracing once warm. Shapes are tiny
+byte-matches a fresh-compile reference without retracing once warm —
+plus that a fully traced replicated fleet (leader -> follower WAL
+shipping + a serve batch) exports one lint-clean Perfetto flow graph
+without moving a single placement. Shapes are tiny
 (<=8 nodes, <=24 pods) so the whole sweep compiles and runs in well
 under a minute on a healthy TPU; off-TPU the Pallas kernels auto-select
 interpreter mode, so the same script validates on CPU (slower).
@@ -1108,6 +1111,104 @@ def run_sharded_variant():
     return base_hash[:16], 2, traced
 
 
+def run_traced_fleet_variant():
+    """Fleet-wide distributed tracing (ISSUE 20) stage-0: a replicated
+    leader -> follower stream run plus a traced serve batch, captured on
+    one flight recorder, must (a) leave the fold chain byte-identical
+    to an untraced run — the recorder is invisible to the decisions;
+    (b) carry every WAL frame's context across the shipping socket:
+    each flow start meets exactly one finish and the follower's replay
+    spans are stamped with leader trace ids; (c) pin the hello-handshake
+    clock anchors tools/trace_merge.py aligns multi-process captures
+    on; and (d) export an artifact that tools/trace_lint.py certifies
+    Perfetto-valid both as captured and after a trace_merge round-trip."""
+    import importlib.util
+    import json
+    import shutil
+    import tempfile
+
+    from tpusim.obs import recorder as flight
+    from tpusim.serve import ScenarioFleet, WhatIfRequest
+    from tpusim.simulator import run_replicated_stream, \
+        run_stream_simulation
+
+    def load_tool(name):
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(os.path.dirname(__file__), f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    kw = dict(num_nodes=16, cycles=8, arrivals=16, evict_fraction=0.25,
+              seed=9, checkpoint_every=2)
+    base_dir = tempfile.mkdtemp(prefix="tpusim-smoke-trace-")
+    rep_dir = tempfile.mkdtemp(prefix="tpusim-smoke-trace-")
+    outer = flight.get_recorder()
+    flight.uninstall()
+    try:
+        base = run_stream_simulation(checkpoint_dir=base_dir, **kw)
+        rec = flight.install(
+            flight.FlightRecorder(process_name="tpusim-smoke-fleet"))
+        out = run_replicated_stream(checkpoint_dir=rep_dir, **kw)
+        if out["fold_chain"] != base["fold_chain"]:
+            raise AssertionError(
+                f"tracing moved the fold chain ({out['fold_chain'][:16]} "
+                f"!= {base['fold_chain'][:16]}); the recorder must be "
+                "invisible to the decisions")
+        if out["divergence"]:
+            raise AssertionError(
+                f"follower diverged under tracing: {out['divergence']}")
+        snap, pods = _base()
+        fleet = ScenarioFleet(bucket_size=2, flush_after_s=60.0)
+        responses = fleet.run([WhatIfRequest(pods=pods, snapshot=snap)
+                               for _ in range(2)])
+        if not all(r.ok for r in responses):
+            raise AssertionError("traced serve batch failed")
+        flight.uninstall()
+
+        s = [e for e in rec.events
+             if e.get("ph") == "s" and e.get("cat") == "wal"]
+        f = [e for e in rec.events
+             if e.get("ph") == "f" and e.get("cat") == "wal"]
+        if not s or {e["id"] for e in s} != {e["id"] for e in f}:
+            raise AssertionError(
+                f"wal flow graph disconnected ({len(s)} starts, "
+                f"{len(f)} finishes)")
+        applies = [e for e in rec.events
+                   if e.get("name") == "replicate:apply"
+                   and e.get("args", {}).get("trace_id")]
+        leader_ids = {e["args"]["trace_id"] for e in s}
+        if not applies or \
+                not {e["args"]["trace_id"] for e in applies} <= leader_ids:
+            raise AssertionError(
+                "follower replay spans lost the leader's trace context")
+        admits = [e for e in rec.events if e.get("name") == "serve:admit"
+                  and e.get("args", {}).get("trace_id")]
+        if not admits:
+            raise AssertionError("serve admissions were not stamped with "
+                                 "a trace context")
+        for anchor in ("hello_tx_us", "peer_clk_us", "peer_clk_rx_us"):
+            if anchor not in rec.anchors:
+                raise AssertionError(
+                    f"clock anchor {anchor} missing; trace_merge cannot "
+                    "align this capture")
+        doc = json.loads(rec.to_chrome_json())
+        lint = load_tool("trace_lint")
+        problems = lint.lint_trace(doc)
+        merged = load_tool("trace_merge").merge([doc])
+        problems += [f"post-merge: {p}" for p in lint.lint_trace(merged)]
+        if problems:
+            raise AssertionError(f"trace lint found: {problems[:3]}")
+        return (out["fold_chain"][:16], len(doc["traceEvents"]), len(s),
+                len(applies))
+    finally:
+        flight.uninstall()
+        if outer is not None:
+            flight.install(outer)
+        shutil.rmtree(base_dir, ignore_errors=True)
+        shutil.rmtree(rep_dir, ignore_errors=True)
+
+
 def _write_smoke_trace(recorder):
     """Persist the sweep's flight-recorder trace; never fail the smoke."""
     path = os.environ.get("TPUSIM_SMOKE_TRACE") or os.path.join(
@@ -1396,6 +1497,26 @@ def main() -> int:
                 print(f"SMOKE sharded: OK hash={h} shards={n_shards} "
                       f"retrace={retrace} ({time.time() - t:.1f}s)",
                       flush=True)
+        if not only or "traced_fleet" in only:
+            t = time.time()
+            vsp = flight.span("smoke_variant")
+            vsp.set("variant", "traced_fleet")
+            try:
+                h, n_events, n_flows, n_applies = run_traced_fleet_variant()
+            except Exception as exc:  # noqa: BLE001
+                vsp.set("parity", "FAILED")
+                vsp.set("error", type(exc).__name__)
+                vsp.end()
+                print(f"SMOKE FAILED: traced_fleet: {exc}", flush=True)
+                return 1
+            vsp.set("parity", "ok")
+            vsp.set("hash", h)
+            vsp.set("events", n_events)
+            vsp.end()
+            ran += 1
+            print(f"SMOKE traced_fleet: OK hash={h} events={n_events} "
+                  f"wal_flows={n_flows} replay_spans={n_applies} "
+                  f"({time.time() - t:.1f}s)", flush=True)
     finally:
         flight.uninstall()
         _write_smoke_trace(recorder)

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Trace-artifact lint (ISSUE 20): Chrome trace_event validity.
+
+Validates a ``--trace-out`` artifact (or a tools/trace_merge.py output)
+the way Perfetto will read it, so a broken export fails in CI instead
+of rendering as a silently-disconnected graph:
+
+  - the document is Perfetto-loadable: a ``traceEvents`` list, known
+    phase codes only (X / i / M / s / f), required fields per phase,
+    non-negative ts and dur
+  - per (pid, tid) the event stream is monotonic in the recorder's
+    clock: events append at span END, so each event's emission time
+    (ts+dur for X, ts otherwise) must be non-decreasing in file order,
+    up to a small slack (--slack-us) for thread hand-off jitter — a
+    violation beyond the slack means a clock went backwards or a merge
+    shifted one process into another's past
+  - every flow start ``s`` has a matching finish ``f`` on the same
+    (cat, id) and vice versa (a dangling arrow means a hop lost its
+    context), and every ``f`` carries ``bp: "e"``
+  - optionally (--metrics), every exemplar trace id decorating a
+    histogram exposition resolves to at least one event stamped with
+    that trace_id — dashboards must be able to click through
+
+Run standalone (``python tools/trace_lint.py trace.json [--metrics
+metrics.prom]``; exit 1 on findings) or through tests/test_trace.py
+(tier-1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, List
+
+_KNOWN_PH = {"X", "i", "M", "s", "f"}
+_EXEMPLAR_RE = re.compile(r'#\s*\{trace_id="([0-9a-f]+)"\}')
+
+
+def lint_trace(doc: Dict[str, Any], slack_us: float = 5000.0) -> List[str]:
+    """All validity violations in one Chrome trace document."""
+    problems: List[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["document has no traceEvents list"]
+    dtu = doc.get("displayTimeUnit", "ms")
+    if dtu not in ("ms", "ns"):
+        problems.append(f"displayTimeUnit {dtu!r} is not ms/ns")
+
+    last_emit: Dict[tuple, float] = {}
+    flow_s: Dict[tuple, int] = {}
+    flow_f: Dict[tuple, int] = {}
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        name = ev.get("name", "?")
+        where = f"event {i} ({name!r})"
+        if ph not in _KNOWN_PH:
+            problems.append(f"{where}: unknown phase {ph!r}")
+            continue
+        if "pid" not in ev:
+            problems.append(f"{where}: no pid")
+            continue
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"{where}: bad ts {ts!r}")
+            continue
+        track = (ev["pid"], ev.get("tid"))
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: X event with bad dur {dur!r}")
+                continue
+            emit = ts + dur
+        else:
+            emit = ts
+        prev = last_emit.get(track)
+        if prev is not None and emit < prev - slack_us:
+            problems.append(
+                f"{where}: emission time {emit} jumps back "
+                f"{round(prev - emit, 3)} us on pid/tid {track} — file "
+                "order must follow the recorder clock (merge shift or "
+                "clock regression)")
+        last_emit[track] = max(emit, prev) if prev is not None else emit
+        if ph == "s":
+            flow_s[(ev.get("cat"), ev.get("id"))] = \
+                flow_s.get((ev.get("cat"), ev.get("id")), 0) + 1
+        elif ph == "f":
+            if ev.get("bp") != "e":
+                problems.append(f"{where}: flow finish without bp=e "
+                                "(enclosing-slice binding)")
+            flow_f[(ev.get("cat"), ev.get("id"))] = \
+                flow_f.get((ev.get("cat"), ev.get("id")), 0) + 1
+    for key in sorted(set(flow_s) - set(flow_f)):
+        problems.append(f"flow {key[0]}:{key[1]}: start (s) without any "
+                        "finish (f) — dangling arrow")
+    for key in sorted(set(flow_f) - set(flow_s)):
+        problems.append(f"flow {key[0]}:{key[1]}: finish (f) without a "
+                        "start (s)")
+    return problems
+
+
+def lint_exemplars(doc: Dict[str, Any], metrics_text: str) -> List[str]:
+    """Every exemplar trace id on the exposition resolves to at least
+    one stamped event in the trace."""
+    stamped = set()
+    for ev in doc.get("traceEvents", []):
+        tid = (ev.get("args") or {}).get("trace_id")
+        if tid:
+            stamped.add(tid)
+    problems = []
+    for trace_id in sorted(set(_EXEMPLAR_RE.findall(metrics_text))):
+        if trace_id not in stamped:
+            problems.append(f"exemplar trace_id {trace_id} on the metrics "
+                            "exposition resolves to no event in the trace")
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Validate a tpusim --trace-out artifact (Perfetto "
+                    "loadability, per-track monotonicity, flow pairing, "
+                    "exemplar resolution)")
+    parser.add_argument("traces", nargs="+", help="Chrome trace JSON files")
+    parser.add_argument("--metrics", default="",
+                        help="A --metrics-out exposition: check its "
+                             "exemplar trace ids resolve into the trace")
+    parser.add_argument("--slack-us", type=float, default=5000.0,
+                        help="Tolerated per-track backwards-jitter in "
+                             "microseconds (thread hand-off races)")
+    args = parser.parse_args(argv)
+    rc = 0
+    for path in args.traces:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"trace-lint: {path}: unreadable: {exc}", file=sys.stderr)
+            rc = 1
+            continue
+        problems = lint_trace(doc, slack_us=args.slack_us)
+        if args.metrics:
+            with open(args.metrics, "r", encoding="utf-8") as f:
+                problems += lint_exemplars(doc, f.read())
+        for problem in problems:
+            print(f"trace-lint: {path}: {problem}", file=sys.stderr)
+        if problems:
+            rc = 1
+        else:
+            n = len(doc.get("traceEvents", []))
+            print(f"trace-lint: {path}: ok ({n} events)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

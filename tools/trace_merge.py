@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Merge per-process flight-recorder traces into one Perfetto timeline
+(ISSUE 20).
+
+Each tpusim process (``tpusim stream --trace-out``, ``tpusim follow
+--trace-out``, ``tpusim serve --trace-out``) writes its own Chrome
+trace_event JSON with timestamps relative to ITS recorder epoch. This
+tool joins them:
+
+- **pid remap.** Every input file gets a distinct pid (its position in
+  the argument list), with its process_name metadata preserved — two
+  processes that both report os.getpid()==1234 stay distinct tracks.
+- **clock alignment.** The replication hello handshake pins anchors in
+  both files' ``otherData.anchors``: the follower stamps
+  ``hello_tx_us`` (its reading when the hello left) and the leader pins
+  ``peer_clk_us`` (that same reading, received) next to
+  ``peer_clk_rx_us`` (the leader's own reading at receive). Aligning
+  the follower means shifting its timeline by
+  ``peer_clk_rx_us - hello_tx_us`` into the leader's clock domain —
+  exact up to the one-way socket latency, which on a localhost pair is
+  well under the span widths being read. Files with no anchors (the
+  serve front door) are left unshifted relative to the FIRST input,
+  which is therefore conventionally the leader.
+- **flow joining needs no work**: flow events match on (cat, id) which
+  are process-independent, so once the files share a document Perfetto
+  renders the leader->follower ``wal:ship`` arrows and the serve
+  enqueue/bucket arrows as one connected graph.
+
+Usage:
+    python tools/trace_merge.py leader.json follower.json serve.json \
+        -o merged.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if "traceEvents" not in doc or not isinstance(doc["traceEvents"], list):
+        raise ValueError(f"{path}: not a Chrome trace_event document "
+                         "(no traceEvents list)")
+    return doc
+
+
+def shift_for(doc: Dict[str, Any], leader: Dict[str, Any]) -> float:
+    """Microseconds to ADD to this document's timestamps to land it in
+    the leader's clock domain; 0.0 when no handshake anchors pair up."""
+    anchors = (doc.get("otherData") or {}).get("anchors") or {}
+    leader_anchors = (leader.get("otherData") or {}).get("anchors") or {}
+    tx = anchors.get("hello_tx_us")
+    rx = leader_anchors.get("peer_clk_rx_us")
+    peer = leader_anchors.get("peer_clk_us")
+    if tx is None or rx is None or peer is None:
+        return 0.0
+    if abs(float(peer) - float(tx)) > 1e-3:
+        # the leader heard a DIFFERENT hello than this file sent (a
+        # reconnect, or a third process): the recorded peer reading is
+        # authoritative for which send it pairs with
+        tx = float(peer)
+    return float(rx) - float(tx)
+
+
+def merge(docs: List[Dict[str, Any]],
+          names: Optional[List[str]] = None) -> Dict[str, Any]:
+    """One merged Chrome trace: docs[0] is the reference clock domain."""
+    events: List[Dict[str, Any]] = []
+    leader = docs[0]
+    for pid, doc in enumerate(docs, start=1):
+        shift = shift_for(doc, leader) if doc is not leader else 0.0
+        pname = (doc.get("otherData") or {}).get("process_name")
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + shift, 3)
+            if ev.get("ph") == "M" and ev.get("name") == "process_name" \
+                    and names is not None and pid - 1 < len(names):
+                ev = dict(ev, args={"name": names[pid - 1]})
+            events.append(ev)
+        if pname and not any(
+                e.get("ph") == "M" and e.get("name") == "process_name"
+                and e.get("pid") == pid for e in events):
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "ts": 0.0,
+                           "args": {"name": pname}})
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "otherData": {
+            "merged_from": len(docs),
+            "shifts_us": [0.0] + [round(shift_for(d, leader), 3)
+                                  for d in docs[1:]],
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Merge tpusim --trace-out files into one "
+                    "Perfetto-loadable timeline (first file = reference "
+                    "clock domain, conventionally the leader)")
+    parser.add_argument("traces", nargs="+",
+                        help="Chrome trace JSON files (leader first)")
+    parser.add_argument("-o", "--out", required=True,
+                        help="Merged output path")
+    parser.add_argument("--name", action="append", default=None,
+                        help="Override process name per input "
+                             "(repeatable, positional)")
+    args = parser.parse_args(argv)
+    try:
+        docs = [load_trace(p) for p in args.traces]
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"trace-merge: error: {exc}", file=sys.stderr)
+        return 2
+    merged = merge(docs, names=args.name)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(merged, f, sort_keys=True, separators=(",", ":"))
+        f.write("\n")
+    flows = sum(1 for e in merged["traceEvents"] if e.get("ph") == "s")
+    print(f"trace-merge: {len(args.traces)} files -> {args.out} "
+          f"({len(merged['traceEvents'])} events, {flows} flows, "
+          f"shifts {merged['otherData']['shifts_us']} us)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -572,7 +572,8 @@ def serve_cli(argv) -> int:
     if args.trace_out:
         from tpusim.obs import recorder as flight
 
-        recorder = flight.install(flight.FlightRecorder())
+        recorder = flight.install(
+            flight.FlightRecorder(process_name="tpusim-serve"))
 
     from tpusim.serve import ScenarioFleet, WhatIfRequest
 
@@ -861,7 +862,8 @@ def stream_cli(argv) -> int:
     if args.trace_out:
         from tpusim.obs import recorder as flight
 
-        recorder = flight.install(flight.FlightRecorder())
+        recorder = flight.install(
+            flight.FlightRecorder(process_name="tpusim-stream"))
 
     replicate_to = None
     if args.replicate_to:
@@ -1076,6 +1078,12 @@ def build_follow_parser() -> argparse.ArgumentParser:
                              "it, instead of replaying from a cycle-0 "
                              "snapshot (--snapshot/--synthetic-nodes are "
                              "then ignored)")
+    parser.add_argument("--trace-out", default="",
+                        help="Write the follower's flight-recorder trace "
+                             "(Chrome trace_event JSON) on exit: replay "
+                             "spans carry the leader's trace ids, so "
+                             "tools/trace_merge.py joins this file with "
+                             "the leader's into one flow graph (ISSUE 20)")
     _add_follow_snapshot_flags(parser)
     add_obs_flags(parser)
     add_explain_flags(parser)
@@ -1117,6 +1125,12 @@ def follow_cli(argv) -> int:
     )
 
     obs_teardown = _arm_observability(args)
+    recorder = None
+    if args.trace_out:
+        from tpusim.obs import recorder as flight
+
+        recorder = flight.install(
+            flight.FlightRecorder(process_name="tpusim-follow"))
     try:
         try:
             follower = FollowerTwin(snapshot,
@@ -1203,6 +1217,17 @@ def follow_cli(argv) -> int:
                       if out["divergence"] else ""))
         return 1 if out["divergence"] else 0
     finally:
+        if recorder is not None:
+            from tpusim.obs import recorder as flight
+
+            flight.uninstall()
+            try:
+                recorder.write(args.trace_out)
+                print(f"trace: {args.trace_out} "
+                      f"({len(recorder.events)} events)", file=sys.stderr)
+            except OSError as exc:
+                print(f"error: failed to write trace: {exc}",
+                      file=sys.stderr)
         obs_teardown()
 
 
@@ -1289,6 +1314,82 @@ def promote_cli(argv) -> int:
             print(f"error: failed to write metrics: {exc}", file=sys.stderr)
             return 2
     return 1 if out["violations"] else 0
+
+
+def build_audit_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusim audit",
+        description="Chain-divergence forensics (ISSUE 20): bisect two "
+                    "WAL directories (checkpoint.json + wal.jsonl pairs, "
+                    "e.g. a leader's and a follower's, or two same-seed "
+                    "runs) to the FIRST divergent cycle via the sha256 "
+                    "digest chain, then re-run that cycle through the "
+                    "scheduler with explain lanes on and emit a "
+                    "per-decision forensic diff: score parts, top-k "
+                    "candidate order, restage classification, shard "
+                    "ownership of the flipped node")
+    parser.add_argument("wal_a", help="First WAL directory")
+    parser.add_argument("wal_b", help="Second WAL directory")
+    parser.add_argument("--algorithmprovider", default="DefaultProvider",
+                        help="Provider the audited runs used (the replay "
+                             "re-decides under the same policy surface)")
+    parser.add_argument("--explain-k", type=int, default=3,
+                        help="Top-k score-breakdown depth for the "
+                             "forensic re-run (default 3; 0 disables "
+                             "the score-parts lanes)")
+    parser.add_argument("--no-replay", action="store_true",
+                        help="Record-level diff only: skip rebuilding "
+                             "the shared prefix and re-deciding the "
+                             "divergent cycle")
+    parser.add_argument("--json", action="store_true",
+                        help="Print the full report as one JSON object")
+    parser.add_argument("--out", default="",
+                        help="Additionally write the JSON report here "
+                             "(the repro harness's forensic artifact)")
+    parser.add_argument("--platform", default="",
+                        help="JAX platform for the replay (e.g. cpu)")
+    return parser
+
+
+def audit_cli(argv) -> int:
+    """`tpusim audit`: first-divergence forensics over two WALs."""
+    import json
+
+    args = build_audit_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        os.environ["TPUSIM_PROBE"] = "0"
+    from tpusim.obs.audit import audit_wal_pair, render_report
+    from tpusim.stream.persist import StreamPersistence
+
+    for d in (args.wal_a, args.wal_b):
+        if not os.path.exists(os.path.join(d, StreamPersistence.WAL)):
+            print(f"error: no {StreamPersistence.WAL} in {d}",
+                  file=sys.stderr)
+            return 2
+    try:
+        report = audit_wal_pair(args.wal_a, args.wal_b,
+                                provider=args.algorithmprovider,
+                                explain_k=args.explain_k,
+                                replay=not args.no_replay)
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if args.out:
+        try:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(report, f, sort_keys=True, indent=2)
+                f.write("\n")
+        except OSError as exc:
+            print(f"error: failed to write report: {exc}", file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(render_report(report), end="")
+    return 1 if report.get("verdict") == "diverged" else 0
 
 
 def build_explain_parser() -> argparse.ArgumentParser:
@@ -1555,6 +1656,8 @@ def main(argv=None) -> int:
         return follow_cli(argv[1:])
     if argv and argv[0] == "promote":
         return promote_cli(argv[1:])
+    if argv and argv[0] == "audit":
+        return audit_cli(argv[1:])
     if argv and argv[0] == "explain":
         return explain_cli(argv[1:])
     if argv and argv[0] == "top":

@@ -47,21 +47,28 @@ class Histogram:
         self.bucket_counts = [0] * len(self.buckets)
         self.count = 0
         self.total = 0.0
+        # OpenMetrics exemplar: the last (trace_id, value) observed with a
+        # trace attached; None until a traced observation lands, so default
+        # expositions are byte-identical to the pre-exemplar format
+        self.exemplar: Optional[tuple] = None
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         with self._lock:
             self.count += 1
             self.total += value
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     self.bucket_counts[i] += 1
+            if exemplar is not None:
+                self.exemplar = (exemplar, value)
 
     def reset(self) -> None:
         with self._lock:
             self.bucket_counts = [0] * len(self.buckets)
             self.count = 0
             self.total = 0.0
+            self.exemplar = None
 
     def expose(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
@@ -70,7 +77,12 @@ class Histogram:
         # bucket whose bound covers the value)
         for bound, bucket_count in zip(self.buckets, self.bucket_counts):
             lines.append(f'{self.name}_bucket{{le="{bound:g}"}} {bucket_count}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
+        inf = f'{self.name}_bucket{{le="+Inf"}} {self.count}'
+        if self.exemplar is not None:
+            trace_id, value = self.exemplar
+            inf += (f' # {{trace_id="{escape_label_value(trace_id)}"}} '
+                    f'{value:g}')
+        lines.append(inf)
         lines.append(f"{self.name}_sum {self.total:g}")
         lines.append(f"{self.name}_count {self.count}")
         return lines
@@ -169,7 +181,8 @@ class LabeledHistogram:
         self.children: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
-    def observe(self, label_value: str, value: float) -> None:
+    def observe(self, label_value: str, value: float,
+                exemplar: Optional[str] = None) -> None:
         with self._lock:
             child = self.children.get(label_value)
             if child is None:
@@ -177,7 +190,7 @@ class LabeledHistogram:
                     f'{self.name}{{{self.label}="{label_value}"}}',
                     self.help, self.buckets)
                 self.children[label_value] = child
-        child.observe(value)
+        child.observe(value, exemplar)
 
     def get(self, label_value: str) -> Optional[Histogram]:
         with self._lock:
@@ -198,8 +211,12 @@ class LabeledHistogram:
                                            child.bucket_counts):
                 lines.append(f'{self.name}_bucket{{{pair},le="{bound:g}"}} '
                              f'{bucket_count}')
-            lines.append(f'{self.name}_bucket{{{pair},le="+Inf"}} '
-                         f'{child.count}')
+            inf = f'{self.name}_bucket{{{pair},le="+Inf"}} {child.count}'
+            if child.exemplar is not None:
+                trace_id, value = child.exemplar
+                inf += (f' # {{trace_id="{escape_label_value(trace_id)}"}} '
+                        f'{value:g}')
+            lines.append(inf)
             lines.append(f'{self.name}_sum{{{pair}}} {child.total:g}')
             lines.append(f'{self.name}_count{{{pair}}} {child.count}')
         return lines
@@ -440,9 +457,20 @@ class SchedulerMetrics:
         # observability plane (ISSUE 13): bounded flight recorder, SLO
         # tracking against a configurable per-cycle latency target, and the
         # recovery chain head published for /healthz continuity checks
-        self.obs_dropped_events = self._reg(Counter(
+        self.obs_dropped_events = self._reg(LabeledCounter(
             "tpusim_obs_dropped_events_total",
-            "Flight-recorder events dropped by the bounded ring buffer"))
+            "Flight-recorder events dropped by the bounded ring buffer, "
+            "by span category", "category"))
+        # fleet-wide distributed tracing (ISSUE 20): cross-boundary flow
+        # events and the bounded /debug/trace ring
+        self.trace_flows = self._reg(LabeledCounter(
+            "tpusim_trace_flows_total",
+            "Cross-boundary trace flow starts (Chrome 's' phase) emitted, "
+            "by boundary site", "site"))
+        self.trace_ring_events = self._reg(Gauge(
+            "tpusim_trace_ring_events",
+            "Events currently held in the flight-recorder ring served by "
+            "/debug/trace"))
         self.slo_target = self._reg(Gauge(
             "tpusim_slo_cycle_latency_target_microseconds",
             "Configured per-cycle latency SLO target (0 = no SLO armed)"))

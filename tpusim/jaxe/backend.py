@@ -58,6 +58,7 @@ from tpusim.jaxe.state import NUM_FIXED_BITS, compile_cluster, reason_strings
 from tpusim.obs import analytics
 from tpusim.obs import provenance
 from tpusim.obs import recorder as flight
+from tpusim.obs import tracectx
 
 log = logging.getLogger(__name__)
 
@@ -917,8 +918,12 @@ class JaxBackend:
             if fast_sig is not None:
                 dsp.set("sig", str(fast_sig))
             dsp.end()
+        # trace-id exemplar (ISSUE 20): a dispatch-latency spike on the
+        # dashboard resolves to the exact device-dispatch trace
+        _ctx = tracectx.current()
         metrics.backend_dispatch_latency.observe(
-            since_in_microseconds(dispatch_start))
+            since_in_microseconds(dispatch_start),
+            exemplar=_ctx.trace_id if _ctx is not None else None)
         metrics.scheduling_algorithm_latency.observe(
             since_in_microseconds(dispatch_start))
 

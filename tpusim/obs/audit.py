@@ -1,0 +1,378 @@
+"""Chain-divergence forensics: ``tpusim audit`` (ISSUE 20).
+
+Two runs that SHOULD have produced byte-identical decision streams — a
+leader and its follower, two same-seed simulations, a run and its
+recovery replay — occasionally don't (ROADMAP item 1 tracks one live
+instance under ``TPUSIM_SHARDS=2``). The placement-hash chain says THAT
+they diverged; this module answers WHERE and WHY:
+
+1. **Bisect.** Fold each WAL's per-cycle digests — sha256 over the
+   cycle's sorted bind list + emit hash — into a resumable chain (the
+   same ``chain_fold`` discipline persist.py uses) and bisect on chain
+   equality to the FIRST divergent cycle: O(log n) chain-head
+   comparisons over the prefix, then one record-level diff at the
+   divergence point.
+
+2. **Replay + re-decide.** Rebuild the shared prefix (checkpoint
+   snapshot + WAL replay, the recover_stream_session discipline) into a
+   fresh session, then re-run the divergent cycle's batch through the
+   scheduler with a ProvenanceLog requesting ``explain_k`` score-
+   breakdown lanes — the per-decision forensic record: top-k candidate
+   order, per-priority score parts, restage classification, and (when
+   the checkpoint carries a shard layout) which shard owned the flipped
+   node.
+
+The module is read-only with respect to the audited directories: the
+replay session journals nothing, and the report is a plain dict
+(rendered by ``render_report`` for the CLI, JSON-dumpable for
+artifacts).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpusim.engine.providers import DEFAULT_PROVIDER
+
+
+@dataclass
+class CycleDigest:
+    """One cycle's comparable identity, extracted from a WAL."""
+
+    cycle: int
+    binds: List[Tuple[str, str]] = field(default_factory=list)
+    emit_hash: Optional[str] = None
+    batch_keys: List[str] = field(default_factory=list)
+    events: int = 0
+
+    def digest(self) -> str:
+        body = json.dumps({"b": sorted(self.binds), "h": self.emit_hash,
+                           "p": self.batch_keys, "e": self.events},
+                          sort_keys=True, separators=(",", ":"))
+        return hashlib.sha256(body.encode()).hexdigest()
+
+
+def extract_cycles(wal_path: str) -> Dict[int, CycleDigest]:
+    """Per-cycle digest table for one WAL (violations are tolerated —
+    a torn tail simply ends the comparable range early)."""
+    from tpusim.stream.persist import read_wal
+
+    records, _violations = read_wal(wal_path)
+    cycles: Dict[int, CycleDigest] = {}
+
+    def at(c: int) -> CycleDigest:
+        if c not in cycles:
+            cycles[c] = CycleDigest(cycle=c)
+        return cycles[c]
+
+    for _ofs, rec in records:
+        k, c = rec.get("k"), int(rec.get("c", -1))
+        if k == "batch":
+            at(c).batch_keys = [
+                f"{(o.get('metadata') or {}).get('namespace') or 'default'}"
+                f"/{(o.get('metadata') or {}).get('name')}"
+                for o in rec.get("pods", [])]
+        elif k == "bind":
+            at(c).binds = [(key, node) for key, node in rec.get("b", [])]
+        elif k == "emit":
+            at(c).emit_hash = rec.get("h")
+        elif k == "ev":
+            at(c).events += 1
+    return cycles
+
+
+def _chain_heads(cycles: Dict[int, CycleDigest],
+                 upto: int) -> List[Tuple[int, str]]:
+    """[(cycle, folded chain head AFTER that cycle)] for cycles 0..upto,
+    in order — the bisection axis."""
+    from tpusim.stream.persist import chain_fold
+
+    heads: List[Tuple[int, str]] = []
+    chain = ""
+    for c in sorted(k for k in cycles if k <= upto):
+        chain = chain_fold(chain, cycles[c].digest())
+        heads.append((c, chain))
+    return heads
+
+
+def first_divergence(a: Dict[int, CycleDigest],
+                     b: Dict[int, CycleDigest]) -> Optional[int]:
+    """The first cycle whose digest differs between the two tables —
+    found by bisecting the folded digest chain — or None when the shared
+    range agrees everywhere. Cycles present on only one side count as
+    divergent (a truncated run diverges at its first missing cycle)."""
+    last = max(max(a, default=-1), max(b, default=-1))
+    if last < 0:
+        return None
+    heads_a = dict(_chain_heads(a, last))
+    heads_b = dict(_chain_heads(b, last))
+    axis = sorted(set(a) | set(b))
+    if heads_a.get(axis[-1]) == heads_b.get(axis[-1]) \
+            and set(a) == set(b):
+        return None
+    lo, hi = 0, len(axis) - 1
+    # invariant: some cycle in axis[lo..hi] diverges; chains agree
+    # strictly below axis[lo]
+    while lo < hi:
+        mid = (lo + hi) // 2
+        c = axis[mid]
+        if heads_a.get(c) == heads_b.get(c) and c in a and c in b:
+            lo = mid + 1
+        else:
+            hi = mid
+    return axis[lo]
+
+
+def _classify(da: Optional[CycleDigest],
+              db: Optional[CycleDigest]) -> str:
+    if da is None or db is None:
+        return "missing_cycle"
+    if da.batch_keys != db.batch_keys:
+        return "batch"
+    if da.events != db.events:
+        return "events"
+    if sorted(da.binds) != sorted(db.binds):
+        return "bind"
+    if da.emit_hash != db.emit_hash:
+        return "emit"
+    return "unknown"
+
+
+def _bind_diff(da: CycleDigest, db: CycleDigest) -> List[Dict[str, Any]]:
+    ma, mb = dict(da.binds), dict(db.binds)
+    rows = []
+    for key in sorted(set(ma) | set(mb)):
+        if ma.get(key) != mb.get(key):
+            rows.append({"pod": key, "a": ma.get(key), "b": mb.get(key)})
+    return rows
+
+
+def _shard_owner(layout: Optional[dict], node: Optional[str]
+                 ) -> Optional[int]:
+    """Which shard of the checkpointed node-mesh layout owns ``node``."""
+    if not layout or not node:
+        return None
+    for shard, nodes in enumerate(layout.get("blocks") or []):
+        if node in nodes:
+            return shard
+    owners = layout.get("owners")
+    if isinstance(owners, dict):
+        return owners.get(node)
+    return None
+
+
+def _replay_prefix(directory: str, divergent: int, *,
+                   provider: str, policy=None):
+    """Rebuild the host picture as of the divergent cycle's admission:
+    checkpoint snapshot + WAL replay of every record strictly BEFORE
+    cycle ``divergent``'s batch record (events labeled with the
+    divergent cycle included — they precede the batch in host-picture
+    order). Returns (session, batch_pods, ck) or (None, reason, None)
+    when the directory cannot support a replay (checkpoint already past
+    the divergence)."""
+    from tpusim.api.snapshot import ClusterSnapshot
+    from tpusim.api.types import Pod
+    from tpusim.backends import bind_pod
+    from tpusim.framework.store import MODIFIED
+    from tpusim.jaxe.delta import IncrementalCluster
+    from tpusim.stream.persist import (
+        _LOADERS,
+        StreamPersistence,
+        read_wal,
+    )
+    from tpusim.stream.runtime import StreamSession
+
+    ck_path = os.path.join(directory, StreamPersistence.CHECKPOINT)
+    wal_path = os.path.join(directory, StreamPersistence.WAL)
+    if not os.path.exists(ck_path):
+        return None, "no checkpoint manifest to replay from", None
+    with open(ck_path, "r", encoding="utf-8") as f:
+        ck = json.load(f)
+    if int(ck["cycle"]) > divergent:
+        return None, (f"checkpoint already covers cycle {ck['cycle']} > "
+                      f"divergent cycle {divergent}; re-run with "
+                      "checkpoint_every=0 to audit"), None
+    records, _ = read_wal(wal_path)
+    inc = IncrementalCluster(ClusterSnapshot.from_obj(ck["snapshot"]))
+    session = StreamSession(incremental=inc, provider=provider,
+                            policy=policy)
+    offset_limit = int(ck["wal_offset"])
+    batch_pods: Optional[List] = None
+    for ofs, rec in records:
+        if ofs < offset_limit:
+            continue
+        k, c = rec["k"], int(rec["c"])
+        if k == "batch":
+            if c == divergent:
+                batch_pods = [Pod.from_obj(o) for o in rec["pods"]]
+                break
+            continue
+        if c >= divergent and k != "ev":
+            break
+        if k == "ev":
+            inc.apply(rec["t"], _LOADERS[rec["r"]](rec["o"]))
+        elif k == "bind":
+            pods_by_key = {}
+            for rec2 in (r for _o, r in records
+                         if r["k"] == "batch" and int(r["c"]) == c):
+                pods_by_key = {p.key(): p
+                               for p in (Pod.from_obj(o)
+                                         for o in rec2["pods"])}
+            for key, node in rec["b"]:
+                pod = pods_by_key.get(key)
+                if pod is not None:
+                    inc.apply(MODIFIED, bind_pod(pod, node))
+    if batch_pods is None:
+        return None, (f"cycle {divergent} has no batch record in "
+                      f"{wal_path}"), None
+    return session, batch_pods, ck
+
+
+def _forensic_rerun(session, batch_pods, *, explain_k: int,
+                    provider: str) -> Dict[str, Any]:
+    """Re-decide the divergent batch, twice: once through the streaming
+    session (restage/path classification + the parity placements), and
+    once through the batch backend with explain lanes armed — the stream
+    restage path does not thread ``explain_k`` into its scan, but the
+    stream-vs-restage parity contract makes the backend's decisions (and
+    therefore its top-k score-parts lanes) the same decisions."""
+    from tpusim.obs import provenance
+
+    out: Dict[str, Any] = {}
+    if explain_k > 0:
+        from tpusim.backends import get_backend
+
+        snap = session.inc.to_snapshot()
+        saved = provenance.get_log()
+        log = provenance.ProvenanceLog(capacity=4096,
+                                       top_k=int(explain_k))
+        provenance._active = log
+        try:
+            backend = get_backend("jax", provider=provider)
+            explained = backend.schedule(batch_pods, snap)
+        finally:
+            provenance._active = saved
+        out["decisions"] = log.tail(limit=max(1, len(batch_pods)))
+        out["explain_placements"] = sorted(
+            (pl.pod.key(), pl.node_name)
+            for pl in explained if pl.node_name)
+    placements = session.schedule(batch_pods)
+    out["path"] = dict(session.path_counts)
+    out["restages"] = dict(session.restage_counts)
+    out["placements"] = sorted((pl.pod.key(), pl.node_name)
+                               for pl in placements if pl.node_name)
+    if "explain_placements" in out \
+            and out["explain_placements"] != out["placements"]:
+        out["violations"] = ["explain-lane backend re-run disagrees with "
+                             "the streaming re-run (parity breach)"]
+    return out
+
+
+def audit_wal_pair(dir_a: str, dir_b: str, *,
+                   provider: str = DEFAULT_PROVIDER, policy=None,
+                   explain_k: int = 3,
+                   replay: bool = True) -> Dict[str, Any]:
+    """The ``tpusim audit`` engine: bisect two WAL directories to the
+    first divergent cycle and (when the checkpoints allow) re-run that
+    cycle with explain lanes for a per-decision forensic diff."""
+    from tpusim.stream.persist import StreamPersistence
+
+    wal_a = os.path.join(dir_a, StreamPersistence.WAL)
+    wal_b = os.path.join(dir_b, StreamPersistence.WAL)
+    cycles_a = extract_cycles(wal_a)
+    cycles_b = extract_cycles(wal_b)
+    report: Dict[str, Any] = {
+        "a": dir_a, "b": dir_b,
+        "cycles_a": len(cycles_a), "cycles_b": len(cycles_b),
+    }
+    divergent = first_divergence(cycles_a, cycles_b)
+    report["divergent_cycle"] = divergent
+    if divergent is None:
+        report["verdict"] = "identical"
+        return report
+    da, db = cycles_a.get(divergent), cycles_b.get(divergent)
+    kind = _classify(da, db)
+    report["verdict"] = "diverged"
+    report["kind"] = kind
+    if da is not None and db is not None:
+        report["bind_diff"] = _bind_diff(da, db)
+        report["emit_hash"] = {"a": da.emit_hash, "b": db.emit_hash}
+        report["batch"] = da.batch_keys
+    if not replay:
+        return report
+    session, batch_or_reason, ck = _replay_prefix(
+        dir_a, divergent, provider=provider, policy=policy)
+    if session is None:
+        report["replay_skipped"] = batch_or_reason
+        return report
+    rerun = _forensic_rerun(session, batch_or_reason, explain_k=explain_k,
+                            provider=provider)
+    report["replay"] = rerun
+    layout = (ck or {}).get("shard_layout")
+    if report.get("bind_diff") and layout:
+        for row in report["bind_diff"]:
+            row["shard_a"] = _shard_owner(layout, row.get("a"))
+            row["shard_b"] = _shard_owner(layout, row.get("b"))
+    # which recorded side (if either) the deterministic re-decide agrees
+    # with: the side that DISAGREES holds the corrupted/nondeterministic
+    # record
+    if da is not None and db is not None:
+        ours = rerun["placements"]
+        agrees_a = ours == sorted(da.binds)
+        agrees_b = ours == sorted(db.binds)
+        report["replay_agrees_with"] = (
+            "both" if agrees_a and agrees_b else
+            "a" if agrees_a else "b" if agrees_b else "neither")
+    return report
+
+
+def render_report(report: Dict[str, Any]) -> str:
+    """Human-readable forensic report (the CLI's stdout body)."""
+    lines = [f"audit: {report['a']}  vs  {report['b']}"]
+    if report.get("verdict") == "identical":
+        lines.append(f"chains identical across "
+                     f"{report['cycles_a']} cycles")
+        return "\n".join(lines) + "\n"
+    d = report["divergent_cycle"]
+    lines.append(f"FIRST DIVERGENT CYCLE: {d}  (kind: "
+                 f"{report.get('kind', '?')})")
+    eh = report.get("emit_hash") or {}
+    if eh.get("a") != eh.get("b"):
+        lines.append(f"  emit hash  a={str(eh.get('a'))[:16]}  "
+                     f"b={str(eh.get('b'))[:16]}")
+    for row in report.get("bind_diff", []):
+        extra = ""
+        if row.get("shard_a") is not None or row.get("shard_b") is not None:
+            extra = (f"  [shard {row.get('shard_a')} -> "
+                     f"{row.get('shard_b')}]")
+        lines.append(f"  pod {row['pod']}: a={row.get('a')}  "
+                     f"b={row.get('b')}{extra}")
+    if "replay_skipped" in report:
+        lines.append(f"  replay skipped: {report['replay_skipped']}")
+    if "replay" in report:
+        rr = report["replay"]
+        lines.append(f"  re-decide agrees with: "
+                     f"{report.get('replay_agrees_with', '?')}")
+        diff_pods = {row["pod"] for row in report.get("bind_diff", [])}
+        for rec in rr.get("decisions", []):
+            if diff_pods and rec.get("pod") not in diff_pods:
+                continue
+            if rec.get("placed"):
+                lines.append(f"    {rec['pod']} -> {rec.get('node')}")
+                for cand in rec.get("top_k", [])[:5]:
+                    parts = cand.get("parts") or {}
+                    parts_s = " ".join(f"{k}={v}"
+                                       for k, v in sorted(parts.items()))
+                    lines.append(f"      candidate {cand['node']} "
+                                 f"score={cand['score']}"
+                                 + (f"  {parts_s}" if parts_s else ""))
+            else:
+                lines.append(f"    {rec['pod']} UNSCHEDULABLE: "
+                             f"{rec.get('message')}")
+        if rr.get("restages"):
+            lines.append(f"  re-run restages: {rr['restages']}")
+    return "\n".join(lines) + "\n"

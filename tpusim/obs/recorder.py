@@ -103,26 +103,72 @@ class FlightRecorder:
     DEFAULT_MAX_EVENTS = 262_144
 
     def __init__(self, clock: Optional[Callable[[], float]] = None,
-                 max_events: Optional[int] = None):
+                 max_events: Optional[int] = None,
+                 pid: Optional[int] = None, process_name: str = "tpusim"):
         self.clock: Callable[[], float] = clock or time.perf_counter
         self._epoch = self.clock()
         self.max_events = (self.DEFAULT_MAX_EVENTS if max_events is None
                            else max(1, int(max_events)))
         self.events: Deque[Dict[str, Any]] = deque(maxlen=self.max_events)
         self.dropped = 0
+        self.dropped_by_category: Dict[str, int] = {}
+        self.pid = PID if pid is None else int(pid)
+        self.process_name = process_name
+        # per-instance track registry: unknown categories get their own
+        # Perfetto track (ISSUE 20) instead of piling onto the shared
+        # "tool" lane — merged multi-process traces stay legible
+        self._tids: Dict[str, int] = dict(_TIDS)
+        # clock anchors for cross-process alignment (tools/trace_merge.py):
+        # name -> recorder-relative microsecond reading of a shared instant
+        self.anchors: Dict[str, float] = {}
         self._lock = threading.Lock()
 
     def _append(self, ev: Dict[str, Any]) -> None:
         # caller holds no lock; the ring drop + counter stay consistent
         with self._lock:
             if len(self.events) == self.max_events:
+                cat = self.events[0].get("cat", "meta")
                 self.dropped += 1
-                _metrics.register().obs_dropped_events.inc()
+                self.dropped_by_category[cat] = \
+                    self.dropped_by_category.get(cat, 0) + 1
+                _metrics.register().obs_dropped_events.inc(cat)
             self.events.append(ev)
+
+    def _tid(self, cat: str) -> int:
+        tid = self._tids.get(cat)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.get(cat)
+                if tid is None:
+                    tid = max(self._tids.values()) + 1
+                    self._tids[cat] = tid
+        return tid
 
     # -- timestamps -------------------------------------------------------
     def _ts(self, t: float) -> float:
         return round((t - self._epoch) * 1e6, 3)
+
+    def now_us(self) -> float:
+        """Recorder-relative timestamp in microseconds — the clock domain
+        shipped in replication hello frames for trace_merge alignment."""
+        return self._ts(self.clock())
+
+    def set_anchor(self, name: str, value: Optional[float] = None) -> None:
+        """Pin a named clock-anchor reading (now by default); exported in
+        ``otherData`` so trace_merge can shift this process's timeline."""
+        self.anchors[name] = self.now_us() if value is None else value
+
+    # -- trace-context stamping -------------------------------------------
+    def _stamp(self, ev: Dict[str, Any]) -> None:
+        """Attach the active TraceContext's ids to an event's args. One
+        contextvar read per event — nothing when no context is active."""
+        ctx = _current_trace()
+        if ctx is not None:
+            args = ev.get("args")
+            if args is None:
+                args = ev["args"] = {}
+            args.setdefault("trace_id", ctx.trace_id)
+            args.setdefault("span_id", ctx.span_id)
 
     # -- recording --------------------------------------------------------
     def span(self, name: str, cat: str = "host") -> Span:
@@ -136,11 +182,12 @@ class FlightRecorder:
             "ph": "X",
             "ts": self._ts(span.t0),
             "dur": round((t1 - span.t0) * 1e6, 3),
-            "pid": PID,
-            "tid": _TIDS.get(span.cat, _TIDS["tool"]),
+            "pid": self.pid,
+            "tid": self._tid(span.cat),
         }
         if span.args:
             ev["args"] = span.args
+        self._stamp(ev)
         self._append(ev)
 
     def add_span(self, name: str, cat: str, t0: float, t1: float,
@@ -152,11 +199,12 @@ class FlightRecorder:
             "ph": "X",
             "ts": self._ts(t0),
             "dur": round((t1 - t0) * 1e6, 3),
-            "pid": PID,
-            "tid": _TIDS.get(cat, _TIDS["tool"]),
+            "pid": self.pid,
+            "tid": self._tid(cat),
         }
         if args:
             ev["args"] = args
+        self._stamp(ev)
         self._append(ev)
 
     def instant(self, name: str, cat: str = "host",
@@ -167,25 +215,70 @@ class FlightRecorder:
             "ph": "i",
             "s": "g",
             "ts": self._ts(self.clock()),
-            "pid": PID,
-            "tid": _TIDS.get(cat, _TIDS["tool"]),
+            "pid": self.pid,
+            "tid": self._tid(cat),
         }
         if args:
             ev["args"] = args
+        self._stamp(ev)
         self._append(ev)
+
+    def _flow(self, ph: str, name: str, flow_id: str, cat: str,
+              args: Optional[Dict[str, Any]] = None) -> None:
+        ev: Dict[str, Any] = {
+            "name": name,
+            "cat": cat,
+            "ph": ph,
+            "id": str(flow_id),
+            "ts": self._ts(self.clock()),
+            "pid": self.pid,
+            "tid": self._tid(cat),
+        }
+        if ph == "f":
+            # bind to the enclosing slice's end, the Perfetto-recommended
+            # terminator so arrows land on the consuming span
+            ev["bp"] = "e"
+        if args:
+            ev["args"] = args
+        self._stamp(ev)
+        self._append(ev)
+
+    def flow_start(self, name: str, flow_id: str, cat: str = "host",
+                   args: Optional[Dict[str, Any]] = None) -> None:
+        """Chrome flow start ('s'): the producing side of a cross-thread /
+        cross-process hand-off. Matched to flow_end by (cat, id)."""
+        self._flow("s", name, flow_id, cat, args)
+
+    def flow_end(self, name: str, flow_id: str, cat: str = "host",
+                 args: Optional[Dict[str, Any]] = None) -> None:
+        """Chrome flow finish ('f'): the consuming side of the hand-off."""
+        self._flow("f", name, flow_id, cat, args)
+
+    def tail(self, limit: int = 100) -> List[Dict[str, Any]]:
+        """The most recent events, oldest-first (the /debug/trace body)."""
+        with self._lock:
+            events = list(self.events)
+        _metrics.register().trace_ring_events.set(len(events))
+        if limit > 0:
+            events = events[-limit:]
+        return events
 
     # -- export -----------------------------------------------------------
     def to_chrome(self) -> Dict[str, Any]:
-        meta = [
-            {"name": "process_name", "ph": "M", "ts": 0, "pid": PID, "tid": 0,
-             "args": {"name": "tpusim"}},
-        ]
-        for cat, tid in sorted(_TIDS.items(), key=lambda kv: kv[1]):
-            meta.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": PID,
-                         "tid": tid, "args": {"name": cat}})
         with self._lock:
             events = list(self.events)
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+            tids = sorted(self._tids.items(), key=lambda kv: kv[1])
+        meta = [
+            {"name": "process_name", "ph": "M", "ts": 0, "pid": self.pid,
+             "tid": 0, "args": {"name": self.process_name}},
+        ]
+        for cat, tid in tids:
+            meta.append({"name": "thread_name", "ph": "M", "ts": 0,
+                         "pid": self.pid, "tid": tid, "args": {"name": cat}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "otherData": {"pid": self.pid,
+                              "process_name": self.process_name,
+                              "anchors": dict(self.anchors)}}
 
     def to_chrome_json(self) -> str:
         return json.dumps(self.to_chrome(), sort_keys=True,
@@ -203,6 +296,19 @@ class FlightRecorder:
         text = self.to_jsonl() if path.endswith(".jsonl") else self.to_chrome_json()
         with open(path, "w") as f:
             f.write(text)
+
+
+# -- trace-context bridge (lazy: tracectx imports this module) -----------
+
+_tracectx: Any = None
+
+
+def _current_trace() -> Any:
+    global _tracectx
+    if _tracectx is None:
+        from tpusim.obs import tracectx
+        _tracectx = tracectx
+    return _tracectx.current()
 
 
 # -- module-level active recorder ----------------------------------------
@@ -242,6 +348,24 @@ def instant(name: str, cat: str = "host",
     rec = _active
     if rec is not None:
         rec.instant(name, cat, args)
+
+
+def flow_start(name: str, flow_id: str, cat: str = "host", site: str = "",
+               args: Optional[Dict[str, Any]] = None) -> None:
+    """Emit a flow start ('s') on the active recorder and count it under
+    tpusim_trace_flows_total{site}; no-op when tracing is disabled."""
+    rec = _active
+    if rec is not None:
+        rec.flow_start(name, flow_id, cat, args)
+        if site:
+            _metrics.register().trace_flows.inc(site)
+
+
+def flow_end(name: str, flow_id: str, cat: str = "host",
+             args: Optional[Dict[str, Any]] = None) -> None:
+    rec = _active
+    if rec is not None:
+        rec.flow_end(name, flow_id, cat, args)
 
 
 # -- telemetry bridges (metrics registry + recorder instants) ------------

@@ -15,6 +15,10 @@ start one of these on a daemon thread next to the runtime:
   on-device utilization/fragmentation sample, HBM residency, compile
   costs, plus a bounded time-series ring (`?limit=N`, default 60).
   `tpusim top` renders this body live.
+- `GET /debug/trace` — the flight recorder's bounded event ring
+  (`?limit=N`, default 100), newest events last, plus the per-category
+  drop counters (ISSUE 20). Bounded exactly like the provenance ring:
+  a deque(maxlen) with drops counted, never an unbounded buffer.
 
 Stdlib-only (http.server): the container bakes no HTTP framework, and a
 scrape endpoint needs none. The handler reads shared state exclusively
@@ -34,6 +38,7 @@ from urllib.parse import parse_qs, urlparse
 from tpusim.framework.metrics import register
 from tpusim.obs import analytics
 from tpusim.obs import provenance
+from tpusim.obs import recorder as flight
 
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -100,6 +105,21 @@ class _Handler(BaseHTTPRequestHandler):
             records = log.tail(limit) if log is not None else []
             self._send(200, "application/json",
                        (json.dumps(records) + "\n").encode())
+        elif parsed.path == "/debug/trace":
+            try:
+                limit = int(parse_qs(parsed.query).get("limit", ["100"])[0])
+            except ValueError:
+                limit = 100
+            rec = flight.get_recorder()
+            if rec is None:
+                body = {"enabled": False, "events": [], "dropped": 0,
+                        "dropped_by_category": {}}
+            else:
+                body = {"enabled": True, "events": rec.tail(limit),
+                        "dropped": rec.dropped,
+                        "dropped_by_category": dict(rec.dropped_by_category)}
+            self._send(200, "application/json",
+                       (json.dumps(body, sort_keys=True) + "\n").encode())
         elif parsed.path == "/analytics":
             try:
                 limit = int(parse_qs(parsed.query).get("limit", ["60"])[0])

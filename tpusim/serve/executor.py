@@ -197,32 +197,39 @@ class ServeExecutor:
                                 "request carries an empty pod list")
         candidates = [(f, "follower") for f in self._replicas.get(ref, ())]
         candidates.append((twin, "resident"))
-        eligible = False
-        for target, path in candidates:
-            if not self._overlay_plan_ok(_twin_session(target), request):
-                continue
-            eligible = True
-            placements = target.overlay_query(request.pods)
-            if placements is None:
-                continue
-            scheduled = sum(1 for p in placements if p.node_name)
-            result = WhatIfResult(placements=placements,
-                                  scheduled=scheduled,
-                                  unschedulable=len(placements) - scheduled)
-            shapes = self._overlay_shapes.setdefault(ref, set())
-            shape = (_budget(len(request.pods)), path)
-            warm = shape in shapes
-            shapes.add(shape)
-            self.stats["overlay_hits"] += 1
-            self.last_path = None
-            register().serve_dispatch.inc("overlay")
-            note_serve("overlay", {"path": path, "ref": ref,
-                                   "pods": len(request.pods)})
-            return result, warm, path
-        if not eligible:
-            register().overlay_fallback.inc("plan_mismatch")
-        self.stats["overlay_fallbacks"] += 1
-        return None
+        with span("serve:overlay") as osp:
+            if osp:
+                osp.set("ref", ref)
+            eligible = False
+            for target, path in candidates:
+                if not self._overlay_plan_ok(_twin_session(target), request):
+                    continue
+                eligible = True
+                placements = target.overlay_query(request.pods)
+                if placements is None:
+                    continue
+                scheduled = sum(1 for p in placements if p.node_name)
+                result = WhatIfResult(
+                    placements=placements, scheduled=scheduled,
+                    unschedulable=len(placements) - scheduled)
+                shapes = self._overlay_shapes.setdefault(ref, set())
+                shape = (_budget(len(request.pods)), path)
+                warm = shape in shapes
+                shapes.add(shape)
+                self.stats["overlay_hits"] += 1
+                self.last_path = None
+                register().serve_dispatch.inc("overlay")
+                if osp:
+                    osp.set("path", path)
+                note_serve("overlay", {"path": path, "ref": ref,
+                                       "pods": len(request.pods)})
+                return result, warm, path
+            if osp:
+                osp.set("path", "fallback")
+            if not eligible:
+                register().overlay_fallback.inc("plan_mismatch")
+            self.stats["overlay_fallbacks"] += 1
+            return None
 
     # -- staging -----------------------------------------------------------
 

@@ -28,8 +28,14 @@ from typing import Callable, List, Optional, Sequence
 
 from tpusim.api.snapshot import ClusterSnapshot
 from tpusim.framework.metrics import register
-from tpusim.obs import slo
-from tpusim.obs.recorder import note_serve, note_serve_retry, span
+from tpusim.obs import slo, tracectx
+from tpusim.obs.recorder import (
+    flow_end,
+    flow_start,
+    note_serve,
+    note_serve_retry,
+    span,
+)
 from tpusim.serve.batcher import Bucket, PendingEntry, ShapeClassBatcher
 from tpusim.serve.executor import ServeExecutor
 from tpusim.serve.queue import AdmissionQueue
@@ -86,15 +92,36 @@ class ScenarioFleet:
                 message: str) -> WhatIfResponse:
         register().serve_rejected.inc(reason)
         note_serve("reject", {"id": request.request_id, "reason": reason})
+        self._end_flows(request)
         return WhatIfResponse(request_id=request.request_id, error=message,
                               rejected=reason)
+
+    def _end_flows(self, request: WhatIfRequest) -> None:
+        """Terminate any still-open trace hand-off arrows for a request
+        that resolves off the happy path (shed, deadline, shutdown) — a
+        flow start without its finish would dangle in the merged graph."""
+        ctx = getattr(request, "trace", None)
+        if ctx is None:
+            return
+        if getattr(request, "_queue_flow", False):
+            request._queue_flow = False
+            flow_end("serve:enqueue", f"{ctx.trace_id}:q")
+        if getattr(request, "_bucket_flow", False):
+            request._bucket_flow = False
+            flow_end("serve:bucket", f"{ctx.trace_id}:b")
 
     def submit(self, request: WhatIfRequest) -> "Future[WhatIfResponse]":
         """Admit one request; the future resolves to a WhatIfResponse (a
         rejection resolves it immediately — submit never raises for
         per-request problems)."""
         future: "Future[WhatIfResponse]" = Future()
-        with span("serve:admit") as sp:
+        # one TraceContext per request lifecycle (ISSUE 20): it rides the
+        # request object across the worker-thread boundary, and the queue
+        # hand-off is a flow arrow keyed on the trace id
+        ctx = tracectx.start()
+        if ctx is not None:
+            request.trace = ctx
+        with tracectx.activate(ctx), span("serve:admit") as sp:
             if sp:
                 sp.set("id", request.request_id)
             admitted, victim = self.queue.offer(
@@ -119,6 +146,10 @@ class ScenarioFleet:
                     else f"admission queue full ({self.queue.maxsize})"))
             else:
                 note_serve("admit", {"id": request.request_id})
+                if ctx is not None:
+                    request._queue_flow = True
+                    flow_start("serve:enqueue", f"{ctx.trace_id}:q",
+                               site="serve")
         return future
 
     # -- pipeline ----------------------------------------------------------
@@ -133,6 +164,17 @@ class ScenarioFleet:
 
     def _process(self, request: WhatIfRequest, future: Future,
                  admitted_at: float) -> None:
+        # re-activate the admission-time TraceContext on this (worker)
+        # thread and close the queue hand-off arrow before any span opens
+        ctx = getattr(request, "trace", None)
+        with tracectx.activate(ctx):
+            if ctx is not None and getattr(request, "_queue_flow", False):
+                request._queue_flow = False
+                flow_end("serve:enqueue", f"{ctx.trace_id}:q")
+            self._process_in_ctx(request, future, admitted_at, ctx)
+
+    def _process_in_ctx(self, request: WhatIfRequest, future: Future,
+                        admitted_at: float, ctx) -> None:
         if self._expired(request, admitted_at):
             # the request aged out waiting in the admission queue: reject
             # before paying for host staging
@@ -150,7 +192,9 @@ class ScenarioFleet:
                 result, warm, path = hit
                 latency = self._clock() - admitted_at
                 reg = register()
-                reg.serve_request_latency.observe(latency * 1e6)
+                reg.serve_request_latency.observe(
+                    latency * 1e6,
+                    exemplar=ctx.trace_id if ctx is not None else None)
                 slo.observe_cycle("serve", latency * 1e6)
                 note_serve("overlay_resolve", {"id": request.request_id,
                                                "path": path})
@@ -175,6 +219,12 @@ class ScenarioFleet:
             full = self.batcher.add(entry)
         note_serve("bucket", {"id": request.request_id,
                               "shape": shape_class.describe()})
+        if ctx is not None:
+            # bucket -> dispatch hand-off: the entry may sit waiting for
+            # shape-class siblings; the arrow lands on whichever dispatch
+            # (or deadline rejection) finally consumes it
+            request._bucket_flow = True
+            flow_start("serve:bucket", f"{ctx.trace_id}:b", site="serve")
         if full is not None:
             self._dispatch(full)
 
@@ -198,8 +248,15 @@ class ScenarioFleet:
             bucket = Bucket(key=bucket.key, size=bucket.size, entries=live)
         reg = register()
         reg.serve_batch_occupancy.observe(len(bucket.entries))
+        # land every member's bucket arrow on this dispatch; the shared
+        # device program then runs under the first member's context so the
+        # dispatch/decode/degraded spans carry a resolvable trace id
+        for entry in bucket.entries:
+            self._end_flows(entry.request)
+        lead = getattr(bucket.entries[0].request, "trace", None)
         try:
-            results, warm = self.executor.dispatch(bucket)
+            with tracectx.activate(lead):
+                results, warm = self.executor.dispatch(bucket)
         except Exception as exc:  # a bucket failure fails its members only
             for entry in bucket.entries:
                 if not entry.future.done():
@@ -211,7 +268,11 @@ class ScenarioFleet:
         degraded = self.executor.last_path
         for entry, result in zip(bucket.entries, results):
             latency = now - entry.admitted_at
-            reg.serve_request_latency.observe(latency * 1e6)
+            entry_ctx = getattr(entry.request, "trace", None)
+            reg.serve_request_latency.observe(
+                latency * 1e6,
+                exemplar=entry_ctx.trace_id if entry_ctx is not None
+                else None)
             slo.observe_cycle("serve", latency * 1e6)
             if not entry.future.done():
                 entry.future.set_result(WhatIfResponse(

@@ -39,6 +39,7 @@ from tpusim.framework.report import GeneralReview, Status, get_report
 from tpusim.framework.store import ADDED, DELETED, MODIFIED, PodQueue, ResourceStore
 from tpusim.framework.strategy import PredictiveStrategy
 from tpusim.obs import recorder as flight
+from tpusim.obs import tracectx
 
 DEFAULT_SCHEDULER_NAME = "TD-Scheduler"  # options.go:49
 
@@ -1214,13 +1215,23 @@ def run_stream_simulation(snapshot: Optional[ClusterSnapshot] = None, *,
             if skip_events:
                 evs = evs[skip_events:]
                 skip_events = 0
-            session.apply_events(evs)
-            batch = gen.batch()
-            t0 = perf_counter()
-            if pipeline:
-                prev = session.schedule_pipelined(batch)
-            else:
-                prev = session.schedule(batch)
+            # one trace context per driver cycle (ISSUE 20): the ingest
+            # span AND the scheduler's own cycle context (a child — same
+            # trace id) share one causal story, so the exported graph
+            # connects ingest → scatter-commit → scan → fold → emit.
+            # start() is None (and everything below a no-op) unless a
+            # flight recorder is installed.
+            with tracectx.activate(tracectx.start()):
+                with flight.span("stream_ingest") as isp:
+                    if isp:
+                        isp.set("events", len(evs))
+                    session.apply_events(evs)
+                batch = gen.batch()
+                t0 = perf_counter()
+                if pipeline:
+                    prev = session.schedule_pipelined(batch)
+                else:
+                    prev = session.schedule(batch)
             latencies.append(perf_counter() - t0)
             if verify:
                 # the reference pictures advance at dispatch time (their

@@ -72,6 +72,8 @@ from tpusim.engine.providers import DEFAULT_PROVIDER
 from tpusim.framework.metrics import register, since_in_microseconds
 from tpusim.framework.store import MODIFIED
 from tpusim.obs import recorder as flight
+from tpusim.obs import tracectx
+from tpusim.obs.recorder import flow_end, flow_start
 from tpusim.stream.persist import (
     _LOADERS,
     StreamPersistence,
@@ -186,24 +188,40 @@ class WalShipper:
 
     def _on_append(self, rec: dict, kind: str, cycle: int,
                    start: int, end: int) -> None:
+        # the hooks fire synchronously on the scheduling thread, so the
+        # active trace context IS the originating cycle's (ISSUE 20): the
+        # frame carries it across the socket and the follower's replay
+        # spans join the leader's trace. The flow `s` is emitted once per
+        # ENQUEUE, not per send — reconnect resends must not duplicate it.
+        ctx = tracectx.current()
         with self._cond:
             seq = len(self._frames)
-            self._frames.append({"t": "rec", "seq": seq, "rec": rec,
-                                 "ofs": end})
+            fr = {"t": "rec", "seq": seq, "rec": rec, "ofs": end}
+            if ctx is not None:
+                fr["tr"] = ctx.to_wire()
+            self._frames.append(fr)
             self._meta.append((perf_counter(), end, True))
             self._end_ofs = end
             self._recs += 1
             self._cond.notify_all()
+        if ctx is not None:
+            flow_start("wal:ship", str(seq), cat="wal", site="wal")
         self._publish_lag()
 
     def _on_checkpoint(self, meta: dict) -> None:
         slim = {k: meta.get(k) for k in _CKPT_FIELDS}
+        ctx = tracectx.current()
         with self._cond:
             seq = len(self._frames)
-            self._frames.append({"t": "ckpt", "seq": seq, "meta": slim})
+            fr = {"t": "ckpt", "seq": seq, "meta": slim}
+            if ctx is not None:
+                fr["tr"] = ctx.to_wire()
+            self._frames.append(fr)
             self._meta.append((perf_counter(), int(meta.get("wal_offset", 0)),
                                False))
             self._cond.notify_all()
+        if ctx is not None:
+            flow_start("wal:ship", str(seq), cat="wal", site="wal")
         self._publish_lag()
 
     def _publish_lag(self) -> None:
@@ -243,6 +261,14 @@ class WalShipper:
                 if hello is None or hello.get("t") != "hello":
                     continue
                 cursor = int(hello.get("next", 0))
+                rec_ = flight.get_recorder()
+                if rec_ is not None and "clk" in hello:
+                    # the clock-alignment handshake (tools/trace_merge.py):
+                    # the follower's recorder-relative reading at hello
+                    # send, paired with OUR reading at hello receive —
+                    # the shared instant both timelines can be shifted to
+                    rec_.set_anchor("peer_clk_us", float(hello["clk"]))
+                    rec_.set_anchor("peer_clk_rx_us")
                 if hello.get("bootstrap") and cursor == 0:
                     snap_fr, cursor = self._bootstrap_frame()
                     if snap_fr is not None:
@@ -497,6 +523,13 @@ class FollowerTwin:
         with self._lock:
             hello = {"t": "hello", "next": self.applied_seq + 1,
                      "chain": self.chain}
+            rec_ = flight.get_recorder()
+            if rec_ is not None:
+                # clock-alignment handshake: our recorder-relative reading
+                # at hello send; the shipper pins it (plus its own receive
+                # reading) as anchors for tools/trace_merge.py
+                hello["clk"] = rec_.now_us()
+                rec_.set_anchor("hello_tx_us", hello["clk"])
             if self._bootstrap and self.applied_seq < 0:
                 hello["bootstrap"] = True
             _send_frame(conn, hello)
@@ -516,6 +549,7 @@ class FollowerTwin:
                                        "chain": chain})
                 continue
             seq = int(fr.get("seq", -1))
+            ctx = tracectx.TraceContext.from_wire(fr.get("tr"))
             with self._lock:
                 if self._stop:
                     return
@@ -523,10 +557,23 @@ class FollowerTwin:
                     continue   # duplicate after a resume race
                 if seq != self.applied_seq + 1:
                     return     # gap: drop; the next hello renegotiates
-                if fr.get("t") == "rec":
-                    self._apply_record(fr["rec"], int(fr.get("ofs", 0)))
-                elif fr.get("t") == "ckpt":
-                    self._apply_ckpt(fr.get("meta") or {})
+                # replay under the LEADER's trace context (ISSUE 20): the
+                # apply span — and every scheduler span the replayed cycle
+                # emits beneath it — carries the originating cycle's trace
+                # id, and the flow `f` closes the leader's `s` arrow. The
+                # dedup/gap guards above already ran, so a reconnect
+                # resend never lands a second `f` for the same seq.
+                with tracectx.activate(ctx), \
+                        flight.span("replicate:apply") as asp:
+                    if asp:
+                        asp.set("seq", seq)
+                        asp.set("frame", str(fr.get("t")))
+                    if ctx is not None:
+                        flow_end("wal:ship", str(seq), cat="wal")
+                    if fr.get("t") == "rec":
+                        self._apply_record(fr["rec"], int(fr.get("ofs", 0)))
+                    elif fr.get("t") == "ckpt":
+                        self._apply_ckpt(fr.get("meta") or {})
                 self.applied_seq = seq
                 chain = self.chain
             register().replication_apply_latency.observe(
@@ -729,7 +776,10 @@ class FollowerTwin:
 
             def recompute(cid: int) -> None:
                 persist.queue_resume(cid)
-                placements = self.session.schedule(self.batches[cid])
+                with flight.span("promote:recompute") as sp:
+                    if sp:
+                        sp.set("cycle", cid)
+                    placements = self.session.schedule(self.batches[cid])
                 report.recomputed.append(cid)
                 self.bound_by_cycle[cid] = [
                     (pl.pod.key(), pl.node_name)
@@ -753,7 +803,14 @@ class FollowerTwin:
 
             inc = self.session.inc
             rsp = flight.span("replicate:promote")
-            with rsp, persist.suppress_events():
+            # one trace context for the whole promotion (ISSUE 20): the
+            # tail-replay timeline — replay, per-cycle recomputes, the
+            # settle pass — shares a single trace id in the export
+            with tracectx.activate(tracectx.start()), rsp, \
+                    persist.suppress_events():
+                tsp = flight.span("promote:tail_replay")
+                if tsp:
+                    tsp.set("records", len(records))
                 for _ofs, rec in records:
                     k, c = rec["k"], int(rec["c"])
                     if k == "ev":
@@ -793,11 +850,14 @@ class FollowerTwin:
                         fold_emit(rec)
                         self.chain_history[persist.cycles_emitted] = \
                             persist.chain
+                if tsp:
+                    tsp.end()
                 # settle everything still open, in cycle order: cycles we
                 # scheduled live but whose emit never became durable get
                 # their emit appended now (our placements ARE the leader's
                 # — per-cycle cross-checks proved it); batch-only crash
                 # tails re-decide deterministically
+                ssp = flight.span("promote:settle")
                 for cid in sorted(set(pending) | set(self._live_pending)):
                     if cid in self._live_pending:
                         persist.log_emit(cid,
@@ -806,6 +866,9 @@ class FollowerTwin:
                     else:
                         pending.remove(cid)
                         recompute(cid)
+                if ssp:
+                    ssp.set("settled_live", len(report.settled_live))
+                    ssp.end()
                 if rsp:
                     rsp.set("tail_records", report.tail_records)
                     rsp.set("recomputed", len(report.recomputed))

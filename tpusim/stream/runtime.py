@@ -112,6 +112,7 @@ from tpusim.obs import analytics
 from tpusim.obs import provenance
 from tpusim.obs import recorder as flight
 from tpusim.obs import slo
+from tpusim.obs import tracectx
 
 log = logging.getLogger(__name__)
 
@@ -275,7 +276,7 @@ class _PendingCycle:
 
     __slots__ = ("pods", "choices", "counts", "compiled", "t0",
                  "dispatched_at", "folded", "bound", "placements",
-                 "wal_cycle")
+                 "wal_cycle", "trace")
 
     def __init__(self, pods, choices=None, counts=None, compiled=None,
                  t0=0.0, dispatched_at=0.0, placements=None,
@@ -292,6 +293,10 @@ class _PendingCycle:
         # WAL cycle id when a persistence layer is attached; None for a
         # sync-buffered cycle (schedule() already journaled its commit)
         self.wal_cycle = wal_cycle
+        # the dispatching cycle's trace context: the deferred decode and
+        # fold-time bind journaling run under THIS cycle's trace, not the
+        # overlapping cycle's (ISSUE 20)
+        self.trace = tracectx.current()
 
 
 class StreamSession:
@@ -429,6 +434,16 @@ class StreamSession:
         re-entrant across the forced latch and the column journal."""
         if not pods:
             return []
+        # one trace context per decision cycle (ISSUE 20): every span the
+        # cycle emits — and every WAL frame it ships — carries this id.
+        # A context already active (serve front door, pipelined degrade)
+        # is the parent; start() is None (and activate a no-op) unless a
+        # flight recorder is installed.
+        with tracectx.activate(tracectx.start(parent=tracectx.current())):
+            return self._schedule_cycle(pods, _routed)
+
+    def _schedule_cycle(self, pods: List[Pod],
+                        _routed=None) -> List[Placement]:
         self.cycles += 1
         inc = self.inc
         t0 = perf_counter()
@@ -1034,10 +1049,12 @@ class StreamSession:
             routed = self._route(pods)
         if routed is not None and routed[0] is None:
             self.cycles += 1
-            t0 = perf_counter()
-            cid = (self.persist.begin_cycle(pods)
-                   if self.persist is not None else None)
-            self._dispatch_async(pods, routed[1], t0, cid)
+            with tracectx.activate(tracectx.start(
+                    parent=tracectx.current())):
+                t0 = perf_counter()
+                cid = (self.persist.begin_cycle(pods)
+                       if self.persist is not None else None)
+                self._dispatch_async(pods, routed[1], t0, cid)
             register().stream_pipeline_depth.set(1.0)
             osp = flight.span("stream_overlap")
             prev = self._finalize(prev_p)
@@ -1092,8 +1109,11 @@ class StreamSession:
         p.folded = True
         if self.persist is not None and p.wal_cycle is not None:
             # journaled at fold time: cycle N's binds land BEFORE cycle
-            # N+1's watch events, the order the host picture mutates
-            self.persist.log_bind(p.wal_cycle, p.bound)
+            # N+1's watch events, the order the host picture mutates —
+            # under cycle N's trace context so the shipped frame links
+            # back to the dispatching cycle, not the overlapping one
+            with tracectx.activate(p.trace):
+                self.persist.log_bind(p.wal_cycle, p.bound)
 
     def _finalize(self, p: Optional[_PendingCycle]
                   ) -> Optional[List[Placement]]:
@@ -1104,22 +1124,23 @@ class StreamSession:
             return None
         if p.placements is not None:
             return p.placements
-        self._fold_binds(p)
-        counts = np.asarray(p.counts)[:len(p.pods)]
-        strings = reason_strings(p.compiled.scalar_names)
-        with flight.span("stream_decode"):
-            placements, _ = _backend.decode_placements(
-                p.pods, p.choices, counts, p.compiled.statics.names, strings,
-                prebound=p.bound)
-        p.placements = placements
-        provenance.capture(placements, "stream",
-                           cycle=p.wal_cycle if p.wal_cycle is not None
-                           else self.cycles)
-        self._note_path("pipelined", len(p.pods))
-        if self.persist is not None and p.wal_cycle is not None:
-            self.persist.log_emit(p.wal_cycle, placements)
-        self._observe_cycle("pipelined", p.t0)
-        return placements
+        with tracectx.activate(p.trace):
+            self._fold_binds(p)
+            counts = np.asarray(p.counts)[:len(p.pods)]
+            strings = reason_strings(p.compiled.scalar_names)
+            with flight.span("stream_decode"):
+                placements, _ = _backend.decode_placements(
+                    p.pods, p.choices, counts, p.compiled.statics.names,
+                    strings, prebound=p.bound)
+            p.placements = placements
+            provenance.capture(placements, "stream",
+                               cycle=p.wal_cycle if p.wal_cycle is not None
+                               else self.cycles)
+            self._note_path("pipelined", len(p.pods))
+            if self.persist is not None and p.wal_cycle is not None:
+                self.persist.log_emit(p.wal_cycle, placements)
+            self._observe_cycle("pipelined", p.t0)
+            return placements
 
     def _dispatch_async(self, pods: List[Pod], cols, t0: float,
                         wal_cycle: Optional[int] = None) -> None:
@@ -1193,7 +1214,10 @@ class StreamSession:
             return None
         m = register()
         m.overlay_queries.inc(_path)
-        m.overlay_latency.observe(since_in_microseconds(t0))
+        ctx = tracectx.current()
+        m.overlay_latency.observe(
+            since_in_microseconds(t0),
+            exemplar=ctx.trace_id if ctx is not None else None)
         return placements
 
     def _overlay_route(self, pods: List[Pod]):
@@ -1381,9 +1405,14 @@ class StreamSession:
 
     def _observe_cycle(self, path: str, t0: float) -> None:
         """Per-cycle latency, twice: the legacy e2e histogram (unchanged
-        semantics) and the per-path stream histogram (ISSUE 9)."""
+        semantics) and the per-path stream histogram (ISSUE 9). When a
+        trace context is active the cycle's trace id rides the histograms
+        as an exemplar (ISSUE 20): the slow-cycle spike on a dashboard
+        resolves to the exact flight-recorder trace that produced it."""
         us = since_in_microseconds(t0)
+        ctx = tracectx.current()
+        ex = ctx.trace_id if ctx is not None else None
         m = register()
-        m.e2e_scheduling_latency.observe(us)
-        m.stream_cycle_latency.observe(path, us)
+        m.e2e_scheduling_latency.observe(us, exemplar=ex)
+        m.stream_cycle_latency.observe(path, us, exemplar=ex)
         slo.observe_cycle(path, us)
